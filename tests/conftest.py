@@ -1,0 +1,36 @@
+"""Test harness: a virtual 8-device CPU mesh emulating a TPU slice.
+
+The reference tests require a real 8-GPU node (SURVEY.md §4); here Pallas
+TPU-interpret mode (``pltpu.InterpretParams``) faithfully emulates remote DMA
+and semaphores across ``xla_force_host_platform_device_count`` CPU devices, so
+the whole distributed test suite runs hardware-free.
+"""
+
+import os
+import sys
+
+# Must run before the CPU client is created.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# The sandbox's sitecustomize force-registers a TPU PJRT plugin; override.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+from triton_distributed_tpu.runtime import initialize_distributed  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def ctx():
+    """1-D 8-way tp mesh over the virtual CPU devices."""
+    assert len(jax.devices()) == 8, (
+        f"expected 8 virtual CPU devices, got {len(jax.devices())} "
+        f"({jax.devices()[0].platform}) — XLA_FLAGS applied too late?"
+    )
+    return initialize_distributed(axis_names=("tp",))

@@ -1,0 +1,33 @@
+"""triton_distributed_tpu — a TPU-native framework for compute–communication
+overlapping kernels.
+
+This package provides, idiomatically on JAX / Pallas / pjit, the capabilities of
+ByteDance's Triton-distributed (reference layer map in SURVEY.md §1):
+
+- ``language``  — distributed device-side primitives (rank/num_ranks, wait/notify,
+  symm_at, put/get with signals) lowered to Pallas-TPU async remote DMA and
+  semaphores over ICI (reference: ``python/triton_dist/language/``).
+- ``runtime``   — host runtime: mesh/topology discovery, symmetric-workspace
+  allocation, ``initialize_distributed``, perf + debug utilities
+  (reference: ``python/triton_dist/utils.py``).
+- ``ops``       — tile-centric overlapped kernel library: AllGather (+GEMM),
+  GEMM(+ReduceScatter), AllReduce (+GEMM epilogue), low-latency MoE AllToAll,
+  SP attention, distributed flash-decode
+  (reference: ``python/triton_dist/kernels/nvidia/``).
+- ``parallel``  — TP/EP/SP/PP model layers
+  (reference: ``python/triton_dist/layers/nvidia/``).
+- ``models``    — model configs, dense + MoE LLMs, KV cache, inference engine
+  (reference: ``python/triton_dist/models/``).
+- ``megakernel``— persistent single-kernel runtime: task queues + semaphore
+  scoreboard in one Pallas kernel
+  (reference: ``python/triton_dist/mega_triton_kernel/``).
+- ``tools``     — AOT compilation helpers (reference: ``python/triton_dist/tools/``).
+"""
+
+__version__ = "0.1.0"
+
+from triton_distributed_tpu.runtime import (  # noqa: F401
+    initialize_distributed,
+    get_context,
+    DistContext,
+)

@@ -1,0 +1,27 @@
+"""Device-side distributed primitives (used *inside* Pallas kernels).
+
+TPU-native analog of the reference DSL layer:
+- ``python/triton_dist/language/distributed_ops.py`` (wait/consume_token/rank/
+  num_ranks/symm_at/notify, :57-111)
+- ``python/triton_dist/language/extra/libshmem_device.py`` (the SHMEM device
+  API surface, :28-341)
+
+On TPU the primitives are Pallas helper functions lowering to Mosaic async
+remote DMA and semaphore ops over ICI, rather than extern calls into an
+NVSHMEM bitcode library.
+"""
+
+from triton_distributed_tpu.language.distributed_ops import (  # noqa: F401
+    rank,
+    num_ranks,
+    wait,
+    notify,
+    consume_token,
+    SignalOp,
+    CommScope,
+)
+from triton_distributed_tpu.language import shmem_device  # noqa: F401
+from triton_distributed_tpu.language.core import (  # noqa: F401
+    kernel_call,
+    next_collective_id,
+)

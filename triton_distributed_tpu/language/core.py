@@ -1,0 +1,120 @@
+"""Kernel-call plumbing shared by every distributed Pallas kernel.
+
+Plays the role of the reference's compiler-backend glue
+(``backends/nvidia/backend/compiler.py:355-640``): a single entry point that
+wires up memory spaces, side-effect flags, collective ids and interpret mode so
+op authors write only the kernel body.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Sequence
+
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu.runtime.context import use_interpret
+
+# Collective ids scope the global barrier semaphore (pltpu.get_barrier_semaphore).
+# Two kernels that could be in flight concurrently must not share an id, and a
+# given kernel definition must keep the same id across retraces (new shapes),
+# so ids are a stable registry keyed by kernel identity — never recycled, and
+# exhaustion is an error rather than silent aliasing. (The reference needs no
+# analog — NVSHMEM teams play this role.)
+_collective_ids: dict = {}
+_collective_id_counter = itertools.count(0)
+_collective_id_lock = threading.Lock()
+_MAX_COLLECTIVE_IDS = 64
+
+
+def next_collective_id(key=None) -> int:
+    """Stable collective id for ``key`` (a kernel function, typically)."""
+    with _collective_id_lock:
+        if key is not None and key in _collective_ids:
+            return _collective_ids[key]
+        cid = next(_collective_id_counter)
+        if cid >= _MAX_COLLECTIVE_IDS:
+            raise RuntimeError(
+                f"exhausted {_MAX_COLLECTIVE_IDS} collective ids; pass "
+                "collective_id explicitly to share barrier semaphores between "
+                "kernels that never run concurrently"
+            )
+        if key is not None:
+            _collective_ids[key] = cid
+        return cid
+
+
+def kernel_call(
+    kernel,
+    out_shape: Any,
+    *,
+    grid: tuple | None = None,
+    in_specs: Sequence[pl.BlockSpec] | None = None,
+    out_specs: Any | None = None,
+    scratch_shapes: Sequence[Any] = (),
+    uses_barrier: bool = False,
+    collective_id: int | None = None,
+    interpret: bool | None = None,
+    cost_estimate: pl.CostEstimate | None = None,
+    vmem_limit_bytes: int | None = None,
+    input_output_aliases: dict | None = None,
+):
+    """Build a ``pl.pallas_call`` preconfigured for distributed kernels.
+
+    Defaults: refs live in ANY memory space (kernels DMA slices explicitly,
+    like the reference's tile-level TMA loads), side effects enabled so comm
+    kernels aren't DCE'd, interpret mode auto-selected off-TPU.
+    """
+    if interpret is None:
+        interpret = use_interpret()
+    params = {}
+    # Mosaic only accepts a collective_id when the kernel actually touches the
+    # global barrier semaphore (get_barrier_semaphore); setting it untouched is
+    # a compile error on real TPU (interpret mode is lenient — don't rely on it).
+    if uses_barrier or collective_id is not None:
+        params["collective_id"] = (
+            next_collective_id(key=kernel) if collective_id is None else collective_id
+        )
+    if vmem_limit_bytes is not None:
+        params["vmem_limit_bytes"] = vmem_limit_bytes
+    compiler_params = pltpu.CompilerParams(has_side_effects=True, **params)
+
+    kwargs: dict[str, Any] = dict(
+        out_shape=out_shape,
+        scratch_shapes=list(scratch_shapes),
+        compiler_params=compiler_params,
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )
+    if grid is not None:
+        kwargs["grid"] = grid
+    if in_specs is not None:
+        kwargs["in_specs"] = list(in_specs)
+    if out_specs is not None:
+        kwargs["out_specs"] = out_specs
+    if cost_estimate is not None:
+        kwargs["cost_estimate"] = cost_estimate
+    if input_output_aliases:
+        kwargs["input_output_aliases"] = input_output_aliases
+    return pl.pallas_call(kernel, **kwargs)
+
+
+ANY = pl.ANY
+
+
+def any_spec() -> pl.BlockSpec:
+    return pl.BlockSpec(memory_space=pl.ANY)
+
+
+def vmem_spec(block_shape=None, index_map=None) -> pl.BlockSpec:
+    if block_shape is None:
+        return pl.BlockSpec(memory_space=pltpu.VMEM)
+    return pl.BlockSpec(block_shape, index_map, memory_space=pltpu.VMEM)
+
+
+def smem_spec(block_shape=None) -> pl.BlockSpec:
+    if block_shape is None:
+        return pl.BlockSpec(memory_space=pltpu.SMEM)
+    return pl.BlockSpec(block_shape, memory_space=pltpu.SMEM)

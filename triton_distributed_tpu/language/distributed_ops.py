@@ -1,0 +1,90 @@
+"""Core distributed primitives: rank/num_ranks/wait/notify/consume_token.
+
+Reference surface: ``python/triton_dist/language/distributed_ops.py``:
+  wait(:57) consume_token(:74) rank(:84) num_ranks(:90) symm_at(:96) notify(:103)
+lowered there by ``DistributedOpToLLVM.cpp`` to PTX spin loops / nvshmem calls.
+
+TPU lowering (this file): semaphores + Mosaic remote ops. Semantics notes:
+
+* The reference's ``wait`` spins on a 64-bit symmetric flag with acquire
+  semantics and returns a token; ``consume_token`` attaches the token to a
+  load to order it after the wait (DistributedOps.td:45,79). On TPU the
+  ordering is structural — a ref read sequenced after ``semaphore_wait``
+  in the kernel body is ordered by construction — so ``consume_token`` is a
+  no-op kept for kernel-author parity.
+
+* TPU ``semaphore_wait(sem, v)`` CONSUMES: it blocks until the count >= v and
+  then subtracts v (unlike NVSHMEM ``signal_wait_until`` which leaves the flag
+  set). Producer/consumer protocols in this framework therefore speak in
+  *deltas*: each producer signal is matched by exactly one consumer wait.
+  ``signal_wait_until``-style level semantics are available via
+  ``shmem_device.signal_wait_until`` which re-signals after the wait.
+
+* ``symm_at(ptr, rank)`` (address translation into the symmetric heap) has no
+  TPU analog because Pallas kernels never hold raw peer pointers; instead every
+  remote copy/signal names its peer via ``device_id``. Use
+  ``shmem_device.putmem_nbi_block(..., peer=r)`` / ``getmem_nbi_block``.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax
+from jax.experimental.pallas import tpu as pltpu
+
+
+class SignalOp(enum.Enum):
+    """Reference enum ``SignalOp{SET, ADD}`` (DistributedAttrDefs.td:36-44).
+
+    TPU semaphores only support ADD (signal = increment). SET is emulated where
+    needed by protocol design (counters are reset by consuming waits).
+    """
+
+    ADD = "add"
+    SET = "set"
+
+
+class CommScope(enum.Enum):
+    """Reference enum ``CommScope{GPU, INTRA_NODE, INTER_NODE}``
+    (DistributedAttrDefs.td:45-53) → TPU tiers core / ICI / DCN."""
+
+    CORE = "core"          # within-chip (reference: GPU scope)
+    ICI = "ici"            # intra-slice interconnect (reference: INTRA_NODE)
+    DCN = "dcn"            # inter-slice network (reference: INTER_NODE)
+
+
+def rank(axis: str = "tp"):
+    """This device's index along ``axis`` (reference distributed_ops.py:84
+    ``rank(axis)`` → GetRankOp). Valid inside shard_map-ed kernels."""
+    return jax.lax.axis_index(axis)
+
+
+def num_ranks(axis: str = "tp"):
+    """World size along ``axis`` (reference distributed_ops.py:90)."""
+    return jax.lax.axis_size(axis)
+
+
+def wait(sem, value: int = 1):
+    """Block until ``sem`` has been signalled ``value`` times, consuming them.
+
+    Reference distributed_ops.py:57 ``wait(barrierPtrs, numBarriers, scope,
+    semantic)`` → per-warp acquire spin loop (DistributedOpToLLVM.cpp:146-219).
+    Returns a token (always 0) for ``consume_token`` parity.
+    """
+    pltpu.semaphore_wait(sem, value)
+    return 0
+
+
+def consume_token(value, token):
+    """No-op on TPU (see module docstring); reference distributed_ops.py:74."""
+    del token
+    return value
+
+
+def notify(sem, peer, inc: int = 1, axis_type=pltpu.DeviceIdType.LOGICAL):
+    """Signal ``sem`` on device ``peer`` (reference distributed_ops.py:103
+    ``notify(ptr, rank, signal, sig_op, comm_scope)`` → nvshmemx_signal_op /
+    remote st; DistributedOpToLLVM.cpp:233-343). ADD semantics only.
+    """
+    pltpu.semaphore_signal(sem, inc=inc, device_id=peer, device_id_type=axis_type)

@@ -1,0 +1,150 @@
+"""SHMEM-style device API over Pallas-TPU remote DMA.
+
+Reference surface: ``python/triton_dist/language/extra/libshmem_device.py``
+(:28-341) — my_pe/n_pes, putmem/getmem {,nbi}{,_block}, putmem_signal*,
+signal_op, signal_wait_until, barrier/sync family — backed there by the
+NVSHMEM device wrapper library (shmem/nvshmem_bind/runtime/nvshmem_wrapper.cu).
+
+TPU mapping (SURVEY.md §7):
+  putmem_nbi_block       → ``make_async_remote_copy(...).start()`` (push over ICI)
+  putmem_signal_nbi      → same; the DMA delivers the recv semaphore increment,
+                           which *is* the signal (no separate flag write needed)
+  signal_op              → ``semaphore_signal(..., device_id=peer)``
+  signal_wait_until      → ``semaphore_wait`` (+ re-signal for level semantics)
+  barrier_all / sync_all → full-mesh signal + wait on the barrier semaphore
+  fence/quiet            → ``.wait_send()`` on outstanding DMA handles
+  getmem                 → NOT a TPU primitive: remote reads don't exist on the
+                           ICI fabric; pull-style algorithms are expressed as
+                           peers pushing (see ops/allgather.py pull variant
+                           for the two-sided emulation).
+
+All helpers are *device-side*: call them inside a Pallas kernel that runs under
+``shard_map`` over the communication axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu.language.distributed_ops import rank as my_pe  # noqa: F401
+from triton_distributed_tpu.language.distributed_ops import num_ranks as n_pes  # noqa: F401
+
+LOGICAL = pltpu.DeviceIdType.LOGICAL
+
+# NVSHMEM comparison constants (libshmem_device.py:…; only the ones a
+# semaphore can express).
+CMP_EQ = "eq"
+CMP_GE = "ge"
+
+
+def putmem_nbi_block(src_ref, dst_ref, send_sem, recv_sem, peer):
+    """Non-blocking push of ``src_ref`` (local) into ``dst_ref`` on ``peer``.
+
+    Returns the DMA handle; call ``.wait_send()`` for quiet/fence semantics or
+    ``.wait()`` to also consume the local recv semaphore (only meaningful when
+    the peer pushes back symmetrically).
+
+    Reference: ``libshmem_device.putmem_nbi_block`` → nvshmem_putmem_nbi_block
+    wrapper (nvshmem_wrapper.cu).
+    """
+    rdma = pltpu.make_async_remote_copy(
+        src_ref=src_ref,
+        dst_ref=dst_ref,
+        send_sem=send_sem,
+        recv_sem=recv_sem,
+        device_id=peer,
+        device_id_type=LOGICAL,
+    )
+    rdma.start()
+    return rdma
+
+
+def putmem_block(src_ref, dst_ref, send_sem, recv_sem, peer):
+    """Blocking push: start + wait for local completion (send side).
+
+    Reference: ``libshmem_device.putmem_block``."""
+    rdma = putmem_nbi_block(src_ref, dst_ref, send_sem, recv_sem, peer)
+    rdma.wait_send()
+    return rdma
+
+
+def putmem_signal_nbi_block(src_ref, dst_ref, send_sem, recv_sem, peer):
+    """Push + signal, fused (NVSHMEM ``putmem_signal_nbi_block``).
+
+    On TPU the remote DMA increments ``recv_sem`` *on the destination device*
+    only when the payload has landed — the recv semaphore IS the signal, with
+    delivery ordering guaranteed by hardware. The consumer waits it with the
+    DMA handle's ``.wait_recv()`` (or an equal-count handle built over the
+    same refs, since all devices run the same kernel body).
+
+    There is deliberately no "signal a second, unrelated semaphore after the
+    data" variant: a sender-side ``semaphore_signal`` travels independently of
+    the DMA payload and can overtake it, so such an API could not honor
+    NVSHMEM's signal-after-data contract. Protocols needing a separate
+    counter should signal it from the *receiver* after ``wait_recv()``.
+    """
+    return putmem_nbi_block(src_ref, dst_ref, send_sem, recv_sem, peer)
+
+
+def signal_op(sem, peer, inc: int = 1):
+    """Remote signal: add ``inc`` to ``sem`` on ``peer``
+    (reference ``libshmem_device.signal_op`` / NotifyOp ADD path)."""
+    pltpu.semaphore_signal(sem, inc=inc, device_id=peer, device_id_type=LOGICAL)
+
+
+def signal_wait_until(sem, value: int, consume: bool = True):
+    """Wait until ``sem`` has accumulated ``value`` signals.
+
+    ``consume=True`` (default) is delta semantics: the count is subtracted —
+    the natural TPU protocol. ``consume=False`` emulates NVSHMEM's level
+    semantics (signal_wait_until leaves the flag set) by re-signalling
+    locally after the wait; use only when a single consumer polls the flag.
+    """
+    pltpu.semaphore_wait(sem, value)
+    if not consume:
+        pltpu.semaphore_signal(sem, inc=value)
+
+
+def barrier_all(axis: str = "tp"):
+    """Full-mesh barrier across ``axis`` inside a kernel.
+
+    Reference: ``libshmem_device.barrier_all`` / the two-phase intra-node
+    barrier ``barrier_all_intra_node_non_atomic`` (common_ops.py:171-210).
+    Every device signals every other device once on the global barrier
+    semaphore, then waits for n-1 signals. Requires the enclosing kernel to
+    carry a ``collective_id``.
+    """
+    n = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    sem = pltpu.get_barrier_semaphore()
+
+    def body(i, _):
+        peer = jax.lax.rem(me + 1 + i, n)
+        pltpu.semaphore_signal(sem, inc=1, device_id=peer, device_id_type=LOGICAL)
+        return 0
+
+    jax.lax.fori_loop(0, n - 1, body, 0)
+    pltpu.semaphore_wait(sem, n - 1)
+
+
+def sync_all(axis: str = "tp"):
+    """Alias of :func:`barrier_all` (NVSHMEM distinguishes barrier_all —
+    which also quiets outstanding puts — from sync_all; on TPU callers quiet
+    explicitly by waiting their DMA handles)."""
+    barrier_all(axis)
+
+
+def fence():
+    """Ordering fence between puts to the same peer. TPU DMAs on one device
+    complete in issue order per destination; explicit fences are expressed by
+    waiting the send semaphore of the prior put (no-op marker for parity)."""
+    return None
+
+
+def quiet(*rdma_handles):
+    """Complete all given outstanding puts (NVSHMEM quiet takes no args; TPU
+    tracks DMAs by handle, so pass the handles to quiesce)."""
+    for h in rdma_handles:
+        h.wait_send()

@@ -1,0 +1,27 @@
+"""Host runtime: mesh/topology, symmetric buffers, init, perf/debug utilities.
+
+TPU-native analog of the reference host runtime ``python/triton_dist/utils.py``
+(``initialize_distributed`` at utils.py:182, ``nvshmem_create_tensor`` at
+utils.py:114, barriers/profiling/topology at utils.py:162-1048).
+"""
+
+from triton_distributed_tpu.runtime.context import (  # noqa: F401
+    DistContext,
+    initialize_distributed,
+    get_context,
+    set_context,
+    use_interpret,
+    shard_map_on,
+)
+from triton_distributed_tpu.runtime.symm import (  # noqa: F401
+    symm_zeros,
+    symm_full,
+    SymmetricWorkspace,
+)
+from triton_distributed_tpu.runtime.utils import (  # noqa: F401
+    dist_print,
+    perf_func,
+    assert_allclose,
+    cdiv,
+    round_up,
+)

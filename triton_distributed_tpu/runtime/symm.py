@@ -1,0 +1,87 @@
+"""Symmetric-buffer allocation.
+
+Reference: NVSHMEM symmetric heap + ``nvshmem_create_tensor(s)`` (utils.py:114,121)
+— every rank allocates identically-shaped buffers; device code translates
+local↔remote addresses via ``symm_at``/``nvshmem_ptr``.
+
+TPU-native design (SURVEY.md §7 mapping table): a "symmetric tensor" is one
+global array whose leading axis is sharded over the communication axis, so each
+device holds an identically-shaped per-device slab of HBM. Inside a
+``shard_map``-ed Pallas kernel the local slab is an ordinary ref; peers are
+addressed by logical device id in ``make_async_remote_copy`` /
+``semaphore_signal`` — there is no raw peer pointer, which is what makes this
+safe (the role the symmetric-heap address translation plays on GPU).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_distributed_tpu.runtime.context import DistContext
+
+
+def _symm_sharding(ctx: DistContext, axis: str | None = None) -> NamedSharding:
+    axis = axis or ctx.tp_axis
+    return NamedSharding(ctx.mesh, P(axis))
+
+
+def symm_zeros(
+    ctx: DistContext,
+    shape: Sequence[int],
+    dtype: Any = jnp.float32,
+    axis: str | None = None,
+) -> jax.Array:
+    """Allocate a zeroed symmetric buffer: per-device shape ``shape``.
+
+    Returns a global array of shape ``(num_ranks, *shape)`` sharded over
+    ``axis`` — the analog of ``nvshmem_create_tensor(shape, dtype)``
+    (utils.py:114), except the "heap" is ordinary sharded HBM.
+    """
+    axis = axis or ctx.tp_axis
+    n = ctx.axis_size(axis)
+    return jax.device_put(
+        jnp.zeros((n, *shape), dtype=dtype), _symm_sharding(ctx, axis)
+    )
+
+
+def symm_full(
+    ctx: DistContext,
+    shape: Sequence[int],
+    fill_value,
+    dtype: Any = jnp.float32,
+    axis: str | None = None,
+) -> jax.Array:
+    axis = axis or ctx.tp_axis
+    n = ctx.axis_size(axis)
+    return jax.device_put(
+        jnp.full((n, *shape), fill_value, dtype=dtype), _symm_sharding(ctx, axis)
+    )
+
+
+@dataclasses.dataclass
+class SymmetricWorkspace:
+    """A named bag of symmetric buffers, the analog of a per-op ``*Context``
+    dataclass in the reference (e.g. AllGatherGEMMTensorParallelContext,
+    allgather_gemm.py:417-487): symmetric workspace + barrier/signal buffers
+    created once and reused across calls.
+    """
+
+    ctx: DistContext
+    buffers: dict = dataclasses.field(default_factory=dict)
+
+    def add_zeros(self, name: str, shape: Sequence[int], dtype=jnp.float32,
+                  axis: str | None = None) -> jax.Array:
+        buf = symm_zeros(self.ctx, shape, dtype, axis)
+        self.buffers[name] = buf
+        return buf
+
+    def __getitem__(self, name: str) -> jax.Array:
+        return self.buffers[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.buffers

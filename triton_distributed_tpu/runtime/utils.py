@@ -1,0 +1,103 @@
+"""Perf / debug / testing utilities.
+
+Reference: ``python/triton_dist/utils.py`` — ``perf_func`` (:274), ``dist_print``
+(:289-318), ``assert_allclose`` (:870), straggler injection (allreduce.py:137),
+``group_profile`` (:505). TPU analogs built on jax timing + jax.profiler.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def round_up(a: int, b: int) -> int:
+    return cdiv(a, b) * b
+
+
+def dist_print(*args, rank: int | None = None, prefix: bool = True, **kwargs):
+    """Rank-aware print (reference utils.py:289). On TPU there is one host
+    process per slice, so "rank" is a logical tag rather than a process id."""
+    debug_only = kwargs.pop("debug", False)
+    if debug_only and os.environ.get("TDTPU_DEBUG", "0") == "0":
+        return
+    tag = f"[rank {rank}] " if (prefix and rank is not None) else ""
+    print(tag + " ".join(str(a) for a in args), **kwargs)
+
+
+def perf_func(
+    fn: Callable[[], Any],
+    iters: int = 10,
+    warmup_iters: int = 3,
+) -> tuple[Any, float]:
+    """Measure mean wall-clock ms of ``fn`` with warmup (reference utils.py:274).
+
+    Blocks on all output arrays each iteration (the jax analog of
+    cuda-event timing around a stream).
+    """
+    out = None
+    for _ in range(warmup_iters):
+        out = fn()
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    jax.block_until_ready(out)
+    dt_ms = (time.perf_counter() - t0) * 1e3 / max(iters, 1)
+    return out, dt_ms
+
+
+def assert_allclose(x, y, atol: float = 1e-3, rtol: float = 1e-3, verbose: bool = True):
+    """Golden comparison (reference utils.py:870)."""
+    x = np.asarray(x)
+    y = np.asarray(y)
+    if x.shape != y.shape:
+        raise AssertionError(f"shape mismatch {x.shape} vs {y.shape}")
+    if not np.allclose(x, y, atol=atol, rtol=rtol):
+        bad = ~np.isclose(x, y, atol=atol, rtol=rtol)
+        n_bad = int(bad.sum())
+        idx = np.argwhere(bad)[:5]
+        msg = (
+            f"allclose failed: {n_bad}/{x.size} mismatches "
+            f"(atol={atol}, rtol={rtol}); first bad idx {idx.tolist()}; "
+            f"x={x[bad][:5].tolist()} y={y[bad][:5].tolist()}"
+        )
+        raise AssertionError(msg)
+    if verbose:
+        dist_print(f"✅ allclose ok shape={x.shape} dtype={x.dtype}")
+
+
+@contextlib.contextmanager
+def group_profile(name: str | None = None, do_prof: bool = False, log_dir: str = "prof"):
+    """jax.profiler trace context (reference ``group_profile`` utils.py:505-591
+    wrapping torch.profiler; here one Perfetto trace per host)."""
+    if not do_prof or name is None:
+        yield
+        return
+    path = os.path.join(log_dir, name)
+    os.makedirs(path, exist_ok=True)
+    with jax.profiler.trace(path):
+        yield
+
+
+def straggler_delay_ns(straggler_option: tuple[int, int] | None, rank: int) -> int:
+    """Compute the artificial per-rank straggler delay, in nanoseconds.
+
+    Reference injects stragglers via ``torch.cuda._sleep`` on one rank
+    (allgather_gemm.py:602-603, allreduce.py:137) to widen race windows. On
+    TPU we thread this value into kernels that spin via ``pl.delay``.
+    """
+    if straggler_option is None:
+        return 0
+    s_rank, ns = straggler_option
+    return int(ns) if rank == s_rank else 0
