@@ -47,6 +47,23 @@ def next_collective_id(key=None) -> int:
         return cid
 
 
+def _interpret_params() -> pltpu.InterpretParams:
+    """Interpret-mode knobs (env-tunable for debugging):
+    TDTPU_INTERPRET_DMA_MODE=eager|on_wait, TDTPU_DETECT_RACES=1.
+
+    Default is "eager": hardware DMA engines progress independently of
+    semaphore waits, which eager models; the interpreter's "on_wait" scheduler
+    can drop remote writes whose completion is observed via the
+    identically-shaped-handle wait idiom (see shmem_device.wait_deliveries).
+    """
+    import os
+
+    return pltpu.InterpretParams(
+        dma_execution_mode=os.environ.get("TDTPU_INTERPRET_DMA_MODE", "eager"),
+        detect_races=os.environ.get("TDTPU_DETECT_RACES", "0") == "1",
+    )
+
+
 def kernel_call(
     kernel,
     out_shape: Any,
@@ -75,8 +92,14 @@ def kernel_call(
     # global barrier semaphore (get_barrier_semaphore); setting it untouched is
     # a compile error on real TPU (interpret mode is lenient — don't rely on it).
     if uses_barrier or collective_id is not None:
+        # Key on the underlying function so retraces of the same kernel (new
+        # shapes via fresh functools.partial wrappers) reuse one id instead of
+        # leaking toward the 64-id cap. Distinct kernel *functions* still get
+        # distinct ids (two launches of the same kernel are ordered per device
+        # by XLA program order, so sharing an id across shapes is safe).
+        key = getattr(kernel, "func", kernel)
         params["collective_id"] = (
-            next_collective_id(key=kernel) if collective_id is None else collective_id
+            next_collective_id(key=key) if collective_id is None else collective_id
         )
     if vmem_limit_bytes is not None:
         params["vmem_limit_bytes"] = vmem_limit_bytes
@@ -86,7 +109,7 @@ def kernel_call(
         out_shape=out_shape,
         scratch_shapes=list(scratch_shapes),
         compiler_params=compiler_params,
-        interpret=pltpu.InterpretParams() if interpret else False,
+        interpret=_interpret_params() if interpret else False,
     )
     if grid is not None:
         kwargs["grid"] = grid

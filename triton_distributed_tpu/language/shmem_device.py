@@ -148,3 +148,17 @@ def quiet(*rdma_handles):
     tracks DMAs by handle, so pass the handles to quiesce)."""
     for h in rdma_handles:
         h.wait_send()
+
+
+def wait_deliveries(like_ref, sem, count: int):
+    """Wait for ``count`` incoming DMA deliveries on ``sem``, each of the byte
+    size of ``like_ref``.
+
+    DMA semaphores count bytes and can only be waited through a handle; the
+    standard Pallas idiom is to construct a copy descriptor of identical shape
+    and wait it without starting it. This is the receive half of
+    ``signal_wait_until`` for put-with-signal protocols (SURVEY.md §7: wait /
+    signal_wait_until → semaphore wait).
+    """
+    for _ in range(count):
+        pltpu.make_async_copy(like_ref, like_ref, sem).wait()

@@ -1,0 +1,18 @@
+"""Tile-centric overlapped kernel library.
+
+TPU-native analog of ``python/triton_dist/kernels/nvidia/`` (SURVEY.md §2.4):
+each op ships a Pallas-TPU implementation (remote DMA + semaphores over ICI)
+plus an XLA-collective reference used as golden and fallback.
+"""
+
+from triton_distributed_tpu.ops.allgather import (  # noqa: F401
+    AllGatherMethod,
+    all_gather,
+    get_auto_all_gather_method,
+)
+from triton_distributed_tpu.ops.reduce_scatter import reduce_scatter  # noqa: F401
+from triton_distributed_tpu.ops.allreduce import (  # noqa: F401
+    AllReduceMethod,
+    all_reduce,
+    get_auto_allreduce_method,
+)

@@ -1,0 +1,170 @@
+"""AllGather producers over ICI.
+
+Reference: ``python/triton_dist/kernels/nvidia/allgather.py`` — 7 methods
+(AllGatherMethod enum :46-54): full-mesh push/pull, 1D/2D rings, inter-node
+NVSHMEM variants, auto-selected by topology (:57). On a TPU slice the ICI
+fabric is a torus with uniform links, so the method space collapses to:
+
+- ``FULL_MESH_PUSH``: every device pushes its shard to all peers
+  simultaneously — lowest latency for small messages (the analog of the
+  reference's push + the low-latency AG of low_latency_allgather.py).
+- ``RING_1D``: bandwidth-optimal neighbor ring — each chunk takes n-1 hops,
+  every link busy every step (the analog of cp_engine_producer_all_gather_
+  ring_push_1d, allgather.py:140).
+- ``XLA``: ``jax.lax.all_gather`` — XLA's own collective, used as golden.
+
+All Pallas variants gather *in place into the output buffer*, so a consumer
+kernel given per-chunk semaphores can start compute before the gather
+completes — that overlap form lives in ops/allgather_gemm.py.
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu import language as dl
+from triton_distributed_tpu.language import shmem_device as shmem
+from triton_distributed_tpu.language.core import kernel_call, any_spec
+from triton_distributed_tpu.runtime.context import DistContext, get_context
+from triton_distributed_tpu.runtime.jit_cache import cached_shard_jit
+
+
+class AllGatherMethod(enum.Enum):
+    """Reference enum allgather.py:46-54, collapsed to the TPU method space."""
+
+    AUTO = "auto"
+    FULL_MESH_PUSH = "full_mesh_push"
+    RING_1D = "ring_1d"
+    XLA = "xla"
+
+
+def get_auto_all_gather_method(nbytes: int, num_ranks: int) -> AllGatherMethod:
+    """Topology/size-based auto-selection (reference allgather.py:57
+    ``get_auto_all_gather_method``): small payloads favor the single-hop
+    full-mesh push (latency-bound), large payloads the ring (which never
+    oversubscribes a link)."""
+    if nbytes <= 256 * 1024 or num_ranks <= 2:
+        return AllGatherMethod.FULL_MESH_PUSH
+    return AllGatherMethod.RING_1D
+
+
+def _ag_full_mesh_push_kernel(n: int, axis: str, m: int,
+                              x_ref, out_ref, send_sems, recv_sem, copy_sem):
+    """Every device pushes its local shard into slot ``me`` of every peer's
+    output (reference cp_engine_producer_all_gather_full_mesh_push,
+    allgather.py:81)."""
+    me = dl.rank(axis)
+    # Entry barrier: guarantees no peer is still in a previous launch whose
+    # buffers our remote writes could land in (role of local_copy_and_
+    # barrier_all, allgather_gemm.py:107).
+    shmem.barrier_all(axis)
+    my_slot = out_ref.at[pl.ds(me * m, m)]
+    local = pltpu.make_async_copy(x_ref, my_slot, copy_sem)
+    local.start()
+    handles = []
+    for i in range(n - 1):
+        peer = jax.lax.rem(me + 1 + i, n)
+        handles.append(
+            shmem.putmem_nbi_block(x_ref, my_slot, send_sems.at[i], recv_sem, peer)
+        )
+    local.wait()
+    shmem.quiet(*handles)
+    shmem.wait_deliveries(x_ref, recv_sem, n - 1)
+
+
+def _ag_ring_kernel(n: int, axis: str, m: int,
+                    x_ref, out_ref, send_sem, recv_sem, copy_sem):
+    """Bandwidth-optimal 1-D ring: forward the chunk received last step
+    (reference cp_engine_producer_all_gather_ring_push_1d, allgather.py:140).
+    The output buffer doubles as the ring transport: chunks land directly in
+    their final slots, so per-chunk readiness is observable by a consumer."""
+    me = dl.rank(axis)
+    shmem.barrier_all(axis)
+    right = jax.lax.rem(me + 1, n)
+    local = pltpu.make_async_copy(x_ref, out_ref.at[pl.ds(me * m, m)], copy_sem)
+    local.start()
+    local.wait()
+    for s in range(n - 1):
+        chunk = jax.lax.rem(me - s + n, n)  # chunk acquired at step s-1 (own at s=0)
+        slot = out_ref.at[pl.ds(chunk * m, m)]
+        h = shmem.putmem_nbi_block(slot, slot, send_sem, recv_sem, right)
+        # Receive chunk (me-1-s) from the left before forwarding it next step.
+        shmem.wait_deliveries(x_ref, recv_sem, 1)
+        h.wait_send()
+
+
+def _build_ag_call(n: int, axis: str, m: int, cols: int, dtype,
+                   method: AllGatherMethod):
+    if method == AllGatherMethod.FULL_MESH_PUSH:
+        kernel = functools.partial(_ag_full_mesh_push_kernel, n, axis, m)
+        scratch = [
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+        ]
+    elif method == AllGatherMethod.RING_1D:
+        kernel = functools.partial(_ag_ring_kernel, n, axis, m)
+        scratch = [
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+        ]
+    else:  # pragma: no cover
+        raise ValueError(f"not a pallas method: {method}")
+
+    return kernel_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n * m, cols), dtype),
+        in_specs=[any_spec()],
+        out_specs=any_spec(),
+        scratch_shapes=scratch,
+        uses_barrier=True,
+    )
+
+
+def all_gather_local(x_local: jax.Array, axis: str = "tp", num_ranks: int | None = None,
+                     method: AllGatherMethod | str = AllGatherMethod.AUTO) -> jax.Array:
+    """Device-local AllGather for use *inside* an existing shard_map region
+    (the composition point for layers). ``x_local``: (m, cols) per device →
+    (num_ranks*m, cols) per device."""
+    method = AllGatherMethod(method) if not isinstance(method, AllGatherMethod) else method
+    if num_ranks is None:
+        raise ValueError("num_ranks required inside shard_map")
+    n = num_ranks
+    if method == AllGatherMethod.AUTO:
+        method = get_auto_all_gather_method(x_local.size * x_local.dtype.itemsize, n)
+    if method == AllGatherMethod.XLA:
+        return jax.lax.all_gather(x_local, axis, tiled=True)
+    m, cols = x_local.shape
+    return _build_ag_call(n, axis, m, cols, x_local.dtype, method)(x_local)
+
+
+def all_gather(x: jax.Array, ctx: DistContext | None = None, axis: str = "tp",
+               method: AllGatherMethod | str = AllGatherMethod.AUTO,
+               stacked: bool = False) -> jax.Array:
+    """Host-level AllGather: ``x`` globally (n*m, cols) sharded over ``axis``
+    → gathered copy on every device.
+
+    ``stacked=True`` returns the per-device copies stacked as (n, n*m, cols)
+    (test introspection); default returns the replicated (n*m, cols) view.
+    """
+    ctx = ctx or get_context()
+    n = ctx.axis_size(axis)
+    method_key = method.value if isinstance(method, AllGatherMethod) else str(method)
+    key = (axis, method_key, stacked, x.shape, str(x.dtype))
+
+    def make():
+        fn = functools.partial(all_gather_local, axis=axis, num_ranks=n,
+                               method=method)
+        return (lambda xl: fn(xl)[None]) if stacked else fn
+
+    jfn = cached_shard_jit(ctx, "all_gather", key, make, P(axis),
+                           P(axis) if stacked else P(None))
+    out = jfn(x)
+    return out.reshape(n, *x.shape) if stacked else out

@@ -1,0 +1,145 @@
+"""AllReduce over ICI.
+
+Reference: ``python/triton_dist/kernels/nvidia/allreduce.py`` (1208 LoC) —
+one-shot push, two-shot, double-tree, multimem variants, auto-selected by size
+(:1101). TPU method space (no NVLS multicast exists — SURVEY.md §7 maps
+multimem → ring/tree):
+
+- ``ONE_SHOT``: every device pushes its full block to all peers, each reduces
+  locally — one network hop, n× traffic; latency-optimal for small payloads
+  (decode activations).
+- ``TWO_SHOT``: ring reduce-scatter + ring all-gather — 2(n-1) hops of 1/n
+  payload each; bandwidth-optimal for large payloads.
+- ``XLA``: ``jax.lax.psum`` golden/fallback.
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu import language as dl
+from triton_distributed_tpu.language import shmem_device as shmem
+from triton_distributed_tpu.language.core import kernel_call, any_spec
+from triton_distributed_tpu.ops.allgather import all_gather_local, AllGatherMethod
+from triton_distributed_tpu.ops.reduce_scatter import (
+    reduce_scatter_local,
+    _pick_tile_m,
+)
+from triton_distributed_tpu.runtime.context import DistContext, get_context
+from triton_distributed_tpu.runtime.jit_cache import cached_shard_jit
+
+
+class AllReduceMethod(enum.Enum):
+    """Reference allreduce.py methods, collapsed to the TPU space."""
+
+    AUTO = "auto"
+    ONE_SHOT = "one_shot"
+    TWO_SHOT = "two_shot"
+    XLA = "xla"
+
+
+def get_auto_allreduce_method(nbytes: int, num_ranks: int) -> AllReduceMethod:
+    """Size-based selection (reference get_auto_allreduce_method,
+    allreduce.py:1101)."""
+    if nbytes <= 128 * 1024 or num_ranks <= 2:
+        return AllReduceMethod.ONE_SHOT
+    return AllReduceMethod.TWO_SHOT
+
+
+def _ar_one_shot_kernel(n: int, axis: str, m: int, tile_m: int,
+                        x_ref, out_ref, ws, va, vacc,
+                        send_sems, recv_sem, copy_sem):
+    """One-shot push AR (reference one-shot variants, allreduce.py:214-…):
+    push local block into slot ``me`` of every peer's workspace, reduce all
+    slots locally, staged through VMEM with fp32 accumulation."""
+    me = dl.rank(axis)
+    shmem.barrier_all(axis)
+    local = pltpu.make_async_copy(x_ref, ws.at[me], copy_sem)
+    local.start()
+    handles = []
+    for i in range(n - 1):
+        peer = jax.lax.rem(me + 1 + i, n)
+        handles.append(
+            shmem.putmem_nbi_block(x_ref, ws.at[me], send_sems.at[i],
+                                   recv_sem, peer)
+        )
+    local.wait()
+    shmem.quiet(*handles)
+    shmem.wait_deliveries(x_ref, recv_sem, n - 1)
+
+    for t in range(m // tile_m):
+        rows = pl.ds(t * tile_m, tile_m)
+        vacc[...] = jnp.zeros_like(vacc)
+        for i in range(n):
+            pltpu.make_async_copy(ws.at[i].at[rows], va, copy_sem).start()
+            pltpu.make_async_copy(ws.at[i].at[rows], va, copy_sem).wait()
+            vacc[...] = vacc[...] + va[...].astype(jnp.float32)
+        va[...] = vacc[...].astype(va.dtype)
+        pltpu.make_async_copy(va, out_ref.at[rows], copy_sem).start()
+        pltpu.make_async_copy(va, out_ref.at[rows], copy_sem).wait()
+
+
+def all_reduce_local(x_local: jax.Array, axis: str = "tp",
+                     num_ranks: int | None = None,
+                     method: AllReduceMethod | str = AllReduceMethod.AUTO) -> jax.Array:
+    """Device-local AllReduce inside an existing shard_map region.
+    ``x_local``: (m, cols) per device → (m, cols) = Σ_d x_d."""
+    method = AllReduceMethod(method) if not isinstance(method, AllReduceMethod) else method
+    if num_ranks is None:
+        raise ValueError("num_ranks required inside shard_map")
+    n = num_ranks
+    if n == 1:
+        return x_local
+    if method == AllReduceMethod.AUTO:
+        method = get_auto_allreduce_method(x_local.size * x_local.dtype.itemsize, n)
+    if method == AllReduceMethod.XLA:
+        return jax.lax.psum(x_local, axis)
+    m, cols = x_local.shape
+    if method == AllReduceMethod.TWO_SHOT:
+        if m % n:
+            raise ValueError(
+                f"two_shot requires rows {m} divisible by num_ranks {n}")
+        scattered = reduce_scatter_local(x_local, axis=axis, num_ranks=n)
+        return all_gather_local(scattered, axis=axis, num_ranks=n,
+                                method=AllGatherMethod.RING_1D)
+    tile_m = _pick_tile_m(m)
+    kernel = functools.partial(_ar_one_shot_kernel, n, axis, m, tile_m)
+    return kernel_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((m, cols), x_local.dtype),
+        in_specs=[any_spec()],
+        out_specs=any_spec(),
+        scratch_shapes=[
+            pltpu.HBM((n, m, cols), x_local.dtype),       # symmetric workspace
+            pltpu.VMEM((tile_m, cols), x_local.dtype),
+            pltpu.VMEM((tile_m, cols), jnp.float32),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        uses_barrier=True,
+    )(x_local)
+
+
+def all_reduce(x: jax.Array, ctx: DistContext | None = None, axis: str = "tp",
+               method: AllReduceMethod | str = AllReduceMethod.AUTO) -> jax.Array:
+    """Host-level AllReduce: ``x`` globally (n, m, cols) stacked contributions
+    over ``axis`` → replicated (m, cols) sum."""
+    ctx = ctx or get_context()
+    n = ctx.axis_size(axis)
+    method_key = method.value if isinstance(method, AllReduceMethod) else str(method)
+    key = (axis, method_key, x.shape, str(x.dtype))
+
+    def make():
+        fn = functools.partial(all_reduce_local, axis=axis, num_ranks=n,
+                               method=method)
+        return lambda xl: fn(xl[0])
+
+    return cached_shard_jit(ctx, "all_reduce", key, make, P(axis), P(None))(x)

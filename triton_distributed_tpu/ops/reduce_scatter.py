@@ -1,0 +1,162 @@
+"""ReduceScatter over ICI.
+
+Reference: ``python/triton_dist/kernels/nvidia/reduce_scatter.py`` — 2D-context
+scatter + ring_reduce (:674-826) and sm-based ring-push RS (:327,415). On a TPU
+slice the idiomatic form is the classic ring reduce-scatter: chunk c starts at
+device c+1, accumulates each hop, and lands fully-reduced at its owner after
+n-1 hops — every ICI link busy every step, total traffic (n-1)/n of the input.
+
+Flow control: incoming partials land in a per-step slot (comm has n-1 slots)
+so a fast upstream producer can never overwrite a slot the local device has
+not consumed; outgoing staging uses 2 slots guarded by the *local* send
+semaphore (wait the step-s-2 send before reusing its slot) — both orderings
+are single-device-observable, so no cross-device timing assumption exists.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu import language as dl
+from triton_distributed_tpu.language import shmem_device as shmem
+from triton_distributed_tpu.language.core import kernel_call, any_spec
+from triton_distributed_tpu.runtime.context import DistContext, get_context
+from triton_distributed_tpu.runtime.jit_cache import cached_shard_jit
+
+
+def _pick_tile_m(m: int, cap: int = 512) -> int:
+    """Largest divisor of m not exceeding cap (VMEM staging tile rows)."""
+    t = min(m, cap)
+    while m % t:
+        t -= 1
+    return t
+
+
+def _tiled_add(dst_at, a_at, b_at, m: int, tile_m: int, va, vb, copy_sem):
+    """dst[t] = a[t] + b[t] for every row tile, staged through VMEM.
+
+    ``*_at`` are callables tile_index -> ref slice. Serial per tile; the
+    overlapped AG+GEMM path has its own fused epilogue, this is the plain
+    collective path.
+    """
+    for t in range(m // tile_m):
+        pltpu.make_async_copy(a_at(t), va, copy_sem).start()
+        pltpu.make_async_copy(a_at(t), va, copy_sem).wait()
+        pltpu.make_async_copy(b_at(t), vb, copy_sem).start()
+        pltpu.make_async_copy(b_at(t), vb, copy_sem).wait()
+        va[...] = va[...] + vb[...]
+        pltpu.make_async_copy(va, dst_at(t), copy_sem).start()
+        pltpu.make_async_copy(va, dst_at(t), copy_sem).wait()
+
+
+def _rs_ring_kernel(n: int, axis: str, m: int, tile_m: int,
+                    x_ref, out_ref, comm, stage, va, vb,
+                    send_sem, recv_sem, copy_sem):
+    """Ring reduce-scatter (see module docstring for the slot protocol).
+
+    x_ref: (n*m, cols) full local rows; out_ref: (m, cols) = Σ_d x_d[me].
+    """
+    me = dl.rank(axis)
+    shmem.barrier_all(axis)
+    right = jax.lax.rem(me + 1, n)
+    chunk_like = x_ref.at[pl.ds(0, m)]
+
+    def x_chunk(c):
+        return x_ref.at[pl.ds(c * m, m)]
+
+    def tile(ref_at, t):
+        return ref_at.at[pl.ds(t * tile_m, tile_m)]
+
+    send_handles: list = [None] * (n - 1)
+    for s in range(n - 1):
+        c = jax.lax.rem(me - 1 - s + 2 * n, n)  # chunk I forward at step s
+        if s == 0:
+            # First hop: raw local contribution, no staging needed.
+            send_handles[0] = shmem.putmem_nbi_block(
+                x_chunk(c), comm.at[0], send_sem, recv_sem, right)
+            continue
+        # Partial for chunk c arrived from the left in slot s-1.
+        shmem.wait_deliveries(chunk_like, recv_sem, 1)
+        slot = s % 2
+        if s >= 2:
+            send_handles[s - 2].wait_send()  # stage[slot] free to reuse
+        _tiled_add(
+            lambda t: tile(stage.at[slot], t),
+            lambda t: tile(comm.at[s - 1], t),
+            lambda t: tile(x_chunk(c), t),
+            m, tile_m, va, vb, copy_sem,
+        )
+        send_handles[s] = shmem.putmem_nbi_block(
+            stage.at[slot], comm.at[s], send_sem, recv_sem, right)
+    # Final arrival: my own chunk, fully reduced except my contribution.
+    shmem.wait_deliveries(chunk_like, recv_sem, 1)
+    _tiled_add(
+        lambda t: tile(out_ref, t),
+        lambda t: tile(comm.at[n - 2], t),
+        lambda t: tile(x_chunk(me), t),
+        m, tile_m, va, vb, copy_sem,
+    )
+    # Drain only the sends not already waited in-loop (steps ≥ 2 waited their
+    # s-2 handle; double-waiting would over-consume send_sem bytes and stall).
+    for h in send_handles[max(n - 3, 0):]:
+        if h is not None:
+            h.wait_send()
+
+
+def reduce_scatter_local(x_local: jax.Array, axis: str = "tp",
+                         num_ranks: int | None = None) -> jax.Array:
+    """Device-local ring reduce-scatter inside an existing shard_map region.
+    ``x_local``: (n*m, cols) per device → (m, cols) per device (chunk ``me``
+    summed over all devices)."""
+    if num_ranks is None:
+        raise ValueError("num_ranks required inside shard_map")
+    n = num_ranks
+    if n == 1:
+        return x_local
+    mt, cols = x_local.shape
+    if mt % n:
+        raise ValueError(f"rows {mt} not divisible by num_ranks {n}")
+    m = mt // n
+    tile_m = _pick_tile_m(m)
+    kernel = functools.partial(_rs_ring_kernel, n, axis, m, tile_m)
+    return kernel_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((m, cols), x_local.dtype),
+        in_specs=[any_spec()],
+        out_specs=any_spec(),
+        scratch_shapes=[
+            pltpu.HBM((n - 1, m, cols), x_local.dtype),   # comm: per-step slots
+            pltpu.HBM((2, m, cols), x_local.dtype),       # stage: double buffer
+            pltpu.VMEM((tile_m, cols), x_local.dtype),
+            pltpu.VMEM((tile_m, cols), x_local.dtype),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        uses_barrier=True,
+    )(x_local)
+
+
+def reduce_scatter(x: jax.Array, ctx: DistContext | None = None,
+                   axis: str = "tp") -> jax.Array:
+    """Host-level ring reduce-scatter.
+
+    ``x``: every device holds (n*m, cols) of *contributions* — globally the
+    array is (n, n*m, cols) stacked over ``axis``. Returns the (n*m, cols)
+    result scattered over ``axis`` (device d owns rows [d*m, (d+1)*m)).
+    """
+    ctx = ctx or get_context()
+    n = ctx.axis_size(axis)
+    key = (axis, x.shape, str(x.dtype))
+
+    def make():
+        fn = functools.partial(reduce_scatter_local, axis=axis, num_ranks=n)
+        return lambda xl: fn(xl[0])
+
+    return cached_shard_jit(ctx, "reduce_scatter", key, make,
+                            P(axis), P(axis))(x)

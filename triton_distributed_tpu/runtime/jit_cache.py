@@ -1,0 +1,43 @@
+"""Cached jitted shard_map wrappers for host-level ops.
+
+The reference relies on CUDA-graph capture + Triton's compile cache to make
+op calls cheap after the first (engine.py:75-105). The JAX analog is
+``jax.jit``: host-level collective wrappers build their shard_map-ed callable
+once per (mesh, op, static-config) and reuse the compiled executable, so
+repeated calls skip tracing/lowering entirely.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Hashable
+
+import jax
+
+from triton_distributed_tpu.runtime.context import DistContext, shard_map_on
+
+_CACHE: dict = {}
+
+
+def cached_shard_jit(
+    ctx: DistContext,
+    op_name: str,
+    key: Hashable,
+    make_local_fn: Callable[[], Callable],
+    in_specs: Any,
+    out_specs: Any,
+):
+    """Return a jitted ``shard_map(local_fn)`` cached by (mesh, op, key).
+
+    ``make_local_fn`` is only invoked on cache miss; ``key`` must capture every
+    static config that changes the trace (shapes, dtype, method, axis).
+    """
+    cache_key = (ctx.mesh, op_name, key)
+    fn = _CACHE.get(cache_key)
+    if fn is None:
+        fn = jax.jit(shard_map_on(ctx, make_local_fn(), in_specs, out_specs))
+        _CACHE[cache_key] = fn
+    return fn
+
+
+def clear_cache() -> None:
+    _CACHE.clear()
