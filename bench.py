@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Round benchmark — prints ONE JSON line for the driver.
+
+Round-1 metric: efficiency of the tiled Pallas consumer-GEMM (the compute
+core of the overlapped AG+GEMM / GEMM+RS kernels, ops/tiling.py:matmul_tiles)
+vs XLA's native dot, measured on-device with a differential chained-matmul
+method. vs_baseline = t_xla / t_pallas (1.0 = the overlap machinery's compute
+core matches XLA — the precondition for beating the reference's fused
+kernels per BASELINE.md).
+
+Timing note: through the axon relay, ``block_until_ready`` does not wait for
+device completion and repeated identical dispatches can be elided, so naive
+wall-clock loops report impossible TFLOP/s. We instead time one jitted call
+containing an on-device *dependent* chain of N matmuls (fori_loop), force
+completion with a host fetch, and subtract a short-chain run to cancel the
+fixed dispatch+fetch cost.
+"""
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _chain(matmul, a, b, n):
+    def body(i, x):
+        y = matmul(x, b)
+        # Cheap renormalization keeps bf16 bounded; identical in both paths so
+        # the differential comparison stays apples-to-apples.
+        return (y.astype(jnp.float32)
+                * (1.0 / jnp.maximum(jnp.max(jnp.abs(y)).astype(jnp.float32), 1e-3))
+                ).astype(x.dtype)
+
+    return jax.lax.fori_loop(0, n, body, a)
+
+
+def _per_iter_seconds(fn, a, b, n_small, n_big, trials=3):
+    def run(n):
+        best = float("inf")
+        out = fn(a, b, n)
+        _ = np.asarray(out)  # host fetch forces completion through the relay
+        for _i in range(trials):
+            t0 = time.perf_counter()
+            out = fn(a, b, n)
+            _ = np.asarray(out)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_small = run(n_small)
+    t_big = run(n_big)
+    return max((t_big - t_small) / (n_big - n_small), 1e-9)
+
+
+def main():
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        S, n_small, n_big, dtype = 2048, 64, 1024, jnp.bfloat16
+    else:
+        from triton_distributed_tpu.runtime.interpret_workarounds import (
+            apply_interpret_workarounds,
+        )
+
+        apply_interpret_workarounds()
+        S, n_small, n_big, dtype = 256, 1, 3, jnp.float32
+
+    from triton_distributed_tpu.ops.gemm import pallas_matmul
+
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.standard_normal((S, S)) * 0.05, dtype)
+    b = jnp.asarray(rng.standard_normal((S, S)) * 0.05, dtype)
+
+    xla_dot = lambda x, w: jnp.dot(  # noqa: E731
+        x, w, preferred_element_type=jnp.float32).astype(x.dtype)
+
+    xla_fn = jax.jit(functools.partial(_chain, xla_dot), static_argnums=2)
+    pallas_fn = jax.jit(functools.partial(_chain, pallas_matmul), static_argnums=2)
+
+    t_xla = _per_iter_seconds(xla_fn, a, b, n_small, n_big)
+    t_pallas = _per_iter_seconds(pallas_fn, a, b, n_small, n_big)
+
+    flops = 2.0 * S * S * S
+    print(json.dumps({
+        "metric": "pallas_consumer_gemm_tflops",
+        "value": round(flops / t_pallas / 1e12, 3),
+        "unit": "TFLOP/s",
+        "vs_baseline": round(t_xla / t_pallas, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -24,73 +24,11 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 from triton_distributed_tpu.runtime import initialize_distributed  # noqa: E402
+from triton_distributed_tpu.runtime.interpret_workarounds import (  # noqa: E402
+    apply_interpret_workarounds,
+)
 
-
-def _patch_interpret_semaphore_wait() -> None:
-    """Replace the interpreter's busy-spin DMA-semaphore wait with a blocking
-    condition-variable wait.
-
-    jax 0.9.0's TPU-interpret ``Semaphore.wait(has_tasks=True)`` spins
-    (`while True: ... continue`) whenever the count is insufficient and no
-    pending task exists — which is the common case in "eager" DMA mode when
-    genuinely waiting on another device. With 8 device threads under one GIL,
-    the spinners starve the worker and a single collective takes minutes.
-    ``signal`` always calls ``cv.notify_all``, so blocking on the cv (with a
-    small timeout as a safety net for task-executed increments) is sound.
-    Test-harness-only; real-TPU execution is untouched.
-    """
-    from jax._src.pallas.mosaic.interpret import shared_memory as sm
-
-    def wait(self, value, global_core_id, *, has_tasks=False):
-        global_core_id = int(global_core_id)
-        assert not self.detect_races, "patched wait does not track vector clocks"
-        while True:
-            with self.cv:
-                if self.count_by_core[global_core_id] >= value:
-                    self.count_by_core[global_core_id] -= value
-                    return
-            task = None
-            if has_tasks:
-                with self.shared_memory.lock:
-                    queue = self.shared_memory.tasks_by_sem[(self.id, global_core_id)]
-                    if len(queue) > 0:
-                        task = queue.pop()
-            if task is not None:
-                task()
-            else:
-                with self.cv:
-                    if self.count_by_core[global_core_id] < value:
-                        self.cv.wait(timeout=0.005)
-
-    sm.Semaphore.wait = wait
-
-
-def _patch_io_callback_device_put() -> None:
-    """Make io/pure callback impls convert args with numpy directly instead of
-    ``device_put`` onto cpu:0.
-
-    On a single-CPU host, ``io_callback_impl`` (jax/_src/callback.py:437)
-    device_puts every callback arg onto cpu:0 asynchronously; materializing it
-    (``np.array(val)``) then requires the cpu:0 execution queue — which a
-    *blocked* pallas-interpret callback (semaphore wait inside a collective
-    kernel) may be occupying. Any buffer big enough to take the async
-    device_put path deadlocks kernel startup (observed threshold ≈64-128KB).
-    The interpret machinery only needs numpy values, so convert in place.
-    """
-    import numpy as np
-    from jax import tree_util
-    from jax._src import callback as jcb
-
-    def _sync_io_callback_impl(*args, result_avals, callback, sharding, ordered):
-        del result_avals, sharding, ordered
-        return tree_util.tree_map(np.asarray, callback(*args))
-
-    jcb.io_callback_impl = _sync_io_callback_impl
-
-
-if os.environ.get("TDTPU_DETECT_RACES", "0") != "1":
-    _patch_interpret_semaphore_wait()
-_patch_io_callback_device_put()
+apply_interpret_workarounds()
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,36 @@
+"""GEMM+AR and P2P transport tests (reference: test_gemm_ar, test_pp analogs)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from triton_distributed_tpu.ops import gemm_allreduce, p2p_shift
+from triton_distributed_tpu.runtime.topology import detect_topology, ici_ring_order
+
+
+def test_gemm_allreduce(ctx):
+    n = ctx.num_ranks
+    rng = np.random.default_rng(3)
+    a = jnp.asarray(rng.standard_normal((32, n * 16)) * 0.1, jnp.float32)
+    b = jnp.asarray(rng.standard_normal((n * 16, 128)) * 0.1, jnp.float32)
+    got = gemm_allreduce(a, b, ctx, method="one_shot")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(a) @ np.asarray(b),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_p2p_shift(ctx):
+    n = ctx.num_ranks
+    x = jnp.arange(n * 8 * 128, dtype=jnp.float32).reshape(n * 8, 128)
+    y = p2p_shift(x, ctx, shift=1)
+    expected = np.roll(np.asarray(x).reshape(n, 8, 128), 1, axis=0).reshape(n * 8, 128)
+    np.testing.assert_array_equal(np.asarray(y), expected)
+    # pull direction
+    y2 = p2p_shift(x, ctx, shift=-1)
+    expected2 = np.roll(np.asarray(x).reshape(n, 8, 128), -1, axis=0).reshape(n * 8, 128)
+    np.testing.assert_array_equal(np.asarray(y2), expected2)
+
+
+def test_topology_cpu_mesh(ctx):
+    topo = detect_topology()
+    assert topo.num_devices == 8
+    assert not topo.is_multi_host
+    assert ici_ring_order(topo) == list(range(8))

@@ -87,6 +87,12 @@ def kernel_call(
     """
     if interpret is None:
         interpret = use_interpret()
+    if interpret:
+        from triton_distributed_tpu.runtime.interpret_workarounds import (
+            apply_interpret_workarounds,
+        )
+
+        apply_interpret_workarounds()
     params = {}
     # Mosaic only accepts a collective_id when the kernel actually touches the
     # global barrier semaphore (get_barrier_semaphore); setting it untouched is

@@ -16,3 +16,18 @@ from triton_distributed_tpu.ops.allreduce import (  # noqa: F401
     all_reduce,
     get_auto_allreduce_method,
 )
+from triton_distributed_tpu.ops.allgather_gemm import (  # noqa: F401
+    AGGemmConfig,
+    ag_gemm,
+    ag_gemm_local,
+)
+from triton_distributed_tpu.ops.gemm_reduce_scatter import (  # noqa: F401
+    GemmRSConfig,
+    gemm_rs,
+    gemm_rs_local,
+)
+from triton_distributed_tpu.ops.gemm_allreduce import (  # noqa: F401
+    gemm_allreduce,
+    gemm_ar_local,
+)
+from triton_distributed_tpu.ops.p2p import p2p_shift, p2p_shift_local  # noqa: F401

@@ -1,0 +1,156 @@
+"""Overlapped AllGather + GEMM — the flagship TP column-parallel pattern.
+
+Reference: ``python/triton_dist/kernels/nvidia/allgather_gemm.py`` — a
+copy-engine producer all-gathers A shards into a symmetric workspace and sets
+per-rank barriers, while a persistent consumer GEMM spins per tile on
+``dl.wait`` with a rank-swizzled tile order (:158-264), wrapped in
+``AllGatherGEMMTensorParallelContext`` (:417-487) and the ``ag_gemm`` op
+(:534).
+
+TPU design (single fused Pallas kernel — the reference's "SM-driven" shape,
+since TPU has no separate copy-engine streams):
+
+1. entry barrier (launch alignment);
+2. push the local A shard to every peer's workspace, each delivery signaling
+   the *per-source-rank* recv semaphore — the analog of the per-rank barrier
+   array;
+3. consumer loop visits rank chunks in swizzled order (own chunk first),
+   waiting each chunk's semaphore before running the tiled MXU matmul over
+   it — so compute on chunk r overlaps deliveries of chunks r+1… .
+
+C = all_gather(A_shards) @ B_local, i.e. per device (n·m, n_cols) with B
+column-sharded (TP): full output rows for this device's output columns.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu import language as dl
+from triton_distributed_tpu.language import shmem_device as shmem
+from triton_distributed_tpu.language.core import kernel_call, any_spec
+from triton_distributed_tpu.ops.tiling import gemm_tiles, matmul_tiles
+from triton_distributed_tpu.runtime.context import DistContext, get_context
+from triton_distributed_tpu.runtime.jit_cache import cached_shard_jit
+
+
+@dataclasses.dataclass(frozen=True)
+class AGGemmConfig:
+    """Tile configuration (the tunable surface the reference exposes through
+    its autotuner configs; AllGatherGEMMTensorParallelContext analog)."""
+
+    tile_m: int = 256
+    tile_n: int = 256
+    tile_k: int = 512
+
+
+def _ag_gemm_kernel(n: int, axis: str, m: int, k: int, ncols: int,
+                    tiles, x_ref, b_ref, out_ref, ws_ref,
+                    va, vb, vacc, vout,
+                    send_sems, recv_sems, copy_sem, mm_sem):
+    """See module docstring. ws_ref is the AG landing workspace (n·m, k)."""
+    me = dl.rank(axis)
+    shmem.barrier_all(axis)
+
+    # --- producer: local copy + full-mesh push of my shard into slot `me`.
+    my_slot = ws_ref.at[pl.ds(me * m, m)]
+    local = pltpu.make_async_copy(x_ref, my_slot, recv_sems.at[me])
+    local.start()
+    handles = []
+    for i in range(n - 1):
+        peer = jax.lax.rem(me + 1 + i, n)
+        handles.append(
+            shmem.putmem_nbi_block(x_ref, my_slot, send_sems.at[i],
+                                   recv_sems.at[me], peer)
+        )
+
+    tm, tk, tn = tiles
+
+    # --- consumer: rank-swizzled chunk loop, wait-then-matmul per chunk
+    # (reference kernel_consumer_gemm_persistent hot loop :217-264).
+    for i in range(n):
+        r = jax.lax.rem(me + i, n)
+        shmem.wait_deliveries(x_ref, recv_sems.at[r], 1)
+        row0 = r * m
+        matmul_tiles(
+            lambda im, kk: ws_ref.at[pl.ds(row0 + im * tm, tm),
+                                     pl.ds(kk * tk, tk)],
+            lambda kk, jn: b_ref.at[pl.ds(kk * tk, tk), pl.ds(jn * tn, tn)],
+            lambda im, jn: out_ref.at[pl.ds(row0 + im * tm, tm),
+                                      pl.ds(jn * tn, tn)],
+            m, k, ncols, tm, tk, tn, va, vb, vacc, vout, mm_sem,
+        )
+    shmem.quiet(*handles)
+
+
+def ag_gemm_local(x_local: jax.Array, b_local: jax.Array, axis: str = "tp",
+                  num_ranks: int | None = None,
+                  cfg: AGGemmConfig = AGGemmConfig()) -> jax.Array:
+    """Device-local overlapped AG+GEMM inside an existing shard_map region.
+
+    x_local: (m, k) A shard; b_local: (k, ncols) local B columns.
+    Returns (num_ranks·m, ncols) = all_gather(A) @ B_local.
+    """
+    if num_ranks is None:
+        raise ValueError("num_ranks required inside shard_map")
+    n = num_ranks
+    m, k = x_local.shape
+    k2, ncols = b_local.shape
+    if k != k2:
+        raise ValueError(f"inner dims mismatch: A has k={k}, B has k={k2}")
+    if n == 1:
+        return jnp.dot(x_local, b_local,
+                       preferred_element_type=jnp.float32).astype(x_local.dtype)
+    tm, tk, tn = gemm_tiles(m, k, ncols, x_local.dtype, cfg)
+    kernel = functools.partial(_ag_gemm_kernel, n, axis, m, k, ncols,
+                               (tm, tk, tn))
+    out, _ = kernel_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((n * m, ncols), x_local.dtype),
+            jax.ShapeDtypeStruct((n * m, k), x_local.dtype),  # AG workspace
+        ),
+        in_specs=[any_spec(), any_spec()],
+        out_specs=(any_spec(), any_spec()),
+        scratch_shapes=[
+            pltpu.VMEM((tm, tk), x_local.dtype),
+            pltpu.VMEM((tk, tn), b_local.dtype),
+            pltpu.VMEM((tm, tn), jnp.float32),
+            pltpu.VMEM((tm, tn), x_local.dtype),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            pltpu.SemaphoreType.DMA((n,)),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        uses_barrier=True,
+    )(x_local, b_local)
+    return out
+
+
+def ag_gemm(a: jax.Array, b: jax.Array, ctx: DistContext | None = None,
+            axis: str = "tp", cfg: AGGemmConfig = AGGemmConfig()) -> jax.Array:
+    """Host-level overlapped AG+GEMM (reference ``ag_gemm`` allgather_gemm.py:534).
+
+    a: (n·m, k) globally, row-sharded over ``axis`` (each device one shard);
+    b: (k, n·ncols) globally, column-sharded over ``axis`` (TP weights).
+    Returns (n·m, n·ncols) sharded over columns, i.e. the standard TP
+    column-parallel activation layout.
+    """
+    ctx = ctx or get_context()
+    n = ctx.axis_size(axis)
+    key = (axis, a.shape, b.shape, str(a.dtype), str(b.dtype), cfg)
+
+    def make():
+        fn = functools.partial(ag_gemm_local, axis=axis, num_ranks=n, cfg=cfg)
+        return fn
+
+    jfn = cached_shard_jit(ctx, "ag_gemm", key, make,
+                           (P(axis), P(None, axis)), P(None, axis))
+    return jfn(a, b)

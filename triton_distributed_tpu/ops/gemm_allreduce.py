@@ -1,0 +1,56 @@
+"""GEMM + AllReduce epilogue (TP fallback path when the RS/AG layout is not
+wanted, e.g. single-layer calls or decode with replicated activations).
+
+Reference: ``python/triton_dist/kernels/nvidia/gemm_allreduce.py`` —
+``create_gemm_ar_context`` / ``gemm_allreduce_op`` / low-latency variant.
+
+TPU design note: for the *matmul itself* XLA's native dot is already optimal
+(MXU-tiled, pipelined); a hand-written Pallas matmul only pays off when comm
+waits must interleave with compute (ops/allgather_gemm.py). So this op is the
+idiomatic composition: XLA dot producing the partial product + the Pallas
+one-shot/two-shot AllReduce kernel (ops/allreduce.py) for the reduction.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.ops.allreduce import AllReduceMethod, all_reduce_local
+from triton_distributed_tpu.runtime.context import DistContext, get_context
+from triton_distributed_tpu.runtime.jit_cache import cached_shard_jit
+
+
+def gemm_ar_local(x_local: jax.Array, b_local: jax.Array, axis: str = "tp",
+                  num_ranks: int | None = None,
+                  method: AllReduceMethod | str = AllReduceMethod.AUTO) -> jax.Array:
+    """Device-local GEMM+AR inside an existing shard_map region.
+
+    x_local: (m, k_local); b_local: (k_local, ncols); returns the fully
+    reduced (m, ncols) on every device.
+    """
+    partial = jnp.dot(x_local, b_local, preferred_element_type=jnp.float32)
+    partial = partial.astype(x_local.dtype)
+    return all_reduce_local(partial, axis=axis, num_ranks=num_ranks,
+                            method=method)
+
+
+def gemm_allreduce(a: jax.Array, b: jax.Array, ctx: DistContext | None = None,
+                   axis: str = "tp",
+                   method: AllReduceMethod | str = AllReduceMethod.AUTO) -> jax.Array:
+    """Host-level GEMM+AR: a (m, n·k) k-sharded, b (n·k, ncols) row-sharded →
+    replicated (m, ncols) = a @ b."""
+    ctx = ctx or get_context()
+    n = ctx.axis_size(axis)
+    method_key = method.value if isinstance(method, AllReduceMethod) else str(method)
+    key = (axis, a.shape, b.shape, str(a.dtype), method_key)
+
+    def make():
+        return functools.partial(gemm_ar_local, axis=axis, num_ranks=n,
+                                 method=method)
+
+    return cached_shard_jit(ctx, "gemm_allreduce", key, make,
+                            (P(None, axis), P(axis)), P(None))(a, b)

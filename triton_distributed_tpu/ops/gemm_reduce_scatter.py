@@ -1,0 +1,183 @@
+"""Overlapped GEMM + ReduceScatter — the TP row-parallel pattern.
+
+Reference: ``python/triton_dist/kernels/nvidia/gemm_reduce_scatter.py`` — a
+persistent producer GEMM writes tiles and ``notify``s per-tile barriers
+(:122-253) while a scatter/ring-reduce consumer completes the reduction
+(reduce_scatter.py:617-856); ``gemm_rs`` op at :569.
+
+TPU design (single fused Pallas kernel): the roles invert relative to AG+GEMM —
+
+1. entry barrier;
+2. producer loop computes partial-output *row chunks* in swizzled order
+   (peer chunks first, own chunk last) and pushes each finished chunk to its
+   owner's accumulation workspace slot ``me`` — so the scatter of chunk c
+   overlaps the matmul of chunk c+1;
+3. consumer phase: wait the n-1 peer deliveries, then reduce workspace slots
+   (fp32) into the local output chunk.
+
+out_d = Σ_r partial_r[rows of d], with A k-sharded and B row-sharded (TP
+row-parallel: each device holds A(:, k_shard) and B[k_shard, :]).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu import language as dl
+from triton_distributed_tpu.language import shmem_device as shmem
+from triton_distributed_tpu.language.core import kernel_call, any_spec
+from triton_distributed_tpu.ops.tiling import gemm_tiles, matmul_tiles
+from triton_distributed_tpu.runtime.context import DistContext, get_context
+from triton_distributed_tpu.runtime.jit_cache import cached_shard_jit
+
+
+@dataclasses.dataclass(frozen=True)
+class GemmRSConfig:
+    """Tile configuration (ReduceScatter2DContext analog,
+    reduce_scatter.py:47-147)."""
+
+    tile_m: int = 256
+    tile_n: int = 256
+    tile_k: int = 512
+
+
+def _gemm_rs_kernel(n: int, axis: str, m_total: int, k: int, ncols: int,
+                    tiles, x_ref, b_ref, out_ref, partial_ref, ws_ref,
+                    va, vb, vacc, vout, vload,
+                    send_sems, recv_sem, copy_sem, mm_sem):
+    """See module docstring.
+
+    partial_ref: (m_total, ncols) local partial-product buffer;
+    ws_ref: (n, mc, ncols) accumulation workspace (slot r = rank r's partial).
+    """
+    me = dl.rank(axis)
+    mc = m_total // n
+    shmem.barrier_all(axis)
+
+    tm, tk, tn = tiles
+
+    # --- producer: compute partial chunks, own chunk LAST (peers need theirs
+    # shipped earliest; reference's swizzle plays the same trick in reverse).
+    handles = []
+    for i in range(n):
+        c = jax.lax.rem(me + 1 + i, n)  # me+1, me+2, …, me
+        row0 = c * mc
+        matmul_tiles(
+            lambda im, kk: x_ref.at[pl.ds(row0 + im * tm, tm),
+                                    pl.ds(kk * tk, tk)],
+            lambda kk, jn: b_ref.at[pl.ds(kk * tk, tk), pl.ds(jn * tn, tn)],
+            lambda im, jn: partial_ref.at[pl.ds(row0 + im * tm, tm),
+                                          pl.ds(jn * tn, tn)],
+            mc, k, ncols, tm, tk, tn, va, vb, vacc, vout, mm_sem,
+        )
+        if i < n - 1:
+            # Ship the finished peer chunk to its owner's slot `me`.
+            handles.append(shmem.putmem_nbi_block(
+                partial_ref.at[pl.ds(row0, mc)], ws_ref.at[me],
+                send_sems.at[i], recv_sem, c))
+
+    # --- consumer: n-1 peer partials + my own local partial.
+    chunk_like = partial_ref.at[pl.ds(0, mc)]
+    shmem.wait_deliveries(chunk_like, recv_sem, n - 1)
+    my_row0 = me * mc
+    for im in range(mc // tm):
+        rows = pl.ds(im * tm, tm)
+        for jn in range(ncols // tn):
+            cols = pl.ds(jn * tn, tn)
+            cp = pltpu.make_async_copy(
+                partial_ref.at[pl.ds(my_row0 + im * tm, tm), cols], vload,
+                copy_sem)
+            cp.start()
+            cp.wait()
+            vacc[...] = vload[...].astype(jnp.float32)
+            for r in range(n - 1):
+                rr = jax.lax.rem(me + 1 + r, n)  # peers only; own partial above
+                cw = pltpu.make_async_copy(
+                    ws_ref.at[rr].at[rows, cols], vload, copy_sem)
+                cw.start()
+                cw.wait()
+                vacc[...] = vacc[...] + vload[...].astype(jnp.float32)
+            vout[...] = vacc[...].astype(vout.dtype)
+            co = pltpu.make_async_copy(vout, out_ref.at[rows, cols], copy_sem)
+            co.start()
+            co.wait()
+    shmem.quiet(*handles)
+
+
+def gemm_rs_local(x_local: jax.Array, b_local: jax.Array, axis: str = "tp",
+                  num_ranks: int | None = None,
+                  cfg: GemmRSConfig = GemmRSConfig()) -> jax.Array:
+    """Device-local overlapped GEMM+RS inside an existing shard_map region.
+
+    x_local: (m_total, k_local) activations (k-sharded); b_local:
+    (k_local, ncols) weight rows. Returns (m_total/num_ranks, ncols): this
+    device's fully-reduced output row chunk.
+    """
+    if num_ranks is None:
+        raise ValueError("num_ranks required inside shard_map")
+    n = num_ranks
+    m_total, k = x_local.shape
+    k2, ncols = b_local.shape
+    if k != k2:
+        raise ValueError(f"inner dims mismatch: A has k={k}, B has k={k2}")
+    if m_total % n:
+        raise ValueError(f"rows {m_total} not divisible by num_ranks {n}")
+    if n == 1:
+        return jnp.dot(x_local, b_local,
+                       preferred_element_type=jnp.float32).astype(x_local.dtype)
+    mc = m_total // n
+    tm, tk, tn = gemm_tiles(mc, k, ncols, x_local.dtype, cfg)
+    kernel = functools.partial(_gemm_rs_kernel, n, axis, m_total, k, ncols,
+                               (tm, tk, tn))
+    out, _, _ = kernel_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((mc, ncols), x_local.dtype),
+            jax.ShapeDtypeStruct((m_total, ncols), x_local.dtype),  # partials
+            jax.ShapeDtypeStruct((n, mc, ncols), x_local.dtype),    # workspace
+        ),
+        in_specs=[any_spec(), any_spec()],
+        out_specs=(any_spec(), any_spec(), any_spec()),
+        scratch_shapes=[
+            pltpu.VMEM((tm, tk), x_local.dtype),
+            pltpu.VMEM((tk, tn), b_local.dtype),
+            pltpu.VMEM((tm, tn), jnp.float32),
+            pltpu.VMEM((tm, tn), x_local.dtype),
+            pltpu.VMEM((tm, tn), x_local.dtype),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        uses_barrier=True,
+    )(x_local, b_local)
+    return out
+
+
+def gemm_rs(a: jax.Array, b: jax.Array, ctx: DistContext | None = None,
+            axis: str = "tp", cfg: GemmRSConfig = GemmRSConfig()) -> jax.Array:
+    """Host-level overlapped GEMM+RS (reference ``gemm_rs``
+    gemm_reduce_scatter.py:569).
+
+    a: (m, n·k) globally, column(k)-sharded over ``axis``;
+    b: (n·k, ncols) globally, row-sharded over ``axis``.
+    Returns (m, ncols) row-sharded over ``axis`` — the standard TP
+    row-parallel output layout (device d owns rows [d·m/n, (d+1)·m/n)).
+    """
+    ctx = ctx or get_context()
+    n = ctx.axis_size(axis)
+    key = (axis, a.shape, b.shape, str(a.dtype), str(b.dtype), cfg)
+
+    def make():
+        return functools.partial(gemm_rs_local, axis=axis, num_ranks=n, cfg=cfg)
+
+    jfn = cached_shard_jit(ctx, "gemm_rs", key, make,
+                           (P(None, axis), P(axis)), P(axis))
+    return jfn(a, b)

@@ -1,0 +1,71 @@
+"""Point-to-point transport (pipeline-parallel stage boundary).
+
+Reference: ``python/triton_dist/kernels/nvidia/p2p.py`` — ``p2p_copy_kernel``
+push/pull over symmetric buffers (:31,54), wrapped by the PP ``CommOp`` layer
+(layers/nvidia/p2p.py:30-132).
+
+TPU form: an explicit-permutation remote copy — every source device pushes its
+block into its destination's output; devices that receive wait the delivery,
+devices that don't zero their output. ``jax.lax.ppermute`` is the XLA analog
+and serves as the golden/fallback.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu import language as dl
+from triton_distributed_tpu.language import shmem_device as shmem
+from triton_distributed_tpu.language.core import kernel_call, any_spec
+from triton_distributed_tpu.runtime.context import DistContext, get_context
+from triton_distributed_tpu.runtime.jit_cache import cached_shard_jit
+
+
+def _p2p_shift_kernel(n: int, axis: str, shift: int, x_ref, out_ref,
+                      send_sem, recv_sem):
+    """Uniform ring shift by ``shift`` (every device sends; the common PP and
+    ring-exchange case — reference p2p push path)."""
+    me = dl.rank(axis)
+    shmem.barrier_all(axis)
+    dst = jax.lax.rem(me + shift + n, n)
+    rdma = shmem.putmem_nbi_block(x_ref, out_ref, send_sem, recv_sem, dst)
+    rdma.wait()
+
+
+def p2p_shift_local(x_local: jax.Array, shift: int = 1, axis: str = "tp",
+                    num_ranks: int | None = None) -> jax.Array:
+    """Device-local ring shift: out on device (d+shift)%n = x from device d.
+    The PP stage-boundary transport (activations flow stage d → d+1)."""
+    if num_ranks is None:
+        raise ValueError("num_ranks required inside shard_map")
+    n = num_ranks
+    if n == 1:
+        return x_local
+    kernel = functools.partial(_p2p_shift_kernel, n, axis, shift)
+    return kernel_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x_local.shape, x_local.dtype),
+        in_specs=[any_spec()],
+        out_specs=any_spec(),
+        scratch_shapes=[pltpu.SemaphoreType.DMA(()), pltpu.SemaphoreType.DMA(())],
+        uses_barrier=True,
+    )(x_local)
+
+
+def p2p_shift(x: jax.Array, ctx: DistContext | None = None, shift: int = 1,
+              axis: str = "tp") -> jax.Array:
+    """Host-level ring shift of per-device blocks (x sharded over ``axis``)."""
+    ctx = ctx or get_context()
+    n = ctx.axis_size(axis)
+    key = (axis, shift, x.shape, str(x.dtype))
+
+    def make():
+        return functools.partial(p2p_shift_local, shift=shift, axis=axis,
+                                 num_ranks=n)
+
+    return cached_shard_jit(ctx, "p2p_shift", key, make, P(axis), P(axis))(x)

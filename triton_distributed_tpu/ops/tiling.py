@@ -1,0 +1,89 @@
+"""Tiling helpers shared by the kernel library.
+
+Plays the role of the reference's threadblock-swizzle helper modules
+(ag_gemm_threadblock_swizzle.py etc., SURVEY.md §2.4): tile-size selection and
+rank-swizzled visit orders for overlap-friendly consumption.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.pallas import tpu as pltpu
+
+
+def pick_tile(dim: int, cap: int, align: int = 1) -> int:
+    """Largest divisor of ``dim`` not exceeding ``cap`` that is a multiple of
+    ``align``; falls back to ``dim`` itself when no aligned divisor exists
+    (slicing the whole dimension never misaligns).
+
+    Mosaic requires HBM slice offsets/shapes aligned to the memref tiling:
+    last dim multiples of 128, second-to-last multiples of the dtype sublane
+    count (8 for f32, 16 for bf16) — interpret mode does not enforce this,
+    real compilation does.
+    """
+    t = min(dim, cap)
+    while t >= align:
+        if dim % t == 0 and t % align == 0:
+            return t
+        t -= 1
+    return dim
+
+
+SUBLANE = {2: 16, 4: 8, 1: 32}  # itemsize -> sublane alignment
+
+
+def sublane_align(dtype) -> int:
+    return SUBLANE.get(jnp.dtype(dtype).itemsize, 8)
+
+
+def gemm_tiles(m: int, k: int, ncols: int, dtype, cfg) -> tuple[int, int, int]:
+    """(tm, tk, tn) for a tiled matmul over (m, k) @ (k, ncols): row tiles
+    sublane-aligned, contraction/column tiles lane(128)-aligned."""
+    sa = sublane_align(dtype)
+    return (
+        pick_tile(m, cfg.tile_m, sa),
+        pick_tile(k, cfg.tile_k, 128),
+        pick_tile(ncols, cfg.tile_n, 128),
+    )
+
+
+def swizzled_ranks(me, n: int):
+    """Visit order starting at own rank: me, me+1, …, me-1 (mod n) — the
+    analog of the reference's rank-swizzled tile order so the consumer starts
+    on data that is locally available first (allgather_gemm.py:221-229)."""
+    return [jax.lax.rem(me + i, n) for i in range(n)]
+
+
+def matmul_tiles(
+    a_tile_at,            # (im, kk) -> HBM ref slice (tm, tk)
+    b_tile_at,            # (kk, jn) -> HBM ref slice (tk, tn)
+    out_tile_at,          # (im, jn) -> HBM ref slice (tm, tn)
+    m: int, k: int, ncols: int,
+    tm: int, tk: int, tn: int,
+    va, vb, vacc, vout, copy_sem,
+):
+    """Serial tiled matmul: out = A @ B staged through VMEM with fp32
+    accumulation on the MXU.
+
+    The compute core shared by the overlapped kernels (the analog of the
+    reference's persistent consumer GEMM inner loop,
+    allgather_gemm.py:217-264, minus readiness waits — callers interleave
+    waits around chunk boundaries).
+    """
+    for jn in range(ncols // tn):
+        for im in range(m // tm):
+            vacc[...] = jnp.zeros_like(vacc)
+            for kk in range(k // tk):
+                ca = pltpu.make_async_copy(a_tile_at(im, kk), va, copy_sem)
+                ca.start()
+                ca.wait()
+                cb = pltpu.make_async_copy(b_tile_at(kk, jn), vb, copy_sem)
+                cb.start()
+                cb.wait()
+                vacc[...] = vacc[...] + jnp.dot(
+                    va[...], vb[...], preferred_element_type=jnp.float32)
+            vout[...] = vacc[...].astype(vout.dtype)
+            co = pltpu.make_async_copy(vout, out_tile_at(im, jn), copy_sem)
+            co.start()
+            co.wait()

@@ -1,0 +1,78 @@
+"""Workarounds for jax 0.9.0 TPU-interpret mode on small-CPU hosts.
+
+Applied automatically the first time a kernel runs in interpret mode (and by
+tests/conftest.py up front). Real-TPU execution never touches these paths.
+
+Two independent issues, both observed deterministically on a 1-CPU sandbox
+with an 8-device virtual mesh:
+
+1. ``Semaphore.wait(has_tasks=True)`` busy-spins while the count is
+   insufficient and no executable task is queued — the common case in "eager"
+   DMA mode when genuinely waiting for another device. Eight spinning device
+   threads under one GIL starve the worker thread; collectives take minutes.
+   Replaced with a blocking condition-variable wait (``signal`` always
+   ``notify_all``s, so this is sound; a small timeout covers increments done
+   by popped tasks).
+
+2. ``io_callback_impl`` (jax/_src/callback.py:437) device_puts every callback
+   arg onto cpu:0 *asynchronously*; ``np.array(val)`` inside the interpret
+   machinery then needs the cpu:0 execution queue — which a blocked
+   pallas-interpret callback may be occupying — deadlocking kernel startup
+   for any buffer large enough to take the async device_put path (≈64KB+).
+   Replaced with direct numpy conversion (the interpret callbacks only
+   consume numpy values).
+"""
+
+from __future__ import annotations
+
+import os
+
+_APPLIED = False
+
+
+def apply_interpret_workarounds() -> None:
+    global _APPLIED
+    if _APPLIED:
+        return
+    _APPLIED = True
+    if os.environ.get("TDTPU_DETECT_RACES", "0") != "1":
+        _patch_semaphore_wait()
+    _patch_io_callback_device_put()
+
+
+def _patch_semaphore_wait() -> None:
+    from jax._src.pallas.mosaic.interpret import shared_memory as sm
+
+    def wait(self, value, global_core_id, *, has_tasks=False):
+        global_core_id = int(global_core_id)
+        while True:
+            with self.cv:
+                if self.count_by_core[global_core_id] >= value:
+                    self.count_by_core[global_core_id] -= value
+                    return
+            task = None
+            if has_tasks:
+                with self.shared_memory.lock:
+                    queue = self.shared_memory.tasks_by_sem[(self.id, global_core_id)]
+                    if len(queue) > 0:
+                        task = queue.pop()
+            if task is not None:
+                task()
+            else:
+                with self.cv:
+                    if self.count_by_core[global_core_id] < value:
+                        self.cv.wait(timeout=0.005)
+
+    sm.Semaphore.wait = wait
+
+
+def _patch_io_callback_device_put() -> None:
+    import numpy as np
+    from jax import tree_util
+    from jax._src import callback as jcb
+
+    def _sync_io_callback_impl(*args, result_avals, callback, sharding, ordered):
+        del result_avals, sharding, ordered
+        return tree_util.tree_map(np.asarray, callback(*args))
+
+    jcb.io_callback_impl = _sync_io_callback_impl

@@ -1,0 +1,83 @@
+"""ICI topology discovery.
+
+Reference: the NVML/nvidia-smi probes in utils.py — NVLink fullmesh (:717),
+PCIe gen (:748), NUMA grouping (:835), multimem support (:963) — feeding comm
+algorithm auto-selection (allgather.py:57-72, allreduce.py:1101).
+
+TPU analog: the JAX runtime exposes chip coordinates directly
+(``device.coords``); a v5p slice's ICI is a 3-D torus, so "fullmesh vs ring"
+becomes "same-ring vs cross-ring" over the torus axes, and DCN vs ICI is
+``device.process_index`` (inter-host slices are still ICI within a pod; DCN
+only across pods/slices — we conservatively treat process boundaries as the
+potential DCN tier, mirroring the reference's intra/inter-node split).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    num_devices: int
+    platform: str
+    coords: tuple | None          # per-device chip coords, None off-TPU
+    num_processes: int
+    devices_per_process: int
+    is_multi_host: bool
+
+    @property
+    def has_ici_torus(self) -> bool:
+        """True when devices expose physical torus coordinates (real TPU)."""
+        return self.coords is not None
+
+
+def detect_topology(devices=None) -> Topology:
+    devs = list(devices if devices is not None else jax.devices())
+    coords = None
+    try:
+        if devs and devs[0].platform == "tpu":
+            coords = tuple(getattr(d, "coords", None) for d in devs)
+            if any(c is None for c in coords):
+                coords = None
+    except Exception:
+        coords = None
+    procs = {d.process_index for d in devs}
+    return Topology(
+        num_devices=len(devs),
+        platform=devs[0].platform if devs else "none",
+        coords=coords,
+        num_processes=len(procs),
+        devices_per_process=len(devs) // max(len(procs), 1),
+        is_multi_host=len(procs) > 1,
+    )
+
+
+def ici_ring_order(topology: Topology) -> list[int]:
+    """A device order that walks the ICI torus with neighbor hops (the ring
+    used by ring collectives). Off-TPU (or unknown coords) the logical order
+    is returned — the CPU test mesh has uniform 'links' anyway.
+
+    Analog of the reference's NUMA-aware ring construction
+    (cp_engine_producer_all_gather_ring_push_numa_2d, allgather.py:211).
+    """
+    n = topology.num_devices
+    if not topology.has_ici_torus:
+        return list(range(n))
+    # Sort by a snake walk over coords: even rows left→right, odd right→left,
+    # which makes successive devices physical neighbors on a torus mesh.
+    idx = sorted(range(n), key=lambda i: _snake_key(topology.coords[i]))
+    return idx
+
+
+def _snake_key(coord):
+    c = tuple(coord)
+    key = []
+    flip = False
+    for axis_val in c[:-1]:
+        key.append(axis_val)
+        flip = (axis_val % 2 == 1) != flip
+    key.append(-c[-1] if flip else c[-1])
+    return tuple(key)
