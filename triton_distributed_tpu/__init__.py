@@ -11,17 +11,8 @@ ByteDance's Triton-distributed (reference layer map in SURVEY.md §1):
   allocation, ``initialize_distributed``, perf + debug utilities
   (reference: ``python/triton_dist/utils.py``).
 - ``ops``       — tile-centric overlapped kernel library: AllGather (+GEMM),
-  GEMM(+ReduceScatter), AllReduce (+GEMM epilogue), low-latency MoE AllToAll,
-  SP attention, distributed flash-decode
+  GEMM(+ReduceScatter), AllReduce (+GEMM epilogue), P2P ring shift
   (reference: ``python/triton_dist/kernels/nvidia/``).
-- ``parallel``  — TP/EP/SP/PP model layers
-  (reference: ``python/triton_dist/layers/nvidia/``).
-- ``models``    — model configs, dense + MoE LLMs, KV cache, inference engine
-  (reference: ``python/triton_dist/models/``).
-- ``megakernel``— persistent single-kernel runtime: task queues + semaphore
-  scoreboard in one Pallas kernel
-  (reference: ``python/triton_dist/mega_triton_kernel/``).
-- ``tools``     — AOT compilation helpers (reference: ``python/triton_dist/tools/``).
 """
 
 __version__ = "0.1.0"

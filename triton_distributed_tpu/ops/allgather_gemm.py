@@ -46,15 +46,14 @@ class AGGemmConfig:
     """Tile configuration (the tunable surface the reference exposes through
     its autotuner configs; AllGatherGEMMTensorParallelContext analog)."""
 
-    tile_m: int = 256
-    tile_n: int = 256
-    tile_k: int = 512
+    tile_m: int = 512
+    tile_n: int = 1024
+    tile_k: int = 1024
 
 
 def _ag_gemm_kernel(n: int, axis: str, m: int, k: int, ncols: int,
                     tiles, x_ref, b_ref, out_ref, ws_ref,
-                    va, vb, vacc, vout,
-                    send_sems, recv_sems, copy_sem, mm_sem):
+                    vacc, send_sems, recv_sems):
     """See module docstring. ws_ref is the AG landing workspace (n·m, k)."""
     me = dl.rank(axis)
     shmem.barrier_all(axis)
@@ -79,14 +78,9 @@ def _ag_gemm_kernel(n: int, axis: str, m: int, k: int, ncols: int,
         r = jax.lax.rem(me + i, n)
         shmem.wait_deliveries(x_ref, recv_sems.at[r], 1)
         row0 = r * m
-        matmul_tiles(
-            lambda im, kk: ws_ref.at[pl.ds(row0 + im * tm, tm),
-                                     pl.ds(kk * tk, tk)],
-            lambda kk, jn: b_ref.at[pl.ds(kk * tk, tk), pl.ds(jn * tn, tn)],
-            lambda im, jn: out_ref.at[pl.ds(row0 + im * tm, tm),
-                                      pl.ds(jn * tn, tn)],
-            m, k, ncols, tm, tk, tn, va, vb, vacc, vout, mm_sem,
-        )
+        rows = pl.ds(row0, m)
+        matmul_tiles(ws_ref.at[rows], b_ref, out_ref.at[rows],
+                     m, k, ncols, tm, tk, tn, vacc)
     shmem.quiet(*handles)
 
 
@@ -106,28 +100,25 @@ def ag_gemm_local(x_local: jax.Array, b_local: jax.Array, axis: str = "tp",
     if k != k2:
         raise ValueError(f"inner dims mismatch: A has k={k}, B has k={k2}")
     if n == 1:
-        return jnp.dot(x_local, b_local,
-                       preferred_element_type=jnp.float32).astype(x_local.dtype)
+        # Degenerate world: no communication, but still run the real Pallas
+        # compute core so single-chip compile checks exercise the kernel path.
+        from triton_distributed_tpu.ops.gemm import pallas_matmul
+
+        return pallas_matmul(x_local, b_local, tile_m=cfg.tile_m,
+                             tile_n=cfg.tile_n, tile_k=cfg.tile_k)
     tm, tk, tn = gemm_tiles(m, k, ncols, x_local.dtype, cfg)
     kernel = functools.partial(_ag_gemm_kernel, n, axis, m, k, ncols,
                                (tm, tk, tn))
-    out, _ = kernel_call(
+    out = kernel_call(
         kernel,
-        out_shape=(
-            jax.ShapeDtypeStruct((n * m, ncols), x_local.dtype),
-            jax.ShapeDtypeStruct((n * m, k), x_local.dtype),  # AG workspace
-        ),
+        out_shape=jax.ShapeDtypeStruct((n * m, ncols), x_local.dtype),
         in_specs=[any_spec(), any_spec()],
-        out_specs=(any_spec(), any_spec()),
+        out_specs=any_spec(),
         scratch_shapes=[
-            pltpu.VMEM((tm, tk), x_local.dtype),
-            pltpu.VMEM((tk, tn), b_local.dtype),
+            pltpu.HBM((n * m, k), x_local.dtype),  # AG landing workspace
             pltpu.VMEM((tm, tn), jnp.float32),
-            pltpu.VMEM((tm, tn), x_local.dtype),
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
             pltpu.SemaphoreType.DMA((n,)),
-            pltpu.SemaphoreType.DMA(()),
-            pltpu.SemaphoreType.DMA(()),
         ],
         uses_barrier=True,
     )(x_local, b_local)

@@ -28,10 +28,8 @@ from triton_distributed_tpu import language as dl
 from triton_distributed_tpu.language import shmem_device as shmem
 from triton_distributed_tpu.language.core import kernel_call, any_spec
 from triton_distributed_tpu.ops.allgather import all_gather_local, AllGatherMethod
-from triton_distributed_tpu.ops.reduce_scatter import (
-    reduce_scatter_local,
-    _pick_tile_m,
-)
+from triton_distributed_tpu.ops.reduce_scatter import reduce_scatter_local
+from triton_distributed_tpu.ops.tiling import pick_tile, sublane_align
 from triton_distributed_tpu.runtime.context import DistContext, get_context
 from triton_distributed_tpu.runtime.jit_cache import cached_shard_jit
 
@@ -109,7 +107,7 @@ def all_reduce_local(x_local: jax.Array, axis: str = "tp",
         scattered = reduce_scatter_local(x_local, axis=axis, num_ranks=n)
         return all_gather_local(scattered, axis=axis, num_ranks=n,
                                 method=AllGatherMethod.RING_1D)
-    tile_m = _pick_tile_m(m)
+    tile_m = pick_tile(m, 512, sublane_align(x_local.dtype))
     kernel = functools.partial(_ar_one_shot_kernel, n, axis, m, tile_m)
     return kernel_call(
         kernel,

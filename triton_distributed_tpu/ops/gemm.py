@@ -1,10 +1,9 @@
 """Single-device tiled Pallas matmul.
 
 The compute core of the overlapped kernels exposed standalone — used for
-benchmarking kernel efficiency against XLA's native dot and by the megakernel
-task library (reference analog: the tile GEMM task kernels of
-mega_triton_kernel/kernels/, and the persistent GEMM of allgather_gemm.py
-without its waits).
+benchmarking kernel efficiency against XLA's native dot (reference analog:
+the persistent consumer GEMM of allgather_gemm.py:158-264 without its
+readiness waits).
 """
 
 from __future__ import annotations
@@ -20,19 +19,13 @@ from triton_distributed_tpu.language.core import kernel_call, any_spec
 from triton_distributed_tpu.ops.tiling import matmul_tiles, pick_tile, sublane_align
 
 
-def _matmul_kernel(m, k, ncols, tm, tk, tn, a_ref, b_ref, out_ref,
-                   va, vb, vacc, vout, sem):
-    matmul_tiles(
-        lambda im, kk: a_ref.at[pl.ds(im * tm, tm), pl.ds(kk * tk, tk)],
-        lambda kk, jn: b_ref.at[pl.ds(kk * tk, tk), pl.ds(jn * tn, tn)],
-        lambda im, jn: out_ref.at[pl.ds(im * tm, tm), pl.ds(jn * tn, tn)],
-        m, k, ncols, tm, tk, tn, va, vb, vacc, vout, sem,
-    )
+def _matmul_kernel(m, k, ncols, tm, tk, tn, a_ref, b_ref, out_ref, vacc):
+    matmul_tiles(a_ref, b_ref, out_ref, m, k, ncols, tm, tk, tn, vacc)
 
 
 def pallas_matmul(a: jax.Array, b: jax.Array,
-                  tile_m: int = 256, tile_n: int = 256,
-                  tile_k: int = 512) -> jax.Array:
+                  tile_m: int = 512, tile_n: int = 1024,
+                  tile_k: int = 1024) -> jax.Array:
     """out = a @ b with fp32 accumulation, staged through VMEM tiles."""
     m, k = a.shape
     k2, ncols = b.shape
@@ -48,11 +41,7 @@ def pallas_matmul(a: jax.Array, b: jax.Array,
         in_specs=[any_spec(), any_spec()],
         out_specs=any_spec(),
         scratch_shapes=[
-            pltpu.VMEM((tm, tk), a.dtype),
-            pltpu.VMEM((tk, tn), b.dtype),
             pltpu.VMEM((tm, tn), jnp.float32),
-            pltpu.VMEM((tm, tn), a.dtype),
-            pltpu.SemaphoreType.DMA(()),
         ],
         cost_estimate=pl.CostEstimate(
             flops=2 * m * k * ncols,

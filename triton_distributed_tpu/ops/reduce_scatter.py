@@ -29,12 +29,7 @@ from triton_distributed_tpu.runtime.context import DistContext, get_context
 from triton_distributed_tpu.runtime.jit_cache import cached_shard_jit
 
 
-def _pick_tile_m(m: int, cap: int = 512) -> int:
-    """Largest divisor of m not exceeding cap (VMEM staging tile rows)."""
-    t = min(m, cap)
-    while m % t:
-        t -= 1
-    return t
+from triton_distributed_tpu.ops.tiling import pick_tile, sublane_align
 
 
 def _tiled_add(dst_at, a_at, b_at, m: int, tile_m: int, va, vb, copy_sem):
@@ -122,7 +117,9 @@ def reduce_scatter_local(x_local: jax.Array, axis: str = "tp",
     if mt % n:
         raise ValueError(f"rows {mt} not divisible by num_ranks {n}")
     m = mt // n
-    tile_m = _pick_tile_m(m)
+    # Sublane-aligned staging tiles — Mosaic rejects unaligned HBM slice
+    # offsets on real TPU even though interpret mode accepts them.
+    tile_m = pick_tile(m, 512, sublane_align(x_local.dtype))
     kernel = functools.partial(_rs_ring_kernel, n, axis, m, tile_m)
     return kernel_call(
         kernel,

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
@@ -56,34 +57,46 @@ def swizzled_ranks(me, n: int):
 
 
 def matmul_tiles(
-    a_tile_at,            # (im, kk) -> HBM ref slice (tm, tk)
-    b_tile_at,            # (kk, jn) -> HBM ref slice (tk, tn)
-    out_tile_at,          # (im, jn) -> HBM ref slice (tm, tn)
+    a_view,               # ref view (m, k) in HBM/ANY
+    b_view,               # ref view (k, ncols)
+    out_view,             # ref view (m, ncols)
     m: int, k: int, ncols: int,
     tm: int, tk: int, tn: int,
-    va, vb, vacc, vout, copy_sem,
+    acc,                  # VMEM (tm, tn) fp32 accumulator scratch
 ):
-    """Serial tiled matmul: out = A @ B staged through VMEM with fp32
-    accumulation on the MXU.
+    """Pipelined tiled matmul: out = A @ B with fp32 MXU accumulation.
 
     The compute core shared by the overlapped kernels (the analog of the
     reference's persistent consumer GEMM inner loop,
     allgather_gemm.py:217-264, minus readiness waits — callers interleave
     waits around chunk boundaries).
+
+    Uses ``pltpu.emit_pipeline`` so every A/B tile fetch and out tile flush
+    is double-buffered against the MXU dots — the DMA/compute overlap the
+    reference gets from its software-pipelined persistent GEMM.
     """
-    for jn in range(ncols // tn):
-        for im in range(m // tm):
-            vacc[...] = jnp.zeros_like(vacc)
-            for kk in range(k // tk):
-                ca = pltpu.make_async_copy(a_tile_at(im, kk), va, copy_sem)
-                ca.start()
-                ca.wait()
-                cb = pltpu.make_async_copy(b_tile_at(kk, jn), vb, copy_sem)
-                cb.start()
-                cb.wait()
-                vacc[...] = vacc[...] + jnp.dot(
-                    va[...], vb[...], preferred_element_type=jnp.float32)
-            vout[...] = vacc[...].astype(vout.dtype)
-            co = pltpu.make_async_copy(vout, out_tile_at(im, jn), copy_sem)
-            co.start()
-            co.wait()
+    nk = k // tk
+
+    def body(a_v, b_v, o_v, acc_ref):
+        kk = pl.program_id(2)
+
+        @pl.when(kk == 0)
+        def _():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        acc_ref[...] += jnp.dot(a_v[...], b_v[...],
+                                preferred_element_type=jnp.float32)
+
+        @pl.when(kk == nk - 1)
+        def _():
+            o_v[...] = acc_ref[...].astype(o_v.dtype)
+
+    pltpu.emit_pipeline(
+        body,
+        grid=(m // tm, ncols // tn, nk),
+        in_specs=[
+            pl.BlockSpec((tm, tk), lambda i, j, q: (i, q)),
+            pl.BlockSpec((tk, tn), lambda i, j, q: (q, j)),
+        ],
+        out_specs=[pl.BlockSpec((tm, tn), lambda i, j, q: (i, j))],
+    )(a_view, b_view, out_view, scratches=[acc])

@@ -90,8 +90,11 @@ def initialize_distributed(
     mesh = Mesh(np.array(devs).reshape(mesh_shape), tuple(axis_names))
     ctx = DistContext(mesh=mesh, tp_axis=axis_names[0])
     set_context(ctx)
-    # Deterministic seeding across the world, like the reference's per-rank seeds.
-    np.random.seed(seed)
+    # Unlike the reference (which reseeds every library's global RNG,
+    # utils.py:182), no global RNG state is touched: callers seed their own
+    # np.random.Generator / jax.random key. ``seed`` is kept for signature
+    # parity and ignored.
+    del seed
     return ctx
 
 

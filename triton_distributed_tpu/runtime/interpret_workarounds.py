@@ -38,6 +38,7 @@ def apply_interpret_workarounds() -> None:
     if os.environ.get("TDTPU_DETECT_RACES", "0") != "1":
         _patch_semaphore_wait()
     _patch_io_callback_device_put()
+    _patch_tpu_generation_probe()
 
 
 def _patch_semaphore_wait() -> None:
@@ -64,6 +65,16 @@ def _patch_semaphore_wait() -> None:
                         self.cv.wait(timeout=0.005)
 
     sm.Semaphore.wait = wait
+
+
+def _patch_tpu_generation_probe() -> None:
+    """``pltpu.emit_pipeline`` queries the TPU generation to size sublane
+    tilings (pipeline._get_tpu_generation → tpu_info.get_tpu_info), which
+    raises on the CPU backend. Interpret mode emulates a current-generation
+    TPU, so answer the probe with a post-v4 generation."""
+    from jax._src.pallas.mosaic import pipeline
+
+    pipeline._get_tpu_generation = lambda: 5
 
 
 def _patch_io_callback_device_put() -> None:
