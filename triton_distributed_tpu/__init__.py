@@ -11,8 +11,13 @@ ByteDance's Triton-distributed (reference layer map in SURVEY.md §1):
   allocation, ``initialize_distributed``, perf + debug utilities
   (reference: ``python/triton_dist/utils.py``).
 - ``ops``       — tile-centric overlapped kernel library: AllGather (+GEMM),
-  GEMM(+ReduceScatter), AllReduce (+GEMM epilogue), P2P ring shift
+  GEMM(+ReduceScatter), AllReduce (+GEMM epilogue), low-latency MoE
+  AllToAll, P2P ring shift
   (reference: ``python/triton_dist/kernels/nvidia/``).
+- ``layers``    — TP model layers (TP_MLP / TP_Attn with xla/overlap/ar
+  modes) (reference: ``python/triton_dist/layers/nvidia/``).
+- ``models``    — ModelConfig, dense Qwen3-style LLM, KV cache, sampling,
+  jitted inference Engine (reference: ``python/triton_dist/models/``).
 """
 
 __version__ = "0.1.0"

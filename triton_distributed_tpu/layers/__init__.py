@@ -1,0 +1,21 @@
+"""TP/EP/SP model layers (reference: ``python/triton_dist/layers/nvidia/``)."""
+
+from triton_distributed_tpu.layers.common import (  # noqa: F401
+    rms_norm,
+    rope_cos_sin,
+    apply_rope,
+    swiglu,
+)
+from triton_distributed_tpu.layers.tp_mlp import (  # noqa: F401
+    init_tp_mlp,
+    tp_mlp_specs,
+    tp_mlp_fwd,
+    pick_mode,
+)
+from triton_distributed_tpu.layers.tp_attn import (  # noqa: F401
+    KVSlice,
+    init_tp_attn,
+    tp_attn_specs,
+    tp_attn_prefill,
+    tp_attn_decode,
+)

@@ -1,0 +1,48 @@
+"""Shared layer math: RMSNorm, RoPE, SwiGLU.
+
+Reference: the norm / rotary helpers inside
+``python/triton_dist/layers/nvidia/tp_attn.py:79-324`` and the
+mega_triton_kernel rms_norm task kernels. On TPU these stay as jnp
+expressions — XLA fuses elementwise chains into neighboring matmuls better
+than hand-written kernels for these shapes (SURVEY.md §7: don't hand-schedule
+what the compiler already does).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    """RMSNorm in fp32 accumulation (Qwen/Llama convention)."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    out = xf * jax.lax.rsqrt(var + eps)
+    return (out * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def rope_cos_sin(positions: jax.Array, head_dim: int, theta: float,
+                 dtype=jnp.float32):
+    """(cos, sin) tables for ``positions`` (any shape) → (*pos, head_dim/2)."""
+    inv_freq = 1.0 / (theta ** (
+        jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Rotate pairs (HF non-interleaved convention: split halves).
+
+    x: (..., seq, heads, head_dim); cos/sin: (..., seq, head_dim/2).
+    """
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    c = cos[..., None, :]  # broadcast over heads
+    s = sin[..., None, :]
+    return jnp.concatenate(
+        [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+
+
+def swiglu(gate: jax.Array, up: jax.Array) -> jax.Array:
+    return jax.nn.silu(gate) * up

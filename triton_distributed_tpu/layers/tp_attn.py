@@ -1,0 +1,200 @@
+"""Tensor-parallel attention — column-parallel QKV, row-parallel output.
+
+Reference: ``python/triton_dist/layers/nvidia/tp_attn.py:79-324`` — QKV
+col-parallel (heads sharded over ranks), RoPE, flash attention, out proj
+row-parallel, with the same mode family as TP_MLP. Qwen3 per-head q/k
+RMSNorm included (reference wires it through the HF weights).
+
+Layouts (same contract as layers/tp_mlp.py):
+- ``overlap``/``xla``: x sequence-row-sharded (m/n, h); the QKV projection
+  regathers the full sequence (AG+GEMM) because attention needs every row —
+  the gather IS the sequence re-materialization, overlapped with the GEMM.
+  Output proj reshards rows via GEMM+RS.
+- ``ar``: x replicated (m, h); local heads attend, out-proj partials ride a
+  fused AllReduce. Decode path.
+
+Heads are sharded: num_heads/n query heads and num_kv_heads/n KV heads per
+device (standard GQA TP; requires n | num_kv_heads).
+
+All functions are device-local: call inside ``shard_map`` over ``axis``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.layers.common import apply_rope, rms_norm, rope_cos_sin
+from triton_distributed_tpu.models.config import ModelConfig
+from triton_distributed_tpu.ops.allgather_gemm import ag_gemm_local
+from triton_distributed_tpu.ops.gemm_reduce_scatter import gemm_rs_local
+from triton_distributed_tpu.ops.allreduce import all_reduce_local
+
+
+def init_tp_attn(rng: jax.Array, cfg: ModelConfig, dtype) -> dict:
+    kq, kk, kv, ko = jax.random.split(rng, 4)
+    h, qs, kvs = cfg.hidden_size, cfg.q_size, cfg.kv_size
+    scale = h ** -0.5
+    params = {
+        "wq": jax.random.normal(kq, (h, qs), dtype) * scale,
+        "wk": jax.random.normal(kk, (h, kvs), dtype) * scale,
+        "wv": jax.random.normal(kv, (h, kvs), dtype) * scale,
+        "wo": jax.random.normal(ko, (qs, h), dtype) * (qs ** -0.5),
+    }
+    if cfg.qk_norm:
+        params["q_norm"] = jnp.ones((cfg.head_dim,), dtype)
+        params["k_norm"] = jnp.ones((cfg.head_dim,), dtype)
+    return params
+
+
+def tp_attn_specs(cfg: ModelConfig, axis: str = "tp") -> dict:
+    from jax.sharding import PartitionSpec as P
+
+    specs = {"wq": P(None, axis), "wk": P(None, axis), "wv": P(None, axis),
+             "wo": P(axis, None)}
+    if cfg.qk_norm:
+        specs["q_norm"] = P()
+        specs["k_norm"] = P()
+    return specs
+
+
+class KVSlice(NamedTuple):
+    """One layer's local KV cache slice: (batch, max_seq, kvh/n, head_dim)."""
+
+    k: jax.Array
+    v: jax.Array
+
+
+def _project_qkv(params, cfg: ModelConfig, x, batch, seq, *, axis, n, mode):
+    """x → q (B,S,hq,d), k/v (B,S,hkv,d) with qk-norm + heads split.
+    In overlap/xla modes this also regathers the full sequence."""
+    if mode in ("overlap", "xla") and n > 1:
+        if mode == "overlap":
+            q = ag_gemm_local(x, params["wq"], axis=axis, num_ranks=n)
+            k = ag_gemm_local(x, params["wk"], axis=axis, num_ranks=n)
+            v = ag_gemm_local(x, params["wv"], axis=axis, num_ranks=n)
+        else:
+            full = jax.lax.all_gather(x, axis, tiled=True)
+            q, k, v = full @ params["wq"], full @ params["wk"], full @ params["wv"]
+    else:  # replicated input (ar modes) or single rank
+        q, k, v = x @ params["wq"], x @ params["wk"], x @ params["wv"]
+    hq = q.shape[-1] // cfg.head_dim
+    hkv = k.shape[-1] // cfg.head_dim
+    q = q.reshape(batch, seq, hq, cfg.head_dim)
+    k = k.reshape(batch, seq, hkv, cfg.head_dim)
+    v = v.reshape(batch, seq, hkv, cfg.head_dim)
+    if cfg.qk_norm:
+        q = rms_norm(q, params["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, params["k_norm"], cfg.rms_norm_eps)
+    return q, k, v
+
+
+def _sdpa(q, k, v, *, causal: bool, kv_len: jax.Array | None = None):
+    """Grouped-query scaled dot-product attention.
+
+    q: (B, Sq, hq, d); k/v: (B, Skv, hkv, d); hq % hkv == 0.
+    ``kv_len`` masks positions >= kv_len (decode over a padded cache).
+    """
+    b, sq, hq, d = q.shape
+    skv, hkv = k.shape[1], k.shape[2]
+    group = hq // hkv
+    qf = q.astype(jnp.float32).reshape(b, sq, hkv, group, d)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    logits = jnp.einsum("bqhgd,bkhd->bhgqk", qf, kf) / math.sqrt(d)
+    mask = None
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, skv), bool), k=skv - sq)
+    if kv_len is not None:
+        len_mask = jnp.arange(skv) < kv_len
+        mask = len_mask[None, :] if mask is None else mask & len_mask[None, :]
+    if mask is not None:
+        logits = jnp.where(mask[None, None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, vf)
+    return out.reshape(b, sq, hq, d).astype(q.dtype)
+
+
+def tp_attn_prefill(params: dict, cfg: ModelConfig, x: jax.Array,
+                    batch: int, seq: int, kv_slice: KVSlice | None = None, *,
+                    axis: str = "tp", num_ranks: int = 1,
+                    mode: str = "overlap"):
+    """Causal prefill. x: (B·S/n, h) row-sharded (overlap/xla) or (B·S, h)
+    replicated (ar). Returns (out, KVSlice of the full prompt written into
+    ``kv_slice`` at positions [0, S))."""
+    n = num_ranks
+    if n == 1:
+        mode = "local"
+    q, k, v = _project_qkv(params, cfg, x, batch, seq,
+                           axis=axis, n=n, mode=mode)
+    cos, sin = rope_cos_sin(jnp.arange(seq), cfg.head_dim, cfg.rope_theta)
+    q = apply_rope(q, cos[None], sin[None])
+    k = apply_rope(k, cos[None], sin[None])
+
+    if kv_slice is not None:
+        new_kv = KVSlice(
+            k=jax.lax.dynamic_update_slice(
+                kv_slice.k, k.astype(kv_slice.k.dtype), (0, 0, 0, 0)),
+            v=jax.lax.dynamic_update_slice(
+                kv_slice.v, v.astype(kv_slice.v.dtype), (0, 0, 0, 0)),
+        )
+    else:
+        new_kv = KVSlice(k=k, v=v)
+
+    attn = _sdpa(q, k, v, causal=True)
+    attn = attn.reshape(batch * seq, -1)
+
+    if n == 1:
+        out = attn @ params["wo"]
+    elif mode == "overlap":
+        out = gemm_rs_local(attn, params["wo"], axis=axis, num_ranks=n)
+    elif mode == "xla":
+        out = jax.lax.psum_scatter(attn @ params["wo"], axis,
+                                   scatter_dimension=0, tiled=True)
+    elif mode == "ar":
+        out = all_reduce_local(attn @ params["wo"], axis=axis, num_ranks=n)
+    elif mode == "xla_rep":
+        out = jax.lax.psum(attn @ params["wo"], axis)
+    else:
+        raise ValueError(f"unknown TP attn mode {mode!r}")
+    return out, new_kv
+
+
+def tp_attn_decode(params: dict, cfg: ModelConfig, x: jax.Array,
+                   kv_slice: KVSlice, pos: jax.Array, *,
+                   axis: str = "tp", num_ranks: int = 1, mode: str = "ar"):
+    """Single-token decode step. x: (B, h) replicated (ar modes only — a
+    1-row activation cannot be row-sharded; reference dense.py uses the AR
+    path for decode too). ``pos``: scalar current position. Returns
+    (out (B, h), updated KVSlice)."""
+    n = num_ranks
+    batch = x.shape[0]
+    q, k, v = _project_qkv(params, cfg, x, batch, 1,
+                           axis=axis, n=n, mode="ar")
+    cos, sin = rope_cos_sin(pos[None], cfg.head_dim, cfg.rope_theta)
+    q = apply_rope(q, cos[None], sin[None])
+    k = apply_rope(k, cos[None], sin[None])
+
+    new_kv = KVSlice(
+        k=jax.lax.dynamic_update_slice(
+            kv_slice.k, k.astype(kv_slice.k.dtype), (0, pos, 0, 0)),
+        v=jax.lax.dynamic_update_slice(
+            kv_slice.v, v.astype(kv_slice.v.dtype), (0, pos, 0, 0)),
+    )
+
+    attn = _sdpa(q, new_kv.k.astype(q.dtype), new_kv.v.astype(q.dtype),
+                 causal=False, kv_len=pos + 1)
+    attn = attn.reshape(batch, -1)
+
+    if n == 1:
+        out = attn @ params["wo"]
+    elif mode == "ar":
+        out = all_reduce_local(attn @ params["wo"], axis=axis, num_ranks=n)
+    elif mode == "xla_rep":
+        out = jax.lax.psum(attn @ params["wo"], axis)
+    else:
+        raise ValueError(f"decode supports modes 'ar'/'xla_rep', got {mode!r}")
+    return out, new_kv

@@ -1,0 +1,91 @@
+"""Tensor-parallel MLP (SwiGLU) — column-parallel gate/up, row-parallel down.
+
+Reference: ``python/triton_dist/layers/nvidia/tp_mlp.py:52-274`` — fwd
+variants ``torch`` (plain collectives), ``dist_triton`` (AG+GEMM → GEMM+RS),
+``triton_dist_AR`` (local GEMMs + fused AllReduce), ``gemm_ar``. Mode names
+here: ``xla`` / ``overlap`` / ``ar`` / ``auto``.
+
+Layout contract (matches the reference's TP dataflow, dense.py:84-115):
+
+- ``overlap`` and ``xla``: activations are **sequence(row)-sharded** —
+  in (m/n, h), out (m/n, h). The AG+GEMM producer regathers rows while the
+  consumer GEMM runs; GEMM+RS returns them scattered.
+- ``ar``: activations **replicated** — in (m, h), out (m, h); the down-proj
+  partial sums ride a fused one-shot AllReduce. The decode path (m < n rows
+  cannot be sharded).
+- ``auto``: ``overlap`` when the row count divides and is worth gathering,
+  else ``ar`` — the analog of the reference's per-M dispatch
+  (models/dense.py:84-99).
+
+All functions are device-local: call inside ``shard_map`` over ``axis``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.layers.common import swiglu
+from triton_distributed_tpu.ops.allgather_gemm import ag_gemm_local
+from triton_distributed_tpu.ops.gemm_reduce_scatter import gemm_rs_local
+from triton_distributed_tpu.ops.allreduce import all_reduce_local
+
+ROW_SHARDED_MODES = ("overlap", "xla")
+REPLICATED_MODES = ("ar", "xla_rep")
+
+
+def init_tp_mlp(rng: jax.Array, hidden: int, ffn: int, dtype) -> dict:
+    """Global-view params; shard w_gate/w_up on dim 1, w_down on dim 0."""
+    kg, ku, kd = jax.random.split(rng, 3)
+    scale = hidden ** -0.5
+    return {
+        "w_gate": jax.random.normal(kg, (hidden, ffn), dtype) * scale,
+        "w_up": jax.random.normal(ku, (hidden, ffn), dtype) * scale,
+        "w_down": jax.random.normal(kd, (ffn, hidden), dtype) * (ffn ** -0.5),
+    }
+
+
+def tp_mlp_specs(axis: str = "tp") -> dict:
+    from jax.sharding import PartitionSpec as P
+
+    return {"w_gate": P(None, axis), "w_up": P(None, axis),
+            "w_down": P(axis, None)}
+
+
+def pick_mode(mode: str, m_total: int, n: int) -> str:
+    """Resolve ``auto`` (reference models/dense.py:84-99 mode dispatch)."""
+    if mode != "auto":
+        return mode
+    if n > 1 and m_total % n == 0 and m_total // n >= 8:
+        return "overlap"
+    return "ar"
+
+
+def tp_mlp_fwd(params: dict, x: jax.Array, *, axis: str = "tp",
+               num_ranks: int = 1, mode: str = "overlap") -> jax.Array:
+    """Device-local TP MLP forward with a concrete mode (models resolve
+    ``auto`` via :func:`pick_mode` — the input layout depends on it).
+    See module docstring for layouts."""
+    n = num_ranks
+    wg, wu, wd = params["w_gate"], params["w_up"], params["w_down"]
+    if n == 1:
+        return swiglu(x @ wg, x @ wu) @ wd
+
+    if mode == "auto":
+        raise ValueError("resolve 'auto' with pick_mode() before calling "
+                         "(the activation layout depends on the mode)")
+    if mode == "overlap":
+        gate = ag_gemm_local(x, wg, axis=axis, num_ranks=n)
+        up = ag_gemm_local(x, wu, axis=axis, num_ranks=n)
+        return gemm_rs_local(swiglu(gate, up), wd, axis=axis, num_ranks=n)
+    if mode == "xla":
+        full = jax.lax.all_gather(x, axis, tiled=True)
+        h = swiglu(full @ wg, full @ wu)
+        return jax.lax.psum_scatter(h @ wd, axis, scatter_dimension=0,
+                                    tiled=True)
+    if mode == "ar":
+        partial = swiglu(x @ wg, x @ wu) @ wd
+        return all_reduce_local(partial, axis=axis, num_ranks=n)
+    if mode == "xla_rep":
+        return jax.lax.psum(swiglu(x @ wg, x @ wu) @ wd, axis)
+    raise ValueError(f"unknown TP MLP mode {mode!r}")

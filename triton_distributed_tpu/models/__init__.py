@@ -1,0 +1,21 @@
+"""Models + inference engine (reference: ``python/triton_dist/models/``)."""
+
+from triton_distributed_tpu.models.config import (  # noqa: F401
+    ModelConfig,
+    QWEN3_8B,
+    QWEN3_32B,
+    QWEN3_30B_A3B,
+    tiny_config,
+)
+from triton_distributed_tpu.models.kv_cache import (  # noqa: F401
+    KVCache,
+    init_kv_cache,
+    kv_cache_specs,
+)
+from triton_distributed_tpu.models.dense import (  # noqa: F401
+    init_dense_llm,
+    dense_llm_specs,
+    dense_prefill,
+    dense_decode_step,
+)
+from triton_distributed_tpu.models.engine import Engine  # noqa: F401

@@ -1,0 +1,140 @@
+"""Dense decoder-only LLM (Qwen3-style) assembled from the TP layers.
+
+Reference: ``python/triton_dist/models/dense.py:53`` (``DenseLLM``), ``:117``
+(``DenseLLMLayer``), ``:169-215`` (shared TP contexts across layers). The
+forward here is **device-local** (runs inside shard_map; the Engine owns the
+mesh) and functional: params in, activations out, KV cache threaded.
+
+Dataflow per block (pre-norm transformer):
+  x ─ rms_norm ─ TP_Attn ─(+)─ rms_norm ─ TP_MLP ─(+)─ …
+with activations sequence-row-sharded in overlap/xla prefill modes and
+replicated in ar/decode modes (see layers/tp_mlp.py for the contract).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.layers.common import rms_norm
+from triton_distributed_tpu.layers.tp_attn import (
+    init_tp_attn, tp_attn_specs, tp_attn_prefill, tp_attn_decode,
+)
+from triton_distributed_tpu.layers.tp_mlp import (
+    init_tp_mlp, tp_mlp_specs, tp_mlp_fwd,
+)
+from triton_distributed_tpu.models.config import ModelConfig
+from triton_distributed_tpu.models.kv_cache import KVCache
+
+
+def init_dense_llm(rng: jax.Array, cfg: ModelConfig) -> dict:
+    """Random global-view params (HF-weight loading: models/hf_loader.py)."""
+    dt = jnp.dtype(cfg.dtype)
+    n_keys = cfg.num_layers * 2 + 3
+    keys = jax.random.split(rng, n_keys)
+    params: dict = {
+        "embed": jax.random.normal(
+            keys[0], (cfg.vocab_size, cfg.hidden_size), dt) * 0.02,
+        "final_norm": jnp.ones((cfg.hidden_size,), dt),
+        "layers": [],
+    }
+    for i in range(cfg.num_layers):
+        params["layers"].append({
+            "attn_norm": jnp.ones((cfg.hidden_size,), dt),
+            "mlp_norm": jnp.ones((cfg.hidden_size,), dt),
+            "attn": init_tp_attn(keys[1 + 2 * i], cfg, dt),
+            "mlp": init_tp_mlp(keys[2 + 2 * i], cfg.hidden_size,
+                               cfg.intermediate_size, dt),
+        })
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = jax.random.normal(
+            keys[-1], (cfg.hidden_size, cfg.vocab_size), dt) * 0.02
+    return params
+
+
+def dense_llm_specs(cfg: ModelConfig, axis: str = "tp") -> dict:
+    """PartitionSpec pytree matching init_dense_llm's structure."""
+    from jax.sharding import PartitionSpec as P
+
+    specs: dict = {"embed": P(), "final_norm": P(), "layers": []}
+    for _ in range(cfg.num_layers):
+        specs["layers"].append({
+            "attn_norm": P(), "mlp_norm": P(),
+            "attn": tp_attn_specs(cfg, axis),
+            "mlp": tp_mlp_specs(axis),
+        })
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, axis)  # vocab col-parallel
+    return specs
+
+
+def _logits(params: dict, cfg: ModelConfig, x: jax.Array, *, axis: str,
+            n: int) -> jax.Array:
+    """Final norm + vocab-col-parallel lm_head; logits gathered to full
+    vocab (reference dense.py lm_head path)."""
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T  # tied: replicated → full vocab locally
+        return x @ head
+    local = x @ head
+    if n == 1:
+        return local
+    return jax.lax.all_gather(local, axis, axis=1, tiled=True)
+
+
+def dense_prefill(params: dict, cfg: ModelConfig, input_ids: jax.Array,
+                  cache: KVCache, *, axis: str = "tp", num_ranks: int = 1,
+                  mode: str = "overlap"):
+    """Device-local causal prefill.
+
+    input_ids: (B, S) replicated. Activations run row-sharded over B·S in
+    overlap/xla modes ((B·S)/n rows per device), replicated otherwise.
+    Returns (last-token logits (B, vocab), cache filled for [0, S)).
+    """
+    n = num_ranks
+    batch, seq = input_ids.shape
+    x = params["embed"][input_ids.reshape(-1)]  # (B·S, h)
+    row_sharded = n > 1 and mode in ("overlap", "xla")
+    if row_sharded:
+        me = jax.lax.axis_index(axis)
+        rows = (batch * seq) // n
+        x = jax.lax.dynamic_slice_in_dim(x, me * rows, rows, axis=0)
+
+    for i, layer in enumerate(params["layers"]):
+        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+        attn_out, kv = tp_attn_prefill(
+            layer["attn"], cfg, h, batch, seq, cache.layer(i),
+            axis=axis, num_ranks=n, mode=mode)
+        cache = cache.with_layer(i, kv)
+        x = x + attn_out
+        h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+        x = x + tp_mlp_fwd(layer["mlp"], h, axis=axis, num_ranks=n, mode=mode)
+
+    if row_sharded:
+        x = jax.lax.all_gather(x, axis, tiled=True)  # (B·S, h)
+    last = x.reshape(batch, seq, -1)[:, -1]
+    logits = _logits(params, cfg, last, axis=axis, n=n)
+    return logits, cache._replace(offset=jnp.int32(seq))
+
+
+def dense_decode_step(params: dict, cfg: ModelConfig, tokens: jax.Array,
+                      cache: KVCache, *, axis: str = "tp",
+                      num_ranks: int = 1, mode: str = "ar"):
+    """Device-local one-token decode. tokens: (B,) replicated. Returns
+    (logits (B, vocab), cache advanced by one)."""
+    n = num_ranks
+    pos = cache.offset
+    x = params["embed"][tokens]  # (B, h)
+    for i, layer in enumerate(params["layers"]):
+        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+        attn_out, kv = tp_attn_decode(
+            layer["attn"], cfg, h, cache.layer(i), pos,
+            axis=axis, num_ranks=n, mode=mode)
+        cache = cache.with_layer(i, kv)
+        x = x + attn_out
+        h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+        x = x + tp_mlp_fwd(layer["mlp"], h, axis=axis, num_ranks=n,
+                           mode=mode if mode in ("ar", "xla_rep") else "ar")
+    logits = _logits(params, cfg, x, axis=axis, n=n)
+    return logits, cache._replace(offset=pos + 1)

@@ -1,0 +1,152 @@
+"""Inference engine — jitted prefill + decode over a TP mesh.
+
+Reference: ``python/triton_dist/models/engine.py:37-189`` — ``Engine`` loads
+weights, captures the decode step in a CUDA graph (:75-105) and serves with
+graph replay (:166-179). TPU-native: the decode step is one ``jax.jit`` of a
+``shard_map``-wrapped device-local forward — XLA's compiled-executable replay
+IS the graph replay (SURVEY.md §7: CUDA graph → jitted step), with the KV
+cache donated so updates happen in place.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_distributed_tpu.layers.tp_mlp import pick_mode
+from triton_distributed_tpu.models.config import ModelConfig
+from triton_distributed_tpu.models.dense import (
+    dense_llm_specs, dense_prefill, dense_decode_step,
+)
+from triton_distributed_tpu.models.kv_cache import (
+    KVCache, init_kv_cache, kv_cache_specs,
+)
+from triton_distributed_tpu.models import sampling
+from triton_distributed_tpu.runtime.context import DistContext, get_context
+
+
+class Engine:
+    """Serve a dense LLM over the context's TP mesh.
+
+    backend: "overlap" (Pallas AG+GEMM / GEMM+RS prefill + fused-AR decode),
+    "xla" (plain collectives — the golden / fallback path, reference
+    ``torch`` mode), or "auto".
+    """
+
+    def __init__(self, cfg: ModelConfig, params: dict,
+                 ctx: DistContext | None = None, *, axis: str = "tp",
+                 backend: str = "auto", max_seq: int = 256,
+                 prefill_fn: Callable = dense_prefill,
+                 decode_fn: Callable = dense_decode_step):
+        self.cfg = cfg
+        self.ctx = ctx or get_context()
+        self.axis = axis
+        self.n = self.ctx.axis_size(axis)
+        self.backend = backend
+        self.max_seq = max_seq
+        self._prefill_fn = prefill_fn
+        self._decode_fn = decode_fn
+        if cfg.num_kv_heads % self.n:
+            raise ValueError(
+                f"num_kv_heads {cfg.num_kv_heads} not divisible by TP "
+                f"degree {self.n}")
+
+        self.param_specs = dense_llm_specs(cfg, axis)
+        mesh = self.ctx.mesh
+        self.params = jax.device_put(
+            params, jax.tree.map(lambda s: NamedSharding(mesh, s),
+                                 self.param_specs,
+                                 is_leaf=lambda x: isinstance(x, P)))
+        self._jit_cache: dict = {}
+
+    # -- mode resolution ----------------------------------------------------
+    def _prefill_mode(self, batch: int, seq: int) -> str:
+        if self.backend == "xla":
+            return "xla" if (batch * seq) % self.n == 0 else "xla_rep"
+        m = pick_mode("auto", batch * seq, self.n)
+        return m if self.backend == "auto" else (
+            "overlap" if m == "overlap" else "ar")
+
+    def _decode_mode(self) -> str:
+        return "xla_rep" if self.backend == "xla" else "ar"
+
+    # -- jitted steps -------------------------------------------------------
+    def _shard(self, f, in_specs, out_specs):
+        return jax.shard_map(f, mesh=self.ctx.mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+
+    def _prefill_jit(self, batch: int, seq: int):
+        key = ("prefill", batch, seq)
+        if key not in self._jit_cache:
+            mode = self._prefill_mode(batch, seq)
+            cspecs = kv_cache_specs(self.axis)
+
+            def step(params, ids, cache):
+                return self._prefill_fn(
+                    params, self.cfg, ids, cache,
+                    axis=self.axis, num_ranks=self.n, mode=mode)
+
+            fn = self._shard(
+                step,
+                in_specs=(self.param_specs, P(), cspecs),
+                out_specs=(P(), cspecs))
+            self._jit_cache[key] = jax.jit(fn, donate_argnums=(2,))
+        return self._jit_cache[key]
+
+    def _decode_jit(self):
+        key = ("decode",)
+        if key not in self._jit_cache:
+            mode = self._decode_mode()
+            cspecs = kv_cache_specs(self.axis)
+
+            def step(params, tokens, cache):
+                logits, cache = self._decode_fn(
+                    params, self.cfg, tokens, cache,
+                    axis=self.axis, num_ranks=self.n, mode=mode)
+                return sampling.greedy(logits), cache
+
+            fn = self._shard(
+                step,
+                in_specs=(self.param_specs, P(), cspecs),
+                out_specs=(P(), cspecs))
+            self._jit_cache[key] = jax.jit(fn, donate_argnums=(2,))
+        return self._jit_cache[key]
+
+    # -- public API ---------------------------------------------------------
+    def new_cache(self, batch: int) -> KVCache:
+        cache = init_kv_cache(self.cfg, batch, self.max_seq)
+        mesh = self.ctx.mesh
+        return jax.device_put(
+            cache, jax.tree.map(lambda s: NamedSharding(mesh, s),
+                                kv_cache_specs(self.axis),
+                                is_leaf=lambda x: isinstance(x, P)))
+
+    def prefill(self, input_ids: jax.Array, cache: KVCache | None = None):
+        """input_ids: (B, S). Returns (last-token logits (B, vocab), cache)."""
+        batch, seq = input_ids.shape
+        if seq > self.max_seq:
+            raise ValueError(f"prompt {seq} exceeds max_seq {self.max_seq}")
+        cache = cache if cache is not None else self.new_cache(batch)
+        return self._prefill_jit(batch, seq)(self.params, input_ids, cache)
+
+    def decode(self, tokens: jax.Array, cache: KVCache):
+        """tokens: (B,). Returns (next_tokens (B,), cache). Compiled once;
+        subsequent calls replay the executable (the CUDA-graph analog)."""
+        return self._decode_jit()(self.params, tokens, cache)
+
+    def serve(self, input_ids: jax.Array, gen_len: int) -> jax.Array:
+        """Greedy generation (reference Engine.serve, engine.py:113).
+
+        Returns (B, gen_len) generated token ids.
+        """
+        logits, cache = self.prefill(jnp.asarray(input_ids))
+        tok = sampling.greedy(logits)
+        outs = [tok]
+        for _ in range(gen_len - 1):
+            tok, cache = self.decode(tok, cache)
+            outs.append(tok)
+        return jnp.stack(outs, axis=1)

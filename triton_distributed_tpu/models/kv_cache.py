@@ -1,0 +1,55 @@
+"""KV cache — functional, per-device-sharded over KV heads.
+
+Reference: ``python/triton_dist/models/kv_cache.py:29`` (``KV_Cache``: per
+layer (batch, max_seq, kv_heads, head_dim) torch tensors with an offset,
+mutated in place). TPU-native: an immutable pytree threaded through the
+jitted step (XLA turns the dynamic_update_slice chain into in-place updates
+when the cache is donated), sharded over the TP axis on the KV-head dim.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.layers.tp_attn import KVSlice
+from triton_distributed_tpu.models.config import ModelConfig
+
+
+class KVCache(NamedTuple):
+    """k/v: (num_layers, batch, max_seq, num_kv_heads, head_dim) global —
+    shard over the kv-head dim for TP. ``offset``: tokens filled so far."""
+
+    k: jax.Array
+    v: jax.Array
+    offset: jax.Array  # scalar int32
+
+    @property
+    def max_seq(self) -> int:
+        return self.k.shape[2]
+
+    def layer(self, i: int) -> KVSlice:
+        return KVSlice(k=self.k[i], v=self.v[i])
+
+    def with_layer(self, i: int, sl: KVSlice) -> "KVCache":
+        return self._replace(k=self.k.at[i].set(sl.k),
+                             v=self.v.at[i].set(sl.v))
+
+
+def init_kv_cache(cfg: ModelConfig, batch: int, max_seq: int,
+                  dtype=None, num_kv_heads: int | None = None) -> KVCache:
+    """Zeroed cache. Pass ``num_kv_heads`` for an already-local shard."""
+    heads = num_kv_heads if num_kv_heads is not None else cfg.num_kv_heads
+    shape = (cfg.num_layers, batch, max_seq, heads, cfg.head_dim)
+    dt = dtype or jnp.dtype(cfg.dtype)
+    return KVCache(k=jnp.zeros(shape, dt), v=jnp.zeros(shape, dt),
+                   offset=jnp.int32(0))
+
+
+def kv_cache_specs(axis: str = "tp"):
+    from jax.sharding import PartitionSpec as P
+
+    return KVCache(k=P(None, None, None, axis, None),
+                   v=P(None, None, None, axis, None), offset=P())

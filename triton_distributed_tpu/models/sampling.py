@@ -1,0 +1,27 @@
+"""Token sampling.
+
+Reference: ``python/triton_dist/models/utils.py:45,86`` (greedy + temperature
+sampling helpers used by Engine.serve).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def greedy(logits: jax.Array) -> jax.Array:
+    """(B, vocab) → (B,) int32 argmax."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def sample(logits: jax.Array, key: jax.Array, temperature: float = 1.0,
+           top_k: int | None = None) -> jax.Array:
+    """Temperature / top-k sampling. (B, vocab) → (B,) int32."""
+    if temperature <= 0.0:
+        return greedy(logits)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

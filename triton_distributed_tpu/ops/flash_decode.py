@@ -1,0 +1,135 @@
+"""Distributed flash-decode — split-KV GQA decode with inter-rank combine.
+
+Reference: ``python/triton_dist/kernels/nvidia/flash_decode.py`` — local
+split-kv kernels (:129), intra-rank combine, and the inter-rank LSE/acc
+combine (:482 ``kernel_inter_rank_gqa_fwd_batch_decode_combine_kv``) that
+pulls per-rank partial (lse, acc) via low-latency AG; persistent variant
+(:1095). This is the SP/CP decode path: the KV cache is sharded over ranks
+along the *sequence* axis, every rank attends its shard, and the partials
+merge with log-sum-exp rescaling.
+
+TPU design: the partial attention runs as dense jnp (XLA's fused attention
+on the MXU — batch×heads×shard shapes tile well); the tiny per-rank
+(acc, lse) partials — (B, hq, d+1) floats — ride either the Pallas one-shot
+AllGather (``method="pallas"``, the low-latency AG use case) or
+``jax.lax.all_gather`` (``method="xla"``, golden), then combine in fp32.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.ops.allgather import all_gather_local, AllGatherMethod
+from triton_distributed_tpu.runtime.context import DistContext, get_context
+from triton_distributed_tpu.runtime.jit_cache import cached_shard_jit
+
+
+def _partial_decode_attn(q, k, v, kv_len):
+    """Partial GQA attention over one KV shard.
+
+    q: (B, hq, d); k/v: (B, S_shard, hkv, d); kv_len: valid rows (traced).
+    Returns acc (B, hq, d) fp32 = Σ softmax-numerator · v (UNnormalized,
+    max-subtracted), lse-parts (m, l): running max (B, hq) and sum-exp (B, hq).
+    """
+    b, hq, d = q.shape
+    s, hkv = k.shape[1], k.shape[2]
+    group = hq // hkv
+    qf = q.astype(jnp.float32).reshape(b, hkv, group, d)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    logits = jnp.einsum("bhgd,bshd->bhgs", qf, kf) / math.sqrt(d)
+    valid = jnp.arange(s) < kv_len
+    logits = jnp.where(valid[None, None, None], logits, -jnp.inf)
+    m = jnp.max(logits, axis=-1)                      # (b, hkv, g)
+    # All-invalid shard: keep math finite; l=0 marks it dead in the combine.
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(logits - m_safe[..., None])
+    p = jnp.where(valid[None, None, None], p, 0.0)
+    l = jnp.sum(p, axis=-1)                            # (b, hkv, g)
+    acc = jnp.einsum("bhgs,bshd->bhgd", p, vf)         # (b, hkv, g, d)
+    return (acc.reshape(b, hq, d), m_safe.reshape(b, hq),
+            l.reshape(b, hq))
+
+
+def combine_partials(accs, ms, ls):
+    """Merge split-KV partials over axis 0 (the reference's combine kernel,
+    flash_decode.py:482-566): online log-sum-exp across splits.
+
+    accs: (n, B, hq, d); ms/ls: (n, B, hq). Returns (B, hq, d) fp32.
+    """
+    m_all = jnp.max(jnp.where(ls > 0, ms, -jnp.inf), axis=0)   # (B, hq)
+    m_all = jnp.where(jnp.isfinite(m_all), m_all, 0.0)
+    scale = jnp.exp(ms - m_all[None]) * (ls > 0)               # (n, B, hq)
+    l_tot = jnp.sum(ls * scale, axis=0)                        # (B, hq)
+    acc = jnp.sum(accs * scale[..., None], axis=0)             # (B, hq, d)
+    return acc / jnp.maximum(l_tot, 1e-30)[..., None]
+
+
+def flash_decode_local(q: jax.Array, k_shard: jax.Array, v_shard: jax.Array,
+                       kv_len: jax.Array, *, axis: str = "tp",
+                       num_ranks: int | None = None,
+                       method: str = "pallas") -> jax.Array:
+    """Device-local distributed flash-decode inside shard_map.
+
+    q: (B, hq, d) replicated; k_shard/v_shard: (B, S/n, hkv, d) — this
+    rank's sequence shard; kv_len: valid rows in THIS shard (int32 scalar,
+    may differ per rank). Returns (B, hq, d) fully-combined attention,
+    replicated.
+    """
+    if num_ranks is None:
+        raise ValueError("num_ranks required inside shard_map")
+    n = num_ranks
+    b, hq, d = q.shape
+    acc, m, l = _partial_decode_attn(q, k_shard, v_shard, kv_len)
+    if n == 1:
+        return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+    # Pack partials into one flat fp32 payload: (B·hq, d+2) → AG → combine.
+    payload = jnp.concatenate(
+        [acc.reshape(b * hq, d), m.reshape(b * hq, 1), l.reshape(b * hq, 1)],
+        axis=1)
+    if method == "pallas":
+        gathered = all_gather_local(payload, axis=axis, num_ranks=n,
+                                    method=AllGatherMethod.FULL_MESH_PUSH)
+        gathered = gathered.reshape(n, b * hq, d + 2)
+    elif method == "xla":
+        gathered = jax.lax.all_gather(payload, axis)
+    else:
+        raise ValueError(f"unknown method {method!r}")
+    accs = gathered[..., :d].reshape(n, b, hq, d)
+    ms = gathered[..., d].reshape(n, b, hq)
+    ls = gathered[..., d + 1].reshape(n, b, hq)
+    return combine_partials(accs, ms, ls).astype(q.dtype)
+
+
+def flash_decode(q: jax.Array, k: jax.Array, v: jax.Array,
+                 kv_lens: jax.Array, ctx: DistContext | None = None,
+                 axis: str = "tp", method: str = "pallas") -> jax.Array:
+    """Host-level distributed flash-decode.
+
+    q: (B, hq, d) replicated; k/v: (B, n·S_shard, hkv, d) sequence-sharded
+    over ``axis``; kv_lens: (n,) int32 valid rows per shard.
+    Returns (B, hq, d) replicated.
+    """
+    ctx = ctx or get_context()
+    n = ctx.axis_size(axis)
+    key = (axis, method, q.shape, k.shape, str(q.dtype))
+
+    def make():
+        fn = functools.partial(flash_decode_local, axis=axis, num_ranks=n,
+                               method=method)
+
+        def wrapped(ql, kl, vl, lens):
+            return fn(ql, kl, vl, lens.reshape(()))
+
+        return wrapped
+
+    jfn = cached_shard_jit(
+        ctx, "flash_decode", key, make,
+        (P(), P(None, axis), P(None, axis), P(axis)), P())
+    return jfn(q, k, v, kv_lens)
