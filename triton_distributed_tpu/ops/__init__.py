@@ -31,3 +31,23 @@ from triton_distributed_tpu.ops.gemm_allreduce import (  # noqa: F401
     gemm_ar_local,
 )
 from triton_distributed_tpu.ops.p2p import p2p_shift, p2p_shift_local  # noqa: F401
+from triton_distributed_tpu.ops.all_to_all import (  # noqa: F401
+    fast_all_to_all,
+    fast_all_to_all_local,
+    dispatch_layout,
+    combine_layout,
+)
+from triton_distributed_tpu.ops.ring_attention import (  # noqa: F401
+    ring_attention,
+    ring_attention_local,
+)
+from triton_distributed_tpu.ops.sp_ag_attention import (  # noqa: F401
+    sp_ag_attention,
+    sp_ag_attention_local,
+)
+from triton_distributed_tpu.ops.flash_decode import (  # noqa: F401
+    flash_decode,
+    flash_decode_local,
+    combine_partials,
+)
+from triton_distributed_tpu.ops.gemm import pallas_matmul  # noqa: F401

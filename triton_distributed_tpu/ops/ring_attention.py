@@ -1,0 +1,131 @@
+"""Ring attention — sequence-parallel causal prefill over the ICI ring.
+
+Reference: the SP AllGather-attention family
+(``sp_ag_attention_intra_node.py:105`` producer, ``:256`` consumer FA,
+``:432`` op) provides long-context prefill by overlapping KV gathering with
+blockwise flash attention. SURVEY.md §2.5 notes the reference has *no*
+softmax-rescaling ring pipeline — on TPU the ring IS the natural shape: KV
+shards rotate around the ICI ring via ``ppermute`` while every device
+accumulates blockwise attention with online log-sum-exp rescaling, so each
+hop's communication overlaps the previous hop's attention compute (XLA
+schedules collective-permute DMA concurrently with the einsums — the
+copy-engine/consumer split of the reference, expressed at the XLA level).
+
+Causality with sequence sharding: query block q_r attends KV block k_s iff
+s <= r (block-causal), with the diagonal block masked triangularly — the
+standard ring-attention schedule.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.runtime.context import DistContext, get_context
+from triton_distributed_tpu.runtime.jit_cache import cached_shard_jit
+
+
+def _block_attn(q, k, v, mask):
+    """Unnormalized blockwise attention with running-max stats.
+
+    q: (B, Sq, hq, d); k/v: (B, Sk, hkv, d); mask: (Sq, Sk) bool or None.
+    Returns (acc (B,Sq,hq,d) fp32, m (B,Sq,hq), l (B,Sq,hq)).
+    """
+    b, sq, hq, d = q.shape
+    sk, hkv = k.shape[1], k.shape[2]
+    g = hq // hkv
+    qf = q.astype(jnp.float32).reshape(b, sq, hkv, g, d)
+    logits = jnp.einsum("bqhgd,bkhd->bqhgk", qf,
+                        k.astype(jnp.float32)) / math.sqrt(d)
+    if mask is not None:
+        logits = jnp.where(mask[None, :, None, None, :], logits, -jnp.inf)
+    m = jnp.max(logits, axis=-1)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(logits - m_safe[..., None])
+    if mask is not None:
+        p = jnp.where(mask[None, :, None, None, :], p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bqhgk,bkhd->bqhgd", p, v.astype(jnp.float32))
+    return (acc.reshape(b, sq, hq, d), m_safe.reshape(b, sq, hq),
+            l.reshape(b, sq, hq))
+
+
+def _merge(state, update):
+    """Online LSE merge of two (acc, m, l) blockwise-attention partials."""
+    acc0, m0, l0 = state
+    acc1, m1, l1 = update
+    dead0, dead1 = l0 <= 0, l1 <= 0
+    m_new = jnp.where(dead0, m1, jnp.where(dead1, m0, jnp.maximum(m0, m1)))
+    s0 = jnp.where(dead0, 0.0, jnp.exp(m0 - m_new))
+    s1 = jnp.where(dead1, 0.0, jnp.exp(m1 - m_new))
+    return (acc0 * s0[..., None] + acc1 * s1[..., None],
+            m_new, l0 * s0 + l1 * s1)
+
+
+def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                         axis: str = "sp", num_ranks: int | None = None,
+                         causal: bool = True) -> jax.Array:
+    """Device-local ring attention inside shard_map.
+
+    q/k/v: (B, S/n, h*, d) — this rank's sequence shard (rank r owns
+    positions [r·S/n, (r+1)·S/n)). Returns (B, S/n, hq, d): attention output
+    for the local queries over the FULL sequence.
+    """
+    if num_ranks is None:
+        raise ValueError("num_ranks required inside shard_map")
+    n = num_ranks
+    me = jax.lax.axis_index(axis)
+    b, sq, hq, d = q.shape
+    sk = k.shape[1]
+
+    diag_mask = (jnp.tril(jnp.ones((sq, sk), bool))
+                 if causal and sq == sk else None)
+
+    # Step 0: my own diagonal block.
+    state = _block_attn(q, k, v, diag_mask)
+
+    if n > 1:
+        perm = [(i, (i + 1) % n) for i in range(n)]  # shift right
+
+        def body(i, carry):
+            state, kc, vc = carry
+            # Rotate: after i+1 hops I hold the shard of rank me-(i+1).
+            kc = jax.lax.ppermute(kc, axis, perm)
+            vc = jax.lax.ppermute(vc, axis, perm)
+            src = jax.lax.rem(me - (i + 1) + n, n)
+            acc, m, l = _block_attn(q, kc, vc, None)
+            if causal:
+                # Block-causal: only attend shards strictly before mine.
+                keep = (src < me).astype(jnp.float32)
+                update = (acc * keep, m, l * keep)
+            else:
+                update = (acc, m, l)
+            return _merge(state, update), kc, vc
+
+        (state, _, _) = jax.lax.fori_loop(0, n - 1, body, (state, k, v))
+
+    acc, m, l = state
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   ctx: DistContext | None = None, axis: str = "tp",
+                   causal: bool = True) -> jax.Array:
+    """Host-level ring attention. q/k/v: (B, S, h*, d) sequence-sharded over
+    ``axis`` (dim 1). Returns (B, S, hq, d) sequence-sharded."""
+    ctx = ctx or get_context()
+    n = ctx.axis_size(axis)
+    key = (axis, causal, q.shape, k.shape, str(q.dtype))
+
+    def make():
+        return functools.partial(ring_attention_local, axis=axis,
+                                 num_ranks=n, causal=causal)
+
+    jfn = cached_shard_jit(ctx, "ring_attention", key, make,
+                          (P(None, axis), P(None, axis), P(None, axis)),
+                          P(None, axis))
+    return jfn(q, k, v)

@@ -1,0 +1,98 @@
+"""SP AllGather-attention — KV-gather prefill (reference-shaped variant).
+
+Reference: ``sp_ag_attention_intra_node.py`` — producer CE all-gathers KV
+shards into symmetric buffers (:105) while a consumer flash-attention waits
+per-KV-chunk (:256); op at :432 (inter-node twin in
+``sp_ag_attention_inter_node.py``).
+
+TPU mapping: the KV shards ride the Pallas full-mesh-push AllGather (remote
+DMA over ICI), then the consumer computes *blockwise* attention per KV chunk
+with the same online-LSE merge as ring attention — chunk r's compute starts
+as soon as the math allows, and XLA overlaps the Pallas AG kernel with the
+first (local-chunk) einsum since there is no data dependence between them.
+For a fully in-kernel waited consumer, see ops/ring_attention.py — on TPU
+the rotating-shard schedule expresses the same overlap with less machinery
+and is the preferred long-context path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.ops.allgather import all_gather_local, AllGatherMethod
+from triton_distributed_tpu.ops.ring_attention import _block_attn, _merge
+from triton_distributed_tpu.runtime.context import DistContext, get_context
+from triton_distributed_tpu.runtime.jit_cache import cached_shard_jit
+
+
+def sp_ag_attention_local(q: jax.Array, k_shard: jax.Array,
+                          v_shard: jax.Array, *, axis: str = "sp",
+                          num_ranks: int | None = None,
+                          causal: bool = True,
+                          method: AllGatherMethod | str = AllGatherMethod.AUTO
+                          ) -> jax.Array:
+    """Device-local SP AG attention inside shard_map.
+
+    q/k_shard/v_shard: (B, S/n, h*, d) sequence shards. Returns
+    (B, S/n, hq, d) — local queries attended over the full (causal) sequence.
+    """
+    if num_ranks is None:
+        raise ValueError("num_ranks required inside shard_map")
+    n = num_ranks
+    me = jax.lax.axis_index(axis)
+    b, sq, hq, d = q.shape
+    sk, hkv = k_shard.shape[1], k_shard.shape[2]
+
+    if n == 1:
+        mask = jnp.tril(jnp.ones((sq, sk), bool)) if causal else None
+        acc, m, l = _block_attn(q, k_shard, v_shard, mask)
+        return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+    # Producer: Pallas AG of the KV shards (flattened to 2-D rows).
+    flat = jnp.concatenate(
+        [k_shard.reshape(b * sk, hkv * d), v_shard.reshape(b * sk, hkv * d)],
+        axis=1)
+    gathered = all_gather_local(flat, axis=axis, num_ranks=n, method=method)
+    gathered = gathered.reshape(n, b, sk, 2, hkv, d)
+    ks = gathered[:, :, :, 0]  # (n, B, sk, hkv, d)
+    vs = gathered[:, :, :, 1]
+
+    # Consumer: blockwise attention per KV chunk + online-LSE merge
+    # (reference kernel_consumer_flash_attn_forward :256).
+    diag_mask = jnp.tril(jnp.ones((sq, sk), bool)) if causal else None
+    state = _block_attn(q, k_shard, v_shard, diag_mask)
+
+    def body(r, state):
+        acc, m, l = _block_attn(q, ks[r], vs[r], None)
+        if causal:
+            keep = (r < me).astype(jnp.float32)
+        else:
+            keep = (r != me).astype(jnp.float32)
+        return _merge(state, (acc * keep, m, l * keep))
+
+    state = jax.lax.fori_loop(0, n, body, state)
+    acc, m, l = state
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def sp_ag_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    ctx: DistContext | None = None, axis: str = "tp",
+                    causal: bool = True) -> jax.Array:
+    """Host-level SP AG attention (reference ``fused_sp_ag_attn_intra_node``,
+    sp_ag_attention_intra_node.py:432). q/k/v: (B, S, h*, d) sharded on dim 1."""
+    ctx = ctx or get_context()
+    n = ctx.axis_size(axis)
+    key = (axis, causal, q.shape, k.shape, str(q.dtype))
+
+    def make():
+        return functools.partial(sp_ag_attention_local, axis=axis,
+                                 num_ranks=n, causal=causal)
+
+    jfn = cached_shard_jit(ctx, "sp_ag_attention", key, make,
+                          (P(None, axis), P(None, axis), P(None, axis)),
+                          P(None, axis))
+    return jfn(q, k, v)
