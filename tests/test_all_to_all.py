@@ -76,9 +76,10 @@ def test_dispatch_combine_round_trip(ctx):
     eids = rng.integers(0, num_experts, size=(n, m)).astype(np.int32)
 
     # Per-device layouts (host-side XLA, no mesh needed).
-    sbufs, ssplits, _ = jax.vmap(
+    layout = jax.vmap(
         lambda t, e: dispatch_layout(t, e, num_experts, n, cap))(
             jnp.asarray(tokens), jnp.asarray(eids))
+    sbufs, ssplits = layout.send_buf, layout.send_splits
 
     recv, rsplits = fast_all_to_all(sbufs, ssplits, ctx)
 

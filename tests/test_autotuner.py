@@ -1,0 +1,73 @@
+"""Contextual autotuner tests (reference autotuner.py:43-105 behavior)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_distributed_tpu.runtime.autotuner import (
+    contextual_autotune,
+    gemm_tile_candidates,
+    tune_ag_gemm,
+)
+
+
+def test_autotune_picks_fastest_and_caches(tmp_path, monkeypatch):
+    monkeypatch.setenv("TDTPU_AUTOTUNE_CACHE", str(tmp_path / "cache.json"))
+    import time
+
+    calls = []
+
+    def build(cfg):
+        def fn(x):
+            calls.append(cfg)
+            time.sleep(0.002 * cfg)  # cfg == sleep multiplier
+            return x
+        return fn
+
+    best, report = contextual_autotune(
+        "sleepy", "k1", [3, 1, 2], build, (jnp.zeros((4,)),), iters=2)
+    assert best == 1
+    assert report.best_index == 1
+    assert all(t is not None for t in report.timings)
+
+    # Cache hit: no new measurements.
+    before = len(calls)
+    best2, report2 = contextual_autotune(
+        "sleepy", "k1", [3, 1, 2], build, (jnp.zeros((4,)),), iters=2)
+    assert best2 == 1 and report2 is None and len(calls) == before
+
+
+def test_autotune_prunes_failing_candidates(tmp_path, monkeypatch):
+    monkeypatch.setenv("TDTPU_AUTOTUNE_CACHE", str(tmp_path / "c.json"))
+
+    def build(cfg):
+        if cfg == "bad":
+            raise RuntimeError("does not compile")
+        return lambda x: x
+
+    best, report = contextual_autotune(
+        "pruney", "k", ["bad", "good"], build, (jnp.zeros((2,)),))
+    assert best == "good"
+    assert report.timings[0] is None
+
+    with pytest.raises(RuntimeError, match="every candidate failed"):
+        contextual_autotune("pruney", "k2", ["bad"], build,
+                            (jnp.zeros((2,)),))
+
+
+def test_gemm_tile_candidates_fit():
+    cands = gemm_tile_candidates(256, 512, 1024, itemsize=4)
+    assert cands
+    for tm, tn, tk in cands:
+        assert tm <= 256 and tn <= 1024 and tk <= 512
+
+
+def test_tune_ag_gemm_end_to_end(ctx, tmp_path, monkeypatch):
+    """Tunes the real distributed op on the CPU mesh (tiny space)."""
+    monkeypatch.setenv("TDTPU_AUTOTUNE_CACHE", str(tmp_path / "c.json"))
+    n, m, k, cols = 8, 16, 128, 128
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.standard_normal((n * m, k)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((k, n * cols)), jnp.float32)
+    cfg = tune_ag_gemm(a, b, ctx)
+    assert cfg.tile_m <= m and cfg.tile_k <= k

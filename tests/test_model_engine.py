@@ -83,6 +83,32 @@ def test_engine_prefill_matches_reference(ctx, backend, cfg):
     assert int(cache.offset) == seq
 
 
+MOE_CFG = tiny_config(num_experts=16, num_experts_per_tok=2,
+                      moe_intermediate_size=64)
+
+
+@pytest.mark.parametrize("backend", ["xla", "overlap"])
+def test_moe_engine_e2e(ctx, backend):
+    """Qwen3-MoE-style model end-to-end: prefill + decode vs single-device
+    reference (reference test_ep_moe_inference pattern)."""
+    batch, seq, gen = 2, 16, 3
+    params = init_dense_llm(jax.random.key(7), MOE_CFG)
+    ids = jax.random.randint(jax.random.key(8), (batch, seq), 0,
+                             MOE_CFG.vocab_size)
+
+    eng = Engine(MOE_CFG, params, ctx, backend=backend, max_seq=64)
+    toks = eng.serve(ids, gen)
+
+    cur = np.asarray(ids)
+    for step in range(gen):
+        logits, _ = _ref_forward_logits(params, MOE_CFG, jnp.asarray(cur))
+        nxt = np.asarray(jnp.argmax(logits, axis=-1).astype(jnp.int32))
+        np.testing.assert_array_equal(
+            np.asarray(toks)[:, step], nxt,
+            err_msg=f"moe backend={backend} divergence at step {step}")
+        cur = np.concatenate([cur, nxt[:, None]], axis=1)
+
+
 @pytest.mark.parametrize("backend", ["xla", "overlap"])
 def test_engine_decode_matches_prefill(ctx, backend):
     """Tokens decoded step-by-step must equal re-running prefill over the

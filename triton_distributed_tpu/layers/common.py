@@ -46,3 +46,10 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
 
 def swiglu(gate: jax.Array, up: jax.Array) -> jax.Array:
     return jax.nn.silu(gate) * up
+
+
+class KVSlice(__import__("typing").NamedTuple):
+    """One layer's local KV cache slice: (batch, max_seq, kvh/n, head_dim)."""
+
+    k: "jax.Array"
+    v: "jax.Array"

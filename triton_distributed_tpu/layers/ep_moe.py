@@ -1,0 +1,135 @@
+"""Expert-parallel MoE layer — AllToAll dispatch → local experts → combine.
+
+Reference: ``python/triton_dist/layers/nvidia/ep_a2a_layer.py`` (the
+``fast_all_to_all`` dispatch → grouped expert MLP → combine path) and
+``tp_moe.py`` for the router conventions; kernels ``low_latency_all_to_all``
++ ``ep_a2a``.
+
+EP sharding: each rank owns ``num_experts/n`` experts with FULL ffn width
+(contrast TP-MoE in ops/moe.py where every rank owns a ffn slice of every
+expert). Tokens travel to their experts' ranks over the Pallas AllToAll and
+come back the same way; the return trip reuses the forward slot layout so
+no second sort is needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.ops.all_to_all import (
+    combine_layout,
+    dispatch_layout,
+    fast_all_to_all_local,
+)
+
+
+def init_ep_moe(rng: jax.Array, hidden: int, ffn: int, num_experts: int,
+                dtype) -> dict:
+    kr, kg, ku, kd = jax.random.split(rng, 4)
+    return {
+        "router": jax.random.normal(kr, (hidden, num_experts), dtype)
+        * hidden ** -0.5,
+        "w_gate": jax.random.normal(kg, (num_experts, hidden, ffn), dtype)
+        * hidden ** -0.5,
+        "w_up": jax.random.normal(ku, (num_experts, hidden, ffn), dtype)
+        * hidden ** -0.5,
+        "w_down": jax.random.normal(kd, (num_experts, ffn, hidden), dtype)
+        * ffn ** -0.5,
+    }
+
+
+def ep_moe_specs(axis: str = "tp") -> dict:
+    from jax.sharding import PartitionSpec as P
+
+    # Experts sharded over dim 0; router replicated.
+    return {"router": P(), "w_gate": P(axis), "w_up": P(axis),
+            "w_down": P(axis)}
+
+
+def router_topk(x: jax.Array, router_w: jax.Array, topk: int):
+    """fp32 router: returns (topk_ids (m, k) int32, weights (m, k))
+    softmaxed over the selected experts (Qwen-MoE convention,
+    reference models/qwen_moe.py)."""
+    logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    top_logits, top_ids = jax.lax.top_k(logits, topk)
+    weights = jax.nn.softmax(top_logits, axis=-1)
+    return top_ids.astype(jnp.int32), weights
+
+
+def ep_moe_fwd(params: dict, x: jax.Array, topk: int, *, axis: str = "tp",
+               num_ranks: int = 1, capacity: int | None = None) -> jax.Array:
+    """Device-local EP-MoE forward inside shard_map.
+
+    x: (m, h) this rank's tokens (data-parallel over ranks); params["w_*"]
+    hold the LOCAL expert shard (E/n, ...) inside shard_map. Returns (m, h).
+
+    capacity: per-destination-rank slot size (static); defaults to the
+    lossless m·topk rounded up to the DMA block.
+    """
+    n = num_ranks
+    m, h = x.shape
+    local_E = params["w_gate"].shape[0]
+    E = local_E * n
+    epr = local_E
+
+    top_ids, weights = router_topk(x, params["router"], topk)
+    weights = weights.astype(x.dtype)
+
+    if n == 1:
+        from triton_distributed_tpu.ops.moe import sort_by_expert
+
+        flat_ids = top_ids.reshape(-1)
+        sort_idx, gs = sort_by_expert(flat_ids, E)
+        xs = jnp.repeat(x, topk, axis=0)[sort_idx]
+        y = _expert_mlp(xs, gs, params)
+        y = y * weights.reshape(-1)[sort_idx][:, None]
+        inv = jnp.argsort(sort_idx)
+        return y[inv].reshape(m, topk, h).sum(axis=1).astype(x.dtype)
+
+    block = 16
+    cap = capacity or -(-(m * topk) // block) * block
+
+    # 1. dispatch: route token copies to their experts' ranks.
+    flat_tokens = jnp.repeat(x, topk, axis=0)          # (m·topk, h)
+    flat_ids = top_ids.reshape(-1)
+    lay = dispatch_layout(flat_tokens, flat_ids, E, n, cap)
+    recv_buf, recv_splits = fast_all_to_all_local(
+        lay.send_buf, lay.send_splits, axis=axis, num_ranks=n)
+
+    # 2. local expert MLP over the received rows, grouped by local expert
+    # (+1 padding group with zero weights so shapes stay static).
+    flat, local_eid, group_sizes = combine_layout(recv_buf, recv_splits)
+    order = jnp.argsort(local_eid, stable=True)
+    t_total = flat.shape[0]
+    gs_ext = jnp.concatenate(
+        [group_sizes, (t_total - group_sizes.sum())[None]]).astype(jnp.int32)
+    y_sorted = _expert_mlp(flat[order], gs_ext, params, pad_group=True)
+    y_slots = jnp.zeros_like(flat).at[order].set(y_sorted)
+    y_slots = y_slots.reshape(n, cap, h)
+
+    # 3. combine: same slot layout in reverse (recv_splits describe exactly
+    # what each source rank sent, so they are the return-trip send_splits).
+    back_buf, _ = fast_all_to_all_local(
+        y_slots, recv_splits, axis=axis, num_ranks=n)
+
+    # 4. un-permute: sorted token i went to (sorted_rank, pos_in_slot) and
+    # its result came back at the same coordinates.
+    y_flat_sorted = back_buf[lay.sorted_rank, lay.pos_in_slot]  # (m·topk, h)
+    w_sorted = weights.reshape(-1)[lay.sort_idx]
+    y_flat_sorted = y_flat_sorted * w_sorted[:, None]
+    inv = jnp.argsort(lay.sort_idx)
+    y_flat = y_flat_sorted[inv]                                  # (m·topk, h)
+    return y_flat.reshape(m, topk, h).sum(axis=1).astype(x.dtype)
+
+
+def _expert_mlp(x_sorted, group_sizes, params, pad_group: bool = False):
+    wg, wu, wd = params["w_gate"], params["w_up"], params["w_down"]
+    if pad_group:
+        wg = jnp.concatenate([wg, jnp.zeros_like(wg[:1])])
+        wu = jnp.concatenate([wu, jnp.zeros_like(wu[:1])])
+        wd = jnp.concatenate([wd, jnp.zeros_like(wd[:1])])
+    gate = jax.lax.ragged_dot(x_sorted, wg, group_sizes)
+    up = jax.lax.ragged_dot(x_sorted, wu, group_sizes)
+    act = (jax.nn.silu(gate) * up).astype(x_sorted.dtype)
+    return jax.lax.ragged_dot(act, wd, group_sizes).astype(x_sorted.dtype)

@@ -22,13 +22,17 @@ All functions are device-local: call inside ``shard_map`` over ``axis``.
 from __future__ import annotations
 
 import math
-from typing import NamedTuple
+from typing import TYPE_CHECKING
 
 import jax
 import jax.numpy as jnp
 
-from triton_distributed_tpu.layers.common import apply_rope, rms_norm, rope_cos_sin
-from triton_distributed_tpu.models.config import ModelConfig
+from triton_distributed_tpu.layers.common import (
+    KVSlice, apply_rope, rms_norm, rope_cos_sin,
+)
+
+if TYPE_CHECKING:  # annotation-only: models imports layers, not vice versa
+    from triton_distributed_tpu.models.config import ModelConfig
 from triton_distributed_tpu.ops.allgather_gemm import ag_gemm_local
 from triton_distributed_tpu.ops.gemm_reduce_scatter import gemm_rs_local
 from triton_distributed_tpu.ops.allreduce import all_reduce_local
@@ -59,13 +63,6 @@ def tp_attn_specs(cfg: ModelConfig, axis: str = "tp") -> dict:
         specs["q_norm"] = P()
         specs["k_norm"] = P()
     return specs
-
-
-class KVSlice(NamedTuple):
-    """One layer's local KV cache slice: (batch, max_seq, kvh/n, head_dim)."""
-
-    k: jax.Array
-    v: jax.Array
 
 
 def _project_qkv(params, cfg: ModelConfig, x, batch, seq, *, axis, n, mode):

@@ -39,13 +39,23 @@ def init_dense_llm(rng: jax.Array, cfg: ModelConfig) -> dict:
         "layers": [],
     }
     for i in range(cfg.num_layers):
-        params["layers"].append({
+        layer = {
             "attn_norm": jnp.ones((cfg.hidden_size,), dt),
             "mlp_norm": jnp.ones((cfg.hidden_size,), dt),
             "attn": init_tp_attn(keys[1 + 2 * i], cfg, dt),
-            "mlp": init_tp_mlp(keys[2 + 2 * i], cfg.hidden_size,
-                               cfg.intermediate_size, dt),
-        })
+        }
+        if cfg.is_moe:
+            # Qwen3-MoE block (reference models/qwen_moe.py:50-206):
+            # router + per-expert SwiGLU, TP-sharded on the expert ffn dim.
+            from triton_distributed_tpu.layers.ep_moe import init_ep_moe
+
+            layer["moe"] = init_ep_moe(
+                keys[2 + 2 * i], cfg.hidden_size, cfg.moe_intermediate_size,
+                cfg.num_experts, dt)
+        else:
+            layer["mlp"] = init_tp_mlp(keys[2 + 2 * i], cfg.hidden_size,
+                                       cfg.intermediate_size, dt)
+        params["layers"].append(layer)
     if not cfg.tie_word_embeddings:
         params["lm_head"] = jax.random.normal(
             keys[-1], (cfg.hidden_size, cfg.vocab_size), dt) * 0.02
@@ -58,11 +68,18 @@ def dense_llm_specs(cfg: ModelConfig, axis: str = "tp") -> dict:
 
     specs: dict = {"embed": P(), "final_norm": P(), "layers": []}
     for _ in range(cfg.num_layers):
-        specs["layers"].append({
+        layer = {
             "attn_norm": P(), "mlp_norm": P(),
             "attn": tp_attn_specs(cfg, axis),
-            "mlp": tp_mlp_specs(axis),
-        })
+        }
+        if cfg.is_moe:
+            # TP-MoE: experts' ffn dim sharded, router replicated.
+            layer["moe"] = {"router": P(), "w_gate": P(None, None, axis),
+                            "w_up": P(None, None, axis),
+                            "w_down": P(None, axis, None)}
+        else:
+            layer["mlp"] = tp_mlp_specs(axis)
+        specs["layers"].append(layer)
     if not cfg.tie_word_embeddings:
         specs["lm_head"] = P(None, axis)  # vocab col-parallel
     return specs
@@ -81,6 +98,20 @@ def _logits(params: dict, cfg: ModelConfig, x: jax.Array, *, axis: str,
     if n == 1:
         return local
     return jax.lax.all_gather(local, axis, axis=1, tiled=True)
+
+
+def _mlp_or_moe(layer: dict, cfg: ModelConfig, h: jax.Array, *, axis: str,
+                n: int, mode: str) -> jax.Array:
+    """FFN block dispatch: dense SwiGLU TP-MLP or TP-MoE (Qwen3-MoE)."""
+    if "moe" in layer:
+        from triton_distributed_tpu.ops.moe import moe_tp_fwd_local
+
+        p = layer["moe"]
+        return moe_tp_fwd_local(
+            h, p["router"], p["w_gate"], p["w_up"], p["w_down"],
+            cfg.num_experts_per_tok, axis=axis, num_ranks=n,
+            mode=mode if n > 1 else "overlap")
+    return tp_mlp_fwd(layer["mlp"], h, axis=axis, num_ranks=n, mode=mode)
 
 
 def dense_prefill(params: dict, cfg: ModelConfig, input_ids: jax.Array,
@@ -109,7 +140,7 @@ def dense_prefill(params: dict, cfg: ModelConfig, input_ids: jax.Array,
         cache = cache.with_layer(i, kv)
         x = x + attn_out
         h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
-        x = x + tp_mlp_fwd(layer["mlp"], h, axis=axis, num_ranks=n, mode=mode)
+        x = x + _mlp_or_moe(layer, cfg, h, axis=axis, n=n, mode=mode)
 
     if row_sharded:
         x = jax.lax.all_gather(x, axis, tiled=True)  # (B·S, h)
@@ -134,7 +165,8 @@ def dense_decode_step(params: dict, cfg: ModelConfig, tokens: jax.Array,
         cache = cache.with_layer(i, kv)
         x = x + attn_out
         h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
-        x = x + tp_mlp_fwd(layer["mlp"], h, axis=axis, num_ranks=n,
-                           mode=mode if mode in ("ar", "xla_rep") else "ar")
+        x = x + _mlp_or_moe(
+            layer, cfg, h, axis=axis, n=n,
+            mode=mode if mode in ("ar", "xla_rep") else "ar")
     logits = _logits(params, cfg, x, axis=axis, n=n)
     return logits, cache._replace(offset=pos + 1)

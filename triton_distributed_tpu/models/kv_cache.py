@@ -14,7 +14,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from triton_distributed_tpu.layers.tp_attn import KVSlice
+from triton_distributed_tpu.layers.common import KVSlice
 from triton_distributed_tpu.models.config import ModelConfig
 
 

@@ -36,6 +36,7 @@ reference reuses ``fast_all_to_all`` for both as well).
 from __future__ import annotations
 
 import functools
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -213,15 +214,23 @@ def fast_all_to_all(send_buf: jax.Array, send_splits: jax.Array,
 # ---------------------------------------------------------------------------
 
 
+class DispatchLayout(NamedTuple):
+    """AllToAll send layout + the coordinates to invert it after combine."""
+
+    send_buf: jax.Array      # (n, cap, hidden)
+    send_splits: jax.Array   # (n, epr) int32
+    sort_idx: jax.Array      # (m,) — expert-stable sort permutation
+    sorted_rank: jax.Array   # (m,) — dest rank of sorted token i
+    pos_in_slot: jax.Array   # (m,) — its row within that rank's slot
+
+
 def dispatch_layout(tokens: jax.Array, expert_ids: jax.Array,
-                    num_experts: int, num_ranks: int, cap: int):
+                    num_experts: int, num_ranks: int, cap: int
+                    ) -> DispatchLayout:
     """Build the AllToAll send layout from flat tokens + expert assignment.
 
     tokens: (m, hidden); expert_ids: (m,) int32 global expert per token
-    (replicate tokens beforehand for topk>1). Returns
-    (send_buf (n, cap, hidden), send_splits (n, epr) int32,
-    sort_idx (m,) — the permutation used, needed to un-permute after
-    combine).
+    (replicate tokens beforehand for topk>1).
 
     Tokens for the same destination rank are packed contiguously (sorted by
     expert) at the head of that rank's slot. Tokens beyond ``cap`` per rank
@@ -254,7 +263,8 @@ def dispatch_layout(tokens: jax.Array, expert_ids: jax.Array,
     expert_counts = jax.ops.segment_sum(ones, expert_ids,
                                         num_segments=num_experts)
     send_splits = expert_counts.reshape(num_ranks, epr)
-    return send_buf, send_splits, sort_idx
+    return DispatchLayout(send_buf, send_splits, sort_idx, sorted_rank,
+                          pos_in_slot)
 
 
 def combine_layout(recv_buf: jax.Array, recv_splits: jax.Array):

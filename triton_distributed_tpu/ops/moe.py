@@ -1,0 +1,199 @@
+"""MoE TP kernels — AG+GroupGEMM and MoE+ReduceScatter.
+
+Reference: ``python/triton_dist/kernels/nvidia/allgather_group_gemm.py``
+(ctx :201, ``ag_group_gemm`` :401 — all-gather tokens then grouped GEMM over
+experts with a sorted gather index built by the CUDA alignment op
+``csrc/lib/moe_utils.cu:61``) and ``moe_reduce_rs.py`` (grouped GEMM →
+topk-weighted reduce → reduce-scatter; ``run_moe_reduce_rs`` :569).
+
+TPU design:
+- token→expert alignment is pure XLA (stable argsort + segment_sum — the
+  ``moe_utils.cu`` replacement; same approach as ops/all_to_all.py);
+- the gather rides the Pallas full-mesh AllGather;
+- the grouped GEMM is ``jax.lax.ragged_dot`` — XLA's native grouped matmul
+  that tiles expert groups onto the MXU (the role of the reference's
+  hand-written grouped-GEMM Triton kernel);
+- the combine rides the Pallas ring ReduceScatter.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.ops.allgather import all_gather_local, AllGatherMethod
+from triton_distributed_tpu.ops.reduce_scatter import reduce_scatter_local
+from triton_distributed_tpu.runtime.context import DistContext, get_context
+from triton_distributed_tpu.runtime.jit_cache import cached_shard_jit
+
+
+def sort_by_expert(expert_ids: jax.Array, num_experts: int):
+    """Stable sort of flat expert assignments.
+
+    Returns (sort_idx (T,), group_sizes (E,) int32) — the alignment metadata
+    the reference builds with ``moe_ag_scatter_align_block_size``.
+    """
+    expert_ids = expert_ids.astype(jnp.int32)
+    sort_idx = jnp.argsort(expert_ids, stable=True)
+    group_sizes = jax.ops.segment_sum(
+        jnp.ones_like(expert_ids), expert_ids, num_segments=num_experts)
+    return sort_idx, group_sizes.astype(jnp.int32)
+
+
+def grouped_mlp(x_sorted: jax.Array, group_sizes: jax.Array,
+                w_gate: jax.Array, w_up: jax.Array, w_down: jax.Array):
+    """SwiGLU expert MLP over expert-sorted tokens via ragged_dot.
+
+    x_sorted: (T, h); w_*: (E, h, ffn) / (E, ffn, h). Returns (T, h)."""
+    gate = jax.lax.ragged_dot(x_sorted, w_gate, group_sizes)
+    up = jax.lax.ragged_dot(x_sorted, w_up, group_sizes)
+    act = jax.nn.silu(gate) * up
+    return jax.lax.ragged_dot(act.astype(x_sorted.dtype), w_down, group_sizes)
+
+
+def ag_group_gemm_local(x_local: jax.Array, expert_ids: jax.Array,
+                        w_experts: jax.Array, topk_weights: jax.Array | None
+                        = None, *, axis: str = "tp",
+                        num_ranks: int | None = None,
+                        method: AllGatherMethod | str = AllGatherMethod.AUTO):
+    """Device-local AG+GroupGEMM inside shard_map.
+
+    x_local: (M/n, h) row-sharded tokens; expert_ids: (M·topk,) replicated
+    flat assignment (token t's k-th expert at t·topk+k); w_experts:
+    (E, h, ffn_local) — expert weights column-sharded over ranks.
+
+    Returns (y_sorted (M·topk, ffn_local), sort_idx, group_sizes): grouped
+    GEMM output in expert-sorted order plus the alignment metadata needed to
+    un-sort (reference ``ag_group_gemm``, allgather_group_gemm.py:401).
+    """
+    if num_ranks is None:
+        raise ValueError("num_ranks required inside shard_map")
+    n = num_ranks
+    E = w_experts.shape[0]
+    x_full = (x_local if n == 1 else
+              all_gather_local(x_local, axis=axis, num_ranks=n, method=method))
+    M = x_full.shape[0]
+    topk = expert_ids.shape[0] // M
+    sort_idx, group_sizes = sort_by_expert(expert_ids, E)
+    token_of_flat = sort_idx // topk
+    x_sorted = x_full[token_of_flat]
+    y_sorted = jax.lax.ragged_dot(x_sorted, w_experts, group_sizes)
+    if topk_weights is not None:
+        y_sorted = y_sorted * topk_weights.reshape(-1)[sort_idx][:, None]
+    return y_sorted.astype(x_local.dtype), sort_idx, group_sizes
+
+
+def moe_reduce_rs_local(y_sorted: jax.Array, sort_idx: jax.Array,
+                        group_sizes: jax.Array, w_down: jax.Array,
+                        topk_weights: jax.Array, num_tokens: int, *,
+                        axis: str = "tp", num_ranks: int | None = None,
+                        mode: str = "overlap"):
+    """Device-local MoE down-proj + topk-combine + ReduceScatter.
+
+    y_sorted: (M·topk, ffn_local) expert-sorted activations; w_down:
+    (E, ffn_local, h) row-sharded expert down-proj; topk_weights: (M, topk).
+    Returns (M/n, h) row-sharded (overlap/xla) or (M, h) replicated (ar
+    modes): the fully-reduced token rows (reference ``run_moe_reduce_rs``,
+    moe_reduce_rs.py:569 — grouped GEMM → weighted scatter-add →
+    reduce-scatter).
+    """
+    if num_ranks is None:
+        raise ValueError("num_ranks required inside shard_map")
+    n = num_ranks
+    M = num_tokens
+    topk = sort_idx.shape[0] // M
+    partial_sorted = jax.lax.ragged_dot(y_sorted, w_down, group_sizes)
+    w_flat = topk_weights.reshape(-1)[sort_idx]
+    partial_sorted = partial_sorted * w_flat[:, None]
+    token_of_flat = sort_idx // topk
+    combined = jax.ops.segment_sum(partial_sorted, token_of_flat,
+                                   num_segments=M)  # (M, h) partial over ffn
+    combined = combined.astype(y_sorted.dtype)
+    if n == 1:
+        return combined
+    if mode == "overlap":
+        return reduce_scatter_local(combined, axis=axis, num_ranks=n)
+    if mode == "xla":
+        return jax.lax.psum_scatter(combined, axis, scatter_dimension=0,
+                                    tiled=True)
+    if mode == "ar":
+        from triton_distributed_tpu.ops.allreduce import all_reduce_local
+
+        return all_reduce_local(combined, axis=axis, num_ranks=n)
+    if mode == "xla_rep":
+        return jax.lax.psum(combined, axis)
+    raise ValueError(f"unknown MoE mode {mode!r}")
+
+
+def moe_tp_fwd_local(x_local: jax.Array, gate_w: jax.Array,
+                     w_gate: jax.Array, w_up: jax.Array, w_down: jax.Array,
+                     topk: int, *, axis: str = "tp",
+                     num_ranks: int | None = None, mode: str = "overlap"):
+    """Full TP-MoE forward: router → AG+GroupGEMM (gate/up) → SwiGLU →
+    MoE+RS (down) — the composition the reference's TP_MoE layer runs
+    (layers/nvidia/tp_moe.py).
+
+    x_local: (M/n, h) row-sharded (overlap/xla) or (M, h) replicated
+    (ar/xla_rep — the decode layout); gate_w: (h, E) replicated router;
+    w_gate/w_up: (E, h, ffn_local); w_down: (E, ffn_local, h). Returns the
+    same layout it was given.
+    """
+    if num_ranks is None:
+        raise ValueError("num_ranks required inside shard_map")
+    n = num_ranks
+    E = gate_w.shape[1]
+    if n == 1 or mode in ("ar", "xla_rep"):
+        x_full = x_local
+    elif mode == "overlap":
+        x_full = all_gather_local(x_local, axis=axis, num_ranks=n)
+    elif mode == "xla":
+        x_full = jax.lax.all_gather(x_local, axis, tiled=True)
+    else:
+        raise ValueError(f"unknown MoE mode {mode!r}")
+    M = x_full.shape[0]
+
+    # Router (fp32 softmax over selected experts, Qwen-MoE convention).
+    logits = (x_full.astype(jnp.float32) @ gate_w.astype(jnp.float32))
+    topk_logits, topk_ids = jax.lax.top_k(logits, topk)       # (M, topk)
+    topk_weights = jax.nn.softmax(topk_logits, axis=-1)
+
+    flat_ids = topk_ids.reshape(-1)
+    sort_idx, group_sizes = sort_by_expert(flat_ids, E)
+    token_of_flat = sort_idx // topk
+    x_sorted = x_full[token_of_flat]
+
+    act = grouped_mlp_gate_up(x_sorted, group_sizes, w_gate, w_up)
+    return moe_reduce_rs_local(
+        act, sort_idx, group_sizes, w_down,
+        topk_weights.astype(x_local.dtype), M, axis=axis, num_ranks=n,
+        mode=mode)
+
+
+def grouped_mlp_gate_up(x_sorted, group_sizes, w_gate, w_up):
+    gate = jax.lax.ragged_dot(x_sorted, w_gate, group_sizes)
+    up = jax.lax.ragged_dot(x_sorted, w_up, group_sizes)
+    return (jax.nn.silu(gate) * up).astype(x_sorted.dtype)
+
+
+def moe_tp_fwd(x: jax.Array, gate_w: jax.Array, w_gate: jax.Array,
+               w_up: jax.Array, w_down: jax.Array, topk: int,
+               ctx: DistContext | None = None, axis: str = "tp") -> jax.Array:
+    """Host-level TP-MoE forward. x: (M, h) row-sharded over ``axis``;
+    router replicated; expert ffn weights sharded on the ffn dim
+    (w_gate/w_up dim 2, w_down dim 1). Returns (M, h) row-sharded."""
+    ctx = ctx or get_context()
+    n = ctx.axis_size(axis)
+    key = (axis, x.shape, w_gate.shape, topk, str(x.dtype))
+
+    def make():
+        return functools.partial(moe_tp_fwd_local, topk=topk, axis=axis,
+                                 num_ranks=n)
+
+    jfn = cached_shard_jit(
+        ctx, "moe_tp_fwd", key, make,
+        (P(axis), P(), P(None, None, axis), P(None, None, axis),
+         P(None, axis, None)), P(axis))
+    return jfn(x, gate_w, w_gate, w_up, w_down)

@@ -1,0 +1,177 @@
+"""Contextual autotuner — tune whole-op thunks, communication included.
+
+Reference: ``python/triton_dist/autotuner.py:43-105``
+(``contextual_autotune(is_dist=...)``): tunes the op as launched in context
+(comm side effects included), all-reduces per-config costs across ranks so
+every rank picks the SAME config, and caches the winner.
+
+TPU simplifications (by construction, not omission):
+- JAX is single-controller: one host times the whole-mesh jitted thunk, so
+  the cross-rank cost aggregation the reference needs (every rank times its
+  own stream) collapses to a single measurement — there is no way for ranks
+  to disagree on the winner.
+- Configs that fail to compile (e.g. VMEM overflow at big tiles) are
+  skipped, like the reference's exception-pruned search space.
+
+Timings use min-over-iters of host-fenced wall clock. A persistent JSON
+cache keyed by (name, key) lives under ``TDTPU_AUTOTUNE_CACHE`` (default
+``~/.cache/triton_distributed_tpu/autotune.json``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Any, Callable, Iterable, Sequence
+
+import jax
+
+_memory_cache: dict = {}
+_DEBUG = os.environ.get("TDTPU_DEBUG", "") == "1"
+
+
+def _cache_path() -> str:
+    return os.environ.get(
+        "TDTPU_AUTOTUNE_CACHE",
+        os.path.expanduser("~/.cache/triton_distributed_tpu/autotune.json"))
+
+
+def _load_disk_cache() -> dict:
+    try:
+        with open(_cache_path()) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _store_disk_cache(cache: dict) -> None:
+    path = _cache_path()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(cache, f, indent=1, sort_keys=True)
+    except OSError:
+        pass  # caching is best-effort
+
+
+@dataclasses.dataclass(frozen=True)
+class TuneReport:
+    """Winner + the full measured space (for inspection/tests)."""
+
+    best_index: int
+    best_time_s: float
+    timings: tuple  # (time_s | None per candidate)
+
+
+def measure(fn: Callable, args: Sequence[Any], *, warmup: int = 1,
+            iters: int = 3) -> float:
+    """Min-over-iters wall time of ``fn(*args)`` with device fencing."""
+    out = fn(*args)
+    jax.block_until_ready(out)
+    for _ in range(max(warmup - 1, 0)):
+        jax.block_until_ready(fn(*args))
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def contextual_autotune(
+    name: str,
+    key: Any,
+    candidates: Sequence[Any],
+    build: Callable[[Any], Callable],
+    args: Sequence[Any],
+    *,
+    warmup: int = 1,
+    iters: int = 3,
+    use_disk_cache: bool = True,
+) -> tuple[Any, TuneReport | None]:
+    """Pick the fastest candidate config for thunk-in-context ``build(cfg)``.
+
+    ``build(cfg)`` returns the ready-to-call (typically jitted/shard_mapped)
+    thunk; it runs with real communication. Returns (best_config, report);
+    report is None on a cache hit.
+    """
+    cache_key = f"{name}::{key}"
+    if cache_key in _memory_cache:
+        return candidates[_memory_cache[cache_key]], None
+    if use_disk_cache:
+        disk = _load_disk_cache()
+        idx = disk.get(cache_key)
+        if isinstance(idx, int) and 0 <= idx < len(candidates):
+            _memory_cache[cache_key] = idx
+            return candidates[idx], None
+
+    timings: list = []
+    for cfg in candidates:
+        try:
+            t = measure(build(cfg), args, warmup=warmup, iters=iters)
+        except Exception as e:  # config doesn't compile/fit — prune
+            if _DEBUG:
+                print(f"[autotune {name}] {cfg} failed: {e}")
+            t = None
+        timings.append(t)
+
+    valid = [(t, i) for i, t in enumerate(timings) if t is not None]
+    if not valid:
+        raise RuntimeError(
+            f"autotune {name!r}: every candidate failed — see "
+            "TDTPU_DEBUG=1 output")
+    best_time, best_index = min(valid)
+    _memory_cache[cache_key] = best_index
+    if use_disk_cache:
+        disk = _load_disk_cache()
+        disk[cache_key] = best_index
+        _store_disk_cache(disk)
+    return candidates[best_index], TuneReport(
+        best_index=best_index, best_time_s=best_time, timings=tuple(timings))
+
+
+def gemm_tile_candidates(m: int, k: int, ncols: int, itemsize: int,
+                         vmem_budget: int = 96 * 1024 * 1024 // 8
+                         ) -> list[tuple[int, int, int]]:
+    """Tile-config search space for the GEMM-core ops, VMEM-fit filtered
+    (the analog of the reference's pruned config lists +
+    gemm_perf_model.py's resource check)."""
+    cands = []
+    for tm in (128, 256, 512, 1024):
+        for tn in (256, 512, 1024):
+            for tk in (256, 512, 1024):
+                if tm > m or tn > ncols or tk > k:
+                    continue
+                # double-buffered a/b + out + fp32 acc
+                vmem = (2 * (tm * tk + tk * tn) + 2 * tm * tn) * itemsize \
+                    + tm * tn * 4
+                if vmem > vmem_budget:
+                    continue
+                cands.append((tm, tn, tk))
+    return cands or [(min(m, 128), min(ncols, 256), min(k, 256))]
+
+
+def tune_ag_gemm(a: jax.Array, b: jax.Array, ctx=None, axis: str = "tp"):
+    """Autotuned AG+GEMM: picks AGGemmConfig for these global shapes.
+
+    Reference: contextual_autotune applied to ag_gemm (autotuner.py usage in
+    test_ag_gemm).
+    """
+    from triton_distributed_tpu.ops.allgather_gemm import AGGemmConfig, ag_gemm
+    from triton_distributed_tpu.runtime.context import get_context
+
+    ctx = ctx or get_context()
+    n = ctx.axis_size(axis)
+    m_local = a.shape[0] // n
+    key = (tuple(a.shape), tuple(b.shape), str(a.dtype), n)
+    cands = [AGGemmConfig(tile_m=tm, tile_n=tn, tile_k=tk)
+             for tm, tn, tk in gemm_tile_candidates(
+                 m_local, a.shape[1], b.shape[1] // n, a.dtype.itemsize)]
+
+    def build(cfg):
+        return lambda x, w: ag_gemm(x, w, ctx, axis=axis, cfg=cfg)
+
+    best, _ = contextual_autotune("ag_gemm", key, cands, build, (a, b))
+    return best
