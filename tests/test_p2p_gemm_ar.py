@@ -33,4 +33,4 @@ def test_topology_cpu_mesh(ctx):
     topo = detect_topology()
     assert topo.num_devices == 8
     assert not topo.is_multi_host
-    assert ici_ring_order(topo) == list(range(8))
+    assert ici_ring_order(topo) is None  # no coords off-TPU: keep logical order

@@ -1,0 +1,62 @@
+"""Two-level (ICI intra + DCN inter) collectives on a (2, 4) CPU mesh —
+the inter-slice tier the reference covers with NVSHMEM/IB (SURVEY.md §7)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_distributed_tpu.ops.two_level import (
+    all_gather_2d,
+    all_reduce_2d,
+    reduce_scatter_2d,
+)
+from triton_distributed_tpu.runtime.context import initialize_distributed
+
+
+@pytest.fixture(scope="module")
+def ctx2d():
+    """(dcn=2, tp=4) mesh over the 8 virtual CPU devices."""
+    return initialize_distributed(mesh_shape=(2, 4),
+                                  axis_names=("dcn", "tp"))
+
+
+def test_all_gather_2d_golden(ctx2d):
+    N, m, cols = 8, 16, 128
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((N * m, cols)), jnp.float32)
+    out = all_gather_2d(x, ctx2d)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=0, atol=0)
+
+
+def test_all_reduce_2d_golden(ctx2d):
+    N, m, cols = 8, 32, 128
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((N, m, cols)), jnp.float32)
+    out = all_reduce_2d(x, ctx2d)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x).sum(0),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_reduce_scatter_2d_golden(ctx2d):
+    N, m, cols = 8, 16, 128
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((N, N * m, cols)), jnp.float32)
+    out = reduce_scatter_2d(x, ctx2d)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x).sum(0),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_ops_work_on_tp_axis_of_2d_mesh(ctx2d):
+    """Pallas remote DMA on the intra axis of a multi-axis mesh — exercises
+    the peer_id coordinate translation (language/distributed_ops.py)."""
+    from triton_distributed_tpu.ops import ag_gemm
+
+    n = 4
+    m, k, cols = 8, 128, 128
+    rng = np.random.default_rng(3)
+    a = jnp.asarray(rng.standard_normal((n * m, k)) * 0.1, jnp.float32)
+    b = jnp.asarray(rng.standard_normal((k, n * cols)) * 0.1, jnp.float32)
+    out = ag_gemm(a, b, ctx2d, axis="tp")
+    ref = np.asarray(a) @ np.asarray(b)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-3, atol=1e-3)

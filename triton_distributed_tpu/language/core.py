@@ -72,6 +72,7 @@ def kernel_call(
     in_specs: Sequence[pl.BlockSpec] | None = None,
     out_specs: Any | None = None,
     scratch_shapes: Sequence[Any] = (),
+    workspaces: Sequence[jax.ShapeDtypeStruct] = (),
     uses_barrier: bool = False,
     collective_id: int | None = None,
     interpret: bool | None = None,
@@ -84,6 +85,13 @@ def kernel_call(
     Defaults: refs live in ANY memory space (kernels DMA slices explicitly,
     like the reference's tile-level TMA loads), side effects enabled so comm
     kernels aren't DCE'd, interpret mode auto-selected off-TPU.
+
+    ``workspaces``: HBM workspace buffers (symmetric across devices —
+    remote-DMA targets). Mosaic does NOT support HBM scratch allocations
+    (`Scratch memref allocation only supported for vmem, smem and
+    semaphore_mem`), so workspaces are appended as extra kernel OUTPUTS —
+    the refs arrive after the real output refs, before scratch — and are
+    dropped from the python-level result.
     """
     if interpret is None:
         interpret = use_interpret()
@@ -111,6 +119,15 @@ def kernel_call(
         params["vmem_limit_bytes"] = vmem_limit_bytes
     compiler_params = pltpu.CompilerParams(has_side_effects=True, **params)
 
+    single_out = not isinstance(out_shape, (tuple, list))
+    n_real = 1 if single_out else len(out_shape)
+    if workspaces:
+        outs = ([out_shape] if single_out else list(out_shape))
+        out_shape = tuple(outs) + tuple(workspaces)
+        if out_specs is not None:
+            specs = [out_specs] if single_out else list(out_specs)
+            out_specs = tuple(specs) + tuple(any_spec() for _ in workspaces)
+
     kwargs: dict[str, Any] = dict(
         out_shape=out_shape,
         scratch_shapes=list(scratch_shapes),
@@ -127,7 +144,16 @@ def kernel_call(
         kwargs["cost_estimate"] = cost_estimate
     if input_output_aliases:
         kwargs["input_output_aliases"] = input_output_aliases
-    return pl.pallas_call(kernel, **kwargs)
+    call = pl.pallas_call(kernel, **kwargs)
+    if not workspaces:
+        return call
+
+    def wrapped(*args):
+        res = call(*args)
+        real = res[:n_real]
+        return real[0] if single_out else tuple(real)
+
+    return wrapped
 
 
 ANY = pl.ANY

@@ -60,6 +60,18 @@ def rank(axis: str = "tp"):
     return jax.lax.axis_index(axis)
 
 
+def peer_id(peer, axis: str) -> dict:
+    """Translate an index along ``axis`` into a remote-DMA ``device_id``.
+
+    Returns the ``{axis: peer}`` mesh-coordinate dict (use with
+    ``DeviceIdType.MESH``): Pallas pins every unnamed mesh axis to this
+    device's own coordinate, so the same kernel works on 1-D and multi-axis
+    meshes — the analog of the reference's CommScope-aware peer translation
+    (``symm_at`` resolves within the active team).
+    """
+    return {axis: peer}
+
+
 def num_ranks(axis: str = "tp"):
     """World size along ``axis`` (reference distributed_ops.py:90)."""
     return jax.lax.axis_size(axis)

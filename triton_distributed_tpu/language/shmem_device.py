@@ -39,8 +39,13 @@ CMP_EQ = "eq"
 CMP_GE = "ge"
 
 
-def putmem_nbi_block(src_ref, dst_ref, send_sem, recv_sem, peer):
+def putmem_nbi_block(src_ref, dst_ref, send_sem, recv_sem, peer,
+                     axis: str | None = None):
     """Non-blocking push of ``src_ref`` (local) into ``dst_ref`` on ``peer``.
+
+    ``peer`` is an index along ``axis`` when ``axis`` is given (translated to
+    full mesh coordinates on multi-axis meshes via ``peer_id``), else a raw
+    logical device id (1-D meshes).
 
     Returns the DMA handle; call ``.wait_send()`` for quiet/fence semantics or
     ``.wait()`` to also consume the local recv semaphore (only meaningful when
@@ -49,28 +54,36 @@ def putmem_nbi_block(src_ref, dst_ref, send_sem, recv_sem, peer):
     Reference: ``libshmem_device.putmem_nbi_block`` → nvshmem_putmem_nbi_block
     wrapper (nvshmem_wrapper.cu).
     """
+    from triton_distributed_tpu.language.distributed_ops import peer_id
+
+    id_type = LOGICAL
+    if axis is not None:
+        peer = peer_id(peer, axis)
+        id_type = pltpu.DeviceIdType.MESH
     rdma = pltpu.make_async_remote_copy(
         src_ref=src_ref,
         dst_ref=dst_ref,
         send_sem=send_sem,
         recv_sem=recv_sem,
         device_id=peer,
-        device_id_type=LOGICAL,
+        device_id_type=id_type,
     )
     rdma.start()
     return rdma
 
 
-def putmem_block(src_ref, dst_ref, send_sem, recv_sem, peer):
+def putmem_block(src_ref, dst_ref, send_sem, recv_sem, peer,
+                 axis: str | None = None):
     """Blocking push: start + wait for local completion (send side).
 
     Reference: ``libshmem_device.putmem_block``."""
-    rdma = putmem_nbi_block(src_ref, dst_ref, send_sem, recv_sem, peer)
+    rdma = putmem_nbi_block(src_ref, dst_ref, send_sem, recv_sem, peer, axis)
     rdma.wait_send()
     return rdma
 
 
-def putmem_signal_nbi_block(src_ref, dst_ref, send_sem, recv_sem, peer):
+def putmem_signal_nbi_block(src_ref, dst_ref, send_sem, recv_sem, peer,
+                            axis: str | None = None):
     """Push + signal, fused (NVSHMEM ``putmem_signal_nbi_block``).
 
     On TPU the remote DMA increments ``recv_sem`` *on the destination device*
@@ -85,13 +98,19 @@ def putmem_signal_nbi_block(src_ref, dst_ref, send_sem, recv_sem, peer):
     NVSHMEM's signal-after-data contract. Protocols needing a separate
     counter should signal it from the *receiver* after ``wait_recv()``.
     """
-    return putmem_nbi_block(src_ref, dst_ref, send_sem, recv_sem, peer)
+    return putmem_nbi_block(src_ref, dst_ref, send_sem, recv_sem, peer, axis)
 
 
-def signal_op(sem, peer, inc: int = 1):
+def signal_op(sem, peer, inc: int = 1, axis: str | None = None):
     """Remote signal: add ``inc`` to ``sem`` on ``peer``
     (reference ``libshmem_device.signal_op`` / NotifyOp ADD path)."""
-    pltpu.semaphore_signal(sem, inc=inc, device_id=peer, device_id_type=LOGICAL)
+    from triton_distributed_tpu.language.distributed_ops import peer_id
+
+    id_type = LOGICAL
+    if axis is not None:
+        peer = peer_id(peer, axis)
+        id_type = pltpu.DeviceIdType.MESH
+    pltpu.semaphore_signal(sem, inc=inc, device_id=peer, device_id_type=id_type)
 
 
 def signal_wait_until(sem, value: int, consume: bool = True):
@@ -116,16 +135,18 @@ def barrier_all(axis: str = "tp"):
     semaphore, then waits for n-1 signals. Requires the enclosing kernel to
     carry a ``collective_id``.
     """
+    from triton_distributed_tpu.language.distributed_ops import peer_id
+
     n = jax.lax.axis_size(axis)
     me = jax.lax.axis_index(axis)
     sem = pltpu.get_barrier_semaphore()
 
-    def body(i, _):
+    # axis_size is static under shard_map; a Python loop traces each peer's
+    # mesh-coordinate device id.
+    for i in range(n - 1):
         peer = jax.lax.rem(me + 1 + i, n)
-        pltpu.semaphore_signal(sem, inc=1, device_id=peer, device_id_type=LOGICAL)
-        return 0
-
-    jax.lax.fori_loop(0, n - 1, body, 0)
+        pltpu.semaphore_signal(sem, inc=1, device_id=peer_id(peer, axis),
+                               device_id_type=pltpu.DeviceIdType.MESH)
     pltpu.semaphore_wait(sem, n - 1)
 
 

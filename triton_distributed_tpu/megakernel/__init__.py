@@ -1,0 +1,17 @@
+"""Persistent single-kernel runtime (reference:
+``python/triton_dist/mega_triton_kernel/``)."""
+
+from triton_distributed_tpu.megakernel.tasks import (  # noqa: F401
+    TILE,
+    Task,
+    TaskType,
+    TensorHandle,
+)
+from triton_distributed_tpu.megakernel.builder import (  # noqa: F401
+    MegaKernelBuilder,
+    CompiledMegaKernel,
+)
+from triton_distributed_tpu.megakernel.scheduler import (  # noqa: F401
+    topo_schedule,
+    using_native_scheduler,
+)

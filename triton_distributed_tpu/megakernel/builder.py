@@ -1,0 +1,172 @@
+"""MegaKernel model builder — record ops as tasks, compile once, replay.
+
+Reference: ``mega_triton_kernel/models/model_builder.py:83-406``
+(``ModelBuilder.make_qkv_proj/make_attn/…/make_allreduce`` record tasks with
+dependencies; ``compile()`` generates the kernel + queues; ``run()`` replays
+the persistent kernel).
+
+Usage:
+    mb = MegaKernelBuilder()
+    x = mb.tensor(128, 256)           # handles into the tiled workspace
+    w = mb.tensor(256, 256)
+    y = mb.tensor(128, 256)
+    mb.gemm(y, x, w)
+    mb.all_reduce(y)                  # cross-device task (TP partial sums)
+    prog = mb.compile(num_ranks=8)
+    outs = prog.run({x: ax, w: aw}, outputs=[y])   # ONE kernel launch
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_distributed_tpu.megakernel.kernel import run_queue
+from triton_distributed_tpu.megakernel.scheduler import topo_schedule
+from triton_distributed_tpu.megakernel.tasks import (
+    TILE, WORDS, Task, TaskType, TensorHandle,
+)
+
+
+class MegaKernelBuilder:
+    """Records tensors + tasks; tracks read/write hazards for the scheduler
+    (the role of the reference's TaskDependency records,
+    core/task_base.py:112-218)."""
+
+    def __init__(self):
+        self._num_tiles = 0
+        self._tasks: list[Task] = []
+        self._edges: list[tuple[int, int]] = []
+        self._last_writer: dict[int, int] = {}
+        self._readers_since_write: dict[int, list[int]] = {}
+
+    # -- tensors ------------------------------------------------------------
+    def tensor(self, rows: int, cols: int) -> TensorHandle:
+        if rows % TILE or cols % TILE:
+            raise ValueError(f"dims must be multiples of {TILE}, got "
+                             f"({rows}, {cols})")
+        h = TensorHandle(self._num_tiles, rows, cols)
+        self._num_tiles += h.rt * h.ct
+        return h
+
+    # -- dependency bookkeeping --------------------------------------------
+    def _emit(self, task: Task, reads: list[int], writes: list[int]) -> int:
+        tid = len(self._tasks)
+        for t in reads:
+            w = self._last_writer.get(t)
+            if w is not None:
+                self._edges.append((w, tid))          # RAW
+            self._readers_since_write.setdefault(t, []).append(tid)
+        for t in writes:
+            w = self._last_writer.get(t)
+            if w is not None:
+                self._edges.append((w, tid))          # WAW
+            for r in self._readers_since_write.get(t, []):
+                if r != tid:
+                    self._edges.append((r, tid))      # WAR
+            self._last_writer[t] = tid
+            self._readers_since_write[t] = []
+        self._tasks.append(task)
+        return tid
+
+    # -- ops ----------------------------------------------------------------
+    def copy(self, out: TensorHandle, a: TensorHandle):
+        self._ew(TaskType.COPY, out, a)
+
+    def add(self, out: TensorHandle, a: TensorHandle, b: TensorHandle):
+        self._ew(TaskType.ADD, out, a, b)
+
+    def silu_mul(self, out: TensorHandle, gate: TensorHandle,
+                 up: TensorHandle):
+        self._ew(TaskType.SILU_MUL, out, gate, up)
+
+    def scale(self, out: TensorHandle, a: TensorHandle, factor: float):
+        arg = int(round(factor * 1e6))
+        for i in range(out.rt):
+            for j in range(out.ct):
+                self._emit(Task(TaskType.SCALE, out.tile(i, j),
+                                a.tile(i, j), arg=arg),
+                           [a.tile(i, j)], [out.tile(i, j)])
+
+    def _ew(self, tt: TaskType, out, a, b=None):
+        if (out.rt, out.ct) != (a.rt, a.ct) or (b and (b.rt, b.ct) != (a.rt, a.ct)):
+            raise ValueError("elementwise shape mismatch")
+        for i in range(out.rt):
+            for j in range(out.ct):
+                reads = [a.tile(i, j)] + ([b.tile(i, j)] if b else [])
+                self._emit(Task(tt, out.tile(i, j), a.tile(i, j),
+                                b.tile(i, j) if b else 0),
+                           reads, [out.tile(i, j)])
+
+    def gemm(self, out: TensorHandle, a: TensorHandle, b: TensorHandle):
+        """out (M,N) = a (M,K) @ b (K,N), one task per output tile
+        (reference make_linear → tile-parallel GEMM tasks)."""
+        if a.cols != b.rows or out.rows != a.rows or out.cols != b.cols:
+            raise ValueError("gemm shape mismatch")
+        kt = a.ct
+        for i in range(out.rt):
+            for j in range(out.ct):
+                reads = [a.tile(i, q) for q in range(kt)]
+                reads += [b.tile(q, j) for q in range(kt)]
+                self._emit(
+                    Task(TaskType.GEMM, out.tile(i, j),
+                         a0=a.tile(i, 0), b0=b.tile(0, j),
+                         k_tiles=kt, a_stride=1, b_stride=b.ct),
+                    reads, [out.tile(i, j)])
+
+    def all_reduce(self, t: TensorHandle):
+        """Sum ``t`` over ranks in place (reference make_allreduce)."""
+        for tile in t.tiles():
+            self._emit(Task(TaskType.ALLREDUCE, tile), [tile], [tile])
+
+    # -- compile / run -------------------------------------------------------
+    def compile(self, num_ranks: int = 1, axis: str = "tp"
+                ) -> "CompiledMegaKernel":
+        order = topo_schedule(len(self._tasks), self._edges)
+        if num_ranks > 1:
+            # Cross-device tasks must execute in the same relative order on
+            # every rank (they match by queue position); the deterministic
+            # scheduler guarantees it because all ranks build the same graph.
+            pass
+        queue = np.asarray([self._tasks[t].encode() for t in order],
+                           np.int32).reshape(-1, WORDS)
+        return CompiledMegaKernel(queue=jnp.asarray(queue),
+                                  num_tiles=self._num_tiles,
+                                  num_ranks=num_ranks, axis=axis)
+
+
+@dataclasses.dataclass
+class CompiledMegaKernel:
+    """Packed queue + workspace geometry; ``run`` is the single launch."""
+
+    queue: jax.Array
+    num_tiles: int
+    num_ranks: int
+    axis: str
+
+    def scatter_input(self, ws: jax.Array, h: TensorHandle,
+                      value: jax.Array) -> jax.Array:
+        """Write (rows, cols) ``value`` into the tiled workspace."""
+        tiles = value.astype(jnp.float32).reshape(
+            h.rt, TILE, h.ct, TILE).transpose(0, 2, 1, 3).reshape(
+            h.rt * h.ct, TILE, TILE)
+        return jax.lax.dynamic_update_slice(ws, tiles, (h.base, 0, 0))
+
+    def gather_output(self, ws: jax.Array, h: TensorHandle) -> jax.Array:
+        tiles = jax.lax.dynamic_slice(
+            ws, (h.base, 0, 0), (h.rt * h.ct, TILE, TILE))
+        return tiles.reshape(h.rt, h.ct, TILE, TILE).transpose(
+            0, 2, 1, 3).reshape(h.rows, h.cols)
+
+    def run(self, inputs: dict, outputs: list[TensorHandle],
+            _device_local: bool = True):
+        """Device-local execution (inside shard_map when num_ranks > 1)."""
+        ws = jnp.zeros((max(self.num_tiles, 1), TILE, TILE), jnp.float32)
+        for h, v in inputs.items():
+            ws = self.scatter_input(ws, h, v)
+        ws = run_queue(self.queue, ws, num_ranks=self.num_ranks,
+                       axis=self.axis)
+        return [self.gather_output(ws, h) for h in outputs]

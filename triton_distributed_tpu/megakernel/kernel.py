@@ -1,0 +1,189 @@
+"""The persistent MegaKernel — one Pallas launch runs the whole task queue.
+
+Reference: ``mega_triton_kernel/core/code_generator.py:31-89`` (the generated
+``MEGA_TRITON_KERNEL``: each SM loops its queue, decodes TaskBaseInfo, waits
+the scoreboard, dispatches on task_type) and ``kernels/task_context.py:92-138``
+(scoreboard).
+
+TPU shape: the Pallas grid IS the queue loop — grid step t executes task t
+(TPU grid steps run sequentially on the core, giving the in-order queue the
+reference builds per SM), the int32 task table rides scalar prefetch into
+SMEM, and dispatch is a ``lax.switch`` over task handlers. The scoreboard
+collapses: same-core dependencies are enforced by the scheduler's topological
+order (sequential execution = implicit scoreboard), and cross-device
+dependencies (the AllReduce task) synchronize with DMA semaphores + the
+barrier semaphore — the only places the reference's ld_acquire spin loops
+have a TPU analog.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu import language as dl
+from triton_distributed_tpu.language import shmem_device as shmem
+from triton_distributed_tpu.language.core import kernel_call, any_spec
+from triton_distributed_tpu.megakernel.tasks import TILE, WORDS
+from triton_distributed_tpu.runtime.context import use_interpret
+
+
+def _mega_kernel(n: int, axis: str, n_tasks: int,
+                 queue_ref, ws_in, ws_out, slots, va, vb, vacc,
+                 copy_sem, send_sems, recv_sem):
+    step = pl.program_id(0)
+
+    # Step 0: materialize the workspace into the output buffer all tasks
+    # read/write (results chain task-to-task within one launch).
+    @pl.when(step == 0)
+    def _():
+        cp = pltpu.make_async_copy(ws_in, ws_out, copy_sem)
+        cp.start()
+        cp.wait()
+        if n > 1:
+            shmem.barrier_all(axis)
+
+    def w(j):
+        return queue_ref[step, j]
+
+    out, a0, b0 = w(1), w(2), w(3)
+    k_tiles, a_stride, b_stride, arg = w(4), w(5), w(6), w(7)
+
+    def load(idx, vref):
+        cp = pltpu.make_async_copy(ws_out.at[idx], vref, copy_sem)
+        cp.start()
+        cp.wait()
+
+    def store(vref, idx):
+        cp = pltpu.make_async_copy(vref, ws_out.at[idx], copy_sem)
+        cp.start()
+        cp.wait()
+
+    def t_copy():
+        load(a0, va)
+        store(va, out)
+
+    def t_add():
+        load(a0, va)
+        load(b0, vb)
+        va[...] = va[...] + vb[...]
+        store(va, out)
+
+    def t_silu_mul():
+        load(a0, va)
+        load(b0, vb)
+        va[...] = jax.nn.silu(va[...]) * vb[...]
+        store(va, out)
+
+    def t_gemm():
+        vacc[...] = jnp.zeros_like(vacc)
+
+        def body(j, _):
+            load(a0 + j * a_stride, va)
+            load(b0 + j * b_stride, vb)
+            vacc[...] = vacc[...] + jnp.dot(
+                va[...], vb[...], preferred_element_type=jnp.float32)
+            return 0
+
+        jax.lax.fori_loop(0, k_tiles, body, 0)
+        va[...] = vacc[...]
+        store(va, out)
+
+    def t_allreduce():
+        # One-shot AR of tile ``out`` (reference tasks/allreduce.py, minus
+        # multimem): push to every peer's slot ``me``, reduce all slots,
+        # exit barrier so slot reuse by the next AR task is race-free.
+        if n == 1:
+            return
+        me = dl.rank(axis)
+        src = ws_out.at[out]
+        local = pltpu.make_async_copy(src, slots.at[me], copy_sem)
+        local.start()
+        handles = []
+        for i in range(n - 1):
+            peer = jax.lax.rem(me + 1 + i, n)
+            handles.append(shmem.putmem_nbi_block(
+                src, slots.at[me], send_sems.at[i], recv_sem, peer, axis))
+        local.wait()
+        shmem.quiet(*handles)
+        shmem.wait_deliveries(src, recv_sem, n - 1)
+        vacc[...] = jnp.zeros_like(vacc)
+        for r in range(n):
+            load_slot = pltpu.make_async_copy(slots.at[r], va, copy_sem)
+            load_slot.start()
+            load_slot.wait()
+            vacc[...] = vacc[...] + va[...]
+        va[...] = vacc[...]
+        store(va, out)
+        shmem.barrier_all(axis)
+
+    def t_scale():
+        load(a0, va)
+        va[...] = va[...] * (arg.astype(jnp.float32) * 1e-6)
+        store(va, out)
+
+    jax.lax.switch(w(0), [t_copy, t_add, t_silu_mul, t_gemm, t_allreduce,
+                          t_scale])
+
+
+def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp"):
+    """Execute the packed task queue over the workspace in ONE pallas_call.
+
+    queue: (n_tasks, WORDS) int32; workspace: (T, TILE, TILE) fp32 (local
+    per device when num_ranks > 1 — call inside shard_map).
+    Returns the post-execution workspace.
+    """
+    n_tasks = queue.shape[0]
+    assert queue.shape[1] == WORDS
+    n = num_ranks
+    T = workspace.shape[0]
+
+    # AR slots ride as a second output: Mosaic has no HBM scratch (see
+    # language/core.py kernel_call ``workspaces``).
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tasks,),
+        in_specs=[any_spec()],
+        out_specs=(any_spec(), any_spec()),
+        scratch_shapes=[
+            pltpu.VMEM((TILE, TILE), jnp.float32),
+            pltpu.VMEM((TILE, TILE), jnp.float32),
+            pltpu.VMEM((TILE, TILE), jnp.float32),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )
+    kernel = functools.partial(_mega_kernel, n, axis, n_tasks)
+    interpret = use_interpret()
+    if interpret:
+        from triton_distributed_tpu.runtime.interpret_workarounds import (
+            apply_interpret_workarounds,
+        )
+
+        apply_interpret_workarounds()
+        from triton_distributed_tpu.language.core import _interpret_params
+
+        interpret_arg = _interpret_params()
+    else:
+        interpret_arg = False
+    params = {}
+    if n > 1:
+        from triton_distributed_tpu.language.core import next_collective_id
+
+        params["collective_id"] = next_collective_id(key=_mega_kernel)
+    ws_out, _slots = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((T, TILE, TILE), jnp.float32),
+            jax.ShapeDtypeStruct((max(n, 1), TILE, TILE), jnp.float32),
+        ),
+        compiler_params=pltpu.CompilerParams(has_side_effects=True, **params),
+        interpret=interpret_arg,
+    )(queue, workspace)
+    return ws_out

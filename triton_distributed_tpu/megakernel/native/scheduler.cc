@@ -1,0 +1,47 @@
+// MegaKernel host-side scheduler — native task-graph ordering.
+//
+// Reference: python/triton_dist/mega_triton_kernel/core/scheduler.py:40-95
+// (static SM work queues, round-robin/zig-zag assignment) and the native
+// runtime obligations of SURVEY.md §2.1. On TPU the queue is consumed
+// sequentially per device core, so the scheduler's job is a hazard-correct
+// topological order that keeps producer→consumer distances short (better
+// DMA locality between dependent tiles).
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image):
+//   topo_schedule(n_tasks, n_edges, edges_src, edges_dst, order_out) -> int
+// Returns 0 on success, -1 on cycle. Kahn's algorithm with a
+// smallest-ready-index heap: deterministic, stable, and dependency-tight.
+
+#include <cstdint>
+#include <queue>
+#include <vector>
+#include <functional>
+
+extern "C" {
+
+int topo_schedule(int32_t n_tasks, int32_t n_edges, const int32_t* edges_src,
+                  const int32_t* edges_dst, int32_t* order_out) {
+  std::vector<std::vector<int32_t>> succ(n_tasks);
+  std::vector<int32_t> indeg(n_tasks, 0);
+  for (int32_t e = 0; e < n_edges; ++e) {
+    int32_t s = edges_src[e], d = edges_dst[e];
+    if (s < 0 || d < 0 || s >= n_tasks || d >= n_tasks) return -2;
+    succ[s].push_back(d);
+    indeg[d]++;
+  }
+  std::priority_queue<int32_t, std::vector<int32_t>, std::greater<int32_t>>
+      ready;
+  for (int32_t i = 0; i < n_tasks; ++i)
+    if (indeg[i] == 0) ready.push(i);
+  int32_t emitted = 0;
+  while (!ready.empty()) {
+    int32_t t = ready.top();
+    ready.pop();
+    order_out[emitted++] = t;
+    for (int32_t d : succ[t])
+      if (--indeg[d] == 0) ready.push(d);
+  }
+  return emitted == n_tasks ? 0 : -1;  // -1: dependency cycle
+}
+
+}  // extern "C"

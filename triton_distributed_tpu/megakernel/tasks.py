@@ -1,0 +1,74 @@
+"""MegaKernel task model — typed tasks over a tiled workspace.
+
+Reference: ``python/triton_dist/mega_triton_kernel/core/task_base.py:150-218``
+(``TaskBase``: (task_type, layer/task/tile ids, dependency, io tensor descs,
+extra params) encoded to an int tuple) and the per-SM uint32 work queues of
+``core/scheduler.py:40-95``.
+
+TPU encoding: every tensor lives in ONE fp32 HBM workspace shaped
+``(num_tiles, TILE, TILE)``; a task is ``WORDS`` int32s addressing tiles by
+index — so the device kernel needs no pointer decoding, only dynamic leading
+indices (the TensorDesc ptr+shape decode of ``kernels/task_context.py:31-50``
+collapses to tile ids).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+TILE = 128      # square fp32 tile (8×128 sublane-aligned, MXU-shaped)
+WORDS = 8       # int32 words per task
+
+
+class TaskType(enum.IntEnum):
+    """Device-dispatchable task kinds (reference tasks/*.py builders)."""
+
+    COPY = 0        # out <- a
+    ADD = 1         # out <- a + b
+    SILU_MUL = 2    # out <- silu(a) * b
+    GEMM = 3        # out <- [acc +] sum_j a[a0+j*as] @ b[b0+j*bs]
+    ALLREDUCE = 4   # out <- sum over ranks of out (one tile, one-shot)
+    SCALE = 5       # out <- a * scalar (scalar in word 7 as fixed-point 1e-6)
+
+
+@dataclasses.dataclass(frozen=True)
+class Task:
+    """One queue entry. Word layout:
+    [type, out, a0, b0, k_tiles, a_stride, b_stride, arg]."""
+
+    type: TaskType
+    out: int
+    a0: int = 0
+    b0: int = 0
+    k_tiles: int = 0
+    a_stride: int = 0
+    b_stride: int = 0
+    arg: int = 0
+
+    def encode(self) -> list[int]:
+        return [int(self.type), self.out, self.a0, self.b0, self.k_tiles,
+                self.a_stride, self.b_stride, self.arg]
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorHandle:
+    """A (R, C) fp32 tensor as a row-major grid of TILE×TILE tiles."""
+
+    base: int
+    rows: int
+    cols: int
+
+    @property
+    def rt(self) -> int:
+        return self.rows // TILE
+
+    @property
+    def ct(self) -> int:
+        return self.cols // TILE
+
+    def tile(self, i: int, j: int) -> int:
+        return self.base + i * self.ct + j
+
+    def tiles(self) -> list[int]:
+        return list(range(self.base, self.base + self.rt * self.ct))

@@ -88,7 +88,7 @@ def _a2a_kernel(n: int, axis: str, cap: int, block: int,
                 pltpu.make_async_copy(src, dst, data_recv_sem).start()
             else:
                 shmem.putmem_nbi_block(src, dst, data_send_sem,
-                                       data_recv_sem, dst_rank)
+                                       data_recv_sem, dst_rank, axis)
             return 0
 
         jax.lax.fori_loop(0, count, body, 0)
@@ -203,7 +203,8 @@ def fast_all_to_all(send_buf: jax.Array, send_splits: jax.Array,
         return wrapped
 
     jfn = cached_shard_jit(ctx, "fast_all_to_all", key, make,
-                           (P(axis), P(axis)), (P(axis), P(axis)))
+                           (P(axis), P(axis)), (P(axis), P(axis)),
+                           ici_axes=(axis,))
     return jfn(send_buf, send_splits)
 
 

@@ -71,7 +71,8 @@ def _ag_full_mesh_push_kernel(n: int, axis: str, m: int,
     for i in range(n - 1):
         peer = jax.lax.rem(me + 1 + i, n)
         handles.append(
-            shmem.putmem_nbi_block(x_ref, my_slot, send_sems.at[i], recv_sem, peer)
+            shmem.putmem_nbi_block(x_ref, my_slot, send_sems.at[i], recv_sem, peer,
+                                   axis)
         )
     local.wait()
     shmem.quiet(*handles)
@@ -93,7 +94,7 @@ def _ag_ring_kernel(n: int, axis: str, m: int,
     for s in range(n - 1):
         chunk = jax.lax.rem(me - s + n, n)  # chunk acquired at step s-1 (own at s=0)
         slot = out_ref.at[pl.ds(chunk * m, m)]
-        h = shmem.putmem_nbi_block(slot, slot, send_sem, recv_sem, right)
+        h = shmem.putmem_nbi_block(slot, slot, send_sem, recv_sem, right, axis)
         # Receive chunk (me-1-s) from the left before forwarding it next step.
         shmem.wait_deliveries(x_ref, recv_sem, 1)
         h.wait_send()
@@ -165,6 +166,7 @@ def all_gather(x: jax.Array, ctx: DistContext | None = None, axis: str = "tp",
         return (lambda xl: fn(xl)[None]) if stacked else fn
 
     jfn = cached_shard_jit(ctx, "all_gather", key, make, P(axis),
-                           P(axis) if stacked else P(None))
+                           P(axis) if stacked else P(None),
+                           ici_axes=(axis,))
     out = jfn(x)
     return out.reshape(n, *x.shape) if stacked else out

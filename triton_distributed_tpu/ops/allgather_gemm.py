@@ -44,19 +44,31 @@ from triton_distributed_tpu.runtime.jit_cache import cached_shard_jit
 @dataclasses.dataclass(frozen=True)
 class AGGemmConfig:
     """Tile configuration (the tunable surface the reference exposes through
-    its autotuner configs; AllGatherGEMMTensorParallelContext analog)."""
+    its autotuner configs; AllGatherGEMMTensorParallelContext analog).
+
+    ``straggler``: optional (rank, cycles) fault injection — that rank spins
+    ``cycles`` before producing, widening race windows (reference
+    straggler_option, allgather_gemm.py:602-603 via torch.cuda._sleep).
+    """
 
     tile_m: int = 512
     tile_n: int = 1024
     tile_k: int = 1024
+    straggler: tuple | None = None
 
 
 def _ag_gemm_kernel(n: int, axis: str, m: int, k: int, ncols: int,
-                    tiles, x_ref, b_ref, out_ref, ws_ref,
+                    tiles, straggler, x_ref, b_ref, out_ref, ws_ref,
                     vacc, send_sems, recv_sems):
     """See module docstring. ws_ref is the AG landing workspace (n·m, k)."""
     me = dl.rank(axis)
     shmem.barrier_all(axis)
+    if straggler is not None:
+        s_rank, cycles = straggler
+
+        @pl.when(me == s_rank)
+        def _():
+            pl.delay(cycles)
 
     # --- producer: local copy + full-mesh push of my shard into slot `me`.
     my_slot = ws_ref.at[pl.ds(me * m, m)]
@@ -67,7 +79,7 @@ def _ag_gemm_kernel(n: int, axis: str, m: int, k: int, ncols: int,
         peer = jax.lax.rem(me + 1 + i, n)
         handles.append(
             shmem.putmem_nbi_block(x_ref, my_slot, send_sems.at[i],
-                                   recv_sems.at[me], peer)
+                                   recv_sems.at[me], peer, axis)
         )
 
     tm, tk, tn = tiles
@@ -108,14 +120,16 @@ def ag_gemm_local(x_local: jax.Array, b_local: jax.Array, axis: str = "tp",
                              tile_n=cfg.tile_n, tile_k=cfg.tile_k)
     tm, tk, tn = gemm_tiles(m, k, ncols, x_local.dtype, cfg)
     kernel = functools.partial(_ag_gemm_kernel, n, axis, m, k, ncols,
-                               (tm, tk, tn))
+                               (tm, tk, tn), cfg.straggler)
     out = kernel_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((n * m, ncols), x_local.dtype),
         in_specs=[any_spec(), any_spec()],
         out_specs=any_spec(),
+        workspaces=[
+            jax.ShapeDtypeStruct((n * m, k), x_local.dtype),  # AG landing ws
+        ],
         scratch_shapes=[
-            pltpu.HBM((n * m, k), x_local.dtype),  # AG landing workspace
             pltpu.VMEM((tm, tn), jnp.float32),
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
             pltpu.SemaphoreType.DMA((n,)),
@@ -143,5 +157,6 @@ def ag_gemm(a: jax.Array, b: jax.Array, ctx: DistContext | None = None,
         return fn
 
     jfn = cached_shard_jit(ctx, "ag_gemm", key, make,
-                           (P(axis), P(None, axis)), P(None, axis))
+                           (P(axis), P(None, axis)), P(None, axis),
+                           ici_axes=(axis,))
     return jfn(a, b)

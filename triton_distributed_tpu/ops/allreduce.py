@@ -66,7 +66,7 @@ def _ar_one_shot_kernel(n: int, axis: str, m: int, tile_m: int,
         peer = jax.lax.rem(me + 1 + i, n)
         handles.append(
             shmem.putmem_nbi_block(x_ref, ws.at[me], send_sems.at[i],
-                                   recv_sem, peer)
+                                   recv_sem, peer, axis)
         )
     local.wait()
     shmem.quiet(*handles)
@@ -114,8 +114,10 @@ def all_reduce_local(x_local: jax.Array, axis: str = "tp",
         out_shape=jax.ShapeDtypeStruct((m, cols), x_local.dtype),
         in_specs=[any_spec()],
         out_specs=any_spec(),
+        workspaces=[
+            jax.ShapeDtypeStruct((n, m, cols), x_local.dtype),  # symmetric ws
+        ],
         scratch_shapes=[
-            pltpu.HBM((n, m, cols), x_local.dtype),       # symmetric workspace
             pltpu.VMEM((tile_m, cols), x_local.dtype),
             pltpu.VMEM((tile_m, cols), jnp.float32),
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
@@ -140,4 +142,5 @@ def all_reduce(x: jax.Array, ctx: DistContext | None = None, axis: str = "tp",
                                method=method)
         return lambda xl: fn(xl[0])
 
-    return cached_shard_jit(ctx, "all_reduce", key, make, P(axis), P(None))(x)
+    return cached_shard_jit(ctx, "all_reduce", key, make, P(axis), P(None),
+                            ici_axes=(axis,))(x)

@@ -78,7 +78,7 @@ def _gemm_rs_kernel(n: int, axis: str, m_total: int, k: int, ncols: int,
         if i < n - 1:
             handles.append(shmem.putmem_nbi_block(
                 partial_ref.at[rows], ws_ref.at[me],
-                send_sems.at[i], recv_sem, c))
+                send_sems.at[i], recv_sem, c, axis))
 
     # --- consumer: wait the n-1 peer deliveries, then pipelined fp32
     # reduction over all n workspace slots (reference ring_reduce epilogue,
@@ -142,9 +142,11 @@ def gemm_rs_local(x_local: jax.Array, b_local: jax.Array, axis: str = "tp",
         out_shape=jax.ShapeDtypeStruct((mc, ncols), x_local.dtype),
         in_specs=[any_spec(), any_spec()],
         out_specs=any_spec(),
+        workspaces=[
+            jax.ShapeDtypeStruct((m_total, ncols), x_local.dtype),  # staging
+            jax.ShapeDtypeStruct((n, mc, ncols), x_local.dtype),    # accum ws
+        ],
         scratch_shapes=[
-            pltpu.HBM((m_total, ncols), x_local.dtype),   # peer-chunk staging
-            pltpu.HBM((n, mc, ncols), x_local.dtype),     # accumulation ws
             pltpu.VMEM((tm, tn), jnp.float32),
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
             pltpu.SemaphoreType.DMA(()),
@@ -172,5 +174,6 @@ def gemm_rs(a: jax.Array, b: jax.Array, ctx: DistContext | None = None,
         return functools.partial(gemm_rs_local, axis=axis, num_ranks=n, cfg=cfg)
 
     jfn = cached_shard_jit(ctx, "gemm_rs", key, make,
-                           (P(None, axis), P(axis)), P(axis))
+                           (P(None, axis), P(axis)), P(axis),
+                           ici_axes=(axis,))
     return jfn(a, b)

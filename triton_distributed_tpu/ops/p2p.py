@@ -33,7 +33,8 @@ def _p2p_shift_kernel(n: int, axis: str, shift: int, x_ref, out_ref,
     me = dl.rank(axis)
     shmem.barrier_all(axis)
     dst = jax.lax.rem(me + shift + n, n)
-    rdma = shmem.putmem_nbi_block(x_ref, out_ref, send_sem, recv_sem, dst)
+    rdma = shmem.putmem_nbi_block(x_ref, out_ref, send_sem, recv_sem, dst,
+                                  axis)
     rdma.wait()
 
 

@@ -73,7 +73,7 @@ def _rs_ring_kernel(n: int, axis: str, m: int, tile_m: int,
         if s == 0:
             # First hop: raw local contribution, no staging needed.
             send_handles[0] = shmem.putmem_nbi_block(
-                x_chunk(c), comm.at[0], send_sem, recv_sem, right)
+                x_chunk(c), comm.at[0], send_sem, recv_sem, right, axis)
             continue
         # Partial for chunk c arrived from the left in slot s-1.
         shmem.wait_deliveries(chunk_like, recv_sem, 1)
@@ -87,7 +87,7 @@ def _rs_ring_kernel(n: int, axis: str, m: int, tile_m: int,
             m, tile_m, va, vb, copy_sem,
         )
         send_handles[s] = shmem.putmem_nbi_block(
-            stage.at[slot], comm.at[s], send_sem, recv_sem, right)
+            stage.at[slot], comm.at[s], send_sem, recv_sem, right, axis)
     # Final arrival: my own chunk, fully reduced except my contribution.
     shmem.wait_deliveries(chunk_like, recv_sem, 1)
     _tiled_add(
@@ -126,9 +126,11 @@ def reduce_scatter_local(x_local: jax.Array, axis: str = "tp",
         out_shape=jax.ShapeDtypeStruct((m, cols), x_local.dtype),
         in_specs=[any_spec()],
         out_specs=any_spec(),
+        workspaces=[
+            jax.ShapeDtypeStruct((n - 1, m, cols), x_local.dtype),  # comm slots
+            jax.ShapeDtypeStruct((2, m, cols), x_local.dtype),      # stage
+        ],
         scratch_shapes=[
-            pltpu.HBM((n - 1, m, cols), x_local.dtype),   # comm: per-step slots
-            pltpu.HBM((2, m, cols), x_local.dtype),       # stage: double buffer
             pltpu.VMEM((tile_m, cols), x_local.dtype),
             pltpu.VMEM((tile_m, cols), x_local.dtype),
             pltpu.SemaphoreType.DMA(()),
@@ -156,4 +158,4 @@ def reduce_scatter(x: jax.Array, ctx: DistContext | None = None,
         return lambda xl: fn(xl[0])
 
     return cached_shard_jit(ctx, "reduce_scatter", key, make,
-                            P(axis), P(axis))(x)
+                            P(axis), P(axis), ici_axes=(axis,))(x)

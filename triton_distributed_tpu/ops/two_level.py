@@ -1,0 +1,137 @@
+"""Two-level collectives — Pallas over ICI within a slice, XLA over DCN.
+
+Reference: the reference is two-tier everywhere — copy-engine/NVLink intra-
+node + NVSHMEM/IB inter-node (e.g. ``allgather.py:293-378`` 2D inter-node
+ring, ``reduce_scatter.py:506`` inter-node p2p, CommScope INTRA/INTER_NODE).
+SURVEY.md §7 maps the inter tier to DCN, where Pallas remote DMA does not
+reach: the idiomatic TPU split is Pallas kernels on the intra-slice axis and
+``jax.lax`` collectives (XLA's DCN-aware transfers) on the inter-slice axis.
+
+Mesh convention: 2-D mesh ``(inter_axis, intra_axis)`` — e.g.
+``initialize_distributed(mesh_shape=(2, 4), axis_names=("dcn", "tp"))``.
+Global shard index of a device = ``inter_idx * n_intra + intra_idx``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.ops.allgather import all_gather_local, AllGatherMethod
+from triton_distributed_tpu.ops.reduce_scatter import reduce_scatter_local
+from triton_distributed_tpu.runtime.context import DistContext, get_context
+from triton_distributed_tpu.runtime.jit_cache import cached_shard_jit
+
+
+def all_gather_2d_local(x_local: jax.Array, *, intra_axis: str = "tp",
+                        inter_axis: str = "dcn",
+                        n_intra: int | None = None,
+                        n_inter: int | None = None) -> jax.Array:
+    """Hierarchical AllGather: Pallas intra-slice, lax over DCN.
+
+    x_local: (m, cols) per device → (n_inter·n_intra·m, cols), rows ordered
+    by global shard index. Intra first (big ICI bandwidth), then the
+    slice-gathered blocks cross DCN once (reference 2D inter-node AG,
+    allgather.py:293-378).
+    """
+    if n_intra is None or n_inter is None:
+        raise ValueError("n_intra/n_inter required inside shard_map")
+    intra = all_gather_local(x_local, axis=intra_axis, num_ranks=n_intra)
+    if n_inter == 1:
+        return intra
+    return jax.lax.all_gather(intra, inter_axis, tiled=True)
+
+
+def reduce_scatter_2d_local(x_local: jax.Array, *, intra_axis: str = "tp",
+                            inter_axis: str = "dcn",
+                            n_intra: int | None = None,
+                            n_inter: int | None = None) -> jax.Array:
+    """Hierarchical ReduceScatter: lax over DCN first (cuts DCN bytes to
+    1/n_inter), then the Pallas ring within the slice.
+
+    x_local: (N·m, cols) contributions, N = n_inter·n_intra →
+    (m, cols): this device's fully-reduced global chunk.
+    """
+    if n_intra is None or n_inter is None:
+        raise ValueError("n_intra/n_inter required inside shard_map")
+    if n_inter > 1:
+        # DCN tier first: each slice keeps its (n_intra·m)-row block, summed
+        # over slices — DCN carries 1/n_inter of the bytes, once.
+        x_local = jax.lax.psum_scatter(x_local, inter_axis,
+                                       scatter_dimension=0, tiled=True)
+    if n_intra == 1:
+        return x_local
+    return reduce_scatter_local(x_local, axis=intra_axis, num_ranks=n_intra)
+
+
+def all_reduce_2d_local(x_local: jax.Array, *, intra_axis: str = "tp",
+                        inter_axis: str = "dcn",
+                        n_intra: int | None = None,
+                        n_inter: int | None = None) -> jax.Array:
+    """Hierarchical AllReduce: intra RS (Pallas ring) → DCN psum (on 1/n_intra
+    of the data) → intra AG (Pallas ring) — the classic two-tier two-shot
+    (the reference's inter-node AR composition; multimem-free)."""
+    if n_intra is None or n_inter is None:
+        raise ValueError("n_intra/n_inter required inside shard_map")
+    m, cols = x_local.shape
+    if n_intra == 1 or m % n_intra:
+        summed = x_local if n_intra == 1 else jax.lax.psum(x_local, intra_axis)
+        return jax.lax.psum(summed, inter_axis) if n_inter > 1 else summed
+    scattered = reduce_scatter_local(x_local, axis=intra_axis,
+                                     num_ranks=n_intra)
+    if n_inter > 1:
+        scattered = jax.lax.psum(scattered, inter_axis)
+    return all_gather_local(scattered, axis=intra_axis, num_ranks=n_intra,
+                            method=AllGatherMethod.RING_1D)
+
+
+def _two_level(ctx, name, local_fn, x, intra_axis, inter_axis, out_spec_fn,
+               stacked: bool):
+    n_intra = ctx.axis_size(intra_axis)
+    n_inter = ctx.axis_size(inter_axis)
+    key = (name, intra_axis, inter_axis, x.shape, str(x.dtype))
+
+    def make():
+        fn = functools.partial(local_fn, intra_axis=intra_axis,
+                               inter_axis=inter_axis, n_intra=n_intra,
+                               n_inter=n_inter)
+        return (lambda xl: fn(xl[0])) if stacked else fn
+
+    in_spec = P((inter_axis, intra_axis))
+    return cached_shard_jit(ctx, name, key, make, in_spec,
+                            out_spec_fn(n_intra, n_inter),
+                            ici_axes=(intra_axis,))(x)
+
+
+def all_gather_2d(x: jax.Array, ctx: DistContext | None = None,
+                  intra_axis: str = "tp", inter_axis: str = "dcn"):
+    """Host-level hierarchical AllGather: ``x`` (N·m, cols) sharded over both
+    axes (global shard d = inter·n_intra + intra) → replicated."""
+    ctx = ctx or get_context()
+    return _two_level(ctx, "all_gather_2d", all_gather_2d_local, x,
+                      intra_axis, inter_axis, lambda ni, no: P(None),
+                      stacked=False)
+
+
+def all_reduce_2d(x: jax.Array, ctx: DistContext | None = None,
+                  intra_axis: str = "tp", inter_axis: str = "dcn"):
+    """Host-level hierarchical AllReduce: ``x`` globally (N, m, cols)
+    stacked contributions → replicated (m, cols) sum."""
+    ctx = ctx or get_context()
+    return _two_level(ctx, "all_reduce_2d", all_reduce_2d_local, x,
+                      intra_axis, inter_axis, lambda ni, no: P(None),
+                      stacked=True)
+
+
+def reduce_scatter_2d(x: jax.Array, ctx: DistContext | None = None,
+                      intra_axis: str = "tp", inter_axis: str = "dcn"):
+    """Host-level hierarchical ReduceScatter: ``x`` globally (N, N·m, cols)
+    stacked contributions → (N·m, cols) scattered by global shard index."""
+    ctx = ctx or get_context()
+    return _two_level(ctx, "reduce_scatter_2d", reduce_scatter_2d_local, x,
+                      intra_axis, inter_axis,
+                      lambda ni, no: P((inter_axis, intra_axis)),
+                      stacked=True)

@@ -65,22 +65,60 @@ class DistContext:
     def axis_size(self, axis: str) -> int:
         return int(self.mesh.shape[axis])
 
+    def axis_is_ici(self, axis: str) -> bool:
+        """True iff every fiber along ``axis`` stays within one process —
+        i.e. Pallas remote DMA over this axis rides ICI, never DCN."""
+        devs = np.asarray(self.mesh.devices)
+        ax = list(self.mesh.axis_names).index(axis)
+        moved = np.moveaxis(devs, ax, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        for j in range(flat.shape[1]):
+            if len({d.process_index for d in flat[:, j]}) != 1:
+                return False
+        return True
+
+    def require_ici(self, axis: str, op_name: str = "op") -> None:
+        """Reject Pallas comm over a DCN-spanning axis with a clear error
+        (the reference's inter-node tier is NVSHMEM/IB; ours is
+        ops/two_level.py hybrid collectives — point the user there)."""
+        if not self.axis_is_ici(axis):
+            raise RuntimeError(
+                f"{op_name}: axis {axis!r} spans multiple processes/slices; "
+                "Pallas remote DMA only reaches ICI within one slice. Use "
+                "the two-level collectives (ops/two_level.py) with this "
+                "axis as inter_axis, or re-shape the mesh so the Pallas "
+                "axis is intra-slice.")
+
 
 def initialize_distributed(
     mesh_shape: Sequence[int] | None = None,
     axis_names: Sequence[str] = ("tp",),
     devices: Sequence[jax.Device] | None = None,
     seed: int = 42,
+    physical_ring: bool = True,
 ) -> DistContext:
     """Build the global mesh context (reference: utils.py:182 ``initialize_distributed``).
 
     Unlike the reference there is no process-group bootstrap: the JAX runtime
     already knows all devices. ``mesh_shape=None`` uses all devices on a 1-D
     tp axis.
+
+    ``physical_ring``: on a 1-D TPU mesh, reorder the devices so logical
+    rank ±1 is a physical ICI torus neighbor (topology.ici_ring_order) —
+    ring collectives then hop only over single links (the reference's
+    NUMA-aware ring, allgather.py:211). No-op when no neighbor cycle exists.
     """
     devs = list(devices if devices is not None else jax.devices())
     if mesh_shape is None:
         mesh_shape = (len(devs),)
+    if physical_ring and len(mesh_shape) == 1 and len(devs) > 2:
+        from triton_distributed_tpu.runtime.topology import (
+            detect_topology, ici_ring_order,
+        )
+
+        order = ici_ring_order(detect_topology(devs))
+        if order is not None:
+            devs = [devs[i] for i in order]
     if int(np.prod(mesh_shape)) != len(devs):
         raise ValueError(
             f"mesh_shape {tuple(mesh_shape)} does not cover {len(devs)} devices"

@@ -25,12 +25,18 @@ def cached_shard_jit(
     make_local_fn: Callable[[], Callable],
     in_specs: Any,
     out_specs: Any,
+    ici_axes: tuple = (),
 ):
     """Return a jitted ``shard_map(local_fn)`` cached by (mesh, op, key).
 
     ``make_local_fn`` is only invoked on cache miss; ``key`` must capture every
     static config that changes the trace (shapes, dtype, method, axis).
+    ``ici_axes``: axes the op runs Pallas remote DMA over — validated to stay
+    within one process/slice (Pallas cannot reach across DCN; the reference's
+    inter-node tier uses NVSHMEM there, ours uses ops/two_level.py).
     """
+    for axis in ici_axes:
+        ctx.require_ici(axis, op_name)
     cache_key = (ctx.mesh, op_name, key)
     fn = _CACHE.get(cache_key)
     if fn is None:

@@ -55,29 +55,64 @@ def detect_topology(devices=None) -> Topology:
     )
 
 
-def ici_ring_order(topology: Topology) -> list[int]:
-    """A device order that walks the ICI torus with neighbor hops (the ring
-    used by ring collectives). Off-TPU (or unknown coords) the logical order
-    is returned — the CPU test mesh has uniform 'links' anyway.
+def _boustrophedon(dims: tuple[int, ...]) -> list[tuple[int, ...]]:
+    """Snake path visiting every coordinate of a grid, recursive over dims."""
+    if len(dims) == 1:
+        return [(i,) for i in range(dims[0])]
+    sub = _boustrophedon(dims[1:])
+    path: list[tuple[int, ...]] = []
+    for a in range(dims[0]):
+        layer = sub if a % 2 == 0 else sub[::-1]
+        path.extend((a,) + c for c in layer)
+    return path
+
+
+def _is_torus_neighbor(a, b, dims) -> bool:
+    diff = 0
+    for x, y, d in zip(a, b, dims):
+        step = min((x - y) % d, (y - x) % d)
+        diff += step
+    return diff == 1
+
+
+def ici_ring_order(topology: Topology) -> list[int] | None:
+    """A CLOSED device cycle walking the ICI torus with neighbor hops only —
+    the physical ring for ring collectives (last→first wraps on the torus).
+
+    The snake path closes into a cycle when the outermost dimension is even
+    (the closing hop (d0-1, start…) → (0, start…) is a torus wrap); every
+    real multi-chip TPU slice shape satisfies this for some axis order, so
+    axis orders are tried until one closes. Returns None when no neighbor
+    cycle exists (odd×odd grids, sparse subslices, unknown coords) — callers
+    keep the logical order.
 
     Analog of the reference's NUMA-aware ring construction
     (cp_engine_producer_all_gather_ring_push_numa_2d, allgather.py:211).
     """
+    import itertools
+
     n = topology.num_devices
-    if not topology.has_ici_torus:
-        return list(range(n))
-    # Sort by a snake walk over coords: even rows left→right, odd right→left,
-    # which makes successive devices physical neighbors on a torus mesh.
-    idx = sorted(range(n), key=lambda i: _snake_key(topology.coords[i]))
-    return idx
+    if not topology.has_ici_torus or n <= 2:
+        return None
+    coords = [tuple(c) for c in topology.coords]
+    ndim = len(coords[0])
+    dims = tuple(max(c[i] for c in coords) + 1 for i in range(ndim))
+    if len(set(coords)) != n or np_prod(dims) != n:
+        return None  # sparse/duplicated subslice — no clean torus
+    index_of = {c: i for i, c in enumerate(coords)}
+    for perm in itertools.permutations(range(ndim)):
+        pdims = tuple(dims[p] for p in perm)
+        path = _boustrophedon(pdims)
+        # Un-permute path coords back to original axis order.
+        unperm = [tuple(c[perm.index(i)] for i in range(ndim)) for c in path]
+        hops = list(zip(unperm, unperm[1:] + unperm[:1]))
+        if all(_is_torus_neighbor(a, b, dims) for a, b in hops):
+            return [index_of[c] for c in unperm]
+    return None
 
 
-def _snake_key(coord):
-    c = tuple(coord)
-    key = []
-    flip = False
-    for axis_val in c[:-1]:
-        key.append(axis_val)
-        flip = (axis_val % 2 == 1) != flip
-    key.append(-c[-1] if flip else c[-1])
-    return tuple(key)
+def np_prod(t) -> int:
+    out = 1
+    for v in t:
+        out *= int(v)
+    return out
