@@ -171,6 +171,68 @@ def quiet(*rdma_handles):
         h.wait_send()
 
 
+def broadcast(src_ref, dst_ref, root, send_sems, recv_sem,
+              axis: str = "tp"):
+    """Root pushes ``src_ref`` into every peer's ``dst_ref``; non-roots wait
+    one delivery (NVSHMEM ``broadcast``; libshmem_device.py broadcast
+    family). Root also copies locally. Call on every rank (SPMD)."""
+    me = my_pe(axis)
+    n = n_pes(axis)
+
+    @pl.when(me == root)
+    def _():
+        local = pltpu.make_async_copy(src_ref, dst_ref, recv_sem)
+        local.start()
+        for i in range(n - 1):
+            peer = jax.lax.rem(root + 1 + i, n)
+            putmem_nbi_block(src_ref, dst_ref, send_sems.at[i], recv_sem,
+                             peer, axis)
+
+    # Everyone (root included, via its local copy) waits one delivery.
+    wait_deliveries(src_ref, recv_sem, 1)
+
+    @pl.when(me == root)
+    def _():
+        for i in range(n - 1):
+            pltpu.make_async_copy(src_ref, src_ref, send_sems.at[i]).wait()
+
+
+def fcollect(src_ref, dst_ref, send_sems, recv_sem, axis: str = "tp"):
+    """AllGather into the symmetric ``dst_ref`` (n·m rows): slot ``me`` on
+    every rank receives rank me's ``src_ref`` (NVSHMEM ``fcollect``).
+
+    The full-mesh push of ops/allgather.py exposed at the SHMEM level so
+    kernels can compose it with their own compute (the pull-style AllGather
+    emulation: NVSHMEM pull = every rank getmem's peers; on push-only ICI
+    the SPMD-equivalent collective is every rank pushing — see ``getmem``
+    note in the module docstring)."""
+    me = my_pe(axis)
+    n = n_pes(axis)
+    m = src_ref.shape[0]
+    my_slot = dst_ref.at[pl.ds(me * m, m)]
+    local = pltpu.make_async_copy(src_ref, my_slot, recv_sem)
+    local.start()
+    handles = []
+    for i in range(n - 1):
+        peer = jax.lax.rem(me + 1 + i, n)
+        handles.append(putmem_nbi_block(src_ref, my_slot, send_sems.at[i],
+                                        recv_sem, peer, axis))
+    quiet(*handles)
+    wait_deliveries(src_ref, recv_sem, n)
+
+
+def getmem_emulated(dst_ref, src_ref, send_sems, recv_sem, axis: str = "tp"):
+    """Pull emulation: NVSHMEM ``getmem`` reads a peer's memory one-sidedly;
+    ICI remote DMA is push-only, so the SPMD-collective equivalent is the
+    transpose — every rank pushes the region its peers would have pulled.
+    This helper implements the common all-pull case (every rank pulls every
+    peer's ``src_ref``) as :func:`fcollect`. For a single-pair pull, invert
+    the direction at the call site: the OWNER calls ``putmem_nbi_block``
+    toward the requester (both ranks run the same kernel, so the rewrite is
+    always possible — reference two-sided note, SURVEY.md §7)."""
+    fcollect(src_ref, dst_ref, send_sems, recv_sem, axis)
+
+
 def wait_deliveries(like_ref, sem, count: int):
     """Wait for ``count`` incoming DMA deliveries on ``sem``, each of the byte
     size of ``like_ref``.

@@ -1,0 +1,88 @@
+"""Pipeline-parallel transport layer — stage-to-stage sends + microbatching.
+
+Reference: ``python/triton_dist/layers/nvidia/p2p.py:30-132`` (``CommOp``
+send/recv over symmetric buffers + signals, PP-group splitting) and the
+microbatch ping-pong of ``test_pp.py:47-120``.
+
+TPU shape: PP stages are positions along a mesh axis; a stage-to-stage send
+is the Pallas ring shift (ops/p2p.py) — every stage sends to ``me+1`` and
+receives from ``me-1`` in the same SPMD kernel, so the send/recv pair of
+the reference collapses into one op. ``PPStream`` adds the microbatch
+schedule: 1F1B-style warmup/steady/drain over ping-pong buffers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.ops.p2p import p2p_shift_local
+
+
+class PPStream:
+    """Device-local PP transport for use inside shard_map over ``axis``.
+
+    send_next(x): push this stage's activation to stage me+1, returning the
+    activation received from stage me-1 (stage 0 receives stage n-1's —
+    callers mask/ignore it, like the reference's ring wraparound).
+    """
+
+    def __init__(self, axis: str = "pp", num_ranks: int | None = None):
+        if num_ranks is None:
+            raise ValueError("num_ranks required inside shard_map")
+        self.axis = axis
+        self.n = num_ranks
+
+    def send_next(self, x: jax.Array) -> jax.Array:
+        if self.n == 1:
+            return x
+        return p2p_shift_local(x, shift=1, axis=self.axis,
+                               num_ranks=self.n)
+
+    def send_prev(self, x: jax.Array) -> jax.Array:
+        if self.n == 1:
+            return x
+        return p2p_shift_local(x, shift=-1, axis=self.axis,
+                               num_ranks=self.n)
+
+
+def pp_pipeline_forward(stage_fn, x_microbatches: jax.Array, *,
+                        axis: str = "pp", num_ranks: int | None = None):
+    """Run microbatches through an n-stage pipeline (device-local).
+
+    stage_fn(mb) — this stage's compute on one microbatch (same signature on
+    every stage; stage identity via jax.lax.axis_index inside if needed).
+    x_microbatches: (num_mb, mb, cols): stage 0's inputs (other stages
+    receive activations; their x is ignored).
+
+    Schedule: num_mb + n - 1 ticks; at tick t stage s computes microbatch
+    t - s (when in range) and ships it onward — the standard GPipe fill/
+    drain, with the Pallas ring shift as the stage boundary. Returns
+    (num_mb, mb, cols): the LAST stage's outputs (other stages return
+    garbage rows — mask at the caller, reference test_pp.py pattern).
+    """
+    if num_ranks is None:
+        raise ValueError("num_ranks required inside shard_map")
+    n = num_ranks
+    stream = PPStream(axis=axis, num_ranks=n)
+    me = jax.lax.axis_index(axis)
+    num_mb, mb, cols = x_microbatches.shape
+    out = jnp.zeros_like(x_microbatches)
+    carry = jnp.zeros((mb, cols), x_microbatches.dtype)
+
+    for t in range(num_mb + n - 1):
+        # Which microbatch does this stage work on at tick t?
+        mb_idx = t - me
+        active = (mb_idx >= 0) & (mb_idx < num_mb)
+        safe_idx = jnp.clip(mb_idx, 0, num_mb - 1)
+        # Stage 0 pulls from its inputs; later stages use the carried recv.
+        x_in = jnp.where(me == 0, x_microbatches[safe_idx], carry)
+        y = stage_fn(x_in)
+        y = jnp.where(active, y, jnp.zeros_like(y))
+        # Last stage records its finished microbatch.
+        out = jnp.where(
+            (me == n - 1) & active,
+            out.at[safe_idx].set(y), out)
+        # Ship to the next stage (ring; stage n-1 → 0 wraps, ignored).
+        carry = stream.send_next(y)
+    return out

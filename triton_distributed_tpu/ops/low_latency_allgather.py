@@ -1,0 +1,96 @@
+"""Low-latency AllGather — decode-shaped small-message gathers.
+
+Reference: ``python/triton_dist/kernels/nvidia/low_latency_allgather.py``
+(987 LoC: pull / push-2d/3d / LL 8-byte flag+data protocol / multimem,
+staged symmetric buffers) and the decode layer
+``layers/nvidia/low_latency_allgather_layer.py:30-120``.
+
+TPU collapse of the method space: ICI has uniform links and DMA-delivered
+semaphores, so the LL flag+data protocol (which exists because separate
+flag writes can pass data writes on NVLink) is unnecessary — a single
+full-mesh push whose recv semaphore IS the flag is already the minimal
+2-hop-free protocol. What remains valuable from the reference design:
+
+- one fused kernel, no barrier-heavy generic path for tiny payloads;
+- the *staged buffer* idea maps to shape-bucketing: decode token counts
+  vary step to step, so ``AllGatherLayer`` pads to a bucket, reusing one
+  compiled executable instead of recompiling per length
+  (reference sp_flash_decode_layer.py:75-77 dynamic buffer shrink).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.ops.allgather import (
+    AllGatherMethod,
+    all_gather_local,
+)
+from triton_distributed_tpu.ops.tiling import sublane_align
+from triton_distributed_tpu.runtime.context import DistContext, get_context
+from triton_distributed_tpu.runtime.jit_cache import cached_shard_jit
+
+
+def fast_allgather_local(x_local: jax.Array, *, axis: str = "tp",
+                         num_ranks: int | None = None) -> jax.Array:
+    """Device-local low-latency AllGather: always the single-hop full-mesh
+    push (latency-optimal; reference ``fast_allgather``)."""
+    return all_gather_local(x_local, axis=axis, num_ranks=num_ranks,
+                            method=AllGatherMethod.FULL_MESH_PUSH)
+
+
+def _bucket(m: int, align: int) -> int:
+    """Smallest power-of-two multiple of ``align`` >= m (bounded recompiles
+    over decode steps)."""
+    b = align
+    while b < m:
+        b *= 2
+    return b
+
+
+class AllGatherLayer:
+    """Decode comm layer: bucketed, cached low-latency AG
+    (reference ``low_latency_allgather_layer.py:30-120`` — staged symmetric
+    buffers + per-stage signals become shape buckets + the jit cache)."""
+
+    def __init__(self, ctx: DistContext | None = None, axis: str = "tp"):
+        self.ctx = ctx or get_context()
+        self.axis = axis
+        self.n = self.ctx.axis_size(axis)
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        """x: (m, cols) sharded rows over ``axis`` globally (m = n·m_local).
+        Returns the gathered (m, cols) replicated. Pads m_local up to a
+        bucket internally; the pad rows never leave the op."""
+        n = self.n
+        m, cols = x.shape
+        m_local = m // n
+        align = sublane_align(x.dtype)
+        bucket = _bucket(max(m_local, 1), align)
+        key = (self.axis, bucket, cols, str(x.dtype))
+
+        def make():
+            fn = functools.partial(fast_allgather_local, axis=self.axis,
+                                   num_ranks=n)
+
+            def padded(xl):
+                pad = bucket - xl.shape[0]
+                xp = jnp.pad(xl, ((0, pad), (0, 0)))
+                return fn(xp).reshape(n, bucket, cols)
+
+            return padded
+
+        jfn = cached_shard_jit(self.ctx, "ll_allgather", key, make,
+                               P(self.axis), P(None), ici_axes=(self.axis,))
+        out = jfn(x)  # (n, bucket, cols) replicated
+        return out[:, :m_local].reshape(m, cols)
+
+
+def fast_allgather(x: jax.Array, ctx: DistContext | None = None,
+                   axis: str = "tp") -> jax.Array:
+    """One-shot host-level low-latency AllGather (layer-less convenience)."""
+    return AllGatherLayer(ctx, axis)(x)
