@@ -152,3 +152,73 @@ def test_symm_buffers(ctx):
     # one shard per device
     assert len(buf.addressable_shards) == 8
     assert buf.addressable_shards[0].data.shape == (1, 64, 128)
+
+
+def test_broadcast(ctx):
+    """Root pushes its block to every rank (NVSHMEM broadcast analog)."""
+    root = 2
+
+    def kernel(in_ref, out_ref, send_sems, recv_sem):
+        shmem.broadcast(in_ref, out_ref, root, send_sems, recv_sem, axis="tp")
+
+    def f(x):
+        return kernel_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            in_specs=[any_spec()],
+            out_specs=any_spec(),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((7,)),
+                            pltpu.SemaphoreType.DMA(())],
+        )(x)
+
+    x = jnp.arange(8 * 2 * 128, dtype=jnp.float32).reshape(8, 2, 128)
+    out = shard_map_on(ctx, f, in_specs=(P("tp"),), out_specs=P("tp"))(x)
+    out = np.asarray(out).reshape(8, 2, 128)
+    for r in range(8):
+        np.testing.assert_array_equal(out[r], np.asarray(x)[root])
+
+
+def test_fcollect(ctx):
+    """SHMEM-level all-gather into the symmetric destination (fcollect)."""
+
+    def kernel(in_ref, out_ref, send_sems, recv_sem):
+        shmem.fcollect(in_ref, out_ref, send_sems, recv_sem, axis="tp")
+
+    def f(x):
+        return kernel_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((8 * x.shape[0], x.shape[1]), x.dtype),
+            in_specs=[any_spec()],
+            out_specs=any_spec(),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((7,)),
+                            pltpu.SemaphoreType.DMA(())],
+        )(x)
+
+    x = jnp.arange(8 * 2 * 128, dtype=jnp.float32).reshape(8 * 2, 128)
+    out = shard_map_on(ctx, f, in_specs=(P("tp"),), out_specs=P("tp"))(x)
+    out = np.asarray(out).reshape(8, 16, 128)
+    for r in range(8):
+        np.testing.assert_array_equal(out[r], np.asarray(x))
+
+
+def test_getmem_emulated(ctx):
+    """Pull-emulation entry point delegates to fcollect (two-sided rewrite)."""
+
+    def kernel(in_ref, out_ref, send_sems, recv_sem):
+        shmem.getmem_emulated(out_ref, in_ref, send_sems, recv_sem, axis="tp")
+
+    def f(x):
+        return kernel_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((8 * x.shape[0], x.shape[1]), x.dtype),
+            in_specs=[any_spec()],
+            out_specs=any_spec(),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((7,)),
+                            pltpu.SemaphoreType.DMA(())],
+        )(x)
+
+    x = jnp.arange(8 * 128, dtype=jnp.float32).reshape(8, 128)
+    out = shard_map_on(ctx, f, in_specs=(P("tp"),), out_specs=P("tp"))(x)
+    out = np.asarray(out).reshape(8, 8, 128)
+    for r in range(8):
+        np.testing.assert_array_equal(out[r], np.asarray(x))

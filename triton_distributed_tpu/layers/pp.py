@@ -83,6 +83,9 @@ def pp_pipeline_forward(stage_fn, x_microbatches: jax.Array, *,
         out = jnp.where(
             (me == n - 1) & active,
             out.at[safe_idx].set(y), out)
-        # Ship to the next stage (ring; stage n-1 → 0 wraps, ignored).
-        carry = stream.send_next(y)
+        # Ship to the next stage (ring; stage n-1 → 0 wraps, ignored). The
+        # final tick's carry is never read — skip that shift (and its
+        # cross-stage barrier) entirely.
+        if t < num_mb + n - 2:
+            carry = stream.send_next(y)
     return out

@@ -45,11 +45,20 @@ class AllGatherMethod(enum.Enum):
 
 
 def get_auto_all_gather_method(nbytes: int, num_ranks: int) -> AllGatherMethod:
-    """Topology/size-based auto-selection (reference allgather.py:57
-    ``get_auto_all_gather_method``): small payloads favor the single-hop
-    full-mesh push (latency-bound), large payloads the ring (which never
-    oversubscribes a link)."""
-    if nbytes <= 256 * 1024 or num_ranks <= 2:
+    """Perf-model auto-selection (reference allgather.py:57
+    ``get_auto_all_gather_method`` picks by NVLink topology probes): small
+    payloads favor the single-hop full-mesh push (latency-bound), large
+    payloads the ring (which never oversubscribes a link). The crossover is
+    computed from the ICI cost models instead of a hard-coded threshold."""
+    if num_ranks <= 2:
+        return AllGatherMethod.FULL_MESH_PUSH
+    from triton_distributed_tpu.runtime.perf_model import (
+        allgather_full_mesh_time_s,
+        allgather_ring_time_s,
+    )
+
+    if (allgather_full_mesh_time_s(nbytes, num_ranks)
+            <= allgather_ring_time_s(nbytes, num_ranks)):
         return AllGatherMethod.FULL_MESH_PUSH
     return AllGatherMethod.RING_1D
 
@@ -139,7 +148,9 @@ def all_gather_local(x_local: jax.Array, axis: str = "tp", num_ranks: int | None
         raise ValueError("num_ranks required inside shard_map")
     n = num_ranks
     if method == AllGatherMethod.AUTO:
-        method = get_auto_all_gather_method(x_local.size * x_local.dtype.itemsize, n)
+        # The model's contract is the GLOBAL gathered payload, not the shard.
+        method = get_auto_all_gather_method(
+            x_local.size * x_local.dtype.itemsize * n, n)
     if method == AllGatherMethod.XLA:
         return jax.lax.all_gather(x_local, axis, tiled=True)
     m, cols = x_local.shape

@@ -44,9 +44,16 @@ class AllReduceMethod(enum.Enum):
 
 
 def get_auto_allreduce_method(nbytes: int, num_ranks: int) -> AllReduceMethod:
-    """Size-based selection (reference get_auto_allreduce_method,
-    allreduce.py:1101)."""
-    if nbytes <= 128 * 1024 or num_ranks <= 2:
+    """Perf-model selection (reference get_auto_allreduce_method,
+    allreduce.py:1101 picks by size/NVLS support): one-shot wins when the
+    payload is latency-bound, two-shot (RS+AG) when bandwidth-bound. The
+    crossover comes from the ICI cost models in runtime/perf_model.py."""
+    if num_ranks <= 2:
+        return AllReduceMethod.ONE_SHOT
+    from triton_distributed_tpu.runtime.perf_model import allreduce_time_s
+
+    if (allreduce_time_s(nbytes, num_ranks, "one_shot")
+            <= allreduce_time_s(nbytes, num_ranks, "two_shot")):
         return AllReduceMethod.ONE_SHOT
     return AllReduceMethod.TWO_SHOT
 

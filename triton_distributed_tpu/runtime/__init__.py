@@ -18,6 +18,20 @@ from triton_distributed_tpu.runtime.symm import (  # noqa: F401
     symm_full,
     SymmetricWorkspace,
 )
+from triton_distributed_tpu.runtime.perf_model import (  # noqa: F401
+    ChipSpec,
+    chip_spec,
+    gemm_time_s,
+    gemm_tflops,
+    allgather_ring_time_s,
+    allgather_full_mesh_time_s,
+    reduce_scatter_ring_time_s,
+    allreduce_time_s,
+    alltoall_time_s,
+    ag_gemm_time_s,
+    gemm_rs_time_s,
+    rank_gemm_tiles,
+)
 from triton_distributed_tpu.runtime.utils import (  # noqa: F401
     dist_print,
     perf_func,

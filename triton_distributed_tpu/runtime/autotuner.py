@@ -162,13 +162,20 @@ def tune_ag_gemm(a: jax.Array, b: jax.Array, ctx=None, axis: str = "tp"):
     from triton_distributed_tpu.ops.allgather_gemm import AGGemmConfig, ag_gemm
     from triton_distributed_tpu.runtime.context import get_context
 
+    from triton_distributed_tpu.runtime.perf_model import rank_gemm_tiles
+
     ctx = ctx or get_context()
     n = ctx.axis_size(axis)
     m_local = a.shape[0] // n
     key = (tuple(a.shape), tuple(b.shape), str(a.dtype), n)
+    # Perf-model pruning (reference prunes its config lists with
+    # gemm_perf_model estimates): rank by modeled time, measure the top 8.
+    tiles = rank_gemm_tiles(
+        gemm_tile_candidates(m_local, a.shape[1], b.shape[1] // n,
+                             a.dtype.itemsize),
+        a.shape[0], b.shape[1] // n, a.shape[1], a.dtype.itemsize, top=8)
     cands = [AGGemmConfig(tile_m=tm, tile_n=tn, tile_k=tk)
-             for tm, tn, tk in gemm_tile_candidates(
-                 m_local, a.shape[1], b.shape[1] // n, a.dtype.itemsize)]
+             for tm, tn, tk in tiles]
 
     def build(cfg):
         return lambda x, w: ag_gemm(x, w, ctx, axis=axis, cfg=cfg)
