@@ -1,0 +1,57 @@
+"""Shared native-extension build/load helper (g++ + ctypes).
+
+The reference ships its native components as prebuilt CMake/pybind targets
+(csrc/, shmem/, tools/runtime). Here each native component is a single .cc
+compiled on first use with the toolchain g++ into a content-addressed .so
+under ``TDTPU_NATIVE_CACHE``; every caller keeps a pure-Python fallback so a
+toolchain-free environment still works (no pybind11 in this image — the C
+ABI + ctypes is the binding layer).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+
+_loaded: dict[str, ctypes.CDLL | None] = {}
+
+
+def native_cache_dir() -> str:
+    d = os.environ.get(
+        "TDTPU_NATIVE_CACHE",
+        os.path.expanduser("~/.cache/triton_distributed_tpu/native"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def load_native_lib(src_path: str, name: str) -> ctypes.CDLL | None:
+    """Compile ``src_path`` (cached by source hash) and dlopen it.
+
+    Returns None if the toolchain is unavailable or compilation fails —
+    callers must degrade to their Python fallback. Failures are cached so a
+    broken toolchain costs one attempt per process.
+    """
+    if name in _loaded:
+        return _loaded[name]
+    lib = None
+    try:
+        with open(src_path, "rb") as f:
+            src = f.read()
+        tag = hashlib.sha256(src).hexdigest()[:16]
+        so_path = os.path.join(native_cache_dir(), f"{name}_{tag}.so")
+        if not os.path.exists(so_path):
+            with tempfile.TemporaryDirectory() as td:
+                tmp = os.path.join(td, f"{name}.so")
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                     src_path, "-o", tmp],
+                    check=True, capture_output=True)
+                os.replace(tmp, so_path)
+        lib = ctypes.CDLL(so_path)
+    except Exception:
+        lib = None
+    _loaded[name] = lib
+    return lib

@@ -1,0 +1,331 @@
+"""AOT compilation + dispatch (L11 analog of the reference tools/).
+
+Reference: ``python/triton_dist/tools/compile_aot.py`` — the
+``aot_compile_spaces`` decorator records grid/signature/algo-info spaces for
+a kernel, an offline step compiles every combination to cubins + C sources,
+and the C++ runtime (``tools/runtime/triton_aot_runtime.cc``) loads them and
+dispatches by runtime args. Used in production for the distributed
+flash-decode kernels (scripts/aot_kernels.txt).
+
+TPU-native redesign:
+- the "compile" step is ``jax.jit(fn).lower(*specs).compile()`` — XLA is the
+  AOT compiler; artifacts are serialized with ``jax.export`` when the
+  lowering supports it (plain XLA/Mosaic programs do; interpret-mode Pallas
+  host callbacks do not, those entries stay process-local);
+- the per-call dispatch decision (exact signature lookup, or bucketed
+  selection of the smallest precompiled M >= runtime M — the flash-decode
+  pattern) runs in the native registry (native/aot_registry.cc) through
+  ctypes, with a Python dict fallback;
+- artifacts + manifest live in a directory, reloadable in a fresh process
+  without the original Python function (``AOTFunction.load``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import functools
+import json
+import os
+from typing import Any, Callable, Sequence
+
+import jax
+
+_NATIVE_SRC = os.path.join(os.path.dirname(__file__), "native",
+                           "aot_registry.cc")
+
+
+# ---------------------------------------------------------------------------
+# Dispatch registry: native (C++) with Python fallback.
+# ---------------------------------------------------------------------------
+
+class _Registry:
+    """Exact + bucketed signature dispatch, native-backed when possible."""
+
+    def __init__(self):
+        self._lib = self._load()
+        if self._lib is not None:
+            self._h = self._lib.tdtpu_aot_create()
+        else:
+            self._exact: dict[str, int] = {}
+            self._buckets: dict[str, list[tuple[int, int]]] = {}
+
+    @staticmethod
+    def _load():
+        from triton_distributed_tpu.runtime.native import load_native_lib
+
+        lib = load_native_lib(_NATIVE_SRC, "aot_registry")
+        if lib is None:
+            return None
+        lib.tdtpu_aot_create.restype = ctypes.c_int
+        lib.tdtpu_aot_register_exact.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+        lib.tdtpu_aot_register_bucket.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_long, ctypes.c_int]
+        lib.tdtpu_aot_lookup.restype = ctypes.c_int
+        lib.tdtpu_aot_lookup.argtypes = [ctypes.c_int, ctypes.c_char_p]
+        lib.tdtpu_aot_select_bucket.restype = ctypes.c_int
+        lib.tdtpu_aot_select_bucket.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_long]
+        lib.tdtpu_aot_size.restype = ctypes.c_int
+        lib.tdtpu_aot_size.argtypes = [ctypes.c_int]
+        return lib
+
+    @property
+    def is_native(self) -> bool:
+        return self._lib is not None
+
+    def register_exact(self, sig: str, index: int) -> None:
+        if self._lib is not None:
+            self._lib.tdtpu_aot_register_exact(self._h, sig.encode(), index)
+        else:
+            self._exact[sig] = index
+
+    def register_bucket(self, family: str, bucket: int, index: int) -> None:
+        if self._lib is not None:
+            self._lib.tdtpu_aot_register_bucket(
+                self._h, family.encode(), bucket, index)
+        else:
+            self._buckets.setdefault(family, []).append((bucket, index))
+            self._buckets[family].sort()
+
+    def lookup(self, sig: str) -> int:
+        if self._lib is not None:
+            return self._lib.tdtpu_aot_lookup(self._h, sig.encode())
+        return self._exact.get(sig, -1)
+
+    def select_bucket(self, family: str, m: int) -> int:
+        if self._lib is not None:
+            return self._lib.tdtpu_aot_select_bucket(self._h, family.encode(), m)
+        for bucket, index in self._buckets.get(family, []):
+            if bucket >= m:
+                return index
+        return -1
+
+    def size(self) -> int:
+        if self._lib is not None:
+            return self._lib.tdtpu_aot_size(self._h)
+        return len(self._exact) + sum(len(v) for v in self._buckets.values())
+
+
+# ---------------------------------------------------------------------------
+# Signatures.
+# ---------------------------------------------------------------------------
+
+def _dt(x) -> str:
+    return jax.numpy.dtype(x.dtype).name
+
+
+def signature_key(args: Sequence[Any], static: Any = None) -> str:
+    """Canonical signature string, e.g. ``f32[128,64];bf16[64]|{...}``."""
+    parts = [f"{_dt(a)}[{','.join(str(d) for d in a.shape)}]" for a in args]
+    key = ";".join(parts)
+    if static:
+        key += "|" + json.dumps(static, sort_keys=True, default=str)
+    return key
+
+
+def _family_key(args: Sequence[Any], bucket_arg: int, bucket_dim: int,
+                static: Any = None) -> str:
+    """Signature with the bucketed dim wildcarded (the dispatch family)."""
+    parts = []
+    for i, a in enumerate(args):
+        dims = [("*" if i == bucket_arg and d == bucket_dim else str(s))
+                for d, s in enumerate(a.shape)]
+        parts.append(f"{_dt(a)}[{','.join(dims)}]")
+    key = ";".join(parts)
+    if static:
+        key += "|" + json.dumps(static, sort_keys=True, default=str)
+    return key
+
+
+@dataclasses.dataclass
+class _Entry:
+    key: str
+    compiled: Any           # callable: the compiled executable (or exported.call)
+    serialized: bytes | None
+    args_spec: tuple
+    static_kwargs: dict
+    family: str | None = None
+    bucket: int | None = None
+
+
+# ---------------------------------------------------------------------------
+# AOTFunction.
+# ---------------------------------------------------------------------------
+
+class AOTFunction:
+    """A function with an ahead-of-time compiled signature space.
+
+    ``precompile`` compiles one signature (optionally registered as an M
+    bucket); ``__call__`` dispatches: exact signature -> compiled executable,
+    else bucket family (caller pads to ``entry.bucket`` via
+    :meth:`select_bucket`), else JIT fallback when allowed.
+    """
+
+    def __init__(self, fn: Callable | None, name: str,
+                 allow_jit_fallback: bool = False):
+        self.fn = fn
+        self.name = name
+        self.allow_jit_fallback = allow_jit_fallback
+        self.entries: list[_Entry] = []
+        self.registry = _Registry()
+
+    # -- compilation -------------------------------------------------------
+
+    def precompile(self, *args_spec, static_kwargs: dict | None = None,
+                   bucket: tuple[int, int] | None = None) -> _Entry:
+        """AOT-compile ``fn`` for ``args_spec`` (ShapeDtypeStructs).
+
+        ``bucket=(arg_index, dim)`` additionally registers the entry for
+        bucketed dispatch on that dimension (its compiled size is the bucket
+        capacity). Serialization is attempted (jax.export); entries whose
+        lowering can't serialize (interpret-mode callbacks) stay
+        process-local, like the reference's JIT-only kernels.
+        """
+        if self.fn is None:
+            raise ValueError("AOTFunction loaded without fn cannot compile")
+        static_kwargs = dict(static_kwargs or {})
+        base = (functools.partial(self.fn, **static_kwargs)
+                if static_kwargs else self.fn)
+        jitted = jax.jit(base)
+        key = signature_key(args_spec, static_kwargs or None)
+        serialized = None
+        try:
+            exported = jax.export.export(jitted)(*args_spec)
+            serialized = exported.serialize()
+            compiled = exported.call
+        except Exception:
+            compiled = jitted.lower(*args_spec).compile()
+        entry = _Entry(key, compiled, serialized, tuple(args_spec),
+                       static_kwargs)
+        index = len(self.entries)
+        self.entries.append(entry)
+        self.registry.register_exact(key, index)
+        if bucket is not None:
+            arg_i, dim_i = bucket
+            entry.family = _family_key(args_spec, arg_i, dim_i,
+                                       static_kwargs or None)
+            entry.bucket = int(args_spec[arg_i].shape[dim_i])
+            self.registry.register_bucket(entry.family, entry.bucket, index)
+        return entry
+
+    # -- dispatch ----------------------------------------------------------
+
+    def lookup(self, *args, static_kwargs: dict | None = None) -> _Entry | None:
+        idx = self.registry.lookup(
+            signature_key(args, dict(static_kwargs or {}) or None))
+        return self.entries[idx] if idx >= 0 else None
+
+    def select_bucket(self, *args, bucket: tuple[int, int],
+                      static_kwargs: dict | None = None) -> _Entry | None:
+        """Bucketed dispatch: the entry whose capacity fits args' dim
+        (reference flash-decode AOT: pick the kernel compiled for the
+        smallest MAX_M >= runtime M; caller pads the input to
+        ``entry.args_spec`` and slices the result)."""
+        arg_i, dim_i = bucket
+        family = _family_key(args, arg_i, dim_i,
+                             dict(static_kwargs or {}) or None)
+        idx = self.registry.select_bucket(family, int(args[arg_i].shape[dim_i]))
+        return self.entries[idx] if idx >= 0 else None
+
+    def __call__(self, *args, **kwargs):
+        entry = self.lookup(*args, static_kwargs=kwargs or None)
+        if entry is not None:
+            return entry.compiled(*args)
+        if self.allow_jit_fallback and self.fn is not None:
+            return jax.jit(functools.partial(self.fn, **kwargs))(*args) \
+                if kwargs else jax.jit(self.fn)(*args)
+        raise KeyError(
+            f"AOT {self.name}: no compiled entry for "
+            f"{signature_key(args, kwargs or None)} "
+            f"({len(self.entries)} entries); precompile it or enable "
+            "allow_jit_fallback")
+
+    # -- persistence -------------------------------------------------------
+
+    def save(self, directory: str) -> int:
+        """Write manifest + serialized artifacts; returns #saved artifacts.
+
+        Process-local (unserializable) entries are listed in the manifest
+        with ``artifact: null`` — a fresh process must recompile those from
+        the original function.
+        """
+        os.makedirs(directory, exist_ok=True)
+        manifest = {"name": self.name, "entries": []}
+        n_saved = 0
+        for i, e in enumerate(self.entries):
+            artifact = None
+            if e.serialized is not None:
+                artifact = f"{self.name}_{i}.stablehlo"
+                with open(os.path.join(directory, artifact), "wb") as f:
+                    f.write(e.serialized)
+                n_saved += 1
+            manifest["entries"].append({
+                "key": e.key, "artifact": artifact, "family": e.family,
+                "bucket": e.bucket,
+                "args": [[_dt(a), list(a.shape)] for a in e.args_spec],
+                "static_kwargs": e.static_kwargs,
+            })
+        with open(os.path.join(directory, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+        return n_saved
+
+    @classmethod
+    def load(cls, directory: str, fn: Callable | None = None,
+             allow_jit_fallback: bool = False) -> "AOTFunction":
+        """Rehydrate from a manifest dir; serialized entries need no fn."""
+        with open(os.path.join(directory, "manifest.json")) as f:
+            manifest = json.load(f)
+        self = cls(fn, manifest["name"], allow_jit_fallback)
+        for rec in manifest["entries"]:
+            if rec["artifact"] is None:
+                if fn is None:
+                    continue  # unserializable and no fn — skip
+                spec = tuple(jax.ShapeDtypeStruct(tuple(s), d)
+                             for d, s in rec["args"])
+                self.precompile(
+                    *spec, static_kwargs=rec["static_kwargs"] or None,
+                    bucket=None)
+            else:
+                with open(os.path.join(directory, rec["artifact"]), "rb") as f:
+                    exported = jax.export.deserialize(f.read())
+                entry = _Entry(
+                    rec["key"], exported.call, None,
+                    tuple(jax.ShapeDtypeStruct(tuple(s), d)
+                          for d, s in rec["args"]),
+                    rec["static_kwargs"] or {})
+                self.entries.append(entry)
+                self.registry.register_exact(entry.key, len(self.entries) - 1)
+            index = len(self.entries) - 1
+            entry = self.entries[index]
+            entry.family, entry.bucket = rec["family"], rec["bucket"]
+            if entry.family is not None:
+                self.registry.register_bucket(entry.family, entry.bucket,
+                                              index)
+        return self
+
+
+def aot_compile_spaces(signatures: Sequence[dict], name: str | None = None,
+                       allow_jit_fallback: bool = True):
+    """Decorator analog of the reference ``aot_compile_spaces``
+    (compile_aot.py:61): each signature dict has ``args`` (a tuple of
+    ShapeDtypeStructs), optional ``static_kwargs`` and ``bucket``. The
+    decorated function becomes an :class:`AOTFunction`; call ``.build()``
+    to compile the whole space (the offline `gen_aot_code.sh` step)."""
+
+    def deco(fn: Callable) -> AOTFunction:
+        af = AOTFunction(fn, name or fn.__name__, allow_jit_fallback)
+        af.spaces = list(signatures)
+
+        def build():
+            for sig in af.spaces:
+                af.precompile(*sig["args"],
+                              static_kwargs=sig.get("static_kwargs"),
+                              bucket=sig.get("bucket"))
+            return af
+
+        af.build = build
+        return af
+
+    return deco

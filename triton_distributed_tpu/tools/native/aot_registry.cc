@@ -1,0 +1,92 @@
+// Native AOT dispatch registry — the TPU analog of the reference's C++ AOT
+// runtime (tools/runtime/triton_aot_runtime.cc: cubin load table, algo-info
+// structs, kernel dispatch by runtime args).
+//
+// On TPU the executable artifacts are XLA/StableHLO programs owned by the
+// Python side (jax.export / in-memory compiled executables); what stays
+// native is the hot dispatch decision made per call:
+//   - exact-signature lookup (signature string -> artifact index), and
+//   - bucketed dispatch by a runtime dimension (family string + runtime M
+//     -> the artifact compiled for the smallest bucket >= M),
+// mirroring triton_aot_runtime.cc's algo_info selection by runtime args.
+//
+// Compiled with g++ -O2 -shared -fPIC at first use (see tools/aot.py), with
+// a pure-Python fallback for toolchain-free environments.
+
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Registry {
+  // signature string -> artifact index (exact dispatch)
+  std::map<std::string, int> exact;
+  // family string -> sorted (bucket, artifact index) (bucketed dispatch)
+  std::map<std::string, std::map<long, int>> buckets;
+};
+
+std::vector<Registry*> g_registries;
+
+}  // namespace
+
+extern "C" {
+
+int tdtpu_aot_create() {
+  g_registries.push_back(new Registry());
+  return static_cast<int>(g_registries.size()) - 1;
+}
+
+void tdtpu_aot_destroy(int h) {
+  if (h < 0 || h >= static_cast<int>(g_registries.size())) return;
+  delete g_registries[h];
+  g_registries[h] = nullptr;
+}
+
+int tdtpu_aot_register_exact(int h, const char* sig, int index) {
+  if (h < 0 || h >= static_cast<int>(g_registries.size()) || !g_registries[h])
+    return -1;
+  g_registries[h]->exact[sig] = index;
+  return 0;
+}
+
+int tdtpu_aot_register_bucket(int h, const char* family, long bucket,
+                              int index) {
+  if (h < 0 || h >= static_cast<int>(g_registries.size()) || !g_registries[h])
+    return -1;
+  g_registries[h]->buckets[family][bucket] = index;
+  return 0;
+}
+
+// Exact-signature lookup; -1 when absent.
+int tdtpu_aot_lookup(int h, const char* sig) {
+  if (h < 0 || h >= static_cast<int>(g_registries.size()) || !g_registries[h])
+    return -1;
+  auto& m = g_registries[h]->exact;
+  auto it = m.find(sig);
+  return it == m.end() ? -1 : it->second;
+}
+
+// Bucketed dispatch: artifact of the smallest bucket >= m; -1 when no
+// bucket fits (caller falls back to JIT or errors).
+int tdtpu_aot_select_bucket(int h, const char* family, long m) {
+  if (h < 0 || h >= static_cast<int>(g_registries.size()) || !g_registries[h])
+    return -1;
+  auto& fam = g_registries[h]->buckets;
+  auto fit = fam.find(family);
+  if (fit == fam.end()) return -1;
+  auto it = fit->second.lower_bound(m);
+  return it == fit->second.end() ? -1 : it->second;
+}
+
+int tdtpu_aot_size(int h) {
+  if (h < 0 || h >= static_cast<int>(g_registries.size()) || !g_registries[h])
+    return -1;
+  int n = static_cast<int>(g_registries[h]->exact.size());
+  for (auto& kv : g_registries[h]->buckets)
+    n += static_cast<int>(kv.second.size());
+  return n;
+}
+
+}  // extern "C"
