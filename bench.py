@@ -58,22 +58,37 @@ def _chain(matmul, a, b, n):
     return jnp.sum(out.astype(jnp.float32))
 
 
-def _timed(fn, a, b, n, trials):
-    best = float("inf")
+def _timed_once(fn, a, b, n):
+    t0 = time.perf_counter()
     out = fn(a, b, n)
     _ = np.asarray(out)  # host fetch forces completion through the relay
-    for _i in range(trials):
-        t0 = time.perf_counter()
-        out = fn(a, b, n)
-        _ = np.asarray(out)
-        best = min(best, time.perf_counter() - t0)
-    return best
+    return time.perf_counter() - t0
 
 
-def _per_iter_seconds(fn, a, b, lengths, flops, trials=3, strict=True):
+def _timed_interleaved(fns, a, b, lengths, trials):
+    """best-of-``trials`` per (fn, length), with all candidates interleaved
+    round-robin inside every trial round.
+
+    The shared chip's clock drifts by ±15% over tens of seconds; timing one
+    candidate to completion then the next bakes that drift into the
+    vs_baseline ratio. Interleaving means each round compares candidates
+    under the same chip conditions, and min-per-cell discards slow rounds.
+    """
+    best = {(i, n): float("inf") for i in range(len(fns)) for n in lengths}
+    for i, fn in enumerate(fns):  # warmup / compile
+        for n in lengths:
+            _timed_once(fn, a, b, n)
+    for _t in range(trials):
+        for i, fn in enumerate(fns):
+            for n in lengths:
+                best[(i, n)] = min(best[(i, n)], _timed_once(fn, a, b, n))
+    return [[best[(i, n)] for n in lengths] for i in range(len(fns))]
+
+
+def _per_iter_seconds(times, lengths, flops, strict=True):
     """Differential per-iteration time over three chain lengths, fail-loud."""
     n1, n2, n3 = lengths
-    t1, t2, t3 = (_timed(fn, a, b, n, trials) for n in (n1, n2, n3))
+    t1, t2, t3 = times
     if strict and not (t3 > t2 > t1):
         raise BenchError(
             f"non-monotone timings: t({n1})={t1:.6f} t({n2})={t2:.6f} "
@@ -97,6 +112,21 @@ def _per_iter_seconds(fn, a, b, lengths, flops, trials=3, strict=True):
 
 
 def main():
+    # The sandbox's remote-compile helper 500s intermittently and the shared
+    # chip occasionally produces a non-monotone round; both are transient.
+    # Retry the whole measurement rather than reporting nothing.
+    last = None
+    for attempt in range(4):
+        try:
+            return _measure_and_report()
+        except Exception as e:  # BenchError or transient compile failure
+            last = e
+            print(f"# bench attempt {attempt} failed: {e}", file=sys.stderr)
+            time.sleep(5)
+    raise last
+
+
+def _measure_and_report():
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
         # Qwen3-32B TP=8 prefill-ish GEMM: (M=2048, K=5120) @ (5120, 5120).
@@ -122,8 +152,10 @@ def main():
     pallas_fn = jax.jit(functools.partial(_chain, pallas_matmul), static_argnums=2)
 
     flops = 2.0 * M * K * K
-    t_xla = _per_iter_seconds(xla_fn, a, b, lengths, flops, strict=strict)
-    t_pallas = _per_iter_seconds(pallas_fn, a, b, lengths, flops, strict=strict)
+    times_xla, times_pallas = _timed_interleaved(
+        [xla_fn, pallas_fn], a, b, lengths, trials=3 if on_tpu else 1)
+    t_xla = _per_iter_seconds(times_xla, lengths, flops, strict=strict)
+    t_pallas = _per_iter_seconds(times_pallas, lengths, flops, strict=strict)
 
     print(json.dumps({
         "metric": "pallas_gemm_tflops_qwen3_tp8_shape",

@@ -1,0 +1,110 @@
+"""AOT compile + dispatch tests (L11 analog; reference
+test/nvidia/test_compile_aot.py pattern: compile a space offline, dispatch by
+runtime signature, golden-check results)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_distributed_tpu.tools.aot import (
+    AOTFunction, aot_compile_spaces, signature_key,
+)
+
+
+def _scale(x, *, factor=2.0):
+    return x * factor
+
+
+def test_precompile_exact_dispatch():
+    af = AOTFunction(_scale, "scale")
+    spec = jax.ShapeDtypeStruct((8, 16), jnp.float32)
+    af.precompile(spec)
+    x = jnp.ones((8, 16), jnp.float32)
+    np.testing.assert_allclose(np.asarray(af(x)), 2.0 * np.ones((8, 16)))
+    # Unknown signature without fallback raises.
+    with pytest.raises(KeyError):
+        af(jnp.ones((4, 4), jnp.float32))
+
+
+def test_jit_fallback_cached():
+    af = AOTFunction(_scale, "scale", allow_jit_fallback=True)
+    x = jnp.ones((4, 4), jnp.float32)
+    np.testing.assert_allclose(np.asarray(af(x, factor=3.0)), 3.0)
+    assert len(af._jit_fallbacks) == 1
+    np.testing.assert_allclose(np.asarray(af(x, factor=3.0)), 3.0)
+    assert len(af._jit_fallbacks) == 1  # reused, not rebuilt
+
+
+def test_bucket_dispatch():
+    """Flash-decode pattern: pick the smallest compiled M >= runtime M."""
+    af = AOTFunction(_scale, "scale")
+    for m in (128, 512):
+        af.precompile(jax.ShapeDtypeStruct((m, 16), jnp.float32),
+                      bucket=(0, 0))
+    probe = jnp.ones((200, 16), jnp.float32)
+    entry = af.select_bucket(probe, bucket=(0, 0))
+    assert entry is not None and entry.bucket == 512
+    padded = jnp.zeros((entry.bucket, 16), jnp.float32).at[:200].set(probe)
+    out = entry.compiled(padded)[:200]
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    # Larger than every bucket -> no entry.
+    assert af.select_bucket(jnp.ones((1024, 16), jnp.float32),
+                            bucket=(0, 0)) is None
+
+
+def test_save_load_roundtrip(tmp_path):
+    af = AOTFunction(_scale, "scale")
+    spec = jax.ShapeDtypeStruct((8, 16), jnp.float32)
+    af.precompile(spec, static_kwargs={"factor": 4.0})
+    n = af.save(str(tmp_path))
+    assert n == 1  # XLA-only fn serializes via jax.export on every backend
+    loaded = AOTFunction.load(str(tmp_path), fn=_scale)
+    x = jnp.ones((8, 16), jnp.float32)
+    np.testing.assert_allclose(np.asarray(loaded(x, factor=4.0)), 4.0)
+
+
+def test_save_manifest_with_dtype_static_kwarg(tmp_path):
+    """Regression: non-JSON static kwargs that signature_key accepts must not
+    crash save() (it now uses the same default=str encoding)."""
+
+    def cast(x, *, dtype=jnp.float32):
+        return x.astype(dtype)
+
+    af = AOTFunction(cast, "cast")
+    af.precompile(jax.ShapeDtypeStruct((8, 16), jnp.float32),
+                  static_kwargs={"dtype": jnp.bfloat16})
+    af.save(str(tmp_path))
+    assert (tmp_path / "manifest.json").exists()
+    # The coerced-to-string kwargs must never be recompiled into fn: a
+    # serialized artifact reloads fine, but a hypothetical process-local
+    # entry would be skipped (static_kwargs_portable=False in the manifest).
+    import json
+    manifest = json.loads((tmp_path / "manifest.json").read_text())
+    assert manifest["entries"][0]["static_kwargs_portable"] is False
+    loaded = AOTFunction.load(str(tmp_path), fn=cast)
+    x = jnp.ones((8, 16), jnp.float32)
+    if loaded.entries:  # reloaded from the serialized artifact
+        out = loaded(x, dtype=jnp.bfloat16)
+        assert out.dtype == jnp.bfloat16
+
+
+def test_aot_compile_spaces_decorator():
+    @aot_compile_spaces([
+        {"args": (jax.ShapeDtypeStruct((8, 8), jnp.float32),)},
+        {"args": (jax.ShapeDtypeStruct((16, 8), jnp.float32),),
+         "bucket": (0, 0)},
+    ], name="scale_space")
+    def scale(x, *, factor=2.0):
+        return x * factor
+
+    af = scale.build()
+    assert af.registry.size() >= 2
+    np.testing.assert_allclose(
+        np.asarray(af(jnp.ones((8, 8), jnp.float32))), 2.0)
+
+
+def test_signature_key_stable():
+    a = jnp.ones((8, 16), jnp.bfloat16)
+    assert signature_key([a]) == "bfloat16[8,16]"
+    assert signature_key([a], {"z": 1}) != signature_key([a])

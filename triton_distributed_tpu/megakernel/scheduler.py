@@ -10,54 +10,31 @@ working.
 from __future__ import annotations
 
 import ctypes
-import hashlib
 import os
-import subprocess
-import tempfile
 
 import numpy as np
 
+from triton_distributed_tpu.runtime.native import load_native_lib
+
 _SRC = os.path.join(os.path.dirname(__file__), "native", "scheduler.cc")
 _lib = None
-_lib_failed = False
-
-
-def _cache_dir() -> str:
-    d = os.environ.get(
-        "TDTPU_NATIVE_CACHE",
-        os.path.expanduser("~/.cache/triton_distributed_tpu/native"))
-    os.makedirs(d, exist_ok=True)
-    return d
+_lib_loaded = False
 
 
 def _load_native():
-    """Compile + load the C++ scheduler (cached by source hash)."""
-    global _lib, _lib_failed
-    if _lib is not None or _lib_failed:
+    """Compile + load the C++ scheduler (shared build/load helper)."""
+    global _lib, _lib_loaded
+    if _lib_loaded:
         return _lib
-    try:
-        with open(_SRC, "rb") as f:
-            src = f.read()
-        tag = hashlib.sha256(src).hexdigest()[:16]
-        so_path = os.path.join(_cache_dir(), f"scheduler_{tag}.so")
-        if not os.path.exists(so_path):
-            with tempfile.TemporaryDirectory() as td:
-                tmp = os.path.join(td, "scheduler.so")
-                subprocess.run(
-                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-                     _SRC, "-o", tmp],
-                    check=True, capture_output=True)
-                os.replace(tmp, so_path)
-        lib = ctypes.CDLL(so_path)
+    _lib_loaded = True
+    lib = load_native_lib(_SRC, "scheduler")
+    if lib is not None:
         lib.topo_schedule.restype = ctypes.c_int32
         lib.topo_schedule.argtypes = [
             ctypes.c_int32, ctypes.c_int32,
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_int32)]
-        _lib = lib
-    except Exception:
-        _lib_failed = True
-        _lib = None
+    _lib = lib
     return _lib
 
 

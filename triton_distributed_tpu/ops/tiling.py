@@ -79,13 +79,15 @@ def matmul_tiles(
 
     def body(a_v, b_v, o_v, acc_ref):
         kk = pl.program_id(2)
+        part = jnp.dot(a_v[...], b_v[...], preferred_element_type=jnp.float32)
 
         @pl.when(kk == 0)
         def _():
-            acc_ref[...] = jnp.zeros_like(acc_ref)
+            acc_ref[...] = part
 
-        acc_ref[...] += jnp.dot(a_v[...], b_v[...],
-                                preferred_element_type=jnp.float32)
+        @pl.when(kk != 0)
+        def _():
+            acc_ref[...] += part
 
         @pl.when(kk == nk - 1)
         def _():

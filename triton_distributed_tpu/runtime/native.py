@@ -16,7 +16,7 @@ import os
 import subprocess
 import tempfile
 
-_loaded: dict[str, ctypes.CDLL | None] = {}
+_loaded: dict[str, ctypes.PyDLL | None] = {}
 
 
 def native_cache_dir() -> str:
@@ -27,31 +27,39 @@ def native_cache_dir() -> str:
     return d
 
 
-def load_native_lib(src_path: str, name: str) -> ctypes.CDLL | None:
+def load_native_lib(src_path: str, name: str) -> ctypes.PyDLL | None:
     """Compile ``src_path`` (cached by source hash) and dlopen it.
 
     Returns None if the toolchain is unavailable or compilation fails —
     callers must degrade to their Python fallback. Failures are cached so a
     broken toolchain costs one attempt per process.
+
+    Loaded as ``PyDLL`` (calls keep the GIL): the native components here are
+    short CPU-side helpers with process-global state, and holding the GIL
+    makes concurrent Python callers race-free without a mutex in each .so.
     """
-    if name in _loaded:
-        return _loaded[name]
+    cache_key = None
     lib = None
     try:
         with open(src_path, "rb") as f:
             src = f.read()
         tag = hashlib.sha256(src).hexdigest()[:16]
+        cache_key = f"{name}_{tag}"  # two sources must never share a slot
+        if cache_key in _loaded:
+            return _loaded[cache_key]
         so_path = os.path.join(native_cache_dir(), f"{name}_{tag}.so")
         if not os.path.exists(so_path):
-            with tempfile.TemporaryDirectory() as td:
+            # Build inside the cache dir: os.replace across filesystems
+            # (tmpfs /tmp -> ~/.cache) raises EXDEV.
+            with tempfile.TemporaryDirectory(dir=native_cache_dir()) as td:
                 tmp = os.path.join(td, f"{name}.so")
                 subprocess.run(
                     ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
                      src_path, "-o", tmp],
                     check=True, capture_output=True)
                 os.replace(tmp, so_path)
-        lib = ctypes.CDLL(so_path)
+        lib = ctypes.PyDLL(so_path)
     except Exception:
         lib = None
-    _loaded[name] = lib
+    _loaded[cache_key if cache_key is not None else f"{name}:{src_path}"] = lib
     return lib

@@ -50,6 +50,16 @@ class _Registry:
             self._exact: dict[str, int] = {}
             self._buckets: dict[str, list[tuple[int, int]]] = {}
 
+    def __del__(self):
+        # Free the native handle; otherwise each transient AOTFunction leaks
+        # one heap Registry for the process lifetime.
+        lib = getattr(self, "_lib", None)
+        if lib is not None:
+            try:
+                lib.tdtpu_aot_destroy(self._h)
+            except Exception:
+                pass
+
     @staticmethod
     def _load():
         from triton_distributed_tpu.runtime.native import load_native_lib
@@ -58,6 +68,7 @@ class _Registry:
         if lib is None:
             return None
         lib.tdtpu_aot_create.restype = ctypes.c_int
+        lib.tdtpu_aot_destroy.argtypes = [ctypes.c_int]
         lib.tdtpu_aot_register_exact.argtypes = [
             ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
         lib.tdtpu_aot_register_bucket.argtypes = [
@@ -170,6 +181,7 @@ class AOTFunction:
         self.allow_jit_fallback = allow_jit_fallback
         self.entries: list[_Entry] = []
         self.registry = _Registry()
+        self._jit_fallbacks: dict[str, Callable] = {}
 
     # -- compilation -------------------------------------------------------
 
@@ -234,8 +246,15 @@ class AOTFunction:
         if entry is not None:
             return entry.compiled(*args)
         if self.allow_jit_fallback and self.fn is not None:
-            return jax.jit(functools.partial(self.fn, **kwargs))(*args) \
-                if kwargs else jax.jit(self.fn)(*args)
+            # One persistent jitted wrapper per static-kwargs key: a fresh
+            # jax.jit per call would retrace + recompile every time.
+            kw_key = json.dumps(kwargs, sort_keys=True, default=str) if kwargs else ""
+            jitted = self._jit_fallbacks.get(kw_key)
+            if jitted is None:
+                jitted = (jax.jit(functools.partial(self.fn, **kwargs))
+                          if kwargs else jax.jit(self.fn))
+                self._jit_fallbacks[kw_key] = jitted
+            return jitted(*args)
         raise KeyError(
             f"AOT {self.name}: no compiled entry for "
             f"{signature_key(args, kwargs or None)} "
@@ -261,14 +280,22 @@ class AOTFunction:
                 with open(os.path.join(directory, artifact), "wb") as f:
                     f.write(e.serialized)
                 n_saved += 1
+            try:  # values like jnp.bfloat16 stringify (default=str) but do
+                # not round-trip; load() must not recompile from the string
+                portable = json.loads(json.dumps(e.static_kwargs)) == e.static_kwargs
+            except (TypeError, ValueError):
+                portable = False
             manifest["entries"].append({
                 "key": e.key, "artifact": artifact, "family": e.family,
                 "bucket": e.bucket,
                 "args": [[_dt(a), list(a.shape)] for a in e.args_spec],
                 "static_kwargs": e.static_kwargs,
+                "static_kwargs_portable": portable,
             })
+        # default=str matches signature_key's encoding, so any static kwarg
+        # that keyed a compile can also be manifested (e.g. a jnp dtype).
         with open(os.path.join(directory, "manifest.json"), "w") as f:
-            json.dump(manifest, f, indent=1, sort_keys=True)
+            json.dump(manifest, f, indent=1, sort_keys=True, default=str)
         return n_saved
 
     @classmethod
@@ -282,6 +309,11 @@ class AOTFunction:
             if rec["artifact"] is None:
                 if fn is None:
                     continue  # unserializable and no fn — skip
+                if not rec.get("static_kwargs_portable", True):
+                    # The manifested kwargs are default=str coercions (e.g.
+                    # "<class 'ml_dtypes.bfloat16'>"); recompiling would bake
+                    # the string into fn. Caller must precompile explicitly.
+                    continue
                 spec = tuple(jax.ShapeDtypeStruct(tuple(s), d)
                              for d, s in rec["args"])
                 self.precompile(
