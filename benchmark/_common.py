@@ -1,0 +1,69 @@
+"""Shared benchmark harness.
+
+Reference analog: ``python/triton_dist/benchmark/`` — shape sweeps over the
+north-star ops. On a real TPU slice the numbers are meaningful; on the
+virtual CPU mesh (default off-TPU) the sweeps are functional smoke only —
+interpret-mode timings say nothing about hardware.
+
+Timing uses the chain-differential method from bench.py: one jitted call
+runs a dependent on-device chain of N ops; two chain lengths difference
+away dispatch+fetch cost (through the axon relay, naive wall-clock loops
+over-report badly — see bench.py's round-1 postmortem).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_DEVICES = 8
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + f" --xla_force_host_platform_device_count={N_DEVICES}")
+
+
+def bootstrap(n_devices: int = N_DEVICES):
+    """CPU mesh by default; TDTPU_BENCH_ON_TPU=1 opts into a real slice.
+
+    Probing the TPU backend initializes it, after which jax can no longer
+    switch to CPU in-process — so the choice must be explicit, not probed.
+    """
+    import jax
+
+    if os.environ.get("TDTPU_BENCH_ON_TPU", "") == "1":
+        assert len(jax.devices()) >= n_devices, (
+            f"TDTPU_BENCH_ON_TPU=1 but only {len(jax.devices())} devices")
+        return jax, True
+    jax.config.update("jax_platforms", "cpu")
+    return jax, False
+
+
+def timed_best(fn, args, iters: int = 5):
+    """Best-of wall-clock with completion forced by host fetch."""
+    import numpy as np
+
+    best = float("inf")
+    _ = np.asarray(fn(*args))  # compile + warm
+    for _i in range(iters):
+        t0 = time.perf_counter()
+        _ = np.asarray(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def per_iter_chain(make_chain, lengths=(4, 36), iters: int = 3):
+    """Differential per-iteration seconds of ``make_chain(n)()``."""
+    import numpy as np
+
+    n1, n2 = lengths
+    f1, f2 = make_chain(n1), make_chain(n2)
+    t1 = t2 = float("inf")
+    _ = np.asarray(f1())
+    _ = np.asarray(f2())
+    for _i in range(iters):
+        t0 = time.perf_counter(); _ = np.asarray(f1())
+        t1 = min(t1, time.perf_counter() - t0)
+        t0 = time.perf_counter(); _ = np.asarray(f2())
+        t2 = min(t2, time.perf_counter() - t0)
+    return max((t2 - t1) / (n2 - n1), 0.0)

@@ -1,0 +1,109 @@
+"""AG+GEMM / GEMM+RS shape sweep vs XLA-collective goldens.
+
+Reference analog: ``benchmark/bench_allgather_gemm.py`` (sweeps M for fixed
+TP weight shapes). Prints one row per (op, M): overlapped-kernel time, the
+unfused golden's time, and the speedup — the overlap-efficiency headline
+of BASELINE.md.
+
+    python benchmark/bench_ag_gemm.py [--kn 5120 5120] [--ms 128 512 2048]
+"""
+
+import argparse
+import functools
+
+from _common import bootstrap, per_iter_chain
+
+jax, ON_TPU = bootstrap()
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from triton_distributed_tpu.ops import ag_gemm, gemm_rs  # noqa: E402
+from triton_distributed_tpu.runtime import (  # noqa: E402
+    initialize_distributed, shard_map_on,
+)
+
+
+def golden_ag_gemm(ctx):
+    def f(a, b):
+        full = jax.lax.all_gather(a, "tp", axis=0, tiled=True)
+        return jnp.dot(full, b, preferred_element_type=jnp.float32).astype(a.dtype)
+    return shard_map_on(ctx, f, in_specs=(P("tp"), P(None, "tp")),
+                        out_specs=P(None, "tp"))
+
+
+def golden_gemm_rs(ctx):
+    def f(a, b):
+        partial = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+        return jax.lax.psum_scatter(partial, "tp", scatter_dimension=0,
+                                    tiled=True)
+    return shard_map_on(ctx, f, in_specs=(P(None, "tp"), P("tp", None)),
+                        out_specs=P("tp", None))
+
+
+def chain_of(op, a, b):
+    """Dependent chain: out feeds the next iteration's activation rows."""
+    def make(n):
+        @jax.jit
+        def run():
+            def body(i, acc):
+                out = op(acc, b)
+                # Fold the output back to the activation shape: keep shapes
+                # static by slicing/broadcast — cheap relative to the op.
+                scale = 1.0 / jnp.maximum(
+                    jnp.max(jnp.abs(out)).astype(jnp.float32), 1e-3)
+                return (acc * scale.astype(acc.dtype))
+            return jnp.sum(jax.lax.fori_loop(0, n, body, a).astype(jnp.float32))
+        return run
+    return make
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--kn", type=int, nargs=2, default=None,
+                   help="K N of the TP weight (global)")
+    p.add_argument("--ms", type=int, nargs="+", default=None,
+                   help="global M values to sweep")
+    p.add_argument("--dtype", default=None, choices=["float32", "bfloat16"])
+    args = p.parse_args()
+
+    n = 8
+    if ON_TPU:
+        k, ncols = args.kn or (5120, 5120)   # Qwen3-32B-ish TP shapes
+        ms = args.ms or [256, 1024, 4096]
+        dtype = jnp.dtype(args.dtype or "bfloat16")
+    else:
+        k, ncols = args.kn or (256, 256)
+        ms = args.ms or [64, 128]
+        dtype = jnp.dtype(args.dtype or "float32")
+
+    ctx = initialize_distributed(mesh_shape=(n,), axis_names=("tp",))
+    rng = np.random.default_rng(0)
+    print(f"# devices={n} K={k} N={ncols} dtype={dtype.name} "
+          f"({'TPU' if ON_TPU else 'CPU interpret — smoke only'})")
+    print(f"{'op':10} {'M':>6} {'fused_ms':>9} {'xla_ms':>9} {'speedup':>8}")
+
+    for m in ms:
+        a = jnp.asarray(rng.standard_normal((m, k)) * 0.1, dtype)
+        b = jnp.asarray(rng.standard_normal((k, ncols)) * 0.1, dtype)
+
+        fused = functools.partial(ag_gemm, ctx=ctx)
+        t_f = per_iter_chain(chain_of(lambda x, w: fused(x, w), a, b))
+        t_g = per_iter_chain(chain_of(
+            lambda x, w: golden_ag_gemm(ctx)(x, w), a, b))
+        print(f"{'ag_gemm':10} {m:>6} {t_f*1e3:>9.3f} {t_g*1e3:>9.3f} "
+              f"{t_g/max(t_f,1e-12):>8.3f}")
+
+        a2 = jnp.asarray(rng.standard_normal((m, k)) * 0.1, dtype)
+        b2 = jnp.asarray(rng.standard_normal((k, ncols)) * 0.1, dtype)
+        fused_rs = functools.partial(gemm_rs, ctx=ctx)
+        t_f = per_iter_chain(chain_of(lambda x, w: fused_rs(x, w), a2, b2))
+        t_g = per_iter_chain(chain_of(
+            lambda x, w: golden_gemm_rs(ctx)(x, w), a2, b2))
+        print(f"{'gemm_rs':10} {m:>6} {t_f*1e3:>9.3f} {t_g*1e3:>9.3f} "
+              f"{t_g/max(t_f,1e-12):>8.3f}")
+
+
+if __name__ == "__main__":
+    main()

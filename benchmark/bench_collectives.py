@@ -1,0 +1,101 @@
+"""AllGather / ReduceScatter / AllReduce size sweep, Pallas vs XLA.
+
+Reference analog: the per-collective perf cases in
+``test/nvidia/test_allreduce.py`` etc. (sweep sizes, compare methods).
+
+    python benchmark/bench_collectives.py [--cols 4096] [--rows 128 1024]
+"""
+
+import argparse
+
+from _common import bootstrap, per_iter_chain
+
+jax, ON_TPU = bootstrap()
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from triton_distributed_tpu.ops import (  # noqa: E402
+    AllGatherMethod, AllReduceMethod, all_gather, all_reduce, reduce_scatter,
+)
+from triton_distributed_tpu.runtime import (  # noqa: E402
+    initialize_distributed, shard_map_on,
+)
+
+
+def chain(make_op, x):
+    def make(n):
+        @jax.jit
+        def run():
+            def body(i, acc):
+                out = make_op(acc)
+                s = 1.0 / jnp.maximum(jnp.max(jnp.abs(out)).astype(jnp.float32), 1e-3)
+                return acc * s.astype(acc.dtype)
+            return jnp.sum(jax.lax.fori_loop(0, n, body, x).astype(jnp.float32))
+        return run
+    return make
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--cols", type=int, default=None)
+    p.add_argument("--rows", type=int, nargs="+", default=None)
+    args = p.parse_args()
+    n = 8
+    cols = args.cols or (4096 if ON_TPU else 256)
+    rows_list = args.rows or ([128, 1024, 8192] if ON_TPU else [32, 128])
+    dtype = jnp.bfloat16 if ON_TPU else jnp.float32
+
+    ctx = initialize_distributed(mesh_shape=(n,), axis_names=("tp",))
+    rng = np.random.default_rng(0)
+    print(f"# devices={n} cols={cols} dtype={jnp.dtype(dtype).name} "
+          f"({'TPU' if ON_TPU else 'CPU interpret — smoke only'})")
+    print(f"{'op':24} {'rows':>7} {'MB':>8} {'ms':>9}")
+
+    def xla_ag(ctx):
+        return shard_map_on(
+            ctx, lambda s: jax.lax.all_gather(s, "tp", axis=0, tiled=True),
+            in_specs=P("tp"), out_specs=P())
+
+    for rows in rows_list:
+        nbytes = rows * cols * jnp.dtype(dtype).itemsize
+        x = jnp.asarray(rng.standard_normal((rows, cols)) * 0.1, dtype)
+
+        for name, op in [
+            ("all_gather[PUSH]", lambda v: all_gather(
+                v, ctx, method=AllGatherMethod.FULL_MESH_PUSH)),
+            ("all_gather[RING]", lambda v: all_gather(
+                v, ctx, method=AllGatherMethod.RING_1D)),
+            ("all_gather[XLA]", lambda v: all_gather(
+                v, ctx, method=AllGatherMethod.XLA)),
+        ]:
+            t = per_iter_chain(chain(op, x))
+            print(f"{name:24} {rows:>7} {nbytes/2**20:>8.2f} {t*1e3:>9.3f}")
+
+        xs = jnp.asarray(rng.standard_normal((n, rows, cols)) * 0.1, dtype)
+        for name, op in [
+            ("all_reduce[ONE_SHOT]", lambda v: all_reduce(
+                v, ctx, method=AllReduceMethod.ONE_SHOT)),
+            ("all_reduce[TWO_SHOT]", lambda v: all_reduce(
+                v, ctx, method=AllReduceMethod.TWO_SHOT)),
+            ("all_reduce[XLA]", lambda v: all_reduce(
+                v, ctx, method=AllReduceMethod.XLA)),
+        ]:
+            def op_keep_shape(v, op=op):
+                out = op(v)                      # (rows, cols) reduced
+                return v * 0 + out[None]         # broadcast back: keep chain shape
+            t = per_iter_chain(chain(op_keep_shape, xs))
+            print(f"{name:24} {rows:>7} {nbytes/2**20:>8.2f} {t*1e3:>9.3f}")
+
+        xrs = jnp.asarray(rng.standard_normal((n, n * rows, cols)) * 0.1, dtype)
+        def rs_keep(v):
+            out = reduce_scatter(v, ctx)         # (n*rows, cols) scattered
+            return v * 0 + out[None]
+        t = per_iter_chain(chain(rs_keep, xrs))
+        print(f"{'reduce_scatter[RING]':24} {rows:>7} {nbytes/2**20:>8.2f} "
+              f"{t*1e3:>9.3f}")
+
+
+if __name__ == "__main__":
+    main()

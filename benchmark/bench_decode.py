@@ -1,0 +1,80 @@
+"""Engine decode-step latency (the reference's e2e decode benchmark).
+
+Reference analog: ``docs/mega_triton_kernel.md`` decode tables +
+``models/engine.py`` profile mode: single-step decode latency at a given
+(batch, context) for each backend mode.
+
+    python benchmark/bench_decode.py [--layers 4] [--batch 8] [--ctx 128]
+"""
+
+import argparse
+import time
+
+from _common import bootstrap
+
+jax, ON_TPU = bootstrap()
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from triton_distributed_tpu.models import ModelConfig  # noqa: E402
+from triton_distributed_tpu.models.dense import init_dense_llm  # noqa: E402
+from triton_distributed_tpu.models.engine import Engine  # noqa: E402
+from triton_distributed_tpu.runtime import initialize_distributed  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--layers", type=int, default=None)
+    p.add_argument("--batch", type=int, default=None)
+    p.add_argument("--ctx", type=int, default=None)
+    p.add_argument("--steps", type=int, default=16)
+    args = p.parse_args()
+
+    n = 8
+    if ON_TPU:
+        cfg = ModelConfig(hidden_size=2048, intermediate_size=6144,
+                          num_layers=args.layers or 8, num_heads=16,
+                          num_kv_heads=8, head_dim=128, vocab_size=32768,
+                          dtype="bfloat16")
+        batch, ctx_len = args.batch or 8, args.ctx or 128
+    else:
+        cfg = ModelConfig(hidden_size=256, intermediate_size=512,
+                          num_layers=args.layers or 2, num_heads=8,
+                          num_kv_heads=8, head_dim=32, vocab_size=512,
+                          dtype="float32")
+        batch, ctx_len = args.batch or 2, args.ctx or 16
+
+    dctx = initialize_distributed(mesh_shape=(n,), axis_names=("tp",))
+    params = init_dense_llm(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, ctx_len)),
+                      jnp.int32)
+
+    print(f"# devices={n} hidden={cfg.hidden_size} layers={cfg.num_layers} "
+          f"batch={batch} ctx={ctx_len} "
+          f"({'TPU' if ON_TPU else 'CPU interpret — smoke only'})")
+    print(f"{'backend':10} {'prefill_ms':>11} {'decode_ms':>10}")
+
+    for backend in ("xla", "auto"):
+        eng = Engine(cfg, params, ctx=dctx, backend=backend,
+                     max_seq=ctx_len + args.steps + 1)
+        t0 = time.perf_counter()
+        logits, cache = eng.prefill(ids)
+        jax.block_until_ready(logits)
+        t_prefill = time.perf_counter() - t0
+
+        from triton_distributed_tpu.models import sampling
+        tok = sampling.greedy(logits)
+        tok, cache = eng.decode(tok, cache)   # compile
+        jax.block_until_ready(tok)
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            tok, cache = eng.decode(tok, cache)
+        jax.block_until_ready(tok)
+        t_decode = (time.perf_counter() - t0) / args.steps
+        print(f"{backend:10} {t_prefill*1e3:>11.2f} {t_decode*1e3:>10.2f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""Bit-rot guard: tutorials are user-facing entry points and must keep
+running. Each executes in a fresh process (they pin their own CPU mesh).
+
+Only a representative subset runs here — the full set (01-10) is exercised
+manually / by CI-style sweeps; each costs a fresh 8-device interpret-mode
+startup, so running all of them would dominate suite time.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "tutorials")
+
+
+@pytest.mark.parametrize("script", [
+    "01-distributed-notify-wait.py",     # primitives
+    "07-overlapping-allgather-gemm.py",  # the flagship overlap pattern
+    "04-moe-infer-all2all.py",           # MoE AllToAll
+])
+def test_tutorial_runs(script):
+    env = dict(os.environ)
+    env.pop("TDTPU_TUTORIALS_ON_TPU", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_DIR, script)],
+        capture_output=True, text=True, timeout=600, env=env, cwd=_DIR)
+    assert proc.returncode == 0, (
+        f"{script} failed\nstdout: {proc.stdout[-2000:]}\n"
+        f"stderr: {proc.stderr[-2000:]}")
+    assert "OK" in proc.stdout

@@ -51,3 +51,21 @@ from triton_distributed_tpu.ops.flash_decode import (  # noqa: F401
     combine_partials,
 )
 from triton_distributed_tpu.ops.gemm import pallas_matmul  # noqa: F401
+from triton_distributed_tpu.ops.moe import (  # noqa: F401
+    ag_group_gemm_local,
+    grouped_mlp,
+    moe_reduce_rs_local,
+    moe_tp_fwd,
+    moe_tp_fwd_local,
+    sort_by_expert,
+)
+from triton_distributed_tpu.ops.low_latency_allgather import (  # noqa: F401
+    AllGatherLayer,
+    fast_allgather,
+    fast_allgather_local,
+)
+from triton_distributed_tpu.ops.two_level import (  # noqa: F401
+    all_gather_2d,
+    all_reduce_2d,
+    reduce_scatter_2d,
+)

@@ -1,0 +1,89 @@
+"""Tutorial 01 — notify/wait: the signal primitives everything is built on.
+
+Reference analog: tutorials/01-distributed-notify-wait.py (a producer rank
+sets a symmetric flag with ``dl.notify``; a consumer spins in ``dl.wait``).
+
+TPU translation: the "symmetric flag" is a Pallas *semaphore*. ``dl.notify``
+signals a peer's semaphore across ICI (the NVSHMEM ``signal_op`` analog);
+``dl.wait`` blocks until the local semaphore reaches a value (the
+``signal_wait_until`` / spin-wait-PTX analog). Two deltas from the CUDA
+semantics, documented in language/distributed_ops.py:
+
+- waits are *consuming* by default (semaphore decrements), so signal values
+  don't accumulate across kernel calls;
+- the data→flag ordering the reference gets from release/acquire PTX comes
+  for free: remote DMA completion signals the receiver's semaphore.
+
+Here every rank pushes a row to its right neighbor, notifies it, and only
+reads its own buffer after waiting — a 1-hop producer/consumer handshake.
+"""
+
+from _common import bootstrap
+
+jax = bootstrap()
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.experimental.pallas import tpu as pltpu  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from triton_distributed_tpu import language as dl  # noqa: E402
+from triton_distributed_tpu.language import shmem_device as shmem  # noqa: E402
+from triton_distributed_tpu.language.core import kernel_call, any_spec  # noqa: E402
+from triton_distributed_tpu.runtime import (  # noqa: E402
+    initialize_distributed, shard_map_on, dist_print,
+)
+
+
+def kernel(in_ref, out_ref, send_sem, recv_sem, flag, scratch):
+    me = dl.rank("tp")
+    n = dl.num_ranks("tp")
+    right = jax.lax.rem(me + 1, n)
+
+    # PRODUCER half: push my block into my right neighbor's out_ref. The
+    # DMA's recv semaphore fires on the *destination* device when the bytes
+    # have landed (putmem_nbi_block, shmem_device.py).
+    rdma = shmem.putmem_nbi_block(in_ref, out_ref, send_sem, recv_sem, right)
+
+    # Tell the neighbor the payload is complete: notify = remote semaphore
+    # signal (reference dl.notify -> nvshmemx_signal_op).
+    dl.notify(flag, right, inc=1)
+
+    # CONSUMER half: wait for my left neighbor's notify, then use the data.
+    # wait() consumes the signal; the rdma recv wait orders the data itself.
+    dl.wait(flag, 1)
+    rdma.wait()   # waits send-side completion too (nbi -> quiet analog)
+    # out_ref lives in HBM (DMA-addressable); compute must stage via VMEM.
+    pltpu.sync_copy(out_ref, scratch)
+    scratch[...] = scratch[...] * 2.0  # safe: producer finished
+    pltpu.sync_copy(scratch, out_ref)
+
+
+def main():
+    ctx = initialize_distributed(mesh_shape=(8,), axis_names=("tp",))
+
+    def f(x):
+        return kernel_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            in_specs=[any_spec()],
+            out_specs=any_spec(),
+            scratch_shapes=[
+                pltpu.SemaphoreType.DMA(()),      # send completion
+                pltpu.SemaphoreType.DMA(()),      # recv completion
+                pltpu.SemaphoreType.REGULAR,      # the notify flag
+                pltpu.VMEM((1, 128), jnp.float32),
+            ],
+        )(x)
+
+    x = jnp.arange(8 * 128, dtype=jnp.float32).reshape(8, 128)
+    y = shard_map_on(ctx, f, in_specs=P("tp"), out_specs=P("tp"))(x)
+
+    expected = np.roll(np.asarray(x).reshape(8, 1, 128), 1, axis=0)
+    expected = expected.reshape(8, 128) * 2.0
+    np.testing.assert_allclose(np.asarray(y), expected)
+    dist_print("tutorial 01 OK — notify/wait handshake verified", rank=0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""Tutorial 03 — inter-slice (two-level) AllGather over ICI + DCN.
+
+Reference analog: tutorials/03-inter-node-allgather.py — a 2D schedule that
+pairs intra-node copy-engine transfers with inter-node NVSHMEM puts
+(kernels/nvidia/allgather.py:293-378).
+
+TPU translation: the two tiers are the ICI torus (intra-slice, Pallas remote
+DMA — our tier-1 kernels) and the data-center network (inter-slice DCN),
+which Pallas cannot DMA across. The idiomatic split (SURVEY.md §7):
+
+    tier 1 (ici / "tp" axis):  Pallas push/ring kernels      <- tutorial 02
+    tier 2 (dcn axis):         XLA collectives over DCN
+
+ops/two_level.py composes them: gather intra-slice first (fast links,
+bulk of the fan-in), then all_gather the slice-local results across the
+"dcn" axis with jax.lax — exactly how the reference nests CE-intranode
+inside NVSHMEM-internode rings.
+
+Run on a (dcn=2, tp=4) mesh: 2 emulated slices of 4 devices.
+"""
+
+from _common import bootstrap
+
+jax = bootstrap()
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from triton_distributed_tpu.ops.two_level import all_gather_2d  # noqa: E402
+from triton_distributed_tpu.runtime import (  # noqa: E402
+    initialize_distributed, dist_print,
+)
+
+
+def main():
+    ctx = initialize_distributed(mesh_shape=(2, 4), axis_names=("dcn", "tp"))
+    N, m, cols = 8, 16, 256   # 8 global devices, row-shard per device
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((N * m, cols)), jnp.float32)
+
+    out = all_gather_2d(x, ctx)   # ICI pallas gather, then DCN XLA gather
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=0, atol=0)
+    dist_print("tutorial 03 OK — two-level AG (ICI pallas + DCN XLA)", rank=0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""Tutorial 05 — intra-slice ReduceScatter (ring) + AllReduce on top.
+
+Reference analog: tutorials/05-intra-node-reduce-scatter.py (scatter + ring
+reduce over per-node symmetric buffers, kernels/nvidia/reduce_scatter.py).
+
+TPU translation (ops/reduce_scatter.py, ops/allreduce.py): the ring
+reduce-scatter sends each chunk around the ICI ring, adding the local
+contribution at every hop — after n-1 hops, rank d holds the fully reduced
+chunk d. fp32 accumulation regardless of input dtype (the reference's
+Triton kernels accumulate in fp32 the same way).
+
+AllReduce = ReduceScatter + AllGather ("two-shot"), or a one-shot push for
+small payloads where a single fan-in round beats two phases; AUTO selects by
+size via the perf model — the analog of the reference's
+get_auto_allreduce_method (allreduce.py:1101).
+"""
+
+from _common import bootstrap
+
+jax = bootstrap()
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from triton_distributed_tpu.ops import (  # noqa: E402
+    AllReduceMethod, all_reduce, reduce_scatter,
+)
+from triton_distributed_tpu.runtime import (  # noqa: E402
+    initialize_distributed, dist_print,
+)
+
+
+def main():
+    ctx = initialize_distributed(mesh_shape=(8,), axis_names=("tp",))
+    n, m, cols = 8, 16, 256
+    rng = np.random.default_rng(0)
+
+    # Every device holds a full (n*m, cols) tensor of contributions.
+    x = jnp.asarray(rng.standard_normal((n, n * m, cols)), jnp.float32)
+    out = reduce_scatter(x, ctx)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x).sum(0),
+                               rtol=1e-4, atol=1e-4)
+    dist_print("reduce_scatter ring OK", rank=0)
+
+    for method in (AllReduceMethod.ONE_SHOT, AllReduceMethod.TWO_SHOT,
+                   AllReduceMethod.AUTO):
+        y = jnp.asarray(rng.standard_normal((n, m, cols)), jnp.float32)
+        out = all_reduce(y, ctx, method=method)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(y).sum(0),
+                                   rtol=1e-4, atol=1e-4)
+        dist_print(f"all_reduce[{method.name}] OK", rank=0)
+
+    dist_print("tutorial 05 OK", rank=0)
+
+
+if __name__ == "__main__":
+    main()

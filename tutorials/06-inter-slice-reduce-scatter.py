@@ -1,0 +1,49 @@
+"""Tutorial 06 — inter-slice (two-level) ReduceScatter + AllReduce.
+
+Reference analog: tutorials/06-inter-node-reduce-scatter.py — intra-node
+scatter/ring-reduce nested inside inter-node p2p transfers
+(kernels/nvidia/reduce_scatter.py:506, 2D context at :47-147).
+
+TPU translation (ops/two_level.py): reduce intra-slice first over ICI with
+the Pallas ring (bulk of the reduction on the fast links), then finish
+across slices with an XLA psum_scatter/psum over DCN. The composition
+mirrors the reference's two-tier design; only the inter tier's transport
+differs (XLA DCN collectives instead of NVSHMEM RDMA).
+"""
+
+from _common import bootstrap
+
+jax = bootstrap()
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from triton_distributed_tpu.ops.two_level import (  # noqa: E402
+    all_reduce_2d, reduce_scatter_2d,
+)
+from triton_distributed_tpu.runtime import (  # noqa: E402
+    initialize_distributed, dist_print,
+)
+
+
+def main():
+    ctx = initialize_distributed(mesh_shape=(2, 4), axis_names=("dcn", "tp"))
+    N, m, cols = 8, 16, 256
+    rng = np.random.default_rng(0)
+
+    x = jnp.asarray(rng.standard_normal((N, N * m, cols)), jnp.float32)
+    out = reduce_scatter_2d(x, ctx)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x).sum(0),
+                               rtol=1e-4, atol=1e-4)
+    dist_print("reduce_scatter_2d OK", rank=0)
+
+    y = jnp.asarray(rng.standard_normal((N, m, cols)), jnp.float32)
+    out = all_reduce_2d(y, ctx)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(y).sum(0),
+                               rtol=1e-4, atol=1e-4)
+    dist_print("tutorial 06 OK — two-level RS/AR (ICI pallas + DCN XLA)",
+               rank=0)
+
+
+if __name__ == "__main__":
+    main()

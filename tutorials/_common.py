@@ -1,0 +1,49 @@
+"""Shared tutorial bootstrap.
+
+Every tutorial runs in one of two environments:
+
+- a real TPU slice: run as-is (`python tutorials/0X-....py`) — the mesh spans
+  the actual devices and Pallas kernels compile through Mosaic;
+- no TPU / a single chip: an 8-device *virtual CPU mesh* is created and the
+  kernels run in Pallas TPU-interpret mode, which faithfully emulates remote
+  DMA + semaphores (the reference's tutorials, by contrast, need a real
+  8-GPU node — SURVEY.md §4).
+
+Call ``bootstrap()`` before importing jax-dependent tutorial code.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_DEVICES = 8
+
+# XLA parses XLA_FLAGS once, at first backend initialization — even probing
+# the TPU backend consumes them. Set the virtual-CPU device count at module
+# import, before any jax touch (it does not affect the TPU platform).
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + f" --xla_force_host_platform_device_count={N_DEVICES}")
+
+
+def bootstrap(n_devices: int = N_DEVICES):
+    """Return a jax module guaranteed to see >= n_devices devices.
+
+    Once a platform initializes it cannot be switched in-process, so the
+    choice is made up front: ``TDTPU_TUTORIALS_ON_TPU=1`` runs on the real
+    TPU slice (set it on a pod slice with >= n_devices chips); the default
+    is the 8-device virtual CPU mesh, where Pallas interpret mode emulates
+    remote DMA + semaphores faithfully.
+    """
+    import jax
+
+    if os.environ.get("TDTPU_TUTORIALS_ON_TPU", "") == "1":
+        assert len(jax.devices()) >= n_devices, (
+            f"TDTPU_TUTORIALS_ON_TPU=1 but only {len(jax.devices())} devices")
+        return jax
+    jax.config.update("jax_platforms", "cpu")
+    assert len(jax.devices()) >= n_devices, (
+        f"{len(jax.devices())} devices after forcing CPU — another jax API "
+        "call initialized the backend before bootstrap()")
+    return jax
