@@ -36,20 +36,10 @@ def bootstrap(n_devices: int = N_DEVICES):
             f"TDTPU_BENCH_ON_TPU=1 but only {len(jax.devices())} devices")
         return jax, True
     jax.config.update("jax_platforms", "cpu")
+    assert len(jax.devices()) >= n_devices, (
+        f"{len(jax.devices())} devices after forcing CPU — another jax API "
+        "call initialized the backend before bootstrap()")
     return jax, False
-
-
-def timed_best(fn, args, iters: int = 5):
-    """Best-of wall-clock with completion forced by host fetch."""
-    import numpy as np
-
-    best = float("inf")
-    _ = np.asarray(fn(*args))  # compile + warm
-    for _i in range(iters):
-        t0 = time.perf_counter()
-        _ = np.asarray(fn(*args))
-        best = min(best, time.perf_counter() - t0)
-    return best
 
 
 def per_iter_chain(make_chain, lengths=(4, 36), iters: int = 3):

@@ -59,7 +59,8 @@ def main():
             in_specs=P("tp"), out_specs=P())
 
     for rows in rows_list:
-        nbytes = rows * cols * jnp.dtype(dtype).itemsize
+        itemsize = jnp.dtype(dtype).itemsize
+        ag_mb = rows * cols * itemsize / 2**20          # per-device shard
         x = jnp.asarray(rng.standard_normal((rows, cols)) * 0.1, dtype)
 
         for name, op in [
@@ -71,9 +72,10 @@ def main():
                 v, ctx, method=AllGatherMethod.XLA)),
         ]:
             t = per_iter_chain(chain(op, x))
-            print(f"{name:24} {rows:>7} {nbytes/2**20:>8.2f} {t*1e3:>9.3f}")
+            print(f"{name:24} {rows:>7} {ag_mb:>8.2f} {t*1e3:>9.3f}")
 
         xs = jnp.asarray(rng.standard_normal((n, rows, cols)) * 0.1, dtype)
+        ar_mb = n * rows * cols * itemsize / 2**20      # (n, rows, cols) input
         for name, op in [
             ("all_reduce[ONE_SHOT]", lambda v: all_reduce(
                 v, ctx, method=AllReduceMethod.ONE_SHOT)),
@@ -86,14 +88,15 @@ def main():
                 out = op(v)                      # (rows, cols) reduced
                 return v * 0 + out[None]         # broadcast back: keep chain shape
             t = per_iter_chain(chain(op_keep_shape, xs))
-            print(f"{name:24} {rows:>7} {nbytes/2**20:>8.2f} {t*1e3:>9.3f}")
+            print(f"{name:24} {rows:>7} {ar_mb:>8.2f} {t*1e3:>9.3f}")
 
         xrs = jnp.asarray(rng.standard_normal((n, n * rows, cols)) * 0.1, dtype)
+        rs_mb = n * n * rows * cols * itemsize / 2**20  # (n, n*rows, cols) input
         def rs_keep(v):
             out = reduce_scatter(v, ctx)         # (n*rows, cols) scattered
             return v * 0 + out[None]
         t = per_iter_chain(chain(rs_keep, xrs))
-        print(f"{'reduce_scatter[RING]':24} {rows:>7} {nbytes/2**20:>8.2f} "
+        print(f"{'reduce_scatter[RING]':24} {rows:>7} {rs_mb:>8.2f} "
               f"{t*1e3:>9.3f}")
 
 

@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Chat client for scripts/model_server.py (reference chat.py analog).
+
+  python scripts/chat.py --port 8400            # REPL (text if server has a
+                                                #  tokenizer, else token ids)
+  python scripts/chat.py --ids 1 2 3 --gen 8    # one-shot with raw ids
+"""
+
+import argparse
+import json
+import urllib.request
+
+
+def post(port, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=600) as r:
+        return json.loads(r.read())
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--port", type=int, default=8400)
+    p.add_argument("--gen", type=int, default=16)
+    p.add_argument("--ids", type=int, nargs="+", default=None)
+    args = p.parse_args()
+
+    if args.ids:
+        print(post(args.port, {"input_ids": [args.ids],
+                               "gen_len": args.gen}))
+        return
+
+    print("interactive mode — type a prompt (or ids: 1 2 3), ctrl-D to exit")
+    while True:
+        try:
+            line = input("> ").strip()
+        except EOFError:
+            break
+        if not line:
+            continue
+        try:
+            toks = [int(t) for t in line.split()]
+            payload = {"input_ids": [toks], "gen_len": args.gen}
+        except ValueError:
+            payload = {"prompt": line, "gen_len": args.gen}
+        resp = post(args.port, payload)
+        print(resp.get("text", resp.get("output_ids", resp)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Multi-host launcher (reference scripts/launch.sh analog).
+
+The reference wraps torchrun: it exports NVSHMEM bootstrap env, picks
+nproc-per-node, and execs the test script on every rank
+(launch.sh:146-180). On TPU pods the platform plays torchrun's role — each
+host runs the same program and ``jax.distributed.initialize()`` discovers
+peers from the TPU metadata — so the launcher reduces to:
+
+  python scripts/launch.py my_script.py [args...]
+
+which initializes the distributed runtime (env-driven overrides below),
+then runs the script with the global mesh available. Environment:
+
+  TDTPU_COORDINATOR   host:port of process 0 (non-TPU/manual bootstrap)
+  TDTPU_NUM_PROCESSES total process count   (with TDTPU_COORDINATOR)
+  TDTPU_PROCESS_ID    this process's id     (with TDTPU_COORDINATOR)
+
+On a TPU pod slice none are needed. The reference's compute-sanitizer hook
+maps to TDTPU_DETECT_RACES=1 (interpret-mode race detection, off-TPU).
+"""
+
+import os
+import runpy
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def maybe_init_distributed():
+    import jax
+
+    coord = os.environ.get("TDTPU_COORDINATOR")
+    if coord:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ["TDTPU_NUM_PROCESSES"]),
+            process_id=int(os.environ["TDTPU_PROCESS_ID"]))
+        return
+    # TPU pod: metadata-driven bootstrap; a single host needs nothing.
+    try:
+        if jax.default_backend() == "tpu" and jax.process_count() == 1:
+            # single-process slice — initialize() would be a no-op or error
+            return
+        jax.distributed.initialize()
+    except Exception:
+        pass  # single-process run
+
+
+def main():
+    if len(sys.argv) < 2:
+        print(__doc__)
+        sys.exit(2)
+    script, sys.argv = sys.argv[1], sys.argv[1:]
+    maybe_init_distributed()
+    runpy.run_path(script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

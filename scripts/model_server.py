@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Minimal model server over the Engine.
+
+Reference analog: ``mega_triton_kernel/test/model_server.py`` (a socket
+server replaying the persistent kernel per request) + ``chat.py`` client.
+
+Serves HTTP (stdlib only):
+  POST /generate   {"input_ids": [[...]], "gen_len": N} |
+                   {"prompt": "...", "gen_len": N}   (needs --tokenizer)
+  GET  /health     config + mesh info
+
+Run (no TPU needed — tiny random model on the virtual CPU mesh):
+  python scripts/model_server.py --demo
+Real checkpoint on a TPU slice:
+  python scripts/model_server.py --checkpoint /path/to/qwen3 --tokenizer /path/to/qwen3
+"""
+
+import argparse
+import json
+import os
+import sys
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_engine(args):
+    if args.demo:
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=8")
+    import jax
+
+    if args.demo:
+        jax.config.update("jax_platforms", "cpu")
+
+    from triton_distributed_tpu.models import AutoLLM
+    from triton_distributed_tpu.models.config import tiny_config
+    from triton_distributed_tpu.runtime import initialize_distributed
+
+    n = len(jax.devices())
+    ctx = initialize_distributed(mesh_shape=(n,), axis_names=("tp",))
+    if args.checkpoint:
+        eng = AutoLLM.from_pretrained(args.checkpoint, ctx=ctx,
+                                      backend=args.backend,
+                                      max_seq=args.max_seq)
+    else:
+        eng = AutoLLM.from_config(tiny_config(), ctx=ctx,
+                                  backend=args.backend, max_seq=args.max_seq)
+    tok = None
+    if args.tokenizer:
+        from triton_distributed_tpu.models.auto import auto_tokenizer
+
+        tok = auto_tokenizer(args.tokenizer)
+    return eng, tok
+
+
+def make_handler(eng, tok):
+    import jax.numpy as jnp
+    import numpy as np
+
+    class Handler(BaseHTTPRequestHandler):
+        def _send(self, code, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # quiet
+            pass
+
+        def do_GET(self):
+            if self.path == "/health":
+                self._send(200, {
+                    "status": "ok",
+                    "model": {"hidden": eng.cfg.hidden_size,
+                              "layers": eng.cfg.num_layers,
+                              "moe": eng.cfg.is_moe},
+                    "tp": eng.n, "backend": eng.backend})
+            else:
+                self._send(404, {"error": "unknown path"})
+
+        def do_POST(self):
+            if self.path != "/generate":
+                return self._send(404, {"error": "unknown path"})
+            try:
+                req = json.loads(self.rfile.read(
+                    int(self.headers.get("Content-Length", 0))))
+                gen_len = int(req.get("gen_len", 16))
+                if "prompt" in req:
+                    if tok is None:
+                        return self._send(400, {
+                            "error": "no tokenizer; pass input_ids"})
+                    ids = np.asarray([tok.encode(req["prompt"])], np.int32)
+                else:
+                    ids = np.asarray(req["input_ids"], np.int32)
+                out = eng.serve(jnp.asarray(ids), gen_len=gen_len)
+                out_ids = np.asarray(out).tolist()
+                resp = {"output_ids": out_ids}
+                if tok is not None:
+                    resp["text"] = [tok.decode(o) for o in out_ids]
+                self._send(200, resp)
+            except Exception as e:  # report, don't crash the server
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+    return Handler
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--checkpoint", default=None,
+                   help="local HF checkpoint dir (default: tiny random model)")
+    p.add_argument("--tokenizer", default=None)
+    p.add_argument("--backend", default="auto",
+                   choices=["auto", "xla", "overlap"])
+    p.add_argument("--max-seq", type=int, default=512)
+    p.add_argument("--port", type=int, default=8400)
+    p.add_argument("--demo", action="store_true",
+                   help="force the 8-device virtual CPU mesh")
+    args = p.parse_args()
+
+    eng, tok = build_engine(args)
+    srv = ThreadingHTTPServer(("127.0.0.1", args.port),
+                              make_handler(eng, tok))
+    print(f"serving on http://127.0.0.1:{args.port} "
+          f"(tp={eng.n}, backend={eng.backend})", flush=True)
+    srv.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

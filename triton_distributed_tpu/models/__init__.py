@@ -19,3 +19,10 @@ from triton_distributed_tpu.models.dense import (  # noqa: F401
     dense_decode_step,
 )
 from triton_distributed_tpu.models.engine import Engine  # noqa: F401
+from triton_distributed_tpu.models.auto import AutoLLM, auto_tokenizer  # noqa: F401
+from triton_distributed_tpu.models.hf_loader import (  # noqa: F401
+    config_from_hf,
+    convert_hf_state_dict,
+    load_pretrained,
+)
+from triton_distributed_tpu.models import sampling  # noqa: F401

@@ -1,0 +1,67 @@
+"""AutoLLM — one entry point from a checkpoint/config to a served model.
+
+Reference: ``python/triton_dist/models/__init__.py:33`` (``AutoLLM``
+dispatches HF model_type -> DenseLLM / Qwen3MoE) and ``:56``
+(``AutoTokenizer`` passthrough).
+
+Here dense vs MoE is a property of :class:`ModelConfig` (``is_moe``), and
+both run through the same functional forward (``dense_prefill`` /
+``dense_decode_step`` dispatch per layer), so AutoLLM reduces to: resolve
+the config, obtain params, hand both to the Engine.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from triton_distributed_tpu.models.config import ModelConfig
+from triton_distributed_tpu.models.dense import init_dense_llm
+from triton_distributed_tpu.models.engine import Engine
+from triton_distributed_tpu.models.hf_loader import (
+    config_from_hf, convert_hf_state_dict, load_pretrained,
+)
+
+
+class AutoLLM:
+    """Build an :class:`Engine` from any supported source."""
+
+    @staticmethod
+    def from_pretrained(path: str, ctx=None, *, dtype=None,
+                        backend: str = "auto", max_seq: int = 2048,
+                        **engine_kw) -> Engine:
+        """Local HF checkpoint dir (config.json + safetensors)."""
+        cfg, params = load_pretrained(path, dtype)
+        return Engine(cfg, params, ctx=ctx, backend=backend,
+                      max_seq=max_seq, **engine_kw)
+
+    @staticmethod
+    def from_hf_model(model: Any, ctx=None, *, dtype=None,
+                      backend: str = "auto", max_seq: int = 2048,
+                      **engine_kw) -> Engine:
+        """In-memory ``transformers`` model (or anything with ``.config``
+        and ``.state_dict()``)."""
+        cfg = config_from_hf(model.config)
+        params = convert_hf_state_dict(model.state_dict(), cfg, dtype)
+        return Engine(cfg, params, ctx=ctx, backend=backend,
+                      max_seq=max_seq, **engine_kw)
+
+    @staticmethod
+    def from_config(cfg: ModelConfig | Any, ctx=None, *, seed: int = 0,
+                    backend: str = "auto", max_seq: int = 2048,
+                    **engine_kw) -> Engine:
+        """Random-init model from a ModelConfig or HF config (benchmarks,
+        tests, dry runs)."""
+        if not isinstance(cfg, ModelConfig):
+            cfg = config_from_hf(cfg)
+        params = init_dense_llm(jax.random.PRNGKey(seed), cfg)
+        return Engine(cfg, params, ctx=ctx, backend=backend,
+                      max_seq=max_seq, **engine_kw)
+
+
+def auto_tokenizer(path: str):
+    """Reference AutoTokenizer passthrough (models/__init__.py:56)."""
+    from transformers import AutoTokenizer
+
+    return AutoTokenizer.from_pretrained(path)

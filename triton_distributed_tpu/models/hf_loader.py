@@ -1,0 +1,149 @@
+"""HuggingFace checkpoint → framework params.
+
+Reference: ``python/triton_dist/models/utils.py:108`` (load HF weights on
+CPU then shard per rank) and the per-model ``init_parameters`` paths in
+``models/dense.py:151-168`` / ``models/qwen_moe.py``.
+
+TPU-native difference: no per-rank slicing code at all — conversion emits
+the *global-view* pytree matching ``init_dense_llm``'s structure, and
+``jax.device_put`` with the ``dense_llm_specs`` NamedShardings performs the
+sharded placement (the Engine does this on construction). HF stores every
+``nn.Linear`` as (out, in); this framework right-multiplies activations, so
+linears transpose to (in, out) on conversion.
+
+Works from either a ``transformers`` model / state_dict (torch CPU tensors)
+or a directory of ``.safetensors`` files — no torch model instantiation
+needed for the directory path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from triton_distributed_tpu.models.config import ModelConfig
+
+
+def config_from_hf(hf_cfg: Any) -> ModelConfig:
+    """Map a transformers Qwen3Config / Qwen3MoeConfig (or a plain dict from
+    config.json) to :class:`ModelConfig`."""
+    get = (hf_cfg.get if isinstance(hf_cfg, Mapping)
+           else lambda k, d=None: getattr(hf_cfg, k, d))
+    num_experts = get("num_experts", None) or 0
+    return ModelConfig(
+        hidden_size=get("hidden_size"),
+        intermediate_size=get("intermediate_size"),
+        num_layers=get("num_hidden_layers"),
+        num_heads=get("num_attention_heads"),
+        num_kv_heads=get("num_key_value_heads"),
+        head_dim=get("head_dim",
+                     get("hidden_size") // get("num_attention_heads")),
+        vocab_size=get("vocab_size"),
+        rope_theta=float(get("rope_theta", 1e6)),
+        rms_norm_eps=float(get("rms_norm_eps", 1e-6)),
+        tie_word_embeddings=bool(get("tie_word_embeddings", False)),
+        num_experts=num_experts,
+        num_experts_per_tok=get("num_experts_per_tok", 0) if num_experts else 0,
+        moe_intermediate_size=get("moe_intermediate_size", 0) if num_experts else 0,
+    )
+
+
+def _to_np(t) -> np.ndarray:
+    if isinstance(t, np.ndarray):
+        return t
+    # torch tensor (possibly bf16, which numpy can't represent): go via f32.
+    t = t.detach().cpu()
+    if str(t.dtype) == "torch.bfloat16":
+        t = t.float()
+    return t.numpy()
+
+
+def convert_hf_state_dict(state_dict: Mapping[str, Any],
+                          cfg: ModelConfig, dtype=None) -> dict:
+    """HF Qwen3 / Qwen3-MoE names → the ``init_dense_llm`` pytree."""
+    dt = jnp.dtype(dtype or cfg.dtype)
+    sd = state_dict
+
+    def lin(name):  # HF (out, in) -> (in, out)
+        return jnp.asarray(_to_np(sd[name]).T, dt)
+
+    def vec(name):
+        return jnp.asarray(_to_np(sd[name]), dt)
+
+    params: dict = {
+        "embed": jnp.asarray(_to_np(sd["model.embed_tokens.weight"]), dt),
+        "final_norm": vec("model.norm.weight"),
+        "layers": [],
+    }
+    for i in range(cfg.num_layers):
+        pre = f"model.layers.{i}."
+        layer: dict = {
+            "attn_norm": vec(pre + "input_layernorm.weight"),
+            "mlp_norm": vec(pre + "post_attention_layernorm.weight"),
+            "attn": {
+                "wq": lin(pre + "self_attn.q_proj.weight"),
+                "wk": lin(pre + "self_attn.k_proj.weight"),
+                "wv": lin(pre + "self_attn.v_proj.weight"),
+                "wo": lin(pre + "self_attn.o_proj.weight"),
+            },
+        }
+        if cfg.qk_norm and pre + "self_attn.q_norm.weight" in sd:
+            layer["attn"]["q_norm"] = vec(pre + "self_attn.q_norm.weight")
+            layer["attn"]["k_norm"] = vec(pre + "self_attn.k_norm.weight")
+        elif cfg.qk_norm:
+            layer["attn"]["q_norm"] = jnp.ones((cfg.head_dim,), dt)
+            layer["attn"]["k_norm"] = jnp.ones((cfg.head_dim,), dt)
+
+        if cfg.is_moe:
+            layer["moe"] = {
+                "router": lin(pre + "mlp.gate.weight"),
+                "w_gate": jnp.stack([
+                    lin(pre + f"mlp.experts.{e}.gate_proj.weight")
+                    for e in range(cfg.num_experts)]),
+                "w_up": jnp.stack([
+                    lin(pre + f"mlp.experts.{e}.up_proj.weight")
+                    for e in range(cfg.num_experts)]),
+                "w_down": jnp.stack([
+                    lin(pre + f"mlp.experts.{e}.down_proj.weight")
+                    for e in range(cfg.num_experts)]),
+            }
+        else:
+            layer["mlp"] = {
+                "w_gate": lin(pre + "mlp.gate_proj.weight"),
+                "w_up": lin(pre + "mlp.up_proj.weight"),
+                "w_down": lin(pre + "mlp.down_proj.weight"),
+            }
+        params["layers"].append(layer)
+
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = lin("lm_head.weight")
+    return params
+
+
+def _load_safetensors_dir(path: str) -> dict:
+    """Merge all .safetensors shards in ``path`` into one name->array dict
+    (numpy, zero-copy views where possible)."""
+    from safetensors import safe_open  # shipped with transformers
+
+    sd: dict = {}
+    files = sorted(f for f in os.listdir(path) if f.endswith(".safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files under {path}")
+    for fname in files:
+        with safe_open(os.path.join(path, fname), framework="np") as f:
+            for key in f.keys():
+                sd[key] = f.get_tensor(key)
+    return sd
+
+
+def load_pretrained(path: str, dtype=None) -> tuple[ModelConfig, dict]:
+    """Load (config, params) from a local HF checkpoint directory
+    (config.json + *.safetensors). The AutoLLM.from_pretrained backend."""
+    with open(os.path.join(path, "config.json")) as f:
+        cfg = config_from_hf(json.load(f))
+    sd = _load_safetensors_dir(path)
+    return cfg, convert_hf_state_dict(sd, cfg, dtype)
