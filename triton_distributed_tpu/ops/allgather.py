@@ -147,6 +147,11 @@ def all_gather_local(x_local: jax.Array, axis: str = "tp", num_ranks: int | None
     if num_ranks is None:
         raise ValueError("num_ranks required inside shard_map")
     n = num_ranks
+    if n == 1:
+        # Degenerate world: identity. Also avoids compiling a barrier/put
+        # kernel over a size-1 axis, which crashes Mosaic (observed SIGABRT
+        # on v5e) and has nothing to do anyway.
+        return x_local
     if method == AllGatherMethod.AUTO:
         # The model's contract is the GLOBAL gathered payload, not the shard.
         method = get_auto_all_gather_method(
