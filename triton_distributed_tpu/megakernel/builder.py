@@ -122,6 +122,86 @@ class MegaKernelBuilder:
         for tile in t.tiles():
             self._emit(Task(TaskType.ALLREDUCE, tile), [tile], [tile])
 
+    def rms_norm(self, out: TensorHandle, a: TensorHandle, w: TensorHandle,
+                 eps: float = 1e-6):
+        """Row-wise RMSNorm over the full width (reference make_rms_norm).
+
+        ``w`` is the norm weight stored broadcast as a (TILE, cols) tensor
+        (see models.broadcast_rows); one task per row block.
+        """
+        if (out.rt, out.ct) != (a.rt, a.ct) or w.ct != a.ct:
+            raise ValueError("rms_norm shape mismatch")
+        for i in range(out.rt):
+            reads = [a.tile(i, j) for j in range(a.ct)]
+            reads += [w.tile(0, j) for j in range(a.ct)]
+            self._emit(
+                Task(TaskType.RMS_NORM, out.tile(i, 0), a0=a.tile(i, 0),
+                     b0=w.tile(0, 0), k_tiles=a.ct,
+                     arg=int(round(eps * 1e9))),
+                reads, [out.tile(i, j) for j in range(out.ct)])
+
+    def rope(self, out: TensorHandle, a: TensorHandle, cos: TensorHandle,
+             sin: TensorHandle):
+        """Per-tile HF half-split rotation; cos/sin are full-width tables
+        (models.rope_tables) stored broadcast like norm weights."""
+        if (out.rt, out.ct) != (a.rt, a.ct) or cos.ct != a.ct or sin.ct != a.ct:
+            raise ValueError("rope shape mismatch")
+        for i in range(out.rt):
+            for j in range(out.ct):
+                self._emit(
+                    Task(TaskType.ROPE, out.tile(i, j), a0=a.tile(i, j),
+                         b0=cos.tile(0, j), arg=sin.tile(0, j)),
+                    [a.tile(i, j), cos.tile(0, j), sin.tile(0, j)],
+                    [out.tile(i, j)])
+
+    def attn_decode(self, out: TensorHandle, q: TensorHandle,
+                    kT: TensorHandle, v: TensorHandle, valid_len: int,
+                    scale: float, k_new: TensorHandle | None = None,
+                    v_new: TensorHandle | None = None):
+        """One-token flash-attention decode for ONE head (reference
+        make_attn: paged FA decode task).
+
+        q/out: (TILE, TILE) — rows = padded batch, cols = head_dim = TILE;
+        kT: (TILE, S) the head's cached keys transposed; v: (S, TILE).
+        ``valid_len`` masks cache columns >= valid (runtime-updatable queue
+        word). ``k_new``/``v_new`` (each one (TILE, TILE) tile, row b = the
+        token batch row b just projected) join the softmax as the current
+        position, so the host appends the cache *after* the step.
+        """
+        if q.rt != 1 or q.ct != 1 or out.rt != 1 or out.ct != 1:
+            raise ValueError("q/out must be a single (TILE, TILE) tile")
+        if kT.rt != 1 or v.ct != 1 or kT.ct != v.rt:
+            raise ValueError("kT must be (TILE, S), v (S, TILE)")
+        if (k_new is None) != (v_new is None):
+            raise ValueError("pass both k_new and v_new or neither")
+        if k_new is None and valid_len < 1:
+            raise ValueError("cache-only attention needs valid_len >= 1 "
+                             "(all-masked softmax)")
+        if valid_len > kT.ct * TILE:
+            raise ValueError(
+                f"valid_len {valid_len} exceeds cache capacity "
+                f"{kT.ct * TILE} — the mask would admit garbage positions")
+        if k_new is not None and (k_new.rt != 1 or k_new.ct != 1
+                                  or v_new.rt != 1 or v_new.ct != 1):
+            raise ValueError("k_new/v_new must be single (TILE, TILE) tiles "
+                             "(one head's current k/v — use a _col view)")
+        # Fully-masked cache tiles contribute nothing: don't visit them.
+        # (k_tiles rides the queue like valid_len, so a host-side queue
+        # update for a later position bumps both words consistently.)
+        k_tiles = min(kT.ct, -(-valid_len // TILE))
+        reads = ([q.tile(0, 0)] + [kT.tile(0, j) for j in range(k_tiles)]
+                 + [v.tile(j, 0) for j in range(k_tiles)])
+        c0 = d0 = -1
+        if k_new is not None:
+            c0, d0 = k_new.tile(0, 0), v_new.tile(0, 0)
+            reads += [c0, d0]
+        self._emit(
+            Task(TaskType.ATTN_DECODE, out.tile(0, 0), a0=q.tile(0, 0),
+                 b0=kT.tile(0, 0), k_tiles=k_tiles, a_stride=v.tile(0, 0),
+                 b_stride=int(valid_len), arg=int(round(scale * 1e6)),
+                 c0=c0, d0=d0),
+            reads, [out.tile(0, 0)])
+
     # -- compile / run -------------------------------------------------------
     def compile(self, num_ranks: int = 1, axis: str = "tp"
                 ) -> "CompiledMegaKernel":

@@ -33,7 +33,7 @@ from triton_distributed_tpu.runtime.context import use_interpret
 
 
 def _mega_kernel(n: int, axis: str, n_tasks: int,
-                 queue_ref, ws_in, ws_out, slots, va, vb, vacc,
+                 queue_ref, ws_in, ws_out, slots, va, vb, vacc, vq,
                  copy_sem, send_sems, recv_sem):
     step = pl.program_id(0)
 
@@ -52,6 +52,7 @@ def _mega_kernel(n: int, axis: str, n_tasks: int,
 
     out, a0, b0 = w(1), w(2), w(3)
     k_tiles, a_stride, b_stride, arg = w(4), w(5), w(6), w(7)
+    c0, d0 = w(8), w(9)
 
     def load(idx, vref):
         cp = pltpu.make_async_copy(ws_out.at[idx], vref, copy_sem)
@@ -126,8 +127,103 @@ def _mega_kernel(n: int, axis: str, n_tasks: int,
         va[...] = va[...] * (arg.astype(jnp.float32) * 1e-6)
         store(va, out)
 
+    def t_rms_norm():
+        # One task normalizes a whole row block: k_tiles column tiles of x
+        # starting at a0, scaled by the weight tiles at b0 (weight stored as
+        # a broadcast (TILE, cols) tensor), written to out.. . eps arrives
+        # fixed-point 1e-9 in arg. Reference tasks/rms_norm.py.
+        vacc[...] = jnp.zeros_like(vacc)
+
+        def pass1(j, _):
+            load(a0 + j, va)
+            vacc[:, :1] += jnp.sum(va[...] * va[...], axis=1, keepdims=True)
+            return 0
+
+        jax.lax.fori_loop(0, k_tiles, pass1, 0)
+        cols = (k_tiles * TILE).astype(jnp.float32)
+        eps = arg.astype(jnp.float32) * 1e-9
+        scale = jax.lax.rsqrt(vacc[:, :1] / cols + eps)
+
+        def pass2(j, _):
+            load(a0 + j, va)
+            load(b0 + j, vb)
+            va[...] = va[...] * scale * vb[...]
+            store(va, out + j)
+            return 0
+
+        jax.lax.fori_loop(0, k_tiles, pass2, 0)
+
+    def t_rope():
+        # HF half-split rotation: out = a*cos + rotate_half(a)*sin with
+        # rotate_half(a) = concat(-a2, a1). cos/sin are full-width tables
+        # (each half repeated), prepared host-side. Reference: the qk-norm+
+        # rope task (mega_triton_kernel tasks).
+        load(a0, va)
+        load(b0, vb)    # cos
+        load(arg, vq)   # sin
+        half = TILE // 2
+        a1, a2 = va[:, :half], va[:, half:]
+        rot = jnp.concatenate([-a2, a1], axis=1)
+        va[...] = va[...] * vb[...] + rot * vq[...]
+        store(va, out)
+
+    def t_attn_decode():
+        # Single-token GQA decode for one q head: online-softmax flash
+        # attention over S = k_tiles*TILE cached positions, masked to
+        # b_stride valid rows. q: one (TILE, TILE) tile (rows = padded
+        # batch, cols = head_dim); KT tiles at b0+j (d, TILE); V tiles at
+        # a_stride+j (TILE, d). When c0 >= 0, the current token's k/v tiles
+        # (c0/d0, each (B, d), one per batch row) join the softmax rowwise —
+        # the cache is appended after the step instead of mutated in-kernel.
+        # Reference: tasks/flash_attn.py (paged FA decode task).
+        load(a0, vq)
+        scale = arg.astype(jnp.float32) * 1e-6
+        valid = b_stride
+        neg = jnp.float32(-1e30)
+        vacc[...] = jnp.zeros_like(vacc)
+        m0 = jnp.full((TILE, 1), neg, jnp.float32)
+        l0 = jnp.zeros((TILE, 1), jnp.float32)
+
+        def body(j, carry):
+            m, l = carry
+            load(b0 + j, vb)                       # KT_j: (d, TILE)
+            s = jnp.dot(vq[...], vb[...],
+                        preferred_element_type=jnp.float32) * scale
+            col = j * TILE + jax.lax.broadcasted_iota(
+                jnp.int32, (TILE, TILE), 1)
+            s = jnp.where(col < valid, s, neg)
+            m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m - m_new)
+            load(a_stride + j, vb)                 # V_j: (TILE, d)
+            pv = jnp.dot(p.astype(jnp.float32), vb[...],
+                         preferred_element_type=jnp.float32)
+            vacc[...] = vacc[...] * corr + pv
+            return (m_new, l * corr + jnp.sum(p, axis=1, keepdims=True))
+
+        m, l = jax.lax.fori_loop(0, k_tiles, body, (m0, l0))
+
+        @pl.when(c0 >= 0)
+        def _():
+            # Current token: per-row dot with each row's own k/v.
+            load(c0, vb)                           # k_new: (B, d)
+            s_cur = jnp.sum(vq[...] * vb[...], axis=1, keepdims=True) * scale
+            m_new = jnp.maximum(m, s_cur)
+            p_cur = jnp.exp(s_cur - m_new)
+            corr = jnp.exp(m - m_new)
+            load(d0, vb)                           # v_new: (B, d)
+            vacc[...] = vacc[...] * corr + p_cur * vb[...]
+            va[:, :1] = l * corr + p_cur
+
+        @pl.when(c0 < 0)
+        def _():
+            va[:, :1] = l
+
+        va[...] = vacc[...] / jnp.maximum(va[:, :1], 1e-30)
+        store(va, out)
+
     jax.lax.switch(w(0), [t_copy, t_add, t_silu_mul, t_gemm, t_allreduce,
-                          t_scale])
+                          t_scale, t_rms_norm, t_rope, t_attn_decode])
 
 
 def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp"):
@@ -153,6 +249,7 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp"):
             pltpu.VMEM((TILE, TILE), jnp.float32),
             pltpu.VMEM((TILE, TILE), jnp.float32),
             pltpu.VMEM((TILE, TILE), jnp.float32),
+            pltpu.VMEM((TILE, TILE), jnp.float32),   # vq: rope/attn operand
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
             pltpu.SemaphoreType.DMA(()),

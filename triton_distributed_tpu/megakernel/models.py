@@ -1,0 +1,197 @@
+"""MegaKernel model assembly — a whole decode step as one task queue.
+
+Reference: ``mega_triton_kernel/models/qwen3.py`` + ``model_builder.py``
+(make_qkv_proj / make_attn / make_o_proj / fc / silu_mul / rms_norm / add /
+allreduce assemble a Qwen3 decode step replayed as one persistent kernel —
+the 3.33 ms headline path, BASELINE.md).
+
+TPU assembly for a TP-sharded Qwen3-style layer (per device):
+
+    x ── rms_norm ── q/k/v proj ── per-head qk-norm + RoPE ──
+      attn_decode per q head (cached KV + in-step current token) ──
+      o-proj ── AllReduce ── +residual ──
+      rms_norm ── gate/up proj ── silu·mul ── down proj ── AllReduce ──
+      +residual
+
+One design delta from the reference: the KV cache is *not* mutated
+in-kernel — the current token's k/v join each attention task's softmax
+directly (ATTN_DECODE c0/d0 operands), and the host appends them to the
+cache after the step (a pure-functional update, idiomatic in jax where the
+cache is a traced value). Constraints: head_dim == TILE (128, the Qwen3
+value), batch <= TILE, hidden/ffn_local/head counts multiples of TILE where
+tiled.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from triton_distributed_tpu.layers.common import rope_cos_sin
+from triton_distributed_tpu.megakernel.builder import MegaKernelBuilder
+from triton_distributed_tpu.megakernel.tasks import TILE, TensorHandle
+
+
+def broadcast_rows(vec: np.ndarray) -> np.ndarray:
+    """A (cols,) vector as the (TILE, cols) broadcast tensor the RMS_NORM /
+    ROPE tasks read (row-replicated; tile (0, j) carries columns of j)."""
+    return np.broadcast_to(np.asarray(vec, np.float32),
+                           (TILE, vec.shape[-1])).copy()
+
+
+def rope_tables(pos: int, head_dim: int, theta: float) -> tuple[np.ndarray, np.ndarray]:
+    """Full-width (TILE, head_dim) cos/sin tables at ``pos`` (HF half-split:
+    each half repeats the head_dim/2 table)."""
+    cos, sin = rope_cos_sin(jnp.asarray([pos]), head_dim, theta)
+    cos, sin = np.asarray(cos)[0], np.asarray(sin)[0]
+    return (broadcast_rows(np.concatenate([cos, cos])),
+            broadcast_rows(np.concatenate([sin, sin])))
+
+
+def _col(t: TensorHandle, j: int) -> TensorHandle:
+    """Single column-tile view (valid because activations have rt == 1)."""
+    assert t.rt == 1
+    return TensorHandle(t.base + j, TILE, TILE)
+
+
+@dataclasses.dataclass
+class DecodeLayerHandles:
+    """Workspace handles for one layer's weights + caches + outputs."""
+
+    attn_norm: TensorHandle     # (TILE, hidden) broadcast
+    mlp_norm: TensorHandle
+    q_norm: TensorHandle        # (TILE, d) broadcast (Qwen3 qk-norm)
+    k_norm: TensorHandle
+    wq: TensorHandle            # (hidden, hq_local*d)
+    wk: TensorHandle            # (hidden, hkv_local*d)
+    wv: TensorHandle
+    wo: TensorHandle            # (hq_local*d, hidden)
+    w_gate: TensorHandle        # (hidden, ffn_local)
+    w_up: TensorHandle
+    w_down: TensorHandle        # (ffn_local, hidden)
+    kT: list[TensorHandle]      # per kv head: (d, S) keys transposed
+    v: list[TensorHandle]       # per kv head: (S, d)
+    k_new: TensorHandle         # (TILE, hkv_local*d) — this step's k (out)
+    v_new: TensorHandle
+
+
+@dataclasses.dataclass
+class DecodeStepProgram:
+    """Builder + handles for a full decode step."""
+
+    mb: MegaKernelBuilder
+    x: TensorHandle
+    layers: list[DecodeLayerHandles]
+    cos: TensorHandle
+    sin: TensorHandle
+    x_out: TensorHandle
+
+
+def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
+                       h: DecodeLayerHandles, cos: TensorHandle,
+                       sin: TensorHandle, *, hq_local: int, hkv_local: int,
+                       pos: int, num_ranks: int,
+                       eps: float = 1e-6) -> TensorHandle:
+    """Emit one transformer layer's decode tasks; returns the output x."""
+    hidden = x.cols
+    d = TILE
+    groups = hq_local // hkv_local
+    scale = d ** -0.5
+
+    xn = mb.tensor(TILE, hidden)
+    mb.rms_norm(xn, x, h.attn_norm, eps)
+
+    q = mb.tensor(TILE, hq_local * d)
+    mb.gemm(q, xn, h.wq)
+    mb.gemm(h.k_new, xn, h.wk)
+    mb.gemm(h.v_new, xn, h.wv)
+
+    # Per-head qk-norm (head_dim == TILE → one-tile-wide RMSNorm) + RoPE.
+    for j in range(hq_local):
+        mb.rms_norm(_col(q, j), _col(q, j), h.q_norm, eps)
+        mb.rope(_col(q, j), _col(q, j), cos, sin)
+    for j in range(hkv_local):
+        mb.rms_norm(_col(h.k_new, j), _col(h.k_new, j), h.k_norm, eps)
+        mb.rope(_col(h.k_new, j), _col(h.k_new, j), cos, sin)
+
+    attn = mb.tensor(TILE, hq_local * d)
+    for j in range(hq_local):
+        kv = j // groups
+        mb.attn_decode(_col(attn, j), _col(q, j), h.kT[kv], h.v[kv],
+                       valid_len=pos, scale=scale,
+                       k_new=_col(h.k_new, kv), v_new=_col(h.v_new, kv))
+
+    o = mb.tensor(TILE, hidden)
+    mb.gemm(o, attn, h.wo)
+    if num_ranks > 1:
+        mb.all_reduce(o)
+    x1 = mb.tensor(TILE, hidden)
+    mb.add(x1, x, o)
+
+    x1n = mb.tensor(TILE, hidden)
+    mb.rms_norm(x1n, x1, h.mlp_norm, eps)
+    ffn_local = h.w_gate.cols
+    gate = mb.tensor(TILE, ffn_local)
+    up = mb.tensor(TILE, ffn_local)
+    act = mb.tensor(TILE, ffn_local)
+    mb.gemm(gate, x1n, h.w_gate)
+    mb.gemm(up, x1n, h.w_up)
+    mb.silu_mul(act, gate, up)
+    down = mb.tensor(TILE, hidden)
+    mb.gemm(down, act, h.w_down)
+    if num_ranks > 1:
+        mb.all_reduce(down)
+    x2 = mb.tensor(TILE, hidden)
+    mb.add(x2, x1, down)
+    return x2
+
+
+def build_decode_step(*, hidden: int, hq_local: int, hkv_local: int,
+                      ffn_local: int, num_layers: int, max_seq: int,
+                      pos: int, num_ranks: int = 1,
+                      eps: float = 1e-6) -> DecodeStepProgram:
+    """Assemble a full num_layers decode step (per-device TP view).
+
+    ``hq_local``/``hkv_local``/``ffn_local`` are this device's shards;
+    head_dim is TILE. The embedding lookup and the lm_head stay outside (the
+    reference megakernel also serves the transformer stack; sampling is
+    host-side)."""
+    if hidden % TILE or ffn_local % TILE or max_seq % TILE:
+        raise ValueError("hidden/ffn_local/max_seq must be TILE multiples")
+    if not 0 <= pos < max_seq:
+        raise ValueError(f"pos {pos} outside cache capacity {max_seq} "
+                         "(the step appends this position's k/v)")
+    mb = MegaKernelBuilder()
+    x = mb.tensor(TILE, hidden)
+    cos = mb.tensor(TILE, TILE)
+    sin = mb.tensor(TILE, TILE)
+    layers: list[DecodeLayerHandles] = []
+    d = TILE
+    for _ in range(num_layers):
+        layers.append(DecodeLayerHandles(
+            attn_norm=mb.tensor(TILE, hidden),
+            mlp_norm=mb.tensor(TILE, hidden),
+            q_norm=mb.tensor(TILE, d),
+            k_norm=mb.tensor(TILE, d),
+            wq=mb.tensor(hidden, hq_local * d),
+            wk=mb.tensor(hidden, hkv_local * d),
+            wv=mb.tensor(hidden, hkv_local * d),
+            wo=mb.tensor(hq_local * d, hidden),
+            w_gate=mb.tensor(hidden, ffn_local),
+            w_up=mb.tensor(hidden, ffn_local),
+            w_down=mb.tensor(ffn_local, hidden),
+            kT=[mb.tensor(d, max_seq) for _ in range(hkv_local)],
+            v=[mb.tensor(max_seq, d) for _ in range(hkv_local)],
+            k_new=mb.tensor(TILE, hkv_local * d),
+            v_new=mb.tensor(TILE, hkv_local * d),
+        ))
+
+    cur = x
+    for h in layers:
+        cur = build_decode_layer(mb, cur, h, cos, sin, hq_local=hq_local,
+                                 hkv_local=hkv_local, pos=pos,
+                                 num_ranks=num_ranks, eps=eps)
+    return DecodeStepProgram(mb=mb, x=x, layers=layers, cos=cos, sin=sin,
+                             x_out=cur)

@@ -18,7 +18,7 @@ import dataclasses
 import enum
 
 TILE = 128      # square fp32 tile (8×128 sublane-aligned, MXU-shaped)
-WORDS = 8       # int32 words per task
+WORDS = 10      # int32 words per task
 
 
 class TaskType(enum.IntEnum):
@@ -30,12 +30,23 @@ class TaskType(enum.IntEnum):
     GEMM = 3        # out <- [acc +] sum_j a[a0+j*as] @ b[b0+j*bs]
     ALLREDUCE = 4   # out <- sum over ranks of out (one tile, one-shot)
     SCALE = 5       # out <- a * scalar (scalar in word 7 as fixed-point 1e-6)
+    RMS_NORM = 6    # out row <- a row * rsqrt(mean(a^2)+eps) * w; one task
+    #                 per row of k_tiles column tiles; eps fixed-point 1e-9
+    ROPE = 7        # out <- a*cos + rotate_half(a)*sin (HF half-split);
+    #                 b0 = cos tile, arg = sin tile (full-width tables)
+    ATTN_DECODE = 8  # out <- softmax(q @ KT * scale, masked to valid) @ V
+    #                 a0=q tile, b0=KT base, a_stride=V base, k_tiles=S/TILE,
+    #                 b_stride=valid_len (runtime-updatable), arg=scale*1e6,
+    #                 c0/d0 = current-token k/v tiles (-1 = cache only):
+    #                 the new token's (B, d) k/v join the softmax rowwise,
+    #                 so the cache is appended AFTER the step (no in-kernel
+    #                 tile mutation needed)
 
 
 @dataclasses.dataclass(frozen=True)
 class Task:
     """One queue entry. Word layout:
-    [type, out, a0, b0, k_tiles, a_stride, b_stride, arg]."""
+    [type, out, a0, b0, k_tiles, a_stride, b_stride, arg, c0, d0]."""
 
     type: TaskType
     out: int
@@ -45,10 +56,12 @@ class Task:
     a_stride: int = 0
     b_stride: int = 0
     arg: int = 0
+    c0: int = 0
+    d0: int = 0
 
     def encode(self) -> list[int]:
         return [int(self.type), self.out, self.a0, self.b0, self.k_tiles,
-                self.a_stride, self.b_stride, self.arg]
+                self.a_stride, self.b_stride, self.arg, self.c0, self.d0]
 
 
 @dataclasses.dataclass(frozen=True)
