@@ -125,6 +125,47 @@ def test_decode_step_single_device():
                                rtol=2e-3, atol=2e-3)
 
 
+def test_decode_queue_reuse_across_positions():
+    """One compiled program serves every decode position: build at
+    max_seq-1, retarget with advance_queue_pos (runtime queue words), feed
+    the position's rope tables — no recompile (the CUDA-graph-replay
+    analog)."""
+    import dataclasses
+
+    from triton_distributed_tpu.megakernel.models import advance_queue_pos
+
+    hidden, hq, hkv, ffn, S, B = 256, 2, 1, 256, 256, 3
+    rng = np.random.default_rng(2)
+    prog = build_decode_step(hidden=hidden, hq_local=hq, hkv_local=hkv,
+                             ffn_local=ffn, num_layers=1, max_seq=S,
+                             pos=S - 1, num_ranks=1)
+    compiled = prog.mb.compile()
+    w = _rand_layer_weights(rng, hidden, hq, hkv, ffn, S - 1)
+    kT_np = [rng.standard_normal((TILE, S)).astype(np.float32) * 0.3
+             for _ in range(hkv)]
+    v_np = [rng.standard_normal((S, TILE)).astype(np.float32) * 0.3
+            for _ in range(hkv)]
+    x = np.zeros((TILE, hidden), np.float32)
+    x[:B] = rng.standard_normal((B, hidden)).astype(np.float32) * 0.3
+
+    for pos in (1, 60, 200):
+        cos_full, sin_full = rope_tables(pos, TILE, 1e6)
+        step = dataclasses.replace(compiled,
+                                   queue=advance_queue_pos(compiled.queue,
+                                                           pos))
+        feeds = {prog.x: jnp.asarray(x), prog.cos: jnp.asarray(cos_full),
+                 prog.sin: jnp.asarray(sin_full)}
+        feeds.update({k: jnp.asarray(val) for k, val in _feed_layer(
+            prog, prog.layers[0], w, kT_np, v_np).items()})
+        (out,) = step.run(feeds, outputs=[prog.x_out])
+
+        w_pos = dict(w, cos_h=cos_full[0, :TILE // 2],
+                     sin_h=sin_full[0, :TILE // 2])
+        ref = _golden_layer(x[:B], w_pos, pos, kT_np, v_np, hq, hkv)
+        np.testing.assert_allclose(np.asarray(out)[:B], ref,
+                                   rtol=2e-3, atol=2e-3)
+
+
 def test_decode_step_tp8(ctx):
     """TP=8 over the CPU mesh: per-device head/ffn shards + in-kernel AR."""
     hidden, HQ, HKV, FFN, S, pos, B = 256, 8, 8, 1024, 128, 60, 2

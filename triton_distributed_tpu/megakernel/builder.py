@@ -84,22 +84,23 @@ class MegaKernelBuilder:
         self._ew(TaskType.SILU_MUL, out, gate, up)
 
     def scale(self, out: TensorHandle, a: TensorHandle, factor: float):
-        arg = int(round(factor * 1e6))
-        for i in range(out.rt):
-            for j in range(out.ct):
-                self._emit(Task(TaskType.SCALE, out.tile(i, j),
-                                a.tile(i, j), arg=arg),
-                           [a.tile(i, j)], [out.tile(i, j)])
+        self._ew(TaskType.SCALE, out, a, arg=int(round(factor * 1e6)))
 
-    def _ew(self, tt: TaskType, out, a, b=None):
+    def _ew(self, tt: TaskType, out, a, b=None, arg: int = 0):
+        """One task per ROW of tiles (k_tiles = ct): the kernel streams the
+        row's tiles double-buffered, so wide elementwise ops cost one task's
+        dispatch instead of ct (the per-tile version serialized ~3 DMA
+        round-trips per tile)."""
         if (out.rt, out.ct) != (a.rt, a.ct) or (b and (b.rt, b.ct) != (a.rt, a.ct)):
             raise ValueError("elementwise shape mismatch")
         for i in range(out.rt):
-            for j in range(out.ct):
-                reads = [a.tile(i, j)] + ([b.tile(i, j)] if b else [])
-                self._emit(Task(tt, out.tile(i, j), a.tile(i, j),
-                                b.tile(i, j) if b else 0),
-                           reads, [out.tile(i, j)])
+            reads = [a.tile(i, j) for j in range(a.ct)]
+            if b:
+                reads += [b.tile(i, j) for j in range(a.ct)]
+            self._emit(Task(tt, out.tile(i, 0), a0=a.tile(i, 0),
+                            b0=b.tile(i, 0) if b else a.tile(i, 0),
+                            k_tiles=a.ct, arg=arg),
+                       reads, [out.tile(i, j) for j in range(out.ct)])
 
     def gemm(self, out: TensorHandle, a: TensorHandle, b: TensorHandle):
         """out (M,N) = a (M,K) @ b (K,N), one task per output tile
@@ -241,12 +242,24 @@ class CompiledMegaKernel:
         return tiles.reshape(h.rt, h.ct, TILE, TILE).transpose(
             0, 2, 1, 3).reshape(h.rows, h.cols)
 
-    def run(self, inputs: dict, outputs: list[TensorHandle],
-            _device_local: bool = True):
-        """Device-local execution (inside shard_map when num_ranks > 1)."""
+    def make_workspace(self, inputs: dict) -> jax.Array:
+        """Build the tiled workspace once (weights + caches + activations).
+        In a serving loop, scatter weights here a single time and update
+        only the per-step tensors afterward (scatter_input is jittable)."""
         ws = jnp.zeros((max(self.num_tiles, 1), TILE, TILE), jnp.float32)
         for h, v in inputs.items():
             ws = self.scatter_input(ws, h, v)
-        ws = run_queue(self.queue, ws, num_ranks=self.num_ranks,
-                       axis=self.axis)
+        return ws
+
+    def step(self, ws: jax.Array, queue: jax.Array | None = None) -> jax.Array:
+        """One queue execution over a prebuilt workspace (jittable; pass an
+        advance_queue_pos-updated ``queue`` to retarget without recompile).
+        Device-local: wrap in shard_map when num_ranks > 1."""
+        return run_queue(self.queue if queue is None else queue, ws,
+                         num_ranks=self.num_ranks, axis=self.axis)
+
+    def run(self, inputs: dict, outputs: list[TensorHandle],
+            _device_local: bool = True):
+        """Device-local execution (inside shard_map when num_ranks > 1)."""
+        ws = self.step(self.make_workspace(inputs))
         return [self.gather_output(ws, h) for h in outputs]

@@ -29,13 +29,17 @@ from triton_distributed_tpu import language as dl
 from triton_distributed_tpu.language import shmem_device as shmem
 from triton_distributed_tpu.language.core import kernel_call, any_spec
 from triton_distributed_tpu.megakernel.tasks import TILE, WORDS
+
+PIPE_DEPTH = 4  # outstanding tile-pair loads per task stream
 from triton_distributed_tpu.runtime.context import use_interpret
 
 
 def _mega_kernel(n: int, axis: str, n_tasks: int,
-                 queue_ref, ws_in, ws_out, slots, va, vb, vacc, vq,
-                 copy_sem, send_sems, recv_sem):
+                 queue_ref, ws_in, ws_out, slots, va2, vb2, vacc, vq,
+                 copy_sem, pipe_sems, send_sems, recv_sem):
     step = pl.program_id(0)
+    # Double-buffer views: slot 0 is the default for unpipelined tasks.
+    va, vb = va2.at[0], vb2.at[0]
 
     # Step 0: materialize the workspace into the output buffer all tasks
     # read/write (results chain task-to-task within one launch).
@@ -64,33 +68,77 @@ def _mega_kernel(n: int, axis: str, n_tasks: int,
         cp.start()
         cp.wait()
 
+    # Pipelined pair loads: tile streams (a_of(j), b_of(j)) double-buffered
+    # so iteration j's MXU work overlaps iteration j+1's DMA — the intra-
+    # task analog of ops/tiling.py's emit_pipeline.
+    def pipelined_pairs(a_of, b_of, n_iters, body_fn, init):
+        # DEPTH tile-pairs in flight: a single-buffer lookahead cannot hide
+        # ~2us DMA latency under a 128x128 dot; 3 outstanding pairs can.
+        # b_of=None streams only `a` (the body's b_ref is then invalid) —
+        # copy/scale/rms-pass1 would otherwise double their HBM reads.
+        def desc(idx, vref2, slot, sem_i):
+            return pltpu.make_async_copy(ws_out.at[idx], vref2.at[slot],
+                                         pipe_sems.at[sem_i])
+
+        def start(j, slot):
+            desc(a_of(j), va2, slot, slot * 2).start()
+            if b_of is not None:
+                desc(b_of(j), vb2, slot, slot * 2 + 1).start()
+
+        def wait(j, slot):
+            desc(a_of(j), va2, slot, slot * 2).wait()
+            if b_of is not None:
+                desc(b_of(j), vb2, slot, slot * 2 + 1).wait()
+
+        for jj in range(PIPE_DEPTH - 1):
+            @pl.when(jj < n_iters)
+            def _(jj=jj):
+                start(jj, jj)
+
+        def body(j, carry):
+            slot = jax.lax.rem(j, PIPE_DEPTH)
+
+            @pl.when(j + PIPE_DEPTH - 1 < n_iters)
+            def _():
+                start(j + PIPE_DEPTH - 1,
+                      jax.lax.rem(j + PIPE_DEPTH - 1, PIPE_DEPTH))
+
+            wait(j, slot)
+            return body_fn(j, va2.at[slot], vb2.at[slot], carry)
+
+        return jax.lax.fori_loop(0, n_iters, body, init)
+
+    # Elementwise tasks stream a whole tile row (k_tiles tiles) per task,
+    # pipelined; unary ops stream a single buffer.
+    def _ew_task(fn, binary=True):
+        def body(j, a_ref, b_ref, _):
+            vq[...] = fn(a_ref[...], b_ref[...])
+            store(vq, out + j)
+            return 0
+
+        pipelined_pairs(lambda j: a0 + j,
+                        (lambda j: b0 + j) if binary else None,
+                        k_tiles, body, 0)
+
     def t_copy():
-        load(a0, va)
-        store(va, out)
+        _ew_task(lambda a, b: a, binary=False)
 
     def t_add():
-        load(a0, va)
-        load(b0, vb)
-        va[...] = va[...] + vb[...]
-        store(va, out)
+        _ew_task(lambda a, b: a + b)
 
     def t_silu_mul():
-        load(a0, va)
-        load(b0, vb)
-        va[...] = jax.nn.silu(va[...]) * vb[...]
-        store(va, out)
+        _ew_task(lambda a, b: jax.nn.silu(a) * b)
 
     def t_gemm():
         vacc[...] = jnp.zeros_like(vacc)
 
-        def body(j, _):
-            load(a0 + j * a_stride, va)
-            load(b0 + j * b_stride, vb)
+        def body(j, a_ref, b_ref, _):
             vacc[...] = vacc[...] + jnp.dot(
-                va[...], vb[...], preferred_element_type=jnp.float32)
+                a_ref[...], b_ref[...], preferred_element_type=jnp.float32)
             return 0
 
-        jax.lax.fori_loop(0, k_tiles, body, 0)
+        pipelined_pairs(lambda j: a0 + j * a_stride,
+                        lambda j: b0 + j * b_stride, k_tiles, body, 0)
         va[...] = vacc[...]
         store(va, out)
 
@@ -123,35 +171,34 @@ def _mega_kernel(n: int, axis: str, n_tasks: int,
         shmem.barrier_all(axis)
 
     def t_scale():
-        load(a0, va)
-        va[...] = va[...] * (arg.astype(jnp.float32) * 1e-6)
-        store(va, out)
+        factor = arg.astype(jnp.float32) * 1e-6
+        _ew_task(lambda a, b: a * factor, binary=False)
 
     def t_rms_norm():
         # One task normalizes a whole row block: k_tiles column tiles of x
         # starting at a0, scaled by the weight tiles at b0 (weight stored as
-        # a broadcast (TILE, cols) tensor), written to out.. . eps arrives
-        # fixed-point 1e-9 in arg. Reference tasks/rms_norm.py.
+        # a broadcast (TILE, cols) tensor), written to out. eps arrives
+        # fixed-point 1e-9 in arg. Reference tasks/rms_norm.py. Both passes
+        # stream (x_j, w_j) pairs double-buffered.
         vacc[...] = jnp.zeros_like(vacc)
 
-        def pass1(j, _):
-            load(a0 + j, va)
-            vacc[:, :1] += jnp.sum(va[...] * va[...], axis=1, keepdims=True)
+        def pass1(j, a_ref, _w_ref, _):
+            vacc[:, :1] += jnp.sum(a_ref[...] * a_ref[...], axis=1,
+                                   keepdims=True)
             return 0
 
-        jax.lax.fori_loop(0, k_tiles, pass1, 0)
+        pipelined_pairs(lambda j: a0 + j, None, k_tiles, pass1, 0)
         cols = (k_tiles * TILE).astype(jnp.float32)
         eps = arg.astype(jnp.float32) * 1e-9
         scale = jax.lax.rsqrt(vacc[:, :1] / cols + eps)
 
-        def pass2(j, _):
-            load(a0 + j, va)
-            load(b0 + j, vb)
-            va[...] = va[...] * scale * vb[...]
-            store(va, out + j)
+        def pass2(j, a_ref, w_ref, _):
+            vq[...] = a_ref[...] * scale * w_ref[...]
+            store(vq, out + j)
             return 0
 
-        jax.lax.fori_loop(0, k_tiles, pass2, 0)
+        pipelined_pairs(lambda j: a0 + j, lambda j: b0 + j, k_tiles,
+                        pass2, 0)
 
     def t_rope():
         # HF half-split rotation: out = a*cos + rotate_half(a)*sin with
@@ -184,10 +231,9 @@ def _mega_kernel(n: int, axis: str, n_tasks: int,
         m0 = jnp.full((TILE, 1), neg, jnp.float32)
         l0 = jnp.zeros((TILE, 1), jnp.float32)
 
-        def body(j, carry):
+        def body(j, kt_ref, v_ref, carry):
             m, l = carry
-            load(b0 + j, vb)                       # KT_j: (d, TILE)
-            s = jnp.dot(vq[...], vb[...],
+            s = jnp.dot(vq[...], kt_ref[...],     # KT_j: (d, TILE)
                         preferred_element_type=jnp.float32) * scale
             col = j * TILE + jax.lax.broadcasted_iota(
                 jnp.int32, (TILE, TILE), 1)
@@ -195,13 +241,13 @@ def _mega_kernel(n: int, axis: str, n_tasks: int,
             m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
             p = jnp.exp(s - m_new)
             corr = jnp.exp(m - m_new)
-            load(a_stride + j, vb)                 # V_j: (TILE, d)
-            pv = jnp.dot(p.astype(jnp.float32), vb[...],
+            pv = jnp.dot(p.astype(jnp.float32), v_ref[...],  # V_j: (TILE, d)
                          preferred_element_type=jnp.float32)
             vacc[...] = vacc[...] * corr + pv
             return (m_new, l * corr + jnp.sum(p, axis=1, keepdims=True))
 
-        m, l = jax.lax.fori_loop(0, k_tiles, body, (m0, l0))
+        m, l = pipelined_pairs(lambda j: b0 + j, lambda j: a_stride + j,
+                               k_tiles, body, (m0, l0))
 
         @pl.when(c0 >= 0)
         def _():
@@ -246,11 +292,12 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp"):
         in_specs=[any_spec()],
         out_specs=(any_spec(), any_spec()),
         scratch_shapes=[
-            pltpu.VMEM((TILE, TILE), jnp.float32),
-            pltpu.VMEM((TILE, TILE), jnp.float32),
-            pltpu.VMEM((TILE, TILE), jnp.float32),
-            pltpu.VMEM((TILE, TILE), jnp.float32),   # vq: rope/attn operand
-            pltpu.SemaphoreType.DMA(()),
+            pltpu.VMEM((PIPE_DEPTH, TILE, TILE), jnp.float32),  # va2
+            pltpu.VMEM((PIPE_DEPTH, TILE, TILE), jnp.float32),  # vb2
+            pltpu.VMEM((TILE, TILE), jnp.float32),     # vacc
+            pltpu.VMEM((TILE, TILE), jnp.float32),     # vq: rope/attn operand
+            pltpu.SemaphoreType.DMA(()),               # copy_sem
+            pltpu.SemaphoreType.DMA((2 * PIPE_DEPTH,)),  # pipe_sems (slot x a/b)
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
             pltpu.SemaphoreType.DMA(()),
         ],

@@ -89,6 +89,37 @@ class DecodeStepProgram:
     x_out: TensorHandle
 
 
+def advance_queue_pos(base_queue, pos: int):
+    """Re-target a compiled decode queue to position ``pos`` WITHOUT
+    recompiling: ATTN_DECODE's valid_len (word 6) and visited-tile count
+    (word 4) are runtime queue words, so one host-side int32 edit per step
+    retargets every attention task — the decode loop replays ONE compiled
+    kernel. RoPE tables are workspace inputs: feed ``rope_tables(pos, ...)``
+    alongside. (The reference re-enqueues task params the same way,
+    model_builder.py enque_tasks/run.)
+
+    ``base_queue`` must come from a program built at ``pos = max_seq - 1``
+    (full cache capacity in word 4); returns an updated int32 copy.
+    """
+    from triton_distributed_tpu.megakernel.tasks import TaskType
+
+    q = np.asarray(base_queue).copy()
+    attn = q[:, 0] == int(TaskType.ATTN_DECODE)
+    need = -(-pos // TILE)
+    if np.any(q[attn, 4] < need):
+        raise ValueError(
+            f"base queue visits {int(q[attn, 4].min(initial=0))} cache "
+            f"tiles but pos {pos} needs {need} — build the program at "
+            "pos = max_seq - 1 (silently dropping cache positions would "
+            "corrupt the softmax)")
+    if pos < 1 and np.any(q[attn, 8] < 0):
+        raise ValueError("pos 0 with a cache-only attention task would be "
+                         "an all-masked softmax")
+    q[attn, 6] = pos
+    q[attn, 4] = np.minimum(q[attn, 4], need)
+    return jnp.asarray(q)
+
+
 def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
                        h: DecodeLayerHandles, cos: TensorHandle,
                        sin: TensorHandle, *, hq_local: int, hkv_local: int,
