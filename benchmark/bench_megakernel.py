@@ -5,7 +5,7 @@ Qwen3-8B 8xH800 — docs/mega_triton_kernel.md, BASELINE.md).
 Single-device run on this host's chip: per-device TP-shard shapes of the
 chosen model, fp32 (the megakernel tile format); the eager baseline is the
 IDENTICAL math under plain jax.jit. Timing: on-device chains of N steps
-(x_out fed back to x by an in-queue COPY task / loop carry), differenced
+(x_out fed back to x by an in-queue damped SCALE task / loop carry),
 over two lengths — dispatch and relay overhead cancel (bench.py method).
 
     python benchmark/bench_megakernel.py [--layers 1] [--seq 1024]

@@ -60,6 +60,62 @@ def test_auto_llm_from_hf_model(ctx, hf_model):
     assert out.shape == (1, 3)
 
 
+def test_llama_family_logits_match_transformers(ctx):
+    """Non-qk-norm families (Llama/Qwen2 style) must convert and match —
+    qk_norm is gated on model_type (unit-weight RMSNorm still renormalizes,
+    so applying it to Llama heads would corrupt them)."""
+    cfg_hf = transformers.LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=8, num_key_value_heads=8, head_dim=8,
+        vocab_size=128, rope_theta=1e4, tie_word_embeddings=False,
+        attention_bias=False, mlp_bias=False)
+    torch.manual_seed(1)
+    with torch.device("cpu"):
+        m = transformers.LlamaForCausalLM(cfg_hf)
+    m = m.eval()
+
+    cfg = config_from_hf(m.config)
+    assert not cfg.qk_norm
+    params = convert_hf_state_dict(m.state_dict(), cfg, dtype=jnp.float32)
+    eng = Engine(cfg, params, ctx=ctx, backend="xla", max_seq=32)
+
+    ids = np.array([[3, 17, 42, 99, 7]], np.int32)
+    with torch.no_grad():
+        ref = m(torch.from_numpy(ids.astype(np.int64))).logits[:, -1]
+    logits, _ = eng.prefill(jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(logits), ref.float().numpy(),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_qwen3_moe_logits_match_transformers(ctx):
+    """MoE conversion parity: stacked expert weights + router + EP/TP MoE
+    forward vs transformers' Qwen3MoeForCausalLM. norm_topk_prob=True is
+    the published Qwen3-MoE setting and matches the framework's
+    softmax-over-selected router convention."""
+    cfg_hf = transformers.Qwen3MoeConfig(
+        hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=8, num_key_value_heads=8, head_dim=16,
+        vocab_size=128, rope_theta=1e6, tie_word_embeddings=False,
+        num_experts=8, num_experts_per_tok=2, moe_intermediate_size=64,
+        norm_topk_prob=True, decoder_sparse_step=1)
+    torch.manual_seed(2)
+    with torch.device("cpu"):
+        m = transformers.Qwen3MoeForCausalLM(cfg_hf)
+    m = m.eval()
+
+    cfg = config_from_hf(m.config)
+    assert cfg.is_moe and cfg.num_experts == 8
+    params = convert_hf_state_dict(m.state_dict(), cfg, dtype=jnp.float32)
+    eng = Engine(cfg, params, ctx=ctx, backend="xla", max_seq=32)
+
+    ids = np.array([[3, 17, 42, 99, 7, 56, 11, 88]], np.int32)
+    with torch.no_grad():
+        ref = m(torch.from_numpy(ids.astype(np.int64))).logits[:, -1]
+    logits, _ = eng.prefill(jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(logits), ref.float().numpy(),
+                               rtol=5e-3, atol=5e-3)
+
+
 def test_auto_llm_from_config(ctx):
     from triton_distributed_tpu.models.config import tiny_config
 

@@ -108,7 +108,7 @@ def advance_queue_pos(base_queue, pos: int):
     need = -(-pos // TILE)
     if np.any(q[attn, 4] < need):
         raise ValueError(
-            f"base queue visits {int(q[attn, 4].min(initial=0))} cache "
+            f"base queue visits {int(q[attn, 4].min())} cache "
             f"tiles but pos {pos} needs {need} — build the program at "
             "pos = max_seq - 1 (silently dropping cache positions would "
             "corrupt the softmax)")

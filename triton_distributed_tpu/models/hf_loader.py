@@ -34,7 +34,12 @@ def config_from_hf(hf_cfg: Any) -> ModelConfig:
     get = (hf_cfg.get if isinstance(hf_cfg, Mapping)
            else lambda k, d=None: getattr(hf_cfg, k, d))
     num_experts = get("num_experts", None) or 0
+    # Per-head q/k RMSNorm is a Qwen3-family trait; applying it with unit
+    # weights to a Llama/Qwen2-style model would still renormalize (and
+    # corrupt) the heads, so gate it on the architecture.
+    model_type = str(get("model_type", "qwen3"))
     return ModelConfig(
+        qk_norm="qwen3" in model_type,
         hidden_size=get("hidden_size"),
         intermediate_size=get("intermediate_size"),
         num_layers=get("num_hidden_layers"),
@@ -91,7 +96,14 @@ def convert_hf_state_dict(state_dict: Mapping[str, Any],
                 "wo": lin(pre + "self_attn.o_proj.weight"),
             },
         }
-        if cfg.qk_norm and pre + "self_attn.q_norm.weight" in sd:
+        has_qk_norm = pre + "self_attn.q_norm.weight" in sd
+        if has_qk_norm and not cfg.qk_norm:
+            raise ValueError(
+                "checkpoint ships q_norm/k_norm weights but the config "
+                "mapped to qk_norm=False (unrecognized model_type?) — "
+                "dropping them silently would corrupt logits; set "
+                "cfg.qk_norm=True")
+        if cfg.qk_norm and has_qk_norm:
             layer["attn"]["q_norm"] = vec(pre + "self_attn.q_norm.weight")
             layer["attn"]["k_norm"] = vec(pre + "self_attn.k_norm.weight")
         elif cfg.qk_norm:
