@@ -111,7 +111,7 @@ def main():
         # Qwen3-8B TP=8 per-device shard: hq=4, hkv=1, ffn=1536, h=4096.
         hidden, hq, hkv, ffn = 4096, 4, 1, 1536
         S = args.seq or 1024
-        lengths = (2, 18)
+        lengths = (8, 56)
     else:
         hidden, hq, hkv, ffn = 256, 2, 1, 256
         S = args.seq or 256
