@@ -139,3 +139,20 @@ def test_group_profile_produces_trace(ctx, tmp_path):
     produced = [p for p in (tmp_path / "ag_gemm_trace").rglob("*")
                 if p.is_file()]
     assert produced, "no trace files written"
+
+    # Merge (the reference's rank-0 _merge_json step): treat the same dir
+    # as two "hosts" and check the combined chrome trace loads.
+    import gzip
+    import json
+
+    from triton_distributed_tpu.runtime.utils import merge_profiles
+
+    out = tmp_path / "merged.trace.json.gz"
+    n = merge_profiles([str(tmp_path / "ag_gemm_trace")] * 2, str(out))
+    assert n == 2
+    with gzip.open(out, "rt") as f:
+        data = json.load(f)
+    assert data["traceEvents"], "merged trace has no events"
+    pids = {e.get("pid") for e in data["traceEvents"]
+            if isinstance(e.get("pid"), int)}
+    assert any(p >= 200_000 for p in pids), "second source pids not offset"

@@ -38,4 +38,6 @@ from triton_distributed_tpu.runtime.utils import (  # noqa: F401
     assert_allclose,
     cdiv,
     round_up,
+    group_profile,
+    merge_profiles,
 )

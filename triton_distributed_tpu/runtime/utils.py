@@ -90,6 +90,52 @@ def group_profile(name: str | None = None, do_prof: bool = False, log_dir: str =
         yield
 
 
+def merge_profiles(log_dirs, out_path: str) -> int:
+    """Merge per-host profiler traces into ONE chrome-trace JSON.
+
+    Reference analog: ``_merge_json`` / ``ParallelJsonDumper``
+    (utils.py:400-504) — every rank dumps its own chrome trace and rank 0
+    merges them with disambiguated pids. ``jax.profiler.trace`` writes a
+    ``*.trace.json.gz`` per host under
+    ``<log_dir>/plugins/profile/<run>/``; this collects every trace under
+    each of ``log_dirs``, prefixes pids per source so hosts don't collide,
+    and writes a single ``.json`` (or ``.json.gz``) loadable in Perfetto /
+    chrome://tracing. Returns the number of source traces merged.
+    """
+    import glob
+    import gzip
+    import json as _json
+
+    merged: list = []
+    n_sources = 0
+    for d_i, d in enumerate(log_dirs):
+        paths = sorted(glob.glob(os.path.join(d, "**", "*.trace.json.gz"),
+                                 recursive=True))
+        paths += sorted(glob.glob(os.path.join(d, "**", "*.trace.json"),
+                                  recursive=True))
+        for p in paths:
+            opener = gzip.open if p.endswith(".gz") else open
+            with opener(p, "rt") as f:
+                data = _json.load(f)
+            events = data.get("traceEvents", data if isinstance(data, list)
+                              else [])
+            host = os.path.basename(p).split(".")[0]
+            offset = (d_i + 1) * 100_000
+            for ev in events:
+                if isinstance(ev.get("pid"), int):
+                    ev["pid"] += offset
+                if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                    args = ev.setdefault("args", {})
+                    args["name"] = f"[{host}] {args.get('name', '')}"
+                merged.append(ev)
+            n_sources += 1
+    opener = gzip.open if out_path.endswith(".gz") else open
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    with opener(out_path, "wt") as f:
+        _json.dump({"traceEvents": merged}, f)
+    return n_sources
+
+
 def straggler_delay_ns(straggler_option: tuple[int, int] | None, rank: int) -> int:
     """Compute the artificial per-rank straggler delay, in nanoseconds.
 
