@@ -88,3 +88,21 @@ def test_flash_decode_golden(ctx, method):
     sel = np.concatenate(rows)
     ref = _dense_attn(q[:, None], k[:, sel], v[:, sel], causal=False)[:, 0]
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_attention_golden(ctx, causal):
+    """Head-exchange SP attention (Ulysses — beyond-reference addition)
+    vs the dense golden; H divisible by the axis."""
+    from triton_distributed_tpu.ops.ulysses import ulysses_attention
+
+    b, s, hq, hkv, d, n = 1, 64, 16, 8, 32, 8
+    rng = np.random.default_rng(7)
+    q = rng.standard_normal((b, s, hq, d)).astype(np.float32)
+    k = rng.standard_normal((b, s, hkv, d)).astype(np.float32)
+    v = rng.standard_normal((b, s, hkv, d)).astype(np.float32)
+
+    out = ulysses_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                            ctx, causal=causal)
+    ref = _dense_attn(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)

@@ -45,6 +45,10 @@ from triton_distributed_tpu.ops.sp_ag_attention import (  # noqa: F401
     sp_ag_attention,
     sp_ag_attention_local,
 )
+from triton_distributed_tpu.ops.ulysses import (  # noqa: F401
+    ulysses_attention,
+    ulysses_attention_local,
+)
 from triton_distributed_tpu.ops.flash_decode import (  # noqa: F401
     flash_decode,
     flash_decode_local,

@@ -1,0 +1,96 @@
+"""Ulysses sequence parallelism — head-exchange AllToAll attention.
+
+NOT in the reference (SURVEY.md §2.5 marks Ulysses "absent"; the reference
+scales sequence length by KV-AllGather + split-KV flash-decode only). Added
+here because the AllToAll head exchange is a better fit for TPU than for
+the reference's stack: `jax.lax.all_to_all` lowers to a single ICI
+all-to-all, and the per-device attention afterwards is a plain
+full-sequence flash attention over a head shard — no waits, no symmetric
+buffers.
+
+Scheme (DeepSpeed-Ulysses): activations arrive sequence-sharded
+(B, S/n, H, d). AllToAll exchanges the head and sequence axes so every
+device holds ALL positions for H/n heads; attention runs dense per head
+shard; a second AllToAll restores sequence sharding:
+
+    (B, S/n, H, d) ── a2a(H→, ←S) ──> (B, S, H/n, d)
+                  ── attention (full S, causal ok) ──
+    (B, S, H/n, d) ── a2a(S→, ←H) ──> (B, S/n, H, d)
+
+Communication volume is 2·B·S·H·d/n per device (vs the KV-AllGather's
+B·S·H_kv·d·(n-1)/n each step) and, unlike ring attention, needs no
+per-step softmax rescaling — at the price of requiring H % n == 0.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.runtime.context import DistContext, get_context
+from triton_distributed_tpu.runtime.jit_cache import cached_shard_jit
+
+
+def _sdpa(q, k, v, causal: bool):
+    """Dense GQA attention, fp32 softmax. q: (B, S, Hq, d); k/v (B, S, Hkv, d)."""
+    b, s, hq, d = q.shape
+    hkv = k.shape[2]
+    groups = hq // hkv
+    k = jnp.repeat(k, groups, axis=2)
+    v = jnp.repeat(v, groups, axis=2)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * (d ** -0.5)
+    if causal:
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def ulysses_attention_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                            axis: str = "sp", num_ranks: int | None = None,
+                            causal: bool = True) -> jax.Array:
+    """Device-local Ulysses attention inside shard_map.
+
+    q: (B, S/n, Hq, d); k/v: (B, S/n, Hkv, d) — sequence-sharded.
+    Returns (B, S/n, Hq, d). Requires Hq % n == 0 and Hkv % n == 0.
+    """
+    if num_ranks is None:
+        raise ValueError("num_ranks required inside shard_map")
+    n = num_ranks
+    if n == 1:
+        return _sdpa(q, k, v, causal)
+    hq, hkv = q.shape[2], k.shape[2]
+    if hq % n or hkv % n:
+        raise ValueError(f"heads ({hq}, {hkv}) not divisible by axis size {n}")
+
+    # Head → sequence exchange: (B, S/n, H, d) -> (B, S, H/n, d).
+    a2a = functools.partial(jax.lax.all_to_all, axis_name=axis,
+                            split_axis=2, concat_axis=1, tiled=True)
+    qg, kg, vg = a2a(q), a2a(k), a2a(v)
+    out = _sdpa(qg, kg, vg, causal)
+    # Inverse exchange restores sequence sharding.
+    return jax.lax.all_to_all(out, axis_name=axis, split_axis=1,
+                              concat_axis=2, tiled=True)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      ctx: DistContext | None = None, axis: str = "tp",
+                      causal: bool = True) -> jax.Array:
+    """Host-level Ulysses attention: q/k/v (B, S, h*, d) sharded on dim 1."""
+    ctx = ctx or get_context()
+    n = ctx.axis_size(axis)
+    key = (axis, causal, q.shape, k.shape, str(q.dtype))
+
+    def make():
+        return functools.partial(ulysses_attention_local, axis=axis,
+                                 num_ranks=n, causal=causal)
+
+    spec = P(None, axis, None, None)
+    jfn = cached_shard_jit(ctx, "ulysses_attention", key, make,
+                           (spec, spec, spec), spec, ici_axes=(axis,))
+    return jfn(q, k, v)
