@@ -1,0 +1,69 @@
+"""TP training step + checkpoint round trip (beyond-reference capability:
+the reference is inference-only)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.models.config import tiny_config
+from triton_distributed_tpu.models.dense import init_dense_llm
+from triton_distributed_tpu.models.train import lm_loss, make_train_step
+
+
+def _batch(rng, cfg, batch=2, seq=12):
+    ids = rng.integers(0, cfg.vocab_size, (batch, seq + 1)).astype(np.int32)
+    return jnp.asarray(ids[:, :-1]), jnp.asarray(ids[:, 1:])
+
+
+def test_train_step_reduces_loss(ctx):
+    cfg = tiny_config()
+    rng = np.random.default_rng(0)
+    params = init_dense_llm(jax.random.PRNGKey(0), cfg)
+    init_state, train_step = make_train_step(cfg, ctx, learning_rate=3e-3)
+    state = init_state(params)
+
+    ids, labels = _batch(rng, cfg)
+    losses = []
+    for _ in range(8):
+        state, loss = train_step(state, ids, labels)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.9, losses
+
+    # Grads/updates respected the TP shardings (spot-check a sharded leaf).
+    wq = state.params["layers"][0]["attn"]["wq"]
+    assert len(wq.sharding.spec) == 2 and wq.sharding.spec[1] == "tp"
+
+
+def test_train_step_moe(ctx):
+    cfg = tiny_config(num_experts=4, num_experts_per_tok=2,
+                      moe_intermediate_size=32)
+    rng = np.random.default_rng(1)
+    params = init_dense_llm(jax.random.PRNGKey(1), cfg)
+    init_state, train_step = make_train_step(cfg, ctx, learning_rate=3e-3)
+    state = init_state(params)
+    ids, labels = _batch(rng, cfg)
+    l0 = float(lm_loss(state.params, cfg, ids, labels))
+    for _ in range(6):
+        state, loss = train_step(state, ids, labels)
+    assert float(loss) < l0, (l0, float(loss))
+
+
+def test_checkpoint_round_trip(ctx, tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    from triton_distributed_tpu.models.checkpoint import (
+        restore_checkpoint, save_checkpoint,
+    )
+
+    cfg = tiny_config()
+    params = init_dense_llm(jax.random.PRNGKey(2), cfg)
+    init_state, _ = make_train_step(cfg, ctx)
+    state = init_state(params)
+
+    path = save_checkpoint(str(tmp_path / "ck"), state.params)
+    restored = restore_checkpoint(path, like=state.params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b)),
+        state.params, restored)

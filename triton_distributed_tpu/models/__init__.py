@@ -26,3 +26,13 @@ from triton_distributed_tpu.models.hf_loader import (  # noqa: F401
     load_pretrained,
 )
 from triton_distributed_tpu.models import sampling  # noqa: F401
+from triton_distributed_tpu.models.train import (  # noqa: F401
+    TrainState,
+    lm_logits,
+    lm_loss,
+    make_train_step,
+)
+from triton_distributed_tpu.models.checkpoint import (  # noqa: F401
+    restore_checkpoint,
+    save_checkpoint,
+)

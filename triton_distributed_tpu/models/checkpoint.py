@@ -1,0 +1,49 @@
+"""Checkpoint save/restore — Orbax-backed, sharding-aware.
+
+BEYOND the reference (inference-only; SURVEY.md §5: checkpoint/resume
+absent — weights only flow HF→GPU). Here params (and optionally a full
+TrainState) round-trip through Orbax: saves happen from the sharded
+device arrays, restores place shards directly onto the mesh.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+
+
+def _ckptr():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_checkpoint(directory: str, tree: Any) -> str:
+    """Save a param / state pytree. Returns the checkpoint path."""
+    path = os.path.abspath(directory)
+    _ckptr().save(path, tree, force=True)
+    return path
+
+
+def restore_checkpoint(directory: str, like: Any | None = None) -> Any:
+    """Restore a pytree; ``like`` (a matching pytree of arrays or
+    ShapeDtypeStructs with shardings) makes the restore place shards
+    directly on the mesh instead of host memory."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(directory)
+    if like is None:
+        return _ckptr().restore(path)
+    targets = jax.tree.map(
+        lambda x: ocp.utils.to_shape_dtype_struct(x) if hasattr(
+            ocp.utils, "to_shape_dtype_struct") else x, like)
+    try:
+        return _ckptr().restore(path, item=targets)
+    except Exception:
+        restored = _ckptr().restore(path)
+        shardings = jax.tree.map(lambda x: getattr(x, "sharding", None), like)
+        return jax.tree.map(
+            lambda arr, sh: jax.device_put(arr, sh) if sh is not None else arr,
+            restored, shardings)
