@@ -36,14 +36,8 @@ def restore_checkpoint(directory: str, like: Any | None = None) -> Any:
     path = os.path.abspath(directory)
     if like is None:
         return _ckptr().restore(path)
-    targets = jax.tree.map(
-        lambda x: ocp.utils.to_shape_dtype_struct(x) if hasattr(
-            ocp.utils, "to_shape_dtype_struct") else x, like)
-    try:
-        return _ckptr().restore(path, item=targets)
-    except Exception:
-        restored = _ckptr().restore(path)
-        shardings = jax.tree.map(lambda x: getattr(x, "sharding", None), like)
-        return jax.tree.map(
-            lambda arr, sh: jax.device_put(arr, sh) if sh is not None else arr,
-            restored, shardings)
+    # restore_args carry the target shardings — without them orbax reads
+    # shardings from the checkpoint file and silently ignores ``like``
+    # (wrong placement when restoring on a different mesh).
+    restore_args = ocp.checkpoint_utils.construct_restore_args(like)
+    return _ckptr().restore(path, item=like, restore_args=restore_args)

@@ -18,6 +18,7 @@ serving).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Callable
 
 import jax
@@ -27,6 +28,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from triton_distributed_tpu.layers.common import (
     apply_rope, rms_norm, rope_cos_sin, swiglu,
 )
+from triton_distributed_tpu.layers.tp_attn import _sdpa
 from triton_distributed_tpu.models.config import ModelConfig
 from triton_distributed_tpu.models.dense import dense_llm_specs
 
@@ -48,15 +50,7 @@ def lm_logits(params: dict, cfg: ModelConfig, input_ids: jax.Array) -> jax.Array
             q = rms_norm(q, a["q_norm"], cfg.rms_norm_eps)
             k = rms_norm(k, a["k_norm"], cfg.rms_norm_eps)
         q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
-        groups = cfg.num_heads // cfg.num_kv_heads
-        kf = jnp.repeat(k, groups, axis=2)
-        vf = jnp.repeat(v, groups, axis=2)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                            kf.astype(jnp.float32)) * cfg.head_dim ** -0.5
-        mask = jnp.tril(jnp.ones((seq, seq), bool))
-        logits = jnp.where(mask[None, None], logits, -jnp.inf)
-        p = jax.nn.softmax(logits, axis=-1)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", p, vf.astype(jnp.float32))
+        attn = _sdpa(q, k, v, causal=True)           # GQA handled natively
         attn = attn.reshape(batch, seq, -1).astype(x.dtype)
         x = x + attn @ a["wo"]
 
@@ -127,7 +121,9 @@ def make_train_step(cfg: ModelConfig, ctx=None, *, axis: str = "tp",
         return TrainState(params=params, opt_state=tx.init(params),
                           step=jnp.zeros((), jnp.int32))
 
-    @jax.jit
+    # Donate the incoming state: params + AdamW m/v are 3x param memory,
+    # and without donation old + new state are live together (~6x peak).
+    @functools.partial(jax.jit, donate_argnums=0)
     def train_step(state: TrainState, input_ids: jax.Array,
                    labels: jax.Array):
         loss, grads = jax.value_and_grad(lm_loss)(state.params, cfg,
