@@ -104,6 +104,7 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--layers", type=int, default=1)
     p.add_argument("--seq", type=int, default=None)
+    p.add_argument("--dtype", default=None, choices=["float32", "bfloat16"])
     args = p.parse_args()
 
     on_tpu = jax.default_backend() == "tpu"
@@ -125,9 +126,12 @@ def main():
     # Feedback: next step's x is this step's x_out (damped so chained
     # activations stay bounded — unbounded growth destabilizes timing).
     prog.mb.scale(prog.x, prog.x_out, 0.2)
-    compiled = prog.mb.compile()
+    wdt = jnp.dtype(args.dtype) if args.dtype else (
+        jnp.bfloat16 if on_tpu else jnp.float32)
+    compiled = prog.mb.compile(dtype=wdt)
     print(f"# hidden={hidden} hq={hq} hkv={hkv} ffn={ffn} S={S} "
           f"layers={args.layers} tasks={compiled.queue.shape[0]} "
+          f"dtype={jnp.dtype(wdt).name} "
           f"({'TPU' if on_tpu else 'CPU smoke'})")
 
     d = TILE
@@ -177,8 +181,11 @@ def main():
                                  ws + salt)
 
     # ---- eager chain: identical math, x carried ------------------------
-    jw = [({k: jnp.asarray(val) for k, val in w.items()},
-           [jnp.asarray(t) for t in kT], [jnp.asarray(t) for t in v])
+    def cast(t):
+        return jnp.asarray(t, wdt) if np.asarray(t).dtype == np.float32 else jnp.asarray(t)
+
+    jw = [({k: cast(val) for k, val in w.items()},
+           [cast(t) for t in kT], [cast(t) for t in v])
           for w, kT, v in eager_layers]
 
     @functools.partial(jax.jit, static_argnums=1)
@@ -186,10 +193,10 @@ def main():
         def body(i, cur):
             for w, kT, v in jw:
                 cur = eager_step(w, kT, v, pos, hq, hkv, cur)
-            return cur * 0.2
-        return jax.lax.fori_loop(0, n, body, x0 + salt)
+            return (cur * 0.2).astype(x0.dtype)
+        return jax.lax.fori_loop(0, n, body, x0 + salt.astype(x0.dtype))
 
-    xj = jnp.asarray(x)
+    xj = jnp.asarray(x, wdt)
     t_mega, t_eager = per_step_seconds_interleaved(
         [lambda n, s_: mega_chain(ws0, n, s_),
          lambda n, s_: eager_chain(xj, n, s_)], lengths)

@@ -125,6 +125,35 @@ def test_decode_step_single_device():
                                rtol=2e-3, atol=2e-3)
 
 
+def test_decode_step_bf16_workspace():
+    """bf16 workspace (halves every tile DMA; fp32 compute) must track the
+    fp32 result within bf16 tolerance."""
+    hidden, hq, hkv, ffn, S, pos, B = 256, 2, 1, 256, 256, 100, 4
+    rng = np.random.default_rng(5)
+    prog = build_decode_step(hidden=hidden, hq_local=hq, hkv_local=hkv,
+                             ffn_local=ffn, num_layers=1, max_seq=S,
+                             pos=pos, num_ranks=1)
+    w = _rand_layer_weights(rng, hidden, hq, hkv, ffn, pos)
+    kT_np = [rng.standard_normal((TILE, S)).astype(np.float32) * 0.3
+             for _ in range(hkv)]
+    v_np = [rng.standard_normal((S, TILE)).astype(np.float32) * 0.3
+            for _ in range(hkv)]
+    x = np.zeros((TILE, hidden), np.float32)
+    x[:B] = rng.standard_normal((B, hidden)).astype(np.float32) * 0.3
+
+    compiled = prog.mb.compile(dtype=jnp.bfloat16)
+    feeds = {prog.x: jnp.asarray(x), prog.cos: jnp.asarray(w["cos_full"]),
+             prog.sin: jnp.asarray(w["sin_full"])}
+    feeds.update({k: jnp.asarray(val) for k, val in
+                  _feed_layer(prog, prog.layers[0], w, kT_np, v_np).items()})
+    (out,) = compiled.run(feeds, outputs=[prog.x_out])
+    assert out.dtype == jnp.bfloat16
+
+    ref = _golden_layer(x[:B], w, pos, kT_np, v_np, hq, hkv)
+    np.testing.assert_allclose(np.asarray(out).astype(np.float32)[:B], ref,
+                               rtol=0.1, atol=0.12)
+
+
 def test_decode_queue_reuse_across_positions():
     """One compiled program serves every decode position: build at
     max_seq-1, retarget with advance_queue_pos (runtime queue words), feed

@@ -204,8 +204,8 @@ class MegaKernelBuilder:
             reads, [out.tile(0, 0)])
 
     # -- compile / run -------------------------------------------------------
-    def compile(self, num_ranks: int = 1, axis: str = "tp"
-                ) -> "CompiledMegaKernel":
+    def compile(self, num_ranks: int = 1, axis: str = "tp",
+                dtype=jnp.float32) -> "CompiledMegaKernel":
         order = topo_schedule(len(self._tasks), self._edges)
         if num_ranks > 1:
             # Cross-device tasks must execute in the same relative order on
@@ -216,7 +216,8 @@ class MegaKernelBuilder:
                            np.int32).reshape(-1, WORDS)
         return CompiledMegaKernel(queue=jnp.asarray(queue),
                                   num_tiles=self._num_tiles,
-                                  num_ranks=num_ranks, axis=axis)
+                                  num_ranks=num_ranks, axis=axis,
+                                  dtype=jnp.dtype(dtype))
 
 
 @dataclasses.dataclass
@@ -227,11 +228,16 @@ class CompiledMegaKernel:
     num_tiles: int
     num_ranks: int
     axis: str
+    dtype: "jnp.dtype" = None  # workspace dtype (fp32 default, bf16 halves DMA)
+
+    def __post_init__(self):
+        if self.dtype is None:
+            self.dtype = jnp.dtype(jnp.float32)
 
     def scatter_input(self, ws: jax.Array, h: TensorHandle,
                       value: jax.Array) -> jax.Array:
         """Write (rows, cols) ``value`` into the tiled workspace."""
-        tiles = value.astype(jnp.float32).reshape(
+        tiles = value.astype(self.dtype).reshape(
             h.rt, TILE, h.ct, TILE).transpose(0, 2, 1, 3).reshape(
             h.rt * h.ct, TILE, TILE)
         return jax.lax.dynamic_update_slice(ws, tiles, (h.base, 0, 0))
@@ -246,7 +252,7 @@ class CompiledMegaKernel:
         """Build the tiled workspace once (weights + caches + activations).
         In a serving loop, scatter weights here a single time and update
         only the per-step tensors afterward (scatter_input is jittable)."""
-        ws = jnp.zeros((max(self.num_tiles, 1), TILE, TILE), jnp.float32)
+        ws = jnp.zeros((max(self.num_tiles, 1), TILE, TILE), self.dtype)
         for h, v in inputs.items():
             ws = self.scatter_input(ws, h, v)
         return ws

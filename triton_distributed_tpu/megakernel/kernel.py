@@ -35,8 +35,9 @@ from triton_distributed_tpu.runtime.context import use_interpret
 
 
 def _mega_kernel(n: int, axis: str, n_tasks: int,
-                 queue_ref, ws_in, ws_out, slots, va2, vb2, vacc, vq,
+                 queue_ref, ws_in, ws_out, slots, va2, vb2, vacc, vq, vstat,
                  copy_sem, pipe_sems, send_sems, recv_sem):
+    wdt = ws_out.dtype   # workspace dtype (fp32 or bf16); compute is fp32
     step = pl.program_id(0)
     # Double-buffer views: slot 0 is the default for unpipelined tasks.
     va, vb = va2.at[0], vb2.at[0]
@@ -112,7 +113,8 @@ def _mega_kernel(n: int, axis: str, n_tasks: int,
     # pipelined; unary ops stream a single buffer.
     def _ew_task(fn, binary=True):
         def body(j, a_ref, b_ref, _):
-            vq[...] = fn(a_ref[...], b_ref[...])
+            vq[...] = fn(a_ref[...].astype(jnp.float32),
+                         b_ref[...].astype(jnp.float32)).astype(wdt)
             store(vq, out + j)
             return 0
 
@@ -139,7 +141,7 @@ def _mega_kernel(n: int, axis: str, n_tasks: int,
 
         pipelined_pairs(lambda j: a0 + j * a_stride,
                         lambda j: b0 + j * b_stride, k_tiles, body, 0)
-        va[...] = vacc[...]
+        va[...] = vacc[...].astype(wdt)
         store(va, out)
 
     def t_allreduce():
@@ -165,8 +167,8 @@ def _mega_kernel(n: int, axis: str, n_tasks: int,
             load_slot = pltpu.make_async_copy(slots.at[r], va, copy_sem)
             load_slot.start()
             load_slot.wait()
-            vacc[...] = vacc[...] + va[...]
-        va[...] = vacc[...]
+            vacc[...] = vacc[...] + va[...].astype(jnp.float32)
+        va[...] = vacc[...].astype(wdt)
         store(va, out)
         shmem.barrier_all(axis)
 
@@ -183,8 +185,8 @@ def _mega_kernel(n: int, axis: str, n_tasks: int,
         vacc[...] = jnp.zeros_like(vacc)
 
         def pass1(j, a_ref, _w_ref, _):
-            vacc[:, :1] += jnp.sum(a_ref[...] * a_ref[...], axis=1,
-                                   keepdims=True)
+            af = a_ref[...].astype(jnp.float32)
+            vacc[:, :1] += jnp.sum(af * af, axis=1, keepdims=True)
             return 0
 
         pipelined_pairs(lambda j: a0 + j, None, k_tiles, pass1, 0)
@@ -193,7 +195,8 @@ def _mega_kernel(n: int, axis: str, n_tasks: int,
         scale = jax.lax.rsqrt(vacc[:, :1] / cols + eps)
 
         def pass2(j, a_ref, w_ref, _):
-            vq[...] = a_ref[...] * scale * w_ref[...]
+            vq[...] = (a_ref[...].astype(jnp.float32) * scale
+                       * w_ref[...].astype(jnp.float32)).astype(wdt)
             store(vq, out + j)
             return 0
 
@@ -209,9 +212,11 @@ def _mega_kernel(n: int, axis: str, n_tasks: int,
         load(b0, vb)    # cos
         load(arg, vq)   # sin
         half = TILE // 2
-        a1, a2 = va[:, :half], va[:, half:]
+        af = va[...].astype(jnp.float32)
+        a1, a2 = af[:, :half], af[:, half:]
         rot = jnp.concatenate([-a2, a1], axis=1)
-        va[...] = va[...] * vb[...] + rot * vq[...]
+        va[...] = (af * vb[...].astype(jnp.float32)
+                   + rot * vq[...].astype(jnp.float32)).astype(wdt)
         store(va, out)
 
     def t_attn_decode():
@@ -241,7 +246,7 @@ def _mega_kernel(n: int, axis: str, n_tasks: int,
             m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
             p = jnp.exp(s - m_new)
             corr = jnp.exp(m - m_new)
-            pv = jnp.dot(p.astype(jnp.float32), v_ref[...],  # V_j: (TILE, d)
+            pv = jnp.dot(p.astype(v_ref.dtype), v_ref[...],  # V_j: (TILE, d)
                          preferred_element_type=jnp.float32)
             vacc[...] = vacc[...] * corr + pv
             return (m_new, l * corr + jnp.sum(p, axis=1, keepdims=True))
@@ -253,19 +258,21 @@ def _mega_kernel(n: int, axis: str, n_tasks: int,
         def _():
             # Current token: per-row dot with each row's own k/v.
             load(c0, vb)                           # k_new: (B, d)
-            s_cur = jnp.sum(vq[...] * vb[...], axis=1, keepdims=True) * scale
+            s_cur = jnp.sum(vq[...].astype(jnp.float32)
+                            * vb[...].astype(jnp.float32),
+                            axis=1, keepdims=True) * scale
             m_new = jnp.maximum(m, s_cur)
             p_cur = jnp.exp(s_cur - m_new)
             corr = jnp.exp(m - m_new)
             load(d0, vb)                           # v_new: (B, d)
-            vacc[...] = vacc[...] * corr + p_cur * vb[...]
-            va[:, :1] = l * corr + p_cur
+            vacc[...] = vacc[...] * corr + p_cur * vb[...].astype(jnp.float32)
+            vstat[:, :1] = l * corr + p_cur
 
         @pl.when(c0 < 0)
         def _():
-            va[:, :1] = l
+            vstat[:, :1] = l
 
-        va[...] = vacc[...] / jnp.maximum(va[:, :1], 1e-30)
+        va[...] = (vacc[...] / jnp.maximum(vstat[:, :1], 1e-30)).astype(wdt)
         store(va, out)
 
     jax.lax.switch(w(0), [t_copy, t_add, t_silu_mul, t_gemm, t_allreduce,
@@ -275,14 +282,16 @@ def _mega_kernel(n: int, axis: str, n_tasks: int,
 def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp"):
     """Execute the packed task queue over the workspace in ONE pallas_call.
 
-    queue: (n_tasks, WORDS) int32; workspace: (T, TILE, TILE) fp32 (local
-    per device when num_ranks > 1 — call inside shard_map).
+    queue: (n_tasks, WORDS) int32; workspace: (T, TILE, TILE) fp32 or bf16
+    (local per device when num_ranks > 1 — call inside shard_map). bf16
+    halves every tile DMA; compute stays fp32 on the VPU/MXU.
     Returns the post-execution workspace.
     """
     n_tasks = queue.shape[0]
     assert queue.shape[1] == WORDS
     n = num_ranks
     T = workspace.shape[0]
+    wdt = workspace.dtype
 
     # AR slots ride as a second output: Mosaic has no HBM scratch (see
     # language/core.py kernel_call ``workspaces``).
@@ -292,10 +301,11 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp"):
         in_specs=[any_spec()],
         out_specs=(any_spec(), any_spec()),
         scratch_shapes=[
-            pltpu.VMEM((PIPE_DEPTH, TILE, TILE), jnp.float32),  # va2
-            pltpu.VMEM((PIPE_DEPTH, TILE, TILE), jnp.float32),  # vb2
-            pltpu.VMEM((TILE, TILE), jnp.float32),     # vacc
-            pltpu.VMEM((TILE, TILE), jnp.float32),     # vq: rope/attn operand
+            pltpu.VMEM((PIPE_DEPTH, TILE, TILE), wdt),  # va2
+            pltpu.VMEM((PIPE_DEPTH, TILE, TILE), wdt),  # vb2
+            pltpu.VMEM((TILE, TILE), jnp.float32),      # vacc (fp32 accum)
+            pltpu.VMEM((TILE, TILE), wdt),              # vq: rope/attn operand
+            pltpu.VMEM((TILE, 128), jnp.float32),       # vstat (softmax stats)
             pltpu.SemaphoreType.DMA(()),               # copy_sem
             pltpu.SemaphoreType.DMA((2 * PIPE_DEPTH,)),  # pipe_sems (slot x a/b)
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
@@ -324,8 +334,8 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp"):
         kernel,
         grid_spec=grid_spec,
         out_shape=(
-            jax.ShapeDtypeStruct((T, TILE, TILE), jnp.float32),
-            jax.ShapeDtypeStruct((max(n, 1), TILE, TILE), jnp.float32),
+            jax.ShapeDtypeStruct((T, TILE, TILE), wdt),
+            jax.ShapeDtypeStruct((max(n, 1), TILE, TILE), wdt),
         ),
         compiler_params=pltpu.CompilerParams(has_side_effects=True, **params),
         interpret=interpret_arg,
