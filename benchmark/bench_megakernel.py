@@ -178,7 +178,7 @@ def main():
     @functools.partial(jax.jit, static_argnums=1)
     def mega_chain(ws, n, salt):
         return jax.lax.fori_loop(0, n, lambda i, w_: compiled.step(w_),
-                                 ws + salt)
+                                 ws + salt.astype(ws.dtype))
 
     # ---- eager chain: identical math, x carried ------------------------
     def cast(t):

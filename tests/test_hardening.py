@@ -156,3 +156,66 @@ def test_group_profile_produces_trace(ctx, tmp_path):
     pids = {e.get("pid") for e in data["traceEvents"]
             if isinstance(e.get("pid"), int)}
     assert any(p >= 200_000 for p in pids), "second source pids not offset"
+
+
+def test_collectives_random_shape_sweep(ctx):
+    """Random (rows, cols) sweep over RS/AR (reference stress pattern:
+    sweep shapes for many iterations to catch shape-dependent bugs)."""
+    from triton_distributed_tpu.ops import all_reduce, reduce_scatter
+
+    n = 8
+    rng = np.random.default_rng(4)
+    for _ in range(3):
+        m = int(rng.choice([8, 16, 24]))
+        cols = int(rng.choice([128, 256, 384]))
+        xs = rng.standard_normal((n, m, cols)).astype(np.float32)
+        out = all_reduce(jnp.asarray(xs), ctx)
+        np.testing.assert_allclose(np.asarray(out), xs.sum(0),
+                                   rtol=1e-4, atol=1e-4,
+                                   err_msg=f"AR {m}x{cols}")
+        xr = rng.standard_normal((n, n * m, cols)).astype(np.float32)
+        out = reduce_scatter(jnp.asarray(xr), ctx)
+        np.testing.assert_allclose(np.asarray(out), xr.sum(0),
+                                   rtol=1e-4, atol=1e-4,
+                                   err_msg=f"RS {m}x{cols}")
+
+
+def test_a2a_random_splits_sweep(ctx):
+    """Random split matrices incl. degenerate rows (reference stress)."""
+    from triton_distributed_tpu.ops import fast_all_to_all
+
+    n, epr, cap, hidden = 8, 2, 32, 128
+    rng = np.random.default_rng(5)
+    for trial in range(2):
+        splits = rng.integers(0, cap // n, size=(n, n, epr)).astype(np.int32)
+        splits[trial % n] = 0  # one device sends nothing
+        send = np.zeros((n, n, cap, hidden), np.float32)
+        for d_ in range(n):
+            for p_ in range(n):
+                r_ = int(splits[d_, p_].sum())
+                send[d_, p_, :r_] = rng.standard_normal((r_, hidden))
+        recv, rsplits = fast_all_to_all(jnp.asarray(send),
+                                        jnp.asarray(splits), ctx)
+        rsplits = np.asarray(rsplits)
+        np.testing.assert_array_equal(rsplits, np.swapaxes(splits, 0, 1))
+        recv = np.asarray(recv)
+        for d_ in range(n):
+            for p_ in range(n):
+                r_ = int(rsplits[d_, p_].sum())
+                np.testing.assert_allclose(
+                    recv[d_, p_, :r_], send[p_, d_, :r_],
+                    err_msg=f"payload recv[{d_},{p_}]")
+
+
+def test_engine_serve_profile(ctx, tmp_path):
+    """Engine.serve(profile_dir=...) must emit a decode trace (reference
+    Engine profile mode, engine.py:153-179)."""
+    from triton_distributed_tpu.models import AutoLLM
+    from triton_distributed_tpu.models.config import tiny_config
+
+    eng = AutoLLM.from_config(tiny_config(), ctx=ctx, max_seq=16)
+    out = eng.serve(jnp.asarray([[1, 2, 3]], jnp.int32), gen_len=3,
+                    profile_dir=str(tmp_path))
+    assert out.shape == (1, 3)
+    files = [p for p in (tmp_path / "decode").rglob("*") if p.is_file()]
+    assert files, "no profiler trace emitted"

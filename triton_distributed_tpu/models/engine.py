@@ -138,15 +138,24 @@ class Engine:
         subsequent calls replay the executable (the CUDA-graph analog)."""
         return self._decode_jit()(self.params, tokens, cache)
 
-    def serve(self, input_ids: jax.Array, gen_len: int) -> jax.Array:
+    def serve(self, input_ids: jax.Array, gen_len: int,
+              profile_dir: str | None = None) -> jax.Array:
         """Greedy generation (reference Engine.serve, engine.py:113).
 
-        Returns (B, gen_len) generated token ids.
+        ``profile_dir`` wraps the decode loop in a jax.profiler trace (the
+        reference's optional 64-step profile → trace_static.json,
+        engine.py:153-179); merge per-host traces with
+        ``runtime.merge_profiles``. Returns (B, gen_len) token ids.
         """
+        from triton_distributed_tpu.runtime.utils import group_profile
+
         logits, cache = self.prefill(jnp.asarray(input_ids))
         tok = sampling.greedy(logits)
         outs = [tok]
-        for _ in range(gen_len - 1):
-            tok, cache = self.decode(tok, cache)
-            outs.append(tok)
+        with group_profile("decode", do_prof=profile_dir is not None,
+                           log_dir=profile_dir or "."):
+            for _ in range(gen_len - 1):
+                tok, cache = self.decode(tok, cache)
+                outs.append(tok)
+            jax.block_until_ready(tok)
         return jnp.stack(outs, axis=1)

@@ -136,12 +136,21 @@ def signature_key(args: Sequence[Any], static: Any = None) -> str:
     return key
 
 
-def _family_key(args: Sequence[Any], bucket_arg: int, bucket_dim: int,
-                static: Any = None) -> str:
-    """Signature with the bucketed dim wildcarded (the dispatch family)."""
+def _normalize_bucket(bucket) -> tuple[tuple[int, int], ...]:
+    """A bucket spec is one (arg, dim) pair or a sequence of pairs — several
+    args can share a correlated bucketed dim (flash-decode buckets the
+    sequence dim of BOTH k and v); the first pair carries the capacity."""
+    if isinstance(bucket[0], int):
+        return (tuple(bucket),)
+    return tuple(tuple(p) for p in bucket)
+
+
+def _family_key(args: Sequence[Any], bucket, static: Any = None) -> str:
+    """Signature with every bucketed dim wildcarded (the dispatch family)."""
+    pairs = set(_normalize_bucket(bucket))
     parts = []
     for i, a in enumerate(args):
-        dims = [("*" if i == bucket_arg and d == bucket_dim else str(s))
+        dims = [("*" if (i, d) in pairs else str(s))
                 for d, s in enumerate(a.shape)]
         parts.append(f"{_dt(a)}[{','.join(dims)}]")
     key = ";".join(parts)
@@ -186,12 +195,13 @@ class AOTFunction:
     # -- compilation -------------------------------------------------------
 
     def precompile(self, *args_spec, static_kwargs: dict | None = None,
-                   bucket: tuple[int, int] | None = None) -> _Entry:
+                   bucket=None) -> _Entry:
         """AOT-compile ``fn`` for ``args_spec`` (ShapeDtypeStructs).
 
-        ``bucket=(arg_index, dim)`` additionally registers the entry for
-        bucketed dispatch on that dimension (its compiled size is the bucket
-        capacity). Serialization is attempted (jax.export); entries whose
+        ``bucket=(arg_index, dim)`` — or a sequence of correlated pairs,
+        e.g. ``((1, 1), (2, 1))`` for flash-decode's k AND v sequence dims
+        — additionally registers the entry for bucketed dispatch (the
+        first pair's compiled size is the bucket capacity). Serialization is attempted (jax.export); entries whose
         lowering can't serialize (interpret-mode callbacks) stay
         process-local, like the reference's JIT-only kernels.
         """
@@ -215,8 +225,8 @@ class AOTFunction:
         self.entries.append(entry)
         self.registry.register_exact(key, index)
         if bucket is not None:
-            arg_i, dim_i = bucket
-            entry.family = _family_key(args_spec, arg_i, dim_i,
+            arg_i, dim_i = _normalize_bucket(bucket)[0]
+            entry.family = _family_key(args_spec, bucket,
                                        static_kwargs or None)
             entry.bucket = int(args_spec[arg_i].shape[dim_i])
             self.registry.register_bucket(entry.family, entry.bucket, index)
@@ -229,14 +239,15 @@ class AOTFunction:
             signature_key(args, dict(static_kwargs or {}) or None))
         return self.entries[idx] if idx >= 0 else None
 
-    def select_bucket(self, *args, bucket: tuple[int, int],
+    def select_bucket(self, *args, bucket,
                       static_kwargs: dict | None = None) -> _Entry | None:
         """Bucketed dispatch: the entry whose capacity fits args' dim
         (reference flash-decode AOT: pick the kernel compiled for the
         smallest MAX_M >= runtime M; caller pads the input to
-        ``entry.args_spec`` and slices the result)."""
-        arg_i, dim_i = bucket
-        family = _family_key(args, arg_i, dim_i,
+        ``entry.args_spec`` and slices the result). ``bucket`` is one
+        (arg, dim) pair or a sequence of correlated pairs."""
+        arg_i, dim_i = _normalize_bucket(bucket)[0]
+        family = _family_key(args, bucket,
                              dict(static_kwargs or {}) or None)
         idx = self.registry.select_bucket(family, int(args[arg_i].shape[dim_i]))
         return self.entries[idx] if idx >= 0 else None
