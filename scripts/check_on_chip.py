@@ -83,6 +83,25 @@ def main() -> int:
     splits = jnp.asarray(np.full((1, 1, 2), 8), jnp.int32)
     check("fast_all_to_all", lambda: fast_all_to_all(send, splits, ctx)[0])
 
+    # Paged-KV attention (page-table scalar prefetch + per-page DMA).
+    from triton_distributed_tpu.ops import (
+        init_paged_kv_cache, paged_append, paged_decode_attention,
+    )
+
+    def paged():
+        cache = init_paged_kv_cache(2, num_pages=8, page_size=16,
+                                    num_kv_heads=8, head_dim=128,
+                                    max_pages=4)
+        for _ in range(20):
+            cache = paged_append(
+                cache,
+                jnp.asarray(rng.standard_normal((2, 8, 128)), jnp.float32),
+                jnp.asarray(rng.standard_normal((2, 8, 128)), jnp.float32))
+        qq = jnp.asarray(rng.standard_normal((2, 8, 128)), jnp.float32)
+        return paged_decode_attention(qq, cache)
+
+    check("paged_decode_attention", paged)
+
     # MegaKernel: a full decode step in one launch (fp32 + bf16).
     from triton_distributed_tpu.megakernel.models import (
         broadcast_rows, build_decode_step, rope_tables,

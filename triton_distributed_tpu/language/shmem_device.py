@@ -15,8 +15,8 @@ TPU mapping (SURVEY.md §7):
   fence/quiet            → ``.wait_send()`` on outstanding DMA handles
   getmem                 → NOT a TPU primitive: remote reads don't exist on the
                            ICI fabric; pull-style algorithms are expressed as
-                           peers pushing (see ops/allgather.py pull variant
-                           for the two-sided emulation).
+                           peers pushing (see :func:`getmem_emulated` /
+                           :func:`fcollect` below for the two-sided emulation).
 
 All helpers are *device-side*: call them inside a Pallas kernel that runs under
 ``shard_map`` over the communication axis.

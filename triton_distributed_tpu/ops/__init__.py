@@ -54,6 +54,12 @@ from triton_distributed_tpu.ops.flash_decode import (  # noqa: F401
     flash_decode_local,
     combine_partials,
 )
+from triton_distributed_tpu.ops.paged_attention import (  # noqa: F401
+    PagedKVCache,
+    init_paged_kv_cache,
+    paged_append,
+    paged_decode_attention,
+)
 from triton_distributed_tpu.ops.gemm import pallas_matmul  # noqa: F401
 from triton_distributed_tpu.ops.moe import (  # noqa: F401
     ag_group_gemm_local,

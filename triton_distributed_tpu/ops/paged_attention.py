@@ -1,0 +1,213 @@
+"""Paged-KV attention decode — page-table driven flash decode.
+
+Reference: ``mega_triton_kernel/models/`` ``PagedKVCache`` + the paged
+flash-attention decode task (SURVEY.md §2.7): the KV cache lives in
+fixed-size pages; a per-sequence page table maps logical positions to
+pool pages, so sequences of different lengths share one pool with no
+per-sequence max_seq reservation.
+
+TPU-native shape: the page table rides **scalar prefetch** into SMEM —
+the idiomatic Mosaic pattern for data-dependent addressing (the grid's
+DMA for page j is issued from a table value, exactly what
+PrefetchScalarGridSpec exists for). The kernel walks (batch, page) grid
+steps; online-softmax state for the current sequence lives in VMEM
+scratch, carried across that sequence's page steps (TPU grid steps run
+sequentially on the core).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu.language.core import _interpret_params
+from triton_distributed_tpu.runtime.context import use_interpret
+
+
+class PagedKVCache(NamedTuple):
+    """A paged KV pool + per-sequence page tables.
+
+    k_pool/v_pool: (num_pages, page, hkv, d); page_table: (B, max_pages)
+    int32 (pool page id per logical page); kv_lens: (B,) valid tokens.
+    """
+
+    k_pool: jax.Array
+    v_pool: jax.Array
+    page_table: jax.Array
+    kv_lens: jax.Array
+
+    @property
+    def page_size(self) -> int:
+        return self.k_pool.shape[1]
+
+
+def init_paged_kv_cache(batch: int, *, num_pages: int, page_size: int,
+                        num_kv_heads: int, head_dim: int, max_pages: int,
+                        dtype=jnp.float32) -> PagedKVCache:
+    """Pool + identity page tables (page allocation policy is the host's;
+    tables are data, so any allocator can rewrite them between steps)."""
+    shape = (num_pages, page_size, num_kv_heads, head_dim)
+    table = (jnp.arange(batch * max_pages, dtype=jnp.int32)
+             .reshape(batch, max_pages) % num_pages)
+    return PagedKVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+                        table, jnp.zeros((batch,), jnp.int32))
+
+
+def paged_append(cache: PagedKVCache, k_new: jax.Array,
+                 v_new: jax.Array) -> PagedKVCache:
+    """Append one token's k/v per sequence at each sequence's current
+    length (k_new/v_new: (B, hkv, d)); pure-functional scatter."""
+    P = cache.page_size
+    b = k_new.shape[0]
+    pos = cache.kv_lens
+    page_idx = cache.page_table[jnp.arange(b), pos // P]
+    row = pos % P
+
+    def scatter(pool, new):
+        return pool.at[page_idx, row].set(new.astype(pool.dtype))
+
+    return cache._replace(k_pool=scatter(cache.k_pool, k_new),
+                          v_pool=scatter(cache.v_pool, v_new),
+                          kv_lens=cache.kv_lens + 1)
+
+
+# ---------------------------------------------------------------------------
+# Kernel.
+# ---------------------------------------------------------------------------
+
+def _paged_decode_kernel(max_pages: int, page: int, scale: float,
+                         table_ref, lens_ref,       # scalar prefetch (SMEM)
+                         q_ref, kp_ref, vp_ref,     # q block + pools (ANY)
+                         o_ref,                     # out block (VMEM)
+                         kpg, vpg, acc, stat, sem):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    kv_len = lens_ref[b]
+
+    @pl.when(j == 0)
+    def _():
+        acc[...] = jnp.zeros_like(acc)
+        stat[...] = jnp.full_like(stat, -1e30)
+        stat[:, 1:2] = jnp.zeros_like(stat[:, 1:2])
+
+    valid_in_page = kv_len - j * page     # tokens of this seq in page j
+
+    @pl.when(valid_in_page > 0)
+    def _():
+        pid = table_ref[b * max_pages + j]
+        cp = pltpu.make_async_copy(kp_ref.at[pid], kpg, sem)
+        cp.start()
+        cp.wait()
+        cp = pltpu.make_async_copy(vp_ref.at[pid], vpg, sem)
+        cp.start()
+        cp.wait()
+
+        q = q_ref[0].astype(jnp.float32)            # (hq, d)
+        hq, d = q.shape
+        hkv = kpg.shape[1]
+        g = hq // hkv
+        k = kpg[...].astype(jnp.float32)            # (page, hkv, d)
+        v = vpg[...].astype(jnp.float32)
+        # Per-kv-head 2D matmuls (static unroll): Mosaic rejects the
+        # batched einsum form ("batch dims must be equal"), and hkv per
+        # device is small.
+        nt = (((1,), (1,)), ((), ()))               # contract last dims
+        s = jnp.concatenate(
+            [jax.lax.dot_general(q[h * g:(h + 1) * g], k[:, h, :], nt,
+                                 preferred_element_type=jnp.float32)
+             for h in range(hkv)], axis=0) * scale  # (hq, page)
+        row_mask = jax.lax.broadcasted_iota(jnp.int32, (hq, page), 1)
+        s = jnp.where(row_mask < valid_in_page, s, -1e30)
+
+        m_prev = stat[:, 0:1]
+        l_prev = stat[:, 1:2]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        pv = jnp.concatenate(
+            [jnp.dot(p[h * g:(h + 1) * g], v[:, h, :],
+                     preferred_element_type=jnp.float32)
+             for h in range(hkv)], axis=0)          # (hq, d)
+        acc[...] = acc[...] * corr + pv
+        stat[:, 0:1] = m_new
+        stat[:, 1:2] = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+
+    @pl.when(j == max_pages - 1)
+    def _():
+        o_ref[0] = (acc[...] / jnp.maximum(stat[:, 1:2], 1e-30)
+                    ).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q: jax.Array, cache: PagedKVCache) -> jax.Array:
+    """One-token GQA decode over the paged cache. q: (B, hq, d) → (B, hq, d).
+
+    Pure-jax golden: gather pages, mask, softmax (see tests). The Pallas
+    path walks each sequence's page table from SMEM and DMAs exactly the
+    pages that hold valid tokens.
+    """
+    b, hq, d = q.shape
+    num_pages, page, hkv, _ = cache.k_pool.shape
+    max_pages = cache.page_table.shape[1]
+    scale = d ** -0.5
+
+    kernel = functools.partial(_paged_decode_kernel, max_pages, page, scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, hq, d), lambda tb, tj, *_: (tb, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, hq, d), lambda tb, tj, *_: (tb, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((page, hkv, d), cache.k_pool.dtype),
+            pltpu.VMEM((page, hkv, d), cache.v_pool.dtype),
+            pltpu.VMEM((hq, d), jnp.float32),
+            pltpu.VMEM((hq, 128), jnp.float32),   # stat: [:,0]=m, [:,1]=l
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )
+    interpret = _interpret_params() if use_interpret() else False
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hq, d), q.dtype),
+        interpret=interpret,
+    )(cache.page_table.reshape(-1), cache.kv_lens, q,
+      cache.k_pool, cache.v_pool)
+
+
+def paged_decode_attention_golden(q: jax.Array,
+                                  cache: PagedKVCache) -> np.ndarray:
+    """Pure-numpy reference."""
+    qn = np.asarray(q, np.float64)
+    kp = np.asarray(cache.k_pool, np.float64)
+    vp = np.asarray(cache.v_pool, np.float64)
+    table = np.asarray(cache.page_table)
+    lens = np.asarray(cache.kv_lens)
+    b, hq, d = qn.shape
+    page = cache.page_size
+    hkv = kp.shape[2]
+    g = hq // hkv
+    out = np.zeros_like(qn)
+    for i in range(b):
+        n_tok = int(lens[i])
+        if n_tok == 0:
+            continue
+        pages = table[i][: -(-n_tok // page)]
+        k = kp[pages].reshape(-1, hkv, d)[:n_tok]
+        v = vp[pages].reshape(-1, hkv, d)[:n_tok]
+        kg = np.repeat(k, g, axis=1)
+        vg = np.repeat(v, g, axis=1)
+        s = np.einsum("hd,khd->hk", qn[i], kg) / np.sqrt(d)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        out[i] = np.einsum("hk,khd->hd", p, vg)
+    return out.astype(np.asarray(q).dtype)
