@@ -160,6 +160,45 @@ def tp_attn_prefill(params: dict, cfg: ModelConfig, x: jax.Array,
     return out, new_kv
 
 
+def _out_proj(attn: jax.Array, params: dict, *, axis: str, n: int,
+              mode: str) -> jax.Array:
+    """Row-parallel output projection + TP reduction (decode modes)."""
+    if n == 1:
+        return attn @ params["wo"]
+    if mode == "ar":
+        return all_reduce_local(attn @ params["wo"], axis=axis, num_ranks=n)
+    if mode == "xla_rep":
+        return jax.lax.psum(attn @ params["wo"], axis)
+    raise ValueError(f"decode supports modes 'ar'/'xla_rep', got {mode!r}")
+
+
+def tp_attn_decode_paged(params: dict, cfg: ModelConfig, x: jax.Array,
+                         cache, *, axis: str = "tp", num_ranks: int = 1,
+                         mode: str = "ar"):
+    """Single-token decode over a paged KV cache — per-SEQUENCE positions
+    (``cache.kv_lens``), so a continuous batch of sequences at different
+    lengths decodes in one step (the modern-serving shape the reference's
+    PagedKVCache exists for). Returns (out (B, h), appended cache)."""
+    from triton_distributed_tpu.ops.paged_attention import (
+        paged_append, paged_decode_attention,
+    )
+
+    n = num_ranks
+    batch = x.shape[0]
+    q, k, v = _project_qkv(params, cfg, x, batch, 1,
+                           axis=axis, n=n, mode="ar")
+    # Per-sequence rotary position = each sequence's current length.
+    cos, sin = rope_cos_sin(cache.kv_lens, cfg.head_dim, cfg.rope_theta)
+    q = apply_rope(q, cos[:, None], sin[:, None])
+    k = apply_rope(k, cos[:, None], sin[:, None])
+
+    cache = paged_append(cache, k[:, 0], v[:, 0])
+    attn = paged_decode_attention(q[:, 0], cache)     # (B, hq_local, d)
+    attn = attn.reshape(batch, -1).astype(x.dtype)
+
+    return _out_proj(attn, params, axis=axis, n=n, mode=mode), cache
+
+
 def tp_attn_decode(params: dict, cfg: ModelConfig, x: jax.Array,
                    kv_slice: KVSlice, pos: jax.Array, *,
                    axis: str = "tp", num_ranks: int = 1, mode: str = "ar"):
@@ -186,12 +225,4 @@ def tp_attn_decode(params: dict, cfg: ModelConfig, x: jax.Array,
                  causal=False, kv_len=pos + 1)
     attn = attn.reshape(batch, -1)
 
-    if n == 1:
-        out = attn @ params["wo"]
-    elif mode == "ar":
-        out = all_reduce_local(attn @ params["wo"], axis=axis, num_ranks=n)
-    elif mode == "xla_rep":
-        out = jax.lax.psum(attn @ params["wo"], axis)
-    else:
-        raise ValueError(f"decode supports modes 'ar'/'xla_rep', got {mode!r}")
-    return out, new_kv
+    return _out_proj(attn, params, axis=axis, n=n, mode=mode), new_kv

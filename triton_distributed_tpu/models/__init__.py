@@ -9,7 +9,9 @@ from triton_distributed_tpu.models.config import (  # noqa: F401
 )
 from triton_distributed_tpu.models.kv_cache import (  # noqa: F401
     KVCache,
+    PagedModelCache,
     init_kv_cache,
+    init_paged_model_cache,
     kv_cache_specs,
 )
 from triton_distributed_tpu.models.dense import (  # noqa: F401
@@ -17,6 +19,7 @@ from triton_distributed_tpu.models.dense import (  # noqa: F401
     dense_llm_specs,
     dense_prefill,
     dense_decode_step,
+    dense_decode_step_paged,
 )
 from triton_distributed_tpu.models.engine import Engine  # noqa: F401
 from triton_distributed_tpu.models.auto import AutoLLM, auto_tokenizer  # noqa: F401

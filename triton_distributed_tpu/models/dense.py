@@ -149,6 +149,21 @@ def dense_prefill(params: dict, cfg: ModelConfig, input_ids: jax.Array,
     return logits, cache._replace(offset=jnp.int32(seq))
 
 
+def _decode_body(params: dict, cfg: ModelConfig, tokens: jax.Array,
+                 attend, *, axis: str, n: int, mode: str) -> jax.Array:
+    """Shared one-token transformer walk; ``attend(i, attn_params, h)``
+    supplies the attention (and threads its cache via closure)."""
+    x = params["embed"][tokens]  # (B, h)
+    for i, layer in enumerate(params["layers"]):
+        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+        x = x + attend(i, layer["attn"], h)
+        h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp_or_moe(
+            layer, cfg, h, axis=axis, n=n,
+            mode=mode if mode in ("ar", "xla_rep") else "ar")
+    return _logits(params, cfg, x, axis=axis, n=n)
+
+
 def dense_decode_step(params: dict, cfg: ModelConfig, tokens: jax.Array,
                       cache: KVCache, *, axis: str = "tp",
                       num_ranks: int = 1, mode: str = "ar"):
@@ -156,17 +171,42 @@ def dense_decode_step(params: dict, cfg: ModelConfig, tokens: jax.Array,
     (logits (B, vocab), cache advanced by one)."""
     n = num_ranks
     pos = cache.offset
-    x = params["embed"][tokens]  # (B, h)
-    for i, layer in enumerate(params["layers"]):
-        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
-        attn_out, kv = tp_attn_decode(
-            layer["attn"], cfg, h, cache.layer(i), pos,
-            axis=axis, num_ranks=n, mode=mode)
+
+    def attend(i, attn_params, h):
+        nonlocal cache
+        out, kv = tp_attn_decode(attn_params, cfg, h, cache.layer(i), pos,
+                                 axis=axis, num_ranks=n, mode=mode)
         cache = cache.with_layer(i, kv)
-        x = x + attn_out
-        h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp_or_moe(
-            layer, cfg, h, axis=axis, n=n,
-            mode=mode if mode in ("ar", "xla_rep") else "ar")
-    logits = _logits(params, cfg, x, axis=axis, n=n)
+        return out
+
+    logits = _decode_body(params, cfg, tokens, attend,
+                          axis=axis, n=n, mode=mode)
     return logits, cache._replace(offset=pos + 1)
+
+
+def dense_decode_step_paged(params: dict, cfg: ModelConfig,
+                            tokens: jax.Array, cache, *, axis: str = "tp",
+                            num_ranks: int = 1, mode: str = "ar"):
+    """One-token decode over a :class:`PagedModelCache` — per-sequence
+    positions (continuous batching: every sequence in the batch may be at
+    a different length). tokens: (B,) replicated. Returns (logits, cache
+    advanced by one per sequence)."""
+    from triton_distributed_tpu.layers.tp_attn import tp_attn_decode_paged
+
+    n = num_ranks
+    start_lens = cache.kv_lens
+
+    def attend(i, attn_params, h):
+        nonlocal cache
+        # Every layer appends at the same positions: reset kv_lens to the
+        # step's start for each layer, advance once at the end.
+        layer_cache = cache.layer(i)._replace(kv_lens=start_lens)
+        out, layer_cache = tp_attn_decode_paged(
+            attn_params, cfg, h, layer_cache,
+            axis=axis, num_ranks=n, mode=mode)
+        cache = cache.with_layer_pools(i, layer_cache)
+        return out
+
+    logits = _decode_body(params, cfg, tokens, attend,
+                          axis=axis, n=n, mode=mode)
+    return logits, cache._replace(kv_lens=start_lens + 1)

@@ -53,3 +53,46 @@ def kv_cache_specs(axis: str = "tp"):
 
     return KVCache(k=P(None, None, None, axis, None),
                    v=P(None, None, None, axis, None), offset=P())
+
+
+class PagedModelCache(NamedTuple):
+    """Per-layer paged pools + ONE page table / length vector shared by all
+    layers (layers always hold the same positions). The modern-serving
+    cache shape: sequences of different lengths share pools, and the decode
+    step takes per-sequence positions (continuous batching).
+
+    k_pools/v_pools: (L, num_pages, page, hkv, d); page_table: (B,
+    max_pages) int32; kv_lens: (B,) int32.
+    """
+
+    k_pools: jax.Array
+    v_pools: jax.Array
+    page_table: jax.Array
+    kv_lens: jax.Array
+
+    def layer(self, i: int):
+        from triton_distributed_tpu.ops.paged_attention import PagedKVCache
+
+        return PagedKVCache(self.k_pools[i], self.v_pools[i],
+                            self.page_table, self.kv_lens)
+
+    def with_layer_pools(self, i: int, layer_cache) -> "PagedModelCache":
+        return self._replace(
+            k_pools=self.k_pools.at[i].set(layer_cache.k_pool),
+            v_pools=self.v_pools.at[i].set(layer_cache.v_pool))
+
+
+def init_paged_model_cache(cfg, batch: int, *, page_size: int,
+                           max_pages: int, num_pages: int | None = None,
+                           dtype=None,
+                           num_kv_heads: int | None = None) -> PagedModelCache:
+    """Zeroed pools + identity page tables (the host's allocator may
+    rewrite tables between steps — they are data)."""
+    heads = num_kv_heads if num_kv_heads is not None else cfg.num_kv_heads
+    num_pages = num_pages or batch * max_pages
+    dt = dtype or jnp.dtype(cfg.dtype)
+    shape = (cfg.num_layers, num_pages, page_size, heads, cfg.head_dim)
+    table = (jnp.arange(batch * max_pages, dtype=jnp.int32)
+             .reshape(batch, max_pages) % num_pages)
+    return PagedModelCache(jnp.zeros(shape, dt), jnp.zeros(shape, dt),
+                           table, jnp.zeros((batch,), jnp.int32))

@@ -62,19 +62,30 @@ def init_paged_kv_cache(batch: int, *, num_pages: int, page_size: int,
 def paged_append(cache: PagedKVCache, k_new: jax.Array,
                  v_new: jax.Array) -> PagedKVCache:
     """Append one token's k/v per sequence at each sequence's current
-    length (k_new/v_new: (B, hkv, d)); pure-functional scatter."""
+    length (k_new/v_new: (B, hkv, d)); pure-functional scatter.
+
+    Sequences already at capacity (kv_lens == max_pages*page) are
+    SATURATED: the append is dropped and kv_lens stays put — under jit a
+    runtime error is impossible, and clamp-indexing would silently corrupt
+    the last page instead. The host owns eviction/reallocation.
+    """
     P = cache.page_size
     b = k_new.shape[0]
+    capacity = cache.page_table.shape[1] * P
     pos = cache.kv_lens
-    page_idx = cache.page_table[jnp.arange(b), pos // P]
-    row = pos % P
+    ok = pos < capacity
+    safe_pos = jnp.minimum(pos, capacity - 1)
+    page_idx = cache.page_table[jnp.arange(b), safe_pos // P]
+    row = safe_pos % P
 
     def scatter(pool, new):
-        return pool.at[page_idx, row].set(new.astype(pool.dtype))
+        cur = pool[page_idx, row]
+        val = jnp.where(ok[:, None, None], new.astype(pool.dtype), cur)
+        return pool.at[page_idx, row].set(val)
 
     return cache._replace(k_pool=scatter(cache.k_pool, k_new),
                           v_pool=scatter(cache.v_pool, v_new),
-                          kv_lens=cache.kv_lens + 1)
+                          kv_lens=cache.kv_lens + ok.astype(jnp.int32))
 
 
 # ---------------------------------------------------------------------------
@@ -85,7 +96,7 @@ def _paged_decode_kernel(max_pages: int, page: int, scale: float,
                          table_ref, lens_ref,       # scalar prefetch (SMEM)
                          q_ref, kp_ref, vp_ref,     # q block + pools (ANY)
                          o_ref,                     # out block (VMEM)
-                         kpg, vpg, acc, stat, sem):
+                         kpg, vpg, acc, stat, sem, sem2):
     b = pl.program_id(0)
     j = pl.program_id(1)
     kv_len = lens_ref[b]
@@ -101,12 +112,12 @@ def _paged_decode_kernel(max_pages: int, page: int, scale: float,
     @pl.when(valid_in_page > 0)
     def _():
         pid = table_ref[b * max_pages + j]
-        cp = pltpu.make_async_copy(kp_ref.at[pid], kpg, sem)
-        cp.start()
-        cp.wait()
-        cp = pltpu.make_async_copy(vp_ref.at[pid], vpg, sem)
-        cp.start()
-        cp.wait()
+        ck = pltpu.make_async_copy(kp_ref.at[pid], kpg, sem)
+        cv = pltpu.make_async_copy(vp_ref.at[pid], vpg, sem2)
+        ck.start()
+        cv.start()          # both page DMAs in flight together
+        ck.wait()
+        cv.wait()
 
         q = q_ref[0].astype(jnp.float32)            # (hq, d)
         hq, d = q.shape
@@ -171,6 +182,7 @@ def paged_decode_attention(q: jax.Array, cache: PagedKVCache) -> jax.Array:
             pltpu.VMEM((page, hkv, d), cache.v_pool.dtype),
             pltpu.VMEM((hq, d), jnp.float32),
             pltpu.VMEM((hq, 128), jnp.float32),   # stat: [:,0]=m, [:,1]=l
+            pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA(()),
         ],
     )
