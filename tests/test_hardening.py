@@ -219,3 +219,19 @@ def test_engine_serve_profile(ctx, tmp_path):
     assert out.shape == (1, 3)
     files = [p for p in (tmp_path / "decode").rglob("*") if p.is_file()]
     assert files, "no profiler trace emitted"
+
+
+def test_gemm_rs_with_straggler(ctx):
+    """Straggler parity for the role-inverted kernel (reference injects on
+    allreduce/RS paths too, allreduce.py:137)."""
+    from triton_distributed_tpu.ops import gemm_rs
+    from triton_distributed_tpu.ops.gemm_reduce_scatter import GemmRSConfig
+
+    n, m, k, cols = 8, 64, 32, 128
+    rng = np.random.default_rng(6)
+    a = jnp.asarray(rng.standard_normal((m, n * k)) * 0.1, jnp.float32)
+    b = jnp.asarray(rng.standard_normal((n * k, cols)) * 0.1, jnp.float32)
+    ref = np.asarray(a) @ np.asarray(b)
+    for s_rank in (0, 5):
+        out = gemm_rs(a, b, ctx, cfg=GemmRSConfig(straggler=(s_rank, 5000)))
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-3, atol=1e-3)

@@ -103,3 +103,19 @@ def test_paged_decode_ragged_lengths(ctx):
     solo_logits, _ = dense_decode_step_paged(params, cfg, tok[1:2], solo)
     np.testing.assert_allclose(np.asarray(solo_logits)[0],
                                np.asarray(logits)[1], rtol=2e-4, atol=2e-4)
+
+def test_engine_paged_matches_linear_serve(ctx):
+    """Engine(page_size=...) must generate IDENTICAL tokens to the linear
+    engine — same params, same prompt, greedy decoding."""
+    from triton_distributed_tpu.models.engine import Engine
+
+    cfg = tiny_config()
+    params = init_dense_llm(jax.random.PRNGKey(3), cfg)
+    ids = jnp.asarray([[1, 2, 3, 4, 5]], jnp.int32)
+
+    lin = Engine(cfg, params, ctx=ctx, backend="xla", max_seq=32)
+    paged = Engine(cfg, params, ctx=ctx, backend="xla", max_seq=32,
+                   page_size=8)
+    out_lin = np.asarray(lin.serve(ids, gen_len=6))
+    out_paged = np.asarray(paged.serve(ids, gen_len=6))
+    np.testing.assert_array_equal(out_lin, out_paged)

@@ -13,6 +13,7 @@ from triton_distributed_tpu.models.kv_cache import (  # noqa: F401
     init_kv_cache,
     init_paged_model_cache,
     kv_cache_specs,
+    paged_cache_specs,
 )
 from triton_distributed_tpu.models.dense import (  # noqa: F401
     init_dense_llm,

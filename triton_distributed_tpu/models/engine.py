@@ -21,9 +21,11 @@ from triton_distributed_tpu.layers.tp_mlp import pick_mode
 from triton_distributed_tpu.models.config import ModelConfig
 from triton_distributed_tpu.models.dense import (
     dense_llm_specs, dense_prefill, dense_decode_step,
+    dense_decode_step_paged,
 )
 from triton_distributed_tpu.models.kv_cache import (
-    KVCache, init_kv_cache, kv_cache_specs,
+    KVCache, PagedModelCache, init_kv_cache, init_paged_model_cache,
+    kv_cache_specs, paged_cache_specs,
 )
 from triton_distributed_tpu.models import sampling
 from triton_distributed_tpu.runtime.context import DistContext, get_context
@@ -40,6 +42,7 @@ class Engine:
     def __init__(self, cfg: ModelConfig, params: dict,
                  ctx: DistContext | None = None, *, axis: str = "tp",
                  backend: str = "auto", max_seq: int = 256,
+                 page_size: int | None = None,
                  prefill_fn: Callable = dense_prefill,
                  decode_fn: Callable = dense_decode_step):
         self.cfg = cfg
@@ -48,8 +51,16 @@ class Engine:
         self.n = self.ctx.axis_size(axis)
         self.backend = backend
         self.max_seq = max_seq
+        # page_size switches decode to the paged cache (continuous
+        # batching; reference PagedKVCache path). Prefill still runs the
+        # fast batched path into a linear cache, then mirrors into pages.
+        self.page_size = page_size
+        self.max_pages = (-(-max_seq // page_size)
+                          if page_size is not None else None)
         self._prefill_fn = prefill_fn
-        self._decode_fn = decode_fn
+        self._decode_fn = (dense_decode_step_paged
+                           if page_size is not None and
+                           decode_fn is dense_decode_step else decode_fn)
         if cfg.num_kv_heads % self.n:
             raise ValueError(
                 f"num_kv_heads {cfg.num_kv_heads} not divisible by TP "
@@ -101,7 +112,8 @@ class Engine:
         key = ("decode",)
         if key not in self._jit_cache:
             mode = self._decode_mode()
-            cspecs = kv_cache_specs(self.axis)
+            cspecs = (paged_cache_specs(self.axis) if self.page_size
+                      else kv_cache_specs(self.axis))
 
             def step(params, tokens, cache):
                 logits, cache = self._decode_fn(
@@ -124,6 +136,28 @@ class Engine:
             cache, jax.tree.map(lambda s: NamedSharding(mesh, s),
                                 kv_cache_specs(self.axis),
                                 is_leaf=lambda x: isinstance(x, P)))
+
+    def to_paged(self, cache: KVCache) -> PagedModelCache:
+        """Mirror a linear cache (the fast batched-prefill target) into the
+        paged layout: identity page tables, per-sequence lengths = offset.
+        Pure reshape+pad under jit, sharding-preserving."""
+        L, batch = cache.k.shape[0], cache.k.shape[1]
+        P_, mp = self.page_size, self.max_pages
+        pad = mp * P_ - cache.max_seq
+
+        def to_pools(x):   # (L, B, S, hkv, d) -> (L, B*mp, P, hkv, d)
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+            return x.reshape(L, batch * mp, P_, *x.shape[3:])
+
+        pcache = PagedModelCache(
+            k_pools=to_pools(cache.k), v_pools=to_pools(cache.v),
+            page_table=jnp.arange(batch * mp, dtype=jnp.int32).reshape(batch, mp),
+            kv_lens=jnp.full((batch,), cache.offset, jnp.int32))
+        mesh = self.ctx.mesh
+        return jax.device_put(
+            pcache, jax.tree.map(lambda sp: NamedSharding(mesh, sp),
+                                 paged_cache_specs(self.axis),
+                                 is_leaf=lambda x: isinstance(x, P)))
 
     def prefill(self, input_ids: jax.Array, cache: KVCache | None = None):
         """input_ids: (B, S). Returns (last-token logits (B, vocab), cache)."""
@@ -150,6 +184,8 @@ class Engine:
         from triton_distributed_tpu.runtime.utils import group_profile
 
         logits, cache = self.prefill(jnp.asarray(input_ids))
+        if self.page_size is not None:
+            cache = self.to_paged(cache)
         tok = sampling.greedy(logits)
         outs = [tok]
         with group_profile("decode", do_prof=profile_dir is not None,

@@ -96,3 +96,14 @@ def init_paged_model_cache(cfg, batch: int, *, page_size: int,
              .reshape(batch, max_pages) % num_pages)
     return PagedModelCache(jnp.zeros(shape, dt), jnp.zeros(shape, dt),
                            table, jnp.zeros((batch,), jnp.int32))
+
+
+def paged_cache_specs(axis: str = "tp"):
+    """Sharding specs for PagedModelCache: pools sharded on the kv-head
+    dim (same TP layout as the linear cache), table/lengths replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    return PagedModelCache(
+        k_pools=P(None, None, None, axis, None),
+        v_pools=P(None, None, None, axis, None),
+        page_table=P(), kv_lens=P())

@@ -41,16 +41,19 @@ from triton_distributed_tpu.runtime.jit_cache import cached_shard_jit
 @dataclasses.dataclass(frozen=True)
 class GemmRSConfig:
     """Tile configuration (ReduceScatter2DContext analog,
-    reduce_scatter.py:47-147)."""
+    reduce_scatter.py:47-147). ``straggler``: optional (rank, cycles)
+    fault injection — that rank spins before producing, widening race
+    windows (reference straggler_option; same hook as AGGemmConfig)."""
 
     tile_m: int = 512
     tile_n: int = 1024
     tile_k: int = 1024
+    straggler: tuple | None = None
 
 
 def _gemm_rs_kernel(n: int, axis: str, m_total: int, k: int, ncols: int,
-                    tiles, x_ref, b_ref, out_ref, partial_ref, ws_ref,
-                    vacc, send_sems, recv_sem):
+                    tiles, straggler, x_ref, b_ref, out_ref, partial_ref,
+                    ws_ref, vacc, send_sems, recv_sem):
     """See module docstring.
 
     partial_ref: (m_total, ncols) staging for peer-bound partial chunks;
@@ -60,6 +63,12 @@ def _gemm_rs_kernel(n: int, axis: str, m_total: int, k: int, ncols: int,
     me = dl.rank(axis)
     mc = m_total // n
     shmem.barrier_all(axis)
+    if straggler is not None:
+        s_rank, cycles = straggler
+
+        @pl.when(me == s_rank)
+        def _():
+            pl.delay(cycles)
 
     tm, tk, tn = tiles
 
@@ -136,7 +145,7 @@ def gemm_rs_local(x_local: jax.Array, b_local: jax.Array, axis: str = "tp",
     mc = m_total // n
     tm, tk, tn = gemm_tiles(mc, k, ncols, x_local.dtype, cfg)
     kernel = functools.partial(_gemm_rs_kernel, n, axis, m_total, k, ncols,
-                               (tm, tk, tn))
+                               (tm, tk, tn), cfg.straggler)
     out = kernel_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((mc, ncols), x_local.dtype),
