@@ -39,13 +39,12 @@ def build_engine(args):
 
     n = len(jax.devices())
     ctx = initialize_distributed(mesh_shape=(n,), axis_names=("tp",))
+    kw = dict(backend=args.backend, max_seq=args.max_seq,
+              page_size=args.page_size)
     if args.checkpoint:
-        eng = AutoLLM.from_pretrained(args.checkpoint, ctx=ctx,
-                                      backend=args.backend,
-                                      max_seq=args.max_seq)
+        eng = AutoLLM.from_pretrained(args.checkpoint, ctx=ctx, **kw)
     else:
-        eng = AutoLLM.from_config(tiny_config(), ctx=ctx,
-                                  backend=args.backend, max_seq=args.max_seq)
+        eng = AutoLLM.from_config(tiny_config(), ctx=ctx, **kw)
     tok = None
     if args.tokenizer:
         from triton_distributed_tpu.models.auto import auto_tokenizer
@@ -115,6 +114,8 @@ def main():
     p.add_argument("--backend", default="auto",
                    choices=["auto", "xla", "overlap"])
     p.add_argument("--max-seq", type=int, default=512)
+    p.add_argument("--page-size", type=int, default=None,
+                   help="serve with the paged KV cache (continuous batching)")
     p.add_argument("--port", type=int, default=8400)
     p.add_argument("--demo", action="store_true",
                    help="force the 8-device virtual CPU mesh")

@@ -17,6 +17,7 @@ from triton_distributed_tpu.language.distributed_ops import (  # noqa: F401
     wait,
     notify,
     consume_token,
+    maybe_straggle,
     SignalOp,
     CommScope,
 )

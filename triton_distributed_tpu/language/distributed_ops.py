@@ -31,6 +31,7 @@ from __future__ import annotations
 import enum
 
 import jax
+from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
@@ -100,3 +101,16 @@ def notify(sem, peer, inc: int = 1, axis_type=pltpu.DeviceIdType.LOGICAL):
     remote st; DistributedOpToLLVM.cpp:233-343). ADD semantics only.
     """
     pltpu.semaphore_signal(sem, inc=inc, device_id=peer, device_id_type=axis_type)
+
+
+def maybe_straggle(straggler, me):
+    """Fault injection: if ``straggler=(rank, cycles)``, that rank spins
+    ``cycles`` before proceeding — widens race windows (reference
+    straggler_option via torch.cuda._sleep). No-op when None."""
+    if straggler is None:
+        return
+    s_rank, cycles = straggler
+
+    @pl.when(me == s_rank)
+    def _():
+        pl.delay(cycles)

@@ -140,24 +140,34 @@ class Engine:
     def to_paged(self, cache: KVCache) -> PagedModelCache:
         """Mirror a linear cache (the fast batched-prefill target) into the
         paged layout: identity page tables, per-sequence lengths = offset.
-        Pure reshape+pad under jit, sharding-preserving."""
-        L, batch = cache.k.shape[0], cache.k.shape[1]
-        P_, mp = self.page_size, self.max_pages
-        pad = mp * P_ - cache.max_seq
+        Jitted with the linear cache DONATED, so XLA aliases the KV buffers
+        instead of holding both layouts live."""
+        key = ("to_paged", cache.k.shape)
+        if key not in self._jit_cache:
+            L, batch = cache.k.shape[0], cache.k.shape[1]
+            P_, mp = self.page_size, self.max_pages
+            pad = mp * P_ - cache.max_seq
+            mesh = self.ctx.mesh
+            shardings = jax.tree.map(
+                lambda sp: NamedSharding(mesh, sp),
+                paged_cache_specs(self.axis),
+                is_leaf=lambda x: isinstance(x, P))
 
-        def to_pools(x):   # (L, B, S, hkv, d) -> (L, B*mp, P, hkv, d)
-            x = jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
-            return x.reshape(L, batch * mp, P_, *x.shape[3:])
+            def convert(c: KVCache) -> PagedModelCache:
+                def to_pools(x):  # (L, B, S, hkv, d) -> (L, B*mp, P, ...)
+                    x = jnp.pad(x, ((0, 0), (0, 0), (0, pad),
+                                    (0, 0), (0, 0)))
+                    return x.reshape(L, batch * mp, P_, *x.shape[3:])
 
-        pcache = PagedModelCache(
-            k_pools=to_pools(cache.k), v_pools=to_pools(cache.v),
-            page_table=jnp.arange(batch * mp, dtype=jnp.int32).reshape(batch, mp),
-            kv_lens=jnp.full((batch,), cache.offset, jnp.int32))
-        mesh = self.ctx.mesh
-        return jax.device_put(
-            pcache, jax.tree.map(lambda sp: NamedSharding(mesh, sp),
-                                 paged_cache_specs(self.axis),
-                                 is_leaf=lambda x: isinstance(x, P)))
+                return PagedModelCache(
+                    k_pools=to_pools(c.k), v_pools=to_pools(c.v),
+                    page_table=jnp.arange(batch * mp, dtype=jnp.int32
+                                          ).reshape(batch, mp),
+                    kv_lens=jnp.full((batch,), c.offset, jnp.int32))
+
+            self._jit_cache[key] = jax.jit(
+                convert, donate_argnums=0, out_shardings=shardings)
+        return self._jit_cache[key](cache)
 
     def prefill(self, input_ids: jax.Array, cache: KVCache | None = None):
         """input_ids: (B, S). Returns (last-token logits (B, vocab), cache)."""
@@ -167,9 +177,14 @@ class Engine:
         cache = cache if cache is not None else self.new_cache(batch)
         return self._prefill_jit(batch, seq)(self.params, input_ids, cache)
 
-    def decode(self, tokens: jax.Array, cache: KVCache):
-        """tokens: (B,). Returns (next_tokens (B,), cache). Compiled once;
-        subsequent calls replay the executable (the CUDA-graph analog)."""
+    def decode(self, tokens: jax.Array, cache):
+        """tokens: (B,). cache: KVCache (linear) or PagedModelCache when
+        ``page_size`` is set — a linear cache from prefill() is converted
+        automatically on first use. Returns (next_tokens (B,), cache).
+        Compiled once; subsequent calls replay the executable (the
+        CUDA-graph analog)."""
+        if self.page_size is not None and isinstance(cache, KVCache):
+            cache = self.to_paged(cache)
         return self._decode_jit()(self.params, tokens, cache)
 
     def serve(self, input_ids: jax.Array, gen_len: int,

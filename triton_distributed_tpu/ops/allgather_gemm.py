@@ -63,12 +63,7 @@ def _ag_gemm_kernel(n: int, axis: str, m: int, k: int, ncols: int,
     """See module docstring. ws_ref is the AG landing workspace (n·m, k)."""
     me = dl.rank(axis)
     shmem.barrier_all(axis)
-    if straggler is not None:
-        s_rank, cycles = straggler
-
-        @pl.when(me == s_rank)
-        def _():
-            pl.delay(cycles)
+    dl.maybe_straggle(straggler, me)
 
     # --- producer: local copy + full-mesh push of my shard into slot `me`.
     my_slot = ws_ref.at[pl.ds(me * m, m)]

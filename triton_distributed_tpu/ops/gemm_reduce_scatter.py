@@ -63,12 +63,7 @@ def _gemm_rs_kernel(n: int, axis: str, m_total: int, k: int, ncols: int,
     me = dl.rank(axis)
     mc = m_total // n
     shmem.barrier_all(axis)
-    if straggler is not None:
-        s_rank, cycles = straggler
-
-        @pl.when(me == s_rank)
-        def _():
-            pl.delay(cycles)
+    dl.maybe_straggle(straggler, me)
 
     tm, tk, tn = tiles
 
