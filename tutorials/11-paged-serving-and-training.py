@@ -23,7 +23,6 @@ jax = bootstrap()
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
-from triton_distributed_tpu.models import AutoLLM  # noqa: E402
 from triton_distributed_tpu.models.config import tiny_config  # noqa: E402
 from triton_distributed_tpu.models.dense import init_dense_llm  # noqa: E402
 from triton_distributed_tpu.models.engine import Engine  # noqa: E402
