@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Long-running randomized stress sweep (reference test/stress analog:
+stress_test_ag_gemm.py sweeps random shapes + stragglers for many
+iterations to shake out shape-dependent and race bugs).
+
+    python scripts/stress.py [--iters 50] [--seed 0] [--on-tpu]
+
+Every iteration draws a random op, random (aligned) shapes, a random
+straggler rank, runs it on the 8-device virtual CPU mesh (or the real
+mesh with --on-tpu), and checks the golden. Exit 0 = all iterations clean.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_DEVICES = 8
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + f" --xla_force_host_platform_device_count={N_DEVICES}")
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=30)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--on-tpu", action="store_true")
+    args = p.parse_args()
+
+    import jax
+
+    if not args.on_tpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from triton_distributed_tpu.ops import (
+        ag_gemm, all_gather, all_reduce, fast_all_to_all, gemm_rs,
+        reduce_scatter,
+    )
+    from triton_distributed_tpu.ops.allgather_gemm import AGGemmConfig
+    from triton_distributed_tpu.ops.gemm_reduce_scatter import GemmRSConfig
+    from triton_distributed_tpu.runtime import initialize_distributed
+
+    n = min(N_DEVICES, len(jax.devices()))
+    ctx = initialize_distributed(devices=jax.devices()[:n],
+                                 axis_names=("tp",))
+    rng = np.random.default_rng(args.seed)
+    fails = 0
+
+    def run_one(i):
+        op = rng.choice(["ag_gemm", "gemm_rs", "ag", "rs", "ar", "a2a"])
+        straggler = (int(rng.integers(0, n)), 3000) if rng.random() < 0.5 else None
+        if op == "ag_gemm":
+            m = int(rng.choice([8, 16, 24, 40]))
+            k = int(rng.choice([128, 256]))
+            cols = int(rng.choice([128, 256]))
+            a = jnp.asarray(rng.standard_normal((n * m, k)) * .1, jnp.float32)
+            b = jnp.asarray(rng.standard_normal((k, n * cols)) * .1, jnp.float32)
+            out = ag_gemm(a, b, ctx, cfg=AGGemmConfig(straggler=straggler))
+            ref = np.asarray(a) @ np.asarray(b)
+        elif op == "gemm_rs":
+            m = int(rng.choice([32, 64])) * n // n * n  # divisible by n
+            k = int(rng.choice([16, 32]))
+            cols = int(rng.choice([128, 256]))
+            a = jnp.asarray(rng.standard_normal((m, n * k)) * .1, jnp.float32)
+            b = jnp.asarray(rng.standard_normal((n * k, cols)) * .1, jnp.float32)
+            out = gemm_rs(a, b, ctx, cfg=GemmRSConfig(straggler=straggler))
+            ref = np.asarray(a) @ np.asarray(b)
+        elif op == "ag":
+            m = int(rng.choice([8, 16, 32]))
+            cols = int(rng.choice([128, 256, 384]))
+            x = jnp.asarray(rng.standard_normal((n * m, cols)), jnp.float32)
+            out = all_gather(x, ctx)
+            ref = np.asarray(x)
+        elif op == "rs":
+            m = int(rng.choice([8, 16]))
+            cols = int(rng.choice([128, 256]))
+            x = jnp.asarray(rng.standard_normal((n, n * m, cols)), jnp.float32)
+            out = reduce_scatter(x, ctx)
+            ref = np.asarray(x).sum(0)
+        elif op == "ar":
+            m = int(rng.choice([8, 16, 32]))
+            cols = int(rng.choice([128, 256]))
+            x = jnp.asarray(rng.standard_normal((n, m, cols)), jnp.float32)
+            out = all_reduce(x, ctx)
+            ref = np.asarray(x).sum(0)
+        else:  # a2a
+            epr, cap, hidden = 2, 32, 128
+            splits = rng.integers(0, cap // n, (n, n, epr)).astype(np.int32)
+            send = np.zeros((n, n, cap, hidden), np.float32)
+            for d_ in range(n):
+                for p_ in range(n):
+                    r_ = int(splits[d_, p_].sum())
+                    send[d_, p_, :r_] = rng.standard_normal((r_, hidden))
+            recv, rsplits = fast_all_to_all(jnp.asarray(send),
+                                            jnp.asarray(splits), ctx)
+            np.testing.assert_array_equal(np.asarray(rsplits),
+                                          np.swapaxes(splits, 0, 1))
+            recv = np.asarray(recv)
+            for d_ in range(n):
+                for p_ in range(n):
+                    r_ = int(splits[p_, d_].sum())
+                    np.testing.assert_allclose(recv[d_, p_, :r_],
+                                               send[p_, d_, :r_])
+            return op, None
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-3, atol=1e-3)
+        return op, straggler
+
+    for i in range(args.iters):
+        try:
+            op, straggler = run_one(i)
+            print(f"  [{i + 1}/{args.iters}] {op:8} "
+                  f"{'straggler=' + str(straggler) if straggler else '':24} OK",
+                  flush=True)
+        except Exception as e:
+            fails += 1
+            print(f"  [{i + 1}/{args.iters}] FAIL: {type(e).__name__}: "
+                  f"{str(e)[:200]}", flush=True)
+
+    print(f"\n{args.iters - fails}/{args.iters} iterations clean")
+    return 1 if fails else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
