@@ -70,3 +70,19 @@ def test_all_reduce_bf16_one_shot(ctx):
     expected = np.asarray(x, dtype=np.float32).sum(axis=0)
     np.testing.assert_allclose(
         np.asarray(got, dtype=np.float32), expected, rtol=2e-2, atol=2e-2)
+
+
+def test_ll_allgather_layer_buckets(ctx):
+    """Decode comm layer: bucketed low-latency AG must strip pad rows and
+    reuse compiled buckets across close shapes (reference
+    low_latency_allgather_layer staged-buffer analog)."""
+    from triton_distributed_tpu.ops import AllGatherLayer
+
+    layer = AllGatherLayer(ctx)
+    rng = np.random.default_rng(9)
+    n = 8
+    for m_local in (3, 5, 8, 13):   # 3/5 share the 8-bucket; 13 -> 16
+        x = jnp.asarray(rng.standard_normal((n * m_local, 128)), jnp.float32)
+        out = layer(x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x),
+                                   rtol=0, atol=0, err_msg=f"m={m_local}")
