@@ -1,0 +1,98 @@
+"""Paged vs linear KV decode-step latency (single device).
+
+The paged cache buys continuous batching + prefix sharing; this measures
+what it costs per step vs the linear cache at the same shapes. Timing via
+salted repeated steps (relay memoizes identical dispatches) with
+interleaved rounds (chip drift) — see bench.py.
+
+    python benchmark/bench_paged.py [--batch 8] [--seq 1024] [--page 128]
+"""
+
+import argparse
+import time
+
+from _common import bootstrap
+
+jax, ON_TPU = bootstrap(n_devices=1)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from triton_distributed_tpu.models.config import ModelConfig  # noqa: E402
+from triton_distributed_tpu.models.dense import (  # noqa: E402
+    dense_decode_step, dense_decode_step_paged, init_dense_llm,
+)
+from triton_distributed_tpu.models.kv_cache import (  # noqa: E402
+    init_kv_cache, init_paged_model_cache,
+)
+
+
+def timed_interleaved(fns, trials=8):
+    best = [float("inf")] * len(fns)
+    for i, fn in enumerate(fns):
+        jax.block_until_ready(fn(0)[0])
+    salt = 1  # varies tokens so the relay cannot memoize repeats
+    for _ in range(trials):
+        for i, fn in enumerate(fns):
+            salt += 1
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(salt)[0])
+            best[i] = min(best[i], time.perf_counter() - t0)
+    return best
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=None)
+    p.add_argument("--seq", type=int, default=None)
+    p.add_argument("--page", type=int, default=None)
+    args = p.parse_args()
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = ModelConfig(hidden_size=2048, intermediate_size=6144,
+                          num_layers=4, num_heads=16, num_kv_heads=8,
+                          head_dim=128, vocab_size=32768, dtype="bfloat16")
+        batch, seq, page = args.batch or 8, args.seq or 1024, args.page or 128
+    else:
+        cfg = ModelConfig(hidden_size=256, intermediate_size=512,
+                          num_layers=2, num_heads=8, num_kv_heads=8,
+                          head_dim=32, vocab_size=512, dtype="float32")
+        batch, seq, page = args.batch or 2, args.seq or 64, args.page or 16
+
+    rng = np.random.default_rng(0)
+    params = init_dense_llm(jax.random.PRNGKey(0), cfg)
+    max_pages = -(-seq // page) + 1
+
+    lin = init_kv_cache(cfg, batch, max_seq=seq + 8)
+    lin = lin._replace(offset=jnp.int32(seq))
+    paged = init_paged_model_cache(cfg, batch, page_size=page,
+                                   max_pages=max_pages)
+    paged = paged._replace(kv_lens=jnp.full((batch,), seq, jnp.int32))
+    tok = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch,)), jnp.int32)
+
+    # Params/caches as ARGUMENTS (closures would bake them into the HLO
+    # as constants — hundreds of MB of compile payload).
+    @jax.jit
+    def lin_step(prm, cache, salt):
+        return dense_decode_step(prm, cfg, (tok + salt) % cfg.vocab_size,
+                                 cache)
+
+    @jax.jit
+    def paged_step(prm, cache, salt):
+        return dense_decode_step_paged(prm, cfg,
+                                       (tok + salt) % cfg.vocab_size, cache)
+
+    t_lin, t_paged = timed_interleaved([
+        lambda s_: lin_step(params, lin, s_),
+        lambda s_: paged_step(params, paged, s_)])
+    print(f"# hidden={cfg.hidden_size} layers={cfg.num_layers} batch={batch} "
+          f"seq={seq} page={page} dtype={cfg.dtype} "
+          f"({'TPU' if on_tpu else 'CPU smoke'})")
+    print(f"{'linear kv':10} {t_lin * 1e3:>9.3f} ms/step")
+    print(f"{'paged kv':10} {t_paged * 1e3:>9.3f} ms/step  "
+          f"(paged/linear = {t_paged / max(t_lin, 1e-12):.3f})")
+
+
+if __name__ == "__main__":
+    main()
