@@ -44,18 +44,31 @@ class BenchError(RuntimeError):
 
 
 def _chain(matmul, a, b, n):
+    # B is (near-)orthogonal (see _orthogonal_b), so |x @ B| ≈ |x| and the
+    # chain needs NO per-iteration renormalization — the round-2 version's
+    # renorm epilogue fused into XLA's dot but not into a pallas_call,
+    # biasing the ratio with work that isn't GEMM.
     def body(i, x):
-        y = matmul(x, b)
-        # Cheap renormalization keeps bf16 bounded; identical in both paths so
-        # the differential comparison stays apples-to-apples.
-        return (y.astype(jnp.float32)
-                * (1.0 / jnp.maximum(jnp.max(jnp.abs(y)).astype(jnp.float32), 1e-3))
-                ).astype(x.dtype)
+        return matmul(x, b)
 
     out = jax.lax.fori_loop(0, n, body, a)
     # Reduce to a scalar ON DEVICE: fetching the full (M, K) result through
     # the relay costs ~1s of transfer noise that swamps the compute signal.
     return jnp.sum(out.astype(jnp.float32))
+
+
+def _orthogonal_b(k: int, dtype):
+    """(k, k) near-orthogonal matrix, cheap: kron of two small orthogonals
+    (kron preserves orthogonality), so a chained x@B stays bounded without
+    an epilogue. Falls back to scaled Gaussian if k doesn't factor."""
+    for f in (64, 32, 16, 8):
+        if k % f == 0:
+            rng = np.random.default_rng(0)
+            q1 = np.linalg.qr(rng.standard_normal((f, f)))[0]
+            q2 = np.linalg.qr(rng.standard_normal((k // f, k // f)))[0]
+            return jnp.asarray(np.kron(q1, q2), dtype)
+    rng = np.random.default_rng(0)
+    return jnp.asarray(rng.standard_normal((k, k)) / np.sqrt(k), dtype)
 
 
 def _timed_once(fn, a, b, n):
@@ -143,13 +156,25 @@ def _measure_and_report():
 
     rng = np.random.default_rng(0)
     a = jnp.asarray(rng.standard_normal((M, K)) * 0.05, dtype)
-    b = jnp.asarray(rng.standard_normal((K, K)) * 0.05, dtype)
+    b = _orthogonal_b(K, dtype)
 
     xla_dot = lambda x, w: jnp.dot(  # noqa: E731
         x, w, preferred_element_type=jnp.float32).astype(x.dtype)
 
+    # The pallas candidate resolves its tile config through the contextual
+    # autotuner (measured on-chip, disk-cached) — the default op path.
+    if on_tpu:
+        from triton_distributed_tpu.runtime.autotuner import tuned_matmul_tiles
+
+        tiles = tuned_matmul_tiles(M, K, K, dtype) or (512, 1024, 1024)
+        tm, tn, tk = tiles
+        pallas_dot = lambda x, w: pallas_matmul(  # noqa: E731
+            x, w, tile_m=tm, tile_n=tn, tile_k=tk)
+    else:
+        pallas_dot = pallas_matmul
+
     xla_fn = jax.jit(functools.partial(_chain, xla_dot), static_argnums=2)
-    pallas_fn = jax.jit(functools.partial(_chain, pallas_matmul), static_argnums=2)
+    pallas_fn = jax.jit(functools.partial(_chain, pallas_dot), static_argnums=2)
 
     flops = 2.0 * M * K * K
     times_xla, times_pallas = _timed_interleaved(
@@ -157,12 +182,76 @@ def _measure_and_report():
     t_xla = _per_iter_seconds(times_xla, lengths, flops, strict=strict)
     t_pallas = _per_iter_seconds(times_pallas, lengths, flops, strict=strict)
 
-    print(json.dumps({
+    result = {
         "metric": "pallas_gemm_tflops_qwen3_tp8_shape",
         "value": round(flops / t_pallas / 1e12, 3),
         "unit": "TFLOP/s",
         "vs_baseline": round(t_xla / t_pallas, 4),
-    }))
+    }
+    if on_tpu:
+        try:
+            result.update(_decode_step_metric())
+        except Exception as e:  # decode metric is additive — never block
+            result["decode_error"] = f"{type(e).__name__}: {str(e)[:120]}"
+    print(json.dumps(result))
+
+
+def _decode_step_metric(gen=(3, 10)):
+    """North-star decode-step latency (BASELINE.md's 5.49→3.33 ms ladder):
+    one-token decode at Qwen3-8B TP=8 PER-DEVICE shard shapes (hidden 4096,
+    4 q + 1 kv local heads, ffn 1536, 36 layers, ctx 512), bs=1, measured as
+    a differential over two jitted multi-step decode chains (token fed back,
+    cache threaded) so dispatch+fetch cost cancels. Runs the Engine's ar
+    decode path math (dense_decode_step, mode='ar', n=1 — single real chip)."""
+    import jax.random as jrandom
+
+    from triton_distributed_tpu.models.config import ModelConfig
+    from triton_distributed_tpu.models.dense import (
+        dense_decode_step, init_dense_llm,
+    )
+    from triton_distributed_tpu.models.kv_cache import init_kv_cache
+
+    cfg = ModelConfig(hidden_size=4096, intermediate_size=1536,
+                      num_layers=36, num_heads=4, num_kv_heads=1,
+                      head_dim=128, vocab_size=151936, qk_norm=True)
+    params = init_dense_llm(jrandom.PRNGKey(0), cfg)
+    cache = init_kv_cache(cfg, 1, 512)
+    cache = cache._replace(offset=jnp.int32(256))  # mid-context decode
+    tok0 = jnp.zeros((1,), jnp.int32)
+
+    def run(tok, cache, n):
+        def body(i, carry):
+            tok, cache = carry
+            logits, cache = dense_decode_step(params, cfg, tok, cache,
+                                              num_ranks=1, mode="ar")
+            # Feed back the argmax token, reset offset so chain length
+            # doesn't change the attended window (steady-state step).
+            return (jnp.argmax(logits, -1).astype(jnp.int32),
+                    cache._replace(offset=jnp.int32(256)))
+
+        tok, _ = jax.lax.fori_loop(0, n, body, (tok, cache))
+        return tok
+
+    jfn = jax.jit(run, static_argnums=2)
+
+    def timed(n):
+        t0 = time.perf_counter()
+        _ = np.asarray(jfn(tok0, cache, n))
+        return time.perf_counter() - t0
+
+    n1, n2 = gen
+    timed(n1), timed(n2)
+    best = {n: float("inf") for n in gen}
+    for _ in range(3):
+        for n in gen:
+            best[n] = min(best[n], timed(n))
+    ms = (best[n2] - best[n1]) / (n2 - n1) * 1e3
+    if ms <= 0:
+        raise BenchError("non-positive decode differential")
+    return {"decode_step_ms_qwen3_8b_tp8_shard": round(ms, 3),
+            "decode_ref_ms": {"torch_cudagraph_h800": 5.49,
+                              "triton_dist_AR_h800": 4.65,
+                              "megatriton_h800": 3.33}}
 
 
 if __name__ == "__main__":

@@ -19,6 +19,7 @@ import argparse
 import json
 import os
 import sys
+import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -54,6 +55,10 @@ def build_engine(args):
 
 
 def make_handler(eng, tok):
+    # ThreadingHTTPServer handles requests concurrently, but Engine.serve
+    # mutates the shared _jit_cache and interleaves device computation —
+    # serialize generation (sufficient for this demo server).
+    gen_lock = threading.Lock()
     import jax.numpy as jnp
     import numpy as np
 
@@ -94,7 +99,8 @@ def make_handler(eng, tok):
                     ids = np.asarray([tok.encode(req["prompt"])], np.int32)
                 else:
                     ids = np.asarray(req["input_ids"], np.int32)
-                out = eng.serve(jnp.asarray(ids), gen_len=gen_len)
+                with gen_lock:
+                    out = eng.serve(jnp.asarray(ids), gen_len=gen_len)
                 out_ids = np.asarray(out).tolist()
                 resp = {"output_ids": out_ids}
                 if tok is not None:
@@ -112,7 +118,9 @@ def main():
                    help="local HF checkpoint dir (default: tiny random model)")
     p.add_argument("--tokenizer", default=None)
     p.add_argument("--backend", default="auto",
-                   choices=["auto", "xla", "overlap"])
+                   choices=["auto", "xla", "overlap", "megakernel"],
+                   help="megakernel = persistent-kernel decode "
+                        "(one pallas_call per token; TP=1, head_dim=128)")
     p.add_argument("--max-seq", type=int, default=512)
     p.add_argument("--page-size", type=int, default=None,
                    help="serve with the paged KV cache (continuous batching)")

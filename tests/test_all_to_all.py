@@ -100,3 +100,18 @@ def test_dispatch_combine_round_trip(ctx):
             np.testing.assert_allclose(got[order_g], want[order_w],
                                        rtol=0, atol=0)
     assert (gsizes.sum() == (np.asarray(eids) >= 0).sum())
+    # Lossless cap -> overflow indicator reports zero drops everywhere.
+    assert int(np.asarray(layout.overflow).sum()) == 0
+
+
+def test_dispatch_layout_overflow_reported():
+    """Undersized cap drops tokens — and says so (VERDICT r2 #9: the
+    reference's MAX_M contract made checkable instead of silent)."""
+    m, hidden, n, num_experts, cap = 16, 8, 2, 4, 4
+    rng = np.random.default_rng(3)
+    tokens = jnp.asarray(rng.standard_normal((m, hidden)), jnp.float32)
+    eids = jnp.zeros((m,), jnp.int32)          # all to expert 0 => rank 0
+    lay = dispatch_layout(tokens, eids, num_experts, n, cap)
+    assert int(lay.overflow) == m - cap
+    full = dispatch_layout(tokens, eids, num_experts, n, m)
+    assert int(full.overflow) == 0

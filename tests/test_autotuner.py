@@ -71,3 +71,49 @@ def test_tune_ag_gemm_end_to_end(ctx, tmp_path, monkeypatch):
     b = jnp.asarray(rng.standard_normal((k, n * cols)), jnp.float32)
     cfg = tune_ag_gemm(a, b, ctx)
     assert cfg.tile_m <= m and cfg.tile_k <= k
+
+
+def test_measure_chain_ranks_work():
+    """Chain-differential timing (the axon-relay-safe measure) separates a
+    cheap op from a 64x-heavier one and survives non-square outputs."""
+    from triton_distributed_tpu.runtime.autotuner import measure_chain
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((64, 64)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((64, 128)), jnp.float32)
+
+    def cheap(x, w):
+        return x @ w                      # (64, 128): not x's shape
+
+    def heavy(x, w):
+        y = x @ w
+        for _ in range(63):
+            y = y + x @ w
+        return y
+
+    t_cheap = measure_chain(cheap, (x, w), lengths=(4, 64), trials=2)
+    t_heavy = measure_chain(heavy, (x, w), lengths=(4, 64), trials=2)
+    assert t_heavy > t_cheap
+
+
+def test_default_cfg_resolution_off_chip(monkeypatch):
+    """cfg=None resolves to the static defaults when tuning is off, and the
+    tuned-matmul entry answers correctly."""
+    from triton_distributed_tpu.ops.allgather_gemm import (
+        AGGemmConfig, resolve_gemm_cfg,
+    )
+    from triton_distributed_tpu.ops.gemm import pallas_matmul_tuned
+    from triton_distributed_tpu.runtime.autotuner import autotune_enabled
+
+    monkeypatch.setenv("TDTPU_AUTOTUNE", "0")   # force off even on TPU hosts
+    assert not autotune_enabled()
+    cfg = resolve_gemm_cfg(None, AGGemmConfig, 256, 512, 512, jnp.float32)
+    assert cfg == AGGemmConfig()
+    assert resolve_gemm_cfg(AGGemmConfig(tile_m=128), AGGemmConfig,
+                            256, 512, 512, jnp.float32).tile_m == 128
+    rng = np.random.default_rng(1)
+    a = jnp.asarray(rng.standard_normal((32, 64)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((64, 128)), jnp.float32)
+    np.testing.assert_allclose(np.asarray(pallas_matmul_tuned(a, b)),
+                               np.asarray(a) @ np.asarray(b), rtol=1e-5,
+                               atol=1e-5)

@@ -48,11 +48,14 @@ def moe_case():
                 wd=wd, ref=ref)
 
 
-def test_moe_tp_golden(ctx, moe_case):
+@pytest.mark.parametrize("mode", ["ring", "overlap", "xla"])
+def test_moe_tp_golden(ctx, moe_case, mode):
+    """All three gather strategies — ring pipeline (default), sequential
+    Pallas AG, lax.all_gather — match the per-token dense golden."""
     c = moe_case
     out = moe_tp_fwd(jnp.asarray(c["x"]), jnp.asarray(c["router"]),
                      jnp.asarray(c["wg"]), jnp.asarray(c["wu"]),
-                     jnp.asarray(c["wd"]), c["topk"], ctx)
+                     jnp.asarray(c["wd"]), c["topk"], ctx, mode=mode)
     np.testing.assert_allclose(np.asarray(out), c["ref"],
                                rtol=2e-3, atol=2e-3)
 

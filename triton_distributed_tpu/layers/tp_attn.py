@@ -90,7 +90,9 @@ def _project_qkv(params, cfg: ModelConfig, x, batch, seq, *, axis, n, mode):
 
 
 def _sdpa(q, k, v, *, causal: bool, kv_len: jax.Array | None = None):
-    """Grouped-query scaled dot-product attention.
+    """Grouped-query scaled dot-product attention (dense — decode path over
+    a padded cache; prefill goes through the tiled flash kernel, see
+    tp_attn_prefill).
 
     q: (B, Sq, hq, d); k/v: (B, Skv, hkv, d); hq % hkv == 0.
     ``kv_len`` masks positions >= kv_len (decode over a padded cache).
@@ -141,7 +143,12 @@ def tp_attn_prefill(params: dict, cfg: ModelConfig, x: jax.Array,
     else:
         new_kv = KVSlice(k=k, v=v)
 
-    attn = _sdpa(q, k, v, causal=True)
+    # Tiled Pallas flash attention (ops/flash_attention.py) — flat-memory
+    # causal prefill; dense fallback only for tiny/odd shapes. Reference:
+    # the FA consumer the reference's TP_Attn runs (tp_attn.py:79-324).
+    from triton_distributed_tpu.ops.flash_attention import shard_attention
+
+    attn = shard_attention(q, k, v, causal=True)
     attn = attn.reshape(batch * seq, -1)
 
     if n == 1:

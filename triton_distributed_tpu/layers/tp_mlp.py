@@ -52,13 +52,32 @@ def tp_mlp_specs(axis: str = "tp") -> dict:
             "w_down": P(axis, None)}
 
 
-def pick_mode(mode: str, m_total: int, n: int) -> str:
-    """Resolve ``auto`` (reference models/dense.py:84-99 mode dispatch)."""
+def pick_mode(mode: str, m_total: int, n: int, *, hidden: int | None = None,
+              ffn: int | None = None, itemsize: int = 2) -> str:
+    """Resolve ``auto`` (reference models/dense.py:84-99 mode dispatch).
+
+    With layer dims supplied, the choice is perf-model-driven: the overlap
+    path (AG+GEMM → GEMM+RS) wins when its modeled time beats the replicated
+    GEMM + fused AllReduce path (runtime/perf_model.py — the analog of the
+    reference's get_auto_* selectors, allgather.py:57 / allreduce.py:1101).
+    Without dims, small decode-like rows fall back to ``ar``.
+    """
     if mode != "auto":
         return mode
-    if n > 1 and m_total % n == 0 and m_total // n >= 8:
-        return "overlap"
-    return "ar"
+    if n <= 1 or m_total % n or m_total // n < 8:
+        return "ar"
+    if hidden is not None and ffn is not None:
+        from triton_distributed_tpu.runtime.perf_model import (
+            ag_gemm_time_s, allreduce_time_s, gemm_rs_time_s, gemm_time_s,
+        )
+
+        t_overlap = (ag_gemm_time_s(m_total, ffn, hidden, n, itemsize)
+                     + gemm_rs_time_s(m_total, hidden, ffn, n, itemsize))
+        t_ar = (gemm_time_s(m_total, ffn, hidden, itemsize)
+                + gemm_time_s(m_total, hidden, ffn, itemsize)
+                + allreduce_time_s(m_total * hidden * itemsize, n))
+        return "overlap" if t_overlap <= t_ar else "ar"
+    return "overlap"
 
 
 def tp_mlp_fwd(params: dict, x: jax.Array, *, axis: str = "tp",

@@ -1,0 +1,171 @@
+"""Megakernel serving — dense/HF model params in, a decode backend out.
+
+Reference: ``mega_triton_kernel/models/qwen3.py`` (HF weights feeding the
+persistent-kernel task graph) + ``model_server.py`` (the serving loop that
+replays it — the 3.33 ms headline path, BASELINE.md). Round-2 VERDICT #5:
+the megakernel ran only random-feed benches; this module loads real model
+params (models/hf_loader.py or init_dense_llm) into DecodeLayerHandles
+feeds and exposes the decode loop the Engine drives.
+
+Flow: Engine prefills with the fast batched dense path (linear KV cache),
+then the cache is transposed into the megakernel's per-head kT/v workspace
+regions and every subsequent token is ONE pallas_call (plus embed/lm_head,
+which stay outside the kernel exactly like the reference keeps sampling
+host-side). The per-step k/v append is a functional workspace column/row
+update — the host-side analog of the reference's in-kernel KV append (a
+deliberate design delta, see megakernel/models.py docstring).
+
+Single-device view (TP=1): the multi-rank megakernel path (in-kernel AR
+tasks) is exercised by tests/test_megakernel_decode.py::test_decode_step_tp8;
+serving glue targets the one-chip case the benchmark ladder measures.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_distributed_tpu.layers.common import rms_norm
+from triton_distributed_tpu.megakernel.models import (
+    DecodeStepProgram, advance_queue_pos, broadcast_rows, build_decode_step,
+    rope_tables,
+)
+from triton_distributed_tpu.megakernel.tasks import TILE
+from triton_distributed_tpu.models.config import ModelConfig
+
+
+def validate_megakernel_cfg(cfg: ModelConfig, max_seq: int) -> None:
+    if cfg.head_dim != TILE:
+        raise ValueError(f"megakernel needs head_dim == {TILE} "
+                         f"(got {cfg.head_dim})")
+    if cfg.hidden_size % TILE or cfg.intermediate_size % TILE:
+        raise ValueError("hidden/intermediate sizes must be TILE multiples")
+    if max_seq % TILE:
+        raise ValueError("max_seq must be a TILE multiple")
+    if cfg.is_moe:
+        raise ValueError("megakernel serving covers the dense stack")
+
+
+def weight_feeds(prog: DecodeStepProgram, cfg: ModelConfig,
+                 params: dict) -> dict:
+    """Map a dense param tree (init_dense_llm / hf_loader layout) onto the
+    program's workspace handles. Global view == per-device view at TP=1."""
+    feeds: dict = {}
+    for h, layer in zip(prog.layers, params["layers"]):
+        attn = layer["attn"]
+        feeds[h.attn_norm] = broadcast_rows(np.asarray(
+            layer["attn_norm"], np.float32))
+        feeds[h.mlp_norm] = broadcast_rows(np.asarray(
+            layer["mlp_norm"], np.float32))
+        qn = (np.asarray(attn["q_norm"], np.float32) if cfg.qk_norm
+              else np.ones(cfg.head_dim, np.float32))
+        kn = (np.asarray(attn["k_norm"], np.float32) if cfg.qk_norm
+              else np.ones(cfg.head_dim, np.float32))
+        feeds[h.q_norm] = broadcast_rows(qn)
+        feeds[h.k_norm] = broadcast_rows(kn)
+        feeds[h.wq] = attn["wq"]
+        feeds[h.wk] = attn["wk"]
+        feeds[h.wv] = attn["wv"]
+        feeds[h.wo] = attn["wo"]
+        mlp = layer["mlp"]
+        feeds[h.w_gate] = mlp["w_gate"]
+        feeds[h.w_up] = mlp["w_up"]
+        feeds[h.w_down] = mlp["w_down"]
+    return feeds
+
+
+def cache_feeds(prog: DecodeStepProgram, cache) -> dict:
+    """KV cache (models/kv_cache.KVCache, batch 1) → per-head kT/v feeds."""
+    feeds: dict = {}
+    k, v = cache.k, cache.v    # (L, 1, S, hkv, d)
+    for li, h in enumerate(prog.layers):
+        for kv in range(len(h.kT)):
+            feeds[h.kT[kv]] = k[li, 0, :, kv, :].T      # (d, S)
+            feeds[h.v[kv]] = v[li, 0, :, kv, :]         # (S, d)
+    return feeds
+
+
+class MegakernelDecoder:
+    """One-chip decode loop over the compiled megakernel.
+
+    Build once per (cfg, max_seq); ``start(cache)`` loads a prefilled KV
+    cache into the workspace; ``step`` runs one token (jitted once — the
+    queue is retargeted per position without recompiling,
+    megakernel/models.py advance_queue_pos).
+    """
+
+    def __init__(self, cfg: ModelConfig, params: dict, *, max_seq: int,
+                 dtype=jnp.float32):
+        validate_megakernel_cfg(cfg, max_seq)
+        self.cfg = cfg
+        self.max_seq = max_seq
+        self.prog = build_decode_step(
+            hidden=cfg.hidden_size, hq_local=cfg.num_heads,
+            hkv_local=cfg.num_kv_heads, ffn_local=cfg.intermediate_size,
+            num_layers=cfg.num_layers, max_seq=max_seq,
+            pos=max_seq - 1, num_ranks=1, eps=cfg.rms_norm_eps)
+        self.comp = self.prog.mb.compile(dtype=dtype)
+        self._weights = weight_feeds(self.prog, cfg, params)
+        self.embed = params["embed"]
+        self.final_norm = params["final_norm"]
+        self.lm_head = params.get("lm_head")
+        self._step_jit = jax.jit(functools.partial(self._step))
+
+    # -- workspace ----------------------------------------------------------
+    def start(self, cache) -> jax.Array:
+        """Workspace with weights + the prefilled KV cache loaded."""
+        if cache.k.shape[1] != 1:
+            raise ValueError("megakernel decode is batch-1 "
+                             f"(cache batch {cache.k.shape[1]})")
+        if cache.max_seq != self.max_seq:
+            raise ValueError(f"cache max_seq {cache.max_seq} != decoder "
+                             f"max_seq {self.max_seq}")
+        feeds = dict(self._weights)
+        feeds.update(cache_feeds(self.prog, cache))
+        return self.comp.make_workspace(feeds)
+
+    # -- one token ----------------------------------------------------------
+    def _append_kv(self, ws: jax.Array, pos: jax.Array) -> jax.Array:
+        """Write this step's (normed+roped) k / raw v — produced by the
+        kernel into the k_new/v_new handles — into the cache regions at
+        column/row ``pos`` (functional update, jit-traced)."""
+        d = TILE
+        tile_i, intra = pos // TILE, pos % TILE
+        for h in self.prog.layers:
+            k_new = self.comp.gather_output(ws, h.k_new)[0]   # (hkv*d,)
+            v_new = self.comp.gather_output(ws, h.v_new)[0]
+            for kv in range(len(h.kT)):
+                kcol = k_new[kv * d:(kv + 1) * d].astype(ws.dtype)
+                vrow = v_new[kv * d:(kv + 1) * d].astype(ws.dtype)
+                ws = ws.at[h.kT[kv].base + tile_i, :, intra].set(kcol)
+                ws = ws.at[h.v[kv].base + tile_i, intra, :].set(vrow)
+        return ws
+
+    def _step(self, ws, queue, cos, sin, token, pos):
+        x_row = self.embed[token[0]].astype(jnp.float32)       # (hidden,)
+        x = jnp.zeros((TILE, self.cfg.hidden_size), jnp.float32
+                      ).at[0].set(x_row)
+        ws = self.comp.scatter_input(ws, self.prog.x, x)
+        ws = self.comp.scatter_input(ws, self.prog.cos, cos)
+        ws = self.comp.scatter_input(ws, self.prog.sin, sin)
+        ws = self.comp.step(ws, queue)
+        ws = self._append_kv(ws, pos)
+        x_out = self.comp.gather_output(ws, self.prog.x_out)[0:1]
+        xn = rms_norm(x_out.astype(jnp.float32),
+                      self.final_norm.astype(jnp.float32),
+                      self.cfg.rms_norm_eps)
+        head = (self.lm_head if self.lm_head is not None
+                else self.embed.T)
+        logits = xn @ head.astype(jnp.float32)
+        return ws, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def step(self, ws: jax.Array, token: jax.Array, pos: int):
+        """token: (1,) int32; pos: host int (current cache length). Returns
+        (workspace', next_token (1,))."""
+        queue = advance_queue_pos(self.comp.queue, pos)
+        cos, sin = rope_tables(pos, TILE, self.cfg.rope_theta)
+        return self._step_jit(ws, queue, jnp.asarray(cos), jnp.asarray(sin),
+                              token, jnp.int32(pos))

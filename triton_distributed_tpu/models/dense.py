@@ -107,10 +107,13 @@ def _mlp_or_moe(layer: dict, cfg: ModelConfig, h: jax.Array, *, axis: str,
         from triton_distributed_tpu.ops.moe import moe_tp_fwd_local
 
         p = layer["moe"]
+        # Prefill "overlap" rides the ring pipeline (chunk rotation under
+        # expert compute — VERDICT r2 #4); other modes map through.
+        moe_mode = "ring" if mode == "overlap" and n > 1 else (
+            mode if n > 1 else "overlap")
         return moe_tp_fwd_local(
             h, p["router"], p["w_gate"], p["w_up"], p["w_down"],
-            cfg.num_experts_per_tok, axis=axis, num_ranks=n,
-            mode=mode if n > 1 else "overlap")
+            cfg.num_experts_per_tok, axis=axis, num_ranks=n, mode=moe_mode)
     return tp_mlp_fwd(layer["mlp"], h, axis=axis, num_ranks=n, mode=mode)
 
 
@@ -209,4 +212,9 @@ def dense_decode_step_paged(params: dict, cfg: ModelConfig,
 
     logits = _decode_body(params, cfg, tokens, attend,
                           axis=axis, n=n, mode=mode)
-    return logits, cache._replace(kv_lens=start_lens + 1)
+    # Saturated sequences (at pool capacity) drop the paged_append write, so
+    # do NOT advance their kv_lens — an unclamped advance would silently
+    # attend a cache missing the newest tokens with drifting RoPE positions.
+    capacity = cache.page_table.shape[1] * cache.k_pools.shape[2]
+    new_lens = jnp.minimum(start_lens + 1, capacity)
+    return logits, cache._replace(kv_lens=new_lens)

@@ -36,7 +36,10 @@ class Engine:
 
     backend: "overlap" (Pallas AG+GEMM / GEMM+RS prefill + fused-AR decode),
     "xla" (plain collectives — the golden / fallback path, reference
-    ``torch`` mode), or "auto".
+    ``torch`` mode), "auto", or "megakernel" (prefill on the fast batched
+    path, decode as ONE persistent Pallas kernel per token —
+    megakernel/serving.py; the reference's MegaTritonKernel serving ladder,
+    docs/mega_triton_kernel.md 3.33 ms row).
     """
 
     def __init__(self, cfg: ModelConfig, params: dict,
@@ -76,9 +79,14 @@ class Engine:
 
     # -- mode resolution ----------------------------------------------------
     def _prefill_mode(self, batch: int, seq: int) -> str:
+        if self.backend == "megakernel":
+            return "ar"   # replicated prefill; decode goes through the MK
         if self.backend == "xla":
             return "xla" if (batch * seq) % self.n == 0 else "xla_rep"
-        m = pick_mode("auto", batch * seq, self.n)
+        m = pick_mode("auto", batch * seq, self.n,
+                      hidden=self.cfg.hidden_size,
+                      ffn=self.cfg.intermediate_size,
+                      itemsize=jnp.dtype(self.cfg.dtype).itemsize)
         return m if self.backend == "auto" else (
             "overlap" if m == "overlap" else "ar")
 
@@ -199,14 +207,46 @@ class Engine:
         from triton_distributed_tpu.runtime.utils import group_profile
 
         logits, cache = self.prefill(jnp.asarray(input_ids))
+        tok = sampling.greedy(logits)
+        if self.backend == "megakernel":
+            return self._serve_megakernel(tok, cache, gen_len, profile_dir)
         if self.page_size is not None:
             cache = self.to_paged(cache)
-        tok = sampling.greedy(logits)
         outs = [tok]
         with group_profile("decode", do_prof=profile_dir is not None,
                            log_dir=profile_dir or "."):
             for _ in range(gen_len - 1):
                 tok, cache = self.decode(tok, cache)
+                outs.append(tok)
+            jax.block_until_ready(tok)
+        return jnp.stack(outs, axis=1)
+
+    def _serve_megakernel(self, tok, cache, gen_len: int,
+                          profile_dir: str | None):
+        """Decode loop through the persistent megakernel (one pallas_call
+        per token; queue retargeted per position without recompiling)."""
+        from triton_distributed_tpu.megakernel.serving import MegakernelDecoder
+        from triton_distributed_tpu.runtime.utils import group_profile
+
+        if self.n != 1:
+            raise ValueError(
+                "backend='megakernel' serves the one-chip view (the "
+                "multi-rank kernel path is exercised at kernel level, "
+                "tests/test_megakernel_decode.py::test_decode_step_tp8)")
+        if self.page_size is not None:
+            raise ValueError("megakernel backend uses its own workspace "
+                             "cache, not the paged cache")
+        if getattr(self, "_mk", None) is None:
+            self._mk = MegakernelDecoder(self.cfg, self.params,
+                                         max_seq=self.max_seq)
+        ws = self._mk.start(cache)
+        pos = int(cache.offset)
+        outs = [tok]
+        with group_profile("mk_decode", do_prof=profile_dir is not None,
+                           log_dir=profile_dir or "."):
+            for _ in range(gen_len - 1):
+                ws, tok = self._mk.step(ws, tok, pos)
+                pos += 1
                 outs.append(tok)
             jax.block_until_ready(tok)
         return jnp.stack(outs, axis=1)

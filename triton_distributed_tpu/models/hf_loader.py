@@ -34,6 +34,15 @@ def config_from_hf(hf_cfg: Any) -> ModelConfig:
     get = (hf_cfg.get if isinstance(hf_cfg, Mapping)
            else lambda k, d=None: getattr(hf_cfg, k, d))
     num_experts = get("num_experts", None) or 0
+    # The MoE forward always softmaxes the selected experts' logits (i.e.
+    # renormalizes top-k weights — Qwen-MoE convention, ops/moe.py:161).
+    # Mixtral-style checkpoints with norm_topk_prob=False would convert
+    # without error but route with wrong weights; refuse them explicitly
+    # (mirrors the qk_norm architecture guard below).
+    if num_experts and get("norm_topk_prob", True) is False:
+        raise ValueError(
+            "norm_topk_prob=False checkpoints are not supported: the MoE "
+            "forward renormalizes top-k router weights (ops/moe.py)")
     # Per-head q/k RMSNorm is a Qwen3-family trait; applying it with unit
     # weights to a Llama/Qwen2-style model would still renormalize (and
     # corrupt) the heads, so gate it on the architecture.

@@ -223,6 +223,8 @@ class DispatchLayout(NamedTuple):
     sort_idx: jax.Array      # (m,) — expert-stable sort permutation
     sorted_rank: jax.Array   # (m,) — dest rank of sorted token i
     pos_in_slot: jax.Array   # (m,) — its row within that rank's slot
+    overflow: jax.Array      # scalar int32 — tokens dropped by the cap (0 =
+    #                          lossless; callers with cap < m must check)
 
 
 def dispatch_layout(tokens: jax.Array, expert_ids: jax.Array,
@@ -235,7 +237,9 @@ def dispatch_layout(tokens: jax.Array, expert_ids: jax.Array,
 
     Tokens for the same destination rank are packed contiguously (sorted by
     expert) at the head of that rank's slot. Tokens beyond ``cap`` per rank
-    are dropped silently — size cap for the worst case (m) to be lossless.
+    are dropped, and the drop count is reported in ``layout.overflow`` —
+    size cap for the worst case (m) to be lossless (the reference's MAX_M
+    contract, low_latency_all_to_all.py:125-175, made checkable).
 
     Reference: the sorted-by-expert input contract of fast_all_to_all plus
     ``moe_ag_scatter_align_block_size`` (csrc/lib/moe_utils.cu:61).
@@ -261,11 +265,12 @@ def dispatch_layout(tokens: jax.Array, expert_ids: jax.Array,
     send_buf = jnp.zeros((num_ranks, cap, hidden), tokens.dtype)
     send_buf = send_buf.at[sorted_rank, pos_in_slot].set(
         sorted_tokens, mode="drop")
+    overflow = jnp.sum((pos_in_slot >= cap).astype(jnp.int32))
     expert_counts = jax.ops.segment_sum(ones, expert_ids,
                                         num_segments=num_experts)
     send_splits = expert_counts.reshape(num_ranks, epr)
     return DispatchLayout(send_buf, send_splits, sort_idx, sorted_rank,
-                          pos_in_slot)
+                          pos_in_slot, overflow)
 
 
 def combine_layout(recv_buf: jax.Array, recv_splits: jax.Array):

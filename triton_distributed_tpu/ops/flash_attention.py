@@ -1,0 +1,311 @@
+"""Tiled Pallas flash-attention prefill — the blockwise online-softmax core.
+
+Reference: ``sp_ag_attention_intra_node.py:256``
+(``kernel_consumer_flash_attn_forward`` — the blockwise FA consumer that the
+reference's SP attention family runs per KV chunk) and the tiled softmax
+structure of ``flash_decode.py:129-481``. Round-2 VERDICT.md's top gap: every
+prefill path here materialized O(S²) fp32 logits; this kernel replaces them
+with a (tile_q × tile_k) VMEM-blockwise online softmax so long-context prefill
+runs in flat memory.
+
+TPU shape: grid (B, hq, Sq-tiles, Sk-tiles) with the KV-tile loop innermost;
+the fp32 accumulator and running (m, l) stats live in VMEM scratch carried
+across the KV steps (TPU grid steps run sequentially on the core — the
+persistent-consumer loop of the reference, expressed as the grid). GQA maps
+query heads onto KV heads in the BlockSpec index map (h // group), so K/V
+tiles are fetched once per query head without a repeated-KV materialization.
+
+Causality is positional: the kernel receives (q_offset, k_offset) through
+scalar prefetch (traced values allowed — ring attention passes rank-dependent
+offsets), masks ``q_pos >= k_pos``, and *skips the compute of fully-hidden
+tiles* — the causal skip the reference gets from its rank-swizzled tile
+order. Partial outputs (unnormalized fp32 acc + running max m + sum-exp l)
+use the same (acc, m, l) contract as ops/ring_attention.py's ``_merge``, so
+ring / SP-AG shards merge across devices unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu.language.core import _interpret_params
+from triton_distributed_tpu.ops.tiling import pick_tile, sublane_align
+from triton_distributed_tpu.runtime.context import use_interpret
+
+_NEG = -1e30
+# VMEM budget for one (q-tile, k-tile) working set; beyond it we fall back to
+# the dense path (tiny/odd shapes where tiling buys nothing).
+_VMEM_BUDGET = 8 * 1024 * 1024
+
+
+# ---------------------------------------------------------------------------
+# Dense (O(S²)-logit) reference path + online-LSE merge. These lived in
+# ops/ring_attention.py in round 2; they are the golden for the tiled kernel
+# and the fallback for shapes the kernel declines (flash_supported).
+# ---------------------------------------------------------------------------
+
+def _block_attn(q, k, v, mask):
+    """Unnormalized blockwise attention with running-max stats (dense).
+
+    q: (B, Sq, hq, d); k/v: (B, Sk, hkv, d); mask: (Sq, Sk) bool or None.
+    Returns (acc (B,Sq,hq,d) fp32, m (B,Sq,hq), l (B,Sq,hq)).
+    """
+    import math
+
+    b, sq, hq, d = q.shape
+    sk, hkv = k.shape[1], k.shape[2]
+    g = hq // hkv
+    qf = q.astype(jnp.float32).reshape(b, sq, hkv, g, d)
+    logits = jnp.einsum("bqhgd,bkhd->bqhgk", qf,
+                        k.astype(jnp.float32)) / math.sqrt(d)
+    if mask is not None:
+        logits = jnp.where(mask[None, :, None, None, :], logits, -jnp.inf)
+    m = jnp.max(logits, axis=-1)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(logits - m_safe[..., None])
+    if mask is not None:
+        p = jnp.where(mask[None, :, None, None, :], p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bqhgk,bkhd->bqhgd", p, v.astype(jnp.float32))
+    return (acc.reshape(b, sq, hq, d), m_safe.reshape(b, sq, hq),
+            l.reshape(b, sq, hq))
+
+
+def _merge(state, update):
+    """Online LSE merge of two (acc, m, l) blockwise-attention partials."""
+    acc0, m0, l0 = state
+    acc1, m1, l1 = update
+    dead0, dead1 = l0 <= 0, l1 <= 0
+    m_new = jnp.where(dead0, m1, jnp.where(dead1, m0, jnp.maximum(m0, m1)))
+    s0 = jnp.where(dead0, 0.0, jnp.exp(m0 - m_new))
+    s1 = jnp.where(dead1, 0.0, jnp.exp(m1 - m_new))
+    return (acc0 * s0[..., None] + acc1 * s1[..., None],
+            m_new, l0 * s0 + l1 * s1)
+
+
+def _col_to_row(col, tq: int):
+    """(tq, 1) fp32 column -> (tq,) lane vector, via an identity-mask
+    reduction (guaranteed-lowerable: broadcast + iota + where + sum; avoids
+    relying on Mosaic sublane->lane relayout of narrow vectors)."""
+    eye = (jax.lax.broadcasted_iota(jnp.int32, (tq, tq), 0)
+           == jax.lax.broadcasted_iota(jnp.int32, (tq, tq), 1))
+    return jnp.sum(jnp.where(eye, jnp.broadcast_to(col, (tq, tq)), 0.0),
+                   axis=0)
+
+
+def _flash_kernel(g: int, nk: int, tq: int, tk: int, scale: float,
+                  causal: bool, normalize: bool,
+                  offs_ref,                   # scalar prefetch: [q_off, k_off]
+                  q_ref, k_ref, v_ref,        # (1,1,tq,d), (1,1,tk,d) blocks
+                  o_ref, m_ref, l_ref,        # (1,1,tq,d), (1,1,tq), (1,1,tq)
+                  acc, mstat, lstat):         # VMEM scratch
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    q_off = offs_ref[0]
+    k_off = offs_ref[1]
+
+    @pl.when(j == 0)
+    def _():
+        acc[...] = jnp.zeros_like(acc)
+        mstat[...] = jnp.full_like(mstat, _NEG)
+        lstat[...] = jnp.zeros_like(lstat)
+
+    # Tile-level causal skip: the last q position of this tile is before the
+    # first k position -> every logit is masked; skip the dots entirely.
+    first_k = k_off + j * tk
+    last_q = q_off + i * tq + (tq - 1)
+    visible = (last_q >= first_k) if causal else (first_k == first_k)
+
+    @pl.when(visible)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)          # (tq, d)
+        k = k_ref[0, 0]                              # (tk, d)
+        s = jax.lax.dot_general(
+            q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (tq, tk)
+        if causal:
+            qpos = (q_off + i * tq
+                    + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0))
+            kpos = (k_off + j * tk
+                    + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1))
+            mask = qpos >= kpos
+            s = jnp.where(mask, s, _NEG)
+        m_prev = mstat[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(mask, p, 0.0)              # kill exp(0)=1 on dead rows
+        corr = jnp.exp(m_prev - m_new)
+        pv = jnp.dot(p.astype(v_ref.dtype), v_ref[0, 0],
+                     preferred_element_type=jnp.float32)  # (tq, d)
+        acc[...] = acc[...] * corr + pv
+        mstat[:, :1] = m_new
+        lstat[:, :1] = lstat[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
+
+    @pl.when(j == nk - 1)
+    def _():
+        l_col = lstat[:, :1]
+        if normalize:
+            o_ref[0, 0] = (acc[...] / jnp.maximum(l_col, 1e-30)
+                           ).astype(o_ref.dtype)
+        else:
+            o_ref[0, 0] = acc[...].astype(o_ref.dtype)
+        # Stats ride an 8-sublane broadcast row block (Mosaic requires the
+        # block's second-to-last dim be 8-divisible; a (1,1,tq) block isn't).
+        m_row = _col_to_row(mstat[:, :1], tq)
+        l_row = _col_to_row(l_col, tq)
+        m_ref[0, 0] = jnp.broadcast_to(m_row[None, :], (8, tq))
+        l_ref[0, 0] = jnp.broadcast_to(l_row[None, :], (8, tq))
+
+
+def _flash_call(q4, k4, v4, q_offset, k_offset, *, causal: bool,
+                normalize: bool, tile_q: int, tile_k: int):
+    """Head-major flash attention. q4: (B, hq, Sq, d); k4/v4: (B, hkv, Sk, d).
+    Returns (out (B,hq,Sq,d), m (B,hq,Sq), l (B,hq,Sq))."""
+    b, hq, sq, d = q4.shape
+    hkv, sk = k4.shape[1], k4.shape[2]
+    g = hq // hkv
+    # tq doubles as the stats blocks' LANE dim: must be 128-divisible (or the
+    # full Sq). pick_tile with align=128 yields exactly that (fallback = dim).
+    tq = pick_tile(sq, tile_q, 128)
+    tk = pick_tile(sk, tile_k, max(sublane_align(q4.dtype),
+                                   sublane_align(k4.dtype)))
+    nq, nk = sq // tq, sk // tk
+    scale = d ** -0.5
+
+    offs = jnp.stack([jnp.asarray(q_offset, jnp.int32).reshape(()),
+                      jnp.asarray(k_offset, jnp.int32).reshape(())])
+
+    kernel = functools.partial(_flash_kernel, g, nk, tq, tk, scale,
+                               causal, normalize)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, hq, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, tq, d), lambda bb, h, i, j, *_: (bb, h, i, 0)),
+            pl.BlockSpec((1, 1, tk, d),
+                         lambda bb, h, i, j, *_: (bb, h // g, j, 0)),
+            pl.BlockSpec((1, 1, tk, d),
+                         lambda bb, h, i, j, *_: (bb, h // g, j, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, tq, d), lambda bb, h, i, j, *_: (bb, h, i, 0)),
+            pl.BlockSpec((1, 1, 8, tq), lambda bb, h, i, j, *_: (bb, h, 0, i)),
+            pl.BlockSpec((1, 1, 8, tq), lambda bb, h, i, j, *_: (bb, h, 0, i)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((tq, d), jnp.float32),
+            pltpu.VMEM((tq, 128), jnp.float32),
+            pltpu.VMEM((tq, 128), jnp.float32),
+        ],
+    )
+    out_dtype = q4.dtype if normalize else jnp.float32
+    interpret = _interpret_params() if use_interpret() else False
+    out, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((b, hq, sq, d), out_dtype),
+            jax.ShapeDtypeStruct((b, hq, 8, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b, hq, 8, sq), jnp.float32),
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * hq * sq * sk * d,
+            bytes_accessed=(q4.size + k4.size + v4.size) * q4.dtype.itemsize
+            + b * hq * sq * d * jnp.dtype(out_dtype).itemsize,
+            transcendentals=b * hq * sq * sk,
+        ),
+        interpret=interpret,
+    )(offs, q4, k4, v4)
+    return out, m[:, :, 0, :], l[:, :, 0, :]
+
+
+def flash_supported(q, k) -> bool:
+    """Whether the tiled kernel handles these shapes within VMEM budget
+    (falls back to the dense path otherwise — tiny/odd shapes)."""
+    b, sq, hq, d = q.shape
+    sk = k.shape[1]
+    if q.shape[-1] != k.shape[-1] or hq % k.shape[2]:
+        return False
+    tq = pick_tile(sq, 256, 128)
+    tk = pick_tile(sk, 512, max(sublane_align(q.dtype),
+                                sublane_align(k.dtype)))
+    # Working set: q/k/v tiles (double-buffered) + acc/stat scratch + s tile.
+    est = (2 * (tq * d + 2 * tk * d) * q.dtype.itemsize
+           + (tq * d + 2 * tq * 128 + tq * tk) * 4)
+    return est <= _VMEM_BUDGET
+
+
+def flash_attention_partial(q, k, v, *, q_offset=0, k_offset=0,
+                            causal: bool = True,
+                            tile_q: int = 256, tile_k: int = 512):
+    """Blockwise flash attention returning UNnormalized partials.
+
+    q: (B, Sq, hq, d); k/v: (B, Sk, hkv, d). Positions are global:
+    query row i has position ``q_offset + i``, key row j position
+    ``k_offset + j``; causal masks q_pos >= k_pos. Returns
+    (acc (B,Sq,hq,d) fp32, m (B,Sq,hq), l (B,Sq,hq)) — the
+    ops/ring_attention.py ``_merge`` contract. A shard entirely hidden by
+    causality returns l=0 (dead, skipped compute).
+    """
+    q4 = q.transpose(0, 2, 1, 3)
+    k4 = k.transpose(0, 2, 1, 3)
+    v4 = v.transpose(0, 2, 1, 3)
+    out, m, l = _flash_call(q4, k4, v4, q_offset, k_offset, causal=causal,
+                            normalize=False, tile_q=tile_q, tile_k=tile_k)
+    return (out.transpose(0, 2, 1, 3), m.transpose(0, 2, 1),
+            l.transpose(0, 2, 1))
+
+
+def flash_attention(q, k, v, *, q_offset=0, k_offset=0, causal: bool = True,
+                    tile_q: int = 256, tile_k: int = 512):
+    """Normalized flash attention: (B, Sq, hq, d) out in q.dtype — the
+    drop-in for dense SDPA on prefill shapes (layers/tp_attn.py,
+    ops/ulysses.py)."""
+    q4 = q.transpose(0, 2, 1, 3)
+    k4 = k.transpose(0, 2, 1, 3)
+    v4 = v.transpose(0, 2, 1, 3)
+    out, _, _ = _flash_call(q4, k4, v4, q_offset, k_offset, causal=causal,
+                            normalize=True, tile_q=tile_q, tile_k=tile_k)
+    return out.transpose(0, 2, 1, 3)
+
+
+def _positional_mask(sq: int, sk: int, q_offset, k_offset, causal: bool):
+    if not causal:
+        return None
+    qpos = jnp.asarray(q_offset) + jnp.arange(sq)
+    kpos = jnp.asarray(k_offset) + jnp.arange(sk)
+    return qpos[:, None] >= kpos[None, :]
+
+
+def shard_attention_partial(q, k, v, *, q_offset=0, k_offset=0,
+                            causal: bool = True):
+    """Partial attention over one KV shard: tiled flash kernel when the
+    shapes support it, dense `_block_attn` otherwise. Same (acc, m, l)
+    return contract either way — the single entry point the SP family
+    (ring / SP-AG) uses per shard."""
+    if flash_supported(q, k):
+        return flash_attention_partial(q, k, v, q_offset=q_offset,
+                                       k_offset=k_offset, causal=causal)
+    mask = _positional_mask(q.shape[1], k.shape[1], q_offset, k_offset,
+                            causal)
+    return _block_attn(q, k, v, mask)
+
+
+def shard_attention(q, k, v, *, causal: bool = True):
+    """Normalized single-shard attention (flash when supported) — the dense
+    SDPA drop-in for prefill (ops/ulysses.py, layers/tp_attn.py)."""
+    if flash_supported(q, k):
+        return flash_attention(q, k, v, causal=causal)
+    mask = _positional_mask(q.shape[1], k.shape[1], 0, 0, causal)
+    acc, _, l = _block_attn(q, k, v, mask)
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)

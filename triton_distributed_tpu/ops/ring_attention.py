@@ -8,62 +8,32 @@ softmax-rescaling ring pipeline — on TPU the ring IS the natural shape: KV
 shards rotate around the ICI ring via ``ppermute`` while every device
 accumulates blockwise attention with online log-sum-exp rescaling, so each
 hop's communication overlaps the previous hop's attention compute (XLA
-schedules collective-permute DMA concurrently with the einsums — the
-copy-engine/consumer split of the reference, expressed at the XLA level).
+schedules collective-permute DMA concurrently with the attention kernel —
+the copy-engine/consumer split of the reference, expressed at the XLA level).
 
-Causality with sequence sharding: query block q_r attends KV block k_s iff
-s <= r (block-causal), with the diagonal block masked triangularly — the
-standard ring-attention schedule.
+The per-shard compute is the tiled Pallas flash kernel
+(ops/flash_attention.py — reference consumer
+``kernel_consumer_flash_attn_forward``, sp_ag_attention_intra_node.py:256):
+causality is positional (rank r owns positions [r·S/n, (r+1)·S/n)), handed
+to the kernel as (q_offset, k_offset), so shards entirely behind the
+diagonal skip their dots in-kernel and fully-hidden shards come back dead
+(l = 0) for the merge.
 """
 
 from __future__ import annotations
 
 import functools
-import math
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+# Re-exported for back-compat: the dense golden + merge lived here in round 2.
+from triton_distributed_tpu.ops.flash_attention import (  # noqa: F401
+    _block_attn, _merge, shard_attention_partial,
+)
 from triton_distributed_tpu.runtime.context import DistContext, get_context
 from triton_distributed_tpu.runtime.jit_cache import cached_shard_jit
-
-
-def _block_attn(q, k, v, mask):
-    """Unnormalized blockwise attention with running-max stats.
-
-    q: (B, Sq, hq, d); k/v: (B, Sk, hkv, d); mask: (Sq, Sk) bool or None.
-    Returns (acc (B,Sq,hq,d) fp32, m (B,Sq,hq), l (B,Sq,hq)).
-    """
-    b, sq, hq, d = q.shape
-    sk, hkv = k.shape[1], k.shape[2]
-    g = hq // hkv
-    qf = q.astype(jnp.float32).reshape(b, sq, hkv, g, d)
-    logits = jnp.einsum("bqhgd,bkhd->bqhgk", qf,
-                        k.astype(jnp.float32)) / math.sqrt(d)
-    if mask is not None:
-        logits = jnp.where(mask[None, :, None, None, :], logits, -jnp.inf)
-    m = jnp.max(logits, axis=-1)
-    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
-    p = jnp.exp(logits - m_safe[..., None])
-    if mask is not None:
-        p = jnp.where(mask[None, :, None, None, :], p, 0.0)
-    l = jnp.sum(p, axis=-1)
-    acc = jnp.einsum("bqhgk,bkhd->bqhgd", p, v.astype(jnp.float32))
-    return (acc.reshape(b, sq, hq, d), m_safe.reshape(b, sq, hq),
-            l.reshape(b, sq, hq))
-
-
-def _merge(state, update):
-    """Online LSE merge of two (acc, m, l) blockwise-attention partials."""
-    acc0, m0, l0 = state
-    acc1, m1, l1 = update
-    dead0, dead1 = l0 <= 0, l1 <= 0
-    m_new = jnp.where(dead0, m1, jnp.where(dead1, m0, jnp.maximum(m0, m1)))
-    s0 = jnp.where(dead0, 0.0, jnp.exp(m0 - m_new))
-    s1 = jnp.where(dead1, 0.0, jnp.exp(m1 - m_new))
-    return (acc0 * s0[..., None] + acc1 * s1[..., None],
-            m_new, l0 * s0 + l1 * s1)
 
 
 def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
@@ -79,34 +49,41 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
         raise ValueError("num_ranks required inside shard_map")
     n = num_ranks
     me = jax.lax.axis_index(axis)
-    b, sq, hq, d = q.shape
+    sq = q.shape[1]
     sk = k.shape[1]
+    q_off = me * sq
 
-    diag_mask = (jnp.tril(jnp.ones((sq, sk), bool))
-                 if causal and sq == sk else None)
+    if n == 1:
+        acc, m, l = shard_attention_partial(q, k, v, q_offset=q_off,
+                                            k_offset=me * sk, causal=causal)
+        return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
-    # Step 0: my own diagonal block.
-    state = _block_attn(q, k, v, diag_mask)
+    perm = [(i, (i + 1) % n) for i in range(n)]  # shift right
 
-    if n > 1:
-        perm = [(i, (i + 1) % n) for i in range(n)]  # shift right
+    def partial_for(kc, vc, src):
+        # Positional causality: src > me shards come back dead (l=0,
+        # compute skipped in-kernel); src < me shards are fully visible.
+        return shard_attention_partial(q, kc, vc, q_offset=q_off,
+                                       k_offset=src * sk, causal=causal)
 
-        def body(i, carry):
-            state, kc, vc = carry
-            # Rotate: after i+1 hops I hold the shard of rank me-(i+1).
-            kc = jax.lax.ppermute(kc, axis, perm)
-            vc = jax.lax.ppermute(vc, axis, perm)
-            src = jax.lax.rem(me - (i + 1) + n, n)
-            acc, m, l = _block_attn(q, kc, vc, None)
-            if causal:
-                # Block-causal: only attend shards strictly before mine.
-                keep = (src < me).astype(jnp.float32)
-                update = (acc * keep, m, l * keep)
-            else:
-                update = (acc, m, l)
-            return _merge(state, update), kc, vc
+    # Exactly n-1 rotations, each issued on data the concurrent attention
+    # call does NOT consume — hop i+1's ppermute DMA rides under hop i's
+    # flash kernel (the copy-engine/consumer split of the reference's SP
+    # attention, expressed in the XLA schedule). The last arriving shard is
+    # consumed after the loop with no further rotation.
+    kc = jax.lax.ppermute(k, axis, perm)         # hop-1 shards in flight...
+    vc = jax.lax.ppermute(v, axis, perm)
+    state = partial_for(k, v, me)                # ...under the diagonal hop
 
-        (state, _, _) = jax.lax.fori_loop(0, n - 1, body, (state, k, v))
+    def body(i, carry):
+        state, kc, vc = carry
+        kc_next = jax.lax.ppermute(kc, axis, perm)
+        vc_next = jax.lax.ppermute(vc, axis, perm)
+        src = jax.lax.rem(me - i + n, n)
+        return _merge(state, partial_for(kc, vc, src)), kc_next, vc_next
+
+    state, kc, vc = jax.lax.fori_loop(1, n - 1, body, (state, kc, vc))
+    state = _merge(state, partial_for(kc, vc, jax.lax.rem(me + 1, n)))
 
     acc, m, l = state
     return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)

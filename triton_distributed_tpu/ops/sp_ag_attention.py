@@ -6,13 +6,14 @@ per-KV-chunk (:256); op at :432 (inter-node twin in
 ``sp_ag_attention_inter_node.py``).
 
 TPU mapping: the KV shards ride the Pallas full-mesh-push AllGather (remote
-DMA over ICI), then the consumer computes *blockwise* attention per KV chunk
-with the same online-LSE merge as ring attention — chunk r's compute starts
-as soon as the math allows, and XLA overlaps the Pallas AG kernel with the
-first (local-chunk) einsum since there is no data dependence between them.
-For a fully in-kernel waited consumer, see ops/ring_attention.py — on TPU
-the rotating-shard schedule expresses the same overlap with less machinery
-and is the preferred long-context path.
+DMA over ICI), then the consumer runs the tiled Pallas flash kernel
+(ops/flash_attention.py — the analog of the reference's waiting consumer
+:256) per KV chunk with the same online-LSE merge as ring attention —
+chunk r's compute starts as soon as the math allows, and XLA overlaps the
+Pallas AG kernel with the local-chunk flash call since there is no data
+dependence between them. For a fully in-kernel waited consumer, see
+ops/ring_attention.py — on TPU the rotating-shard schedule expresses the
+same overlap with less machinery and is the preferred long-context path.
 """
 
 from __future__ import annotations
@@ -24,7 +25,9 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu.ops.allgather import all_gather_local, AllGatherMethod
-from triton_distributed_tpu.ops.ring_attention import _block_attn, _merge
+from triton_distributed_tpu.ops.flash_attention import (
+    _merge, shard_attention_partial,
+)
 from triton_distributed_tpu.runtime.context import DistContext, get_context
 from triton_distributed_tpu.runtime.jit_cache import cached_shard_jit
 
@@ -48,8 +51,8 @@ def sp_ag_attention_local(q: jax.Array, k_shard: jax.Array,
     sk, hkv = k_shard.shape[1], k_shard.shape[2]
 
     if n == 1:
-        mask = jnp.tril(jnp.ones((sq, sk), bool)) if causal else None
-        acc, m, l = _block_attn(q, k_shard, v_shard, mask)
+        acc, m, l = shard_attention_partial(q, k_shard, v_shard,
+                                            causal=causal)
         return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
     # Producer: Pallas AG of the KV shards (flattened to 2-D rows).
@@ -61,17 +64,19 @@ def sp_ag_attention_local(q: jax.Array, k_shard: jax.Array,
     ks = gathered[:, :, :, 0]  # (n, B, sk, hkv, d)
     vs = gathered[:, :, :, 1]
 
-    # Consumer: blockwise attention per KV chunk + online-LSE merge
-    # (reference kernel_consumer_flash_attn_forward :256).
-    diag_mask = jnp.tril(jnp.ones((sq, sk), bool)) if causal else None
-    state = _block_attn(q, k_shard, v_shard, diag_mask)
+    # Consumer: tiled flash attention per KV chunk + online-LSE merge
+    # (reference kernel_consumer_flash_attn_forward :256). Positional
+    # causality: rank r's chunk holds positions [r·sk, (r+1)·sk); chunks
+    # entirely behind the diagonal skip their dots in-kernel.
+    q_off = me * sq
+    state = shard_attention_partial(q, k_shard, v_shard, q_offset=q_off,
+                                    k_offset=me * sk, causal=causal)
 
     def body(r, state):
-        acc, m, l = _block_attn(q, ks[r], vs[r], None)
-        if causal:
-            keep = (r < me).astype(jnp.float32)
-        else:
-            keep = (r != me).astype(jnp.float32)
+        acc, m, l = shard_attention_partial(q, ks[r], vs[r], q_offset=q_off,
+                                            k_offset=r * sk, causal=causal)
+        # r == me is the diagonal chunk already accumulated above.
+        keep = (r != me).astype(jnp.float32)
         return _merge(state, (acc * keep, m, l * keep))
 
     state = jax.lax.fori_loop(0, n, body, state)

@@ -35,20 +35,12 @@ from triton_distributed_tpu.runtime.jit_cache import cached_shard_jit
 
 
 def _sdpa(q, k, v, causal: bool):
-    """Dense GQA attention, fp32 softmax. q: (B, S, Hq, d); k/v (B, S, Hkv, d)."""
-    b, s, hq, d = q.shape
-    hkv = k.shape[2]
-    groups = hq // hkv
-    k = jnp.repeat(k, groups, axis=2)
-    v = jnp.repeat(v, groups, axis=2)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                        k.astype(jnp.float32)) * (d ** -0.5)
-    if causal:
-        mask = jnp.tril(jnp.ones((s, s), bool))
-        logits = jnp.where(mask[None, None], logits, -jnp.inf)
-    p = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+    """Per-head-shard attention after the exchange: the tiled Pallas flash
+    kernel (ops/flash_attention.py) on supported shapes, dense fallback on
+    tiny/odd ones. q: (B, S, Hq, d); k/v (B, S, Hkv, d)."""
+    from triton_distributed_tpu.ops.flash_attention import shard_attention
+
+    return shard_attention(q, k, v, causal=causal)
 
 
 def ulysses_attention_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
