@@ -110,6 +110,55 @@ def main() -> int:
     splits = jnp.asarray(np.full((1, 1, 2), 8), jnp.int32)
     check("fast_all_to_all", lambda: fast_all_to_all(send, splits, ctx)[0])
 
+    # Barrier-free parity-stream kernels (decode steady state): the n=1
+    # degenerate grid still compiles the parity slicing, per-parity
+    # semaphores, and aliased persistent workspace through Mosaic.
+    from triton_distributed_tpu.ops.allreduce import (
+        all_reduce_stream, ar_stream_workspace,
+    )
+    from triton_distributed_tpu.ops.all_to_all import (
+        a2a_stream_workspace, fast_all_to_all_stream,
+    )
+    from triton_distributed_tpu.runtime import shard_map_on
+    from jax.sharding import PartitionSpec as _P
+
+    def ar_stream():
+        xloc = jnp.asarray(rng.standard_normal((1, 64, 256)), jnp.float32)
+
+        def run(x):
+            ws, idx = ar_stream_workspace(1, 64, 256, x.dtype)
+            out, ws, idx = all_reduce_stream(x[0], ws, idx, num_ranks=1,
+                                             force_kernel=True)
+            out, ws, idx = all_reduce_stream(out, ws, idx, num_ranks=1,
+                                             force_kernel=True)
+            return out[None]
+
+        out = shard_map_on(ctx, run, _P("tp"), _P("tp"))(xloc)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(xloc),
+                                   rtol=1e-6)
+        return out
+
+    check("all_reduce_stream (parity)", ar_stream)
+
+    def a2a_stream():
+        sb = jnp.asarray(rng.standard_normal((1, 1, 32, 128)), jnp.float32)
+        sp = jnp.asarray(np.full((1, 1, 2), 8), jnp.int32)
+
+        def run(sb, sp):
+            ws, idx = a2a_stream_workspace(1, 32, 128, sb.dtype)
+            rb, rs, ws, idx = fast_all_to_all_stream(
+                sb[0], sp[0], ws, idx, num_ranks=1, force_kernel=True)
+            rb, rs, ws, idx = fast_all_to_all_stream(
+                rb, rs, ws, idx, num_ranks=1, force_kernel=True)
+            return rb[None]
+
+        out = shard_map_on(ctx, run, (_P("tp"), _P("tp")), _P("tp"))(sb, sp)
+        np.testing.assert_allclose(np.asarray(out)[0, 0, :16],
+                                   np.asarray(sb)[0, 0, :16], rtol=0)
+        return out
+
+    check("fast_all_to_all_stream (parity)", a2a_stream)
+
     # Paged-KV attention (page-table scalar prefetch + per-page DMA).
     from triton_distributed_tpu.ops import (
         init_paged_kv_cache, paged_append, paged_decode_attention,
@@ -168,6 +217,34 @@ def main() -> int:
 
     check("megakernel decode step (fp32)", lambda: mega(jnp.float32))
     check("megakernel decode step (bf16)", lambda: mega(jnp.bfloat16))
+
+    # In-kernel paged-attention task: page table in queue DATA rows, DMA
+    # addresses read from SMEM per step.
+    from triton_distributed_tpu.megakernel import MegaKernelBuilder
+    from triton_distributed_tpu.megakernel.tasks import TILE as MTILE
+
+    def mega_paged():
+        mb = MegaKernelBuilder()
+        q = mb.tensor(MTILE, MTILE)
+        out = mb.tensor(MTILE, MTILE)
+        kt_pages = [mb.tensor(MTILE, MTILE) for _ in range(3)]
+        v_pages = [mb.tensor(MTILE, MTILE) for _ in range(3)]
+        pages = [(kt_pages[j].tile(0, 0), v_pages[j].tile(0, 0))
+                 for j in range(3)]
+        mb.attn_decode_paged(out, q, pages, valid_len=2 * MTILE + 40,
+                             scale=MTILE ** -0.5)
+        comp = mb.compile()
+        feeds = {q: rng.standard_normal((MTILE, MTILE)) * 0.3}
+        for j in range(3):
+            feeds[kt_pages[j]] = rng.standard_normal((MTILE, MTILE)) * 0.3
+            feeds[v_pages[j]] = rng.standard_normal((MTILE, MTILE)) * 0.3
+        feeds = {h: jnp.asarray(np.asarray(v_, np.float32))
+                 for h, v_ in feeds.items()}
+        (res,) = comp.run(feeds, outputs=[out])
+        assert np.isfinite(np.asarray(res)).all()
+        return res
+
+    check("megakernel paged-attention task", mega_paged)
 
     if failures:
         print(f"\n{len(failures)} FAILURES: {failures}")

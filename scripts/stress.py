@@ -119,9 +119,75 @@ def main() -> int:
             print(f"  [{i + 1}/{args.iters}] FAIL: {type(e).__name__}: "
                   f"{str(e)[:200]}", flush=True)
 
-    print(f"\n{args.iters - fails}/{args.iters} iterations clean")
-    return 1 if fails else 0
+    print("parity streams (barrier-free decode collectives):", flush=True)
+    parity_failed = False
+    try:
+        stress_parity_streams(ctx, iters=max(args.iters * 5, 100),
+                              seed=args.seed)
+    except AssertionError as e:
+        parity_failed = True
+        print(f"  parity-stream FAIL: {e}", flush=True)
+
+    print(f"\n{args.iters - fails}/{args.iters} iterations clean"
+          + ("" if not parity_failed else "; parity-stream phase FAILED"))
+    return 1 if (fails or parity_failed) else 0
 
 
+def stress_parity_streams(ctx, iters: int = 300, seed: int = 0):
+    """Randomized stress for the barrier-free parity streams (AR/AG/A2A):
+    random shapes per round, rotating stragglers, repeated calls over one
+    workspace each — the steady-state decode-loop contract under the
+    widest race windows the interpreter can produce."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from triton_distributed_tpu.ops.allgather import (
+        ag_stream_workspace, all_gather_stream,
+    )
+    from triton_distributed_tpu.ops.allreduce import (
+        all_reduce_stream, ar_stream_workspace,
+    )
+    from triton_distributed_tpu.runtime import shard_map_on
+
+    rng = np.random.default_rng(seed)
+    n = ctx.num_ranks
+    for case in range(3):
+        m = int(rng.choice([8, 16, 24]))
+        cols = int(rng.choice([128, 256]))
+        base = rng.standard_normal((n, m, cols)).astype(np.float32)
+
+        def run(xl):
+            xl = xl[0]
+            ws_r, idx_r = ar_stream_workspace(n, m, cols, xl.dtype)
+            ws_g, idx_g = ag_stream_workspace(n, m, cols, xl.dtype)
+            want_sum = jax.lax.psum(xl, "tp")
+            want_cat = jax.lax.all_gather(xl, "tp", tiled=True)
+
+            def body(t, carry):
+                ws_r, idx_r, ws_g, idx_g, err = carry
+                x_t = xl * (1.0 + t)
+                s, ws_r, idx_r = all_reduce_stream(
+                    x_t, ws_r, idx_r, axis="tp", num_ranks=n,
+                    straggler=("rotate", 512))
+                g, ws_g, idx_g = all_gather_stream(
+                    x_t, ws_g, idx_g, axis="tp", num_ranks=n,
+                    straggler=("rotate", 512))
+                err = jnp.maximum(err, jnp.max(jnp.abs(
+                    s / (1.0 + t) - want_sum)))
+                err = jnp.maximum(err, jnp.max(jnp.abs(
+                    g / (1.0 + t) - want_cat)))
+                return ws_r, idx_r, ws_g, idx_g, err
+
+            init = (ws_r, idx_r, ws_g, idx_g, jnp.float32(0))
+            *_, err = jax.lax.fori_loop(0, iters, body, init)
+            return err[None]
+
+        fn = shard_map_on(ctx, run, P("tp"), P("tp"))
+        err = float(np.max(np.asarray(fn(jnp.asarray(base)))))
+        print(f"  parity-stream case {case}: m={m} cols={cols} "
+              f"iters={iters} max_err={err:.2e}")
+        assert err < 1e-3, err
 if __name__ == "__main__":
     sys.exit(main())

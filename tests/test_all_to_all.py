@@ -115,3 +115,56 @@ def test_dispatch_layout_overflow_reported():
     assert int(lay.overflow) == m - cap
     full = dispatch_layout(tokens, eids, num_experts, n, m)
     assert int(full.overflow) == 0
+
+
+def test_a2a_stream_parity_repeated_calls(ctx):
+    """Barrier-free parity AllToAll (VERDICT r2 #6): repeated calls over one
+    persistent workspace with a rotating straggler; every round-trip exact.
+    Data-dependent counts vary per call (the zero-block edge included)."""
+    from jax.sharding import PartitionSpec as P
+
+    from triton_distributed_tpu.ops.all_to_all import (
+        a2a_stream_workspace, fast_all_to_all_stream,
+    )
+    from triton_distributed_tpu.runtime import shard_map_on
+
+    n, cap, hidden, epr, steps = 8, 32, 128, 2, 60
+    rng = np.random.default_rng(7)
+    base = rng.standard_normal((n, n, cap, hidden)).astype(np.float32)
+    # Per-step, per-destination row counts in [0, cap], incl. zeros.
+    counts = rng.integers(0, cap + 1, size=(steps, n, n)).astype(np.int32)
+    splits0 = counts[..., None] // epr
+    splits1 = counts[..., None] - splits0
+    splits = np.concatenate([splits0, splits1], axis=-1)  # (steps, n, n, epr)
+
+    def run(sb, sp):
+        sb, sp = sb[0], sp[0]        # (n, cap, h), (steps, n, epr)
+        ws, idx = a2a_stream_workspace(n, cap, hidden, sb.dtype)
+
+        def body(t, carry):
+            ws, idx, err = carry
+            x_t = sb * (1.0 + t)
+            recv, rsp, ws, idx = fast_all_to_all_stream(
+                x_t, sp[t], ws, idx, axis="tp", num_ranks=n,
+                straggler=("rotate", 256))
+            # Echo back: second stream call returns each rank's rows.
+            back, _, ws, idx = fast_all_to_all_stream(
+                recv, rsp, ws, idx, axis="tp", num_ranks=n)
+            # Valid rows of slot p on the way back = what I originally sent p.
+            rows = jnp.sum(sp[t], axis=1)             # (n,)
+            mask = (jnp.arange(cap)[None, :, None] < rows[:, None, None])
+            diff = jnp.abs(back - x_t) * mask
+            return ws, idx, jnp.maximum(err, jnp.max(diff))
+
+        _, idx, err = jax.lax.fori_loop(0, steps, body,
+                                        (ws, idx, jnp.float32(0)))
+        return err[None], idx[None]
+
+    fn = shard_map_on(ctx, run, (P("tp"), P("tp")), (P("tp"), P("tp")))
+    err, idx = fn(jnp.asarray(base), jnp.asarray(splits).transpose(1, 0, 2, 3))
+    # Tolerance is 1-ulp scale only: XLA strength-reduces sb*(1+t) inside
+    # the fori_loop, so the recomputed comparison tensor can differ from
+    # the transported bytes by an ulp (a python-loop variant is bitwise
+    # exact). Any real parity race shows up as O(1) stale-scale values.
+    assert float(np.max(np.asarray(err))) < 1e-4, float(np.max(np.asarray(err)))
+    assert int(np.asarray(idx)[0]) == 2 * steps

@@ -86,3 +86,81 @@ def test_ll_allgather_layer_buckets(ctx):
         out = layer(x)
         np.testing.assert_allclose(np.asarray(out), np.asarray(x),
                                    rtol=0, atol=0, err_msg=f"m={m_local}")
+
+
+def test_ar_stream_parity_correct_and_barrier_free(ctx):
+    """Barrier-free parity AR (VERDICT r2 #6): many repeated calls over ONE
+    persistent workspace, a rotating straggler widening every reuse window,
+    every call's sum exact. The kernel contains no barrier_all — correctness
+    rests purely on the parity + DMA-completion-chain protocol."""
+    from triton_distributed_tpu.ops.allreduce import (
+        all_reduce_stream, ar_stream_workspace,
+    )
+    from triton_distributed_tpu.runtime import shard_map_on
+
+    n, m, cols, steps = 8, 8, 128, 1000
+    rng = np.random.default_rng(0)
+    base = rng.standard_normal((n, m, cols)).astype(np.float32)
+    want_base = base.sum(axis=0)
+
+    def run(xl):
+        xl = xl[0]                       # (m, cols) this rank's block
+        ws, idx = ar_stream_workspace(n, m, cols, xl.dtype)
+
+        def body(t, carry):
+            ws, idx, err = carry
+            x_t = xl * (1.0 + t)
+            out, ws, idx = all_reduce_stream(
+                x_t, ws, idx, axis="tp", num_ranks=n,
+                straggler=("rotate", 256))
+            return ws, idx, jnp.maximum(
+                err, jnp.max(jnp.abs(out / (1.0 + t) - want_ref)))
+
+        want_ref = jnp.asarray(want_base)
+        _, idx, err = jax.lax.fori_loop(
+            0, steps, body, (ws, idx, jnp.float32(0)))
+        return err[None], idx[None]
+
+    from jax.sharding import PartitionSpec as P
+
+    fn = shard_map_on(ctx, run, P("tp"), (P("tp"), P("tp")))
+    err, idx = fn(jnp.asarray(base))
+    assert float(np.max(np.asarray(err))) < 1e-3, float(np.max(np.asarray(err)))
+    assert int(np.asarray(idx)[0]) == steps
+
+
+def test_ag_stream_parity_repeated_calls(ctx):
+    """Barrier-free parity AllGather: repeated calls over one persistent
+    workspace with a rotating straggler stay exact (same protocol + safety
+    chain as the AR stream)."""
+    from triton_distributed_tpu.ops.allgather import (
+        ag_stream_workspace, all_gather_stream,
+    )
+    from triton_distributed_tpu.runtime import shard_map_on
+    from jax.sharding import PartitionSpec as P
+
+    n, m, cols, steps = 8, 16, 128, 200
+    rng = np.random.default_rng(5)
+    base = rng.standard_normal((n, m, cols)).astype(np.float32)
+    want = jnp.asarray(base.reshape(n * m, cols))
+
+    def run(xl):
+        xl = xl[0]
+        ws, idx = ag_stream_workspace(n, m, cols, xl.dtype)
+
+        def body(t, carry):
+            ws, idx, err = carry
+            out, ws, idx = all_gather_stream(
+                xl * (1.0 + t), ws, idx, axis="tp", num_ranks=n,
+                straggler=("rotate", 256))
+            return ws, idx, jnp.maximum(
+                err, jnp.max(jnp.abs(out / (1.0 + t) - want)))
+
+        _, idx, err = jax.lax.fori_loop(0, steps, body,
+                                        (ws, idx, jnp.float32(0)))
+        return err[None], idx[None]
+
+    fn = shard_map_on(ctx, run, P("tp"), (P("tp"), P("tp")))
+    err, idx = fn(jnp.asarray(base))
+    assert float(np.max(np.asarray(err))) < 1e-4, float(np.max(np.asarray(err)))
+    assert int(np.asarray(idx)[0]) == steps

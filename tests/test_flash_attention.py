@@ -123,18 +123,20 @@ def test_flash_bf16():
 
 
 def test_dispatcher_fallback_on_odd_shapes():
-    """Mismatched head_dim between q and k is not flash-supported, but the
-    dispatcher still answers through the dense path."""
+    """A sequence with no 128-aligned divisor forces whole-dim tiles past
+    the VMEM budget; flash_supported refuses and the dispatcher answers
+    through the dense path."""
     rng = np.random.default_rng(5)
-    q = _rand(rng, (1, 33, 4, 32))   # S=33: no aligned tiling, tiny
-    k = _rand(rng, (1, 33, 2, 32))
-    v = _rand(rng, (1, 33, 2, 32))
+    q = _rand(rng, (1, 997, 2, 128))   # prime S, d=128 -> tile = S, too big
+    k = _rand(rng, (1, 997, 1, 128))
+    v = _rand(rng, (1, 997, 1, 128))
+    assert not flash_supported(q, k)
     out = shard_attention(q, k, v, causal=True)
     gold = _dense(np.asarray(q), np.asarray(k), np.asarray(v),
-                  np.tril(np.ones((33, 33), bool)))
+                  np.tril(np.ones((997, 997), bool)))
     np.testing.assert_allclose(np.asarray(out), gold, rtol=2e-4, atol=2e-4)
-    acc, m, l = shard_attention_partial(q, k, v, q_offset=33, k_offset=0)
-    assert acc.shape == (1, 33, 4, 32)
+    acc, m, l = shard_attention_partial(q, k, v, q_offset=997, k_offset=0)
+    assert acc.shape == (1, 997, 2, 128)
 
 
 def test_flash_supported_rejects_vmem_blowup():

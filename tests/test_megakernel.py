@@ -92,3 +92,103 @@ def test_megakernel_tp_allreduce(ctx):
     ref = sum(ax[d] @ aw[d] for d in range(n))
     for d in range(n):
         np.testing.assert_allclose(got[d], ref, rtol=2e-3, atol=2e-3)
+
+
+def test_megakernel_paged_attention_task():
+    """ATTN_DECODE_PAGED: the page-table walk (table in queue DATA rows,
+    pages scattered arbitrarily in the workspace) matches the linear
+    ATTN_DECODE task on the same logical cache (VERDICT r2 §2.7 gap)."""
+    from triton_distributed_tpu.megakernel.tasks import TILE
+
+    d = TILE
+    S = 3 * TILE                     # 3 logical pages
+    valid = 2 * TILE + 40
+    rng = np.random.default_rng(0)
+    q_np = rng.standard_normal((TILE, d)).astype(np.float32) * 0.3
+    kT_np = rng.standard_normal((d, S)).astype(np.float32) * 0.3
+    v_np = rng.standard_normal((S, d)).astype(np.float32) * 0.3
+    k_new = rng.standard_normal((TILE, d)).astype(np.float32) * 0.3
+    v_new = rng.standard_normal((TILE, d)).astype(np.float32) * 0.3
+
+    def build(paged: bool):
+        mb = MegaKernelBuilder()
+        q = mb.tensor(TILE, d)
+        kn = mb.tensor(TILE, d)
+        vn = mb.tensor(TILE, d)
+        out = mb.tensor(TILE, d)
+        if paged:
+            # Pages allocated as separate scattered tensors, deliberately
+            # out of logical order in the workspace.
+            kt_pages = [mb.tensor(d, TILE) for _ in range(3)]
+            v_pages = [mb.tensor(TILE, d) for _ in range(3)]
+            pages = [(kt_pages[j].tile(0, 0), v_pages[j].tile(0, 0))
+                     for j in range(3)]
+            mb.attn_decode_paged(out, q, pages, valid_len=valid,
+                                 scale=d ** -0.5, k_new=kn, v_new=vn)
+            feeds = {q: q_np, kn: k_new, vn: v_new}
+            for j in range(3):
+                feeds[kt_pages[j]] = kT_np[:, j * TILE:(j + 1) * TILE]
+                feeds[v_pages[j]] = v_np[j * TILE:(j + 1) * TILE]
+        else:
+            kT = mb.tensor(d, S)
+            v = mb.tensor(S, d)
+            mb.attn_decode(out, q, kT, v, valid_len=valid, scale=d ** -0.5,
+                           k_new=kn, v_new=vn)
+            feeds = {q: q_np, kT: kT_np, v: v_np, kn: k_new, vn: v_new}
+        comp = mb.compile()
+        feeds = {h: jnp.asarray(val) for h, val in feeds.items()}
+        (res,) = comp.run(feeds, outputs=[out])
+        return np.asarray(res)
+
+    linear = build(paged=False)
+    paged = build(paged=True)
+    np.testing.assert_allclose(paged, linear, rtol=1e-5, atol=1e-5)
+
+    # Numpy golden: softmax over valid cache positions + current token.
+    s = np.concatenate([q_np @ kT_np[:, :valid],
+                        (q_np * k_new).sum(-1, keepdims=True)],
+                       axis=1) * d ** -0.5
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    gold = p[:, :valid] @ v_np[:valid] + p[:, valid:] * v_new
+    np.testing.assert_allclose(paged, gold, rtol=2e-4, atol=2e-4)
+
+
+def test_megakernel_prefetch_task():
+    """PREFETCH + gemm(prefetch_first=True): the warmed first weight tile
+    path produces the same result as the plain gemm, and the builder
+    rejects mismatched/double prefetches (VERDICT r2 §2.7 gap)."""
+    from triton_distributed_tpu.megakernel.tasks import TILE
+
+    rng = np.random.default_rng(1)
+    a_np = rng.standard_normal((TILE, 2 * TILE)).astype(np.float32) * 0.2
+    b_np = rng.standard_normal((2 * TILE, TILE)).astype(np.float32) * 0.2
+
+    def build(pf: bool):
+        mb = MegaKernelBuilder()
+        a = mb.tensor(TILE, 2 * TILE)
+        b = mb.tensor(2 * TILE, TILE)
+        out = mb.tensor(TILE, TILE)
+        if pf:
+            mb.prefetch(b.tile(0, 0))
+        mb.gemm(out, a, b, prefetch_first=pf)
+        comp = mb.compile()
+        (res,) = comp.run({a: jnp.asarray(a_np), b: jnp.asarray(b_np)},
+                          outputs=[out])
+        return np.asarray(res)
+
+    np.testing.assert_allclose(build(True), build(False), rtol=1e-6)
+    np.testing.assert_allclose(build(False), a_np @ b_np, rtol=1e-4,
+                               atol=1e-4)
+
+    mb = MegaKernelBuilder()
+    a = mb.tensor(TILE, TILE)
+    b = mb.tensor(TILE, TILE)
+    out = mb.tensor(TILE, TILE)
+    with pytest.raises(ValueError, match="does not match"):
+        mb.prefetch(a.tile(0, 0))
+        mb.gemm(out, a, b, prefetch_first=True)
+    mb2 = MegaKernelBuilder()
+    with pytest.raises(ValueError, match="not yet consumed"):
+        mb2.prefetch(a.tile(0, 0))
+        mb2.prefetch(b.tile(0, 0))

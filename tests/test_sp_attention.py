@@ -106,3 +106,38 @@ def test_ulysses_attention_golden(ctx, causal):
                             ctx, causal=causal)
     ref = _dense_attn(q, k, v, causal)
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_flash_decode_ag_stream_repeated(ctx):
+    """SP decode steady state: flash_decode through the barrier-free parity
+    AG (ag_state threaded over repeated steps) matches the one-shot path."""
+    from jax.sharding import PartitionSpec as P
+
+    from triton_distributed_tpu.ops.allgather import ag_stream_workspace
+    from triton_distributed_tpu.ops.flash_decode import flash_decode_local
+    from triton_distributed_tpu.runtime import shard_map_on
+
+    n, b, hq, hkv, d, s_shard = 8, 2, 4, 2, 64, 32
+    rng = np.random.default_rng(11)
+    q = rng.standard_normal((b, hq, d)).astype(np.float32)
+    k = rng.standard_normal((n, b, s_shard, hkv, d)).astype(np.float32)
+    v = rng.standard_normal((n, b, s_shard, hkv, d)).astype(np.float32)
+
+    def run(ql, kl, vl):
+        kl, vl = kl[0], vl[0]
+        ws, idx = ag_stream_workspace(n, b * hq, d + 2, jnp.float32)
+        outs = []
+        for _ in range(3):
+            out, (ws, idx) = flash_decode_local(
+                ql, kl, vl, jnp.int32(s_shard), axis="tp", num_ranks=n,
+                ag_state=(ws, idx))
+            outs.append(out)
+        ref = flash_decode_local(ql, kl, vl, jnp.int32(s_shard),
+                                 axis="tp", num_ranks=n, method="xla")
+        return jnp.stack(outs), ref
+
+    fn = shard_map_on(ctx, run, (P(), P("tp"), P("tp")), (P(), P()))
+    outs, ref = fn(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    for t in range(3):
+        np.testing.assert_allclose(np.asarray(outs)[t], np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)

@@ -20,6 +20,7 @@ _DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "01-distributed-notify-wait.py",     # primitives
     "07-overlapping-allgather-gemm.py",  # the flagship overlap pattern
     "04-moe-infer-all2all.py",           # MoE AllToAll
+    "12-barrier-free-decode-streams.py", # parity-stream decode collectives
 ])
 def test_tutorial_runs(script):
     env = dict(os.environ)

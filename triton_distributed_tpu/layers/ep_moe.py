@@ -21,6 +21,7 @@ from triton_distributed_tpu.ops.all_to_all import (
     combine_layout,
     dispatch_layout,
     fast_all_to_all_local,
+    fast_all_to_all_stream,
 )
 
 
@@ -58,7 +59,8 @@ def router_topk(x: jax.Array, router_w: jax.Array, topk: int):
 
 
 def ep_moe_fwd(params: dict, x: jax.Array, topk: int, *, axis: str = "tp",
-               num_ranks: int = 1, capacity: int | None = None) -> jax.Array:
+               num_ranks: int = 1, capacity: int | None = None,
+               a2a_state=None):
     """Device-local EP-MoE forward inside shard_map.
 
     x: (m, h) this rank's tokens (data-parallel over ranks); params["w_*"]
@@ -66,6 +68,12 @@ def ep_moe_fwd(params: dict, x: jax.Array, topk: int, *, axis: str = "tp",
 
     capacity: per-destination-rank slot size (static); defaults to the
     lossless m·topk rounded up to the DMA block.
+
+    ``a2a_state``: (ws, call_index) from ops/all_to_all.a2a_stream_workspace
+    — the decode loop's barrier-free parity AllToAll (VERDICT r2 #6;
+    reference low_latency_all_to_all.py call_count). Both the dispatch and
+    the combine trip ride the same workspace with alternating parity. When
+    given, returns (y, a2a_state').
     """
     n = num_ranks
     m, h = x.shape
@@ -85,7 +93,8 @@ def ep_moe_fwd(params: dict, x: jax.Array, topk: int, *, axis: str = "tp",
         y = _expert_mlp(xs, gs, params)
         y = y * weights.reshape(-1)[sort_idx][:, None]
         inv = jnp.argsort(sort_idx)
-        return y[inv].reshape(m, topk, h).sum(axis=1).astype(x.dtype)
+        y = y[inv].reshape(m, topk, h).sum(axis=1).astype(x.dtype)
+        return (y, a2a_state) if a2a_state is not None else y
 
     block = 16
     cap = capacity or -(-(m * topk) // block) * block
@@ -94,8 +103,13 @@ def ep_moe_fwd(params: dict, x: jax.Array, topk: int, *, axis: str = "tp",
     flat_tokens = jnp.repeat(x, topk, axis=0)          # (m·topk, h)
     flat_ids = top_ids.reshape(-1)
     lay = dispatch_layout(flat_tokens, flat_ids, E, n, cap)
-    recv_buf, recv_splits = fast_all_to_all_local(
-        lay.send_buf, lay.send_splits, axis=axis, num_ranks=n)
+    if a2a_state is not None:
+        ws, idx = a2a_state
+        recv_buf, recv_splits, ws, idx = fast_all_to_all_stream(
+            lay.send_buf, lay.send_splits, ws, idx, axis=axis, num_ranks=n)
+    else:
+        recv_buf, recv_splits = fast_all_to_all_local(
+            lay.send_buf, lay.send_splits, axis=axis, num_ranks=n)
 
     # 2. local expert MLP over the received rows, grouped by local expert
     # (+1 padding group with zero weights so shapes stay static).
@@ -110,8 +124,12 @@ def ep_moe_fwd(params: dict, x: jax.Array, topk: int, *, axis: str = "tp",
 
     # 3. combine: same slot layout in reverse (recv_splits describe exactly
     # what each source rank sent, so they are the return-trip send_splits).
-    back_buf, _ = fast_all_to_all_local(
-        y_slots, recv_splits, axis=axis, num_ranks=n)
+    if a2a_state is not None:
+        back_buf, _, ws, idx = fast_all_to_all_stream(
+            y_slots, recv_splits, ws, idx, axis=axis, num_ranks=n)
+    else:
+        back_buf, _ = fast_all_to_all_local(
+            y_slots, recv_splits, axis=axis, num_ranks=n)
 
     # 4. un-permute: sorted token i went to (sorted_rank, pos_in_slot) and
     # its result came back at the same coordinates.
@@ -120,7 +138,8 @@ def ep_moe_fwd(params: dict, x: jax.Array, topk: int, *, axis: str = "tp",
     y_flat_sorted = y_flat_sorted * w_sorted[:, None]
     inv = jnp.argsort(lay.sort_idx)
     y_flat = y_flat_sorted[inv]                                  # (m·topk, h)
-    return y_flat.reshape(m, topk, h).sum(axis=1).astype(x.dtype)
+    y = y_flat.reshape(m, topk, h).sum(axis=1).astype(x.dtype)
+    return (y, (ws, idx)) if a2a_state is not None else y
 
 
 def _expert_mlp(x_sorted, group_sizes, params, pad_group: bool = False):

@@ -168,12 +168,19 @@ def tp_attn_prefill(params: dict, cfg: ModelConfig, x: jax.Array,
 
 
 def _out_proj(attn: jax.Array, params: dict, *, axis: str, n: int,
-              mode: str) -> jax.Array:
-    """Row-parallel output projection + TP reduction (decode modes)."""
+              mode: str, ar_fn=None) -> jax.Array:
+    """Row-parallel output projection + TP reduction (decode modes).
+
+    ``ar_fn``: optional replacement for the default fused AllReduce — the
+    decode loop passes the barrier-free parity-stream AR here
+    (ops/allreduce.all_reduce_stream via models/dense.py)."""
     if n == 1:
         return attn @ params["wo"]
     if mode == "ar":
-        return all_reduce_local(attn @ params["wo"], axis=axis, num_ranks=n)
+        y = attn @ params["wo"]
+        if ar_fn is not None:
+            return ar_fn(y)
+        return all_reduce_local(y, axis=axis, num_ranks=n)
     if mode == "xla_rep":
         return jax.lax.psum(attn @ params["wo"], axis)
     raise ValueError(f"decode supports modes 'ar'/'xla_rep', got {mode!r}")
@@ -181,7 +188,7 @@ def _out_proj(attn: jax.Array, params: dict, *, axis: str, n: int,
 
 def tp_attn_decode_paged(params: dict, cfg: ModelConfig, x: jax.Array,
                          cache, *, axis: str = "tp", num_ranks: int = 1,
-                         mode: str = "ar"):
+                         mode: str = "ar", ar_fn=None):
     """Single-token decode over a paged KV cache — per-SEQUENCE positions
     (``cache.kv_lens``), so a continuous batch of sequences at different
     lengths decodes in one step (the modern-serving shape the reference's
@@ -203,12 +210,14 @@ def tp_attn_decode_paged(params: dict, cfg: ModelConfig, x: jax.Array,
     attn = paged_decode_attention(q[:, 0], cache)     # (B, hq_local, d)
     attn = attn.reshape(batch, -1).astype(x.dtype)
 
-    return _out_proj(attn, params, axis=axis, n=n, mode=mode), cache
+    return _out_proj(attn, params, axis=axis, n=n, mode=mode,
+                     ar_fn=ar_fn), cache
 
 
 def tp_attn_decode(params: dict, cfg: ModelConfig, x: jax.Array,
                    kv_slice: KVSlice, pos: jax.Array, *,
-                   axis: str = "tp", num_ranks: int = 1, mode: str = "ar"):
+                   axis: str = "tp", num_ranks: int = 1, mode: str = "ar",
+                   ar_fn=None):
     """Single-token decode step. x: (B, h) replicated (ar modes only — a
     1-row activation cannot be row-sharded; reference dense.py uses the AR
     path for decode too). ``pos``: scalar current position. Returns
@@ -232,4 +241,5 @@ def tp_attn_decode(params: dict, cfg: ModelConfig, x: jax.Array,
                  causal=False, kv_len=pos + 1)
     attn = attn.reshape(batch, -1)
 
-    return _out_proj(attn, params, axis=axis, n=n, mode=mode), new_kv
+    return _out_proj(attn, params, axis=axis, n=n, mode=mode,
+                     ar_fn=ar_fn), new_kv

@@ -81,10 +81,13 @@ def pick_mode(mode: str, m_total: int, n: int, *, hidden: int | None = None,
 
 
 def tp_mlp_fwd(params: dict, x: jax.Array, *, axis: str = "tp",
-               num_ranks: int = 1, mode: str = "overlap") -> jax.Array:
+               num_ranks: int = 1, mode: str = "overlap",
+               ar_fn=None) -> jax.Array:
     """Device-local TP MLP forward with a concrete mode (models resolve
     ``auto`` via :func:`pick_mode` — the input layout depends on it).
-    See module docstring for layouts."""
+    See module docstring for layouts. ``ar_fn`` optionally replaces the
+    fused AllReduce of mode="ar" (the decode loop's barrier-free
+    parity-stream AR, ops/allreduce.all_reduce_stream)."""
     n = num_ranks
     wg, wu, wd = params["w_gate"], params["w_up"], params["w_down"]
     if n == 1:
@@ -104,6 +107,8 @@ def tp_mlp_fwd(params: dict, x: jax.Array, *, axis: str = "tp",
                                     tiled=True)
     if mode == "ar":
         partial = swiglu(x @ wg, x @ wu) @ wd
+        if ar_fn is not None:
+            return ar_fn(partial)
         return all_reduce_local(partial, axis=axis, num_ranks=n)
     if mode == "xla_rep":
         return jax.lax.psum(swiglu(x @ wg, x @ wu) @ wd, axis)

@@ -42,6 +42,13 @@ class MegaKernelBuilder:
         self._edges: list[tuple[int, int]] = []
         self._last_writer: dict[int, int] = {}
         self._readers_since_write: dict[int, list[int]] = {}
+        # task id -> flat int list; packed as extra queue rows at compile
+        # (page tables for ATTN_DECODE_PAGED — data rows, never dispatched).
+        self._task_tables: dict[int, list[int]] = {}
+        # Prefetch hand-off: pseudo-resource tile serializing the single
+        # reserved slot, and the tile id the pending prefetch warmed.
+        self._pf_res: TensorHandle | None = None
+        self._pending_pf: int | None = None
 
     # -- tensors ------------------------------------------------------------
     def tensor(self, rows: int, cols: int) -> TensorHandle:
@@ -102,21 +109,57 @@ class MegaKernelBuilder:
                             k_tiles=a.ct, arg=arg),
                        reads, [out.tile(i, j) for j in range(out.ct)])
 
-    def gemm(self, out: TensorHandle, a: TensorHandle, b: TensorHandle):
+    def prefetch(self, weight_tile: int):
+        """Start warming ``weight_tile`` into the reserved pipeline slot
+        (reference: the weight-prefetch task, SURVEY.md §2.7). The next
+        ``gemm(..., prefetch_first=True)`` whose first weight tile equals it
+        consumes the warm copy for its j=0 load. One outstanding prefetch at
+        a time — the pseudo-resource hazard serializes slot reuse through
+        the scheduler, and the builder rejects an unconsumed double-issue.
+        """
+        if self._pending_pf is not None:
+            raise ValueError(
+                f"prefetch of tile {self._pending_pf} not yet consumed — "
+                "one reserved slot, one outstanding prefetch")
+        if self._pf_res is None:
+            self._pf_res = self.tensor(TILE, TILE)   # hazard token only
+        self._emit(Task(TaskType.PREFETCH, out=0, a0=int(weight_tile)),
+                   [int(weight_tile)], [self._pf_res.tile(0, 0)])
+        self._pending_pf = int(weight_tile)
+
+    def gemm(self, out: TensorHandle, a: TensorHandle, b: TensorHandle,
+             prefetch_first: bool = False):
         """out (M,N) = a (M,K) @ b (K,N), one task per output tile
-        (reference make_linear → tile-parallel GEMM tasks)."""
+        (reference make_linear → tile-parallel GEMM tasks).
+
+        ``prefetch_first``: the first task's j=0 weight tile was warmed by a
+        preceding :meth:`prefetch` — it reads the reserved slot instead of
+        issuing its own DMA (queue word c0 = 1)."""
         if a.cols != b.rows or out.rows != a.rows or out.cols != b.cols:
             raise ValueError("gemm shape mismatch")
+        if prefetch_first:
+            if self._pending_pf != b.tile(0, 0):
+                raise ValueError(
+                    f"prefetch_first: pending prefetch {self._pending_pf} "
+                    f"does not match this gemm's first weight tile "
+                    f"{b.tile(0, 0)}")
+            self._pending_pf = None
         kt = a.ct
+        first = True
         for i in range(out.rt):
             for j in range(out.ct):
                 reads = [a.tile(i, q) for q in range(kt)]
                 reads += [b.tile(q, j) for q in range(kt)]
+                use_pf = prefetch_first and first
+                if use_pf:
+                    reads.append(self._pf_res.tile(0, 0))
                 self._emit(
                     Task(TaskType.GEMM, out.tile(i, j),
                          a0=a.tile(i, 0), b0=b.tile(0, j),
-                         k_tiles=kt, a_stride=1, b_stride=b.ct),
+                         k_tiles=kt, a_stride=1, b_stride=b.ct,
+                         c0=1 if use_pf else 0),
                     reads, [out.tile(i, j)])
+                first = False
 
     def all_reduce(self, t: TensorHandle):
         """Sum ``t`` over ranks in place (reference make_allreduce)."""
@@ -203,21 +246,85 @@ class MegaKernelBuilder:
                  c0=c0, d0=d0),
             reads, [out.tile(0, 0)])
 
+    def attn_decode_paged(self, out: TensorHandle, q: TensorHandle,
+                          pages: list[tuple[int, int]], valid_len: int,
+                          scale: float, k_new: TensorHandle | None = None,
+                          v_new: TensorHandle | None = None):
+        """Page-table flash-attention decode for ONE head: the j-th cache
+        tile pair (kT tile id, V tile id) comes from ``pages`` — arbitrary
+        workspace tiles, so sequences share pools without per-sequence
+        max_seq reservations. The table rides extra queue rows (SMEM via
+        scalar prefetch — the in-kernel analog of
+        ops/paged_attention.py's table walk; reference: the paged FA task,
+        mega_triton_kernel tasks/flash_attn.py).
+
+        ``pages[j]``: (kT_tile, v_tile) covering logical positions
+        [j·TILE, (j+1)·TILE); kT tiles are (d, TILE) key columns, v tiles
+        (TILE, d) value rows — the same layout the linear task uses.
+        """
+        if q.rt != 1 or q.ct != 1 or out.rt != 1 or out.ct != 1:
+            raise ValueError("q/out must be a single (TILE, TILE) tile")
+        if (k_new is None) != (v_new is None):
+            raise ValueError("pass both k_new and v_new or neither")
+        if k_new is None and valid_len < 1:
+            raise ValueError("cache-only attention needs valid_len >= 1")
+        if valid_len > len(pages) * TILE:
+            raise ValueError(
+                f"valid_len {valid_len} exceeds table coverage "
+                f"{len(pages) * TILE}")
+        # valid_len == 0 (empty cache, current token only): visit no pages.
+        k_tiles = min(len(pages), -(-valid_len // TILE))
+        reads = [q.tile(0, 0)]
+        flat: list[int] = []
+        for kt_t, v_t in pages:
+            flat += [int(kt_t), int(v_t)]
+        reads += [t for pair in pages[:k_tiles] for t in pair]
+        c0 = d0 = -1
+        if k_new is not None:
+            c0, d0 = k_new.tile(0, 0), v_new.tile(0, 0)
+            reads += [c0, d0]
+        tid = self._emit(
+            Task(TaskType.ATTN_DECODE_PAGED, out.tile(0, 0),
+                 a0=q.tile(0, 0), b0=-1,   # b0 patched to table row at compile
+                 k_tiles=k_tiles, a_stride=0,
+                 b_stride=int(valid_len), arg=int(round(scale * 1e6)),
+                 c0=c0, d0=d0),
+            reads, [out.tile(0, 0)])
+        self._task_tables[tid] = flat
+
     # -- compile / run -------------------------------------------------------
     def compile(self, num_ranks: int = 1, axis: str = "tp",
                 dtype=jnp.float32) -> "CompiledMegaKernel":
+        if self._pending_pf is not None:
+            raise ValueError(
+                f"prefetch of tile {self._pending_pf} never consumed — the "
+                "kernel would exit with an outstanding DMA on the reserved "
+                "slot (emit the matching gemm(prefetch_first=True))")
         order = topo_schedule(len(self._tasks), self._edges)
         if num_ranks > 1:
             # Cross-device tasks must execute in the same relative order on
             # every rank (they match by queue position); the deterministic
             # scheduler guarantees it because all ranks build the same graph.
             pass
-        queue = np.asarray([self._tasks[t].encode() for t in order],
-                           np.int32).reshape(-1, WORDS)
+        rows = [self._tasks[t].encode() for t in order]
+        n_exec = len(rows)
+        # Page tables pack as DATA rows after the executable tasks (the
+        # grid never reaches them); each owning task's b0 becomes its
+        # table's absolute starting row.
+        for pos, t in enumerate(order):
+            flat = self._task_tables.get(t)
+            if flat is None:
+                continue
+            rows[pos][3] = len(rows)
+            padded = list(flat) + [0] * (-len(flat) % WORDS)
+            for off in range(0, len(padded), WORDS):
+                rows.append(padded[off:off + WORDS])
+        queue = np.asarray(rows, np.int32).reshape(-1, WORDS)
         return CompiledMegaKernel(queue=jnp.asarray(queue),
                                   num_tiles=self._num_tiles,
                                   num_ranks=num_ranks, axis=axis,
-                                  dtype=jnp.dtype(dtype))
+                                  dtype=jnp.dtype(dtype),
+                                  num_exec=n_exec)
 
 
 @dataclasses.dataclass
@@ -229,6 +336,7 @@ class CompiledMegaKernel:
     num_ranks: int
     axis: str
     dtype: jnp.dtype = jnp.dtype(jnp.float32)  # bf16 halves tile DMA bytes
+    num_exec: int | None = None   # dispatched rows (rest = page-table data)
 
     def scatter_input(self, ws: jax.Array, h: TensorHandle,
                       value: jax.Array) -> jax.Array:
@@ -258,7 +366,8 @@ class CompiledMegaKernel:
         advance_queue_pos-updated ``queue`` to retarget without recompile).
         Device-local: wrap in shard_map when num_ranks > 1."""
         return run_queue(self.queue if queue is None else queue, ws,
-                         num_ranks=self.num_ranks, axis=self.axis)
+                         num_ranks=self.num_ranks, axis=self.axis,
+                         num_tasks=self.num_exec)
 
     def run(self, inputs: dict, outputs: list[TensorHandle],
             _device_local: bool = True):

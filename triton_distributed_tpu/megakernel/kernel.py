@@ -72,11 +72,14 @@ def _mega_kernel(n: int, axis: str, n_tasks: int,
     # Pipelined pair loads: tile streams (a_of(j), b_of(j)) double-buffered
     # so iteration j's MXU work overlaps iteration j+1's DMA — the intra-
     # task analog of ops/tiling.py's emit_pipeline.
-    def pipelined_pairs(a_of, b_of, n_iters, body_fn, init):
+    def pipelined_pairs(a_of, b_of, n_iters, body_fn, init, b_pf=None):
         # DEPTH tile-pairs in flight: a single-buffer lookahead cannot hide
         # ~2us DMA latency under a 128x128 dot; 3 outstanding pairs can.
         # b_of=None streams only `a` (the body's b_ref is then invalid) —
         # copy/scale/rms-pass1 would otherwise double their HBM reads.
+        # b_pf (traced bool): j=0's b tile was warmed into the RESERVED
+        # slot vb2[PIPE_DEPTH] by a PREFETCH task — wait its semaphore
+        # instead of issuing a load (reference weight-prefetch task).
         def desc(idx, vref2, slot, sem_i):
             return pltpu.make_async_copy(ws_out.at[idx], vref2.at[slot],
                                          pipe_sems.at[sem_i])
@@ -84,12 +87,25 @@ def _mega_kernel(n: int, axis: str, n_tasks: int,
         def start(j, slot):
             desc(a_of(j), va2, slot, slot * 2).start()
             if b_of is not None:
-                desc(b_of(j), vb2, slot, slot * 2 + 1).start()
+                if b_pf is None:
+                    desc(b_of(j), vb2, slot, slot * 2 + 1).start()
+                else:
+                    @pl.when(jnp.logical_or(j != 0, ~b_pf))
+                    def _():
+                        desc(b_of(j), vb2, slot, slot * 2 + 1).start()
+
+        def bslot_sem(j, slot):
+            if b_pf is None:
+                return slot, slot * 2 + 1
+            use = jnp.logical_and(j == 0, b_pf)
+            return (jnp.where(use, PIPE_DEPTH, slot),
+                    jnp.where(use, 2 * PIPE_DEPTH, slot * 2 + 1))
 
         def wait(j, slot):
             desc(a_of(j), va2, slot, slot * 2).wait()
             if b_of is not None:
-                desc(b_of(j), vb2, slot, slot * 2 + 1).wait()
+                bs, sem = bslot_sem(j, slot)
+                desc(b_of(j), vb2, bs, sem).wait()
 
         for jj in range(PIPE_DEPTH - 1):
             @pl.when(jj < n_iters)
@@ -105,7 +121,8 @@ def _mega_kernel(n: int, axis: str, n_tasks: int,
                       jax.lax.rem(j + PIPE_DEPTH - 1, PIPE_DEPTH))
 
             wait(j, slot)
-            return body_fn(j, va2.at[slot], vb2.at[slot], carry)
+            bs, _sem = bslot_sem(j, slot)
+            return body_fn(j, va2.at[slot], vb2.at[bs], carry)
 
         return jax.lax.fori_loop(0, n_iters, body, init)
 
@@ -140,9 +157,16 @@ def _mega_kernel(n: int, axis: str, n_tasks: int,
             return 0
 
         pipelined_pairs(lambda j: a0 + j * a_stride,
-                        lambda j: b0 + j * b_stride, k_tiles, body, 0)
+                        lambda j: b0 + j * b_stride, k_tiles, body, 0,
+                        b_pf=(c0 == 1))
         va[...] = vacc[...].astype(wdt)
         store(va, out)
+
+    def t_prefetch():
+        # Fire-and-forget warm of tile a0 into the reserved slot; the
+        # consuming GEMM (c0 == 1) waits the semaphore at its j=0.
+        pltpu.make_async_copy(ws_out.at[a0], vb2.at[PIPE_DEPTH],
+                              pipe_sems.at[2 * PIPE_DEPTH]).start()
 
     def t_allreduce():
         # One-shot AR of tile ``out`` (reference tasks/allreduce.py, minus
@@ -219,15 +243,9 @@ def _mega_kernel(n: int, axis: str, n_tasks: int,
                    + rot * vq[...].astype(jnp.float32)).astype(wdt)
         store(va, out)
 
-    def t_attn_decode():
-        # Single-token GQA decode for one q head: online-softmax flash
-        # attention over S = k_tiles*TILE cached positions, masked to
-        # b_stride valid rows. q: one (TILE, TILE) tile (rows = padded
-        # batch, cols = head_dim); KT tiles at b0+j (d, TILE); V tiles at
-        # a_stride+j (TILE, d). When c0 >= 0, the current token's k/v tiles
-        # (c0/d0, each (B, d), one per batch row) join the softmax rowwise —
-        # the cache is appended after the step instead of mutated in-kernel.
-        # Reference: tasks/flash_attn.py (paged FA decode task).
+    def _attn_softmax(kt_of, v_of):
+        """Shared online-softmax body: streams (kT_j, V_j) tile pairs by the
+        given index functions, then folds in the current token (c0/d0)."""
         load(a0, vq)
         scale = arg.astype(jnp.float32) * 1e-6
         valid = b_stride
@@ -251,8 +269,7 @@ def _mega_kernel(n: int, axis: str, n_tasks: int,
             vacc[...] = vacc[...] * corr + pv
             return (m_new, l * corr + jnp.sum(p, axis=1, keepdims=True))
 
-        m, l = pipelined_pairs(lambda j: b0 + j, lambda j: a_stride + j,
-                               k_tiles, body, (m0, l0))
+        m, l = pipelined_pairs(kt_of, v_of, k_tiles, body, (m0, l0))
 
         @pl.when(c0 >= 0)
         def _():
@@ -275,19 +292,49 @@ def _mega_kernel(n: int, axis: str, n_tasks: int,
         va[...] = (vacc[...] / jnp.maximum(vstat[:, :1], 1e-30)).astype(wdt)
         store(va, out)
 
+    def t_attn_decode_paged():
+        # Page-table walk: the j-th (kT, V) tile pair comes from queue DATA
+        # rows starting at row b0 — entry pair j at flat offsets (2j, 2j+1).
+        # The table rides scalar prefetch (SMEM), so the DMA addresses are
+        # data-dependent exactly like ops/paged_attention.py's table walk.
+        def kt_of(j):
+            f = 2 * j
+            return queue_ref[b0 + f // WORDS, jax.lax.rem(f, WORDS)]
+
+        def v_of(j):
+            f = 2 * j + 1
+            return queue_ref[b0 + f // WORDS, jax.lax.rem(f, WORDS)]
+
+        _attn_softmax(kt_of, v_of)
+
+    def t_attn_decode():
+        # Single-token GQA decode for one q head: online-softmax flash
+        # attention over S = k_tiles*TILE cached positions, masked to
+        # b_stride valid rows. q: one (TILE, TILE) tile (rows = padded
+        # batch, cols = head_dim); KT tiles at b0+j (d, TILE); V tiles at
+        # a_stride+j (TILE, d). When c0 >= 0, the current token's k/v tiles
+        # (c0/d0, each (B, d), one per batch row) join the softmax rowwise —
+        # the cache is appended after the step instead of mutated in-kernel.
+        # Reference: tasks/flash_attn.py (paged FA decode task).
+        _attn_softmax(lambda j: b0 + j, lambda j: a_stride + j)
+
     jax.lax.switch(w(0), [t_copy, t_add, t_silu_mul, t_gemm, t_allreduce,
-                          t_scale, t_rms_norm, t_rope, t_attn_decode])
+                          t_scale, t_rms_norm, t_rope, t_attn_decode,
+                          t_attn_decode_paged, t_prefetch])
 
 
-def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp"):
+def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
+              num_tasks: int | None = None):
     """Execute the packed task queue over the workspace in ONE pallas_call.
 
-    queue: (n_tasks, WORDS) int32; workspace: (T, TILE, TILE) fp32 or bf16
+    queue: (n_rows, WORDS) int32; workspace: (T, TILE, TILE) fp32 or bf16
     (local per device when num_ranks > 1 — call inside shard_map). bf16
     halves every tile DMA; compute stays fp32 on the VPU/MXU.
+    ``num_tasks``: dispatched rows (default all) — rows beyond are DATA
+    (ATTN_DECODE_PAGED page tables) the grid never visits.
     Returns the post-execution workspace.
     """
-    n_tasks = queue.shape[0]
+    n_tasks = num_tasks if num_tasks is not None else queue.shape[0]
     assert queue.shape[1] == WORDS
     n = num_ranks
     T = workspace.shape[0]
@@ -301,13 +348,13 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp"):
         in_specs=[any_spec()],
         out_specs=(any_spec(), any_spec()),
         scratch_shapes=[
-            pltpu.VMEM((PIPE_DEPTH, TILE, TILE), wdt),  # va2
-            pltpu.VMEM((PIPE_DEPTH, TILE, TILE), wdt),  # vb2
+            pltpu.VMEM((PIPE_DEPTH, TILE, TILE), wdt),      # va2
+            pltpu.VMEM((PIPE_DEPTH + 1, TILE, TILE), wdt),  # vb2 (+pf slot)
             pltpu.VMEM((TILE, TILE), jnp.float32),      # vacc (fp32 accum)
             pltpu.VMEM((TILE, TILE), wdt),              # vq: rope/attn operand
             pltpu.VMEM((TILE, 128), jnp.float32),       # vstat (softmax stats)
             pltpu.SemaphoreType.DMA(()),               # copy_sem
-            pltpu.SemaphoreType.DMA((2 * PIPE_DEPTH,)),  # pipe_sems (slot x a/b)
+            pltpu.SemaphoreType.DMA((2 * PIPE_DEPTH + 1,)),  # pipe (+pf sem)
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
             pltpu.SemaphoreType.DMA(()),
         ],

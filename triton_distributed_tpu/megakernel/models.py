@@ -89,7 +89,7 @@ class DecodeStepProgram:
     x_out: TensorHandle
 
 
-def advance_queue_pos(base_queue, pos: int):
+def advance_queue_pos(base_queue, pos: int, num_exec: int | None = None):
     """Re-target a compiled decode queue to position ``pos`` WITHOUT
     recompiling: ATTN_DECODE's valid_len (word 6) and visited-tile count
     (word 4) are runtime queue words, so one host-side int32 edit per step
@@ -104,7 +104,12 @@ def advance_queue_pos(base_queue, pos: int):
     from triton_distributed_tpu.megakernel.tasks import TaskType
 
     q = np.asarray(base_queue).copy()
-    attn = q[:, 0] == int(TaskType.ATTN_DECODE)
+    attn = ((q[:, 0] == int(TaskType.ATTN_DECODE))
+            | (q[:, 0] == int(TaskType.ATTN_DECODE_PAGED)))
+    if num_exec is not None:
+        # Rows beyond the executable prefix are page-table DATA — their
+        # words must never be interpreted as task fields.
+        attn[num_exec:] = False
     need = -(-pos // TILE)
     if np.any(q[attn, 4] < need):
         raise ValueError(
@@ -132,12 +137,20 @@ def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
     scale = d ** -0.5
 
     xn = mb.tensor(TILE, hidden)
+    # Weight prefetches: each gemm's first weight tile is warmed into the
+    # reserved pipeline slot while the preceding tasks run (reference
+    # weight-prefetch task, SURVEY.md §2.7) — wq under the norm, wo under
+    # the whole attention phase, w_gate under AR+add+norm, etc.
+    mb.prefetch(h.wq.tile(0, 0))
     mb.rms_norm(xn, x, h.attn_norm, eps)
 
     q = mb.tensor(TILE, hq_local * d)
-    mb.gemm(q, xn, h.wq)
-    mb.gemm(h.k_new, xn, h.wk)
-    mb.gemm(h.v_new, xn, h.wv)
+    mb.gemm(q, xn, h.wq, prefetch_first=True)
+    mb.prefetch(h.wk.tile(0, 0))
+    mb.gemm(h.k_new, xn, h.wk, prefetch_first=True)
+    mb.prefetch(h.wv.tile(0, 0))
+    mb.gemm(h.v_new, xn, h.wv, prefetch_first=True)
+    mb.prefetch(h.wo.tile(0, 0))
 
     # Per-head qk-norm (head_dim == TILE → one-tile-wide RMSNorm) + RoPE.
     for j in range(hq_local):
@@ -155,7 +168,8 @@ def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
                        k_new=_col(h.k_new, kv), v_new=_col(h.v_new, kv))
 
     o = mb.tensor(TILE, hidden)
-    mb.gemm(o, attn, h.wo)
+    mb.gemm(o, attn, h.wo, prefetch_first=True)
+    mb.prefetch(h.w_gate.tile(0, 0))
     if num_ranks > 1:
         mb.all_reduce(o)
     x1 = mb.tensor(TILE, hidden)
@@ -167,11 +181,13 @@ def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
     gate = mb.tensor(TILE, ffn_local)
     up = mb.tensor(TILE, ffn_local)
     act = mb.tensor(TILE, ffn_local)
-    mb.gemm(gate, x1n, h.w_gate)
-    mb.gemm(up, x1n, h.w_up)
+    mb.gemm(gate, x1n, h.w_gate, prefetch_first=True)
+    mb.prefetch(h.w_up.tile(0, 0))
+    mb.gemm(up, x1n, h.w_up, prefetch_first=True)
+    mb.prefetch(h.w_down.tile(0, 0))
     mb.silu_mul(act, gate, up)
     down = mb.tensor(TILE, hidden)
-    mb.gemm(down, act, h.w_down)
+    mb.gemm(down, act, h.w_down, prefetch_first=True)
     if num_ranks > 1:
         mb.all_reduce(down)
     x2 = mb.tensor(TILE, hidden)

@@ -112,7 +112,9 @@ class MegakernelDecoder:
         self.embed = params["embed"]
         self.final_norm = params["final_norm"]
         self.lm_head = params.get("lm_head")
-        self._step_jit = jax.jit(functools.partial(self._step))
+        # Donate the workspace: it is ALL the weights + KV — without
+        # donation every token would pay a whole-workspace device copy.
+        self._step_jit = jax.jit(self._step, donate_argnums=(0,))
 
     # -- workspace ----------------------------------------------------------
     def start(self, cache) -> jax.Array:
@@ -144,8 +146,12 @@ class MegakernelDecoder:
                 ws = ws.at[h.v[kv].base + tile_i, intra, :].set(vrow)
         return ws
 
-    def _step(self, ws, queue, cos, sin, token, pos):
-        x_row = self.embed[token[0]].astype(jnp.float32)       # (hidden,)
+    def _step(self, ws, embed, final_norm, lm_head, queue, cos, sin, token,
+              pos):
+        # embed / final_norm / lm_head arrive as ARGUMENTS: closed over,
+        # jit would bake them into the trace as inline constants (multi-GB
+        # for real checkpoints — the exact hazard bench.py documents).
+        x_row = embed[token[0]].astype(jnp.float32)            # (hidden,)
         x = jnp.zeros((TILE, self.cfg.hidden_size), jnp.float32
                       ).at[0].set(x_row)
         ws = self.comp.scatter_input(ws, self.prog.x, x)
@@ -155,17 +161,23 @@ class MegakernelDecoder:
         ws = self._append_kv(ws, pos)
         x_out = self.comp.gather_output(ws, self.prog.x_out)[0:1]
         xn = rms_norm(x_out.astype(jnp.float32),
-                      self.final_norm.astype(jnp.float32),
+                      final_norm.astype(jnp.float32),
                       self.cfg.rms_norm_eps)
-        head = (self.lm_head if self.lm_head is not None
-                else self.embed.T)
+        head = lm_head if lm_head is not None else embed.T
         logits = xn @ head.astype(jnp.float32)
         return ws, jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     def step(self, ws: jax.Array, token: jax.Array, pos: int):
         """token: (1,) int32; pos: host int (current cache length). Returns
         (workspace', next_token (1,))."""
-        queue = advance_queue_pos(self.comp.queue, pos)
+        if pos >= self.max_seq:
+            raise ValueError(
+                f"pos {pos} >= max_seq {self.max_seq}: the step appends "
+                "this position's k/v — past capacity it would write into "
+                "the adjacent workspace region")
+        queue = advance_queue_pos(self.comp.queue, pos,
+                                  num_exec=self.comp.num_exec)
         cos, sin = rope_tables(pos, TILE, self.cfg.rope_theta)
-        return self._step_jit(ws, queue, jnp.asarray(cos), jnp.asarray(sin),
+        return self._step_jit(ws, self.embed, self.final_norm, self.lm_head,
+                              queue, jnp.asarray(cos), jnp.asarray(sin),
                               token, jnp.int32(pos))

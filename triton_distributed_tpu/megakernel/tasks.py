@@ -41,6 +41,23 @@ class TaskType(enum.IntEnum):
     #                 the new token's (B, d) k/v join the softmax rowwise,
     #                 so the cache is appended AFTER the step (no in-kernel
     #                 tile mutation needed)
+    PREFETCH = 10   # fire-and-forget DMA warm: start copying tile a0 into
+    #                 the reserved pipeline slot (vb2[PIPE_DEPTH]); the next
+    #                 GEMM emitted with prefetch_first=True (queue word
+    #                 c0 == 1) consumes it as its j=0 weight tile instead of
+    #                 issuing its own load — the first-tile DMA latency hides
+    #                 under whatever tasks the scheduler places in between.
+    #                 Reference: the weight-prefetch task of
+    #                 mega_triton_kernel (SURVEY.md §2.7 task builders).
+    ATTN_DECODE_PAGED = 9  # ATTN_DECODE over a PAGE TABLE: the j-th cache
+    #                 tile pair comes from table entries (kT tile id, V tile
+    #                 id) stored in extra queue rows (scalar-prefetched SMEM
+    #                 — data-dependent addressing, the same mechanism as
+    #                 ops/paged_attention.py). b0 = table start ROW in the
+    #                 queue; entry pair j at flat offsets (2j, 2j+1) within
+    #                 rows b0+. Other words as ATTN_DECODE (a_stride unused).
+    #                 Reference: the paged FA decode task of
+    #                 mega_triton_kernel tasks/flash_attn.py.
 
 
 @dataclasses.dataclass(frozen=True)

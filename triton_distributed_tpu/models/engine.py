@@ -116,24 +116,69 @@ class Engine:
             self._jit_cache[key] = jax.jit(fn, donate_argnums=(2,))
         return self._jit_cache[key]
 
-    def _decode_jit(self):
-        key = ("decode",)
+    def _use_ar_stream(self) -> bool:
+        """Barrier-free parity AR on the decode path: mode='ar', real TP,
+        dense decode fns only — a user-supplied decode_fn has no ar_state
+        contract (opt out with TDTPU_AR_STREAM=0)."""
+        import os
+
+        return (self.n > 1 and self._decode_mode() == "ar"
+                and self._decode_fn in (dense_decode_step,
+                                        dense_decode_step_paged)
+                and os.environ.get("TDTPU_AR_STREAM", "1") != "0")
+
+    def _ar_state(self, batch: int):
+        """Host-level persistent parity workspace, sharded one slab per
+        device (allocated once per batch shape; threaded + donated through
+        the decode loop so the buffer address is stable — the symmetric-
+        memory persistence the barrier-free protocol requires)."""
+        key = ("ar_ws", batch)
+        if key not in self._jit_cache:
+            from jax.sharding import NamedSharding
+
+            mesh = self.ctx.mesh
+            h = self.cfg.hidden_size
+            dt = jnp.dtype(self.cfg.dtype)
+            ws = jnp.zeros((self.n, 2, self.n, batch, h), dt)
+            ws = jax.device_put(ws, NamedSharding(mesh, P(self.axis)))
+            idx = jax.device_put(jnp.zeros((), jnp.int32),
+                                 NamedSharding(mesh, P()))
+            self._jit_cache[key] = (ws, idx)
+        return self._jit_cache[key]
+
+    def _decode_jit(self, ar_stream: bool):
+        key = ("decode", ar_stream)
         if key not in self._jit_cache:
             mode = self._decode_mode()
             cspecs = (paged_cache_specs(self.axis) if self.page_size
                       else kv_cache_specs(self.axis))
 
-            def step(params, tokens, cache):
-                logits, cache = self._decode_fn(
-                    params, self.cfg, tokens, cache,
-                    axis=self.axis, num_ranks=self.n, mode=mode)
-                return sampling.greedy(logits), cache
+            if ar_stream:
+                def step(params, tokens, cache, ws, idx):
+                    logits, cache, (ws, idx) = self._decode_fn(
+                        params, self.cfg, tokens, cache,
+                        axis=self.axis, num_ranks=self.n, mode=mode,
+                        ar_state=(ws[0], idx))
+                    return sampling.greedy(logits), cache, ws[None], idx
 
-            fn = self._shard(
-                step,
-                in_specs=(self.param_specs, P(), cspecs),
-                out_specs=(P(), cspecs))
-            self._jit_cache[key] = jax.jit(fn, donate_argnums=(2,))
+                fn = self._shard(
+                    step,
+                    in_specs=(self.param_specs, P(), cspecs,
+                              P(self.axis), P()),
+                    out_specs=(P(), cspecs, P(self.axis), P()))
+                self._jit_cache[key] = jax.jit(fn, donate_argnums=(2, 3))
+            else:
+                def step(params, tokens, cache):
+                    logits, cache = self._decode_fn(
+                        params, self.cfg, tokens, cache,
+                        axis=self.axis, num_ranks=self.n, mode=mode)
+                    return sampling.greedy(logits), cache
+
+                fn = self._shard(
+                    step,
+                    in_specs=(self.param_specs, P(), cspecs),
+                    out_specs=(P(), cspecs))
+                self._jit_cache[key] = jax.jit(fn, donate_argnums=(2,))
         return self._jit_cache[key]
 
     # -- public API ---------------------------------------------------------
@@ -190,10 +235,19 @@ class Engine:
         ``page_size`` is set — a linear cache from prefill() is converted
         automatically on first use. Returns (next_tokens (B,), cache).
         Compiled once; subsequent calls replay the executable (the
-        CUDA-graph analog)."""
+        CUDA-graph analog). With TP > 1 on the ar path, every in-step
+        AllReduce runs the barrier-free parity-stream kernel over a
+        persistent workspace threaded here."""
         if self.page_size is not None and isinstance(cache, KVCache):
             cache = self.to_paged(cache)
-        return self._decode_jit()(self.params, tokens, cache)
+        batch = int(tokens.shape[0])
+        if self._use_ar_stream():
+            ws, idx = self._ar_state(batch)
+            tok, cache, ws, idx = self._decode_jit(True)(
+                self.params, tokens, cache, ws, idx)
+            self._jit_cache[("ar_ws", batch)] = (ws, idx)
+            return tok, cache
+        return self._decode_jit(False)(self.params, tokens, cache)
 
     def serve(self, input_ids: jax.Array, gen_len: int,
               profile_dir: str | None = None) -> jax.Array:
@@ -239,8 +293,13 @@ class Engine:
         if getattr(self, "_mk", None) is None:
             self._mk = MegakernelDecoder(self.cfg, self.params,
                                          max_seq=self.max_seq)
-        ws = self._mk.start(cache)
         pos = int(cache.offset)
+        if pos + gen_len - 1 > self.max_seq:
+            raise ValueError(
+                f"prompt ({pos}) + gen_len ({gen_len}) exceeds max_seq "
+                f"{self.max_seq} — reject up front rather than dying "
+                "mid-generation")
+        ws = self._mk.start(cache)
         outs = [tok]
         with group_profile("mk_decode", do_prof=profile_dir is not None,
                            log_dir=profile_dir or "."):

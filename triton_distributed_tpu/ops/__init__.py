@@ -7,13 +7,17 @@ plus an XLA-collective reference used as golden and fallback.
 
 from triton_distributed_tpu.ops.allgather import (  # noqa: F401
     AllGatherMethod,
+    ag_stream_workspace,
     all_gather,
+    all_gather_stream,
     get_auto_all_gather_method,
 )
 from triton_distributed_tpu.ops.reduce_scatter import reduce_scatter  # noqa: F401
 from triton_distributed_tpu.ops.allreduce import (  # noqa: F401
     AllReduceMethod,
     all_reduce,
+    all_reduce_stream,
+    ar_stream_workspace,
     get_auto_allreduce_method,
 )
 from triton_distributed_tpu.ops.allgather_gemm import (  # noqa: F401
@@ -32,8 +36,10 @@ from triton_distributed_tpu.ops.gemm_allreduce import (  # noqa: F401
 )
 from triton_distributed_tpu.ops.p2p import p2p_shift, p2p_shift_local  # noqa: F401
 from triton_distributed_tpu.ops.all_to_all import (  # noqa: F401
+    a2a_stream_workspace,
     fast_all_to_all,
     fast_all_to_all_local,
+    fast_all_to_all_stream,
     dispatch_layout,
     combine_layout,
 )
@@ -60,7 +66,17 @@ from triton_distributed_tpu.ops.paged_attention import (  # noqa: F401
     paged_append,
     paged_decode_attention,
 )
-from triton_distributed_tpu.ops.gemm import pallas_matmul  # noqa: F401
+from triton_distributed_tpu.ops.flash_attention import (  # noqa: F401
+    flash_attention,
+    flash_attention_partial,
+    flash_supported,
+    shard_attention,
+    shard_attention_partial,
+)
+from triton_distributed_tpu.ops.gemm import (  # noqa: F401
+    pallas_matmul,
+    pallas_matmul_tuned,
+)
 from triton_distributed_tpu.ops.moe import (  # noqa: F401
     ag_group_gemm_local,
     grouped_mlp,

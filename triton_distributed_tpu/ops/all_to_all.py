@@ -177,6 +177,131 @@ def fast_all_to_all_local(
     return recv_buf, recv_splits
 
 
+def _a2a_parity_kernel(n: int, axis: str, cap: int, block: int, straggler,
+                       idx_ref, send_ref, send_rows, recv_rows, _ws_in,
+                       recv_ref, ws, data_send_sem, data_recv_sems,
+                       copy_sem):
+    """Barrier-free parity A2A for repeated decode-step calls.
+
+    Reference: ``low_latency_all_to_all.py:125-175`` — the double-buffered
+    ``call_count`` parity protocol itself (this op is its direct analog).
+    The entry barrier is replaced by (a) a persistent caller-owned
+    workspace (aliased input/output — remote writes always target a live
+    allocation) and (b) the per-call XLA splits exchange, which is a
+    full-axis rendezvous: a rank can only be at call t+2 after every peer
+    completed call t+1's splits collective, hence finished reading its
+    call-t parity slab. Per-parity recv semaphores keep early t+1
+    deliveries from being miscounted against call t.
+    """
+    me = dl.rank(axis)
+    p = jax.lax.rem(idx_ref[0], 2)
+    if straggler is not None and straggler[0] == "rotate":
+        straggler = (jax.lax.rem(idx_ref[0], n), straggler[1])
+    dl.maybe_straggle(straggler, me)
+    slab = ws.at[p]                     # (n, cap, hidden) parity slab
+    block_like = send_ref.at[0, pl.ds(0, block)]
+    recv_sem = data_recv_sems.at[p]
+
+    def nblocks(rows):
+        return jax.lax.div(rows + (block - 1), block)
+
+    def push_blocks(slot, dst_rank, count):
+        def body(j, _):
+            src = send_ref.at[slot, pl.ds(j * block, block)]
+            dst = slab.at[me, pl.ds(j * block, block)]
+            if dst_rank is None:
+                pltpu.make_async_copy(src, dst, recv_sem).start()
+            else:
+                shmem.putmem_nbi_block(src, dst, data_send_sem,
+                                       recv_sem, dst_rank, axis)
+            return 0
+
+        jax.lax.fori_loop(0, count, body, 0)
+
+    total_sent = jnp.int32(0)
+    for i in range(n - 1):
+        q = jax.lax.rem(me + 1 + i, n)
+        nb = nblocks(send_rows[q])
+        push_blocks(q, q, nb)
+        total_sent = total_sent + nb
+    push_blocks(me, None, nblocks(send_rows[me]))
+
+    expected = jnp.int32(0)
+    for q in range(n):
+        expected = expected + nblocks(recv_rows[q])
+    _wait_n(block_like, recv_sem, expected)
+
+    # Landed slab -> this call's output (local copy; remote hazards are
+    # confined to the persistent slab).
+    out_cp = pltpu.make_async_copy(slab, recv_ref, copy_sem)
+    out_cp.start()
+    out_cp.wait()
+    _wait_n(block_like, data_send_sem, total_sent)
+
+
+def a2a_stream_workspace(n: int, cap: int, hidden: int, dtype
+                         ) -> tuple[jax.Array, jax.Array]:
+    """Device-local persistent (workspace, call_index) for
+    :func:`fast_all_to_all_stream`; allocate once, thread through the
+    decode loop."""
+    return (jnp.zeros((2, n, cap, hidden), dtype), jnp.zeros((), jnp.int32))
+
+
+def fast_all_to_all_stream(send_buf: jax.Array, send_splits: jax.Array,
+                           ws: jax.Array, call_index: jax.Array, *,
+                           axis: str = "tp", num_ranks: int | None = None,
+                           block_rows: int | None = None,
+                           straggler: tuple | None = None,
+                           force_kernel: bool = False):
+    """Barrier-free steady-state AllToAll (EP decode path).
+
+    Same contract as :func:`fast_all_to_all_local` plus the threaded
+    (ws, call_index) pair from :func:`a2a_stream_workspace`. Returns
+    (recv_buf, recv_splits, ws', call_index + 1). ``force_kernel`` runs the
+    Pallas kernel even at n=1 (single-chip Mosaic compile check).
+    """
+    if num_ranks is None:
+        raise ValueError("num_ranks required inside shard_map")
+    n = num_ranks
+    send_splits = send_splits.astype(jnp.int32)
+    if n == 1 and not force_kernel:
+        return send_buf, send_splits, ws, call_index + 1
+    _, cap, hidden = send_buf.shape
+    block = block_rows or max(16, sublane_align(send_buf.dtype))
+    if cap % block:
+        raise ValueError(f"slot capacity {cap} not a multiple of "
+                         f"block_rows {block}")
+    if ws.shape != (2, n, cap, hidden):
+        raise ValueError(f"workspace shape {ws.shape} != (2, {n}, {cap}, "
+                         f"{hidden})")
+
+    recv_splits = jax.lax.all_to_all(send_splits, axis, split_axis=0,
+                                     concat_axis=0, tiled=True)
+    send_rows = send_splits.sum(axis=1, dtype=jnp.int32)
+    recv_rows = recv_splits.sum(axis=1, dtype=jnp.int32)
+
+    kernel = functools.partial(_a2a_parity_kernel, n, axis, cap, block,
+                               straggler)
+    recv_buf, ws_new = kernel_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((n, cap, hidden), send_buf.dtype),
+            jax.ShapeDtypeStruct(ws.shape, ws.dtype),
+        ),
+        in_specs=[smem_spec((1,)), any_spec(), smem_spec(), smem_spec(),
+                  any_spec()],
+        out_specs=(any_spec(), any_spec()),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        input_output_aliases={4: 1},
+    )(jnp.asarray(call_index, jnp.int32).reshape(1), send_buf, send_rows,
+      recv_rows, ws)
+    return recv_buf, recv_splits, ws_new, call_index + 1
+
+
 def fast_all_to_all(send_buf: jax.Array, send_splits: jax.Array,
                     ctx: DistContext | None = None, axis: str = "tp",
                     block_rows: int | None = None):

@@ -162,6 +162,87 @@ def all_gather_local(x_local: jax.Array, axis: str = "tp", num_ranks: int | None
     return _build_ag_call(n, axis, m, cols, x_local.dtype, method)(x_local)
 
 
+# ---------------------------------------------------------------------------
+# Barrier-free steady-state AG (decode path). Same call_count-parity protocol
+# as ops/allreduce.all_reduce_stream (reference low_latency_all_to_all.py
+# :125-175); safety argument identical — AG completion waits a delivery from
+# EVERY peer, so the DMA-completion chain orders parity-slab reuse.
+# ---------------------------------------------------------------------------
+
+def _ag_parity_kernel(n: int, axis: str, m: int, straggler,
+                      idx_ref, x_ref, _ws_in, out_ref, ws,
+                      send_sems, recv_sems, copy_sem):
+    import jax.numpy as jnp
+
+    me = dl.rank(axis)
+    p = jax.lax.rem(idx_ref[0], 2)
+    if straggler is not None and straggler[0] == "rotate":
+        straggler = (jax.lax.rem(idx_ref[0], n), straggler[1])
+    dl.maybe_straggle(straggler, me)
+    slab = ws.at[p]                       # (n·m, cols) parity slab
+    my_slot = slab.at[pl.ds(me * m, m)]
+    local = pltpu.make_async_copy(x_ref, my_slot, copy_sem)
+    local.start()
+    handles = []
+    for i in range(n - 1):
+        peer = jax.lax.rem(me + 1 + i, n)
+        handles.append(
+            shmem.putmem_nbi_block(x_ref, my_slot, send_sems.at[i],
+                                   recv_sems.at[p], peer, axis))
+    local.wait()
+    shmem.quiet(*handles)
+    shmem.wait_deliveries(x_ref, recv_sems.at[p], n - 1)
+    out_cp = pltpu.make_async_copy(slab, out_ref, copy_sem)
+    out_cp.start()
+    out_cp.wait()
+
+
+def ag_stream_workspace(n: int, m: int, cols: int, dtype):
+    """Persistent (workspace (2, n·m, cols), call_index) pair for
+    :func:`all_gather_stream`; allocate once, thread through the loop."""
+    import jax.numpy as jnp
+
+    return (jnp.zeros((2, n * m, cols), dtype), jnp.zeros((), jnp.int32))
+
+
+def all_gather_stream(x_local: jax.Array, ws: jax.Array,
+                      call_index: jax.Array, *, axis: str = "tp",
+                      num_ranks: int | None = None,
+                      straggler: tuple | None = None,
+                      force_kernel: bool = False):
+    """Barrier-free full-mesh-push AllGather over a persistent parity
+    workspace. x_local: (m, cols) → ((n·m, cols), ws', call_index + 1)."""
+    import jax.numpy as jnp
+
+    if num_ranks is None:
+        raise ValueError("num_ranks required inside shard_map")
+    n = num_ranks
+    if n == 1 and not force_kernel:
+        return x_local, ws, call_index + 1
+    m, cols = x_local.shape
+    if ws.shape != (2, n * m, cols):
+        raise ValueError(f"workspace shape {ws.shape} != (2, {n * m}, {cols})")
+    from triton_distributed_tpu.language.core import smem_spec
+
+    kernel = functools.partial(_ag_parity_kernel, n, axis, m, straggler)
+    out, ws_new = kernel_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((n * m, cols), x_local.dtype),
+            jax.ShapeDtypeStruct(ws.shape, ws.dtype),
+        ),
+        in_specs=[smem_spec((1,)), any_spec(), any_spec()],
+        out_specs=(any_spec(), any_spec()),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        input_output_aliases={2: 1},
+    )(jnp.asarray(call_index, jnp.int32).reshape(1), x_local, ws)
+    return out, ws_new, call_index + 1
+
+
 def all_gather(x: jax.Array, ctx: DistContext | None = None, axis: str = "tp",
                method: AllGatherMethod | str = AllGatherMethod.AUTO,
                stacked: bool = False) -> jax.Array:

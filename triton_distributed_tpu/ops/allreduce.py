@@ -78,7 +78,10 @@ def _ar_one_shot_kernel(n: int, axis: str, m: int, tile_m: int,
     local.wait()
     shmem.quiet(*handles)
     shmem.wait_deliveries(x_ref, recv_sem, n - 1)
+    _reduce_slots(n, m, tile_m, ws, out_ref, va, vacc, copy_sem)
 
+
+def _reduce_slots(n, m, tile_m, ws, out_ref, va, vacc, copy_sem):
     for t in range(m // tile_m):
         rows = pl.ds(t * tile_m, tile_m)
         vacc[...] = jnp.zeros_like(vacc)
@@ -91,11 +94,64 @@ def _ar_one_shot_kernel(n: int, axis: str, m: int, tile_m: int,
         pltpu.make_async_copy(va, out_ref.at[rows], copy_sem).wait()
 
 
+def _ar_one_shot_parity_kernel(n: int, axis: str, m: int, tile_m: int,
+                               straggler,
+                               idx_ref, x_ref, _ws_in, out_ref, ws,
+                               va, vacc, send_sems, recv_sems, copy_sem):
+    """Barrier-free one-shot AR for repeated decode-step calls.
+
+    Reference: the ``call_count`` parity double-buffering of
+    ``low_latency_all_to_all.py:125-175`` — two PERSISTENT workspace slot
+    sets and two recv semaphores, flipped by the caller-supplied call
+    index, replace the full-mesh entry barrier (VERDICT r2 #6: two extra
+    sync phases per transformer layer on the decode path).
+
+    The workspace is caller-owned and threaded through the decode loop
+    (input aliased to output) — persistence is what makes barrier-freedom
+    sound: a per-call transient buffer could be remotely written before the
+    peer's kernel (hence allocation) even exists, which is exactly what the
+    barrier variant's entry barrier protects against.
+
+    Safety (per parity p): for a rank to write parity-p slots of call t+2,
+    it must have finished call t+1, which required every peer's call-t+1
+    delivery, which each peer sends only after fully reducing its call-t
+    (parity-p) workspace — reuse is ordered by the DMA-completion chain
+    itself. Per-parity recv semaphores keep a fast peer's t+1 deliveries
+    from being miscounted against call t's wait.
+    """
+    me = dl.rank(axis)
+    p = jax.lax.rem(idx_ref[0], 2)
+    if straggler is not None and straggler[0] == "rotate":
+        # Rotating straggler: rank (call_index mod n) spins — the stress
+        # harness's worst case for parity reuse (a different rank lags every
+        # call, so every interleaving of slow-read vs next-write occurs).
+        straggler = (jax.lax.rem(idx_ref[0], n), straggler[1])
+    dl.maybe_straggle(straggler, me)
+    slots = ws.at[p]                          # (n, m, cols) parity slab
+    local = pltpu.make_async_copy(x_ref, slots.at[me], copy_sem)
+    local.start()
+    handles = []
+    for i in range(n - 1):
+        peer = jax.lax.rem(me + 1 + i, n)
+        handles.append(
+            shmem.putmem_nbi_block(x_ref, slots.at[me], send_sems.at[i],
+                                   recv_sems.at[p], peer, axis)
+        )
+    local.wait()
+    shmem.quiet(*handles)
+    shmem.wait_deliveries(x_ref, recv_sems.at[p], n - 1)
+    _reduce_slots(n, m, tile_m, slots, out_ref, va, vacc, copy_sem)
+
+
 def all_reduce_local(x_local: jax.Array, axis: str = "tp",
                      num_ranks: int | None = None,
                      method: AllReduceMethod | str = AllReduceMethod.AUTO) -> jax.Array:
     """Device-local AllReduce inside an existing shard_map region.
-    ``x_local``: (m, cols) per device → (m, cols) = Σ_d x_d."""
+    ``x_local``: (m, cols) per device → (m, cols) = Σ_d x_d.
+
+    For repeated steady-state calls (decode loops) see
+    :func:`all_reduce_stream` — the barrier-free parity path.
+    """
     method = AllReduceMethod(method) if not isinstance(method, AllReduceMethod) else method
     if num_ranks is None:
         raise ValueError("num_ranks required inside shard_map")
@@ -133,6 +189,69 @@ def all_reduce_local(x_local: jax.Array, axis: str = "tp",
         ],
         uses_barrier=True,
     )(x_local)
+
+
+# ---------------------------------------------------------------------------
+# Barrier-free steady-state AR (decode path). VERDICT r2 #6.
+# ---------------------------------------------------------------------------
+
+def ar_stream_workspace(n: int, m: int, cols: int, dtype
+                        ) -> tuple[jax.Array, jax.Array]:
+    """Device-local persistent (workspace, call_index) pair for
+    :func:`all_reduce_stream`. Allocate ONCE and thread through the decode
+    loop (at the host level: a (n_dev,)-sharded leading dim, see
+    models/engine.py). Both parities start clean."""
+    return (jnp.zeros((2, n, m, cols), dtype), jnp.zeros((), jnp.int32))
+
+
+def all_reduce_stream(x_local: jax.Array, ws: jax.Array,
+                      call_index: jax.Array, *, axis: str = "tp",
+                      num_ranks: int | None = None,
+                      straggler: tuple | None = None,
+                      force_kernel: bool = False):
+    """Barrier-free one-shot AllReduce over a persistent parity workspace.
+
+    x_local: (m, cols); ws: (2, n, m, cols) from :func:`ar_stream_workspace`
+    threaded through the loop (donated/aliased); call_index: traced int32,
+    incremented once per call, SAME sequence on every rank (SPMD program
+    order guarantees this). Returns (sum (m, cols), ws', call_index + 1).
+    Zero full-mesh barriers in steady state — the reference's call_count
+    parity protocol (low_latency_all_to_all.py:125-175) applied to AR.
+    """
+    if num_ranks is None:
+        raise ValueError("num_ranks required inside shard_map")
+    n = num_ranks
+    if n == 1 and not force_kernel:
+        # force_kernel: single-chip Mosaic compile check (scripts/
+        # check_on_chip.py) — the degenerate kernel (0 peers) still
+        # exercises the parity slicing + semaphore paths.
+        return x_local, ws, call_index + 1
+    m, cols = x_local.shape
+    if ws.shape != (2, n, m, cols):
+        raise ValueError(f"workspace shape {ws.shape} != (2, {n}, {m}, {cols})")
+    from triton_distributed_tpu.language.core import smem_spec
+
+    tile_m = pick_tile(m, 512, sublane_align(x_local.dtype))
+    kernel = functools.partial(_ar_one_shot_parity_kernel, n, axis, m,
+                               tile_m, straggler)
+    out, ws_new = kernel_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((m, cols), x_local.dtype),
+            jax.ShapeDtypeStruct(ws.shape, ws.dtype),
+        ),
+        in_specs=[smem_spec((1,)), any_spec(), any_spec()],
+        out_specs=(any_spec(), any_spec()),
+        scratch_shapes=[
+            pltpu.VMEM((tile_m, cols), x_local.dtype),
+            pltpu.VMEM((tile_m, cols), jnp.float32),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        input_output_aliases={2: 1},   # ws input -> ws output (persistent)
+    )(jnp.asarray(call_index, jnp.int32).reshape(1), x_local, ws)
+    return out, ws_new, call_index + 1
 
 
 def all_reduce(x: jax.Array, ctx: DistContext | None = None, axis: str = "tp",

@@ -236,9 +236,9 @@ def flash_supported(q, k) -> bool:
     sk = k.shape[1]
     if q.shape[-1] != k.shape[-1] or hq % k.shape[2]:
         return False
-    tq = pick_tile(sq, 256, 128)
-    tk = pick_tile(sk, 512, max(sublane_align(q.dtype),
-                                sublane_align(k.dtype)))
+    tq = pick_tile(sq, 512, 128)
+    tk = pick_tile(sk, 1024, max(sublane_align(q.dtype),
+                                 sublane_align(k.dtype)))
     # Working set: q/k/v tiles (double-buffered) + acc/stat scratch + s tile.
     est = (2 * (tq * d + 2 * tk * d) * q.dtype.itemsize
            + (tq * d + 2 * tq * 128 + tq * tk) * 4)
@@ -247,7 +247,7 @@ def flash_supported(q, k) -> bool:
 
 def flash_attention_partial(q, k, v, *, q_offset=0, k_offset=0,
                             causal: bool = True,
-                            tile_q: int = 256, tile_k: int = 512):
+                            tile_q: int = 512, tile_k: int = 1024):
     """Blockwise flash attention returning UNnormalized partials.
 
     q: (B, Sq, hq, d); k/v: (B, Sk, hkv, d). Positions are global:
@@ -267,7 +267,7 @@ def flash_attention_partial(q, k, v, *, q_offset=0, k_offset=0,
 
 
 def flash_attention(q, k, v, *, q_offset=0, k_offset=0, causal: bool = True,
-                    tile_q: int = 256, tile_k: int = 512):
+                    tile_q: int = 512, tile_k: int = 1024):
     """Normalized flash attention: (B, Sq, hq, d) out in q.dtype — the
     drop-in for dense SDPA on prefill shapes (layers/tp_attn.py,
     ops/ulysses.py)."""

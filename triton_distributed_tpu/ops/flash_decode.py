@@ -108,13 +108,18 @@ def combine_partials(accs, ms, ls):
 def flash_decode_local(q: jax.Array, k_shard: jax.Array, v_shard: jax.Array,
                        kv_len: jax.Array, *, axis: str = "tp",
                        num_ranks: int | None = None,
-                       method: str = "pallas") -> jax.Array:
+                       method: str = "pallas", ag_state=None):
     """Device-local distributed flash-decode inside shard_map.
 
     q: (B, hq, d) replicated; k_shard/v_shard: (B, S/n, hkv, d) — this
     rank's sequence shard; kv_len: valid rows in THIS shard (int32 scalar,
     may differ per rank). Returns (B, hq, d) fully-combined attention,
     replicated.
+
+    ``ag_state``: (ws, call_index) from ops/allgather.ag_stream_workspace
+    (shape (2, n·B·hq, d+2)) — the decode loop's barrier-free parity AG for
+    the partials exchange (the reference's staged low-latency AG layer,
+    sp_flash_decode_layer.py). When given, returns (out, ag_state').
     """
     if num_ranks is None:
         raise ValueError("num_ranks required inside shard_map")
@@ -122,13 +127,22 @@ def flash_decode_local(q: jax.Array, k_shard: jax.Array, v_shard: jax.Array,
     b, hq, d = q.shape
     acc, m, l = _partial_decode_attn(q, k_shard, v_shard, kv_len)
     if n == 1:
-        return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+        out = (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+        return (out, ag_state) if ag_state is not None else out
 
     # Pack partials into one flat fp32 payload: (B·hq, d+2) → AG → combine.
     payload = jnp.concatenate(
         [acc.reshape(b * hq, d), m.reshape(b * hq, 1), l.reshape(b * hq, 1)],
         axis=1)
-    if method == "pallas":
+    if ag_state is not None:
+        from triton_distributed_tpu.ops.allgather import all_gather_stream
+
+        ws, idx = ag_state
+        gathered, ws, idx = all_gather_stream(payload, ws, idx, axis=axis,
+                                              num_ranks=n)
+        gathered = gathered.reshape(n, b * hq, d + 2)
+        ag_state = (ws, idx)
+    elif method == "pallas":
         gathered = all_gather_local(payload, axis=axis, num_ranks=n,
                                     method=AllGatherMethod.FULL_MESH_PUSH)
         gathered = gathered.reshape(n, b * hq, d + 2)
@@ -139,7 +153,8 @@ def flash_decode_local(q: jax.Array, k_shard: jax.Array, v_shard: jax.Array,
     accs = gathered[..., :d].reshape(n, b, hq, d)
     ms = gathered[..., d].reshape(n, b, hq)
     ls = gathered[..., d + 1].reshape(n, b, hq)
-    return combine_partials(accs, ms, ls).astype(q.dtype)
+    out = combine_partials(accs, ms, ls).astype(q.dtype)
+    return (out, ag_state) if ag_state is not None else out
 
 
 def flash_decode(q: jax.Array, k: jax.Array, v: jax.Array,

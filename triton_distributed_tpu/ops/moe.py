@@ -100,7 +100,7 @@ def moe_reduce_rs_local(y_sorted: jax.Array, sort_idx: jax.Array,
                         group_sizes: jax.Array, w_down: jax.Array,
                         topk_weights: jax.Array, num_tokens: int, *,
                         axis: str = "tp", num_ranks: int | None = None,
-                        mode: str = "overlap"):
+                        mode: str = "overlap", ar_fn=None):
     """Device-local MoE down-proj + topk-combine + ReduceScatter.
 
     y_sorted: (M·topk, ffn_local) expert-sorted activations; w_down:
@@ -130,6 +130,8 @@ def moe_reduce_rs_local(y_sorted: jax.Array, sort_idx: jax.Array,
         return jax.lax.psum_scatter(combined, axis, scatter_dimension=0,
                                     tiled=True)
     if mode == "ar":
+        if ar_fn is not None:
+            return ar_fn(combined)
         from triton_distributed_tpu.ops.allreduce import all_reduce_local
 
         return all_reduce_local(combined, axis=axis, num_ranks=n)
@@ -138,20 +140,31 @@ def moe_reduce_rs_local(y_sorted: jax.Array, sort_idx: jax.Array,
     raise ValueError(f"unknown MoE mode {mode!r}")
 
 
+def route_and_sort(x: jax.Array, gate_w: jax.Array, topk: int):
+    """THE routing convention, in one place: fp32 router logits → top-k →
+    softmax over the selected experts (Qwen-MoE; hf_loader rejects
+    norm_topk_prob=False because of exactly this) → expert-stable sort.
+
+    Returns (x_sorted, sort_idx, group_sizes, token_of_flat, topk_weights).
+    """
+    E = gate_w.shape[1]
+    logits = x.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+    topk_logits, topk_ids = jax.lax.top_k(logits, topk)
+    topk_weights = jax.nn.softmax(topk_logits, axis=-1)
+    sort_idx, group_sizes = sort_by_expert(topk_ids.reshape(-1), E)
+    token_of_flat = sort_idx // topk
+    return x[token_of_flat], sort_idx, group_sizes, token_of_flat, \
+        topk_weights
+
+
 def _chunk_moe(xc: jax.Array, gate_w: jax.Array, w_gate: jax.Array,
                w_up: jax.Array, w_down: jax.Array, topk: int):
     """Full expert-MLP partial for one token chunk: router → top-k → sort →
     gate/up grouped GEMM → SwiGLU → weighted down-proj → per-token combine.
     xc: (mc, h). Returns (mc, h) — partial over this rank's ffn shard."""
-    E = gate_w.shape[1]
     mc = xc.shape[0]
-    logits = xc.astype(jnp.float32) @ gate_w.astype(jnp.float32)
-    topk_logits, topk_ids = jax.lax.top_k(logits, topk)
-    topk_weights = jax.nn.softmax(topk_logits, axis=-1)
-    flat_ids = topk_ids.reshape(-1)
-    sort_idx, group_sizes = sort_by_expert(flat_ids, E)
-    token_of_flat = sort_idx // topk
-    x_sorted = xc[token_of_flat]
+    x_sorted, sort_idx, group_sizes, token_of_flat, topk_weights = \
+        route_and_sort(xc, gate_w, topk)
     act = grouped_mlp_gate_up(x_sorted, group_sizes, w_gate, w_up)
     part = jax.lax.ragged_dot(act, w_down, group_sizes)
     part = part * topk_weights.reshape(-1)[sort_idx][:, None]
@@ -205,7 +218,8 @@ def moe_ring_fwd_local(x_local: jax.Array, gate_w: jax.Array,
 def moe_tp_fwd_local(x_local: jax.Array, gate_w: jax.Array,
                      w_gate: jax.Array, w_up: jax.Array, w_down: jax.Array,
                      topk: int, *, axis: str = "tp",
-                     num_ranks: int | None = None, mode: str = "ring"):
+                     num_ranks: int | None = None, mode: str = "ring",
+                     ar_fn=None):
     """Full TP-MoE forward: router → AG+GroupGEMM (gate/up) → SwiGLU →
     MoE+RS (down) — the composition the reference's TP_MoE layer runs
     (layers/nvidia/tp_moe.py).
@@ -233,22 +247,13 @@ def moe_tp_fwd_local(x_local: jax.Array, gate_w: jax.Array,
     else:
         raise ValueError(f"unknown MoE mode {mode!r}")
     M = x_full.shape[0]
-
-    # Router (fp32 softmax over selected experts, Qwen-MoE convention).
-    logits = (x_full.astype(jnp.float32) @ gate_w.astype(jnp.float32))
-    topk_logits, topk_ids = jax.lax.top_k(logits, topk)       # (M, topk)
-    topk_weights = jax.nn.softmax(topk_logits, axis=-1)
-
-    flat_ids = topk_ids.reshape(-1)
-    sort_idx, group_sizes = sort_by_expert(flat_ids, E)
-    token_of_flat = sort_idx // topk
-    x_sorted = x_full[token_of_flat]
-
+    x_sorted, sort_idx, group_sizes, _, topk_weights = route_and_sort(
+        x_full, gate_w, topk)
     act = grouped_mlp_gate_up(x_sorted, group_sizes, w_gate, w_up)
     return moe_reduce_rs_local(
         act, sort_idx, group_sizes, w_down,
         topk_weights.astype(x_local.dtype), M, axis=axis, num_ranks=n,
-        mode="overlap" if mode == "ring" else mode)
+        mode="overlap" if mode == "ring" else mode, ar_fn=ar_fn)
 
 
 def grouped_mlp_gate_up(x_sorted, group_sizes, w_gate, w_up):

@@ -81,7 +81,7 @@ def measure(fn: Callable, args: Sequence[Any], *, warmup: int = 1,
 
 
 def measure_chain(fn: Callable, args: Sequence[Any], *,
-                  lengths: tuple[int, int] = (2, 10),
+                  lengths: tuple[int, int] = (16, 256),
                   trials: int = 3) -> float:
     """Per-call time of ``fn(*args)`` via an on-device dependent chain.
 
@@ -161,10 +161,18 @@ def contextual_autotune(
         return candidates[_memory_cache[cache_key]], None
     if use_disk_cache:
         disk = _load_disk_cache()
-        idx = disk.get(cache_key)
-        if isinstance(idx, int) and 0 <= idx < len(candidates):
-            _memory_cache[cache_key] = idx
-            return candidates[idx], None
+        entry = disk.get(cache_key)
+        # Entries carry the winning config's repr so a cache written against
+        # an older candidate space can never silently select the wrong one.
+        if isinstance(entry, dict):
+            idx = entry.get("index")
+            if (isinstance(idx, int) and 0 <= idx < len(candidates)
+                    and repr(candidates[idx]) == entry.get("config")):
+                _memory_cache[cache_key] = idx
+                return candidates[idx], None
+        elif isinstance(entry, int) and 0 <= entry < len(candidates):
+            # legacy bare-index entry: ignore (candidate order may differ)
+            pass
 
     timings: list = []
     for cfg in candidates:
@@ -188,7 +196,8 @@ def contextual_autotune(
     _memory_cache[cache_key] = best_index
     if use_disk_cache:
         disk = _load_disk_cache()
-        disk[cache_key] = best_index
+        disk[cache_key] = {"index": best_index,
+                           "config": repr(candidates[best_index])}
         _store_disk_cache(disk)
     return candidates[best_index], TuneReport(
         best_index=best_index, best_time_s=best_time, timings=tuple(timings))
@@ -201,11 +210,13 @@ def gemm_tile_candidates(m: int, k: int, ncols: int, itemsize: int,
     (the analog of the reference's pruned config lists +
     gemm_perf_model.py's resource check)."""
     cands = []
-    for tm in (128, 256, 512, 1024):
-        for tn in (256, 512, 1024):
+    for tm in (128, 256, 512, 1024, 2048):
+        for tn in (256, 512, 1024, 1280, 2560):
             for tk in (256, 512, 1024):
                 if tm > m or tn > ncols or tk > k:
                     continue
+                if m % tm or ncols % tn or k % tk:
+                    continue   # pick_tile would shrink them anyway
                 # double-buffered a/b + out + fp32 acc
                 vmem = (2 * (tm * tk + tk * tn) + 2 * tm * tn) * itemsize \
                     + tm * tn * 4
@@ -261,8 +272,14 @@ def tuned_matmul_tiles(m: int, k: int, ncols: int, dtype) -> tuple | None:
         return lambda x, w: pallas_matmul(x, w, tile_m=tm, tile_n=tn,
                                           tile_k=tk)
 
-    best, _ = contextual_autotune("pallas_matmul", key, list(cands), build,
-                                  (a, bb))
+    try:
+        best, _ = contextual_autotune("pallas_matmul", key, list(cands),
+                                      build, (a, bb))
+    except RuntimeError:
+        # Every candidate failed to measure (chip too noisy / compile
+        # trouble) — fall back to the static default rather than failing
+        # the op's default path.
+        return None
     return best
 
 

@@ -265,7 +265,12 @@ def rank_gemm_tiles(candidates, m: int, n: int, k: int, itemsize: int,
                        + n_m * (m * k / n_m) * n_n * itemsize
                        + m * n * itemsize)
         t_memory = bytes_moved / (spec.hbm_gbps * 1e9)
-        return max(t_compute, t_memory)
+        # SUM, not max: with max, every config whose traffic fits under the
+        # compute roof ties at t_compute and the ranking degenerates to
+        # list order (round-3 finding — the tuner then measured only tiny
+        # tiles). The sum keeps the compute term while still ordering
+        # same-compute configs by their real traffic difference.
+        return t_compute + t_memory
 
     ranked = sorted(candidates, key=score)
     return ranked[:top] if top else ranked
