@@ -21,6 +21,14 @@ This version HARD-FAILS instead of clamping:
   - raises if timings are non-monotone in chain length;
   - raises if the implied TFLOP/s exceeds any real TPU's peak (elision);
   - raises if the two independent differentials disagree wildly (noise).
+
+Round-3 finding: per-iteration time is NON-linear in chain length on this
+chip — short calls run at boost clocks, sustained calls throttle (measured
+0.27 ms/iter over 8→64 iters vs 0.63 ms/iter over 64→128 in one window).
+The differential over (8, 128) therefore reports ~sustained throughput;
+single-burst measurements can read up to ~1.8x higher. Both candidates are
+measured identically (interleaved, min over two separated passes), so the
+RATIO is the meaningful number; absolute TFLOP/s is sustained-clock.
 """
 
 import functools
@@ -180,8 +188,17 @@ def _measure_and_report():
     pallas_fn = jax.jit(functools.partial(_chain, pallas_dot), static_argnums=2)
 
     flops = 2.0 * M * K * K
+    # Two separated passes, elementwise min: contention on the shared chip
+    # comes in bursts longer than one interleaved round, so a single pass
+    # can be entirely inside a bad window.
     times_xla, times_pallas = _timed_interleaved(
-        [xla_fn, pallas_fn], a, b, lengths, trials=6 if on_tpu else 1)
+        [xla_fn, pallas_fn], a, b, lengths, trials=3 if on_tpu else 1)
+    if on_tpu:
+        time.sleep(3)
+        t2_xla, t2_pallas = _timed_interleaved(
+            [xla_fn, pallas_fn], a, b, lengths, trials=3)
+        times_xla = [min(x, y) for x, y in zip(times_xla, t2_xla)]
+        times_pallas = [min(x, y) for x, y in zip(times_pallas, t2_pallas)]
     t_xla = _per_iter_seconds(times_xla, lengths, flops, strict=strict)
     t_pallas = _per_iter_seconds(times_pallas, lengths, flops, strict=strict)
 
@@ -247,9 +264,12 @@ def _decode_step_metric(gen=(3, 10)):
     n1, n2 = gen
     timed(n1), timed(n2)
     best = {n: float("inf") for n in gen}
-    for _ in range(3):
-        for n in gen:
-            best[n] = min(best[n], timed(n))
+    for burst in range(2):        # two separated bursts beat long
+        for _ in range(3):        # contention windows (min estimator)
+            for n in gen:
+                best[n] = min(best[n], timed(n))
+        if burst == 0:
+            time.sleep(3)
     ms = (best[n2] - best[n1]) / (n2 - n1) * 1e3
     if ms <= 0:
         raise BenchError("non-positive decode differential")

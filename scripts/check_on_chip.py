@@ -159,6 +159,29 @@ def main() -> int:
 
     check("fast_all_to_all_stream (parity)", a2a_stream)
 
+    from triton_distributed_tpu.ops.allgather import (
+        ag_stream_workspace, all_gather_stream,
+    )
+
+    def ag_stream():
+        xloc = jnp.asarray(rng.standard_normal((1, 64, 256)), jnp.float32)
+
+        def run(x):
+            ws, idx = ag_stream_workspace(1, 64, 256, x.dtype)
+            out, ws, idx = all_gather_stream(x[0], ws, idx, num_ranks=1,
+                                             force_kernel=True)
+            out2, ws, idx = all_gather_stream(out[:64], ws, idx,
+                                              num_ranks=1,
+                                              force_kernel=True)
+            return out2[None]
+
+        out = shard_map_on(ctx, run, _P("tp"), _P("tp"))(xloc)
+        np.testing.assert_allclose(np.asarray(out)[0], np.asarray(xloc)[0],
+                                   rtol=1e-6)
+        return out
+
+    check("all_gather_stream (parity)", ag_stream)
+
     # Paged-KV attention (page-table scalar prefetch + per-page DMA).
     from triton_distributed_tpu.ops import (
         init_paged_kv_cache, paged_append, paged_decode_attention,

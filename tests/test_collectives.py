@@ -164,3 +164,49 @@ def test_ag_stream_parity_repeated_calls(ctx):
     err, idx = fn(jnp.asarray(base))
     assert float(np.max(np.asarray(err))) < 1e-4, float(np.max(np.asarray(err)))
     assert int(np.asarray(idx)[0]) == steps
+
+
+def test_decode_layers_sp_flash_and_gemm_ar(ctx):
+    """Decode comm layers (reference SpGQAFlashDecodeAttention /
+    GemmARLayer): stream-stateful wrappers match the stateless goldens
+    across repeated steps."""
+    from jax.sharding import PartitionSpec as P
+
+    from triton_distributed_tpu.layers.decode_layers import (
+        GemmARLayer, SpFlashDecodeAttention,
+    )
+    from triton_distributed_tpu.ops.flash_decode import flash_decode_local
+    from triton_distributed_tpu.runtime import shard_map_on
+
+    n, b, hq, hkv, d, s_shard = 8, 2, 4, 2, 64, 32
+    m, kloc, cols = 8, 16, 128
+    rng = np.random.default_rng(3)
+    q = rng.standard_normal((b, hq, d)).astype(np.float32)
+    k = rng.standard_normal((n, b, s_shard, hkv, d)).astype(np.float32)
+    v = rng.standard_normal((n, b, s_shard, hkv, d)).astype(np.float32)
+    x = rng.standard_normal((n, m, kloc)).astype(np.float32)
+    w = rng.standard_normal((n, kloc, cols)).astype(np.float32)
+
+    def run(ql, kl, vl, xl, wl):
+        kl, vl, xl, wl = kl[0], vl[0], xl[0], wl[0]
+        attn = SpFlashDecodeAttention(num_ranks=n)
+        st = attn.init_state(b, hq, d)
+        proj = GemmARLayer(num_ranks=n)
+        pst = proj.init_state(m, cols)
+        for _ in range(2):
+            o1, st = attn(ql, kl, vl, jnp.int32(s_shard), st)
+            y1, pst = proj(xl, wl, pst)
+        ref_o = flash_decode_local(ql, kl, vl, jnp.int32(s_shard),
+                                   num_ranks=n, method="xla")
+        ref_y = jax.lax.psum(xl @ wl, "tp")
+        return o1, y1, ref_o, ref_y
+
+    fn = shard_map_on(ctx, run, (P(), P("tp"), P("tp"), P("tp"), P("tp")),
+                      (P(), P(), P(), P()))
+    o1, y1, ref_o, ref_y = fn(jnp.asarray(q), jnp.asarray(k),
+                              jnp.asarray(v), jnp.asarray(x),
+                              jnp.asarray(w))
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(ref_o),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(ref_y),
+                               rtol=1e-4, atol=1e-4)

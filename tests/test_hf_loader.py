@@ -122,3 +122,18 @@ def test_auto_llm_from_config(ctx):
     eng = AutoLLM.from_config(tiny_config(), ctx=ctx, max_seq=16)
     out = eng.serve(jnp.asarray([[1, 2, 3, 4]], jnp.int32), gen_len=2)
     assert out.shape == (1, 2)
+
+
+def test_norm_topk_prob_false_rejected():
+    """Mixtral-style routing (no top-k renormalization) must refuse loudly
+    instead of converting with wrong router weights (ADVICE r2)."""
+    import pytest
+
+    with pytest.raises(ValueError, match="norm_topk_prob"):
+        config_from_hf({
+            "model_type": "qwen3_moe", "hidden_size": 64,
+            "intermediate_size": 128, "num_hidden_layers": 1,
+            "num_attention_heads": 4, "num_key_value_heads": 4,
+            "head_dim": 16, "vocab_size": 64, "num_experts": 4,
+            "num_experts_per_tok": 2, "moe_intermediate_size": 32,
+            "norm_topk_prob": False})

@@ -12,6 +12,10 @@ from triton_distributed_tpu.layers.tp_mlp import (  # noqa: F401
     tp_mlp_fwd,
     pick_mode,
 )
+from triton_distributed_tpu.layers.decode_layers import (  # noqa: F401
+    GemmARLayer,
+    SpFlashDecodeAttention,
+)
 from triton_distributed_tpu.layers.tp_attn import (  # noqa: F401
     KVSlice,
     init_tp_attn,

@@ -1,0 +1,94 @@
+"""Decode comm layers — stateful wrappers for the per-step collectives.
+
+Reference: ``layers/nvidia/sp_flash_decode_layer.py:44``
+(``SpGQAFlashDecodeAttention`` — staged symmetric AG buffers + dynamic
+buffer shrink around the distributed flash-decode kernels) and
+``layers/nvidia/gemm_ar_layer.py``-style ``GemmARLayer`` (fused GEMM +
+AllReduce for the row-parallel decode projection). SURVEY.md §2.6 "Decode
+comm layers".
+
+TPU shape: the reference's staged symmetric buffers become the persistent
+parity workspaces of the ``*_stream`` collectives (ops/allgather.py,
+ops/allreduce.py) — the layer owns the (workspace, call_index) state and
+threads it across steps, so steady-state decode pays zero full-mesh
+barriers. State is functional: each call returns the layer's next state
+(idiomatic jax; keep it in your loop carry), with a mutable convenience
+wrapper for python-loop serving.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.ops.allgather import ag_stream_workspace
+from triton_distributed_tpu.ops.allreduce import (
+    AllReduceMethod,
+    all_reduce_local,
+    all_reduce_stream,
+    ar_stream_workspace,
+)
+from triton_distributed_tpu.ops.flash_decode import flash_decode_local
+
+
+class SpFlashDecodeAttention:
+    """SP/CP decode attention over a sequence-sharded KV cache.
+
+    Reference ``SpGQAFlashDecodeAttention`` (sp_flash_decode_layer.py:44):
+    each rank attends its KV shard (Pallas split-KV chunk walk), the tiny
+    (acc, lse) partials ride the barrier-free parity AllGather, and the
+    combine is the inter-rank LSE merge. Device-local: call inside
+    shard_map; state threads through the decode loop.
+    """
+
+    def __init__(self, *, axis: str = "tp", num_ranks: int):
+        self.axis = axis
+        self.n = num_ranks
+
+    def init_state(self, batch: int, hq: int, d: int, dtype=jnp.float32):
+        """Persistent parity-AG workspace for the (B·hq, d+2) partials."""
+        return ag_stream_workspace(self.n, batch * hq, d + 2, dtype)
+
+    def __call__(self, q: jax.Array, k_shard: jax.Array, v_shard: jax.Array,
+                 kv_len: jax.Array, state):
+        """q: (B, hq, d) replicated; k/v_shard: (B, S/n, hkv, d); kv_len:
+        valid rows in this shard. Returns (out (B, hq, d), state')."""
+        out, state = flash_decode_local(
+            q, k_shard, v_shard, kv_len, axis=self.axis, num_ranks=self.n,
+            ag_state=state)
+        return out, state
+
+
+class GemmARLayer:
+    """Row-parallel projection + fused AllReduce for decode steps.
+
+    Reference ``GemmARLayer`` / the ``triton_dist_gemm_ar`` mode
+    (models/dense.py:84-99): y = x @ W followed by the fused AR. With a
+    state (from :meth:`init_state`) the AR is the barrier-free parity
+    stream; without, the one-shot barrier variant.
+    """
+
+    def __init__(self, *, axis: str = "tp", num_ranks: int,
+                 method: AllReduceMethod | str = AllReduceMethod.AUTO):
+        self.axis = axis
+        self.n = num_ranks
+        self.method = method
+
+    def init_state(self, m: int, cols: int, dtype=jnp.float32):
+        return ar_stream_workspace(self.n, m, cols, dtype)
+
+    def __call__(self, x: jax.Array, w: jax.Array, state=None):
+        """x: (m, k_local); w: (k_local, cols). Returns the reduced
+        (m, cols) — and (out, state') when a stream state is given."""
+        partial = jnp.dot(x, w, preferred_element_type=jnp.float32
+                          ).astype(x.dtype)
+        if self.n == 1:
+            return (partial, state) if state is not None else partial
+        if state is not None:
+            ws, idx = state
+            out, ws, idx = all_reduce_stream(partial, ws, idx,
+                                             axis=self.axis,
+                                             num_ranks=self.n)
+            return out, (ws, idx)
+        return all_reduce_local(partial, axis=self.axis, num_ranks=self.n,
+                                method=self.method)

@@ -103,6 +103,12 @@ def advance_queue_pos(base_queue, pos: int, num_exec: int | None = None):
     """
     from triton_distributed_tpu.megakernel.tasks import TaskType
 
+    # base_queue may be a CompiledMegaKernel (preferred — carries the
+    # executable/data row split) or a raw queue array.
+    if hasattr(base_queue, "queue"):
+        if num_exec is None:
+            num_exec = base_queue.num_exec
+        base_queue = base_queue.queue
     q = np.asarray(base_queue).copy()
     attn = ((q[:, 0] == int(TaskType.ATTN_DECODE))
             | (q[:, 0] == int(TaskType.ATTN_DECODE_PAGED)))
@@ -110,6 +116,13 @@ def advance_queue_pos(base_queue, pos: int, num_exec: int | None = None):
         # Rows beyond the executable prefix are page-table DATA — their
         # words must never be interpreted as task fields.
         attn[num_exec:] = False
+    elif np.any(q[:, 0] == int(TaskType.ATTN_DECODE_PAGED)):
+        # Paged programs append raw tile-id DATA rows after the tasks; a
+        # row starting with 8/9 would match the mask and get corrupted.
+        raise ValueError(
+            "queue contains ATTN_DECODE_PAGED tasks: pass the "
+            "CompiledMegaKernel (or num_exec=) so page-table DATA rows "
+            "are not misread as tasks")
     need = -(-pos // TILE)
     if np.any(q[attn, 4] < need):
         raise ValueError(

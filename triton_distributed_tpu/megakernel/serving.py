@@ -22,7 +22,6 @@ serving glue targets the one-chip case the benchmark ladder measures.
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp

@@ -274,6 +274,10 @@ def fast_all_to_all_stream(send_buf: jax.Array, send_splits: jax.Array,
     if ws.shape != (2, n, cap, hidden):
         raise ValueError(f"workspace shape {ws.shape} != (2, {n}, {cap}, "
                          f"{hidden})")
+    if ws.dtype != send_buf.dtype:
+        raise ValueError(f"workspace dtype {ws.dtype} != payload "
+                         f"{send_buf.dtype} — allocate a2a_stream_workspace "
+                         "with the token dtype")
 
     recv_splits = jax.lax.all_to_all(send_splits, axis, split_axis=0,
                                      concat_axis=0, tiled=True)

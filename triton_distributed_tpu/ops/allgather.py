@@ -222,6 +222,10 @@ def all_gather_stream(x_local: jax.Array, ws: jax.Array,
     m, cols = x_local.shape
     if ws.shape != (2, n * m, cols):
         raise ValueError(f"workspace shape {ws.shape} != (2, {n * m}, {cols})")
+    if ws.dtype != x_local.dtype:
+        raise ValueError(f"workspace dtype {ws.dtype} != input "
+                         f"{x_local.dtype} — allocate ag_stream_workspace "
+                         "with the payload dtype")
     from triton_distributed_tpu.language.core import smem_spec
 
     kernel = functools.partial(_ag_parity_kernel, n, axis, m, straggler)

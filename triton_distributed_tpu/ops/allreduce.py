@@ -229,6 +229,10 @@ def all_reduce_stream(x_local: jax.Array, ws: jax.Array,
     m, cols = x_local.shape
     if ws.shape != (2, n, m, cols):
         raise ValueError(f"workspace shape {ws.shape} != (2, {n}, {m}, {cols})")
+    if ws.dtype != x_local.dtype:
+        raise ValueError(f"workspace dtype {ws.dtype} != input "
+                         f"{x_local.dtype} — allocate ar_stream_workspace "
+                         "with the activation dtype")
     from triton_distributed_tpu.language.core import smem_spec
 
     tile_m = pick_tile(m, 512, sublane_align(x_local.dtype))

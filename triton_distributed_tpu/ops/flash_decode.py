@@ -123,6 +123,11 @@ def flash_decode_local(q: jax.Array, k_shard: jax.Array, v_shard: jax.Array,
     """
     if num_ranks is None:
         raise ValueError("num_ranks required inside shard_map")
+    if ag_state is not None and method != "pallas":
+        raise ValueError(
+            f"method={method!r} with ag_state: the stream AG would shadow "
+            "the requested path — a golden comparison would compare the "
+            "stream against itself. Pass one or the other.")
     n = num_ranks
     b, hq, d = q.shape
     acc, m, l = _partial_decode_attn(q, k_shard, v_shard, kv_len)
