@@ -45,9 +45,11 @@ class SpFlashDecodeAttention:
         self.axis = axis
         self.n = num_ranks
 
-    def init_state(self, batch: int, hq: int, d: int, dtype=jnp.float32):
-        """Persistent parity-AG workspace for the (B·hq, d+2) partials."""
-        return ag_stream_workspace(self.n, batch * hq, d + 2, dtype)
+    def init_state(self, batch: int, hq: int, d: int):
+        """Persistent parity-AG workspace for the (B·hq, d+2) partials.
+        Always fp32: the partials payload (acc, m, l) is fp32 regardless of
+        the model dtype (flash_decode_local packs in fp32)."""
+        return ag_stream_workspace(self.n, batch * hq, d + 2, jnp.float32)
 
     def __call__(self, q: jax.Array, k_shard: jax.Array, v_shard: jax.Array,
                  kv_len: jax.Array, state):

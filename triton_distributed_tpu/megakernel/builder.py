@@ -246,6 +246,56 @@ class MegaKernelBuilder:
                  c0=c0, d0=d0),
             reads, [out.tile(0, 0)])
 
+    def attn_decode_gqa(self, out: TensorHandle, out_j: int,
+                        q: TensorHandle, q_j: int, g: int,
+                        kT: TensorHandle, v: TensorHandle, valid_len: int,
+                        scale: float, k_new: TensorHandle | None = None,
+                        v_new: TensorHandle | None = None):
+        """One-token decode for a WHOLE GQA group: the ``g`` q-heads at
+        column tiles ``q_j..q_j+g-1`` of ``q`` (outputs at
+        ``out_j..out_j+g-1`` of ``out``) attend the shared kv head's
+        kT/v — KV streams once for the group instead of once per head.
+        """
+        if not 1 <= g <= 127:
+            raise ValueError(f"group size {g} out of range")
+        if q_j + g > q.ct or out_j + g > out.ct:
+            raise ValueError(
+                f"group [{q_j}, {q_j + g}) exceeds q.ct={q.ct} or "
+                f"out.ct={out.ct} — the tiles would alias the next tensor")
+        if q.rt != 1 or out.rt != 1:
+            raise ValueError("q/out must be single-row-tile activations")
+        if not 0 < scale < 16:
+            raise ValueError(f"scale {scale} out of the 24-bit arg field")
+        if kT.rt != 1 or v.ct != 1 or kT.ct != v.rt:
+            raise ValueError("kT must be (TILE, S), v (S, TILE)")
+        if (k_new is None) != (v_new is None):
+            raise ValueError("pass both k_new and v_new or neither")
+        if k_new is None and valid_len < 1:
+            raise ValueError("cache-only attention needs valid_len >= 1")
+        if valid_len > kT.ct * TILE:
+            raise ValueError(f"valid_len {valid_len} exceeds cache "
+                             f"capacity {kT.ct * TILE}")
+        k_tiles = min(kT.ct, -(-valid_len // TILE))
+        q_tiles = [q.tile(0, q_j + h) for h in range(g)]
+        out_tiles = [out.tile(0, out_j + h) for h in range(g)]
+        reads = (q_tiles + [kT.tile(0, j) for j in range(k_tiles)]
+                 + [v.tile(j, 0) for j in range(k_tiles)])
+        c0 = d0 = -1
+        if k_new is not None:
+            if (k_new.rt != 1 or k_new.ct != 1 or v_new.rt != 1
+                    or v_new.ct != 1):
+                raise ValueError("k_new/v_new must be single (TILE, TILE) "
+                                 "tiles (one kv head's current k/v)")
+            c0, d0 = k_new.tile(0, 0), v_new.tile(0, 0)
+            reads += [c0, d0]
+        self._max_gqa = max(getattr(self, "_max_gqa", 1), g)
+        self._emit(
+            Task(TaskType.ATTN_DECODE_GQA, out_tiles[0], a0=q_tiles[0],
+                 b0=kT.tile(0, 0), k_tiles=k_tiles, a_stride=v.tile(0, 0),
+                 b_stride=int(valid_len),
+                 arg=int(round(scale * 1e6)) | (g << 24), c0=c0, d0=d0),
+            reads, out_tiles)
+
     def attn_decode_paged(self, out: TensorHandle, q: TensorHandle,
                           pages: list[tuple[int, int]], valid_len: int,
                           scale: float, k_new: TensorHandle | None = None,
@@ -324,7 +374,8 @@ class MegaKernelBuilder:
                                   num_tiles=self._num_tiles,
                                   num_ranks=num_ranks, axis=axis,
                                   dtype=jnp.dtype(dtype),
-                                  num_exec=n_exec)
+                                  num_exec=n_exec,
+                                  max_gqa=getattr(self, "_max_gqa", 1))
 
 
 @dataclasses.dataclass
@@ -337,6 +388,7 @@ class CompiledMegaKernel:
     axis: str
     dtype: jnp.dtype = jnp.dtype(jnp.float32)  # bf16 halves tile DMA bytes
     num_exec: int | None = None   # dispatched rows (rest = page-table data)
+    max_gqa: int = 1              # largest GQA group (sizes VMEM scratch)
 
     def scatter_input(self, ws: jax.Array, h: TensorHandle,
                       value: jax.Array) -> jax.Array:
@@ -367,7 +419,7 @@ class CompiledMegaKernel:
         Device-local: wrap in shard_map when num_ranks > 1."""
         return run_queue(self.queue if queue is None else queue, ws,
                          num_ranks=self.num_ranks, axis=self.axis,
-                         num_tasks=self.num_exec)
+                         num_tasks=self.num_exec, max_gqa=self.max_gqa)
 
     def run(self, inputs: dict, outputs: list[TensorHandle],
             _device_local: bool = True):

@@ -34,8 +34,9 @@ PIPE_DEPTH = 4  # outstanding tile-pair loads per task stream
 from triton_distributed_tpu.runtime.context import use_interpret
 
 
-def _mega_kernel(n: int, axis: str, n_tasks: int,
+def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
                  queue_ref, ws_in, ws_out, slots, va2, vb2, vacc, vq, vstat,
+                 vqg, vaccg, vstatg,
                  copy_sem, pipe_sems, send_sems, recv_sem):
     wdt = ws_out.dtype   # workspace dtype (fp32 or bf16); compute is fp32
     step = pl.program_id(0)
@@ -318,13 +319,89 @@ def _mega_kernel(n: int, axis: str, n_tasks: int,
         # Reference: tasks/flash_attn.py (paged FA decode task).
         _attn_softmax(lambda j: b0 + j, lambda j: a_stride + j)
 
+    def t_attn_decode_gqa():
+        # A whole GQA group in one task: g q-heads (tiles a0..a0+g-1) share
+        # the kv head's KT/V stream — tiles stream ONCE for the group and
+        # g-1 dispatches vanish. Per-head state lives in the group scratch
+        # (vqg/vaccg/vstatg: stats col 0 = m, col 1 = l); statically
+        # unrolled over max_gqa with h < g masking.
+        g = arg >> 24
+        scale = (arg & 0xFFFFFF).astype(jnp.float32) * 1e-6
+        valid = b_stride
+        neg = jnp.float32(-1e30)
+        for h in range(max_gqa):
+            @pl.when(h < g)
+            def _(h=h):
+                load(a0 + h, vqg.at[h])
+                vaccg[h, :, :] = jnp.zeros_like(vaccg[h])
+                vstatg[h, :, 0:1] = jnp.full((TILE, 1), neg, jnp.float32)
+                vstatg[h, :, 1:2] = jnp.zeros((TILE, 1), jnp.float32)
+
+        def body(j, kt_ref, v_ref, _):
+            col = j * TILE + jax.lax.broadcasted_iota(
+                jnp.int32, (TILE, TILE), 1)
+            for h in range(max_gqa):
+                @pl.when(h < g)
+                def _(h=h):
+                    s = jnp.dot(vqg[h], kt_ref[...],
+                                preferred_element_type=jnp.float32) * scale
+                    s = jnp.where(col < valid, s, neg)
+                    m_prev = vstatg[h, :, 0:1]
+                    m_new = jnp.maximum(m_prev,
+                                        jnp.max(s, axis=1, keepdims=True))
+                    p = jnp.exp(s - m_new)
+                    corr = jnp.exp(m_prev - m_new)
+                    pv = jnp.dot(p.astype(v_ref.dtype), v_ref[...],
+                                 preferred_element_type=jnp.float32)
+                    vaccg[h, :, :] = vaccg[h] * corr + pv
+                    vstatg[h, :, 0:1] = m_new
+                    vstatg[h, :, 1:2] = (vstatg[h, :, 1:2] * corr
+                                         + jnp.sum(p, axis=1, keepdims=True))
+            return 0
+
+        pipelined_pairs(lambda j: b0 + j, lambda j: a_stride + j,
+                        k_tiles, body, 0)
+
+        @pl.when(c0 >= 0)
+        def _():
+            load(c0, vb)                           # k_new: (B, d)
+            for h in range(max_gqa):
+                @pl.when(h < g)
+                def _(h=h):
+                    s_cur = jnp.sum(vqg[h].astype(jnp.float32)
+                                    * vb[...].astype(jnp.float32),
+                                    axis=1, keepdims=True) * scale
+                    m_prev = vstatg[h, :, 0:1]
+                    m_new = jnp.maximum(m_prev, s_cur)
+                    p_cur = jnp.exp(s_cur - m_new)
+                    corr = jnp.exp(m_prev - m_new)
+                    vstatg[h, :, 0:1] = m_new
+                    # stash p_cur in stats col 2 for the v_new pass
+                    vstatg[h, :, 2:3] = p_cur
+                    vstatg[h, :, 1:2] = vstatg[h, :, 1:2] * corr + p_cur
+                    vaccg[h, :, :] = vaccg[h] * corr
+            load(d0, vb)                           # v_new: (B, d)
+            for h in range(max_gqa):
+                @pl.when(h < g)
+                def _(h=h):
+                    vaccg[h, :, :] = (vaccg[h] + vstatg[h, :, 2:3]
+                                * vb[...].astype(jnp.float32))
+
+        for h in range(max_gqa):
+            @pl.when(h < g)
+            def _(h=h):
+                va[...] = (vaccg[h] / jnp.maximum(vstatg[h, :, 1:2], 1e-30)
+                           ).astype(wdt)
+                store(va, out + h)
+
     jax.lax.switch(w(0), [t_copy, t_add, t_silu_mul, t_gemm, t_allreduce,
                           t_scale, t_rms_norm, t_rope, t_attn_decode,
-                          t_attn_decode_paged, t_prefetch])
+                          t_attn_decode_paged, t_prefetch,
+                          t_attn_decode_gqa])
 
 
 def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
-              num_tasks: int | None = None):
+              num_tasks: int | None = None, max_gqa: int = 1):
     """Execute the packed task queue over the workspace in ONE pallas_call.
 
     queue: (n_rows, WORDS) int32; workspace: (T, TILE, TILE) fp32 or bf16
@@ -332,6 +409,8 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
     halves every tile DMA; compute stays fp32 on the VPU/MXU.
     ``num_tasks``: dispatched rows (default all) — rows beyond are DATA
     (ATTN_DECODE_PAGED page tables) the grid never visits.
+    ``max_gqa``: largest ATTN_DECODE_GQA group in the queue (sizes the
+    per-head group scratch; 1 when unused).
     Returns the post-execution workspace.
     """
     n_tasks = num_tasks if num_tasks is not None else queue.shape[0]
@@ -339,6 +418,7 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
     n = num_ranks
     T = workspace.shape[0]
     wdt = workspace.dtype
+    G = max(max_gqa, 1)
 
     # AR slots ride as a second output: Mosaic has no HBM scratch (see
     # language/core.py kernel_call ``workspaces``).
@@ -353,13 +433,16 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
             pltpu.VMEM((TILE, TILE), jnp.float32),      # vacc (fp32 accum)
             pltpu.VMEM((TILE, TILE), wdt),              # vq: rope/attn operand
             pltpu.VMEM((TILE, 128), jnp.float32),       # vstat (softmax stats)
+            pltpu.VMEM((G, TILE, TILE), wdt),           # vqg (group q tiles)
+            pltpu.VMEM((G, TILE, TILE), jnp.float32),   # vaccg
+            pltpu.VMEM((G, TILE, 128), jnp.float32),    # vstatg
             pltpu.SemaphoreType.DMA(()),               # copy_sem
             pltpu.SemaphoreType.DMA((2 * PIPE_DEPTH + 1,)),  # pipe (+pf sem)
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
             pltpu.SemaphoreType.DMA(()),
         ],
     )
-    kernel = functools.partial(_mega_kernel, n, axis, n_tasks)
+    kernel = functools.partial(_mega_kernel, n, axis, n_tasks, G)
     interpret = use_interpret()
     if interpret:
         from triton_distributed_tpu.runtime.interpret_workarounds import (

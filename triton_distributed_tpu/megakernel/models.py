@@ -111,7 +111,8 @@ def advance_queue_pos(base_queue, pos: int, num_exec: int | None = None):
         base_queue = base_queue.queue
     q = np.asarray(base_queue).copy()
     attn = ((q[:, 0] == int(TaskType.ATTN_DECODE))
-            | (q[:, 0] == int(TaskType.ATTN_DECODE_PAGED)))
+            | (q[:, 0] == int(TaskType.ATTN_DECODE_PAGED))
+            | (q[:, 0] == int(TaskType.ATTN_DECODE_GQA)))
     if num_exec is not None:
         # Rows beyond the executable prefix are page-table DATA — their
         # words must never be interpreted as task fields.
@@ -174,11 +175,12 @@ def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
         mb.rope(_col(h.k_new, j), _col(h.k_new, j), cos, sin)
 
     attn = mb.tensor(TILE, hq_local * d)
-    for j in range(hq_local):
-        kv = j // groups
-        mb.attn_decode(_col(attn, j), _col(q, j), h.kT[kv], h.v[kv],
-                       valid_len=pos, scale=scale,
-                       k_new=_col(h.k_new, kv), v_new=_col(h.v_new, kv))
+    # One task per KV head: the whole GQA group's q-heads share the KV
+    # stream (tiles fetched once per group, not once per head).
+    for kv in range(hkv_local):
+        mb.attn_decode_gqa(attn, kv * groups, q, kv * groups, groups,
+                           h.kT[kv], h.v[kv], valid_len=pos, scale=scale,
+                           k_new=_col(h.k_new, kv), v_new=_col(h.v_new, kv))
 
     o = mb.tensor(TILE, hidden)
     mb.gemm(o, attn, h.wo, prefetch_first=True)

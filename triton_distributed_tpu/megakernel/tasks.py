@@ -41,6 +41,13 @@ class TaskType(enum.IntEnum):
     #                 the new token's (B, d) k/v join the softmax rowwise,
     #                 so the cache is appended AFTER the step (no in-kernel
     #                 tile mutation needed)
+    ATTN_DECODE_GQA = 11  # ATTN_DECODE for a whole GQA group: g q-heads
+    #                 sharing ONE kv head computed in one task — KV tiles
+    #                 stream ONCE for the group (vs once per head) and g-1
+    #                 task dispatches disappear. q tiles a0..a0+g-1 and out
+    #                 tiles out..out+g-1 are contiguous (the model's head
+    #                 layout groups q-heads by kv head). g rides the high
+    #                 bits of arg: arg = round(scale*1e6) | (g << 24).
     PREFETCH = 10   # fire-and-forget DMA warm: start copying tile a0 into
     #                 the reserved pipeline slot (vb2[PIPE_DEPTH]); the next
     #                 GEMM emitted with prefetch_first=True (queue word

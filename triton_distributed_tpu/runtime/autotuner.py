@@ -259,7 +259,11 @@ def tuned_matmul_tiles(m: int, k: int, ncols: int, dtype) -> tuple | None:
     base = gemm_tile_candidates(m, k, ncols, itemsize)
     # Key includes the candidate-space fingerprint: a cached winner from an
     # older space must not suppress measurement of newly added configs.
-    space_tag = hash(tuple(base)) & 0xFFFFFFFF
+    # crc32 of the repr, not hash(): stable across interpreter versions so
+    # the persistent cache survives upgrades.
+    import zlib
+
+    space_tag = zlib.crc32(repr(base).encode())
     key = (m, k, ncols, str(jnp.dtype(dtype)), chip, space_tag)
     # Top-4 by the perf model: each candidate costs two chain compiles
     # (~30s each through the remote-compile relay), so the measured set is
