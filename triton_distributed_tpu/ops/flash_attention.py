@@ -38,9 +38,37 @@ from triton_distributed_tpu.ops.tiling import pick_tile, sublane_align
 from triton_distributed_tpu.runtime.context import use_interpret
 
 _NEG = -1e30
-# VMEM budget for one (q-tile, k-tile) working set; beyond it we fall back to
-# the dense path (tiny/odd shapes where tiling buys nothing).
+# VMEM budget for one (q-tile, k-tile) working set; beyond it the tile caps
+# degrade (and only shapes no cap can fit fall back to the dense path).
 _VMEM_BUDGET = 8 * 1024 * 1024
+# Default tile caps (single source of truth — the predicate, the dispatcher
+# and the public entry points must agree). 1024x1024 measured 33% faster
+# than 512x1024 at S=32k on-chip; smaller caps are tried automatically when
+# the working-set estimate exceeds the budget (e.g. fp32 payloads).
+DEFAULT_TILE_Q = 1024
+DEFAULT_TILE_K = 1024
+
+
+def _tile_estimate(tq: int, tk: int, d: int, itemsize: int) -> int:
+    """Working set: q/k/v tiles (double-buffered) + acc/stat scratch +
+    the fp32 (tq, tk) logits tile."""
+    return (2 * (tq * d + 2 * tk * d) * itemsize
+            + (tq * d + 2 * tq * 128 + tq * tk) * 4)
+
+
+def _fit_tiles(sq: int, sk: int, d: int, q_dtype, k_dtype,
+               tile_q: int, tile_k: int):
+    """(tq, tk) within the VMEM budget, degrading the q-tile cap (then the
+    k-tile cap) before giving up; None if nothing fits (dense fallback)."""
+    itemsize = max(jnp.dtype(q_dtype).itemsize, jnp.dtype(k_dtype).itemsize)
+    k_align = max(sublane_align(q_dtype), sublane_align(k_dtype))
+    for tk_cap in (tile_k, 512, 256):
+        tk = pick_tile(sk, tk_cap, k_align)
+        for tq_cap in (tile_q, 512, 256, 128):
+            tq = pick_tile(sq, tq_cap, 128)
+            if _tile_estimate(tq, tk, d, itemsize) <= _VMEM_BUDGET:
+                return tq, tk
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -170,11 +198,16 @@ def _flash_call(q4, k4, v4, q_offset, k_offset, *, causal: bool,
     b, hq, sq, d = q4.shape
     hkv, sk = k4.shape[1], k4.shape[2]
     g = hq // hkv
-    # tq doubles as the stats blocks' LANE dim: must be 128-divisible (or the
-    # full Sq). pick_tile with align=128 yields exactly that (fallback = dim).
-    tq = pick_tile(sq, tile_q, 128)
-    tk = pick_tile(sk, tile_k, max(sublane_align(q4.dtype),
-                                   sublane_align(k4.dtype)))
+    # tq doubles as the stats blocks' LANE dim: must be 128-divisible (or
+    # the full Sq) — _fit_tiles/pick_tile(align=128) guarantee it, and the
+    # caps degrade until the working set fits VMEM (same policy as
+    # flash_supported, so a dispatched shape always fits).
+    fitted = _fit_tiles(sq, sk, d, q4.dtype, k4.dtype, tile_q, tile_k)
+    if fitted is None:
+        raise ValueError(
+            f"no tile configuration fits VMEM for Sq={sq} Sk={sk} d={d} — "
+            "guard calls with flash_supported()")
+    tq, tk = fitted
     nq, nk = sq // tq, sk // tk
     scale = d ** -0.5
 
@@ -230,24 +263,21 @@ def _flash_call(q4, k4, v4, q_offset, k_offset, *, causal: bool,
 
 
 def flash_supported(q, k) -> bool:
-    """Whether the tiled kernel handles these shapes within VMEM budget
-    (falls back to the dense path otherwise — tiny/odd shapes)."""
+    """Whether the tiled kernel handles these shapes within VMEM budget at
+    SOME tile configuration (tile caps degrade before giving up; only
+    shapes where even the smallest caps blow the budget — e.g. a prime S
+    forcing whole-dimension tiles — fall back to the dense path)."""
     b, sq, hq, d = q.shape
     sk = k.shape[1]
     if q.shape[-1] != k.shape[-1] or hq % k.shape[2]:
         return False
-    tq = pick_tile(sq, 512, 128)
-    tk = pick_tile(sk, 1024, max(sublane_align(q.dtype),
-                                 sublane_align(k.dtype)))
-    # Working set: q/k/v tiles (double-buffered) + acc/stat scratch + s tile.
-    est = (2 * (tq * d + 2 * tk * d) * q.dtype.itemsize
-           + (tq * d + 2 * tq * 128 + tq * tk) * 4)
-    return est <= _VMEM_BUDGET
+    return _fit_tiles(sq, sk, d, q.dtype, k.dtype,
+                      DEFAULT_TILE_Q, DEFAULT_TILE_K) is not None
 
 
 def flash_attention_partial(q, k, v, *, q_offset=0, k_offset=0,
                             causal: bool = True,
-                            tile_q: int = 512, tile_k: int = 1024):
+                            tile_q: int = DEFAULT_TILE_Q, tile_k: int = DEFAULT_TILE_K):
     """Blockwise flash attention returning UNnormalized partials.
 
     q: (B, Sq, hq, d); k/v: (B, Sk, hkv, d). Positions are global:
@@ -267,7 +297,7 @@ def flash_attention_partial(q, k, v, *, q_offset=0, k_offset=0,
 
 
 def flash_attention(q, k, v, *, q_offset=0, k_offset=0, causal: bool = True,
-                    tile_q: int = 512, tile_k: int = 1024):
+                    tile_q: int = DEFAULT_TILE_Q, tile_k: int = DEFAULT_TILE_K):
     """Normalized flash attention: (B, Sq, hq, d) out in q.dtype — the
     drop-in for dense SDPA on prefill shapes (layers/tp_attn.py,
     ops/ulysses.py)."""
