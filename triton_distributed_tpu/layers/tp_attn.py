@@ -186,6 +186,53 @@ def _out_proj(attn: jax.Array, params: dict, *, axis: str, n: int,
     raise ValueError(f"decode supports modes 'ar'/'xla_rep', got {mode!r}")
 
 
+def tp_attn_prefill_chunk(params: dict, cfg: ModelConfig, x: jax.Array,
+                          kv_slice: KVSlice, start: jax.Array,
+                          chunk_len: int, *, axis: str = "tp",
+                          num_ranks: int = 1, mode: str = "ar"):
+    """Chunked-prefill attention: the chunk's queries (positions
+    [start, start+chunk_len)) attend the cached prefix — the flash kernel's
+    positional causality (q_offset=start, TRACED) makes this one call, so
+    long prompts prefill in bounded activation memory AND the chunk loop
+    can be a ``fori_loop`` (one compiled body, not an O(S/chunk) unroll).
+
+    The attention runs over the FULL cache capacity: positions beyond the
+    written prefix are masked by causality (kpos > qpos) and their tiles
+    SKIP compute in-kernel — the cost of the traced-offset design is only
+    the masked tiles' K/V DMA (zeros/stale finite values, never read into
+    the softmax).
+
+    x: (B*chunk_len, h) replicated (ar modes — the bounded-memory
+    use-case); kv_slice: the layer's full-capacity cache. Returns
+    (out, kv_slice with the chunk's k/v written at [start, start+chunk)).
+    """
+    from triton_distributed_tpu.ops.flash_attention import (
+        shard_attention_partial,
+    )
+
+    n = num_ranks
+    batch = x.shape[0] // chunk_len
+    q, k, v = _project_qkv(params, cfg, x, batch, chunk_len,
+                           axis=axis, n=n, mode="ar")
+    pos = start + jnp.arange(chunk_len)
+    cos, sin = rope_cos_sin(pos, cfg.head_dim, cfg.rope_theta)
+    q = apply_rope(q, cos[None], sin[None])
+    k = apply_rope(k, cos[None], sin[None])
+
+    new_kv = KVSlice(
+        k=jax.lax.dynamic_update_slice(
+            kv_slice.k, k.astype(kv_slice.k.dtype), (0, start, 0, 0)),
+        v=jax.lax.dynamic_update_slice(
+            kv_slice.v, v.astype(kv_slice.v.dtype), (0, start, 0, 0)),
+    )
+    acc, m, l = shard_attention_partial(
+        q, new_kv.k.astype(q.dtype), new_kv.v.astype(q.dtype),
+        q_offset=start, k_offset=0, causal=True)
+    attn = (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+    attn = attn.reshape(batch * chunk_len, -1)
+    return _out_proj(attn, params, axis=axis, n=n, mode=mode), new_kv
+
+
 def tp_attn_decode_paged(params: dict, cfg: ModelConfig, x: jax.Array,
                          cache, *, axis: str = "tp", num_ranks: int = 1,
                          mode: str = "ar", ar_fn=None):

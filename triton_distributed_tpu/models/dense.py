@@ -154,6 +154,59 @@ def dense_prefill(params: dict, cfg: ModelConfig, input_ids: jax.Array,
     return logits, cache._replace(offset=jnp.int32(seq))
 
 
+def dense_prefill_chunked(params: dict, cfg: ModelConfig,
+                          input_ids: jax.Array, cache: KVCache, *,
+                          chunk: int, axis: str = "tp", num_ranks: int = 1,
+                          mode: str = "ar"):
+    """Bounded-memory causal prefill: the prompt is processed ``chunk``
+    tokens at a time, each chunk's queries attending the whole cached
+    prefix through the flash kernel's positional causality
+    (layers/tp_attn.tp_attn_prefill_chunk). Peak activation memory is
+    O(chunk·hidden) per layer instead of O(S·hidden) — the long-prompt
+    serving shape (beyond the reference, which prefills whole prompts).
+
+    input_ids: (B, S) replicated, S % chunk == 0. Activations replicated
+    (ar modes — the bounded-memory use-case). Returns (last-token logits,
+    cache filled for [0, S)).
+    """
+    from triton_distributed_tpu.layers.tp_attn import tp_attn_prefill_chunk
+
+    n = num_ranks
+    batch, seq = input_ids.shape
+    if seq % chunk:
+        raise ValueError(f"prompt length {seq} not a multiple of "
+                         f"chunk {chunk} (pad the prompt)")
+    attn_mode = mode if mode in ("ar", "xla_rep") else "ar"
+
+    # fori_loop over chunks: ONE compiled chunk body regardless of prompt
+    # length (the flash kernel takes the chunk start as a TRACED offset;
+    # tiles beyond the causal frontier skip compute in-kernel), so compile
+    # time does not grow with S/chunk.
+    def body(c, carry):
+        cache, _ = carry
+        start = c * chunk
+        ids = jax.lax.dynamic_slice_in_dim(input_ids, start, chunk, axis=1)
+        x = params["embed"][ids.reshape(-1)]          # (B·chunk, h)
+        for i, layer in enumerate(params["layers"]):
+            h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+            attn_out, kv = tp_attn_prefill_chunk(
+                layer["attn"], cfg, h, cache.layer(i), start, chunk,
+                axis=axis, num_ranks=n, mode=attn_mode)
+            cache = cache.with_layer(i, kv)
+            x = x + attn_out
+            h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+            x = x + _mlp_or_moe(layer, cfg, h, axis=axis, n=n,
+                                mode=attn_mode)
+        return cache, x
+
+    x0 = jnp.zeros((batch * chunk, cfg.hidden_size),
+                   params["embed"].dtype)
+    cache, x_last = jax.lax.fori_loop(0, seq // chunk, body, (cache, x0))
+    last = x_last.reshape(batch, chunk, -1)[:, -1]
+    logits = _logits(params, cfg, last, axis=axis, n=n)
+    return logits, cache._replace(offset=jnp.int32(seq))
+
+
 def make_ar_stream_fn(ar_state, *, axis: str, n: int):
     """Build the barrier-free parity AllReduce hook for the decode walk.
 

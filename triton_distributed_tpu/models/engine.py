@@ -222,13 +222,48 @@ class Engine:
                 convert, donate_argnums=0, out_shardings=shardings)
         return self._jit_cache[key](cache)
 
-    def prefill(self, input_ids: jax.Array, cache: KVCache | None = None):
-        """input_ids: (B, S). Returns (last-token logits (B, vocab), cache)."""
+    def prefill(self, input_ids: jax.Array, cache: KVCache | None = None,
+                chunk: int | None = None):
+        """input_ids: (B, S). Returns (last-token logits (B, vocab), cache).
+
+        ``chunk``: bounded-memory chunked prefill — the prompt is processed
+        ``chunk`` tokens at a time with each chunk attending the cached
+        prefix (flash positional causality); peak activation memory drops
+        from O(S) to O(chunk) per layer. Requires S % chunk == 0."""
         batch, seq = input_ids.shape
         if seq > self.max_seq:
             raise ValueError(f"prompt {seq} exceeds max_seq {self.max_seq}")
         cache = cache if cache is not None else self.new_cache(batch)
+        if chunk is not None:
+            if self._prefill_fn is not dense_prefill:
+                raise ValueError(
+                    "chunked prefill is implemented for the dense forward; "
+                    "a custom prefill_fn has no chunked contract")
+            return self._prefill_chunked_jit(batch, seq, chunk)(
+                self.params, input_ids, cache)
         return self._prefill_jit(batch, seq)(self.params, input_ids, cache)
+
+    def _prefill_chunked_jit(self, batch: int, seq: int, chunk: int):
+        from triton_distributed_tpu.models.dense import dense_prefill_chunked
+
+        key = ("prefill_chunked", batch, seq, chunk)
+        if key not in self._jit_cache:
+            cspecs = kv_cache_specs(self.axis)
+            # Replicated-activation mode matching the backend: 'xla' engines
+            # must not silently run Pallas collectives.
+            mode = self._decode_mode()
+
+            def step(params, ids, cache):
+                return dense_prefill_chunked(
+                    params, self.cfg, ids, cache, chunk=chunk,
+                    axis=self.axis, num_ranks=self.n, mode=mode)
+
+            fn = self._shard(
+                step,
+                in_specs=(self.param_specs, P(), cspecs),
+                out_specs=(P(), cspecs))
+            self._jit_cache[key] = jax.jit(fn, donate_argnums=(2,))
+        return self._jit_cache[key]
 
     def decode(self, tokens: jax.Array, cache):
         """tokens: (B,). cache: KVCache (linear) or PagedModelCache when
