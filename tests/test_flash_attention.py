@@ -145,3 +145,27 @@ def test_flash_supported_rejects_vmem_blowup():
     q = jnp.zeros((1, 9973, 4, 128))      # prime S -> tile == S
     k = jnp.zeros((1, 9973, 2, 128))
     assert not flash_supported(q, k)
+
+
+def test_dense_fallback_traced_offsets():
+    """The dense fallback must honor TRACED positional offsets (the chunked
+    prefill contract: a fori_loop chunk body passes traced starts even when
+    the shape routes to the dense path)."""
+    import jax
+
+    b, s, hq, hkv, d = 1, 33, 2, 1, 32   # odd S -> whole-dim tiles, tiny
+    rng = np.random.default_rng(7)
+    q = _rand(rng, (b, s, hq, d))
+    k = _rand(rng, (b, 2 * s, hkv, d))
+    v = _rand(rng, (b, 2 * s, hkv, d))
+
+    @jax.jit
+    def run(q, k, v, off):
+        acc, m, l = shard_attention_partial(q, k, v, q_offset=off,
+                                            k_offset=0, causal=True)
+        return acc / jnp.maximum(l, 1e-30)[..., None]
+
+    out = run(q, k, v, jnp.int32(33))
+    mask = (np.arange(s) + 33)[:, None] >= np.arange(2 * s)[None, :]
+    gold = _dense(np.asarray(q), np.asarray(k), np.asarray(v), mask)
+    np.testing.assert_allclose(np.asarray(out), gold, rtol=2e-4, atol=2e-4)

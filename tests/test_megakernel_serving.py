@@ -59,3 +59,25 @@ def test_megakernel_decoder_validates(ctx1, tiny_model):
                         intermediate_size=256), 128)
     with pytest.raises(ValueError, match="TILE multiple"):
         validate_megakernel_cfg(cfg, 100)
+
+
+def test_megakernel_serve_tp8_matches_ar(ctx):
+    """TP=8 megakernel serving on the CPU mesh: per-rank weight/cache
+    shards feed the workspace, the decode step runs under shard_map with
+    in-kernel AllReduce tasks, and generation is token-identical to the
+    jitted ar backend (the reference's multi-GPU MegaTritonKernel serving
+    shape — previously only exercised at kernel level)."""
+    cfg = ModelConfig(hidden_size=256, intermediate_size=1024, num_layers=1,
+                      num_heads=8, num_kv_heads=8, head_dim=128,
+                      vocab_size=256, qk_norm=True, dtype="float32")
+    params = init_dense_llm(jax.random.PRNGKey(1), cfg)
+    ids = np.array([[7, 101, 33]], np.int32)
+    gen = 4
+
+    eng_ar = Engine(cfg, params, ctx, backend="auto", max_seq=128)
+    out_ar = np.asarray(eng_ar.serve(jnp.asarray(ids), gen_len=gen))
+
+    eng_mk = Engine(cfg, params, ctx, backend="megakernel", max_seq=128)
+    out_mk = np.asarray(eng_mk.serve(jnp.asarray(ids), gen_len=gen))
+
+    np.testing.assert_array_equal(out_ar, out_mk)

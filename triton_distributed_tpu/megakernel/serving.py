@@ -49,9 +49,23 @@ def validate_megakernel_cfg(cfg: ModelConfig, max_seq: int) -> None:
 
 
 def weight_feeds(prog: DecodeStepProgram, cfg: ModelConfig,
-                 params: dict) -> dict:
+                 params: dict, *, rank: int = 0,
+                 num_ranks: int = 1) -> dict:
     """Map a dense param tree (init_dense_llm / hf_loader layout) onto the
-    program's workspace handles. Global view == per-device view at TP=1."""
+    program's workspace handles — ``rank``'s TP shard (column-parallel
+    qkv/gate/up, row-parallel o/down; global view == shard at TP=1)."""
+    n = num_ranks
+    d = cfg.head_dim
+    hq_l = cfg.num_heads // n
+    hkv_l = cfg.num_kv_heads // n
+    ffn_l = cfg.intermediate_size // n
+
+    def cols(w, width):
+        return w[:, rank * width:(rank + 1) * width]
+
+    def rows(w, height):
+        return w[rank * height:(rank + 1) * height]
+
     feeds: dict = {}
     for h, layer in zip(prog.layers, params["layers"]):
         attn = layer["attn"]
@@ -65,68 +79,139 @@ def weight_feeds(prog: DecodeStepProgram, cfg: ModelConfig,
               else np.ones(cfg.head_dim, np.float32))
         feeds[h.q_norm] = broadcast_rows(qn)
         feeds[h.k_norm] = broadcast_rows(kn)
-        feeds[h.wq] = attn["wq"]
-        feeds[h.wk] = attn["wk"]
-        feeds[h.wv] = attn["wv"]
-        feeds[h.wo] = attn["wo"]
+        feeds[h.wq] = cols(attn["wq"], hq_l * d)
+        feeds[h.wk] = cols(attn["wk"], hkv_l * d)
+        feeds[h.wv] = cols(attn["wv"], hkv_l * d)
+        feeds[h.wo] = rows(attn["wo"], hq_l * d)
         mlp = layer["mlp"]
-        feeds[h.w_gate] = mlp["w_gate"]
-        feeds[h.w_up] = mlp["w_up"]
-        feeds[h.w_down] = mlp["w_down"]
+        feeds[h.w_gate] = cols(mlp["w_gate"], ffn_l)
+        feeds[h.w_up] = cols(mlp["w_up"], ffn_l)
+        feeds[h.w_down] = rows(mlp["w_down"], ffn_l)
     return feeds
 
 
-def cache_feeds(prog: DecodeStepProgram, cache) -> dict:
-    """KV cache (models/kv_cache.KVCache, batch 1) → per-head kT/v feeds."""
+def cache_feeds(prog: DecodeStepProgram, cache, *, rank: int = 0,
+                num_ranks: int = 1) -> dict:
+    """KV cache (models/kv_cache.KVCache, batch 1) → ``rank``'s per-head
+    kT/v feeds (kv heads are TP-sharded)."""
     feeds: dict = {}
-    k, v = cache.k, cache.v    # (L, 1, S, hkv, d)
+    k, v = cache.k, cache.v    # (L, 1, S, hkv_global, d)
+    hkv_l = k.shape[3] // num_ranks
     for li, h in enumerate(prog.layers):
         for kv in range(len(h.kT)):
-            feeds[h.kT[kv]] = k[li, 0, :, kv, :].T      # (d, S)
-            feeds[h.v[kv]] = v[li, 0, :, kv, :]         # (S, d)
+            g_kv = rank * hkv_l + kv
+            feeds[h.kT[kv]] = k[li, 0, :, g_kv, :].T      # (d, S)
+            feeds[h.v[kv]] = v[li, 0, :, g_kv, :]         # (S, d)
     return feeds
 
 
 class MegakernelDecoder:
-    """One-chip decode loop over the compiled megakernel.
+    """TP decode loop over the compiled megakernel.
 
-    Build once per (cfg, max_seq); ``start(cache)`` loads a prefilled KV
-    cache into the workspace; ``step`` runs one token (jitted once — the
-    queue is retargeted per position without recompiling,
-    megakernel/models.py advance_queue_pos).
+    Build once per (cfg, max_seq, num_ranks); ``start(cache)`` loads a
+    prefilled KV cache into the (per-rank) workspace; ``step`` runs one
+    token (jitted once — the queue is retargeted per position without
+    recompiling, megakernel/models.py advance_queue_pos). With
+    ``num_ranks > 1`` the step runs under shard_map and the in-kernel
+    AllReduce tasks carry the TP reductions (the reference's multi-GPU
+    MegaTritonKernel serving shape).
     """
 
     def __init__(self, cfg: ModelConfig, params: dict, *, max_seq: int,
-                 dtype=jnp.float32):
+                 dtype=jnp.float32, ctx=None, axis: str = "tp",
+                 num_ranks: int = 1):
         validate_megakernel_cfg(cfg, max_seq)
+        n = num_ranks
+        if cfg.num_heads % n or cfg.num_kv_heads % n or \
+                cfg.intermediate_size % n:
+            raise ValueError(f"heads/ffn not divisible by TP degree {n}")
+        if (cfg.intermediate_size // n) % TILE:
+            raise ValueError("per-rank ffn must stay a TILE multiple")
         self.cfg = cfg
         self.max_seq = max_seq
+        self.n = n
+        self.axis = axis
+        self.ctx = ctx
         self.prog = build_decode_step(
-            hidden=cfg.hidden_size, hq_local=cfg.num_heads,
-            hkv_local=cfg.num_kv_heads, ffn_local=cfg.intermediate_size,
+            hidden=cfg.hidden_size, hq_local=cfg.num_heads // n,
+            hkv_local=cfg.num_kv_heads // n,
+            ffn_local=cfg.intermediate_size // n,
             num_layers=cfg.num_layers, max_seq=max_seq,
-            pos=max_seq - 1, num_ranks=1, eps=cfg.rms_norm_eps)
-        self.comp = self.prog.mb.compile(dtype=dtype)
-        self._weights = weight_feeds(self.prog, cfg, params)
-        self.embed = params["embed"]
-        self.final_norm = params["final_norm"]
-        self.lm_head = params.get("lm_head")
-        # Donate the workspace: it is ALL the weights + KV — without
-        # donation every token would pay a whole-workspace device copy.
-        self._step_jit = jax.jit(self._step, donate_argnums=(0,))
+            pos=max_seq - 1, num_ranks=n, eps=cfg.rms_norm_eps)
+        self.comp = self.prog.mb.compile(num_ranks=n, axis=axis,
+                                         dtype=dtype)
+        # Weight feeds computed ONCE (per rank) — start() merges only the
+        # cache feeds, so repeated serve() calls never re-slice the model.
+        self._weight_feeds = [
+            weight_feeds(self.prog, cfg, params, rank=r, num_ranks=n)
+            for r in range(n)
+        ]
+        # embed / final_norm / lm_head replicated once up front: passing
+        # the Engine's vocab-sharded lm_head through a replicated shard_map
+        # spec would insert a full all-gather into every decode step.
+        def replicated(x):
+            if x is None or n == 1:
+                return x
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            return jax.device_put(jnp.asarray(x),
+                                  NamedSharding(ctx.mesh, P()))
+
+        self.embed = replicated(params["embed"])
+        self.final_norm = replicated(params["final_norm"])
+        self.lm_head = replicated(params.get("lm_head"))
+        if n == 1:
+            # Donate the workspace: it is ALL the weights + KV — without
+            # donation every token would pay a whole-workspace device copy.
+            self._step_jit = jax.jit(self._step, donate_argnums=(0,))
+        else:
+            from jax.sharding import PartitionSpec as P
+
+            mesh = ctx.mesh
+
+            def sharded(ws, embed, final_norm, lm_head, queue, cos, sin,
+                        token, pos):
+                ws, tok = self._step(ws[0], embed, final_norm, lm_head,
+                                     queue, cos, sin, token, pos)
+                return ws[None], tok
+
+            fn = jax.shard_map(
+                sharded, mesh=mesh,
+                in_specs=(P(axis), P(), P(), P(), P(), P(), P(), P(), P()),
+                out_specs=(P(axis), P()), check_vma=False)
+            self._step_jit = jax.jit(fn, donate_argnums=(0,))
 
     # -- workspace ----------------------------------------------------------
     def start(self, cache) -> jax.Array:
-        """Workspace with weights + the prefilled KV cache loaded."""
+        """Workspace(s) with weights + the prefilled KV cache loaded:
+        (T, TILE, TILE) at TP=1, (n, T, TILE, TILE) sharded over the axis
+        otherwise."""
         if cache.k.shape[1] != 1:
             raise ValueError("megakernel decode is batch-1 "
                              f"(cache batch {cache.k.shape[1]})")
         if cache.max_seq != self.max_seq:
             raise ValueError(f"cache max_seq {cache.max_seq} != decoder "
                              f"max_seq {self.max_seq}")
-        feeds = dict(self._weights)
-        feeds.update(cache_feeds(self.prog, cache))
-        return self.comp.make_workspace(feeds)
+        if self.n == 1:
+            feeds = dict(self._weight_feeds[0])
+            feeds.update(cache_feeds(self.prog, cache))
+            return self.comp.make_workspace(feeds)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        # Build each rank's workspace ON its device (no n-times stack spike
+        # on device 0 — the workspace is the whole model + KV).
+        mesh = self.ctx.mesh
+        devices = list(mesh.devices.flat)
+        shards = []
+        for r in range(self.n):
+            feeds = dict(self._weight_feeds[r])
+            feeds.update(cache_feeds(self.prog, cache, rank=r,
+                                     num_ranks=self.n))
+            ws_r = self.comp.make_workspace(feeds)
+            shards.append(jax.device_put(ws_r[None], devices[r]))
+        shape = (self.n,) + shards[0].shape[1:]
+        return jax.make_array_from_single_device_arrays(
+            shape, NamedSharding(mesh, P(self.axis)), shards)
 
     # -- one token ----------------------------------------------------------
     def _append_kv(self, ws: jax.Array, pos: jax.Array) -> jax.Array:

@@ -176,7 +176,12 @@ def dense_prefill_chunked(params: dict, cfg: ModelConfig,
     if seq % chunk:
         raise ValueError(f"prompt length {seq} not a multiple of "
                          f"chunk {chunk} (pad the prompt)")
-    attn_mode = mode if mode in ("ar", "xla_rep") else "ar"
+    if mode not in ("ar", "xla_rep"):
+        raise ValueError(
+            f"chunked prefill runs replicated activations: mode must be "
+            f"'ar' or 'xla_rep', got {mode!r} (silently substituting a "
+            "different collective stack would break the backend contract)")
+    attn_mode = mode
 
     # fori_loop over chunks: ONE compiled chunk body regardless of prompt
     # length (the flash kernel takes the chunk start as a TRACED offset;

@@ -317,17 +317,14 @@ class Engine:
         from triton_distributed_tpu.megakernel.serving import MegakernelDecoder
         from triton_distributed_tpu.runtime.utils import group_profile
 
-        if self.n != 1:
-            raise ValueError(
-                "backend='megakernel' serves the one-chip view (the "
-                "multi-rank kernel path is exercised at kernel level, "
-                "tests/test_megakernel_decode.py::test_decode_step_tp8)")
         if self.page_size is not None:
             raise ValueError("megakernel backend uses its own workspace "
                              "cache, not the paged cache")
         if getattr(self, "_mk", None) is None:
             self._mk = MegakernelDecoder(self.cfg, self.params,
-                                         max_seq=self.max_seq)
+                                         max_seq=self.max_seq,
+                                         ctx=self.ctx, axis=self.axis,
+                                         num_ranks=self.n)
         pos = int(cache.offset)
         if pos + gen_len - 1 > self.max_seq:
             raise ValueError(
