@@ -251,3 +251,47 @@ def test_decode_step_tp8(ctx):
     ref = _golden_layer(x[:B], W, pos, kT_all, v_all, HQ, HKV)
     for r in range(n):
         np.testing.assert_allclose(got[r][:B], ref, rtol=5e-3, atol=5e-3)
+
+
+def test_paged_decode_step_matches_linear():
+    """build_decode_step(paged=True): attention walks page-table DATA rows
+    over the kT/v pools; with identity tables it equals the linear decode
+    step exactly (the reference megakernel's PagedKVCache assembly)."""
+    hidden, hq, hkv, ffn, S, pos = 256, 2, 1, 256, 256, 100
+    rng = np.random.default_rng(5)
+    feed_vals = {}
+
+    def build(paged):
+        prog = build_decode_step(hidden=hidden, hq_local=hq, hkv_local=hkv,
+                                 ffn_local=ffn, num_layers=1, max_seq=S,
+                                 pos=pos, num_ranks=1, paged=paged)
+        comp = prog.mb.compile()
+        h = prog.layers[0]
+        cos, sin = rope_tables(pos, TILE, 1e6)
+        if not feed_vals:   # generate once, reuse for both variants
+            feed_vals["x"] = rng.standard_normal((TILE, hidden)) * 0.3
+            feed_vals["w"] = {
+                n: rng.standard_normal(s) * 0.05 for n, s in [
+                    ("wq", (hidden, hq * TILE)), ("wk", (hidden, hkv * TILE)),
+                    ("wv", (hidden, hkv * TILE)), ("wo", (hq * TILE, hidden)),
+                    ("w_gate", (hidden, ffn)), ("w_up", (hidden, ffn)),
+                    ("w_down", (ffn, hidden))]
+            }
+            feed_vals["kT"] = rng.standard_normal((TILE, S)) * 0.3
+            feed_vals["v"] = rng.standard_normal((S, TILE)) * 0.3
+        ones_h = broadcast_rows(np.ones(hidden, np.float32))
+        ones_d = broadcast_rows(np.ones(TILE, np.float32))
+        feeds = {prog.x: feed_vals["x"], prog.cos: cos, prog.sin: sin,
+                 h.attn_norm: ones_h, h.mlp_norm: ones_h,
+                 h.q_norm: ones_d, h.k_norm: ones_d,
+                 h.kT[0]: feed_vals["kT"], h.v[0]: feed_vals["v"]}
+        for name, val in feed_vals["w"].items():
+            feeds[getattr(h, name)] = val
+        feeds = {k_: jnp.asarray(np.asarray(v_, np.float32))
+                 for k_, v_ in feeds.items()}
+        (out,) = comp.run(feeds, outputs=[prog.x_out])
+        return np.asarray(out)
+
+    linear = build(paged=False)
+    paged = build(paged=True)
+    np.testing.assert_allclose(paged, linear, rtol=1e-5, atol=1e-5)

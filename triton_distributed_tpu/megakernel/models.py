@@ -143,7 +143,7 @@ def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
                        h: DecodeLayerHandles, cos: TensorHandle,
                        sin: TensorHandle, *, hq_local: int, hkv_local: int,
                        pos: int, num_ranks: int,
-                       eps: float = 1e-6) -> TensorHandle:
+                       eps: float = 1e-6, paged: bool = False) -> TensorHandle:
     """Emit one transformer layer's decode tasks; returns the output x."""
     hidden = x.cols
     d = TILE
@@ -175,12 +175,34 @@ def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
         mb.rope(_col(h.k_new, j), _col(h.k_new, j), cos, sin)
 
     attn = mb.tensor(TILE, hq_local * d)
-    # One task per KV head: the whole GQA group's q-heads share the KV
-    # stream (tiles fetched once per group, not once per head).
-    for kv in range(hkv_local):
-        mb.attn_decode_gqa(attn, kv * groups, q, kv * groups, groups,
-                           h.kT[kv], h.v[kv], valid_len=pos, scale=scale,
-                           k_new=_col(h.k_new, kv), v_new=_col(h.v_new, kv))
+    if paged:
+        # Paged cache (reference mega_triton_kernel PagedKVCache): the
+        # kT/v handles are PAGE POOLS; each attention task walks an
+        # identity page table packed as queue DATA rows, which the host
+        # can rewrite per step to remap logical pages onto pool tiles
+        # (tables are data, so any allocator works without recompiling).
+        # Limitations vs the linear GQA path (deliberate, documented): the
+        # paged task is single-head, so a GQA group re-streams its shared
+        # KV pool `groups` times and each q-head carries its OWN copy of
+        # the kv-head's table — a host remapper must rewrite every task's
+        # DATA rows (find them via each task's b0 word), not just one.
+        n_pages = h.kT[0].ct
+        for j in range(hq_local):
+            kv = j // groups
+            pages = [(h.kT[kv].tile(0, p), h.v[kv].tile(p, 0))
+                     for p in range(n_pages)]
+            mb.attn_decode_paged(_col(attn, j), _col(q, j), pages,
+                                 valid_len=pos, scale=scale,
+                                 k_new=_col(h.k_new, kv),
+                                 v_new=_col(h.v_new, kv))
+    else:
+        # One task per KV head: the whole GQA group's q-heads share the KV
+        # stream (tiles fetched once per group, not once per head).
+        for kv in range(hkv_local):
+            mb.attn_decode_gqa(attn, kv * groups, q, kv * groups, groups,
+                               h.kT[kv], h.v[kv], valid_len=pos,
+                               scale=scale, k_new=_col(h.k_new, kv),
+                               v_new=_col(h.v_new, kv))
 
     o = mb.tensor(TILE, hidden)
     mb.gemm(o, attn, h.wo, prefetch_first=True)
@@ -213,7 +235,8 @@ def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
 def build_decode_step(*, hidden: int, hq_local: int, hkv_local: int,
                       ffn_local: int, num_layers: int, max_seq: int,
                       pos: int, num_ranks: int = 1,
-                      eps: float = 1e-6) -> DecodeStepProgram:
+                      eps: float = 1e-6,
+                      paged: bool = False) -> DecodeStepProgram:
     """Assemble a full num_layers decode step (per-device TP view).
 
     ``hq_local``/``hkv_local``/``ffn_local`` are this device's shards;
@@ -254,6 +277,6 @@ def build_decode_step(*, hidden: int, hq_local: int, hkv_local: int,
     for h in layers:
         cur = build_decode_layer(mb, cur, h, cos, sin, hq_local=hq_local,
                                  hkv_local=hkv_local, pos=pos,
-                                 num_ranks=num_ranks, eps=eps)
+                                 num_ranks=num_ranks, eps=eps, paged=paged)
     return DecodeStepProgram(mb=mb, x=x, layers=layers, cos=cos, sin=sin,
                              x_out=cur)

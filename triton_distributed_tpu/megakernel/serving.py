@@ -15,9 +15,12 @@ host-side). The per-step k/v append is a functional workspace column/row
 update — the host-side analog of the reference's in-kernel KV append (a
 deliberate design delta, see megakernel/models.py docstring).
 
-Single-device view (TP=1): the multi-rank megakernel path (in-kernel AR
-tasks) is exercised by tests/test_megakernel_decode.py::test_decode_step_tp8;
-serving glue targets the one-chip case the benchmark ladder measures.
+TP serving (round 3): with ``num_ranks > 1`` the decoder shards weights
+per rank (column-parallel qkv/gate/up, row-parallel o/down, kv-head
+split), builds each rank's workspace on its device, and runs the step
+under shard_map with the in-kernel AllReduce tasks carrying the TP
+reductions — token-identical to the jitted ar backend at TP=1 and TP=8
+(tests/test_megakernel_serving.py). Requires a 1-D mesh over the TP axis.
 """
 
 from __future__ import annotations
@@ -127,6 +130,16 @@ class MegakernelDecoder:
             raise ValueError(f"heads/ffn not divisible by TP degree {n}")
         if (cfg.intermediate_size // n) % TILE:
             raise ValueError("per-rank ffn must stay a TILE multiple")
+        if n > 1:
+            if ctx is None:
+                raise ValueError("num_ranks > 1 requires ctx (the mesh "
+                                 "hosting the TP axis)")
+            if tuple(ctx.mesh.axis_names) != (axis,):
+                raise ValueError(
+                    f"megakernel TP serving needs a 1-D mesh over "
+                    f"{axis!r}; got axes {ctx.mesh.axis_names} — the "
+                    "per-rank workspace placement maps rank r to the "
+                    "r-th device of that axis")
         self.cfg = cfg
         self.max_seq = max_seq
         self.n = n
