@@ -117,3 +117,12 @@ def test_default_cfg_resolution_off_chip(monkeypatch):
     np.testing.assert_allclose(np.asarray(pallas_matmul_tuned(a, b)),
                                np.asarray(a) @ np.asarray(b), rtol=1e-5,
                                atol=1e-5)
+
+
+def test_tuned_flash_tiles_off_chip(monkeypatch):
+    """Flash-tile tuning is chip-measured only: with tuning disabled the
+    entry returns None and callers keep the swept defaults."""
+    from triton_distributed_tpu.runtime.autotuner import tuned_flash_tiles
+
+    monkeypatch.setenv("TDTPU_AUTOTUNE", "0")   # force off even on TPU hosts
+    assert tuned_flash_tiles(1024, 1024, 8, 1, 128, jnp.bfloat16) is None

@@ -2,7 +2,9 @@
 
 from triton_distributed_tpu.models.config import (  # noqa: F401
     ModelConfig,
+    QWEN3_4B,
     QWEN3_8B,
+    QWEN3_14B,
     QWEN3_32B,
     QWEN3_30B_A3B,
     tiny_config,

@@ -57,6 +57,17 @@ QWEN3_8B = ModelConfig(
     num_heads=32, num_kv_heads=8, head_dim=128, vocab_size=151_936,
 )
 
+QWEN3_4B = ModelConfig(
+    hidden_size=2560, intermediate_size=9728, num_layers=36,
+    num_heads=32, num_kv_heads=8, head_dim=128, vocab_size=151_936,
+    tie_word_embeddings=True,
+)
+
+QWEN3_14B = ModelConfig(
+    hidden_size=5120, intermediate_size=17_408, num_layers=40,
+    num_heads=40, num_kv_heads=8, head_dim=128, vocab_size=151_936,
+)
+
 QWEN3_32B = ModelConfig(
     hidden_size=5120, intermediate_size=25_600, num_layers=64,
     num_heads=64, num_kv_heads=8, head_dim=128, vocab_size=151_936,

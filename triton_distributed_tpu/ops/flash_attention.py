@@ -318,17 +318,34 @@ def _positional_mask(sq: int, sk: int, q_offset, k_offset, causal: bool):
 
 
 def shard_attention_partial(q, k, v, *, q_offset=0, k_offset=0,
-                            causal: bool = True):
+                            causal: bool = True,
+                            tile_q: int = DEFAULT_TILE_Q,
+                            tile_k: int = DEFAULT_TILE_K):
     """Partial attention over one KV shard: tiled flash kernel when the
     shapes support it, dense `_block_attn` otherwise. Same (acc, m, l)
     return contract either way — the single entry point the SP family
-    (ring / SP-AG) uses per shard."""
+    (ring / SP-AG) uses per shard. ``tile_q/tile_k`` override the swept
+    defaults (host wrappers pass autotuned caps when tuning is on)."""
     if flash_supported(q, k):
         return flash_attention_partial(q, k, v, q_offset=q_offset,
-                                       k_offset=k_offset, causal=causal)
+                                       k_offset=k_offset, causal=causal,
+                                       tile_q=tile_q, tile_k=tile_k)
     mask = _positional_mask(q.shape[1], k.shape[1], q_offset, k_offset,
                             causal)
     return _block_attn(q, k, v, mask)
+
+
+def resolve_flash_tiles(sq: int, sk: int, hq: int, hkv: int, d: int,
+                        dtype) -> tuple[int, int]:
+    """Tile caps for the SP wrappers: on-chip autotuned when tuning is on
+    (runtime/autotuner.tuned_flash_tiles — the S=4k optimum measured
+    512x1024 while S=32k measured 1024x1024), swept defaults otherwise.
+    Call at the HOST level (e.g. inside a jit-cache make()) — tuning
+    launches real measurements."""
+    from triton_distributed_tpu.runtime.autotuner import tuned_flash_tiles
+
+    tiles = tuned_flash_tiles(sq, sk, hq, hkv, d, dtype)
+    return tiles if tiles else (DEFAULT_TILE_Q, DEFAULT_TILE_K)
 
 
 def shard_attention(q, k, v, *, causal: bool = True):

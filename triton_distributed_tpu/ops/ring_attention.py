@@ -38,7 +38,8 @@ from triton_distributed_tpu.runtime.jit_cache import cached_shard_jit
 
 def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
                          axis: str = "sp", num_ranks: int | None = None,
-                         causal: bool = True) -> jax.Array:
+                         causal: bool = True,
+                         tiles: tuple | None = None) -> jax.Array:
     """Device-local ring attention inside shard_map.
 
     q/k/v: (B, S/n, h*, d) — this rank's sequence shard (rank r owns
@@ -52,10 +53,16 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
     sq = q.shape[1]
     sk = k.shape[1]
     q_off = me * sq
+    from triton_distributed_tpu.ops.flash_attention import (
+        DEFAULT_TILE_K, DEFAULT_TILE_Q,
+    )
+
+    tq, tk = tiles if tiles else (DEFAULT_TILE_Q, DEFAULT_TILE_K)
 
     if n == 1:
         acc, m, l = shard_attention_partial(q, k, v, q_offset=q_off,
-                                            k_offset=me * sk, causal=causal)
+                                            k_offset=me * sk, causal=causal,
+                                            tile_q=tq, tile_k=tk)
         return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
     perm = [(i, (i + 1) % n) for i in range(n)]  # shift right
@@ -64,7 +71,8 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
         # Positional causality: src > me shards come back dead (l=0,
         # compute skipped in-kernel); src < me shards are fully visible.
         return shard_attention_partial(q, kc, vc, q_offset=q_off,
-                                       k_offset=src * sk, causal=causal)
+                                       k_offset=src * sk, causal=causal,
+                                       tile_q=tq, tile_k=tk)
 
     # Exactly n-1 rotations, each issued on data the concurrent attention
     # call does NOT consume — hop i+1's ppermute DMA rides under hop i's
@@ -99,8 +107,17 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     key = (axis, causal, q.shape, k.shape, str(q.dtype))
 
     def make():
+        # Tile caps resolved HERE (host level, once per shape signature):
+        # on-chip autotuned when tuning is on, swept defaults otherwise.
+        from triton_distributed_tpu.ops.flash_attention import (
+            resolve_flash_tiles,
+        )
+
+        tiles = resolve_flash_tiles(q.shape[1] // n, k.shape[1] // n,
+                                    q.shape[2], k.shape[2], q.shape[3],
+                                    q.dtype)
         return functools.partial(ring_attention_local, axis=axis,
-                                 num_ranks=n, causal=causal)
+                                 num_ranks=n, causal=causal, tiles=tiles)
 
     jfn = cached_shard_jit(ctx, "ring_attention", key, make,
                           (P(None, axis), P(None, axis), P(None, axis)),

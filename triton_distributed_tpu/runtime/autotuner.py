@@ -293,6 +293,58 @@ def tuned_matmul_tiles(m: int, k: int, ncols: int, dtype) -> tuple | None:
     return best
 
 
+def tuned_flash_tiles(sq: int, sk: int, hq: int, hkv: int, d: int,
+                      dtype) -> tuple | None:
+    """(tile_q, tile_k) for ops/flash_attention at this shape, measured
+    on-chip over the VMEM-fitting candidate caps, disk-cached by
+    (shape, dtype, chip). None when tuning is off — callers fall back to
+    the swept defaults (DEFAULT_TILE_Q/K).
+
+    The round-3 sweep at S=32k picked 1024x1024 (33% over 512x1024); this
+    entry exists for shapes where that static choice may not hold.
+    """
+    if not autotune_enabled():
+        return None
+    import jax.numpy as jnp
+    import numpy as np
+
+    from triton_distributed_tpu.ops.flash_attention import (
+        _fit_tiles, flash_attention,
+    )
+
+    caps = []
+    for tq_cap in (1024, 512, 256):
+        for tk_cap in (2048, 1024, 512):
+            fitted = _fit_tiles(sq, sk, d, dtype, dtype, tq_cap, tk_cap)
+            if fitted and fitted not in caps:
+                caps.append(fitted)
+    if not caps:
+        return None
+    import zlib
+
+    chip = jax.devices()[0].device_kind
+    space_tag = zlib.crc32(repr(caps).encode())
+    key = (sq, sk, hq, hkv, d, str(jnp.dtype(dtype)), chip, space_tag)
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((1, sq, hq, d)) * 0.3, dtype)
+    k = jnp.asarray(rng.standard_normal((1, sk, hkv, d)) * 0.3, dtype)
+    v = jnp.asarray(rng.standard_normal((1, sk, hkv, d)) * 0.3, dtype)
+
+    def build(cfg):
+        tq, tk = cfg
+        # measure_chain applies its standard zero-scalar coupling; the
+        # kernel runs on the same q every iteration (fine for timing).
+        return lambda qq, kk, vv: flash_attention(qq, kk, vv, causal=True,
+                                                  tile_q=tq, tile_k=tk)
+
+    try:
+        best, _ = contextual_autotune("flash_attention", key, caps, build,
+                                      (q, k, v))
+    except RuntimeError:
+        return None
+    return best
+
+
 def tune_ag_gemm(a: jax.Array, b: jax.Array, ctx=None, axis: str = "tp"):
     """Autotuned AG+GEMM: picks AGGemmConfig for these global shapes.
 
