@@ -27,7 +27,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from triton_distributed_tpu import language as dl
 from triton_distributed_tpu.language import shmem_device as shmem
-from triton_distributed_tpu.language.core import kernel_call, any_spec
+from triton_distributed_tpu.language.core import any_spec
 from triton_distributed_tpu.megakernel.tasks import TILE, WORDS
 
 PIPE_DEPTH = 4  # outstanding tile-pair loads per task stream

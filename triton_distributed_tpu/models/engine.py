@@ -10,7 +10,6 @@ cache donated so updates happen in place.
 
 from __future__ import annotations
 
-import functools
 from typing import Callable
 
 import jax
