@@ -211,7 +211,7 @@ def main() -> int:
         hidden, hq, hkv, ffn, S, pos = 256, 2, 1, 256, 256, 100
         prog = build_decode_step(hidden=hidden, hq_local=hq, hkv_local=hkv,
                                  ffn_local=ffn, num_layers=1, max_seq=S,
-                                 pos=pos, num_ranks=1)
+                                 pos=pos, num_ranks=1, inkernel_append=True)
         comp = prog.mb.compile(dtype=dtype)
         h = prog.layers[0]
         cos, sin = rope_tables(pos, TILE, 1e6)

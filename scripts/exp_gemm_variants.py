@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""GEMM kernel-variant experiment (round 4, VERDICT #1).
+
+Compares Pallas matmul structures against XLA's dot at the north-star shape
+(M=2048, K=N=5120 bf16) with the chain-differential + interleaved + min-of-
+passes methodology (the only trustworthy one on this shared chip — see
+bench.py header). Also times a trivial pallas kernel to bound the fixed
+Mosaic dispatch overhead per call.
+
+Usage: python scripts/exp_gemm_variants.py [--lengths 8 40] [--trials 3]
+"""
+
+import argparse
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu.language.core import any_spec, kernel_call
+
+
+def grid_matmul(a, b, tm, tn, tk):
+    """Classic pallas_call grid matmul: Mosaic's own pipelining, parallel
+    dimension semantics on (i, j)."""
+    m, k = a.shape
+    _, n = b.shape
+    nk = k // tk
+
+    def kernel(a_ref, b_ref, o_ref, acc_ref):
+        kk = pl.program_id(2)
+
+        @pl.when(kk == 0)
+        def _():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        acc_ref[...] += jnp.dot(a_ref[...], b_ref[...],
+                                preferred_element_type=jnp.float32)
+
+        @pl.when(kk == nk - 1)
+        def _():
+            o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(m // tm, n // tn, nk),
+        in_specs=[pl.BlockSpec((tm, tk), lambda i, j, q: (i, q)),
+                  pl.BlockSpec((tk, tn), lambda i, j, q: (q, j))],
+        out_specs=pl.BlockSpec((tm, tn), lambda i, j, q: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), a.dtype),
+        scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m * k * n,
+            bytes_accessed=(m * k + k * n + m * n) * a.dtype.itemsize,
+            transcendentals=0),
+    )(a, b)
+
+
+def ep_matmul(a, b, tm, tn, tk, semantics=False):
+    """Current repo structure: one ANY-space kernel + emit_pipeline, with
+    optional parallel dimension semantics."""
+    m, k = a.shape
+    _, n = b.shape
+    nk = k // tk
+
+    def kernel(a_ref, b_ref, o_ref, acc):
+        def body(a_v, b_v, o_v, acc_ref):
+            kk = pl.program_id(2)
+            part = jnp.dot(a_v[...], b_v[...],
+                           preferred_element_type=jnp.float32)
+
+            @pl.when(kk == 0)
+            def _():
+                acc_ref[...] = part
+
+            @pl.when(kk != 0)
+            def _():
+                acc_ref[...] += part
+
+            @pl.when(kk == nk - 1)
+            def _():
+                o_v[...] = acc_ref[...].astype(o_v.dtype)
+
+        kw = {}
+        if semantics:
+            kw["dimension_semantics"] = (pltpu.PARALLEL, pltpu.PARALLEL,
+                                         pltpu.ARBITRARY)
+        pltpu.emit_pipeline(
+            body,
+            grid=(m // tm, n // tn, nk),
+            in_specs=[
+                pl.BlockSpec((tm, tk), lambda i, j, q: (i, q)),
+                pl.BlockSpec((tk, tn), lambda i, j, q: (q, j)),
+            ],
+            out_specs=[pl.BlockSpec((tm, tn), lambda i, j, q: (i, j))],
+            **kw,
+        )(a_ref, b_ref, o_ref, scratches=[acc])
+
+    return kernel_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((m, n), a.dtype),
+        in_specs=[any_spec(), any_spec()],
+        out_specs=any_spec(),
+        scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m * k * n,
+            bytes_accessed=(m * k + k * n + m * n) * a.dtype.itemsize,
+            transcendentals=0),
+    )(a, b)
+
+
+def tiny_copy(x):
+    """Trivial pallas kernel: bounds the fixed per-call Mosaic overhead."""
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] + 1.0
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+
+
+def _chain(matmul, a, b, n):
+    def body(i, x):
+        return matmul(x, b)
+
+    out = jax.lax.fori_loop(0, n, body, a)
+    return jnp.sum(out.astype(jnp.float32))
+
+
+def _timed_once(fn, a, b, n):
+    t0 = time.perf_counter()
+    out = fn(a, b, n)
+    _ = np.asarray(out)
+    return time.perf_counter() - t0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--lengths", type=int, nargs=2, default=[8, 40])
+    ap.add_argument("--trials", type=int, default=3)
+    args = ap.parse_args()
+
+    assert jax.default_backend() == "tpu", "experiment needs the real chip"
+    M, K = 2048, 5120
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.standard_normal((M, K)) * 0.05, jnp.bfloat16)
+    # near-orthogonal B (kron of orthogonals) so the chain stays bounded
+    q1 = np.linalg.qr(rng.standard_normal((64, 64)))[0]
+    q2 = np.linalg.qr(rng.standard_normal((K // 64, K // 64)))[0]
+    b = jnp.asarray(np.kron(q1, q2), jnp.bfloat16)
+
+    variants = {
+        "xla": lambda x, w: jnp.dot(
+            x, w, preferred_element_type=jnp.float32).astype(x.dtype),
+        "ep_cur_512_1024_1024": functools.partial(
+            ep_matmul, tm=512, tn=1024, tk=1024),
+        "ep_sem_512_1024_1024": functools.partial(
+            ep_matmul, tm=512, tn=1024, tk=1024, semantics=True),
+        "grid_512_1024_1024": functools.partial(
+            grid_matmul, tm=512, tn=1024, tk=1024),
+        "grid_1024_1024_512": functools.partial(
+            grid_matmul, tm=1024, tn=1024, tk=512),
+        "grid_512_1024_2560": functools.partial(
+            grid_matmul, tm=512, tn=1024, tk=2560),
+    }
+
+    fns = {name: jax.jit(functools.partial(_chain, fn), static_argnums=2)
+           for name, fn in variants.items()}
+
+    n1, n2 = args.lengths
+    flops = 2.0 * M * K * K
+
+    # warmup/compile
+    for name, fn in fns.items():
+        t0 = time.perf_counter()
+        try:
+            _timed_once(fn, a, b, n1)
+            print(f"compiled {name} in {time.perf_counter()-t0:.1f}s",
+                  flush=True)
+        except Exception as e:
+            print(f"COMPILE FAIL {name}: {str(e)[:200]}", flush=True)
+            fns[name] = None
+    fns = {k: v for k, v in fns.items() if v is not None}
+
+    best = {(name, n): float("inf") for name in fns for n in (n1, n2)}
+    for _pass in range(2):
+        for _t in range(args.trials):
+            for name, fn in fns.items():
+                for n in (n1, n2):
+                    best[(name, n)] = min(best[(name, n)],
+                                          _timed_once(fn, a, b, n))
+        if _pass == 0:
+            time.sleep(3)
+
+    print(f"\nshape M={M} K=N={K} bf16, lengths {n1}/{n2}, "
+          f"min over 2x{args.trials} interleaved trials")
+    t_xla = None
+    for name in fns:
+        per = (best[(name, n2)] - best[(name, n1)]) / (n2 - n1)
+        tf = flops / per / 1e12
+        if name == "xla":
+            t_xla = per
+        ratio = (t_xla / per) if t_xla else float("nan")
+        print(f"  {name:28s} {per*1e3:8.3f} ms/iter  {tf:7.1f} TF/s  "
+              f"vs_xla={ratio:.4f}")
+
+    # fixed-overhead probe: chain of tiny pallas calls
+    xs = jnp.zeros((8, 128), jnp.float32)
+
+    def tiny_chain(x, _unused, n):
+        return jnp.sum(jax.lax.fori_loop(0, n, lambda i, v: tiny_copy(v), x))
+
+    tfn = jax.jit(tiny_chain, static_argnums=2)
+    _timed_once(tfn, xs, None, 8)
+    tb = {n: float("inf") for n in (64, 256)}
+    for _ in range(4):
+        for n in (64, 256):
+            tb[n] = min(tb[n], _timed_once(tfn, xs, None, n))
+    per = (tb[256] - tb[64]) / (256 - 64)
+    print(f"\ntiny pallas call fixed overhead: {per*1e6:.1f} us/call")
+
+
+if __name__ == "__main__":
+    main()

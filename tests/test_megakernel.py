@@ -192,3 +192,68 @@ def test_megakernel_prefetch_task():
     with pytest.raises(ValueError, match="not yet consumed"):
         mb2.prefetch(a.tile(0, 0))
         mb2.prefetch(b.tile(0, 0))
+
+
+def test_gemm_wide_strips_and_prefetch():
+    """GEMM_WIDE: a (256, 640) output at width=3 splits into 3+2 strips per
+    row tile; values match numpy, and the prefetch warm feeds strip 0's
+    first weight tile."""
+    from triton_distributed_tpu.megakernel.tasks import TILE, TaskType
+
+    mb = MegaKernelBuilder()
+    m, k, n = 2 * TILE, 3 * TILE, 5 * TILE
+    x = mb.tensor(m, k)
+    w = mb.tensor(k, n)
+    out = mb.tensor(m, n)
+    mb.prefetch(w.tile(0, 0))
+    mb.gemm(out, x, w, prefetch_first=True, width=3)
+    prog = mb.compile()
+    wide = [t for t in np.asarray(prog.queue)
+            if t[0] == int(TaskType.GEMM_WIDE)]
+    assert sorted(t[7] for t in wide) == [2, 2, 3, 3]   # widths per strip
+    assert prog.max_gemm_width == 3
+
+    rng = np.random.default_rng(3)
+    ax = rng.standard_normal((m, k)).astype(np.float32)
+    aw = rng.standard_normal((k, n)).astype(np.float32) * 0.1
+    (res,) = prog.run({x: jnp.asarray(ax), w: jnp.asarray(aw)},
+                      outputs=[out])
+    np.testing.assert_allclose(np.asarray(res), ax @ aw, rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_append_kv_task_and_retarget():
+    """APPEND_KV writes k_new row 0 into the kT column / v row at pos, and
+    advance_queue_pos retargets the destination tile + column without
+    recompiling."""
+    from triton_distributed_tpu.megakernel.models import advance_queue_pos
+    from triton_distributed_tpu.megakernel.tasks import TILE
+
+    mb = MegaKernelBuilder()
+    S = 2 * TILE
+    kT = mb.tensor(TILE, S)
+    v = mb.tensor(S, TILE)
+    k_new = mb.tensor(TILE, TILE)
+    v_new = mb.tensor(TILE, TILE)
+    build_pos = S - 1
+    mb.append_kv(kT, v, build_pos, k_new, v_new)
+    prog = mb.compile()
+
+    rng = np.random.default_rng(4)
+    feeds = {kT: rng.standard_normal((TILE, S)).astype(np.float32),
+             v: rng.standard_normal((S, TILE)).astype(np.float32),
+             k_new: rng.standard_normal((TILE, TILE)).astype(np.float32),
+             v_new: rng.standard_normal((TILE, TILE)).astype(np.float32)}
+    jf = {h: jnp.asarray(a) for h, a in feeds.items()}
+
+    for pos in (build_pos, 5, TILE + 17):   # build pos + two retargets
+        queue = advance_queue_pos(prog, pos)
+        ws = prog.step(prog.make_workspace(jf), queue)
+        got_k = np.asarray(prog.gather_output(ws, kT))
+        got_v = np.asarray(prog.gather_output(ws, v))
+        want_k = feeds[kT].copy()
+        want_k[:, pos] = feeds[k_new][0]
+        want_v = feeds[v].copy()
+        want_v[pos, :] = feeds[v_new][0]
+        np.testing.assert_allclose(got_k, want_k, rtol=1e-6)
+        np.testing.assert_allclose(got_v, want_v, rtol=1e-6)

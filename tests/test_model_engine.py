@@ -162,3 +162,47 @@ def test_chunked_prefill_matches_full(ctx):
     tok_c, cache_chunk = eng.decode(jnp.argmax(logits_chunk, -1).astype(
         jnp.int32), cache_chunk)
     np.testing.assert_array_equal(np.asarray(tok_f), np.asarray(tok_c))
+
+
+def test_decode_force_ar_kernel_runs_at_n1():
+    """force_ar_kernel must actually route every layer reduction through
+    the parity-stream kernel at n=1 (the bench's labeled with-AR number):
+    the threaded call_index advances once per reduction site, and logits
+    match the bare path."""
+    import jax.random as jrandom
+
+    from triton_distributed_tpu.models.config import tiny_config
+    from triton_distributed_tpu.models.dense import (
+        dense_decode_step, init_dense_llm,
+    )
+    from triton_distributed_tpu.models.kv_cache import init_kv_cache
+    from triton_distributed_tpu.ops.allreduce import ar_stream_workspace
+
+    cfg = tiny_config()
+    params = init_dense_llm(jrandom.PRNGKey(0), cfg)
+    cache = init_kv_cache(cfg, 1, 64)
+    cache = cache._replace(offset=jnp.int32(3))
+    tok = jnp.zeros((1,), jnp.int32)
+
+    logits0, _ = dense_decode_step(params, cfg, tok, cache, num_ranks=1)
+
+    from triton_distributed_tpu.runtime import initialize_distributed
+    from triton_distributed_tpu.runtime.context import shard_map_on
+    from jax.sharding import PartitionSpec as P
+
+    ctx1 = initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                  devices=jax.devices()[:1])
+
+    def run(params, tok, cache):
+        ws, idx = ar_stream_workspace(1, 1, cfg.hidden_size, jnp.float32)
+        logits, _, (ws2, idx2) = dense_decode_step(
+            params, cfg, tok, cache, num_ranks=1, ar_state=(ws, idx),
+            force_ar_kernel=True)
+        return logits, idx2
+
+    logits1, idx2 = shard_map_on(ctx1, run, (P(), P(), P()),
+                                 (P(), P()))(params, tok, cache)
+    # one AR per attn out-proj + one per MLP down-proj, per layer
+    assert int(idx2) == 2 * cfg.num_layers
+    np.testing.assert_allclose(np.asarray(logits1), np.asarray(logits0),
+                               rtol=1e-5, atol=1e-5)

@@ -121,3 +121,74 @@ def test_moe_ep_stream_matches_barrier_path(ctx, moe_case):
     outs = np.asarray(fn(params, jnp.asarray(c["x"])))
     for t in range(3):
         np.testing.assert_allclose(outs[t], c["ref"], rtol=2e-3, atol=2e-3)
+
+
+def test_moe_ep_overflow_reporting(ctx, moe_case):
+    """return_overflow surfaces dropped token copies when a caller-supplied
+    capacity undercuts m*topk — and reports 0 on the lossless default
+    (round-3 advisor: ep_moe_fwd used to discard lay.overflow)."""
+    c = moe_case
+    n, topk = c["n"], c["topk"]
+    m = c["x"].shape[0]
+    params = {"router": jnp.asarray(c["router"]),
+              "w_gate": jnp.asarray(c["wg"]),
+              "w_up": jnp.asarray(c["wu"]),
+              "w_down": jnp.asarray(c["wd"])}
+    specs = ep_moe_specs("tp")
+
+    def run(cap):
+        def body(p, xl):
+            y, ov = ep_moe_fwd(p, xl, topk, num_ranks=n, capacity=cap,
+                               return_overflow=True)
+            return y, ov[None]   # scalar -> per-rank vector for out_specs
+
+        fn = shard_map_on(ctx, body, (specs, P("tp")), (P("tp"), P("tp")))
+        y, ov = fn(params, jnp.asarray(c["x"]))
+        return y, np.asarray(ov)
+
+    _, ov = run(None)
+    assert (ov == 0).all()
+
+    # n=1 path reports structural zero.
+    y1, ov1 = ep_moe_fwd(params, jnp.asarray(c["x"]), topk, num_ranks=1,
+                         return_overflow=True)
+    assert int(ov1) == 0
+    np.testing.assert_allclose(np.asarray(y1), c["ref"], rtol=2e-3, atol=2e-3)
+
+    # Starve the slots deterministically: all-ones tokens with a biased
+    # router route every copy to rank 0's experts 0/1 (positive logits only
+    # for them). 32 tokens/rank * topk 2 = 64 copies to ONE 16-slot cap:
+    # the stable expert sort keeps tokens 0..15's expert-0 copies and drops
+    # everything else — tokens 16..31 lose BOTH copies.
+    biased = np.full_like(c["router"], -10.0)
+    biased[:, 0], biased[:, 1] = 10.0, 9.0     # experts 0,1 = rank 0's
+    params["router"] = jnp.asarray(biased)
+
+    def body(p, xl):
+        y, ov = ep_moe_fwd(p, xl, topk, num_ranks=n, capacity=16,
+                           return_overflow=True)
+        return y, ov[None]
+
+    fn = shard_map_on(ctx, body, (specs, P("tp")), (P("tp"), P("tp")))
+    h = c["x"].shape[1]
+    ones = jnp.ones((32 * n, h), jnp.float32)
+    y_tight, ov_tight = fn(params, ones)
+    assert (np.asarray(ov_tight) == 48).all()   # 64 copies, 16 slots
+    y_np = np.asarray(y_tight).reshape(n, 32, h)
+    # Dropped copies must contribute ZERO — before the round-4 fix their
+    # clamped gather pulled slot 15's (another token's) output.
+    np.testing.assert_array_equal(y_np[:, 16:], 0.0)
+    assert np.abs(y_np[:, :16]).max() > 0
+
+    # Unit-level clamp contract: every copy to one destination, cap holds
+    # half — overflow reports the drop AND the advertised splits shrink to
+    # what the slot holds (they used to claim the unclamped count, walking
+    # the receiver past the buffer).
+    from triton_distributed_tpu.ops.all_to_all import dispatch_layout
+
+    toks = jnp.asarray(np.arange(32 * 4, dtype=np.float32).reshape(32, 4))
+    lay = dispatch_layout(toks, jnp.zeros((32,), jnp.int32),
+                          num_experts=c["E"], num_ranks=n, cap=16)
+    assert int(lay.overflow) == 16
+    assert int(lay.send_splits.sum()) == 16
+    assert (np.asarray(lay.send_splits)[0] <= 16).all()

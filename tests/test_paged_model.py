@@ -119,3 +119,27 @@ def test_engine_paged_matches_linear_serve(ctx):
     out_lin = np.asarray(lin.serve(ids, gen_len=6))
     out_paged = np.asarray(paged.serve(ids, gen_len=6))
     np.testing.assert_array_equal(out_lin, out_paged)
+
+
+def test_paged_saturation_flag(ctx):
+    """PagedModelCache.saturated flags sequences at pool capacity, and
+    dense_decode_step_paged holds their kv_lens at capacity instead of
+    letting them run past the table (round-3 advisor: saturation used to be
+    silent — serving loops can now evict)."""
+    cfg = tiny_config()
+    params = init_dense_llm(jax.random.PRNGKey(0), cfg)
+    page, max_pages = 8, 2
+    cache = init_paged_model_cache(cfg, 2, page_size=page,
+                                   max_pages=max_pages)
+    capacity = page * max_pages
+    assert cache.capacity == capacity
+    # Seq 0 one step short of capacity, seq 1 far from it.
+    cache = cache._replace(
+        kv_lens=jnp.asarray([capacity - 1, 4], jnp.int32))
+    tok = jnp.zeros((2,), jnp.int32)
+    for _ in range(3):
+        _, cache = dense_decode_step_paged(params, cfg, tok, cache,
+                                           num_ranks=1)
+    sat = np.asarray(cache.saturated)
+    assert sat.tolist() == [True, False]
+    assert np.asarray(cache.kv_lens).tolist() == [capacity, 7]

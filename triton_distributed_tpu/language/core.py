@@ -79,6 +79,7 @@ def kernel_call(
     cost_estimate: pl.CostEstimate | None = None,
     vmem_limit_bytes: int | None = None,
     input_output_aliases: dict | None = None,
+    dimension_semantics: tuple | None = None,
 ):
     """Build a ``pl.pallas_call`` preconfigured for distributed kernels.
 
@@ -117,6 +118,8 @@ def kernel_call(
         )
     if vmem_limit_bytes is not None:
         params["vmem_limit_bytes"] = vmem_limit_bytes
+    if dimension_semantics is not None:
+        params["dimension_semantics"] = tuple(dimension_semantics)
     compiler_params = pltpu.CompilerParams(has_side_effects=True, **params)
 
     single_out = not isinstance(out_shape, (tuple, list))

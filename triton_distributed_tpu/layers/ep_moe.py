@@ -60,14 +60,19 @@ def router_topk(x: jax.Array, router_w: jax.Array, topk: int):
 
 def ep_moe_fwd(params: dict, x: jax.Array, topk: int, *, axis: str = "tp",
                num_ranks: int = 1, capacity: int | None = None,
-               a2a_state=None):
+               a2a_state=None, return_overflow: bool = False):
     """Device-local EP-MoE forward inside shard_map.
 
     x: (m, h) this rank's tokens (data-parallel over ranks); params["w_*"]
     hold the LOCAL expert shard (E/n, ...) inside shard_map. Returns (m, h).
 
     capacity: per-destination-rank slot size (static); defaults to the
-    lossless m·topk rounded up to the DMA block.
+    lossless m·topk rounded up to the DMA block. A caller-supplied capacity
+    below m·topk can DROP token copies; pass ``return_overflow=True`` to get
+    the dispatch layout's drop count appended to the return (scalar int32,
+    0 = lossless) — serving loops should alarm on nonzero instead of
+    silently degrading (round-3 advisor finding; the reference surfaces the
+    same condition via its A2A recv-count postprocess).
 
     ``a2a_state``: (ws, call_index) from ops/all_to_all.a2a_stream_workspace
     — the decode loop's barrier-free parity AllToAll (VERDICT r2 #6;
@@ -94,7 +99,10 @@ def ep_moe_fwd(params: dict, x: jax.Array, topk: int, *, axis: str = "tp",
         y = y * weights.reshape(-1)[sort_idx][:, None]
         inv = jnp.argsort(sort_idx)
         y = y[inv].reshape(m, topk, h).sum(axis=1).astype(x.dtype)
-        return (y, a2a_state) if a2a_state is not None else y
+        out = (y, a2a_state) if a2a_state is not None else (y,)
+        if return_overflow:   # no cap on the local path — structurally 0
+            out = out + (jnp.int32(0),)
+        return out if len(out) > 1 else out[0]
 
     block = 16
     cap = capacity or -(-(m * topk) // block) * block
@@ -132,14 +140,21 @@ def ep_moe_fwd(params: dict, x: jax.Array, topk: int, *, axis: str = "tp",
             y_slots, recv_splits, axis=axis, num_ranks=n)
 
     # 4. un-permute: sorted token i went to (sorted_rank, pos_in_slot) and
-    # its result came back at the same coordinates.
+    # its result came back at the same coordinates. Copies the cap dropped
+    # (pos_in_slot >= cap) never travelled: their gather index would clamp
+    # to slot cap-1 — ANOTHER token's output — so mask them to zero (the
+    # degradation overflow reports, not corruption).
     y_flat_sorted = back_buf[lay.sorted_rank, lay.pos_in_slot]  # (m·topk, h)
     w_sorted = weights.reshape(-1)[lay.sort_idx]
+    w_sorted = jnp.where(lay.pos_in_slot < cap, w_sorted, 0.0)
     y_flat_sorted = y_flat_sorted * w_sorted[:, None]
     inv = jnp.argsort(lay.sort_idx)
     y_flat = y_flat_sorted[inv]                                  # (m·topk, h)
     y = y_flat.reshape(m, topk, h).sum(axis=1).astype(x.dtype)
-    return (y, (ws, idx)) if a2a_state is not None else y
+    out = (y, (ws, idx)) if a2a_state is not None else (y,)
+    if return_overflow:
+        out = out + (lay.overflow,)
+    return out if len(out) > 1 else out[0]
 
 
 def _expert_mlp(x_sorted, group_sizes, params, pad_group: bool = False):

@@ -173,9 +173,14 @@ def _out_proj(attn: jax.Array, params: dict, *, axis: str, n: int,
 
     ``ar_fn``: optional replacement for the default fused AllReduce — the
     decode loop passes the barrier-free parity-stream AR here
-    (ops/allreduce.all_reduce_stream via models/dense.py)."""
+    (ops/allreduce.all_reduce_stream via models/dense.py). At n=1 a
+    supplied ar_fn still runs (the force_ar_kernel bench path measures the
+    loopback kernel's overhead — without this, every reduction site
+    early-returns and the 'with AR kernel' number silently measures the
+    bare chain)."""
     if n == 1:
-        return attn @ params["wo"]
+        y = attn @ params["wo"]
+        return ar_fn(y) if ar_fn is not None else y
     if mode == "ar":
         y = attn @ params["wo"]
         if ar_fn is not None:

@@ -91,7 +91,10 @@ def tp_mlp_fwd(params: dict, x: jax.Array, *, axis: str = "tp",
     n = num_ranks
     wg, wu, wd = params["w_gate"], params["w_up"], params["w_down"]
     if n == 1:
-        return swiglu(x @ wg, x @ wu) @ wd
+        y = swiglu(x @ wg, x @ wu) @ wd
+        # A supplied ar_fn still runs at n=1: the force_ar_kernel bench
+        # path measures the loopback AR kernel's overhead here.
+        return ar_fn(y) if ar_fn is not None else y
 
     if mode == "auto":
         raise ValueError("resolve 'auto' with pick_mode() before calling "

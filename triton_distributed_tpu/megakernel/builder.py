@@ -128,15 +128,21 @@ class MegaKernelBuilder:
         self._pending_pf = int(weight_tile)
 
     def gemm(self, out: TensorHandle, a: TensorHandle, b: TensorHandle,
-             prefetch_first: bool = False):
-        """out (M,N) = a (M,K) @ b (K,N), one task per output tile
-        (reference make_linear → tile-parallel GEMM tasks).
+             prefetch_first: bool = False, width: int = 8):
+        """out (M,N) = a (M,K) @ b (K,N) as GEMM_WIDE strips of up to
+        ``width`` output column tiles per task (reference make_linear emits
+        multi-tile work per task the same way). One task streams the A row
+        once for its whole strip — the round-3 single-tile version re-
+        fetched it per output tile and paid ~2.8us of queue-walk overhead
+        per tile.
 
-        ``prefetch_first``: the first task's j=0 weight tile was warmed by a
+        ``prefetch_first``: the first task's f=0 weight tile was warmed by a
         preceding :meth:`prefetch` — it reads the reserved slot instead of
         issuing its own DMA (queue word c0 = 1)."""
         if a.cols != b.rows or out.rows != a.rows or out.cols != b.cols:
             raise ValueError("gemm shape mismatch")
+        if not 1 <= width <= 16:
+            raise ValueError(f"gemm width {width} out of range")
         if prefetch_first:
             if self._pending_pf != b.tile(0, 0):
                 raise ValueError(
@@ -147,19 +153,73 @@ class MegaKernelBuilder:
         kt = a.ct
         first = True
         for i in range(out.rt):
-            for j in range(out.ct):
+            j = 0
+            while j < out.ct:
+                wd = min(width, out.ct - j)
                 reads = [a.tile(i, q) for q in range(kt)]
-                reads += [b.tile(q, j) for q in range(kt)]
+                reads += [b.tile(q, j + w) for q in range(kt)
+                          for w in range(wd)]
                 use_pf = prefetch_first and first
                 if use_pf:
                     reads.append(self._pf_res.tile(0, 0))
                 self._emit(
-                    Task(TaskType.GEMM, out.tile(i, j),
+                    Task(TaskType.GEMM_WIDE, out.tile(i, j),
                          a0=a.tile(i, 0), b0=b.tile(0, j),
                          k_tiles=kt, a_stride=1, b_stride=b.ct,
-                         c0=1 if use_pf else 0),
-                    reads, [out.tile(i, j)])
+                         arg=wd, c0=1 if use_pf else 0),
+                    reads, [out.tile(i, j + w) for w in range(wd)])
+                self._max_gemm_width = max(
+                    getattr(self, "_max_gemm_width", 1), wd)
                 first = False
+                j += wd
+
+    def norm_rope(self, out: TensorHandle, a: TensorHandle,
+                  w: TensorHandle, cos: TensorHandle, sin: TensorHandle,
+                  eps: float = 1e-6):
+        """Fused per-head qk-norm + RoPE over ONE (TILE, TILE) head tile
+        (head_dim == TILE — the norm reduces over this tile's columns).
+        Replaces the rms_norm + rope task pair per head."""
+        for t in (out, a):
+            if t.rt != 1 or t.ct != 1:
+                raise ValueError("norm_rope operates on single head tiles")
+        for t in (w, cos, sin):
+            if t.rt != 1 or t.ct < 1:
+                raise ValueError("norm weight / rope tables must be single-"
+                                 "row-tile tensors")
+        if cos.ct != 1 or sin.ct != 1 or w.ct != 1:
+            raise ValueError("norm_rope reads one (TILE, TILE) tile of "
+                             "w/cos/sin — wider tables would be silently "
+                             "truncated")
+        self._emit(
+            Task(TaskType.NORM_ROPE, out.tile(0, 0), a0=a.tile(0, 0),
+                 b0=w.tile(0, 0), arg=int(round(eps * 1e9)),
+                 c0=cos.tile(0, 0), d0=sin.tile(0, 0)),
+            [a.tile(0, 0), w.tile(0, 0), cos.tile(0, 0), sin.tile(0, 0)],
+            [out.tile(0, 0)])
+
+    def append_kv(self, kT: TensorHandle, v: TensorHandle, pos: int,
+                  k_new: TensorHandle, v_new: TensorHandle):
+        """In-kernel KV cache append at position ``pos``: k_new's row 0
+        becomes column pos of the kT cache, v_new's row 0 becomes row pos
+        of the v cache (reference appends in-kernel inside its qkv/attn
+        tasks, model_builder.py). The task row is self-describing
+        (a_stride/b_stride carry the cache base tiles) so
+        advance_queue_pos retargets it per step without recompiling."""
+        if not 0 <= pos < kT.ct * TILE:
+            raise ValueError(f"append pos {pos} outside cache capacity")
+        if kT.rt != 1 or v.ct != 1:
+            raise ValueError("kT must be (d, S), v (S, d)")
+        for t in (k_new, v_new):
+            if t.rt != 1 or t.ct != 1:
+                raise ValueError("k_new/v_new must be single head tiles")
+        ti, col = pos // TILE, pos % TILE
+        kt_tile, v_tile = kT.tile(0, ti), v.tile(ti, 0)
+        self._emit(
+            Task(TaskType.APPEND_KV, kt_tile, a0=k_new.tile(0, 0),
+                 b0=v_tile, a_stride=kT.tile(0, 0), b_stride=v.tile(0, 0),
+                 c0=col, d0=v_new.tile(0, 0)),
+            [k_new.tile(0, 0), v_new.tile(0, 0), kt_tile, v_tile],
+            [kt_tile, v_tile])
 
     def all_reduce(self, t: TensorHandle):
         """Sum ``t`` over ranks in place (reference make_allreduce)."""
@@ -183,20 +243,6 @@ class MegaKernelBuilder:
                      b0=w.tile(0, 0), k_tiles=a.ct,
                      arg=int(round(eps * 1e9))),
                 reads, [out.tile(i, j) for j in range(out.ct)])
-
-    def rope(self, out: TensorHandle, a: TensorHandle, cos: TensorHandle,
-             sin: TensorHandle):
-        """Per-tile HF half-split rotation; cos/sin are full-width tables
-        (models.rope_tables) stored broadcast like norm weights."""
-        if (out.rt, out.ct) != (a.rt, a.ct) or cos.ct != a.ct or sin.ct != a.ct:
-            raise ValueError("rope shape mismatch")
-        for i in range(out.rt):
-            for j in range(out.ct):
-                self._emit(
-                    Task(TaskType.ROPE, out.tile(i, j), a0=a.tile(i, j),
-                         b0=cos.tile(0, j), arg=sin.tile(0, j)),
-                    [a.tile(i, j), cos.tile(0, j), sin.tile(0, j)],
-                    [out.tile(i, j)])
 
     def attn_decode(self, out: TensorHandle, q: TensorHandle,
                     kT: TensorHandle, v: TensorHandle, valid_len: int,
@@ -375,7 +421,9 @@ class MegaKernelBuilder:
                                   num_ranks=num_ranks, axis=axis,
                                   dtype=jnp.dtype(dtype),
                                   num_exec=n_exec,
-                                  max_gqa=getattr(self, "_max_gqa", 1))
+                                  max_gqa=getattr(self, "_max_gqa", 1),
+                                  max_gemm_width=getattr(
+                                      self, "_max_gemm_width", 1))
 
 
 @dataclasses.dataclass
@@ -389,6 +437,7 @@ class CompiledMegaKernel:
     dtype: jnp.dtype = jnp.dtype(jnp.float32)  # bf16 halves tile DMA bytes
     num_exec: int | None = None   # dispatched rows (rest = page-table data)
     max_gqa: int = 1              # largest GQA group (sizes VMEM scratch)
+    max_gemm_width: int = 1       # widest GEMM strip (sizes acc scratch)
 
     def scatter_input(self, ws: jax.Array, h: TensorHandle,
                       value: jax.Array) -> jax.Array:
@@ -419,7 +468,8 @@ class CompiledMegaKernel:
         Device-local: wrap in shard_map when num_ranks > 1."""
         return run_queue(self.queue if queue is None else queue, ws,
                          num_ranks=self.num_ranks, axis=self.axis,
-                         num_tasks=self.num_exec, max_gqa=self.max_gqa)
+                         num_tasks=self.num_exec, max_gqa=self.max_gqa,
+                         max_gemm_width=self.max_gemm_width)
 
     def run(self, inputs: dict, outputs: list[TensorHandle],
             _device_local: bool = True):

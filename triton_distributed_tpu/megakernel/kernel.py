@@ -35,8 +35,9 @@ from triton_distributed_tpu.runtime.context import use_interpret
 
 
 def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
+                 max_gemm_width: int,
                  queue_ref, ws_in, ws_out, slots, va2, vb2, vacc, vq, vstat,
-                 vqg, vaccg, vstatg,
+                 vqg, vaccg, vstatg, vaccw,
                  copy_sem, pipe_sems, send_sems, recv_sem):
     wdt = ws_out.dtype   # workspace dtype (fp32 or bf16); compute is fp32
     step = pl.program_id(0)
@@ -73,14 +74,13 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
     # Pipelined pair loads: tile streams (a_of(j), b_of(j)) double-buffered
     # so iteration j's MXU work overlaps iteration j+1's DMA — the intra-
     # task analog of ops/tiling.py's emit_pipeline.
-    def pipelined_pairs(a_of, b_of, n_iters, body_fn, init, b_pf=None):
+    def pipelined_pairs(a_of, b_of, n_iters, body_fn, init):
         # DEPTH tile-pairs in flight: a single-buffer lookahead cannot hide
         # ~2us DMA latency under a 128x128 dot; 3 outstanding pairs can.
         # b_of=None streams only `a` (the body's b_ref is then invalid) —
         # copy/scale/rms-pass1 would otherwise double their HBM reads.
-        # b_pf (traced bool): j=0's b tile was warmed into the RESERVED
-        # slot vb2[PIPE_DEPTH] by a PREFETCH task — wait its semaphore
-        # instead of issuing a load (reference weight-prefetch task).
+        # (Prefetch-warm consumption lives in t_gemm_wide, the only task
+        # the builder pairs with PREFETCH.)
         def desc(idx, vref2, slot, sem_i):
             return pltpu.make_async_copy(ws_out.at[idx], vref2.at[slot],
                                          pipe_sems.at[sem_i])
@@ -88,25 +88,12 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
         def start(j, slot):
             desc(a_of(j), va2, slot, slot * 2).start()
             if b_of is not None:
-                if b_pf is None:
-                    desc(b_of(j), vb2, slot, slot * 2 + 1).start()
-                else:
-                    @pl.when(jnp.logical_or(j != 0, ~b_pf))
-                    def _():
-                        desc(b_of(j), vb2, slot, slot * 2 + 1).start()
-
-        def bslot_sem(j, slot):
-            if b_pf is None:
-                return slot, slot * 2 + 1
-            use = jnp.logical_and(j == 0, b_pf)
-            return (jnp.where(use, PIPE_DEPTH, slot),
-                    jnp.where(use, 2 * PIPE_DEPTH, slot * 2 + 1))
+                desc(b_of(j), vb2, slot, slot * 2 + 1).start()
 
         def wait(j, slot):
             desc(a_of(j), va2, slot, slot * 2).wait()
             if b_of is not None:
-                bs, sem = bslot_sem(j, slot)
-                desc(b_of(j), vb2, bs, sem).wait()
+                desc(b_of(j), vb2, slot, slot * 2 + 1).wait()
 
         for jj in range(PIPE_DEPTH - 1):
             @pl.when(jj < n_iters)
@@ -122,8 +109,7 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
                       jax.lax.rem(j + PIPE_DEPTH - 1, PIPE_DEPTH))
 
             wait(j, slot)
-            bs, _sem = bslot_sem(j, slot)
-            return body_fn(j, va2.at[slot], vb2.at[bs], carry)
+            return body_fn(j, va2.at[slot], vb2.at[slot], carry)
 
         return jax.lax.fori_loop(0, n_iters, body, init)
 
@@ -149,25 +135,143 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
     def t_silu_mul():
         _ew_task(lambda a, b: jax.nn.silu(a) * b)
 
-    def t_gemm():
-        vacc[...] = jnp.zeros_like(vacc)
-
-        def body(j, a_ref, b_ref, _):
-            vacc[...] = vacc[...] + jnp.dot(
-                a_ref[...], b_ref[...], preferred_element_type=jnp.float32)
-            return 0
-
-        pipelined_pairs(lambda j: a0 + j * a_stride,
-                        lambda j: b0 + j * b_stride, k_tiles, body, 0,
-                        b_pf=(c0 == 1))
-        va[...] = vacc[...].astype(wdt)
-        store(va, out)
+    def t_retired():
+        # Queue-ABI placeholder for retired task types (GEMM -> GEMM_WIDE,
+        # ROPE -> NORM_ROPE): keeps lax.switch indices stable without
+        # compiling a dead body. The builder no longer emits them.
+        pass
 
     def t_prefetch():
         # Fire-and-forget warm of tile a0 into the reserved slot; the
         # consuming GEMM (c0 == 1) waits the semaphore at its j=0.
         pltpu.make_async_copy(ws_out.at[a0], vb2.at[PIPE_DEPTH],
                               pipe_sems.at[2 * PIPE_DEPTH]).start()
+
+    def t_gemm_wide():
+        # One task computes ``width`` contiguous output column tiles: the A
+        # row tiles stream ONCE for the strip (the single-tile GEMM
+        # re-fetched them per output tile) and width-1 task dispatches
+        # disappear. A double-buffers over 2 slots of va2; the flattened
+        # (j, w) B stream pipelines PIPE_DEPTH deep over vb2; per-column
+        # fp32 accumulators live in vaccw's leading dim (dynamic leading-
+        # dim indexing — lane-dim dynamic slicing would not lower).
+        width = arg
+        n_b = k_tiles * width
+        vaccw[...] = jnp.zeros_like(vaccw)
+
+        def b_tile_idx(f):
+            j = f // width
+            return b0 + j * b_stride + (f - j * width)
+
+        def bdesc(f, slot, sem_i):
+            return pltpu.make_async_copy(ws_out.at[b_tile_idx(f)],
+                                         vb2.at[slot], pipe_sems.at[sem_i])
+
+        def adesc(j, slot):
+            return pltpu.make_async_copy(ws_out.at[a0 + j * a_stride],
+                                         va2.at[slot],
+                                         pipe_sems.at[slot * 2])
+
+        def b_slot_sem(f, slot):
+            # f == 0 may have been warmed into the reserved slot by a
+            # PREFETCH task (c0 == 1) — consume that instead of loading.
+            use_pf = jnp.logical_and(f == 0, c0 == 1)
+            return (jnp.where(use_pf, PIPE_DEPTH, slot),
+                    jnp.where(use_pf, 2 * PIPE_DEPTH, slot * 2 + 1))
+
+        def b_start(f, slot):
+            @pl.when(jnp.logical_or(f != 0, c0 != 1))
+            def _():
+                bdesc(f, slot, slot * 2 + 1).start()
+
+        for s in range(PIPE_DEPTH - 1):
+            @pl.when(s < n_b)
+            def _(s=s):
+                b_start(s, s)
+        adesc(0, 0).start()
+
+        @pl.when(k_tiles > 1)
+        def _():
+            adesc(1, 1).start()
+
+        def jbody(j, _):
+            aslot = jax.lax.rem(j, 2)
+            adesc(j, aslot).wait()
+
+            def wbody(w, _):
+                f = j * width + w
+                slot = jax.lax.rem(f, PIPE_DEPTH)
+                nxt = f + PIPE_DEPTH - 1
+
+                @pl.when(nxt < n_b)
+                def _():
+                    b_start(nxt, jax.lax.rem(nxt, PIPE_DEPTH))
+
+                bs, sem = b_slot_sem(f, slot)
+                pltpu.make_async_copy(ws_out.at[b_tile_idx(f)], vb2.at[bs],
+                                      pipe_sems.at[sem]).wait()
+                vaccw[w, :, :] = vaccw[w] + jnp.dot(
+                    va2[aslot], vb2[bs],
+                    preferred_element_type=jnp.float32)
+                return 0
+
+            jax.lax.fori_loop(0, width, wbody, 0)
+
+            @pl.when(j + 2 < k_tiles)
+            def _():
+                adesc(j + 2, aslot).start()
+
+            return 0
+
+        jax.lax.fori_loop(0, k_tiles, jbody, 0)
+
+        def store_w(w, _):
+            va[...] = vaccw[w].astype(wdt)
+            store(va, out + w)
+            return 0
+
+        jax.lax.fori_loop(0, width, store_w, 0)
+
+    def t_norm_rope():
+        # Fused per-head qk-norm + RoPE: one load of the head tile instead
+        # of the rms_norm task's two streamed passes plus a separate rope
+        # task (head_dim == TILE — the norm reduces over this tile alone).
+        load(a0, va)           # head tile (B, d)
+        load(b0, vb)           # norm weight (broadcast rows)
+        af = va[...].astype(jnp.float32)
+        eps = arg.astype(jnp.float32) * 1e-9
+        scale_r = jax.lax.rsqrt(
+            jnp.mean(af * af, axis=1, keepdims=True) + eps)
+        xn = af * scale_r * vb[...].astype(jnp.float32)
+        load(c0, vb)           # cos
+        load(d0, vq)           # sin
+        half = TILE // 2
+        rot = jnp.concatenate([-xn[:, half:], xn[:, :half]], axis=1)
+        va[...] = (xn * vb[...].astype(jnp.float32)
+                   + rot * vq[...].astype(jnp.float32)).astype(wdt)
+        store(va, out)
+
+    def t_append_kv():
+        # In-kernel KV append (reference appends inside its attn tasks):
+        # k_new row 0 -> column c0 of kT cache tile ``out``; v_new row 0 ->
+        # row c0 of v cache tile ``b0``. Read-modify-write of the two cache
+        # tiles; the scheduler's WAR edges order it after every attention
+        # task that read them this step.
+        load(a0, vq)           # k_new (B, d)
+        load(out, va)          # kT cache tile (d, TILE)
+        kcolT = vq[...].astype(jnp.float32).T    # (d, B); col 0 = row 0
+        cols = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 1)
+        va[...] = jnp.where(cols == c0,
+                            jnp.broadcast_to(kcolT[:, 0:1], (TILE, TILE)),
+                            va[...].astype(jnp.float32)).astype(wdt)
+        store(va, out)
+        load(d0, vq)           # v_new (B, d)
+        load(b0, va)           # v cache tile (TILE, d)
+        rows = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 0)
+        va[...] = jnp.where(rows == c0,
+                            jnp.broadcast_to(vq[0:1, :], (TILE, TILE)),
+                            va[...].astype(jnp.float32)).astype(wdt)
+        store(va, b0)
 
     def t_allreduce():
         # One-shot AR of tile ``out`` (reference tasks/allreduce.py, minus
@@ -227,22 +331,6 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
 
         pipelined_pairs(lambda j: a0 + j, lambda j: b0 + j, k_tiles,
                         pass2, 0)
-
-    def t_rope():
-        # HF half-split rotation: out = a*cos + rotate_half(a)*sin with
-        # rotate_half(a) = concat(-a2, a1). cos/sin are full-width tables
-        # (each half repeated), prepared host-side. Reference: the qk-norm+
-        # rope task (mega_triton_kernel tasks).
-        load(a0, va)
-        load(b0, vb)    # cos
-        load(arg, vq)   # sin
-        half = TILE // 2
-        af = va[...].astype(jnp.float32)
-        a1, a2 = af[:, :half], af[:, half:]
-        rot = jnp.concatenate([-a2, a1], axis=1)
-        va[...] = (af * vb[...].astype(jnp.float32)
-                   + rot * vq[...].astype(jnp.float32)).astype(wdt)
-        store(va, out)
 
     def _attn_softmax(kt_of, v_of):
         """Shared online-softmax body: streams (kT_j, V_j) tile pairs by the
@@ -394,14 +482,16 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
                            ).astype(wdt)
                 store(va, out + h)
 
-    jax.lax.switch(w(0), [t_copy, t_add, t_silu_mul, t_gemm, t_allreduce,
-                          t_scale, t_rms_norm, t_rope, t_attn_decode,
+    jax.lax.switch(w(0), [t_copy, t_add, t_silu_mul, t_retired, t_allreduce,
+                          t_scale, t_rms_norm, t_retired, t_attn_decode,
                           t_attn_decode_paged, t_prefetch,
-                          t_attn_decode_gqa])
+                          t_attn_decode_gqa, t_gemm_wide, t_norm_rope,
+                          t_append_kv])
 
 
 def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
-              num_tasks: int | None = None, max_gqa: int = 1):
+              num_tasks: int | None = None, max_gqa: int = 1,
+              max_gemm_width: int = 1):
     """Execute the packed task queue over the workspace in ONE pallas_call.
 
     queue: (n_rows, WORDS) int32; workspace: (T, TILE, TILE) fp32 or bf16
@@ -411,6 +501,8 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
     (ATTN_DECODE_PAGED page tables) the grid never visits.
     ``max_gqa``: largest ATTN_DECODE_GQA group in the queue (sizes the
     per-head group scratch; 1 when unused).
+    ``max_gemm_width``: widest GEMM_WIDE strip (sizes the per-column
+    accumulator scratch; 1 when unused).
     Returns the post-execution workspace.
     """
     n_tasks = num_tasks if num_tasks is not None else queue.shape[0]
@@ -419,6 +511,7 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
     T = workspace.shape[0]
     wdt = workspace.dtype
     G = max(max_gqa, 1)
+    W = max(max_gemm_width, 1)
 
     # AR slots ride as a second output: Mosaic has no HBM scratch (see
     # language/core.py kernel_call ``workspaces``).
@@ -436,13 +529,14 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
             pltpu.VMEM((G, TILE, TILE), wdt),           # vqg (group q tiles)
             pltpu.VMEM((G, TILE, TILE), jnp.float32),   # vaccg
             pltpu.VMEM((G, TILE, 128), jnp.float32),    # vstatg
+            pltpu.VMEM((W, TILE, TILE), jnp.float32),   # vaccw (wide GEMM)
             pltpu.SemaphoreType.DMA(()),               # copy_sem
             pltpu.SemaphoreType.DMA((2 * PIPE_DEPTH + 1,)),  # pipe (+pf sem)
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
             pltpu.SemaphoreType.DMA(()),
         ],
     )
-    kernel = functools.partial(_mega_kernel, n, axis, n_tasks, G)
+    kernel = functools.partial(_mega_kernel, n, axis, n_tasks, G, W)
     interpret = use_interpret()
     if interpret:
         from triton_distributed_tpu.runtime.interpret_workarounds import (

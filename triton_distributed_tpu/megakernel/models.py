@@ -13,13 +13,15 @@ TPU assembly for a TP-sharded Qwen3-style layer (per device):
       rms_norm ── gate/up proj ── silu·mul ── down proj ── AllReduce ──
       +residual
 
-One design delta from the reference: the KV cache is *not* mutated
-in-kernel — the current token's k/v join each attention task's softmax
-directly (ATTN_DECODE c0/d0 operands), and the host appends them to the
-cache after the step (a pure-functional update, idiomatic in jax where the
-cache is a traced value). Constraints: head_dim == TILE (128, the Qwen3
-value), batch <= TILE, hidden/ffn_local/head counts multiples of TILE where
-tiled.
+The current token's k/v join each attention task's softmax directly
+(ATTN_DECODE c0/d0 operands); with ``inkernel_append=True`` the cache is
+then appended IN-KERNEL by APPEND_KV tasks (matching the reference's
+in-kernel append; the WAR hazard on the cache tiles orders the append
+after the attention reads), retargeted per position by
+``advance_queue_pos``. Without the flag the host appends after the step
+(pure-functional update — the test-friendly default). Constraints:
+head_dim == TILE (128, the Qwen3 value), batch <= TILE,
+hidden/ffn_local/head counts multiples of TILE where tiled.
 """
 
 from __future__ import annotations
@@ -136,6 +138,15 @@ def advance_queue_pos(base_queue, pos: int, num_exec: int | None = None):
                          "an all-masked softmax")
     q[attn, 6] = pos
     q[attn, 4] = np.minimum(q[attn, 4], need)
+    # APPEND_KV rows are self-describing (a_stride/b_stride = cache base
+    # tiles): retarget the destination tile + intra-tile column to ``pos``.
+    app = q[:, 0] == int(TaskType.APPEND_KV)
+    if num_exec is not None:
+        app[num_exec:] = False
+    ti, col = pos // TILE, pos % TILE
+    q[app, 1] = q[app, 5] + ti        # out = kT base tile + pos tile
+    q[app, 3] = q[app, 6] + ti        # b0  = v base tile + pos tile
+    q[app, 8] = col                   # c0  = intra-tile column/row
     return jnp.asarray(q)
 
 
@@ -143,7 +154,8 @@ def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
                        h: DecodeLayerHandles, cos: TensorHandle,
                        sin: TensorHandle, *, hq_local: int, hkv_local: int,
                        pos: int, num_ranks: int,
-                       eps: float = 1e-6, paged: bool = False) -> TensorHandle:
+                       eps: float = 1e-6, paged: bool = False,
+                       inkernel_append: bool = False) -> TensorHandle:
     """Emit one transformer layer's decode tasks; returns the output x."""
     hidden = x.cols
     d = TILE
@@ -166,13 +178,13 @@ def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
     mb.gemm(h.v_new, xn, h.wv, prefetch_first=True)
     mb.prefetch(h.wo.tile(0, 0))
 
-    # Per-head qk-norm (head_dim == TILE → one-tile-wide RMSNorm) + RoPE.
+    # Per-head qk-norm + RoPE, fused into one task per head (head_dim ==
+    # TILE → the norm reduces over the single head tile).
     for j in range(hq_local):
-        mb.rms_norm(_col(q, j), _col(q, j), h.q_norm, eps)
-        mb.rope(_col(q, j), _col(q, j), cos, sin)
+        mb.norm_rope(_col(q, j), _col(q, j), h.q_norm, cos, sin, eps)
     for j in range(hkv_local):
-        mb.rms_norm(_col(h.k_new, j), _col(h.k_new, j), h.k_norm, eps)
-        mb.rope(_col(h.k_new, j), _col(h.k_new, j), cos, sin)
+        mb.norm_rope(_col(h.k_new, j), _col(h.k_new, j), h.k_norm, cos,
+                     sin, eps)
 
     attn = mb.tensor(TILE, hq_local * d)
     if paged:
@@ -203,6 +215,15 @@ def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
                                h.kT[kv], h.v[kv], valid_len=pos,
                                scale=scale, k_new=_col(h.k_new, kv),
                                v_new=_col(h.v_new, kv))
+
+    if inkernel_append and not paged:
+        # In-kernel KV append (reference model_builder.py appends inside
+        # its attn tasks): the WAR hazards on the cache tiles order these
+        # after this layer's attention reads. advance_queue_pos retargets
+        # the destination tile/column per step.
+        for kv in range(hkv_local):
+            mb.append_kv(h.kT[kv], h.v[kv], pos, _col(h.k_new, kv),
+                         _col(h.v_new, kv))
 
     o = mb.tensor(TILE, hidden)
     mb.gemm(o, attn, h.wo, prefetch_first=True)
@@ -236,7 +257,8 @@ def build_decode_step(*, hidden: int, hq_local: int, hkv_local: int,
                       ffn_local: int, num_layers: int, max_seq: int,
                       pos: int, num_ranks: int = 1,
                       eps: float = 1e-6,
-                      paged: bool = False) -> DecodeStepProgram:
+                      paged: bool = False,
+                      inkernel_append: bool = False) -> DecodeStepProgram:
     """Assemble a full num_layers decode step (per-device TP view).
 
     ``hq_local``/``hkv_local``/``ffn_local`` are this device's shards;
@@ -277,6 +299,7 @@ def build_decode_step(*, hidden: int, hq_local: int, hkv_local: int,
     for h in layers:
         cur = build_decode_layer(mb, cur, h, cos, sin, hq_local=hq_local,
                                  hkv_local=hkv_local, pos=pos,
-                                 num_ranks=num_ranks, eps=eps, paged=paged)
+                                 num_ranks=num_ranks, eps=eps, paged=paged,
+                                 inkernel_append=inkernel_append)
     return DecodeStepProgram(mb=mb, x=x, layers=layers, cos=cos, sin=sin,
                              x_out=cur)

@@ -11,9 +11,9 @@ Flow: Engine prefills with the fast batched dense path (linear KV cache),
 then the cache is transposed into the megakernel's per-head kT/v workspace
 regions and every subsequent token is ONE pallas_call (plus embed/lm_head,
 which stay outside the kernel exactly like the reference keeps sampling
-host-side). The per-step k/v append is a functional workspace column/row
-update — the host-side analog of the reference's in-kernel KV append (a
-deliberate design delta, see megakernel/models.py docstring).
+host-side). The per-step k/v append runs IN-KERNEL (APPEND_KV tasks,
+round 4 — matching the reference's in-kernel append in its qkv/attn
+tasks); advance_queue_pos retargets the append destination each step.
 
 TP serving (round 3): with ``num_ranks > 1`` the decoder shards weights
 per rank (column-parallel qkv/gate/up, row-parallel o/down, kv-head
@@ -150,7 +150,8 @@ class MegakernelDecoder:
             hkv_local=cfg.num_kv_heads // n,
             ffn_local=cfg.intermediate_size // n,
             num_layers=cfg.num_layers, max_seq=max_seq,
-            pos=max_seq - 1, num_ranks=n, eps=cfg.rms_norm_eps)
+            pos=max_seq - 1, num_ranks=n, eps=cfg.rms_norm_eps,
+            inkernel_append=True)
         self.comp = self.prog.mb.compile(num_ranks=n, axis=axis,
                                          dtype=dtype)
         # Weight feeds computed ONCE (per rank) — start() merges only the
@@ -183,14 +184,14 @@ class MegakernelDecoder:
             mesh = ctx.mesh
 
             def sharded(ws, embed, final_norm, lm_head, queue, cos, sin,
-                        token, pos):
+                        token):
                 ws, tok = self._step(ws[0], embed, final_norm, lm_head,
-                                     queue, cos, sin, token, pos)
+                                     queue, cos, sin, token)
                 return ws[None], tok
 
             fn = jax.shard_map(
                 sharded, mesh=mesh,
-                in_specs=(P(axis), P(), P(), P(), P(), P(), P(), P(), P()),
+                in_specs=(P(axis), P(), P(), P(), P(), P(), P(), P()),
                 out_specs=(P(axis), P()), check_vma=False)
             self._step_jit = jax.jit(fn, donate_argnums=(0,))
 
@@ -227,27 +228,12 @@ class MegakernelDecoder:
             shape, NamedSharding(mesh, P(self.axis)), shards)
 
     # -- one token ----------------------------------------------------------
-    def _append_kv(self, ws: jax.Array, pos: jax.Array) -> jax.Array:
-        """Write this step's (normed+roped) k / raw v — produced by the
-        kernel into the k_new/v_new handles — into the cache regions at
-        column/row ``pos`` (functional update, jit-traced)."""
-        d = TILE
-        tile_i, intra = pos // TILE, pos % TILE
-        for h in self.prog.layers:
-            k_new = self.comp.gather_output(ws, h.k_new)[0]   # (hkv*d,)
-            v_new = self.comp.gather_output(ws, h.v_new)[0]
-            for kv in range(len(h.kT)):
-                kcol = k_new[kv * d:(kv + 1) * d].astype(ws.dtype)
-                vrow = v_new[kv * d:(kv + 1) * d].astype(ws.dtype)
-                ws = ws.at[h.kT[kv].base + tile_i, :, intra].set(kcol)
-                ws = ws.at[h.v[kv].base + tile_i, intra, :].set(vrow)
-        return ws
-
-    def _step(self, ws, embed, final_norm, lm_head, queue, cos, sin, token,
-              pos):
+    def _step(self, ws, embed, final_norm, lm_head, queue, cos, sin, token):
         # embed / final_norm / lm_head arrive as ARGUMENTS: closed over,
         # jit would bake them into the trace as inline constants (multi-GB
         # for real checkpoints — the exact hazard bench.py documents).
+        # (The position rides the QUEUE: KV append happens in-kernel via
+        # APPEND_KV tasks retargeted by advance_queue_pos.)
         x_row = embed[token[0]].astype(jnp.float32)            # (hidden,)
         x = jnp.zeros((TILE, self.cfg.hidden_size), jnp.float32
                       ).at[0].set(x_row)
@@ -255,7 +241,6 @@ class MegakernelDecoder:
         ws = self.comp.scatter_input(ws, self.prog.cos, cos)
         ws = self.comp.scatter_input(ws, self.prog.sin, sin)
         ws = self.comp.step(ws, queue)
-        ws = self._append_kv(ws, pos)
         x_out = self.comp.gather_output(ws, self.prog.x_out)[0:1]
         xn = rms_norm(x_out.astype(jnp.float32),
                       final_norm.astype(jnp.float32),
@@ -277,4 +262,4 @@ class MegakernelDecoder:
         cos, sin = rope_tables(pos, TILE, self.cfg.rope_theta)
         return self._step_jit(ws, self.embed, self.final_norm, self.lm_head,
                               queue, jnp.asarray(cos), jnp.asarray(sin),
-                              token, jnp.int32(pos))
+                              token)

@@ -27,13 +27,14 @@ class TaskType(enum.IntEnum):
     COPY = 0        # out <- a
     ADD = 1         # out <- a + b
     SILU_MUL = 2    # out <- silu(a) * b
-    GEMM = 3        # out <- [acc +] sum_j a[a0+j*as] @ b[b0+j*bs]
+    GEMM = 3        # RETIRED (queue-ABI placeholder) — the builder emits
+    #                 GEMM_WIDE for all matmuls since round 4
     ALLREDUCE = 4   # out <- sum over ranks of out (one tile, one-shot)
     SCALE = 5       # out <- a * scalar (scalar in word 7 as fixed-point 1e-6)
     RMS_NORM = 6    # out row <- a row * rsqrt(mean(a^2)+eps) * w; one task
     #                 per row of k_tiles column tiles; eps fixed-point 1e-9
-    ROPE = 7        # out <- a*cos + rotate_half(a)*sin (HF half-split);
-    #                 b0 = cos tile, arg = sin tile (full-width tables)
+    ROPE = 7        # RETIRED (queue-ABI placeholder) — fused into
+    #                 NORM_ROPE since round 4
     ATTN_DECODE = 8  # out <- softmax(q @ KT * scale, masked to valid) @ V
     #                 a0=q tile, b0=KT base, a_stride=V base, k_tiles=S/TILE,
     #                 b_stride=valid_len (runtime-updatable), arg=scale*1e6,
@@ -65,6 +66,29 @@ class TaskType(enum.IntEnum):
     #                 rows b0+. Other words as ATTN_DECODE (a_stride unused).
     #                 Reference: the paged FA decode task of
     #                 mega_triton_kernel tasks/flash_attn.py.
+    GEMM_WIDE = 12  # GEMM over ``arg`` contiguous output column tiles
+    #                 (out..out+arg-1) in ONE task: the A row streams once
+    #                 for the whole strip (vs once per output tile) and
+    #                 arg-1 dispatches disappear — the round-4 answer to the
+    #                 ~2.8us/task queue-walk floor (the reference's linear
+    #                 task similarly emits multi-tile work per task,
+    #                 model_builder.py make_linear). Words as GEMM plus
+    #                 arg=width; c0=1 consumes a PREFETCH warm for the
+    #                 f=0 weight tile.
+    NORM_ROPE = 13  # out <- rope(rms_norm(a) * w): the per-head qk-norm +
+    #                 RoPE pair fused into one task (one load of the q/k
+    #                 head tile instead of two round-trips; reference fuses
+    #                 the same pair in its qkv task). a0 = head tile
+    #                 (norm over its TILE columns = head_dim), b0 = norm
+    #                 weight tile, c0/d0 = cos/sin tiles, arg = eps 1e-9.
+    APPEND_KV = 14  # In-kernel KV cache append (reference does the append
+    #                 inside its attention tasks, model_builder.py qkv/attn):
+    #                 writes k_new's row 0 (a0, (B,d) tile) into column
+    #                 ``c0`` of the kT cache tile ``out`` (d, TILE), and
+    #                 v_new's row 0 (d0) into row ``c0`` of the v cache tile
+    #                 ``b0`` (TILE, d). a_stride/b_stride carry the kT/v
+    #                 tensor BASE tile ids so advance_queue_pos can retarget
+    #                 out/b0/c0 per position without recompiling.
 
 
 @dataclasses.dataclass(frozen=True)

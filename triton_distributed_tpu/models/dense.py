@@ -212,7 +212,8 @@ def dense_prefill_chunked(params: dict, cfg: ModelConfig,
     return logits, cache._replace(offset=jnp.int32(seq))
 
 
-def make_ar_stream_fn(ar_state, *, axis: str, n: int):
+def make_ar_stream_fn(ar_state, *, axis: str, n: int,
+                      force_kernel: bool = False):
     """Build the barrier-free parity AllReduce hook for the decode walk.
 
     ``ar_state``: (ws (2, n, B, h), idx scalar int32) from
@@ -228,7 +229,8 @@ def make_ar_stream_fn(ar_state, *, axis: str, n: int):
 
     def ar_fn(y):
         out, ws, idx = all_reduce_stream(y, state[0], state[1],
-                                         axis=axis, num_ranks=n)
+                                         axis=axis, num_ranks=n,
+                                         force_kernel=force_kernel)
         state[0], state[1] = ws, idx
         return out
 
@@ -254,15 +256,21 @@ def _decode_body(params: dict, cfg: ModelConfig, tokens: jax.Array,
 def dense_decode_step(params: dict, cfg: ModelConfig, tokens: jax.Array,
                       cache: KVCache, *, axis: str = "tp",
                       num_ranks: int = 1, mode: str = "ar",
-                      ar_state=None):
+                      ar_state=None, force_ar_kernel: bool = False):
     """Device-local one-token decode. tokens: (B,) replicated. Returns
     (logits (B, vocab), cache advanced by one); with ``ar_state`` given
-    (barrier-free parity AR), returns (logits, cache, ar_state')."""
+    (barrier-free parity AR), returns (logits, cache, ar_state').
+
+    ``force_ar_kernel``: run the parity-stream AR kernel even at n=1 (the
+    degenerate loopback grid) — single-chip benches use it so decode
+    numbers can be labeled with the kernel overhead included rather than
+    silently excluding all communication (round-3 advisor finding)."""
     n = num_ranks
     pos = cache.offset
     ar_fn = final = None
-    if ar_state is not None and mode == "ar" and n > 1:
-        ar_fn, final = make_ar_stream_fn(ar_state, axis=axis, n=n)
+    if ar_state is not None and mode == "ar" and (n > 1 or force_ar_kernel):
+        ar_fn, final = make_ar_stream_fn(ar_state, axis=axis, n=n,
+                                         force_kernel=force_ar_kernel)
 
     def attend(i, attn_params, h):
         nonlocal cache
@@ -313,8 +321,8 @@ def dense_decode_step_paged(params: dict, cfg: ModelConfig,
     # Saturated sequences (at pool capacity) drop the paged_append write, so
     # do NOT advance their kv_lens — an unclamped advance would silently
     # attend a cache missing the newest tokens with drifting RoPE positions.
-    capacity = cache.page_table.shape[1] * cache.k_pools.shape[2]
-    new_lens = jnp.minimum(start_lens + 1, capacity)
+    # (cache.saturated exposes the condition to serving loops.)
+    new_lens = jnp.minimum(start_lens + 1, cache.capacity)
     cache = cache._replace(kv_lens=new_lens)
     if ar_state is not None:
         return logits, cache, (final() if final is not None else ar_state)

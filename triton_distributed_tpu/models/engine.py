@@ -14,6 +14,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from triton_distributed_tpu.layers.tp_mlp import pick_mode
@@ -135,10 +136,13 @@ class Engine:
         if key not in self._jit_cache:
             from jax.sharding import NamedSharding
 
+            from triton_distributed_tpu.ops.allreduce import _ar_rows_padded
+
             mesh = self.ctx.mesh
             h = self.cfg.hidden_size
             dt = jnp.dtype(self.cfg.dtype)
-            ws = jnp.zeros((self.n, 2, self.n, batch, h), dt)
+            ws = jnp.zeros((self.n, 2, self.n, _ar_rows_padded(batch, dt), h),
+                           dt)
             ws = jax.device_put(ws, NamedSharding(mesh, P(self.axis)))
             idx = jax.device_put(jnp.zeros((), jnp.int32),
                                  NamedSharding(mesh, P()))
@@ -307,6 +311,17 @@ class Engine:
                 tok, cache = self.decode(tok, cache)
                 outs.append(tok)
             jax.block_until_ready(tok)
+        if self.page_size is not None and bool(jnp.any(cache.saturated)):
+            # Saturated sequences kept generating with their newest KV
+            # writes dropped — surface it (continuous-batching callers
+            # should instead watch cache.saturated per step and evict).
+            import warnings
+
+            warnings.warn(
+                "paged KV pool saturated for sequence(s) "
+                f"{np.flatnonzero(np.asarray(cache.saturated)).tolist()} — "
+                "their final tokens attended a truncated cache; raise "
+                "max_pages or evict earlier", RuntimeWarning, stacklevel=2)
         return jnp.stack(outs, axis=1)
 
     def _serve_megakernel(self, tok, cache, gen_len: int,

@@ -81,6 +81,20 @@ class PagedModelCache(NamedTuple):
             k_pools=self.k_pools.at[i].set(layer_cache.k_pool),
             v_pools=self.v_pools.at[i].set(layer_cache.v_pool))
 
+    @property
+    def capacity(self) -> int:
+        """Max positions one sequence's page allotment can hold."""
+        return self.page_table.shape[1] * self.k_pools.shape[2]
+
+    @property
+    def saturated(self) -> jax.Array:
+        """(B,) bool — sequences at pool capacity. A saturated sequence's
+        decode steps DROP the newest KV write (dense_decode_step_paged
+        clamps rather than corrupting the pools), so continuous-batching
+        callers must evict or stop these sequences instead of letting them
+        silently degrade (round-3 advisor finding)."""
+        return self.kv_lens >= self.capacity
+
 
 def init_paged_model_cache(cfg, batch: int, *, page_size: int,
                            max_pages: int, num_pages: int | None = None,

@@ -397,7 +397,13 @@ def dispatch_layout(tokens: jax.Array, expert_ids: jax.Array,
     overflow = jnp.sum((pos_in_slot >= cap).astype(jnp.int32))
     expert_counts = jax.ops.segment_sum(ones, expert_ids,
                                         num_segments=num_experts)
-    send_splits = expert_counts.reshape(num_ranks, epr)
+    # Clamp the splits to what the slot actually holds: rows past ``cap``
+    # were dropped from the buffer above, so the advertised counts must
+    # drop the same tail (per-expert groups are packed in order — the
+    # receiver would otherwise read past the slot).
+    within = expert_counts.reshape(num_ranks, epr)
+    group_starts = jnp.cumsum(within, axis=1) - within
+    send_splits = jnp.clip(cap - group_starts, 0, within).astype(jnp.int32)
     return DispatchLayout(send_buf, send_splits, sort_idx, sorted_rank,
                           pos_in_slot, overflow)
 

@@ -195,13 +195,24 @@ def all_reduce_local(x_local: jax.Array, axis: str = "tp",
 # Barrier-free steady-state AR (decode path). VERDICT r2 #6.
 # ---------------------------------------------------------------------------
 
+def _ar_rows_padded(m: int, dtype) -> int:
+    """Row dim padded to the dtype's sublane tiling: Mosaic cannot slice a
+    1-row bf16 block out of the (2, n, m, cols) workspace (tiling (2,128)),
+    which is exactly the decode shape (batch 1, bf16)."""
+    a = sublane_align(dtype)
+    return -(-m // a) * a
+
+
 def ar_stream_workspace(n: int, m: int, cols: int, dtype
                         ) -> tuple[jax.Array, jax.Array]:
     """Device-local persistent (workspace, call_index) pair for
     :func:`all_reduce_stream`. Allocate ONCE and thread through the decode
     loop (at the host level: a (n_dev,)-sharded leading dim, see
-    models/engine.py). Both parities start clean."""
-    return (jnp.zeros((2, n, m, cols), dtype), jnp.zeros((), jnp.int32))
+    models/engine.py). Both parities start clean. The row dim is padded to
+    the sublane tiling internally (batch-1 bf16 decode otherwise fails to
+    compile); all_reduce_stream pads/slices to match."""
+    return (jnp.zeros((2, n, _ar_rows_padded(m, dtype), cols), dtype),
+            jnp.zeros((), jnp.int32))
 
 
 def all_reduce_stream(x_local: jax.Array, ws: jax.Array,
@@ -227,21 +238,25 @@ def all_reduce_stream(x_local: jax.Array, ws: jax.Array,
         # exercises the parity slicing + semaphore paths.
         return x_local, ws, call_index + 1
     m, cols = x_local.shape
-    if ws.shape != (2, n, m, cols):
-        raise ValueError(f"workspace shape {ws.shape} != (2, {n}, {m}, {cols})")
+    mp = _ar_rows_padded(m, x_local.dtype)
+    if ws.shape != (2, n, mp, cols):
+        raise ValueError(f"workspace shape {ws.shape} != (2, {n}, {mp}, "
+                         f"{cols}) — allocate via ar_stream_workspace")
     if ws.dtype != x_local.dtype:
         raise ValueError(f"workspace dtype {ws.dtype} != input "
                          f"{x_local.dtype} — allocate ar_stream_workspace "
                          "with the activation dtype")
     from triton_distributed_tpu.language.core import smem_spec
 
-    tile_m = pick_tile(m, 512, sublane_align(x_local.dtype))
-    kernel = functools.partial(_ar_one_shot_parity_kernel, n, axis, m,
+    if mp != m:
+        x_local = jnp.pad(x_local, ((0, mp - m), (0, 0)))
+    tile_m = pick_tile(mp, 512, sublane_align(x_local.dtype))
+    kernel = functools.partial(_ar_one_shot_parity_kernel, n, axis, mp,
                                tile_m, straggler)
     out, ws_new = kernel_call(
         kernel,
         out_shape=(
-            jax.ShapeDtypeStruct((m, cols), x_local.dtype),
+            jax.ShapeDtypeStruct((mp, cols), x_local.dtype),
             jax.ShapeDtypeStruct(ws.shape, ws.dtype),
         ),
         in_specs=[smem_spec((1,)), any_spec(), any_spec()],
@@ -255,7 +270,7 @@ def all_reduce_stream(x_local: jax.Array, ws: jax.Array,
         ],
         input_output_aliases={2: 1},   # ws input -> ws output (persistent)
     )(jnp.asarray(call_index, jnp.int32).reshape(1), x_local, ws)
-    return out, ws_new, call_index + 1
+    return out[:m], ws_new, call_index + 1
 
 
 def all_reduce(x: jax.Array, ctx: DistContext | None = None, axis: str = "tp",

@@ -80,6 +80,37 @@ def measure(fn: Callable, args: Sequence[Any], *, warmup: int = 1,
     return best
 
 
+def _chain_timer(fn: Callable, args: Sequence[Any]) -> Callable[[int], float]:
+    """Build ``timed(n)`` measuring one host-fenced call of an n-long
+    on-device dependent chain of ``fn`` (the only timing primitive that
+    works through the axon relay — see :func:`measure_chain`)."""
+    import numpy as np
+
+    x0, rest = args[0], tuple(args[1:])
+
+    def jnp_sum(o):
+        import jax.numpy as jnp
+
+        return jnp.sum(o).astype(jnp.float32)
+
+    def chain(x, n):
+        def body(i, x):
+            out = fn(x, *rest)
+            z = sum(jnp_sum(o) for o in jax.tree.leaves(out))
+            return x + (z * 0.0).astype(x.dtype)
+
+        return jnp_sum(jax.lax.fori_loop(0, n, body, x))
+
+    jfn = jax.jit(chain, static_argnums=1)
+
+    def timed(n):
+        t0 = time.perf_counter()
+        _ = np.asarray(jfn(x0, n))
+        return time.perf_counter() - t0
+
+    return timed
+
+
 def measure_chain(fn: Callable, args: Sequence[Any], *,
                   lengths: tuple[int, int] = (16, 256),
                   trials: int = 3) -> float:
@@ -95,30 +126,7 @@ def measure_chain(fn: Callable, args: Sequence[Any], *,
     dispatch+fetch cost cancels. Works for any output shape — the coupling
     is a scalar, not the output itself.
     """
-    import numpy as np
-
-    x0, rest = args[0], tuple(args[1:])
-
-    def chain(x, n):
-        def body(i, x):
-            out = fn(x, *rest)
-            z = sum(jnp_sum(o) for o in jax.tree.leaves(out))
-            return x + (z * 0.0).astype(x.dtype)
-
-        return jnp_sum(jax.lax.fori_loop(0, n, body, x))
-
-    def jnp_sum(o):
-        import jax.numpy as jnp
-
-        return jnp.sum(o).astype(jnp.float32)
-
-    jfn = jax.jit(chain, static_argnums=1)
-
-    def timed(n):
-        t0 = time.perf_counter()
-        _ = np.asarray(jfn(x0, n))
-        return time.perf_counter() - t0
-
+    timed = _chain_timer(fn, args)
     n1, n2 = lengths
     timed(n1), timed(n2)  # compile + warm both traces
     best = {n: float("inf") for n in lengths}
@@ -129,6 +137,62 @@ def measure_chain(fn: Callable, args: Sequence[Any], *,
     if d <= 0:
         raise RuntimeError("non-positive differential — timing too noisy")
     return d
+
+
+def _measure_chain_interleaved(fns: Sequence[Callable | None],
+                               args: Sequence[Any], *,
+                               lengths: tuple[int, int] = (16, 256),
+                               trials: int = 3) -> list:
+    """Chain-differential timing of several candidates with the trial
+    rounds INTERLEAVED round-robin across candidates.
+
+    The round-3 tuner measured candidates sequentially, minutes apart —
+    the shared chip's clock swings ~2x between windows, so a candidate
+    measured in a bad window lost regardless of merit (a default-config
+    pick from exactly that failure mode is in the round-4 bench log).
+    Interleaving puts every candidate in every window; min-per-cell then
+    discards the bad rounds for all of them equally (the bench.py method).
+    Returns per-candidate seconds (None = failed to build/compile or
+    non-positive differential).
+    """
+    timers: list = []
+    for fn in fns:
+        if fn is None:
+            timers.append(None)
+            continue
+        try:
+            t = _chain_timer(fn, args)
+            for n in lengths:
+                t(n)          # compile + warm both traces
+            timers.append(t)
+        except Exception as e:
+            if _DEBUG:
+                print(f"[autotune] candidate failed to compile: {e}")
+            timers.append(None)
+    best = {(i, n): float("inf")
+            for i, t in enumerate(timers) if t is not None for n in lengths}
+    for _ in range(trials):
+        for i, t in enumerate(timers):
+            if t is None:
+                continue
+            for n in lengths:
+                try:
+                    best[(i, n)] = min(best[(i, n)], t(n))
+                except Exception as e:
+                    if _DEBUG:
+                        print(f"[autotune] candidate {i} failed during a "
+                              f"timing round: {e}")
+                    timers[i] = None
+                    break
+    n1, n2 = lengths
+    out: list = []
+    for i, t in enumerate(timers):
+        if t is None:
+            out.append(None)
+            continue
+        d = (best[(i, n2)] - best[(i, n1)]) / (n2 - n1)
+        out.append(d if d > 0 else None)
+    return out
 
 
 def contextual_autotune(
@@ -174,18 +238,28 @@ def contextual_autotune(
             # legacy bare-index entry: ignore (candidate order may differ)
             pass
 
-    timings: list = []
-    for cfg in candidates:
-        try:
-            if method == "chain":
-                t = measure_chain(build(cfg), args, trials=iters)
-            else:
+    if method == "chain":
+        fns: list = []
+        for cfg in candidates:
+            try:
+                fns.append(build(cfg))
+            except Exception as e:
+                if _DEBUG:
+                    print(f"[autotune {name}] {cfg} failed to build: {e}")
+                fns.append(None)
+        # Interleaved rounds: every candidate sees the same chip windows
+        # (sequential timing let clock drift pick the winner — round 4).
+        timings = _measure_chain_interleaved(fns, args, trials=iters)
+    else:
+        timings = []
+        for cfg in candidates:
+            try:
                 t = measure(build(cfg), args, warmup=warmup, iters=iters)
-        except Exception as e:  # config doesn't compile/fit — prune
-            if _DEBUG:
-                print(f"[autotune {name}] {cfg} failed: {e}")
-            t = None
-        timings.append(t)
+            except Exception as e:  # config doesn't compile/fit — prune
+                if _DEBUG:
+                    print(f"[autotune {name}] {cfg} failed: {e}")
+                t = None
+            timings.append(t)
 
     valid = [(t, i) for i, t in enumerate(timings) if t is not None]
     if not valid:
@@ -256,7 +330,13 @@ def tuned_matmul_tiles(m: int, k: int, ncols: int, dtype) -> tuple | None:
 
     itemsize = jnp.dtype(dtype).itemsize
     chip = jax.devices()[0].device_kind
-    base = gemm_tile_candidates(m, k, ncols, itemsize)
+    # Grid-form pallas_matmul has less Mosaic VMEM overhead than the
+    # emit_pipeline core (measured round 4: (1024,1024,512) = 12.6MB modeled
+    # compiles under the grid form, OOMs under emit_pipeline), so its
+    # candidate space gets a larger budget than gemm_tile_candidates'
+    # emit_pipeline default.
+    base = gemm_tile_candidates(m, k, ncols, itemsize,
+                                vmem_budget=13 * 1024 * 1024)
     # Key includes the candidate-space fingerprint: a cached winner from an
     # older space must not suppress measurement of newly added configs.
     # crc32 of the repr, not hash(): stable across interpreter versions so
@@ -270,7 +350,7 @@ def tuned_matmul_tiles(m: int, k: int, ncols: int, dtype) -> tuple | None:
     # kept small — the model ranking retains the winner (test_perf_model).
     cands = rank_gemm_tiles(base, m, ncols, k, itemsize, top=4)
     # Keep the static default in the race so tuning can only help.
-    default = (512, 1024, 1024)
+    default = (512, 1024, 512)
     if default not in cands:
         cands = [default] + list(cands)
     rng = np.random.default_rng(0)
