@@ -182,6 +182,35 @@ def main() -> int:
 
     check("all_gather_stream (parity)", ag_stream)
 
+    # Fused GEMM+AR stream (chunked partials pushed while the next chunk
+    # computes): the n=1 degenerate grid still compiles the per-chunk
+    # matmul-into-slot, nbi-push bookkeeping, parity slicing, and slot
+    # reduction through Mosaic.
+    from triton_distributed_tpu.ops.gemm_allreduce import (
+        gemm_ar_stream, gemm_ar_stream_workspace,
+    )
+
+    def gemm_ar_fused():
+        af = jnp.asarray(rng.standard_normal((8, 512)) * 0.1, jnp.bfloat16)
+        bf = jnp.asarray(rng.standard_normal((512, 512)) * 0.1, jnp.bfloat16)
+
+        def run(a2, b2):
+            ws, idx = gemm_ar_stream_workspace(1, a2.shape[0], b2.shape[1],
+                                               a2.dtype)
+            out, ws, idx = gemm_ar_stream(a2, b2, ws, idx, axis="tp",
+                                          num_ranks=1, force_kernel=True)
+            out2, ws, idx = gemm_ar_stream(a2, b2, ws, idx, axis="tp",
+                                           num_ranks=1, force_kernel=True)
+            return out2
+
+        out = shard_map_on(ctx, run, (_P(), _P()), _P())(af, bf)
+        gold = np.asarray(af, np.float32) @ np.asarray(bf, np.float32)
+        np.testing.assert_allclose(np.asarray(out, np.float32), gold,
+                                   rtol=5e-2, atol=5e-2)
+        return out
+
+    check("gemm_ar_stream (fused, degenerate)", gemm_ar_fused)
+
     # Paged-KV attention (page-table scalar prefetch + per-page DMA).
     from triton_distributed_tpu.ops import (
         init_paged_kv_cache, paged_append, paged_decode_attention,

@@ -28,41 +28,12 @@ from triton_distributed_tpu.language.core import any_spec, kernel_call
 
 
 def grid_matmul(a, b, tm, tn, tk):
-    """Classic pallas_call grid matmul: Mosaic's own pipelining, parallel
-    dimension semantics on (i, j)."""
-    m, k = a.shape
-    _, n = b.shape
-    nk = k // tk
+    """The PRODUCTION grid-form kernel (ops/gemm.py pallas_matmul) at an
+    explicit tile config — the experiment must time the real code path,
+    not a local copy that could drift."""
+    from triton_distributed_tpu.ops.gemm import pallas_matmul
 
-    def kernel(a_ref, b_ref, o_ref, acc_ref):
-        kk = pl.program_id(2)
-
-        @pl.when(kk == 0)
-        def _():
-            acc_ref[...] = jnp.zeros_like(acc_ref)
-
-        acc_ref[...] += jnp.dot(a_ref[...], b_ref[...],
-                                preferred_element_type=jnp.float32)
-
-        @pl.when(kk == nk - 1)
-        def _():
-            o_ref[...] = acc_ref[...].astype(o_ref.dtype)
-
-    return pl.pallas_call(
-        kernel,
-        grid=(m // tm, n // tn, nk),
-        in_specs=[pl.BlockSpec((tm, tk), lambda i, j, q: (i, q)),
-                  pl.BlockSpec((tk, tn), lambda i, j, q: (q, j))],
-        out_specs=pl.BlockSpec((tm, tn), lambda i, j, q: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((m, n), a.dtype),
-        scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
-        cost_estimate=pl.CostEstimate(
-            flops=2 * m * k * n,
-            bytes_accessed=(m * k + k * n + m * n) * a.dtype.itemsize,
-            transcendentals=0),
-    )(a, b)
+    return pallas_matmul(a, b, tile_m=tm, tile_n=tn, tile_k=tk)
 
 
 def ep_matmul(a, b, tm, tn, tk, semantics=False):

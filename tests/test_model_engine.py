@@ -206,3 +206,20 @@ def test_decode_force_ar_kernel_runs_at_n1():
     assert int(idx2) == 2 * cfg.num_layers
     np.testing.assert_allclose(np.asarray(logits1), np.asarray(logits0),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_engine_fused_gemm_ar_matches_default(ctx, monkeypatch):
+    """TDTPU_GEMM_AR=1 routes every decode reduction through the fused
+    chunk-overlapped GEMM+AR stream kernel; greedy tokens must match the
+    default dot + parity-AR path."""
+    params = init_dense_llm(jax.random.PRNGKey(0), CFG)
+    ids = np.array([[3, 141, 59, 26]], np.int32)
+
+    eng = Engine(CFG, params, ctx, backend="ar", max_seq=64)
+    base = np.asarray(eng.serve(jnp.asarray(ids), gen_len=6))
+
+    monkeypatch.setenv("TDTPU_GEMM_AR", "1")
+    eng2 = Engine(CFG, params, ctx, backend="ar", max_seq=64)
+    assert eng2._use_fused_gemm_ar()
+    fused = np.asarray(eng2.serve(jnp.asarray(ids), gen_len=6))
+    np.testing.assert_array_equal(fused, base)

@@ -146,9 +146,17 @@ def tp_attn_prefill(params: dict, cfg: ModelConfig, x: jax.Array,
     # Tiled Pallas flash attention (ops/flash_attention.py) — flat-memory
     # causal prefill; dense fallback only for tiny/odd shapes. Reference:
     # the FA consumer the reference's TP_Attn runs (tp_attn.py:79-324).
-    from triton_distributed_tpu.ops.flash_attention import shard_attention
+    # Tile caps resolve through the autotuner at trace time (shapes are
+    # concrete; tuning measures once per shape/chip, disk-cached —
+    # VERDICT r3 #8: this path used to run only the static caps).
+    from triton_distributed_tpu.ops.flash_attention import (
+        resolve_flash_tiles, shard_attention,
+    )
 
-    attn = shard_attention(q, k, v, causal=True)
+    tq_cap, tk_cap = resolve_flash_tiles(q.shape[1], k.shape[1], q.shape[2],
+                                         k.shape[2], q.shape[3], q.dtype)
+    attn = shard_attention(q, k, v, causal=True, tile_q=tq_cap,
+                           tile_k=tk_cap)
     attn = attn.reshape(batch * seq, -1)
 
     if n == 1:
@@ -168,20 +176,25 @@ def tp_attn_prefill(params: dict, cfg: ModelConfig, x: jax.Array,
 
 
 def _out_proj(attn: jax.Array, params: dict, *, axis: str, n: int,
-              mode: str, ar_fn=None) -> jax.Array:
+              mode: str, ar_fn=None, gemm_ar_fn=None) -> jax.Array:
     """Row-parallel output projection + TP reduction (decode modes).
 
     ``ar_fn``: optional replacement for the default fused AllReduce — the
     decode loop passes the barrier-free parity-stream AR here
-    (ops/allreduce.all_reduce_stream via models/dense.py). At n=1 a
-    supplied ar_fn still runs (the force_ar_kernel bench path measures the
-    loopback kernel's overhead — without this, every reduction site
-    early-returns and the 'with AR kernel' number silently measures the
-    bare chain)."""
+    (ops/allreduce.all_reduce_stream via models/dense.py). ``gemm_ar_fn``
+    replaces the dot AND the reduction with the fused chunk-overlapped
+    GEMM+AR (ops/gemm_allreduce.gemm_ar_stream). At n=1 supplied hooks
+    still run (the force_ar_kernel bench path measures the loopback
+    kernel's overhead — without this, every reduction site early-returns
+    and the 'with AR kernel' number silently measures the bare chain)."""
     if n == 1:
+        if gemm_ar_fn is not None:
+            return gemm_ar_fn(attn, params["wo"])
         y = attn @ params["wo"]
         return ar_fn(y) if ar_fn is not None else y
     if mode == "ar":
+        if gemm_ar_fn is not None:
+            return gemm_ar_fn(attn, params["wo"])
         y = attn @ params["wo"]
         if ar_fn is not None:
             return ar_fn(y)
@@ -212,7 +225,7 @@ def tp_attn_prefill_chunk(params: dict, cfg: ModelConfig, x: jax.Array,
     (out, kv_slice with the chunk's k/v written at [start, start+chunk)).
     """
     from triton_distributed_tpu.ops.flash_attention import (
-        shard_attention_partial,
+        resolve_flash_tiles, shard_attention_partial,
     )
 
     n = num_ranks
@@ -230,9 +243,16 @@ def tp_attn_prefill_chunk(params: dict, cfg: ModelConfig, x: jax.Array,
         v=jax.lax.dynamic_update_slice(
             kv_slice.v, v.astype(kv_slice.v.dtype), (0, start, 0, 0)),
     )
+    # Autotuned tile caps (trace-time resolution, same rationale as the
+    # full prefill path above): mid-length chunks have a different optimum
+    # than the S=32k sweep's.
+    tq_cap, tk_cap = resolve_flash_tiles(
+        chunk_len, kv_slice.k.shape[1], q.shape[2], k.shape[2], q.shape[3],
+        q.dtype)
     acc, m, l = shard_attention_partial(
         q, new_kv.k.astype(q.dtype), new_kv.v.astype(q.dtype),
-        q_offset=start, k_offset=0, causal=True)
+        q_offset=start, k_offset=0, causal=True, tile_q=tq_cap,
+        tile_k=tk_cap)
     attn = (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
     attn = attn.reshape(batch * chunk_len, -1)
     return _out_proj(attn, params, axis=axis, n=n, mode=mode), new_kv
@@ -269,7 +289,7 @@ def tp_attn_decode_paged(params: dict, cfg: ModelConfig, x: jax.Array,
 def tp_attn_decode(params: dict, cfg: ModelConfig, x: jax.Array,
                    kv_slice: KVSlice, pos: jax.Array, *,
                    axis: str = "tp", num_ranks: int = 1, mode: str = "ar",
-                   ar_fn=None):
+                   ar_fn=None, gemm_ar_fn=None):
     """Single-token decode step. x: (B, h) replicated (ar modes only — a
     1-row activation cannot be row-sharded; reference dense.py uses the AR
     path for decode too). ``pos``: scalar current position. Returns
@@ -294,4 +314,4 @@ def tp_attn_decode(params: dict, cfg: ModelConfig, x: jax.Array,
     attn = attn.reshape(batch, -1)
 
     return _out_proj(attn, params, axis=axis, n=n, mode=mode,
-                     ar_fn=ar_fn), new_kv
+                     ar_fn=ar_fn, gemm_ar_fn=gemm_ar_fn), new_kv

@@ -82,18 +82,26 @@ def pick_mode(mode: str, m_total: int, n: int, *, hidden: int | None = None,
 
 def tp_mlp_fwd(params: dict, x: jax.Array, *, axis: str = "tp",
                num_ranks: int = 1, mode: str = "overlap",
-               ar_fn=None) -> jax.Array:
+               ar_fn=None, gemm_ar_fn=None) -> jax.Array:
     """Device-local TP MLP forward with a concrete mode (models resolve
     ``auto`` via :func:`pick_mode` — the input layout depends on it).
     See module docstring for layouts. ``ar_fn`` optionally replaces the
     fused AllReduce of mode="ar" (the decode loop's barrier-free
-    parity-stream AR, ops/allreduce.all_reduce_stream)."""
+    parity-stream AR, ops/allreduce.all_reduce_stream); ``gemm_ar_fn``
+    goes one step further and replaces the down-proj dot AND its
+    reduction with the fused chunk-overlapped GEMM+AR kernel
+    (ops/gemm_allreduce.gemm_ar_stream)."""
     n = num_ranks
     wg, wu, wd = params["w_gate"], params["w_up"], params["w_down"]
     if n == 1:
-        y = swiglu(x @ wg, x @ wu) @ wd
-        # A supplied ar_fn still runs at n=1: the force_ar_kernel bench
-        # path measures the loopback AR kernel's overhead here.
+        act = swiglu(x @ wg, x @ wu)
+        # Supplied hooks still run at n=1: the force_ar_kernel bench path
+        # measures the loopback kernel overhead here. gemm_ar_fn is the
+        # FUSED matmul+AR (ops/gemm_allreduce.gemm_ar_stream) — it
+        # replaces the dot itself, not just the reduction.
+        if gemm_ar_fn is not None:
+            return gemm_ar_fn(act, wd)
+        y = act @ wd
         return ar_fn(y) if ar_fn is not None else y
 
     if mode == "auto":
@@ -109,7 +117,10 @@ def tp_mlp_fwd(params: dict, x: jax.Array, *, axis: str = "tp",
         return jax.lax.psum_scatter(h @ wd, axis, scatter_dimension=0,
                                     tiled=True)
     if mode == "ar":
-        partial = swiglu(x @ wg, x @ wu) @ wd
+        act = swiglu(x @ wg, x @ wu)
+        if gemm_ar_fn is not None:
+            return gemm_ar_fn(act, wd)
+        partial = act @ wd
         if ar_fn is not None:
             return ar_fn(partial)
         return all_reduce_local(partial, axis=axis, num_ranks=n)

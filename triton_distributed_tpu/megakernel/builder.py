@@ -396,6 +396,17 @@ class MegaKernelBuilder:
                 f"prefetch of tile {self._pending_pf} never consumed — the "
                 "kernel would exit with an outstanding DMA on the reserved "
                 "slot (emit the matching gemm(prefetch_first=True))")
+        retired = {TaskType.GEMM, TaskType.ROPE}
+        for t in self._tasks:
+            if t.type in retired:
+                # The kernel keeps these switch slots as no-ops for queue-
+                # ABI stability; executing one would silently skip work
+                # (output tiles never written — garbage from stale
+                # workspace data). Fail at build time instead.
+                raise ValueError(
+                    f"task type {t.type.name} is retired (GEMM -> "
+                    "GEMM_WIDE, ROPE -> NORM_ROPE); the kernel would "
+                    "no-op it silently")
         order = topo_schedule(len(self._tasks), self._edges)
         if num_ranks > 1:
             # Cross-device tasks must execute in the same relative order on

@@ -101,7 +101,7 @@ def _logits(params: dict, cfg: ModelConfig, x: jax.Array, *, axis: str,
 
 
 def _mlp_or_moe(layer: dict, cfg: ModelConfig, h: jax.Array, *, axis: str,
-                n: int, mode: str, ar_fn=None) -> jax.Array:
+                n: int, mode: str, ar_fn=None, gemm_ar_fn=None) -> jax.Array:
     """FFN block dispatch: dense SwiGLU TP-MLP or TP-MoE (Qwen3-MoE)."""
     if "moe" in layer:
         from triton_distributed_tpu.ops.moe import moe_tp_fwd_local
@@ -116,7 +116,7 @@ def _mlp_or_moe(layer: dict, cfg: ModelConfig, h: jax.Array, *, axis: str,
             cfg.num_experts_per_tok, axis=axis, num_ranks=n, mode=moe_mode,
             ar_fn=ar_fn)
     return tp_mlp_fwd(layer["mlp"], h, axis=axis, num_ranks=n, mode=mode,
-                      ar_fn=ar_fn)
+                      ar_fn=ar_fn, gemm_ar_fn=gemm_ar_fn)
 
 
 def dense_prefill(params: dict, cfg: ModelConfig, input_ids: jax.Array,
@@ -237,9 +237,34 @@ def make_ar_stream_fn(ar_state, *, axis: str, n: int,
     return ar_fn, lambda: (state[0], state[1])
 
 
+def make_gemm_ar_stream_fn(state0, *, axis: str, n: int,
+                           force_kernel: bool = False):
+    """Build the FUSED GEMM+AR hook for the decode walk: every mode="ar"
+    row-parallel projection (attn out-proj, MLP down-proj) runs
+    ops/gemm_allreduce.gemm_ar_stream — each output chunk's AR pushes
+    overlap the next chunk's matmul inside one kernel, instead of the
+    reduction's full latency trailing the dot (reference
+    low_latency_gemm_allreduce_op). ``state0``: (ws, idx) from
+    gemm_ar_stream_workspace(n, B, hidden, dtype) — ONE workspace shared
+    by every site (all reduce the same (B, hidden) shape). Returns
+    (gemm_ar_fn, final_state_getter)."""
+    from triton_distributed_tpu.ops.gemm_allreduce import gemm_ar_stream
+
+    state = list(state0)
+
+    def gemm_ar_fn(x, w):
+        out, ws, idx = gemm_ar_stream(x, w, state[0], state[1],
+                                      axis=axis, num_ranks=n,
+                                      force_kernel=force_kernel)
+        state[0], state[1] = ws, idx
+        return out
+
+    return gemm_ar_fn, lambda: (state[0], state[1])
+
+
 def _decode_body(params: dict, cfg: ModelConfig, tokens: jax.Array,
                  attend, *, axis: str, n: int, mode: str,
-                 ar_fn=None) -> jax.Array:
+                 ar_fn=None, gemm_ar_fn=None) -> jax.Array:
     """Shared one-token transformer walk; ``attend(i, attn_params, h)``
     supplies the attention (and threads its cache via closure)."""
     x = params["embed"][tokens]  # (B, h)
@@ -249,14 +274,16 @@ def _decode_body(params: dict, cfg: ModelConfig, tokens: jax.Array,
         h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp_or_moe(
             layer, cfg, h, axis=axis, n=n,
-            mode=mode if mode in ("ar", "xla_rep") else "ar", ar_fn=ar_fn)
+            mode=mode if mode in ("ar", "xla_rep") else "ar", ar_fn=ar_fn,
+            gemm_ar_fn=gemm_ar_fn)
     return _logits(params, cfg, x, axis=axis, n=n)
 
 
 def dense_decode_step(params: dict, cfg: ModelConfig, tokens: jax.Array,
                       cache: KVCache, *, axis: str = "tp",
                       num_ranks: int = 1, mode: str = "ar",
-                      ar_state=None, force_ar_kernel: bool = False):
+                      ar_state=None, force_ar_kernel: bool = False,
+                      fused_gemm_ar: bool = False):
     """Device-local one-token decode. tokens: (B,) replicated. Returns
     (logits (B, vocab), cache advanced by one); with ``ar_state`` given
     (barrier-free parity AR), returns (logits, cache, ar_state').
@@ -264,24 +291,34 @@ def dense_decode_step(params: dict, cfg: ModelConfig, tokens: jax.Array,
     ``force_ar_kernel``: run the parity-stream AR kernel even at n=1 (the
     degenerate loopback grid) — single-chip benches use it so decode
     numbers can be labeled with the kernel overhead included rather than
-    silently excluding all communication (round-3 advisor finding)."""
+    silently excluding all communication (round-3 advisor finding).
+
+    ``fused_gemm_ar``: ``ar_state`` is a gemm_ar_stream_workspace and
+    every row-parallel projection runs the FUSED chunk-overlapped GEMM+AR
+    kernel (ops/gemm_allreduce.gemm_ar_stream) instead of dot + AR —
+    the reference's low_latency_gemm_allreduce_op path."""
     n = num_ranks
     pos = cache.offset
-    ar_fn = final = None
+    ar_fn = gemm_ar_fn = final = None
     if ar_state is not None and mode == "ar" and (n > 1 or force_ar_kernel):
-        ar_fn, final = make_ar_stream_fn(ar_state, axis=axis, n=n,
-                                         force_kernel=force_ar_kernel)
+        if fused_gemm_ar:
+            gemm_ar_fn, final = make_gemm_ar_stream_fn(
+                ar_state, axis=axis, n=n, force_kernel=force_ar_kernel)
+        else:
+            ar_fn, final = make_ar_stream_fn(ar_state, axis=axis, n=n,
+                                             force_kernel=force_ar_kernel)
 
     def attend(i, attn_params, h):
         nonlocal cache
         out, kv = tp_attn_decode(attn_params, cfg, h, cache.layer(i), pos,
                                  axis=axis, num_ranks=n, mode=mode,
-                                 ar_fn=ar_fn)
+                                 ar_fn=ar_fn, gemm_ar_fn=gemm_ar_fn)
         cache = cache.with_layer(i, kv)
         return out
 
     logits = _decode_body(params, cfg, tokens, attend,
-                          axis=axis, n=n, mode=mode, ar_fn=ar_fn)
+                          axis=axis, n=n, mode=mode, ar_fn=ar_fn,
+                          gemm_ar_fn=gemm_ar_fn)
     cache = cache._replace(offset=pos + 1)
     if ar_state is not None:
         return logits, cache, (final() if final is not None else ar_state)

@@ -127,22 +127,43 @@ class Engine:
                                         dense_decode_step_paged)
                 and os.environ.get("TDTPU_AR_STREAM", "1") != "0")
 
+    def _use_fused_gemm_ar(self) -> bool:
+        """Fused chunk-overlapped GEMM+AR on the decode path (opt-in,
+        TDTPU_GEMM_AR=1): the row-parallel projections run
+        ops/gemm_allreduce.gemm_ar_stream instead of dot + parity-AR.
+        Linear-cache dense decode only (the paged step keeps dot+AR)."""
+        import os
+
+        return (self._use_ar_stream()
+                and self._decode_fn is dense_decode_step
+                and os.environ.get("TDTPU_GEMM_AR", "0") == "1")
+
     def _ar_state(self, batch: int):
         """Host-level persistent parity workspace, sharded one slab per
         device (allocated once per batch shape; threaded + donated through
         the decode loop so the buffer address is stable — the symmetric-
         memory persistence the barrier-free protocol requires)."""
-        key = ("ar_ws", batch)
+        key = ("ar_ws", batch, self._use_fused_gemm_ar())
         if key not in self._jit_cache:
             from jax.sharding import NamedSharding
-
-            from triton_distributed_tpu.ops.allreduce import _ar_rows_padded
 
             mesh = self.ctx.mesh
             h = self.cfg.hidden_size
             dt = jnp.dtype(self.cfg.dtype)
-            ws = jnp.zeros((self.n, 2, self.n, _ar_rows_padded(batch, dt), h),
-                           dt)
+            if self._use_fused_gemm_ar():
+                from triton_distributed_tpu.ops.gemm_allreduce import (
+                    gemm_ar_stream_workspace,
+                )
+
+                ws0, _ = gemm_ar_stream_workspace(self.n, batch, h, dt)
+                ws = jnp.broadcast_to(ws0, (self.n,) + ws0.shape)
+            else:
+                from triton_distributed_tpu.ops.allreduce import (
+                    _ar_rows_padded,
+                )
+
+                ws = jnp.zeros(
+                    (self.n, 2, self.n, _ar_rows_padded(batch, dt), h), dt)
             ws = jax.device_put(ws, NamedSharding(mesh, P(self.axis)))
             idx = jax.device_put(jnp.zeros((), jnp.int32),
                                  NamedSharding(mesh, P()))
@@ -150,18 +171,21 @@ class Engine:
         return self._jit_cache[key]
 
     def _decode_jit(self, ar_stream: bool):
-        key = ("decode", ar_stream)
+        key = ("decode", ar_stream, self._use_fused_gemm_ar())
         if key not in self._jit_cache:
             mode = self._decode_mode()
             cspecs = (paged_cache_specs(self.axis) if self.page_size
                       else kv_cache_specs(self.axis))
 
             if ar_stream:
+                fused = self._use_fused_gemm_ar()
+                extra = {"fused_gemm_ar": True} if fused else {}
+
                 def step(params, tokens, cache, ws, idx):
                     logits, cache, (ws, idx) = self._decode_fn(
                         params, self.cfg, tokens, cache,
                         axis=self.axis, num_ranks=self.n, mode=mode,
-                        ar_state=(ws[0], idx))
+                        ar_state=(ws[0], idx), **extra)
                     return sampling.greedy(logits), cache, ws[None], idx
 
                 fn = self._shard(
@@ -283,7 +307,8 @@ class Engine:
             ws, idx = self._ar_state(batch)
             tok, cache, ws, idx = self._decode_jit(True)(
                 self.params, tokens, cache, ws, idx)
-            self._jit_cache[("ar_ws", batch)] = (ws, idx)
+            self._jit_cache[("ar_ws", batch,
+                             self._use_fused_gemm_ar())] = (ws, idx)
             return tok, cache
         return self._decode_jit(False)(self.params, tokens, cache)
 

@@ -335,12 +335,16 @@ def _positional_mask(sq: int, sk: int, q_offset, k_offset, causal: bool):
 def shard_attention_partial(q, k, v, *, q_offset=0, k_offset=0,
                             causal: bool = True,
                             tile_q: int = DEFAULT_TILE_Q,
-                            tile_k: int = DEFAULT_TILE_K):
+                            tile_k: int = DEFAULT_TILE_K,
+                            tiles: tuple[int, int] | None = None):
     """Partial attention over one KV shard: tiled flash kernel when the
     shapes support it, dense `_block_attn` otherwise. Same (acc, m, l)
     return contract either way — the single entry point the SP family
-    (ring / SP-AG) uses per shard. ``tile_q/tile_k`` override the swept
-    defaults (host wrappers pass autotuned caps when tuning is on)."""
+    (ring / SP-AG) uses per shard. ``tile_q/tile_k`` (or the ``tiles``
+    pair, which wins when given — the host wrappers' autotuned caps)
+    override the swept defaults."""
+    if tiles is not None:
+        tile_q, tile_k = tiles
     if flash_supported(q, k):
         return flash_attention_partial(q, k, v, q_offset=q_offset,
                                        k_offset=k_offset, causal=causal,
@@ -355,19 +359,33 @@ def resolve_flash_tiles(sq: int, sk: int, hq: int, hkv: int, d: int,
     """Tile caps for the SP wrappers: on-chip autotuned when tuning is on
     (runtime/autotuner.tuned_flash_tiles — the S=4k optimum measured
     512x1024 while S=32k measured 1024x1024), swept defaults otherwise.
-    Call at the HOST level (e.g. inside a jit-cache make()) — tuning
-    launches real measurements."""
+
+    Call at the HOST level — either inside a jit-cache make() (the SP
+    wrappers) or at TRACE time of a jitted layer fn (tp_attn prefill):
+    tracing is host-side Python, shapes are concrete, and the tuner's
+    measurements run eagerly on its own concrete arrays. Either way the
+    first call for a new (shape, dtype, chip) blocks on real measurements
+    (~30s/candidate through the compile relay) and every later call is a
+    disk-cache hit."""
     from triton_distributed_tpu.runtime.autotuner import tuned_flash_tiles
 
     tiles = tuned_flash_tiles(sq, sk, hq, hkv, d, dtype)
     return tiles if tiles else (DEFAULT_TILE_Q, DEFAULT_TILE_K)
 
 
-def shard_attention(q, k, v, *, causal: bool = True):
+def shard_attention(q, k, v, *, causal: bool = True,
+                    tile_q: int = DEFAULT_TILE_Q,
+                    tile_k: int = DEFAULT_TILE_K,
+                    tiles: tuple[int, int] | None = None):
     """Normalized single-shard attention (flash when supported) — the dense
-    SDPA drop-in for prefill (ops/ulysses.py, layers/tp_attn.py)."""
+    SDPA drop-in for prefill (ops/ulysses.py, layers/tp_attn.py).
+    ``tile_q/tile_k`` (or the ``tiles`` pair, which wins when given)
+    override the swept defaults."""
+    if tiles is not None:
+        tile_q, tile_k = tiles
     if flash_supported(q, k):
-        return flash_attention(q, k, v, causal=causal)
+        return flash_attention(q, k, v, causal=causal, tile_q=tile_q,
+                               tile_k=tile_k)
     mask = _positional_mask(q.shape[1], k.shape[1], 0, 0, causal)
     acc, _, l = _block_attn(q, k, v, mask)
     return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)

@@ -36,12 +36,14 @@ def sp_ag_attention_local(q: jax.Array, k_shard: jax.Array,
                           v_shard: jax.Array, *, axis: str = "sp",
                           num_ranks: int | None = None,
                           causal: bool = True,
-                          method: AllGatherMethod | str = AllGatherMethod.AUTO
-                          ) -> jax.Array:
+                          method: AllGatherMethod | str = AllGatherMethod.AUTO,
+                          tiles: tuple[int, int] | None = None) -> jax.Array:
     """Device-local SP AG attention inside shard_map.
 
     q/k_shard/v_shard: (B, S/n, h*, d) sequence shards. Returns
     (B, S/n, hq, d) — local queries attended over the full (causal) sequence.
+    ``tiles``: (tile_q, tile_k) flash caps (host wrappers pass autotuned
+    values; None = swept defaults).
     """
     if num_ranks is None:
         raise ValueError("num_ranks required inside shard_map")
@@ -52,7 +54,7 @@ def sp_ag_attention_local(q: jax.Array, k_shard: jax.Array,
 
     if n == 1:
         acc, m, l = shard_attention_partial(q, k_shard, v_shard,
-                                            causal=causal)
+                                            causal=causal, tiles=tiles)
         return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
     # Producer: Pallas AG of the KV shards (flattened to 2-D rows).
@@ -70,11 +72,12 @@ def sp_ag_attention_local(q: jax.Array, k_shard: jax.Array,
     # entirely behind the diagonal skip their dots in-kernel.
     q_off = me * sq
     state = shard_attention_partial(q, k_shard, v_shard, q_offset=q_off,
-                                    k_offset=me * sk, causal=causal)
+                                    k_offset=me * sk, causal=causal, tiles=tiles)
 
     def body(r, state):
         acc, m, l = shard_attention_partial(q, ks[r], vs[r], q_offset=q_off,
-                                            k_offset=r * sk, causal=causal)
+                                            k_offset=r * sk, causal=causal,
+                                            tiles=tiles)
         # r == me is the diagonal chunk already accumulated above.
         keep = (r != me).astype(jnp.float32)
         return _merge(state, (acc * keep, m, l * keep))
@@ -94,8 +97,19 @@ def sp_ag_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     key = (axis, causal, q.shape, k.shape, str(q.dtype))
 
     def make():
+        # Tile caps resolved HERE (host level, once per shape signature) —
+        # autotuned on-chip when tuning is on (VERDICT r3 #8: the non-ring
+        # prefill paths ran static caps and left the measured S=4k optimum
+        # on the table).
+        from triton_distributed_tpu.ops.flash_attention import (
+            resolve_flash_tiles,
+        )
+
+        tiles = resolve_flash_tiles(q.shape[1] // n, k.shape[1] // n,
+                                    q.shape[2], k.shape[2], q.shape[3],
+                                    q.dtype)
         return functools.partial(sp_ag_attention_local, axis=axis,
-                                 num_ranks=n, causal=causal)
+                                 num_ranks=n, causal=causal, tiles=tiles)
 
     jfn = cached_shard_jit(ctx, "sp_ag_attention", key, make,
                           (P(None, axis), P(None, axis), P(None, axis)),

@@ -58,24 +58,33 @@ def swizzled_ranks(me, n: int):
 
 def matmul_tiles(
     a_view,               # ref view (m, k) in HBM/ANY
-    b_view,               # ref view (k, ncols)
+    b_view,               # ref view (k, >= b_col_block_offset*tn + ncols)
     out_view,             # ref view (m, ncols)
     m: int, k: int, ncols: int,
     tm: int, tk: int, tn: int,
     acc,                  # VMEM (tm, tn) fp32 accumulator scratch
+    b_col_block_offset: int = 0,
 ):
-    """Pipelined tiled matmul: out = A @ B with fp32 MXU accumulation.
+    """Pipelined tiled matmul: out = A @ B[:, off:off+ncols] with fp32 MXU
+    accumulation (off = b_col_block_offset * tn).
 
     The compute core shared by the overlapped kernels (the analog of the
     reference's persistent consumer GEMM inner loop,
     allgather_gemm.py:217-264, minus readiness waits — callers interleave
     waits around chunk boundaries).
 
+    ``b_col_block_offset`` selects a column-chunk of B through the
+    BlockSpec index map instead of a lane-dim sliced ref view — Mosaic
+    crashes (SIGABRT) pipelining over `.at[:, cols]` views, so chunked
+    consumers (ops/gemm_allreduce.py) pass block offsets and keep every
+    ref whole.
+
     Uses ``pltpu.emit_pipeline`` so every A/B tile fetch and out tile flush
     is double-buffered against the MXU dots — the DMA/compute overlap the
     reference gets from its software-pipelined persistent GEMM.
     """
     nk = k // tk
+    off_j = b_col_block_offset
 
     def body(a_v, b_v, o_v, acc_ref):
         kk = pl.program_id(2)
@@ -98,7 +107,7 @@ def matmul_tiles(
         grid=(m // tm, ncols // tn, nk),
         in_specs=[
             pl.BlockSpec((tm, tk), lambda i, j, q: (i, q)),
-            pl.BlockSpec((tk, tn), lambda i, j, q: (q, j)),
+            pl.BlockSpec((tk, tn), lambda i, j, q: (q, j + off_j)),
         ],
         out_specs=[pl.BlockSpec((tm, tn), lambda i, j, q: (i, j))],
     )(a_view, b_view, out_view, scratches=[acc])

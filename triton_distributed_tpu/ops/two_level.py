@@ -135,3 +135,119 @@ def reduce_scatter_2d(x: jax.Array, ctx: DistContext | None = None,
                       intra_axis, inter_axis,
                       lambda ni, no: P((inter_axis, intra_axis)),
                       stacked=True)
+
+
+def fast_all_to_all_2d_local(send_buf: jax.Array, send_splits: jax.Array, *,
+                             intra_axis: str = "tp",
+                             inter_axis: str = "dcn",
+                             n_intra: int | None = None,
+                             n_inter: int | None = None
+                             ) -> tuple[jax.Array, jax.Array]:
+    """Hierarchical EP AllToAll: one DCN hop groups token slots by
+    destination slice, then the Pallas intra-slice AllToAll delivers each
+    source slice's block over ICI.
+
+    send_buf: (N, cap, hidden), N = n_inter·n_intra, slot g = tokens for
+    global rank g's experts (g = inter·n_intra + intra — dispatch_layout's
+    layout unchanged); send_splits: (N, epr). Returns (recv_buf (N, cap,
+    hidden), recv_splits (N, epr)) ordered by global SOURCE rank — the
+    same contract as ops/all_to_all.fast_all_to_all_local, so
+    combine_layout and the EP-MoE layer compose unchanged.
+
+    Reference: the 4-node low-latency MoE AllToAll (IB across nodes +
+    NVLink within, low_latency_all_to_all.py / README.md:96-97); SURVEY.md
+    §7 maps the inter tier to DCN where Pallas remote DMA does not reach.
+    """
+    if n_intra is None or n_inter is None:
+        raise ValueError("n_intra/n_inter required inside shard_map")
+    from triton_distributed_tpu.ops.all_to_all import fast_all_to_all_local
+
+    N, cap, hidden = send_buf.shape
+    epr = send_splits.shape[1]
+    if N != n_inter * n_intra:
+        raise ValueError(f"send_buf slots {N} != {n_inter}*{n_intra}")
+    if n_inter == 1:
+        return fast_all_to_all_local(send_buf, send_splits,
+                                     axis=intra_axis, num_ranks=n_intra)
+
+    # DCN hop: device (a, i) sends its dest-slice-b block to (b, i);
+    # afterwards block [s] holds what slice-peer (s, i) destined for MY
+    # slice's ranks. Splits ride the same exchange.
+    buf = send_buf.reshape(n_inter, n_intra, cap, hidden)
+    spl = send_splits.reshape(n_inter, n_intra, epr)
+    buf = jax.lax.all_to_all(buf, inter_axis, split_axis=0, concat_axis=0,
+                             tiled=False)
+    spl = jax.lax.all_to_all(spl, inter_axis, split_axis=0, concat_axis=0,
+                             tiled=False)
+
+    # Intra tier: per source slice, the Pallas AllToAll delivers to the
+    # final intra rank. recv block for global source (s, i) = rb_s[i].
+    rbs, rss = [], []
+    for s in range(n_inter):
+        rb, rs = fast_all_to_all_local(buf[s], spl[s], axis=intra_axis,
+                                       num_ranks=n_intra)
+        rbs.append(rb)
+        rss.append(rs)
+    recv_buf = jnp.stack(rbs).reshape(N, cap, hidden)
+    recv_splits = jnp.stack(rss).reshape(N, epr)
+    return recv_buf, recv_splits
+
+
+def sp_ag_attention_2d_local(q: jax.Array, k_shard: jax.Array,
+                             v_shard: jax.Array, *,
+                             intra_axis: str = "tp",
+                             inter_axis: str = "dcn",
+                             n_intra: int | None = None,
+                             n_inter: int | None = None,
+                             causal: bool = True,
+                             tiles: tuple[int, int] | None = None
+                             ) -> jax.Array:
+    """Hierarchical SP attention: KV is gathered within the slice by the
+    Pallas AllGather (ICI), each slice's aggregated block crosses DCN ONCE,
+    and the flash consumer merges per-slice chunks with the online-LSE
+    contract.
+
+    q/k_shard/v_shard: (B, S/N, h*, d) sequence shards by global index
+    g = inter·n_intra + intra. Returns (B, S/N, hq, d).
+
+    Reference: ``sp_ag_attention_inter_node.py`` (NVSHMEM inter-node KV
+    gather feeding the same waiting flash consumer).
+    """
+    if n_intra is None or n_inter is None:
+        raise ValueError("n_intra/n_inter required inside shard_map")
+    from triton_distributed_tpu.ops.flash_attention import (
+        _merge, shard_attention_partial,
+    )
+
+    b, sq, hq, d = q.shape
+    sk, hkv = k_shard.shape[1], k_shard.shape[2]
+    me_intra = jax.lax.axis_index(intra_axis)
+    me_inter = jax.lax.axis_index(inter_axis)
+    g = me_inter * n_intra + me_intra
+    q_off = g * sq
+
+    # Intra tier: Pallas AG of the slice's KV shards over ICI.
+    flat = jnp.concatenate(
+        [k_shard.reshape(b * sk, hkv * d), v_shard.reshape(b * sk, hkv * d)],
+        axis=1)
+    slice_kv = all_gather_local(flat, axis=intra_axis, num_ranks=n_intra)
+    # DCN tier: each slice's aggregated block crosses once.
+    all_kv = jax.lax.all_gather(slice_kv, inter_axis)   # (n_inter, ...)
+    all_kv = all_kv.reshape(n_inter, n_intra, b, sk, 2, hkv, d)
+
+    state = shard_attention_partial(q, k_shard, v_shard, q_offset=q_off,
+                                    k_offset=g * sk, causal=causal, tiles=tiles)
+
+    def body(r, state):
+        a, j = r // n_intra, r % n_intra
+        ks = all_kv[a, j, :, :, 0]
+        vs = all_kv[a, j, :, :, 1]
+        acc, m, l = shard_attention_partial(q, ks, vs, q_offset=q_off,
+                                            k_offset=r * sk, causal=causal,
+                                            tiles=tiles)
+        keep = (r != g).astype(jnp.float32)   # diagonal chunk done above
+        return _merge(state, (acc * keep, m, l * keep))
+
+    state = jax.lax.fori_loop(0, n_inter * n_intra, body, state)
+    acc, m, l = state
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)

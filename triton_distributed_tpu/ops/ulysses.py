@@ -34,18 +34,19 @@ from triton_distributed_tpu.runtime.context import DistContext, get_context
 from triton_distributed_tpu.runtime.jit_cache import cached_shard_jit
 
 
-def _sdpa(q, k, v, causal: bool):
+def _sdpa(q, k, v, causal: bool, tiles=None):
     """Per-head-shard attention after the exchange: the tiled Pallas flash
     kernel (ops/flash_attention.py) on supported shapes, dense fallback on
     tiny/odd ones. q: (B, S, Hq, d); k/v (B, S, Hkv, d)."""
     from triton_distributed_tpu.ops.flash_attention import shard_attention
 
-    return shard_attention(q, k, v, causal=causal)
+    return shard_attention(q, k, v, causal=causal, tiles=tiles)
 
 
 def ulysses_attention_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
                             axis: str = "sp", num_ranks: int | None = None,
-                            causal: bool = True) -> jax.Array:
+                            causal: bool = True,
+                            tiles: tuple[int, int] | None = None) -> jax.Array:
     """Device-local Ulysses attention inside shard_map.
 
     q: (B, S/n, Hq, d); k/v: (B, S/n, Hkv, d) — sequence-sharded.
@@ -55,7 +56,7 @@ def ulysses_attention_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
         raise ValueError("num_ranks required inside shard_map")
     n = num_ranks
     if n == 1:
-        return _sdpa(q, k, v, causal)
+        return _sdpa(q, k, v, causal, tiles)
     hq, hkv = q.shape[2], k.shape[2]
     if hq % n or hkv % n:
         raise ValueError(f"heads ({hq}, {hkv}) not divisible by axis size {n}")
@@ -64,7 +65,7 @@ def ulysses_attention_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
     a2a = functools.partial(jax.lax.all_to_all, axis_name=axis,
                             split_axis=2, concat_axis=1, tiled=True)
     qg, kg, vg = a2a(q), a2a(k), a2a(v)
-    out = _sdpa(qg, kg, vg, causal)
+    out = _sdpa(qg, kg, vg, causal, tiles)
     # Inverse exchange restores sequence sharding.
     return jax.lax.all_to_all(out, axis_name=axis, split_axis=1,
                               concat_axis=2, tiled=True)
@@ -79,8 +80,18 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     key = (axis, causal, q.shape, k.shape, str(q.dtype))
 
     def make():
+        # Post-exchange shapes: full S, heads/n — tile caps resolved at
+        # host level, autotuned on-chip when tuning is on (VERDICT r3 #8).
+        from triton_distributed_tpu.ops.flash_attention import (
+            resolve_flash_tiles,
+        )
+
+        tiles = resolve_flash_tiles(q.shape[1], k.shape[1],
+                                    max(q.shape[2] // n, 1),
+                                    max(k.shape[2] // n, 1), q.shape[3],
+                                    q.dtype)
         return functools.partial(ulysses_attention_local, axis=axis,
-                                 num_ranks=n, causal=causal)
+                                 num_ranks=n, causal=causal, tiles=tiles)
 
     spec = P(None, axis, None, None)
     jfn = cached_shard_jit(ctx, "ulysses_attention", key, make,
