@@ -212,10 +212,58 @@ def _measure_and_report():
     }
     if on_tpu:
         try:
+            result.update(_fp8_gemm_metric(a, b, lengths[:2]))
+        except Exception as e:  # additive metrics never block the headline
+            result["fp8_error"] = f"{type(e).__name__}: {str(e)[:120]}"
+        try:
             result.update(_decode_step_metric())
-        except Exception as e:  # decode metric is additive — never block
+        except Exception as e:
             result["decode_error"] = f"{type(e).__name__}: {str(e)[:120]}"
     print(json.dumps(result))
+
+
+def _fp8_gemm_metric(a_bf16, b_bf16, lengths):
+    """float8_e4m3fn GEMM lane vs bf16 (both through pallas_matmul,
+    interleaved same-window) at TWO shapes: the compute-bound square
+    north-star (ratio ~1 — no native fp8 MXU on this chip, the upcast
+    rides the load) and a weight-streaming decode shape (m=8), where
+    halving the weight bytes is the point. Reference: the fp8 payloads of
+    its flagship kernels (README.md:96-97)."""
+    from triton_distributed_tpu.ops.gemm import pallas_matmul
+
+    M, K = a_bf16.shape
+    flops = 2.0 * M * K * K
+    a8 = a_bf16.astype(jnp.float8_e4m3fn)
+    b8 = b_bf16.astype(jnp.float8_e4m3fn)
+    a_sk = a_bf16[:8]                       # weight-streaming decode shape
+    a_sk8 = a_sk.astype(jnp.float8_e4m3fn)
+
+    mk = lambda: jax.jit(functools.partial(  # noqa: E731
+        _chain, lambda x, w: pallas_matmul(x, w)), static_argnums=2)
+    fns = {"bf16": mk(), "fp8": mk(), "bf16_m8": mk(), "fp8_m8": mk()}
+    args = {"bf16": (a_bf16, b_bf16), "fp8": (a8, b8),
+            "bf16_m8": (a_sk, b_bf16), "fp8_m8": (a_sk8, b8)}
+    n1, n2 = lengths
+    for name, fn in fns.items():
+        for n in lengths:
+            _timed_once(fn, *args[name], n)
+    best = {(name, n): float("inf") for name in fns for n in lengths}
+    for _p in range(2):
+        for _t in range(3):
+            for name, fn in fns.items():
+                for n in lengths:
+                    best[(name, n)] = min(best[(name, n)],
+                                          _timed_once(fn, *args[name], n))
+        if _p == 0:
+            time.sleep(2)
+    per = {name: (best[(name, n2)] - best[(name, n1)]) / (n2 - n1)
+           for name in fns}
+    if min(per.values()) <= 0:
+        raise BenchError("non-positive fp8 differential")
+    return {"fp8_gemm_tflops": round(flops / per["fp8"] / 1e12, 3),
+            "fp8_vs_bf16": round(per["bf16"] / per["fp8"], 4),
+            "fp8_vs_bf16_decode_shape": round(
+                per["bf16_m8"] / per["fp8_m8"], 4)}
 
 
 def _decode_step_metric(gen=(3, 10)):

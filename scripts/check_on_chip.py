@@ -56,6 +56,41 @@ def main() -> int:
     a = jnp.asarray(rng.standard_normal((256, 512)) * 0.1, jnp.bfloat16)
     b = jnp.asarray(rng.standard_normal((512, 256)) * 0.1, jnp.bfloat16)
     check("pallas_matmul", lambda: pallas_matmul(a, b))
+
+    def fp8_matmul():
+        a8 = a.astype(jnp.float8_e4m3fn)
+        b8 = b.astype(jnp.float8_e4m3fn)
+        out = pallas_matmul(a8, b8, out_dtype=jnp.float32)
+        gold = np.asarray(a8.astype(jnp.float32)) @ np.asarray(
+            b8.astype(jnp.float32))
+        np.testing.assert_allclose(np.asarray(out), gold, rtol=1e-4,
+                                   atol=1e-4)
+        return out
+
+    check("pallas_matmul fp8 (e4m3)", fp8_matmul)
+
+    # Sub-chunk AG+GEMM: the degenerate 0-peer kernel still compiles the
+    # per-(source, sub-block) semaphore waits + per-sub matmul structure.
+    from jax.sharding import PartitionSpec as _P
+
+    from triton_distributed_tpu.ops.allgather_gemm import (
+        AGGemmConfig, ag_gemm_local,
+    )
+    from triton_distributed_tpu.runtime import shard_map_on
+
+    def ag_gemm_sub():
+        def run(a2, b2):
+            return ag_gemm_local(a2, b2, axis="tp", num_ranks=1,
+                                 cfg=AGGemmConfig(sub_chunks=2,
+                                                  force_kernel=True))
+
+        out = shard_map_on(ctx, run, (_P(), _P()), _P())(a, b)
+        gold = np.asarray(a, np.float32) @ np.asarray(b, np.float32)
+        np.testing.assert_allclose(np.asarray(out, np.float32), gold,
+                                   rtol=5e-2, atol=5e-2)
+        return out
+
+    check("ag_gemm sub-chunk (degenerate)", ag_gemm_sub)
     check("ag_gemm", lambda: ag_gemm(a, b, ctx))
     check("gemm_rs", lambda: gemm_rs(a, b, ctx))
     check("gemm_allreduce", lambda: gemm_allreduce(a, b, ctx))
@@ -109,6 +144,9 @@ def main() -> int:
     send = jnp.asarray(rng.standard_normal((1, 1, 32, 128)) * 0.1, jnp.float32)
     splits = jnp.asarray(np.full((1, 1, 2), 8), jnp.int32)
     check("fast_all_to_all", lambda: fast_all_to_all(send, splits, ctx)[0])
+    send8 = send.astype(jnp.float8_e4m3fn)
+    check("fast_all_to_all fp8 (e4m3)",
+          lambda: fast_all_to_all(send8, splits, ctx)[0])
 
     # Barrier-free parity-stream kernels (decode steady state): the n=1
     # degenerate grid still compiles the parity slicing, per-parity
@@ -269,6 +307,28 @@ def main() -> int:
 
     check("megakernel decode step (fp32)", lambda: mega(jnp.float32))
     check("megakernel decode step (bf16)", lambda: mega(jnp.bfloat16))
+
+    # fp8 weight workspace: GEMM_WIDE_W8 + PREFETCH_W8 stream e4m3 weight
+    # tiles (half the bytes) and upcast in VMEM.
+    def mega_fp8():
+        mb = MegaKernelBuilder()
+        x8 = mb.tensor(TILE, 2 * TILE)
+        w8 = mb.tensor(2 * TILE, 3 * TILE, fp8=True)
+        out8 = mb.tensor(TILE, 3 * TILE)
+        mb.prefetch(w8.tile(0, 0), fp8=True)
+        mb.gemm(out8, x8, w8, prefetch_first=True, width=3)
+        comp = mb.compile(dtype=jnp.bfloat16)
+        ax = rng.standard_normal((TILE, 2 * TILE)).astype(np.float32)
+        aw = rng.standard_normal((2 * TILE, 3 * TILE)).astype(np.float32) * 0.1
+        (res,) = comp.run({x8: jnp.asarray(ax), w8: jnp.asarray(aw)},
+                          outputs=[out8])
+        wq = np.asarray(jnp.asarray(aw).astype(jnp.float8_e4m3fn)
+                        .astype(jnp.float32))
+        np.testing.assert_allclose(np.asarray(res, np.float32), ax @ wq,
+                                   rtol=5e-2, atol=5e-2)
+        return res
+
+    check("megakernel fp8 weight workspace", mega_fp8)
 
     # In-kernel paged-attention task: page table in queue DATA rows, DMA
     # addresses read from SMEM per step.

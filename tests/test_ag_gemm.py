@@ -46,3 +46,33 @@ def test_gemm_rs(ctx, dtype):
 def test_ag_gemm_shape_errors(ctx):
     with pytest.raises((ValueError, TypeError)):
         ag_gemm(jnp.ones((8 * 16, 64)), jnp.ones((128, 8 * 16)), ctx)
+
+
+def test_pallas_matmul_fp8():
+    """float8_e4m3fn GEMM lane: fp8 operands, fp32 accumulation, bf16 out
+    — matches the upcast golden exactly (the fp8 values are exact in
+    bf16/f32, so the MXU accumulation is the only rounding source)."""
+    from triton_distributed_tpu.ops.gemm import pallas_matmul
+
+    rng = np.random.default_rng(11)
+    a = jnp.asarray(rng.standard_normal((64, 128)), jnp.float8_e4m3fn)
+    b = jnp.asarray(rng.standard_normal((128, 256)), jnp.float8_e4m3fn)
+    out = pallas_matmul(a, b, out_dtype=jnp.float32)
+    gold = np.asarray(a.astype(jnp.float32)) @ np.asarray(
+        b.astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), gold, rtol=1e-5, atol=1e-5)
+
+
+def test_ag_gemm_sub_chunk_odd_rows(ctx):
+    """Sub-chunked consumer with shard rows whose default tile does not
+    divide the sub-block (m=1152: pick_tile(m)=384, m_sub=576) — the
+    round-4 review's row-drop scenario. Every output row must be real."""
+    from triton_distributed_tpu.ops.allgather_gemm import AGGemmConfig
+
+    n, m, k, nc = 8, 1152, 128, 128
+    rng = np.random.default_rng(13)
+    a = jnp.asarray(rng.standard_normal((n * m, k)) * 0.1, jnp.float32)
+    b = jnp.asarray(rng.standard_normal((k, n * nc)) * 0.1, jnp.float32)
+    out = ag_gemm(a, b, ctx, cfg=AGGemmConfig(sub_chunks=2))
+    gold = np.asarray(a, np.float64) @ np.asarray(b, np.float64)
+    np.testing.assert_allclose(np.asarray(out), gold, rtol=2e-4, atol=2e-4)

@@ -91,9 +91,20 @@ def test_measure_chain_ranks_work():
             y = y + x @ w
         return y
 
-    t_cheap = measure_chain(cheap, (x, w), lengths=(4, 64), trials=2)
-    t_heavy = measure_chain(heavy, (x, w), lengths=(4, 64), trials=2)
-    assert t_heavy > t_cheap
+    # Wall-clock on a loaded CI host is jumpy: retry the whole measurement
+    # a few times before declaring the ranking broken (a transient
+    # non-positive differential under CPU contention is not a bug).
+    last = None
+    for _ in range(3):
+        try:
+            t_cheap = measure_chain(cheap, (x, w), lengths=(4, 64), trials=2)
+            t_heavy = measure_chain(heavy, (x, w), lengths=(4, 64), trials=2)
+            if t_heavy > t_cheap:
+                return
+            last = AssertionError(f"heavy {t_heavy} !> cheap {t_cheap}")
+        except RuntimeError as e:   # non-positive differential
+            last = e
+    raise last
 
 
 def test_default_cfg_resolution_off_chip(monkeypatch):
@@ -126,3 +137,54 @@ def test_tuned_flash_tiles_off_chip(monkeypatch):
 
     monkeypatch.setenv("TDTPU_AUTOTUNE", "0")   # force off even on TPU hosts
     assert tuned_flash_tiles(1024, 1024, 8, 1, 128, jnp.bfloat16) is None
+
+
+def test_comm_tuning_cache_roundtrip(ctx, tmp_path, monkeypatch):
+    """Comm-side tuning (TDTPU_AUTOTUNE_COMM): the AR one/two-shot/xla
+    crossover is measured through the real whole-mesh thunk, the winner is
+    disk-cached, and a second resolution is a pure cache hit (no
+    re-measure). Block timing on the CPU mesh exercises the MACHINERY —
+    the measured decision is only meaningful on real hardware."""
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.runtime import autotuner
+
+    monkeypatch.setenv("TDTPU_AUTOTUNE_CACHE",
+                       str(tmp_path / "autotune.json"))
+    autotuner._memory_cache.clear()
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((8, 16, 128)), jnp.float32)
+
+    best = autotuner.tuned_allreduce_method(x, ctx, axis="tp",
+                                            method="block")
+    assert best in ("one_shot", "two_shot", "xla")
+
+    # Second resolution must be a cache hit: contextual_autotune returns a
+    # None report on hits, and the memory cache must already hold the key.
+    calls = []
+    orig = autotuner.measure
+
+    def spy(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    monkeypatch.setattr(autotuner, "measure", spy)
+    best2 = autotuner.tuned_allreduce_method(x, ctx, axis="tp",
+                                             method="block")
+    assert best2 == best
+    assert not calls, "cache hit must not re-measure"
+
+    # Cross-process persistence: a fresh memory cache resolves from disk.
+    autotuner._memory_cache.clear()
+    best3 = autotuner.tuned_allreduce_method(x, ctx, axis="tp",
+                                             method="block")
+    assert best3 == best
+    assert not calls
+
+    # A2A block-rows tuning rides the same machinery.
+    sb = jnp.asarray(rng.standard_normal((8, 8, 32, 64)), jnp.float32)
+    sp = jnp.asarray(np.full((8, 8, 2), 2), np.int32)
+    b = autotuner.tuned_a2a_block_rows(sb, sp, ctx, axis="tp",
+                                       method="block")
+    assert b in (16, 32)

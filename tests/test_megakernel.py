@@ -257,3 +257,44 @@ def test_append_kv_task_and_retarget():
         want_v[pos, :] = feeds[v_new][0]
         np.testing.assert_allclose(got_k, want_k, rtol=1e-6)
         np.testing.assert_allclose(got_v, want_v, rtol=1e-6)
+
+
+def test_megakernel_fp8_weight_workspace():
+    """GEMM_WIDE_W8 + PREFETCH_W8: weights stream from the float8_e4m3fn
+    workspace (half the bytes) and the result matches the golden computed
+    on the e4m3-quantized weights exactly (fp32 compute path)."""
+    mb = MegaKernelBuilder()
+    m, k, n = 128, 256, 640
+    x = mb.tensor(m, k)
+    w = mb.tensor(k, n, fp8=True)
+    out = mb.tensor(m, n)
+    mb.prefetch(w.tile(0, 0), fp8=True)
+    mb.gemm(out, x, w, prefetch_first=True, width=3)
+    prog = mb.compile()
+    assert prog.num_tiles8 == (k // 128) * (n // 128)
+
+    rng = np.random.default_rng(9)
+    ax = rng.standard_normal((m, k)).astype(np.float32)
+    aw = rng.standard_normal((k, n)).astype(np.float32) * 0.1
+    (res,) = prog.run({x: jnp.asarray(ax), w: jnp.asarray(aw)},
+                      outputs=[out])
+    w_q = np.asarray(jnp.asarray(aw).astype(jnp.float8_e4m3fn)
+                     .astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(res), ax @ w_q, rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_fp8_handles_rejected_outside_gemm_b():
+    """fp8 weight-space handles alias main-workspace tile ids — every op
+    except the GEMM B operand must reject them at build time."""
+    mb = MegaKernelBuilder()
+    x = mb.tensor(128, 128)
+    w8 = mb.tensor(128, 128, fp8=True)
+    with pytest.raises(ValueError, match="fp8"):
+        mb.add(x, x, w8)
+    with pytest.raises(ValueError, match="fp8"):
+        mb.rms_norm(x, x, w8)
+    with pytest.raises(ValueError, match="fp8"):
+        mb.gemm(w8, x, x)     # fp8 as output
+    with pytest.raises(ValueError, match="fp8"):
+        mb.gemm(x, w8, x)     # fp8 as activation

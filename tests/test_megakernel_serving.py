@@ -81,3 +81,47 @@ def test_megakernel_serve_tp8_matches_ar(ctx):
     out_mk = np.asarray(eng_mk.serve(jnp.asarray(ids), gen_len=gen))
 
     np.testing.assert_array_equal(out_ar, out_mk)
+
+
+def test_megakernel_fp8_weights_matches_quantized_golden(ctx1, tiny_model):
+    """fp8_weights serving == the ar path run on e4m3-quantized weights:
+    the fp8 weight workspace must change ONLY the weight quantization, not
+    the transport/compute semantics."""
+    import jax.tree_util as jtu
+
+    from triton_distributed_tpu.megakernel.serving import MegakernelDecoder
+    from triton_distributed_tpu.models.dense import dense_prefill
+    from triton_distributed_tpu.models.kv_cache import init_kv_cache
+
+    cfg, params = tiny_model
+    ids = np.array([[3, 141, 59, 26, 5]], np.int32)
+    gen = 5
+
+    def quant(path, x):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"):
+            return jnp.asarray(x).astype(jnp.float8_e4m3fn).astype(x.dtype)
+        return x
+
+    params_q = jtu.tree_map_with_path(quant, params)
+
+    # Golden: ar Engine on pre-quantized weights.
+    eng_q = Engine(cfg, params_q, ctx1, backend="ar", max_seq=128)
+    out_q = np.asarray(eng_q.serve(jnp.asarray(ids), gen_len=gen))
+
+    # fp8 megakernel: full-precision params in, e4m3 workspace inside.
+    dec = MegakernelDecoder(cfg, params, max_seq=128, ctx=ctx1,
+                            num_ranks=1, fp8_weights=True)
+    cache = init_kv_cache(cfg, 1, 128, dtype=jnp.float32)
+    # Prefill must also see the quantized weights for token identity.
+    logits, cache = dense_prefill(params_q, cfg, jnp.asarray(ids), cache,
+                                  num_ranks=1)
+    tok = jnp.argmax(logits, -1).astype(jnp.int32)
+    ws = dec.start(cache)
+    toks = [int(tok[0])]
+    pos = int(cache.offset)
+    for _ in range(gen - 1):
+        ws, tok = dec.step(ws, tok, pos)
+        toks.append(int(tok[0]))
+        pos += 1
+    np.testing.assert_array_equal(np.asarray([toks]), out_q)

@@ -36,8 +36,14 @@ class MegaKernelBuilder:
     (the role of the reference's TaskDependency records,
     core/task_base.py:112-218)."""
 
+    # Hazard-id offset for fp8 weight-workspace tiles: their tile ids live
+    # in a separate space, so dependency bookkeeping must not collide them
+    # with main-workspace ids.
+    _W8_HAZARD = 1 << 30
+
     def __init__(self):
         self._num_tiles = 0
+        self._num_tiles8 = 0
         self._tasks: list[Task] = []
         self._edges: list[tuple[int, int]] = []
         self._last_writer: dict[int, int] = {}
@@ -51,13 +57,32 @@ class MegaKernelBuilder:
         self._pending_pf: int | None = None
 
     # -- tensors ------------------------------------------------------------
-    def tensor(self, rows: int, cols: int) -> TensorHandle:
+    def tensor(self, rows: int, cols: int, fp8: bool = False) -> TensorHandle:
+        """``fp8=True``: allocate in the float8_e4m3fn WEIGHT workspace (a
+        separate read-only input — GEMM B operands only; half the
+        weight-streaming bytes of bf16)."""
         if rows % TILE or cols % TILE:
             raise ValueError(f"dims must be multiples of {TILE}, got "
                              f"({rows}, {cols})")
+        if fp8:
+            h = TensorHandle(self._num_tiles8, rows, cols, fp8=True)
+            self._num_tiles8 += h.rt * h.ct
+            return h
         h = TensorHandle(self._num_tiles, rows, cols)
         self._num_tiles += h.rt * h.ct
         return h
+
+    @staticmethod
+    def _no_fp8(*handles):
+        """fp8-space handles are GEMM B operands only: their tile ids live
+        in a separate space starting at 0, so any other op encoding them
+        would silently alias main-workspace tiles (data AND hazards)."""
+        for h in handles:
+            if h is not None and getattr(h, "fp8", False):
+                raise ValueError(
+                    "fp8 weight-workspace tensors can only be GEMM B "
+                    "operands (GEMM_WIDE_W8) — other tasks address the "
+                    "main workspace")
 
     # -- dependency bookkeeping --------------------------------------------
     def _emit(self, task: Task, reads: list[int], writes: list[int]) -> int:
@@ -98,6 +123,7 @@ class MegaKernelBuilder:
         row's tiles double-buffered, so wide elementwise ops cost one task's
         dispatch instead of ct (the per-tile version serialized ~3 DMA
         round-trips per tile)."""
+        self._no_fp8(out, a, b)
         if (out.rt, out.ct) != (a.rt, a.ct) or (b and (b.rt, b.ct) != (a.rt, a.ct)):
             raise ValueError("elementwise shape mismatch")
         for i in range(out.rt):
@@ -109,23 +135,27 @@ class MegaKernelBuilder:
                             k_tiles=a.ct, arg=arg),
                        reads, [out.tile(i, j) for j in range(out.ct)])
 
-    def prefetch(self, weight_tile: int):
+    def prefetch(self, weight_tile: int, fp8: bool = False):
         """Start warming ``weight_tile`` into the reserved pipeline slot
         (reference: the weight-prefetch task, SURVEY.md §2.7). The next
         ``gemm(..., prefetch_first=True)`` whose first weight tile equals it
         consumes the warm copy for its j=0 load. One outstanding prefetch at
         a time — the pseudo-resource hazard serializes slot reuse through
         the scheduler, and the builder rejects an unconsumed double-issue.
+        ``fp8``: the tile lives in the fp8 weight workspace (PREFETCH_W8 →
+        the fp8 reserved slot).
         """
         if self._pending_pf is not None:
             raise ValueError(
-                f"prefetch of tile {self._pending_pf} not yet consumed — "
+                f"prefetch of tile {self._pending_pf[0]} not yet consumed — "
                 "one reserved slot, one outstanding prefetch")
         if self._pf_res is None:
             self._pf_res = self.tensor(TILE, TILE)   # hazard token only
-        self._emit(Task(TaskType.PREFETCH, out=0, a0=int(weight_tile)),
-                   [int(weight_tile)], [self._pf_res.tile(0, 0)])
-        self._pending_pf = int(weight_tile)
+        tt = TaskType.PREFETCH_W8 if fp8 else TaskType.PREFETCH
+        read_id = int(weight_tile) + (self._W8_HAZARD if fp8 else 0)
+        self._emit(Task(tt, out=0, a0=int(weight_tile)),
+                   [read_id], [self._pf_res.tile(0, 0)])
+        self._pending_pf = (int(weight_tile), fp8)
 
     def gemm(self, out: TensorHandle, a: TensorHandle, b: TensorHandle,
              prefetch_first: bool = False, width: int = 8):
@@ -143,27 +173,33 @@ class MegaKernelBuilder:
             raise ValueError("gemm shape mismatch")
         if not 1 <= width <= 16:
             raise ValueError(f"gemm width {width} out of range")
+        if a.fp8 or out.fp8:
+            raise ValueError("fp8 space holds weights (GEMM B operands) "
+                             "only — activations/outputs stay in the main "
+                             "workspace")
         if prefetch_first:
-            if self._pending_pf != b.tile(0, 0):
+            if self._pending_pf != (b.tile(0, 0), b.fp8):
                 raise ValueError(
                     f"prefetch_first: pending prefetch {self._pending_pf} "
                     f"does not match this gemm's first weight tile "
-                    f"{b.tile(0, 0)}")
+                    f"{(b.tile(0, 0), b.fp8)}")
             self._pending_pf = None
         kt = a.ct
+        tt = TaskType.GEMM_WIDE_W8 if b.fp8 else TaskType.GEMM_WIDE
+        b_off = self._W8_HAZARD if b.fp8 else 0
         first = True
         for i in range(out.rt):
             j = 0
             while j < out.ct:
                 wd = min(width, out.ct - j)
                 reads = [a.tile(i, q) for q in range(kt)]
-                reads += [b.tile(q, j + w) for q in range(kt)
+                reads += [b.tile(q, j + w) + b_off for q in range(kt)
                           for w in range(wd)]
                 use_pf = prefetch_first and first
                 if use_pf:
                     reads.append(self._pf_res.tile(0, 0))
                 self._emit(
-                    Task(TaskType.GEMM_WIDE, out.tile(i, j),
+                    Task(tt, out.tile(i, j),
                          a0=a.tile(i, 0), b0=b.tile(0, j),
                          k_tiles=kt, a_stride=1, b_stride=b.ct,
                          arg=wd, c0=1 if use_pf else 0),
@@ -179,6 +215,7 @@ class MegaKernelBuilder:
         """Fused per-head qk-norm + RoPE over ONE (TILE, TILE) head tile
         (head_dim == TILE — the norm reduces over this tile's columns).
         Replaces the rms_norm + rope task pair per head."""
+        self._no_fp8(out, a, w, cos, sin)
         for t in (out, a):
             if t.rt != 1 or t.ct != 1:
                 raise ValueError("norm_rope operates on single head tiles")
@@ -205,6 +242,7 @@ class MegaKernelBuilder:
         tasks, model_builder.py). The task row is self-describing
         (a_stride/b_stride carry the cache base tiles) so
         advance_queue_pos retargets it per step without recompiling."""
+        self._no_fp8(kT, v, k_new, v_new)
         if not 0 <= pos < kT.ct * TILE:
             raise ValueError(f"append pos {pos} outside cache capacity")
         if kT.rt != 1 or v.ct != 1:
@@ -223,6 +261,7 @@ class MegaKernelBuilder:
 
     def all_reduce(self, t: TensorHandle):
         """Sum ``t`` over ranks in place (reference make_allreduce)."""
+        self._no_fp8(t)
         for tile in t.tiles():
             self._emit(Task(TaskType.ALLREDUCE, tile), [tile], [tile])
 
@@ -233,6 +272,7 @@ class MegaKernelBuilder:
         ``w`` is the norm weight stored broadcast as a (TILE, cols) tensor
         (see models.broadcast_rows); one task per row block.
         """
+        self._no_fp8(out, a, w)
         if (out.rt, out.ct) != (a.rt, a.ct) or w.ct != a.ct:
             raise ValueError("rms_norm shape mismatch")
         for i in range(out.rt):
@@ -258,6 +298,7 @@ class MegaKernelBuilder:
         token batch row b just projected) join the softmax as the current
         position, so the host appends the cache *after* the step.
         """
+        self._no_fp8(out, q, kT, v, k_new, v_new)
         if q.rt != 1 or q.ct != 1 or out.rt != 1 or out.ct != 1:
             raise ValueError("q/out must be a single (TILE, TILE) tile")
         if kT.rt != 1 or v.ct != 1 or kT.ct != v.rt:
@@ -302,6 +343,7 @@ class MegaKernelBuilder:
         ``out_j..out_j+g-1`` of ``out``) attend the shared kv head's
         kT/v — KV streams once for the group instead of once per head.
         """
+        self._no_fp8(out, q, kT, v, k_new, v_new)
         if not 1 <= g <= 127:
             raise ValueError(f"group size {g} out of range")
         if q_j + g > q.ct or out_j + g > out.ct:
@@ -358,6 +400,7 @@ class MegaKernelBuilder:
         [j·TILE, (j+1)·TILE); kT tiles are (d, TILE) key columns, v tiles
         (TILE, d) value rows — the same layout the linear task uses.
         """
+        self._no_fp8(out, q, k_new, v_new)
         if q.rt != 1 or q.ct != 1 or out.rt != 1 or out.ct != 1:
             raise ValueError("q/out must be a single (TILE, TILE) tile")
         if (k_new is None) != (v_new is None):
@@ -434,7 +477,8 @@ class MegaKernelBuilder:
                                   num_exec=n_exec,
                                   max_gqa=getattr(self, "_max_gqa", 1),
                                   max_gemm_width=getattr(
-                                      self, "_max_gemm_width", 1))
+                                      self, "_max_gemm_width", 1),
+                                  num_tiles8=self._num_tiles8)
 
 
 @dataclasses.dataclass
@@ -449,11 +493,14 @@ class CompiledMegaKernel:
     num_exec: int | None = None   # dispatched rows (rest = page-table data)
     max_gqa: int = 1              # largest GQA group (sizes VMEM scratch)
     max_gemm_width: int = 1       # widest GEMM strip (sizes acc scratch)
+    num_tiles8: int = 0           # fp8 weight-workspace tiles (0 = unused)
 
     def scatter_input(self, ws: jax.Array, h: TensorHandle,
                       value: jax.Array) -> jax.Array:
-        """Write (rows, cols) ``value`` into the tiled workspace."""
-        tiles = value.astype(self.dtype).reshape(
+        """Write (rows, cols) ``value`` into the tiled workspace (main or
+        fp8 — ``ws`` must be the matching array for ``h.fp8``)."""
+        dt = jnp.float8_e4m3fn if h.fp8 else self.dtype
+        tiles = value.astype(dt).reshape(
             h.rt, TILE, h.ct, TILE).transpose(0, 2, 1, 3).reshape(
             h.rt * h.ct, TILE, TILE)
         return jax.lax.dynamic_update_slice(ws, tiles, (h.base, 0, 0))
@@ -465,25 +512,47 @@ class CompiledMegaKernel:
             0, 2, 1, 3).reshape(h.rows, h.cols)
 
     def make_workspace(self, inputs: dict) -> jax.Array:
-        """Build the tiled workspace once (weights + caches + activations).
+        """Build the tiled MAIN workspace once (weights + caches +
+        activations; fp8-space handles are rejected — use make_workspace8).
         In a serving loop, scatter weights here a single time and update
         only the per-step tensors afterward (scatter_input is jittable)."""
         ws = jnp.zeros((max(self.num_tiles, 1), TILE, TILE), self.dtype)
         for h, v in inputs.items():
+            if h.fp8:
+                raise ValueError("fp8 handle in main workspace feeds — "
+                                 "pass it to make_workspace8")
             ws = self.scatter_input(ws, h, v)
         return ws
 
-    def step(self, ws: jax.Array, queue: jax.Array | None = None) -> jax.Array:
+    def make_workspace8(self, inputs: dict) -> jax.Array:
+        """Build the float8_e4m3fn weight workspace (read-only input of
+        every step; values quantize to e4m3 on scatter)."""
+        ws8 = jnp.zeros((max(self.num_tiles8, 1), TILE, TILE),
+                        jnp.float8_e4m3fn)
+        for h, v in inputs.items():
+            if not h.fp8:
+                raise ValueError("non-fp8 handle in fp8 workspace feeds")
+            ws8 = self.scatter_input(ws8, h, v)
+        return ws8
+
+    def step(self, ws: jax.Array, queue: jax.Array | None = None,
+             ws8: jax.Array | None = None) -> jax.Array:
         """One queue execution over a prebuilt workspace (jittable; pass an
         advance_queue_pos-updated ``queue`` to retarget without recompile).
-        Device-local: wrap in shard_map when num_ranks > 1."""
+        Device-local: wrap in shard_map when num_ranks > 1. ``ws8``: the
+        fp8 weight workspace when the program uses one."""
         return run_queue(self.queue if queue is None else queue, ws,
                          num_ranks=self.num_ranks, axis=self.axis,
                          num_tasks=self.num_exec, max_gqa=self.max_gqa,
-                         max_gemm_width=self.max_gemm_width)
+                         max_gemm_width=self.max_gemm_width,
+                         workspace8=ws8)
 
     def run(self, inputs: dict, outputs: list[TensorHandle],
             _device_local: bool = True):
-        """Device-local execution (inside shard_map when num_ranks > 1)."""
-        ws = self.step(self.make_workspace(inputs))
+        """Device-local execution (inside shard_map when num_ranks > 1).
+        fp8-space handles in ``inputs`` feed the weight workspace."""
+        main = {h: v for h, v in inputs.items() if not h.fp8}
+        w8 = {h: v for h, v in inputs.items() if h.fp8}
+        ws8 = self.make_workspace8(w8) if w8 else None
+        ws = self.step(self.make_workspace(main), ws8=ws8)
         return [self.gather_output(ws, h) for h in outputs]

@@ -36,8 +36,8 @@ from triton_distributed_tpu.runtime.context import use_interpret
 
 def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
                  max_gemm_width: int,
-                 queue_ref, ws_in, ws_out, slots, va2, vb2, vacc, vq, vstat,
-                 vqg, vaccg, vstatg, vaccw,
+                 queue_ref, ws_in, ws8, ws_out, slots, va2, vb2, vb8, vacc,
+                 vq, vstat, vqg, vaccg, vstatg, vaccw,
                  copy_sem, pipe_sems, send_sems, recv_sem):
     wdt = ws_out.dtype   # workspace dtype (fp32 or bf16); compute is fp32
     step = pl.program_id(0)
@@ -147,12 +147,14 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
         pltpu.make_async_copy(ws_out.at[a0], vb2.at[PIPE_DEPTH],
                               pipe_sems.at[2 * PIPE_DEPTH]).start()
 
-    def t_gemm_wide():
+    def _gemm_wide_body(b_ws, b_buf):
         # One task computes ``width`` contiguous output column tiles: the A
         # row tiles stream ONCE for the strip (the single-tile GEMM
         # re-fetched them per output tile) and width-1 task dispatches
         # disappear. A double-buffers over 2 slots of va2; the flattened
-        # (j, w) B stream pipelines PIPE_DEPTH deep over vb2; per-column
+        # (j, w) B stream pipelines PIPE_DEPTH deep over ``b_buf``
+        # (vb2 in workspace dtype, or the fp8 vb8 for GEMM_WIDE_W8 —
+        # weight tiles from the fp8 workspace upcast in VMEM); per-column
         # fp32 accumulators live in vaccw's leading dim (dynamic leading-
         # dim indexing — lane-dim dynamic slicing would not lower).
         width = arg
@@ -164,8 +166,8 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
             return b0 + j * b_stride + (f - j * width)
 
         def bdesc(f, slot, sem_i):
-            return pltpu.make_async_copy(ws_out.at[b_tile_idx(f)],
-                                         vb2.at[slot], pipe_sems.at[sem_i])
+            return pltpu.make_async_copy(b_ws.at[b_tile_idx(f)],
+                                         b_buf.at[slot], pipe_sems.at[sem_i])
 
         def adesc(j, slot):
             return pltpu.make_async_copy(ws_out.at[a0 + j * a_stride],
@@ -208,10 +210,10 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
                     b_start(nxt, jax.lax.rem(nxt, PIPE_DEPTH))
 
                 bs, sem = b_slot_sem(f, slot)
-                pltpu.make_async_copy(ws_out.at[b_tile_idx(f)], vb2.at[bs],
+                pltpu.make_async_copy(b_ws.at[b_tile_idx(f)], b_buf.at[bs],
                                       pipe_sems.at[sem]).wait()
                 vaccw[w, :, :] = vaccw[w] + jnp.dot(
-                    va2[aslot], vb2[bs],
+                    va2[aslot], b_buf[bs].astype(va2.dtype),
                     preferred_element_type=jnp.float32)
                 return 0
 
@@ -231,6 +233,18 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
             return 0
 
         jax.lax.fori_loop(0, width, store_w, 0)
+
+    def t_gemm_wide():
+        _gemm_wide_body(ws_out, vb2)
+
+    def t_gemm_wide_w8():
+        _gemm_wide_body(ws8, vb8)
+
+    def t_prefetch_w8():
+        # Fire-and-forget warm of fp8 weight tile a0 into vb8's reserved
+        # slot (consumed by the next GEMM_WIDE_W8 with c0 == 1).
+        pltpu.make_async_copy(ws8.at[a0], vb8.at[PIPE_DEPTH],
+                              pipe_sems.at[2 * PIPE_DEPTH]).start()
 
     def t_norm_rope():
         # Fused per-head qk-norm + RoPE: one load of the head tile instead
@@ -486,12 +500,12 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
                           t_scale, t_rms_norm, t_retired, t_attn_decode,
                           t_attn_decode_paged, t_prefetch,
                           t_attn_decode_gqa, t_gemm_wide, t_norm_rope,
-                          t_append_kv])
+                          t_append_kv, t_gemm_wide_w8, t_prefetch_w8])
 
 
 def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
               num_tasks: int | None = None, max_gqa: int = 1,
-              max_gemm_width: int = 1):
+              max_gemm_width: int = 1, workspace8=None):
     """Execute the packed task queue over the workspace in ONE pallas_call.
 
     queue: (n_rows, WORDS) int32; workspace: (T, TILE, TILE) fp32 or bf16
@@ -503,6 +517,9 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
     per-head group scratch; 1 when unused).
     ``max_gemm_width``: widest GEMM_WIDE strip (sizes the per-column
     accumulator scratch; 1 when unused).
+    ``workspace8``: optional (T8, TILE, TILE) float8_e4m3fn READ-ONLY
+    weight workspace (GEMM_WIDE_W8 / PREFETCH_W8 B-tile source — half the
+    weight-streaming bytes of bf16).
     Returns the post-execution workspace.
     """
     n_tasks = num_tasks if num_tasks is not None else queue.shape[0]
@@ -512,17 +529,21 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
     wdt = workspace.dtype
     G = max(max_gqa, 1)
     W = max(max_gemm_width, 1)
+    if workspace8 is None:
+        workspace8 = jnp.zeros((1, TILE, TILE), jnp.float8_e4m3fn)
 
     # AR slots ride as a second output: Mosaic has no HBM scratch (see
     # language/core.py kernel_call ``workspaces``).
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_tasks,),
-        in_specs=[any_spec()],
+        in_specs=[any_spec(), any_spec()],
         out_specs=(any_spec(), any_spec()),
         scratch_shapes=[
             pltpu.VMEM((PIPE_DEPTH, TILE, TILE), wdt),      # va2
             pltpu.VMEM((PIPE_DEPTH + 1, TILE, TILE), wdt),  # vb2 (+pf slot)
+            pltpu.VMEM((PIPE_DEPTH + 1, TILE, TILE),
+                       jnp.float8_e4m3fn),                  # vb8 (+pf slot)
             pltpu.VMEM((TILE, TILE), jnp.float32),      # vacc (fp32 accum)
             pltpu.VMEM((TILE, TILE), wdt),              # vq: rope/attn operand
             pltpu.VMEM((TILE, 128), jnp.float32),       # vstat (softmax stats)
@@ -563,5 +584,5 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
         ),
         compiler_params=pltpu.CompilerParams(has_side_effects=True, **params),
         interpret=interpret_arg,
-    )(queue, workspace)
+    )(queue, workspace, workspace8)
     return ws_out

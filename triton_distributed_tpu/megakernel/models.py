@@ -167,16 +167,16 @@ def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
     # reserved pipeline slot while the preceding tasks run (reference
     # weight-prefetch task, SURVEY.md §2.7) — wq under the norm, wo under
     # the whole attention phase, w_gate under AR+add+norm, etc.
-    mb.prefetch(h.wq.tile(0, 0))
+    mb.prefetch(h.wq.tile(0, 0), fp8=h.wq.fp8)
     mb.rms_norm(xn, x, h.attn_norm, eps)
 
     q = mb.tensor(TILE, hq_local * d)
     mb.gemm(q, xn, h.wq, prefetch_first=True)
-    mb.prefetch(h.wk.tile(0, 0))
+    mb.prefetch(h.wk.tile(0, 0), fp8=h.wk.fp8)
     mb.gemm(h.k_new, xn, h.wk, prefetch_first=True)
-    mb.prefetch(h.wv.tile(0, 0))
+    mb.prefetch(h.wv.tile(0, 0), fp8=h.wv.fp8)
     mb.gemm(h.v_new, xn, h.wv, prefetch_first=True)
-    mb.prefetch(h.wo.tile(0, 0))
+    mb.prefetch(h.wo.tile(0, 0), fp8=h.wo.fp8)
 
     # Per-head qk-norm + RoPE, fused into one task per head (head_dim ==
     # TILE → the norm reduces over the single head tile).
@@ -227,7 +227,7 @@ def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
 
     o = mb.tensor(TILE, hidden)
     mb.gemm(o, attn, h.wo, prefetch_first=True)
-    mb.prefetch(h.w_gate.tile(0, 0))
+    mb.prefetch(h.w_gate.tile(0, 0), fp8=h.w_gate.fp8)
     if num_ranks > 1:
         mb.all_reduce(o)
     x1 = mb.tensor(TILE, hidden)
@@ -240,9 +240,9 @@ def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
     up = mb.tensor(TILE, ffn_local)
     act = mb.tensor(TILE, ffn_local)
     mb.gemm(gate, x1n, h.w_gate, prefetch_first=True)
-    mb.prefetch(h.w_up.tile(0, 0))
+    mb.prefetch(h.w_up.tile(0, 0), fp8=h.w_up.fp8)
     mb.gemm(up, x1n, h.w_up, prefetch_first=True)
-    mb.prefetch(h.w_down.tile(0, 0))
+    mb.prefetch(h.w_down.tile(0, 0), fp8=h.w_down.fp8)
     mb.silu_mul(act, gate, up)
     down = mb.tensor(TILE, hidden)
     mb.gemm(down, act, h.w_down, prefetch_first=True)
@@ -258,13 +258,16 @@ def build_decode_step(*, hidden: int, hq_local: int, hkv_local: int,
                       pos: int, num_ranks: int = 1,
                       eps: float = 1e-6,
                       paged: bool = False,
-                      inkernel_append: bool = False) -> DecodeStepProgram:
+                      inkernel_append: bool = False,
+                      fp8_weights: bool = False) -> DecodeStepProgram:
     """Assemble a full num_layers decode step (per-device TP view).
 
     ``hq_local``/``hkv_local``/``ffn_local`` are this device's shards;
     head_dim is TILE. The embedding lookup and the lm_head stay outside (the
     reference megakernel also serves the transformer stack; sampling is
-    host-side)."""
+    host-side). ``fp8_weights``: projection/MLP weights live in the
+    float8_e4m3fn weight workspace (GEMM_WIDE_W8 streams them at half the
+    bytes; quality is the e4m3 quantization's)."""
     if hidden % TILE or ffn_local % TILE or max_seq % TILE:
         raise ValueError("hidden/ffn_local/max_seq must be TILE multiples")
     if not 0 <= pos < max_seq:
@@ -282,13 +285,13 @@ def build_decode_step(*, hidden: int, hq_local: int, hkv_local: int,
             mlp_norm=mb.tensor(TILE, hidden),
             q_norm=mb.tensor(TILE, d),
             k_norm=mb.tensor(TILE, d),
-            wq=mb.tensor(hidden, hq_local * d),
-            wk=mb.tensor(hidden, hkv_local * d),
-            wv=mb.tensor(hidden, hkv_local * d),
-            wo=mb.tensor(hq_local * d, hidden),
-            w_gate=mb.tensor(hidden, ffn_local),
-            w_up=mb.tensor(hidden, ffn_local),
-            w_down=mb.tensor(ffn_local, hidden),
+            wq=mb.tensor(hidden, hq_local * d, fp8=fp8_weights),
+            wk=mb.tensor(hidden, hkv_local * d, fp8=fp8_weights),
+            wv=mb.tensor(hidden, hkv_local * d, fp8=fp8_weights),
+            wo=mb.tensor(hq_local * d, hidden, fp8=fp8_weights),
+            w_gate=mb.tensor(hidden, ffn_local, fp8=fp8_weights),
+            w_up=mb.tensor(hidden, ffn_local, fp8=fp8_weights),
+            w_down=mb.tensor(ffn_local, hidden, fp8=fp8_weights),
             kT=[mb.tensor(d, max_seq) for _ in range(hkv_local)],
             v=[mb.tensor(max_seq, d) for _ in range(hkv_local)],
             k_new=mb.tensor(TILE, hkv_local * d),

@@ -122,7 +122,7 @@ class MegakernelDecoder:
 
     def __init__(self, cfg: ModelConfig, params: dict, *, max_seq: int,
                  dtype=jnp.float32, ctx=None, axis: str = "tp",
-                 num_ranks: int = 1):
+                 num_ranks: int = 1, fp8_weights: bool = False):
         validate_megakernel_cfg(cfg, max_seq)
         n = num_ranks
         if cfg.num_heads % n or cfg.num_kv_heads % n or \
@@ -145,13 +145,18 @@ class MegakernelDecoder:
         self.n = n
         self.axis = axis
         self.ctx = ctx
+        # fp8_weights: projection/MLP weights stream from the
+        # float8_e4m3fn weight workspace (half the decode-dominant
+        # weight bytes; outputs carry the e4m3 quantization — opt-in,
+        # token-identity with the bf16 ar path is NOT expected).
+        self.fp8_weights = fp8_weights
         self.prog = build_decode_step(
             hidden=cfg.hidden_size, hq_local=cfg.num_heads // n,
             hkv_local=cfg.num_kv_heads // n,
             ffn_local=cfg.intermediate_size // n,
             num_layers=cfg.num_layers, max_seq=max_seq,
             pos=max_seq - 1, num_ranks=n, eps=cfg.rms_norm_eps,
-            inkernel_append=True)
+            inkernel_append=True, fp8_weights=fp8_weights)
         self.comp = self.prog.mb.compile(num_ranks=n, axis=axis,
                                          dtype=dtype)
         # Weight feeds computed ONCE (per rank) — start() merges only the
@@ -184,14 +189,19 @@ class MegakernelDecoder:
             mesh = ctx.mesh
 
             def sharded(ws, embed, final_norm, lm_head, queue, cos, sin,
-                        token):
+                        token, ws8):
+                # fp8_weights is a static python flag: without it ws8 is a
+                # placeholder tile the kernel never reads.
                 ws, tok = self._step(ws[0], embed, final_norm, lm_head,
-                                     queue, cos, sin, token)
+                                     queue, cos, sin, token,
+                                     ws8=ws8[0] if self.fp8_weights
+                                     else None)
                 return ws[None], tok
 
             fn = jax.shard_map(
                 sharded, mesh=mesh,
-                in_specs=(P(axis), P(), P(), P(), P(), P(), P(), P()),
+                in_specs=(P(axis), P(), P(), P(), P(), P(), P(), P(),
+                          P(axis)),
                 out_specs=(P(axis), P()), check_vma=False)
             self._step_jit = jax.jit(fn, donate_argnums=(0,))
 
@@ -209,7 +219,11 @@ class MegakernelDecoder:
         if self.n == 1:
             feeds = dict(self._weight_feeds[0])
             feeds.update(cache_feeds(self.prog, cache))
-            return self.comp.make_workspace(feeds)
+            main = {h: v for h, v in feeds.items() if not h.fp8}
+            self._ws8 = (self.comp.make_workspace8(
+                {h: v for h, v in feeds.items() if h.fp8})
+                if self.fp8_weights else None)
+            return self.comp.make_workspace(main)
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         # Build each rank's workspace ON its device (no n-times stack spike
@@ -217,18 +231,31 @@ class MegakernelDecoder:
         mesh = self.ctx.mesh
         devices = list(mesh.devices.flat)
         shards = []
+        ws8_shards = []
         for r in range(self.n):
             feeds = dict(self._weight_feeds[r])
             feeds.update(cache_feeds(self.prog, cache, rank=r,
                                      num_ranks=self.n))
-            ws_r = self.comp.make_workspace(feeds)
+            main = {h: v for h, v in feeds.items() if not h.fp8}
+            ws_r = self.comp.make_workspace(main)
             shards.append(jax.device_put(ws_r[None], devices[r]))
+            if self.fp8_weights:
+                ws8_r = self.comp.make_workspace8(
+                    {h: v for h, v in feeds.items() if h.fp8})
+                ws8_shards.append(jax.device_put(ws8_r[None], devices[r]))
         shape = (self.n,) + shards[0].shape[1:]
+        if self.fp8_weights:
+            s8 = (self.n,) + ws8_shards[0].shape[1:]
+            self._ws8 = jax.make_array_from_single_device_arrays(
+                s8, NamedSharding(mesh, P(self.axis)), ws8_shards)
+        else:
+            self._ws8 = None
         return jax.make_array_from_single_device_arrays(
             shape, NamedSharding(mesh, P(self.axis)), shards)
 
     # -- one token ----------------------------------------------------------
-    def _step(self, ws, embed, final_norm, lm_head, queue, cos, sin, token):
+    def _step(self, ws, embed, final_norm, lm_head, queue, cos, sin, token,
+              ws8=None):
         # embed / final_norm / lm_head arrive as ARGUMENTS: closed over,
         # jit would bake them into the trace as inline constants (multi-GB
         # for real checkpoints — the exact hazard bench.py documents).
@@ -240,7 +267,7 @@ class MegakernelDecoder:
         ws = self.comp.scatter_input(ws, self.prog.x, x)
         ws = self.comp.scatter_input(ws, self.prog.cos, cos)
         ws = self.comp.scatter_input(ws, self.prog.sin, sin)
-        ws = self.comp.step(ws, queue)
+        ws = self.comp.step(ws, queue, ws8=ws8)
         x_out = self.comp.gather_output(ws, self.prog.x_out)[0:1]
         xn = rms_norm(x_out.astype(jnp.float32),
                       final_norm.astype(jnp.float32),
@@ -260,6 +287,11 @@ class MegakernelDecoder:
         queue = advance_queue_pos(self.comp.queue, pos,
                                   num_exec=self.comp.num_exec)
         cos, sin = rope_tables(pos, TILE, self.cfg.rope_theta)
+        ws8 = getattr(self, "_ws8", None)
+        if self.n > 1 and ws8 is None:
+            # shard_map needs a real array operand; `sharded` drops it
+            # statically when fp8_weights is off.
+            ws8 = jnp.zeros((self.n, 1, TILE, TILE), jnp.float8_e4m3fn)
         return self._step_jit(ws, self.embed, self.final_norm, self.lm_head,
                               queue, jnp.asarray(cos), jnp.asarray(sin),
-                              token)
+                              token, ws8)

@@ -89,6 +89,14 @@ class TaskType(enum.IntEnum):
     #                 ``b0`` (TILE, d). a_stride/b_stride carry the kT/v
     #                 tensor BASE tile ids so advance_queue_pos can retarget
     #                 out/b0/c0 per position without recompiling.
+    GEMM_WIDE_W8 = 15  # GEMM_WIDE whose B (weight) tiles live in the
+    #                 float8_e4m3fn weight workspace (separate read-only
+    #                 input; tile ids index it, upcast to the compute dtype
+    #                 in VMEM) — half the weight-streaming bytes, the
+    #                 dominant decode traffic. Reference: its kernels' fp8
+    #                 weight payloads (README.md:96-97).
+    PREFETCH_W8 = 16  # PREFETCH of an fp8 weight-workspace tile into the
+    #                 fp8 reserved slot (consumed by GEMM_WIDE_W8 c0 == 1).
 
 
 @dataclasses.dataclass(frozen=True)
@@ -114,11 +122,16 @@ class Task:
 
 @dataclasses.dataclass(frozen=True)
 class TensorHandle:
-    """A (R, C) fp32 tensor as a row-major grid of TILE×TILE tiles."""
+    """A (R, C) tensor as a row-major grid of TILE×TILE tiles.
+
+    ``fp8``: lives in the float8_e4m3fn WEIGHT workspace (a separate
+    read-only input array with its own tile-id space) instead of the main
+    workspace."""
 
     base: int
     rows: int
     cols: int
+    fp8: bool = False
 
     @property
     def rt(self) -> int:

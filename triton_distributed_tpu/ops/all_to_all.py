@@ -315,9 +315,21 @@ def fast_all_to_all(send_buf: jax.Array, send_splits: jax.Array,
     send_splits: (n, n, experts_per_rank) int32.
     Returns (recv_buf, recv_splits) with the same global shapes, where
     [d, p] = what device d received from rank p.
+
+    With comm tuning opted in (TDTPU_AUTOTUNE_COMM=1), a None
+    ``block_rows`` resolves by MEASUREMENT over the aligned candidates
+    (disk-cached per shape/mesh/chip) instead of the static default.
     """
     ctx = ctx or get_context()
     n = ctx.axis_size(axis)
+    if block_rows is None and n > 1:
+        from triton_distributed_tpu.runtime.autotuner import (
+            comm_autotune_enabled, tuned_a2a_block_rows,
+        )
+
+        if comm_autotune_enabled():
+            block_rows = tuned_a2a_block_rows(send_buf, send_splits, ctx,
+                                              axis=axis)
     key = (axis, send_buf.shape, send_splits.shape, str(send_buf.dtype),
            block_rows)
 

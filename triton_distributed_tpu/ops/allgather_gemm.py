@@ -49,45 +49,82 @@ class AGGemmConfig:
     ``straggler``: optional (rank, cycles) fault injection — that rank spins
     ``cycles`` before producing, widening race windows (reference
     straggler_option, allgather_gemm.py:602-603 via torch.cuda._sleep).
+
+    ``sub_chunks``: split each rank's shard into this many sub-blocks with
+    per-sub-block delivery semaphores — the consumer starts on a remote
+    chunk after 1/sub of its rows land instead of the whole shard
+    (VERDICT r3 #5; the reference waits per M-TILE, allgather_gemm.py:236).
+    Shrinks automatically to a divisor of the shard rows that keeps
+    sub-blocks sublane-aligned. Trade-off: the per-sub matmul caps tile_m
+    at the sub-block rows, so B re-streams sub× per chunk — finer overlap
+    buys earlier first-tile at some extra B traffic.
     """
 
     tile_m: int = 512
     tile_n: int = 1024
     tile_k: int = 1024
     straggler: tuple | None = None
+    sub_chunks: int = 2
+    # Run the degenerate 0-peer kernel at n=1 (single-chip Mosaic compile
+    # check of the sub-chunk wait structure, scripts/check_on_chip.py).
+    force_kernel: bool = False
+
+
+def _ag_sub_chunks(m: int, want: int, dtype) -> int:
+    from triton_distributed_tpu.ops.tiling import sublane_align
+
+    sa = sublane_align(dtype)
+    sub = max(1, want)
+    while sub > 1 and (m % sub or (m // sub) % sa):
+        sub -= 1
+    return sub
 
 
 def _ag_gemm_kernel(n: int, axis: str, m: int, k: int, ncols: int,
-                    tiles, straggler, x_ref, b_ref, out_ref, ws_ref,
+                    tiles, straggler, sub, x_ref, b_ref, out_ref, ws_ref,
                     vacc, send_sems, recv_sems):
-    """See module docstring. ws_ref is the AG landing workspace (n·m, k)."""
-    me = dl.rank(axis)
-    shmem.barrier_all(axis)
-    dl.maybe_straggle(straggler, me)
+    """See module docstring. ws_ref is the AG landing workspace (n·m, k).
 
-    # --- producer: local copy + full-mesh push of my shard into slot `me`.
-    my_slot = ws_ref.at[pl.ds(me * m, m)]
-    local = pltpu.make_async_copy(x_ref, my_slot, recv_sems.at[me])
-    local.start()
+    recv_sems: (n, sub) — one DMA semaphore per (source rank, sub-block).
+    A single per-source byte-counting semaphore cannot order sub-block
+    deliveries (DMA completion order is unspecified, so sub-block 2's
+    bytes could satisfy a wait for sub-block 0); per-sub semaphores make
+    each wait specific to its rows."""
+    me = dl.rank(axis)
+    if n > 1:    # n=1 compile checks: Mosaic rejects the barrier
+        shmem.barrier_all(axis)    # semaphore on a single-device launch
+    dl.maybe_straggle(straggler, me)
+    m_sub = m // sub
+
+    # --- producer: per-sub-block local copy + full-mesh push into slot
+    # `me` (each delivery signals its own (me, s) semaphore).
     handles = []
-    for i in range(n - 1):
-        peer = jax.lax.rem(me + 1 + i, n)
-        handles.append(
-            shmem.putmem_nbi_block(x_ref, my_slot, send_sems.at[i],
-                                   recv_sems.at[me], peer, axis)
-        )
+    for s in range(sub):
+        src = x_ref.at[pl.ds(s * m_sub, m_sub)]
+        dst = ws_ref.at[pl.ds(me * m + s * m_sub, m_sub)]
+        local = pltpu.make_async_copy(src, dst, recv_sems.at[me].at[s])
+        local.start()
+        for i in range(n - 1):
+            peer = jax.lax.rem(me + 1 + i, n)
+            handles.append(
+                shmem.putmem_nbi_block(src, dst,
+                                       send_sems.at[s * (n - 1) + i],
+                                       recv_sems.at[me].at[s], peer, axis)
+            )
 
     tm, tk, tn = tiles
 
-    # --- consumer: rank-swizzled chunk loop, wait-then-matmul per chunk
-    # (reference kernel_consumer_gemm_persistent hot loop :217-264).
+    # --- consumer: rank-swizzled chunk loop, wait-then-matmul per
+    # SUB-BLOCK (reference kernel_consumer_gemm_persistent waits per
+    # M-tile, :217-264 — sub-block granularity is the TPU analog).
     for i in range(n):
         r = jax.lax.rem(me + i, n)
-        shmem.wait_deliveries(x_ref, recv_sems.at[r], 1)
-        row0 = r * m
-        rows = pl.ds(row0, m)
-        matmul_tiles(ws_ref.at[rows], b_ref, out_ref.at[rows],
-                     m, k, ncols, tm, tk, tn, vacc)
+        for s in range(sub):
+            rows = pl.ds(r * m + s * m_sub, m_sub)
+            shmem.wait_deliveries(x_ref.at[pl.ds(0, m_sub)],
+                                  recv_sems.at[r].at[s], 1)
+            matmul_tiles(ws_ref.at[rows], b_ref, out_ref.at[rows],
+                         m_sub, k, ncols, tm, tk, tn, vacc)
     shmem.quiet(*handles)
 
 
@@ -106,16 +143,20 @@ def ag_gemm_local(x_local: jax.Array, b_local: jax.Array, axis: str = "tp",
     k2, ncols = b_local.shape
     if k != k2:
         raise ValueError(f"inner dims mismatch: A has k={k}, B has k={k2}")
-    if n == 1:
+    if n == 1 and not cfg.force_kernel:
         # Degenerate world: no communication, but still run the real Pallas
         # compute core so single-chip compile checks exercise the kernel path.
         from triton_distributed_tpu.ops.gemm import pallas_matmul
 
         return pallas_matmul(x_local, b_local, tile_m=cfg.tile_m,
                              tile_n=cfg.tile_n, tile_k=cfg.tile_k)
-    tm, tk, tn = gemm_tiles(m, k, ncols, x_local.dtype, cfg)
+    sub = _ag_sub_chunks(m, cfg.sub_chunks, x_local.dtype)
+    # Tiles derive from the SUB-BLOCK rows: a tile that divides m but not
+    # m/sub would make matmul_tiles' floored grid silently drop the
+    # sub-block's remainder rows.
+    tm, tk, tn = gemm_tiles(m // sub, k, ncols, x_local.dtype, cfg)
     kernel = functools.partial(_ag_gemm_kernel, n, axis, m, k, ncols,
-                               (tm, tk, tn), cfg.straggler)
+                               (tm, tk, tn), cfg.straggler, sub)
     out = kernel_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((n * m, ncols), x_local.dtype),
@@ -126,10 +167,10 @@ def ag_gemm_local(x_local: jax.Array, b_local: jax.Array, axis: str = "tp",
         ],
         scratch_shapes=[
             pltpu.VMEM((tm, tn), jnp.float32),
-            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
-            pltpu.SemaphoreType.DMA((n,)),
+            pltpu.SemaphoreType.DMA((max((n - 1) * sub, 1),)),
+            pltpu.SemaphoreType.DMA((n, sub)),
         ],
-        uses_barrier=True,
+        uses_barrier=n > 1,
     )(x_local, b_local)
     return out
 
@@ -162,6 +203,16 @@ def ag_gemm(a: jax.Array, b: jax.Array, ctx: DistContext | None = None,
     """
     ctx = ctx or get_context()
     n = ctx.axis_size(axis)
+    if cfg is None and n > 1:
+        from triton_distributed_tpu.runtime.autotuner import (
+            comm_autotune_enabled, tune_ag_gemm,
+        )
+
+        if comm_autotune_enabled():
+            # Whole-thunk comm tuning (tiles + sub-chunk depth measured
+            # with the real AG in the loop) — reference
+            # contextual_autotune(is_dist=True), autotuner.py:97.
+            cfg = tune_ag_gemm(a, b, ctx, axis=axis)
     cfg = resolve_gemm_cfg(cfg, AGGemmConfig, a.shape[0] // n, a.shape[1],
                            b.shape[1] // n, a.dtype)
     key = (axis, a.shape, b.shape, str(a.dtype), str(b.dtype), cfg)

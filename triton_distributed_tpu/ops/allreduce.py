@@ -276,9 +276,21 @@ def all_reduce_stream(x_local: jax.Array, ws: jax.Array,
 def all_reduce(x: jax.Array, ctx: DistContext | None = None, axis: str = "tp",
                method: AllReduceMethod | str = AllReduceMethod.AUTO) -> jax.Array:
     """Host-level AllReduce: ``x`` globally (n, m, cols) stacked contributions
-    over ``axis`` → replicated (m, cols) sum."""
+    over ``axis`` → replicated (m, cols) sum.
+
+    With comm tuning opted in (TDTPU_AUTOTUNE_COMM=1), AUTO resolves by
+    MEASUREMENT — the one/two-shot/xla crossover is timed on this mesh via
+    the chain harness and disk-cached — instead of the perf model
+    (reference contextual_autotune(is_dist=True), autotuner.py:97)."""
     ctx = ctx or get_context()
     n = ctx.axis_size(axis)
+    if method in (AllReduceMethod.AUTO, "auto") and n > 1:
+        from triton_distributed_tpu.runtime.autotuner import (
+            comm_autotune_enabled, tuned_allreduce_method,
+        )
+
+        if comm_autotune_enabled():
+            method = tuned_allreduce_method(x, ctx, axis=axis)
     method_key = method.value if isinstance(method, AllReduceMethod) else str(method)
     key = (axis, method_key, x.shape, str(x.dtype))
 

@@ -46,19 +46,27 @@ def _grid_matmul_kernel(nk, a_ref, b_ref, out_ref, acc_ref):
 
 def pallas_matmul(a: jax.Array, b: jax.Array,
                   tile_m: int = 512, tile_n: int = 1024,
-                  tile_k: int = 512) -> jax.Array:
-    """out = a @ b with fp32 accumulation, tiled over a parallel grid."""
+                  tile_k: int = 512, out_dtype=None) -> jax.Array:
+    """out = a @ b with fp32 accumulation, tiled over a parallel grid.
+
+    Low-precision lane: float8_e4m3fn operands are first-class — the fp8
+    tiles stream at half bf16's HBM traffic and the MXU dot accumulates
+    fp32 (the reference's fp8 kernels, README.md:96-97 headline payload).
+    ``out_dtype`` defaults to a.dtype; fp8 callers usually want bf16/f32
+    out (an fp8 store would quantize the accumulated result).
+    """
     m, k = a.shape
     k2, ncols = b.shape
     if k != k2:
         raise ValueError(f"inner dims mismatch {k} vs {k2}")
+    out_dtype = a.dtype if out_dtype is None else jnp.dtype(out_dtype)
     tm = pick_tile(m, tile_m, sublane_align(a.dtype))
     tk = pick_tile(k, tile_k, 128)
     tn = pick_tile(ncols, tile_n, 128)
     nk = k // tk
     return kernel_call(
         functools.partial(_grid_matmul_kernel, nk),
-        out_shape=jax.ShapeDtypeStruct((m, ncols), a.dtype),
+        out_shape=jax.ShapeDtypeStruct((m, ncols), out_dtype),
         grid=(m // tm, ncols // tn, nk),
         in_specs=[pl.BlockSpec((tm, tk), lambda i, j, q: (i, q)),
                   pl.BlockSpec((tk, tn), lambda i, j, q: (q, j))],

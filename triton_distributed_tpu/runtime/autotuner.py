@@ -426,10 +426,14 @@ def tuned_flash_tiles(sq: int, sk: int, hq: int, hkv: int, d: int,
 
 
 def tune_ag_gemm(a: jax.Array, b: jax.Array, ctx=None, axis: str = "tp"):
-    """Autotuned AG+GEMM: picks AGGemmConfig for these global shapes.
+    """Autotuned AG+GEMM: picks the whole AGGemmConfig — tiles AND the
+    sub-chunk readiness granularity — by measuring the REAL comm thunk
+    (comm side effects included; the candidates are timed interleaved so
+    chip drift cannot pick the winner).
 
-    Reference: contextual_autotune applied to ag_gemm (autotuner.py usage in
-    test_ag_gemm).
+    Reference: contextual_autotune applied to ag_gemm (autotuner.py:97).
+    Called from the op's default path when TDTPU_AUTOTUNE_COMM=1
+    (ops/allgather_gemm.resolve_gemm_cfg).
     """
     from triton_distributed_tpu.ops.allgather_gemm import AGGemmConfig, ag_gemm
     from triton_distributed_tpu.runtime.context import get_context
@@ -439,18 +443,84 @@ def tune_ag_gemm(a: jax.Array, b: jax.Array, ctx=None, axis: str = "tp"):
     ctx = ctx or get_context()
     n = ctx.axis_size(axis)
     m_local = a.shape[0] // n
-    key = (tuple(a.shape), tuple(b.shape), str(a.dtype), n)
+    chip = jax.devices()[0].device_kind
+    key = (tuple(a.shape), tuple(b.shape), str(a.dtype), n, chip)
     # Perf-model pruning (reference prunes its config lists with
-    # gemm_perf_model estimates): rank by modeled time, measure the top 8.
+    # gemm_perf_model estimates): top-2 tile configs x sub-chunk depths.
     tiles = rank_gemm_tiles(
         gemm_tile_candidates(m_local, a.shape[1], b.shape[1] // n,
                              a.dtype.itemsize),
-        a.shape[0], b.shape[1] // n, a.shape[1], a.dtype.itemsize, top=8)
-    cands = [AGGemmConfig(tile_m=tm, tile_n=tn, tile_k=tk)
-             for tm, tn, tk in tiles]
+        a.shape[0], b.shape[1] // n, a.shape[1], a.dtype.itemsize, top=2)
+    cands = [AGGemmConfig(tile_m=tm, tile_n=tn, tile_k=tk, sub_chunks=s)
+             for tm, tn, tk in tiles for s in (1, 2, 4)]
 
     def build(cfg):
         return lambda x, w: ag_gemm(x, w, ctx, axis=axis, cfg=cfg)
 
     best, _ = contextual_autotune("ag_gemm", key, cands, build, (a, b))
+    return best
+
+
+def comm_autotune_enabled() -> bool:
+    """Comm-side tuning (whole thunks INCLUDING collectives — the
+    reference's contextual_autotune(is_dist=True) mode) is opt-in:
+    TDTPU_AUTOTUNE_COMM=1. Each candidate costs chain compiles through
+    the relay, and the measured numbers are only meaningful on the mesh
+    they ran on (the decision is cached per mesh size + chip)."""
+    return (os.environ.get("TDTPU_AUTOTUNE_COMM", "") == "1"
+            and autotune_enabled())
+
+
+def tuned_allreduce_method(x: Any, ctx, axis: str = "tp",
+                           method: str = "auto"):
+    """Measured one-shot / two-shot / xla AllReduce selection for this
+    (shape, dtype, mesh size, chip) — the reference tunes whole comm
+    thunks the same way (contextual_autotune(is_dist=True),
+    autotuner.py:97). Returns the winning method name; the decision is
+    disk-cached (a cache hit never re-measures).
+
+    ``x``: the host-level stacked (n, m, cols) input the AllReduce op
+    takes. The perf-model AUTO selector remains the default path —
+    this runs only when comm tuning is opted in (see the caller,
+    ops/allreduce.all_reduce).
+    """
+    from triton_distributed_tpu.ops.allreduce import all_reduce
+
+    n = ctx.axis_size(axis)
+    chip = jax.devices()[0].device_kind
+    cands = ["one_shot", "two_shot", "xla"]
+    if x.shape[1] % n:
+        cands.remove("two_shot")     # needs rows divisible by n
+    key = (tuple(x.shape), str(x.dtype), n, chip)
+
+    def build(m):
+        return lambda xv: all_reduce(xv, ctx, axis=axis, method=m)
+
+    best, _ = contextual_autotune("allreduce_method", key, cands, build,
+                                  (x,), method=method)
+    return best
+
+
+def tuned_a2a_block_rows(send_buf: Any, send_splits: Any, ctx,
+                         axis: str = "tp", method: str = "auto"):
+    """Measured AllToAll DMA block-row granularity for this (shape, dtype,
+    mesh size, chip): small blocks start forwarding sooner, large blocks
+    amortize per-DMA latency — folklore the perf model guesses and this
+    measures (reference: contextual_autotune over its A2A configs)."""
+    from triton_distributed_tpu.ops.all_to_all import fast_all_to_all
+    from triton_distributed_tpu.ops.tiling import sublane_align
+
+    n = ctx.axis_size(axis)
+    chip = jax.devices()[0].device_kind
+    cap = send_buf.shape[2]
+    base = max(16, sublane_align(send_buf.dtype))
+    cands = [b for b in (base, 2 * base, 4 * base) if cap % b == 0] or [base]
+    key = (tuple(send_buf.shape), str(send_buf.dtype), n, chip)
+
+    def build(b):
+        return lambda sb: fast_all_to_all(sb, send_splits, ctx, axis=axis,
+                                          block_rows=b)[0]
+
+    best, _ = contextual_autotune("a2a_block_rows", key, cands, build,
+                                  (send_buf,), method=method)
     return best
