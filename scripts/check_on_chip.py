@@ -310,6 +310,8 @@ def main() -> int:
 
     # fp8 weight workspace: GEMM_WIDE_W8 + PREFETCH_W8 stream e4m3 weight
     # tiles (half the bytes) and upcast in VMEM.
+    from triton_distributed_tpu.megakernel import MegaKernelBuilder
+
     def mega_fp8():
         mb = MegaKernelBuilder()
         x8 = mb.tensor(TILE, 2 * TILE)
@@ -332,7 +334,6 @@ def main() -> int:
 
     # In-kernel paged-attention task: page table in queue DATA rows, DMA
     # addresses read from SMEM per step.
-    from triton_distributed_tpu.megakernel import MegaKernelBuilder
     from triton_distributed_tpu.megakernel.tasks import TILE as MTILE
 
     def mega_paged():

@@ -76,3 +76,23 @@ def test_ag_gemm_sub_chunk_odd_rows(ctx):
     out = ag_gemm(a, b, ctx, cfg=AGGemmConfig(sub_chunks=2))
     gold = np.asarray(a, np.float64) @ np.asarray(b, np.float64)
     np.testing.assert_allclose(np.asarray(out), gold, rtol=2e-4, atol=2e-4)
+
+
+def test_pallas_matmul_mixed_bf16_fp8():
+    """The realistic fp8 configuration: bf16 activations x e4m3 weights
+    (upcast in VMEM), bf16 out — matches the quantized-weight golden; a
+    low-precision A with wider B is rejected (it would silently quantize
+    the weights)."""
+    from triton_distributed_tpu.ops.gemm import pallas_matmul
+
+    rng = np.random.default_rng(17)
+    a = jnp.asarray(rng.standard_normal((64, 128)), jnp.bfloat16)
+    b8 = jnp.asarray(rng.standard_normal((128, 256)) * 0.1,
+                     jnp.float8_e4m3fn)
+    out = pallas_matmul(a, b8, out_dtype=jnp.float32)
+    gold = np.asarray(a.astype(jnp.float32)) @ np.asarray(
+        b8.astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), gold, rtol=2e-2, atol=2e-2)
+
+    with pytest.raises(ValueError, match="narrower"):
+        pallas_matmul(a.astype(jnp.float8_e4m3fn), b8.astype(jnp.bfloat16))

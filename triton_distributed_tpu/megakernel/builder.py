@@ -436,9 +436,9 @@ class MegaKernelBuilder:
                 dtype=jnp.float32) -> "CompiledMegaKernel":
         if self._pending_pf is not None:
             raise ValueError(
-                f"prefetch of tile {self._pending_pf} never consumed — the "
-                "kernel would exit with an outstanding DMA on the reserved "
-                "slot (emit the matching gemm(prefetch_first=True))")
+                f"prefetch of tile {self._pending_pf[0]} never consumed — "
+                "the kernel would exit with an outstanding DMA on the "
+                "reserved slot (emit the matching gemm(prefetch_first=True))")
         retired = {TaskType.GEMM, TaskType.ROPE}
         for t in self._tasks:
             if t.type in retired:
@@ -506,6 +506,12 @@ class CompiledMegaKernel:
         return jax.lax.dynamic_update_slice(ws, tiles, (h.base, 0, 0))
 
     def gather_output(self, ws: jax.Array, h: TensorHandle) -> jax.Array:
+        if h.fp8:
+            # fp8 ids alias main-workspace ids (separate space starting at
+            # 0) — gathering one from the main ws returns unrelated tiles.
+            raise ValueError("fp8 weight-workspace tensors are read-only "
+                             "inputs; gather_output reads the main "
+                             "workspace")
         tiles = jax.lax.dynamic_slice(
             ws, (h.base, 0, 0), (h.rt * h.ct, TILE, TILE))
         return tiles.reshape(h.rt, h.ct, TILE, TILE).transpose(
@@ -541,6 +547,13 @@ class CompiledMegaKernel:
         advance_queue_pos-updated ``queue`` to retarget without recompile).
         Device-local: wrap in shard_map when num_ranks > 1. ``ws8``: the
         fp8 weight workspace when the program uses one."""
+        if self.num_tiles8 and ws8 is None:
+            # The placeholder run_queue substitutes is ONE tile — a W8
+            # program would DMA weight tiles from out-of-bounds indices
+            # (silent garbage on hardware). Fail loudly instead.
+            raise ValueError(
+                f"program uses {self.num_tiles8} fp8 weight tiles but no "
+                "ws8 was passed — build it with make_workspace8")
         return run_queue(self.queue if queue is None else queue, ws,
                          num_ranks=self.num_ranks, axis=self.axis,
                          num_tasks=self.num_exec, max_gqa=self.max_gqa,

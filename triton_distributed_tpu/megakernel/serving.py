@@ -204,6 +204,15 @@ class MegakernelDecoder:
                           P(axis)),
                 out_specs=(P(axis), P()), check_vma=False)
             self._step_jit = jax.jit(fn, donate_argnums=(0,))
+            if not fp8_weights:
+                # Placeholder fp8 operand allocated ONCE with its final
+                # sharding — a fresh per-step array would add a host
+                # allocation + reshard to every token.
+                from jax.sharding import NamedSharding
+
+                self._ws8 = jax.device_put(
+                    jnp.zeros((n, 1, TILE, TILE), jnp.float8_e4m3fn),
+                    NamedSharding(mesh, P(axis)))
 
     # -- workspace ----------------------------------------------------------
     def start(self, cache) -> jax.Array:
@@ -248,8 +257,8 @@ class MegakernelDecoder:
             s8 = (self.n,) + ws8_shards[0].shape[1:]
             self._ws8 = jax.make_array_from_single_device_arrays(
                 s8, NamedSharding(mesh, P(self.axis)), ws8_shards)
-        else:
-            self._ws8 = None
+        # (fp8 off: keep the __init__-time placeholder — shard_map still
+        # needs its array operand.)
         return jax.make_array_from_single_device_arrays(
             shape, NamedSharding(mesh, P(self.axis)), shards)
 
@@ -288,10 +297,6 @@ class MegakernelDecoder:
                                   num_exec=self.comp.num_exec)
         cos, sin = rope_tables(pos, TILE, self.cfg.rope_theta)
         ws8 = getattr(self, "_ws8", None)
-        if self.n > 1 and ws8 is None:
-            # shard_map needs a real array operand; `sharded` drops it
-            # statically when fp8_weights is off.
-            ws8 = jnp.zeros((self.n, 1, TILE, TILE), jnp.float8_e4m3fn)
         return self._step_jit(ws, self.embed, self.final_norm, self.lm_head,
                               queue, jnp.asarray(cos), jnp.asarray(sin),
                               token, ws8)

@@ -36,7 +36,13 @@ def _grid_matmul_kernel(nk, a_ref, b_ref, out_ref, acc_ref):
     def _():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    acc_ref[...] += jnp.dot(a_ref[...], b_ref[...],
+    bv = b_ref[...]
+    if bv.dtype != a_ref.dtype:
+        # Mixed-precision lane (bf16 activations x fp8 weights): the
+        # low-precision B tile upcasts in VMEM after streaming at its
+        # smaller byte size — the weight-streaming win fp8 exists for.
+        bv = bv.astype(a_ref.dtype)
+    acc_ref[...] += jnp.dot(a_ref[...], bv,
                             preferred_element_type=jnp.float32)
 
     @pl.when(kk == nk - 1)
@@ -59,6 +65,11 @@ def pallas_matmul(a: jax.Array, b: jax.Array,
     k2, ncols = b.shape
     if k != k2:
         raise ValueError(f"inner dims mismatch {k} vs {k2}")
+    if b.dtype != a.dtype and b.dtype.itemsize >= a.dtype.itemsize:
+        # Only LOW-precision B mixes (weights stream small, upcast in
+        # VMEM); an implicit downcast of B would silently quantize it.
+        raise ValueError(f"mixed dtypes need B ({b.dtype}) narrower than "
+                         f"A ({a.dtype})")
     out_dtype = a.dtype if out_dtype is None else jnp.dtype(out_dtype)
     tm = pick_tile(m, tile_m, sublane_align(a.dtype))
     tk = pick_tile(k, tile_k, 128)

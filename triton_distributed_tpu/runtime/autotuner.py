@@ -457,7 +457,10 @@ def tune_ag_gemm(a: jax.Array, b: jax.Array, ctx=None, axis: str = "tp"):
     def build(cfg):
         return lambda x, w: ag_gemm(x, w, ctx, axis=axis, cfg=cfg)
 
-    best, _ = contextual_autotune("ag_gemm", key, cands, build, (a, b))
+    try:
+        best, _ = contextual_autotune("ag_gemm", key, cands, build, (a, b))
+    except RuntimeError:
+        return None      # caller resolves the static default (noisy window)
     return best
 
 
@@ -496,8 +499,14 @@ def tuned_allreduce_method(x: Any, ctx, axis: str = "tp",
     def build(m):
         return lambda xv: all_reduce(xv, ctx, axis=axis, method=m)
 
-    best, _ = contextual_autotune("allreduce_method", key, cands, build,
-                                  (x,), method=method)
+    try:
+        best, _ = contextual_autotune("allreduce_method", key, cands, build,
+                                      (x,), method=method)
+    except RuntimeError:
+        # Every candidate failed to measure (noisy window) — fall back to
+        # the perf-model AUTO rather than crashing the op's default path
+        # (same contract as tuned_matmul_tiles).
+        return "auto"
     return best
 
 
@@ -515,12 +524,16 @@ def tuned_a2a_block_rows(send_buf: Any, send_splits: Any, ctx,
     cap = send_buf.shape[2]
     base = max(16, sublane_align(send_buf.dtype))
     cands = [b for b in (base, 2 * base, 4 * base) if cap % b == 0] or [base]
-    key = (tuple(send_buf.shape), str(send_buf.dtype), n, chip)
+    key = (tuple(send_buf.shape), tuple(send_splits.shape),
+           str(send_buf.dtype), n, chip)
 
     def build(b):
         return lambda sb: fast_all_to_all(sb, send_splits, ctx, axis=axis,
                                           block_rows=b)[0]
 
-    best, _ = contextual_autotune("a2a_block_rows", key, cands, build,
-                                  (send_buf,), method=method)
+    try:
+        best, _ = contextual_autotune("a2a_block_rows", key, cands, build,
+                                      (send_buf,), method=method)
+    except RuntimeError:
+        return None      # static default (noisy window — see above)
     return best
