@@ -196,11 +196,16 @@ def _measure_and_report():
     times_xla, times_pallas = _timed_interleaved(
         [xla_fn, pallas_fn], a, b, lengths, trials=4 if on_tpu else 1)
     if on_tpu:
-        time.sleep(3)
-        t2_xla, t2_pallas = _timed_interleaved(
-            [xla_fn, pallas_fn], a, b, lengths, trials=4)
-        times_xla = [min(x, y) for x, y in zip(times_xla, t2_xla)]
-        times_pallas = [min(x, y) for x, y in zip(times_pallas, t2_pallas)]
+        # THREE separated passes, elementwise min: contention bursts on the
+        # shared chip span whole passes; the min estimator converges to the
+        # clean-window reading for both candidates equally.
+        for _pass in range(2):
+            time.sleep(3)
+            t2_xla, t2_pallas = _timed_interleaved(
+                [xla_fn, pallas_fn], a, b, lengths, trials=4)
+            times_xla = [min(x, y) for x, y in zip(times_xla, t2_xla)]
+            times_pallas = [min(x, y)
+                            for x, y in zip(times_pallas, t2_pallas)]
     t_xla = _per_iter_seconds(times_xla, lengths, flops, strict=strict)
     t_pallas = _per_iter_seconds(times_pallas, lengths, flops, strict=strict)
 
