@@ -217,7 +217,7 @@ def _measure_and_report():
     }
     if on_tpu:
         try:
-            result.update(_fp8_gemm_metric(a, b, lengths[:2]))
+            result.update(_fp8_gemm_metric(a, b, lengths))
         except Exception as e:  # additive metrics never block the headline
             result["fp8_error"] = f"{type(e).__name__}: {str(e)[:120]}"
         try:
@@ -277,9 +277,18 @@ def _fp8_gemm_metric(a_bf16, b_bf16, lengths):
             time.sleep(2)
 
     def per_iter(name):
-        n1, n2 = lens[name]
-        d = (best[(name, n2)] - best[(name, n1)]) / (n2 - n1)
-        return d if d > 0 else None
+        """The headline metric's full fail-loud gate (monotonicity,
+        differential consistency, AND the peak-TFLOPS elision ceiling) —
+        a window that elides/hoists one lane's cells must drop the lane,
+        not ship a 450 TF/s bf16 reading into the ratio."""
+        m_lane = 8 if name.endswith("_m8") else M
+        lane_flops = 2.0 * m_lane * K * K
+        try:
+            return _per_iter_seconds(
+                [best[(name, n)] for n in lens[name]], lens[name],
+                lane_flops, strict=True)
+        except BenchError:
+            return None
 
     per = {name: per_iter(name) for name in fns}
     out = {}
@@ -292,7 +301,8 @@ def _fp8_gemm_metric(a_bf16, b_bf16, lengths):
         out["fp8_vs_bf16_decode_shape"] = round(
             per["bf16_m8"] / per["fp8_m8"], 4)
     if not out:
-        raise BenchError("non-positive fp8 differentials in every lane")
+        raise BenchError("every fp8 lane failed the consistency/elision "
+                         "gates this window")
     return out
 
 
