@@ -67,37 +67,60 @@ def eager_step(w, kT, v, pos, hq, hkv, x, eps=1e-6):
     return x1 + act @ w["w_down"]
 
 
-def per_step_seconds_interleaved(chains, lengths=(2, 18), trials=6):
-    """Differential per-step time for several chain fns, measured in
-    interleaved rounds so chip-speed drift hits all candidates equally
-    (bench.py method)."""
-    n1, n2 = lengths
-    t = {(i, n): float("inf") for i in range(len(chains)) for n in lengths}
+def per_step_seconds_interleaved(chains, lengths_per_chain, trials=6,
+                                 floor_s=0.0):
+    """Differential per-step time for several chain fns, interleaved so
+    chip-speed drift hits all candidates equally (bench.py method).
+
+    Round-4 hardening after two contradictory windows (0.72x vs 10.9x):
+    the old 2-length/short-chain version left the cheap jit chain's
+    differential inside the relay's ±50 ms dispatch swing. Now each chain
+    gets its OWN three lengths (scale them so (n3-n1)·per_step clears
+    ~30 ms), the sub-differentials must agree within 3x, and readings
+    below ``floor_s`` (the weight-streaming roofline — nothing real can
+    be faster) are rejected as elision. Fail-loud on any violation."""
+    idxs = range(len(chains))
+    t = {(i, n): float("inf") for i in idxs for n in lengths_per_chain[i]}
     salt = 0
-    for fn in chains:  # warm/compile both lengths
-        for n in lengths:
+    for i, fn in enumerate(chains):  # warm/compile all lengths
+        for n in lengths_per_chain[i]:
             jax.block_until_ready(fn(n, jnp.float32(salt)))
             salt += 1
-    for _ in range(trials):
-        for i, fn in enumerate(chains):
-            for n in lengths:
-                # A fresh salt every call: the relay memoizes identical
-                # dispatches, which would make long chains "faster" than
-                # short ones.
-                salt += 1
-                t0 = time.perf_counter()
-                out = fn(n, jnp.float32(salt * 1e-6))
-                _ = np.asarray(jnp.sum(out))  # host fetch forces completion
-                t[(i, n)] = min(t[(i, n)], time.perf_counter() - t0)
-    for i in range(len(chains)):
-        if t[(i, n2)] <= t[(i, n1)]:
+    for p in range(2):
+        for _ in range(trials):
+            for i, fn in enumerate(chains):
+                for n in lengths_per_chain[i]:
+                    # A fresh salt every call: the relay memoizes identical
+                    # dispatches, which would make long chains "faster"
+                    # than short ones.
+                    salt += 1
+                    t0 = time.perf_counter()
+                    out = fn(n, jnp.float32(salt * 1e-6))
+                    _ = np.asarray(jnp.sum(out))  # host fetch = completion
+                    t[(i, n)] = min(t[(i, n)], time.perf_counter() - t0)
+        if p == 0:
+            time.sleep(3)
+    out_s = []
+    for i in idxs:
+        n1, n2, n3 = lengths_per_chain[i]
+        t1, t2, t3 = (t[(i, n)] for n in lengths_per_chain[i])
+        if not (t3 > t2 > t1):
             raise RuntimeError(
-                f"non-monotone timings for chain {i}: t({n1})={t[(i, n1)]:.4f} "
-                f"t({n2})={t[(i, n2)]:.4f} — the relay/chip is not completing "
-                "work synchronously; refusing to report garbage (retry when "
-                "the chip is quiet)")
-    return [(t[(i, n2)] - t[(i, n1)]) / (n2 - n1)
-            for i in range(len(chains))]
+                f"non-monotone timings for chain {i}: {t1:.4f}/{t2:.4f}/"
+                f"{t3:.4f} — elision/noise; refusing to report garbage")
+        d21 = (t2 - t1) / (n2 - n1)
+        d32 = (t3 - t2) / (n3 - n2)
+        if not (0.33 < d21 / max(d32, 1e-12) < 3.0):
+            raise RuntimeError(
+                f"inconsistent differentials for chain {i}: {d21:.3e} vs "
+                f"{d32:.3e} — window too noisy to trust")
+        per = (t3 - t1) / (n3 - n1)
+        if per < floor_s:
+            raise RuntimeError(
+                f"chain {i} reads {per*1e3:.3f} ms/step, below the "
+                f"{floor_s*1e3:.3f} ms weight-streaming roofline — elided")
+        out_s.append(per)
+    return out_s
 
 
 def main():
@@ -112,11 +135,14 @@ def main():
         # Qwen3-8B TP=8 per-device shard: hq=4, hkv=1, ffn=1536, h=4096.
         hidden, hq, hkv, ffn = 4096, 4, 1, 1536
         S = args.seq or 1024
-        lengths = (8, 56)
+        # Per-chain triples sized so each differential clears ~30 ms of
+        # relay dispatch swing: the megakernel step is ~0.5 ms, the jitted
+        # eager step can be ~0.05 ms at boost clocks.
+        mega_lengths, eager_lengths = (8, 40, 72), (48, 240, 432)
     else:
         hidden, hq, hkv, ffn = 256, 2, 1, 256
         S = args.seq or 256
-        lengths = (1, 3)
+        mega_lengths = eager_lengths = (1, 2, 3)
     pos = S - 1
 
     rng = np.random.default_rng(0)
@@ -197,9 +223,15 @@ def main():
         return jax.lax.fori_loop(0, n, body, x0 + salt.astype(x0.dtype))
 
     xj = jnp.asarray(x, wdt)
+    # Weight-streaming floor: one layer-step must re-read every weight
+    # (they exceed VMEM); below weights_bytes / 2.5 TB/s nothing is real.
+    wbytes = (hidden * (hq + 2 * hkv) * TILE + hq * TILE * hidden
+              + 3 * hidden * ffn) * jnp.dtype(wdt).itemsize * args.layers
+    floor_s = wbytes / 2.5e12
     t_mega, t_eager = per_step_seconds_interleaved(
         [lambda n, s_: mega_chain(ws0, n, s_),
-         lambda n, s_: eager_chain(xj, n, s_)], lengths)
+         lambda n, s_: eager_chain(xj, n, s_)],
+        [mega_lengths, eager_lengths], floor_s=floor_s)
 
     print(f"{'megakernel':12} {t_mega * 1e3:>9.3f} ms/step")
     print(f"{'eager xla':12} {t_eager * 1e3:>9.3f} ms/step  "
