@@ -517,12 +517,20 @@ class CompiledMegaKernel:
         return tiles.reshape(h.rt, h.ct, TILE, TILE).transpose(
             0, 2, 1, 3).reshape(h.rows, h.cols)
 
+    @property
+    def _strip_pad(self) -> int:
+        """GEMM_WIDE fetches B strips at the STATIC max width even for
+        narrower edge strips (traced-size DMAs are illegal); padding the
+        workspaces by width-1 tiles keeps that overfetch in bounds."""
+        return max(self.max_gemm_width - 1, 0)
+
     def make_workspace(self, inputs: dict) -> jax.Array:
         """Build the tiled MAIN workspace once (weights + caches +
         activations; fp8-space handles are rejected — use make_workspace8).
         In a serving loop, scatter weights here a single time and update
         only the per-step tensors afterward (scatter_input is jittable)."""
-        ws = jnp.zeros((max(self.num_tiles, 1), TILE, TILE), self.dtype)
+        ws = jnp.zeros((max(self.num_tiles, 1) + self._strip_pad,
+                        TILE, TILE), self.dtype)
         for h, v in inputs.items():
             if h.fp8:
                 raise ValueError("fp8 handle in main workspace feeds — "
@@ -533,8 +541,8 @@ class CompiledMegaKernel:
     def make_workspace8(self, inputs: dict) -> jax.Array:
         """Build the float8_e4m3fn weight workspace (read-only input of
         every step; values quantize to e4m3 on scatter)."""
-        ws8 = jnp.zeros((max(self.num_tiles8, 1), TILE, TILE),
-                        jnp.float8_e4m3fn)
+        ws8 = jnp.zeros((max(self.num_tiles8, 1) + self._strip_pad,
+                         TILE, TILE), jnp.float8_e4m3fn)
         for h, v in inputs.items():
             if not h.fp8:
                 raise ValueError("non-fp8 handle in fp8 workspace feeds")

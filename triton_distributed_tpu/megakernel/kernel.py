@@ -36,8 +36,8 @@ from triton_distributed_tpu.runtime.context import use_interpret
 
 def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
                  max_gemm_width: int,
-                 queue_ref, ws_in, ws8, ws_out, slots, va2, vb2, vb8, vacc,
-                 vq, vstat, vqg, vaccg, vstatg, vaccw,
+                 queue_ref, ws_in, ws8, ws_out, slots, va2, vb2, vb8, vbw,
+                 vbw8, vacc, vq, vstat, vqg, vaccg, vstatg, vaccw,
                  copy_sem, pipe_sems, send_sems, recv_sem):
     wdt = ws_out.dtype   # workspace dtype (fp32 or bf16); compute is fp32
     step = pl.program_id(0)
@@ -147,73 +147,60 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
         pltpu.make_async_copy(ws_out.at[a0], vb2.at[PIPE_DEPTH],
                               pipe_sems.at[2 * PIPE_DEPTH]).start()
 
-    def _gemm_wide_body(b_ws, b_buf):
+    def _gemm_wide_body(b_ws, b_strip):
         # One task computes ``width`` contiguous output column tiles: the A
-        # row tiles stream ONCE for the strip (the single-tile GEMM
-        # re-fetched them per output tile) and width-1 task dispatches
-        # disappear. A double-buffers over 2 slots of va2; the flattened
-        # (j, w) B stream pipelines PIPE_DEPTH deep over ``b_buf``
-        # (vb2 in workspace dtype, or the fp8 vb8 for GEMM_WIDE_W8 —
-        # weight tiles from the fp8 workspace upcast in VMEM); per-column
-        # fp32 accumulators live in vaccw's leading dim (dynamic leading-
-        # dim indexing — lane-dim dynamic slicing would not lower).
+        # row tiles stream ONCE for the strip and width-1 task dispatches
+        # disappear. The strip's B tiles are CONTIGUOUS workspace tiles
+        # (b0 + j*b_stride + w), so each k-step fetches the whole
+        # (W, TILE, TILE) strip in ONE DMA — the round-4 retraction's
+        # diagnosis was ~2000 per-tile fetches per layer-step against a
+        # ~55 us streaming roofline, and strip DMAs divide that count by
+        # the width. The DMA size is STATIC (full W even for narrower edge
+        # strips — compile() pads the workspaces so the overfetch stays in
+        # bounds); ``b_strip`` double-buffers over its leading dim (vbw in
+        # workspace dtype, vbw8 for GEMM_WIDE_W8 — fp8 tiles upcast at the
+        # dot). Per-column fp32 accumulators live in vaccw's leading dim
+        # (dynamic leading-dim indexing — lane-dim slicing would not
+        # lower).
         width = arg
-        n_b = k_tiles * width
         vaccw[...] = jnp.zeros_like(vaccw)
 
-        def b_tile_idx(f):
-            j = f // width
-            return b0 + j * b_stride + (f - j * width)
+        # A PREFETCH warm (c0 == 1) targeted the single-tile reserved slot
+        # of the old per-tile stream; the strip fetch re-reads that tile
+        # anyway, so just CONSUME the outstanding DMA's semaphore (kernel
+        # hygiene: exiting with an unawaited DMA is illegal).
+        @pl.when(c0 == 1)
+        def _():
+            pltpu.make_async_copy(b_ws.at[b0], vb2.at[PIPE_DEPTH]
+                                  if b_strip is vbw else vb8.at[PIPE_DEPTH],
+                                  pipe_sems.at[2 * PIPE_DEPTH]).wait()
 
-        def bdesc(f, slot, sem_i):
-            return pltpu.make_async_copy(b_ws.at[b_tile_idx(f)],
-                                         b_buf.at[slot], pipe_sems.at[sem_i])
+        def sdesc(j, slot):
+            return pltpu.make_async_copy(
+                b_ws.at[pl.ds(b0 + j * b_stride, b_strip.shape[1])],
+                b_strip.at[slot], pipe_sems.at[slot * 2 + 1])
 
         def adesc(j, slot):
             return pltpu.make_async_copy(ws_out.at[a0 + j * a_stride],
                                          va2.at[slot],
                                          pipe_sems.at[slot * 2])
 
-        def b_slot_sem(f, slot):
-            # f == 0 may have been warmed into the reserved slot by a
-            # PREFETCH task (c0 == 1) — consume that instead of loading.
-            use_pf = jnp.logical_and(f == 0, c0 == 1)
-            return (jnp.where(use_pf, PIPE_DEPTH, slot),
-                    jnp.where(use_pf, 2 * PIPE_DEPTH, slot * 2 + 1))
-
-        def b_start(f, slot):
-            @pl.when(jnp.logical_or(f != 0, c0 != 1))
-            def _():
-                bdesc(f, slot, slot * 2 + 1).start()
-
-        for s in range(PIPE_DEPTH - 1):
-            @pl.when(s < n_b)
-            def _(s=s):
-                b_start(s, s)
         adesc(0, 0).start()
+        sdesc(0, 0).start()
 
         @pl.when(k_tiles > 1)
         def _():
             adesc(1, 1).start()
+            sdesc(1, 1).start()
 
         def jbody(j, _):
-            aslot = jax.lax.rem(j, 2)
-            adesc(j, aslot).wait()
+            slot = jax.lax.rem(j, 2)
+            adesc(j, slot).wait()
+            sdesc(j, slot).wait()
 
             def wbody(w, _):
-                f = j * width + w
-                slot = jax.lax.rem(f, PIPE_DEPTH)
-                nxt = f + PIPE_DEPTH - 1
-
-                @pl.when(nxt < n_b)
-                def _():
-                    b_start(nxt, jax.lax.rem(nxt, PIPE_DEPTH))
-
-                bs, sem = b_slot_sem(f, slot)
-                pltpu.make_async_copy(b_ws.at[b_tile_idx(f)], b_buf.at[bs],
-                                      pipe_sems.at[sem]).wait()
                 vaccw[w, :, :] = vaccw[w] + jnp.dot(
-                    va2[aslot], b_buf[bs].astype(va2.dtype),
+                    va2[slot], b_strip[slot, w].astype(va2.dtype),
                     preferred_element_type=jnp.float32)
                 return 0
 
@@ -221,7 +208,8 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
 
             @pl.when(j + 2 < k_tiles)
             def _():
-                adesc(j + 2, aslot).start()
+                adesc(j + 2, slot).start()
+                sdesc(j + 2, slot).start()
 
             return 0
 
@@ -235,10 +223,10 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
         jax.lax.fori_loop(0, width, store_w, 0)
 
     def t_gemm_wide():
-        _gemm_wide_body(ws_out, vb2)
+        _gemm_wide_body(ws_out, vbw)
 
     def t_gemm_wide_w8():
-        _gemm_wide_body(ws8, vb8)
+        _gemm_wide_body(ws8, vbw8)
 
     def t_prefetch_w8():
         # Fire-and-forget warm of fp8 weight tile a0 into vb8's reserved
@@ -511,6 +499,10 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
     queue: (n_rows, WORDS) int32; workspace: (T, TILE, TILE) fp32 or bf16
     (local per device when num_ranks > 1 — call inside shard_map). bf16
     halves every tile DMA; compute stays fp32 on the VPU/MXU.
+    CONTRACT: T must include max_gemm_width-1 PAD tiles past the last
+    real tile (GEMM_WIDE fetches static full-width B strips; narrower
+    edge strips overfetch into the pad) — CompiledMegaKernel.make_workspace
+    adds the pad; raw callers must too.
     ``num_tasks``: dispatched rows (default all) — rows beyond are DATA
     (ATTN_DECODE_PAGED page tables) the grid never visits.
     ``max_gqa``: largest ATTN_DECODE_GQA group in the queue (sizes the
@@ -529,8 +521,16 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
     wdt = workspace.dtype
     G = max(max_gqa, 1)
     W = max(max_gemm_width, 1)
+    w8_absent = workspace8 is None
     if workspace8 is None:
         workspace8 = jnp.zeros((1, TILE, TILE), jnp.float8_e4m3fn)
+    if workspace8.shape[0] < W + 1:
+        # The compiled GEMM_WIDE_W8 branch statically slices W-tile strips
+        # (and exists in the switch even for programs that never dispatch
+        # it) — an undersized placeholder must pad so the slice bound
+        # checks out.
+        workspace8 = jnp.pad(
+            workspace8, ((0, W + 1 - workspace8.shape[0]), (0, 0), (0, 0)))
 
     # AR slots ride as a second output: Mosaic has no HBM scratch (see
     # language/core.py kernel_call ``workspaces``).
@@ -544,6 +544,12 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
             pltpu.VMEM((PIPE_DEPTH + 1, TILE, TILE), wdt),  # vb2 (+pf slot)
             pltpu.VMEM((PIPE_DEPTH + 1, TILE, TILE),
                        jnp.float8_e4m3fn),                  # vb8 (+pf slot)
+            pltpu.VMEM((2, W, TILE, TILE), wdt),            # vbw (B strips)
+            # fp8 strip buffer shrinks to 1 tile when the program has no
+            # fp8 workspace (the W8 branch still compiles; it adapts via
+            # b_strip.shape[1]) — ~0.5 MB of VMEM saved at W=8.
+            pltpu.VMEM((2, W if not w8_absent else 1, TILE, TILE),
+                       jnp.float8_e4m3fn),                  # vbw8
             pltpu.VMEM((TILE, TILE), jnp.float32),      # vacc (fp32 accum)
             pltpu.VMEM((TILE, TILE), wdt),              # vq: rope/attn operand
             pltpu.VMEM((TILE, 128), jnp.float32),       # vstat (softmax stats)

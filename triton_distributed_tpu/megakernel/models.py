@@ -163,20 +163,17 @@ def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
     scale = d ** -0.5
 
     xn = mb.tensor(TILE, hidden)
-    # Weight prefetches: each gemm's first weight tile is warmed into the
-    # reserved pipeline slot while the preceding tasks run (reference
-    # weight-prefetch task, SURVEY.md §2.7) — wq under the norm, wo under
-    # the whole attention phase, w_gate under AR+add+norm, etc.
-    mb.prefetch(h.wq.tile(0, 0), fp8=h.wq.fp8)
+    # No weight prefetches since the strip-fetch GEMM (round 4): one
+    # (W, TILE, TILE) strip DMA replaced the per-tile stream, so a
+    # single-tile warm would be discarded — each prefetch would cost a
+    # dispatch plus a wasted tile fetch. (The PREFETCH task types remain
+    # for direct builder use; reference weight-prefetch, SURVEY.md §2.7.)
     mb.rms_norm(xn, x, h.attn_norm, eps)
 
     q = mb.tensor(TILE, hq_local * d)
-    mb.gemm(q, xn, h.wq, prefetch_first=True)
-    mb.prefetch(h.wk.tile(0, 0), fp8=h.wk.fp8)
-    mb.gemm(h.k_new, xn, h.wk, prefetch_first=True)
-    mb.prefetch(h.wv.tile(0, 0), fp8=h.wv.fp8)
-    mb.gemm(h.v_new, xn, h.wv, prefetch_first=True)
-    mb.prefetch(h.wo.tile(0, 0), fp8=h.wo.fp8)
+    mb.gemm(q, xn, h.wq)
+    mb.gemm(h.k_new, xn, h.wk)
+    mb.gemm(h.v_new, xn, h.wv)
 
     # Per-head qk-norm + RoPE, fused into one task per head (head_dim ==
     # TILE → the norm reduces over the single head tile).
@@ -226,8 +223,7 @@ def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
                          _col(h.v_new, kv))
 
     o = mb.tensor(TILE, hidden)
-    mb.gemm(o, attn, h.wo, prefetch_first=True)
-    mb.prefetch(h.w_gate.tile(0, 0), fp8=h.w_gate.fp8)
+    mb.gemm(o, attn, h.wo)
     if num_ranks > 1:
         mb.all_reduce(o)
     x1 = mb.tensor(TILE, hidden)
@@ -239,13 +235,11 @@ def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
     gate = mb.tensor(TILE, ffn_local)
     up = mb.tensor(TILE, ffn_local)
     act = mb.tensor(TILE, ffn_local)
-    mb.gemm(gate, x1n, h.w_gate, prefetch_first=True)
-    mb.prefetch(h.w_up.tile(0, 0), fp8=h.w_up.fp8)
-    mb.gemm(up, x1n, h.w_up, prefetch_first=True)
-    mb.prefetch(h.w_down.tile(0, 0), fp8=h.w_down.fp8)
+    mb.gemm(gate, x1n, h.w_gate)
+    mb.gemm(up, x1n, h.w_up)
     mb.silu_mul(act, gate, up)
     down = mb.tensor(TILE, hidden)
-    mb.gemm(down, act, h.w_down, prefetch_first=True)
+    mb.gemm(down, act, h.w_down)
     if num_ranks > 1:
         mb.all_reduce(down)
     x2 = mb.tensor(TILE, hidden)
