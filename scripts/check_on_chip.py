@@ -35,8 +35,10 @@ def main() -> int:
     ctx = initialize_distributed(mesh_shape=(1,), axis_names=("tp",))
     rng = np.random.default_rng(0)
     failures = []
+    total = [0]
 
     def check(name, fn):
+        total[0] += 1
         try:
             jax.block_until_ready(fn())
             print(f"  OK   {name}")
@@ -360,9 +362,11 @@ def main() -> int:
     check("megakernel paged-attention task", mega_paged)
 
     if failures:
-        print(f"\n{len(failures)} FAILURES: {failures}")
+        print(f"\n{total[0] - len(failures)}/{total[0]} passed — "
+              f"{len(failures)} FAILURES: {failures}")
         return 1
-    print("\nall kernel families compile + run on real TPU")
+    print(f"\n{total[0]}/{total[0]}: all kernel families compile + run "
+          "on real TPU")
     return 0
 
 

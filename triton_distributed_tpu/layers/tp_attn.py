@@ -120,7 +120,8 @@ def _sdpa(q, k, v, *, causal: bool, kv_len: jax.Array | None = None):
 def tp_attn_prefill(params: dict, cfg: ModelConfig, x: jax.Array,
                     batch: int, seq: int, kv_slice: KVSlice | None = None, *,
                     axis: str = "tp", num_ranks: int = 1,
-                    mode: str = "overlap"):
+                    mode: str = "overlap",
+                    flash_tiles: tuple[int, int] | None = None):
     """Causal prefill. x: (B·S/n, h) row-sharded (overlap/xla) or (B·S, h)
     replicated (ar). Returns (out, KVSlice of the full prompt written into
     ``kv_slice`` at positions [0, S))."""
@@ -146,15 +147,20 @@ def tp_attn_prefill(params: dict, cfg: ModelConfig, x: jax.Array,
     # Tiled Pallas flash attention (ops/flash_attention.py) — flat-memory
     # causal prefill; dense fallback only for tiny/odd shapes. Reference:
     # the FA consumer the reference's TP_Attn runs (tp_attn.py:79-324).
-    # Tile caps resolve through the autotuner at trace time (shapes are
-    # concrete; tuning measures once per shape/chip, disk-cached —
-    # VERDICT r3 #8: this path used to run only the static caps).
+    # Tile caps: ``flash_tiles`` when the host-level caller resolved them
+    # (Engine._prefill_jit runs the autotuner at make() time); otherwise a
+    # CACHE-ONLY lookup — this fn traces inside jit, and launching eager
+    # on-chip measurements mid-trace stalled the default path for minutes
+    # (round-4 advisor finding).
     from triton_distributed_tpu.ops.flash_attention import (
         resolve_flash_tiles, shard_attention,
     )
 
-    tq_cap, tk_cap = resolve_flash_tiles(q.shape[1], k.shape[1], q.shape[2],
-                                         k.shape[2], q.shape[3], q.dtype)
+    if flash_tiles is None:
+        flash_tiles = resolve_flash_tiles(
+            q.shape[1], k.shape[1], q.shape[2], k.shape[2], q.shape[3],
+            q.dtype, cache_only=True)
+    tq_cap, tk_cap = flash_tiles
     attn = shard_attention(q, k, v, causal=True, tile_q=tq_cap,
                            tile_k=tk_cap)
     attn = attn.reshape(batch * seq, -1)
@@ -207,7 +213,8 @@ def _out_proj(attn: jax.Array, params: dict, *, axis: str, n: int,
 def tp_attn_prefill_chunk(params: dict, cfg: ModelConfig, x: jax.Array,
                           kv_slice: KVSlice, start: jax.Array,
                           chunk_len: int, *, axis: str = "tp",
-                          num_ranks: int = 1, mode: str = "ar"):
+                          num_ranks: int = 1, mode: str = "ar",
+                          flash_tiles: tuple[int, int] | None = None):
     """Chunked-prefill attention: the chunk's queries (positions
     [start, start+chunk_len)) attend the cached prefix — the flash kernel's
     positional causality (q_offset=start, TRACED) makes this one call, so
@@ -243,12 +250,15 @@ def tp_attn_prefill_chunk(params: dict, cfg: ModelConfig, x: jax.Array,
         v=jax.lax.dynamic_update_slice(
             kv_slice.v, v.astype(kv_slice.v.dtype), (0, start, 0, 0)),
     )
-    # Autotuned tile caps (trace-time resolution, same rationale as the
-    # full prefill path above): mid-length chunks have a different optimum
-    # than the S=32k sweep's.
-    tq_cap, tk_cap = resolve_flash_tiles(
-        chunk_len, kv_slice.k.shape[1], q.shape[2], k.shape[2], q.shape[3],
-        q.dtype)
+    # Tile caps: host-resolved ``flash_tiles`` when given, else a
+    # cache-only tuner lookup (never measure mid-trace — see
+    # tp_attn_prefill). Mid-length chunks have a different optimum than
+    # the S=32k sweep's.
+    if flash_tiles is None:
+        flash_tiles = resolve_flash_tiles(
+            chunk_len, kv_slice.k.shape[1], q.shape[2], k.shape[2],
+            q.shape[3], q.dtype, cache_only=True)
+    tq_cap, tk_cap = flash_tiles
     acc, m, l = shard_attention_partial(
         q, new_kv.k.astype(q.dtype), new_kv.v.astype(q.dtype),
         q_offset=start, k_offset=0, causal=True, tile_q=tq_cap,

@@ -121,12 +121,15 @@ def _mlp_or_moe(layer: dict, cfg: ModelConfig, h: jax.Array, *, axis: str,
 
 def dense_prefill(params: dict, cfg: ModelConfig, input_ids: jax.Array,
                   cache: KVCache, *, axis: str = "tp", num_ranks: int = 1,
-                  mode: str = "overlap"):
+                  mode: str = "overlap",
+                  flash_tiles: tuple[int, int] | None = None):
     """Device-local causal prefill.
 
     input_ids: (B, S) replicated. Activations run row-sharded over B·S in
     overlap/xla modes ((B·S)/n rows per device), replicated otherwise.
     Returns (last-token logits (B, vocab), cache filled for [0, S)).
+    ``flash_tiles``: host-resolved flash tile caps (Engine passes the
+    autotuned pair; None = cache-only lookup inside the layer).
     """
     n = num_ranks
     batch, seq = input_ids.shape
@@ -141,7 +144,7 @@ def dense_prefill(params: dict, cfg: ModelConfig, input_ids: jax.Array,
         h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
         attn_out, kv = tp_attn_prefill(
             layer["attn"], cfg, h, batch, seq, cache.layer(i),
-            axis=axis, num_ranks=n, mode=mode)
+            axis=axis, num_ranks=n, mode=mode, flash_tiles=flash_tiles)
         cache = cache.with_layer(i, kv)
         x = x + attn_out
         h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
@@ -157,7 +160,8 @@ def dense_prefill(params: dict, cfg: ModelConfig, input_ids: jax.Array,
 def dense_prefill_chunked(params: dict, cfg: ModelConfig,
                           input_ids: jax.Array, cache: KVCache, *,
                           chunk: int, axis: str = "tp", num_ranks: int = 1,
-                          mode: str = "ar"):
+                          mode: str = "ar",
+                          flash_tiles: tuple[int, int] | None = None):
     """Bounded-memory causal prefill: the prompt is processed ``chunk``
     tokens at a time, each chunk's queries attending the whole cached
     prefix through the flash kernel's positional causality
@@ -196,7 +200,8 @@ def dense_prefill_chunked(params: dict, cfg: ModelConfig,
             h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
             attn_out, kv = tp_attn_prefill_chunk(
                 layer["attn"], cfg, h, cache.layer(i), start, chunk,
-                axis=axis, num_ranks=n, mode=attn_mode)
+                axis=axis, num_ranks=n, mode=attn_mode,
+                flash_tiles=flash_tiles)
             cache = cache.with_layer(i, kv)
             x = x + attn_out
             h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)

@@ -98,16 +98,38 @@ class Engine:
         return jax.shard_map(f, mesh=self.ctx.mesh, in_specs=in_specs,
                              out_specs=out_specs, check_vma=False)
 
+    def _flash_tiles(self, sq: int, sk: int) -> tuple[int, int]:
+        """Host-level flash tile resolution for the prefill paths — the
+        autotuner measures HERE (make() time, before the jit call traces),
+        never inside the traced layer fn (round-4 advisor: measuring
+        mid-trace stalled Engine tracing for minutes). Shard-local GQA
+        head counts: heads are column-parallel over the TP axis.
+
+        When sq < sk (chunked prefill) the measurement runs at the
+        late-chunk offset (sk - sq), where the causal skip hides nothing —
+        at offset 0 nearly every KV tile is masked and the tuner would
+        rank DMA cost, not the compute that dominates real prefill."""
+        from triton_distributed_tpu.ops.flash_attention import (
+            resolve_flash_tiles,
+        )
+
+        return resolve_flash_tiles(
+            sq, sk, self.cfg.num_heads // self.n,
+            self.cfg.num_kv_heads // self.n, self.cfg.head_dim,
+            jnp.dtype(self.cfg.dtype), q_offset=max(sk - sq, 0))
+
     def _prefill_jit(self, batch: int, seq: int):
         key = ("prefill", batch, seq)
         if key not in self._jit_cache:
             mode = self._prefill_mode(batch, seq)
             cspecs = kv_cache_specs(self.axis)
+            extra = ({"flash_tiles": self._flash_tiles(seq, seq)}
+                     if self._prefill_fn is dense_prefill else {})
 
             def step(params, ids, cache):
                 return self._prefill_fn(
                     params, self.cfg, ids, cache,
-                    axis=self.axis, num_ranks=self.n, mode=mode)
+                    axis=self.axis, num_ranks=self.n, mode=mode, **extra)
 
             fn = self._shard(
                 step,
@@ -279,11 +301,13 @@ class Engine:
             # Replicated-activation mode matching the backend: 'xla' engines
             # must not silently run Pallas collectives.
             mode = self._decode_mode()
+            tiles = self._flash_tiles(chunk, self.max_seq)
 
             def step(params, ids, cache):
                 return dense_prefill_chunked(
                     params, self.cfg, ids, cache, chunk=chunk,
-                    axis=self.axis, num_ranks=self.n, mode=mode)
+                    axis=self.axis, num_ranks=self.n, mode=mode,
+                    flash_tiles=tiles)
 
             fn = self._shard(
                 step,

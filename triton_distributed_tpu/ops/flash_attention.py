@@ -71,19 +71,32 @@ def _tile_estimate(tq: int, tk: int, d: int, itemsize: int) -> int:
             + 2 * 2 * 8 * tq * 4)     # (8, tq) m/l out blocks, double-buffered
 
 
-def _fit_tiles(sq: int, sk: int, d: int, q_dtype, k_dtype,
-               tile_q: int, tile_k: int):
-    """(tq, tk) within the VMEM budget, degrading the q-tile cap (then the
-    k-tile cap) before giving up; None if nothing fits (dense fallback)."""
+def _fit_ladder(sq: int, sk: int, d: int, q_dtype, k_dtype,
+                tile_q: int, tile_k: int) -> list:
+    """All (tq, tk) configs within the VMEM budget, best-first (q-tile cap
+    degrades before the k-tile cap); empty if nothing fits (dense
+    fallback). The probe in :func:`_flash_call` walks this ladder when a
+    config's modeled working set sits close enough to the budget that the
+    estimate alone cannot be trusted (round-4 advisor finding: a
+    mis-modeled shape used to hard-fail at Mosaic compile)."""
     itemsize = max(jnp.dtype(q_dtype).itemsize, jnp.dtype(k_dtype).itemsize)
     k_align = max(sublane_align(q_dtype), sublane_align(k_dtype))
+    ladder = []
     for tk_cap in (tile_k, 512, 256):
         tk = pick_tile(sk, tk_cap, k_align)
         for tq_cap in (tile_q, 512, 256, 128):
             tq = pick_tile(sq, tq_cap, 128)
-            if _tile_estimate(tq, tk, d, itemsize) <= _VMEM_BUDGET:
-                return tq, tk
-    return None
+            if (_tile_estimate(tq, tk, d, itemsize) <= _VMEM_BUDGET
+                    and (tq, tk) not in ladder):
+                ladder.append((tq, tk))
+    return ladder
+
+
+def _fit_tiles(sq: int, sk: int, d: int, q_dtype, k_dtype,
+               tile_q: int, tile_k: int):
+    """Best (tq, tk) within the VMEM budget; None if nothing fits."""
+    ladder = _fit_ladder(sq, sk, d, q_dtype, k_dtype, tile_q, tile_k)
+    return ladder[0] if ladder else None
 
 
 # ---------------------------------------------------------------------------
@@ -206,29 +219,86 @@ def _flash_kernel(g: int, nk: int, tq: int, tk: int, scale: float,
         l_ref[0, 0] = jnp.broadcast_to(l_row[None, :], (8, tq))
 
 
-def _flash_call(q4, k4, v4, q_offset, k_offset, *, causal: bool,
-                normalize: bool, tile_q: int, tile_k: int):
-    """Head-major flash attention. q4: (B, hq, Sq, d); k4/v4: (B, hkv, Sk, d).
-    Returns (out (B,hq,Sq,d), m (B,hq,Sq), l (B,hq,Sq))."""
-    b, hq, sq, d = q4.shape
-    hkv, sk = k4.shape[1], k4.shape[2]
+class FlashCompileError(ValueError):
+    """No flash tile configuration fits VMEM (modeled) or compiles
+    (probed) for this shape — callers fall back to the dense path."""
+
+
+# A config whose modeled working set exceeds this is probe-compiled on real
+# TPU before dispatch (the model is calibrated on two points; near the
+# 16MiB boundary it cannot be trusted to a few percent — round-4 advisor).
+_PROBE_SAFE = 14_000_000
+# Configs measured compiling + running on the real chip (rounds 3-4 sweeps):
+# (tq, tk, d, itemsize). These skip the probe even inside the risk band —
+# probing them would re-add a ~30 s trace-time compile to the default
+# S=32k prefill path for nothing.
+_KNOWN_GOOD = {(1024, 1024, 128, 2), (512, 1024, 128, 4)}
+_probe_memory: dict = {}
+
+
+def _probe_ok(hq: int, hkv: int, sq: int, sk: int, d: int, q_dtype, k_dtype,
+              v_dtype, causal: bool, normalize: bool, tq: int, tk: int
+              ) -> bool:
+    """AOT-compile the kernel at this config (B=1 — batch is a parallel
+    grid dim and does not change the per-block VMEM footprint); False on a
+    Mosaic VMEM/resource failure, re-raising anything that doesn't look
+    like one. Verdicts are disk-cached per chip so each shape pays the
+    probe compile (~30 s through the relay) once."""
+    import jax as _jax
+
+    chip = _jax.devices()[0].device_kind
+    key = (f"flash_probe::{hq},{hkv},{sq},{sk},{d},{jnp.dtype(q_dtype)},"
+           f"{jnp.dtype(k_dtype)},{jnp.dtype(v_dtype)},{causal},"
+           f"{normalize},{tq},{tk},{chip}")
+    if key in _probe_memory:
+        return _probe_memory[key]
+    from triton_distributed_tpu.runtime.autotuner import (
+        _load_disk_cache, _store_disk_cache,
+    )
+
+    disk = _load_disk_cache()
+    if isinstance(disk.get(key), bool):
+        _probe_memory[key] = disk[key]
+        return disk[key]
+    fn = _build_flash(1, hq, hkv, sq, sk, d, q_dtype, k_dtype, v_dtype,
+                      causal=causal, normalize=normalize, tq=tq, tk=tk)
+    cacheable = True
+    try:
+        jax.jit(fn).lower(
+            jax.ShapeDtypeStruct((2,), jnp.int32),
+            jax.ShapeDtypeStruct((1, hq, sq, d), q_dtype),
+            jax.ShapeDtypeStruct((1, hkv, sk, d), k_dtype),
+            jax.ShapeDtypeStruct((1, hkv, sk, d), v_dtype)).compile()
+        ok = True
+    except Exception as e:
+        msg = str(e).lower()
+        if any(s in msg for s in ("vmem", "scoped")):
+            # Deterministic Mosaic VMEM rejection — safe to remember.
+            ok = False
+        else:
+            # Anything else (relay HTTP 500, timeouts, transient compile
+            # trouble) is INCONCLUSIVE: dispatch the config anyway — the
+            # pre-probe code would have — and never cache the verdict, so a
+            # network blip can't permanently demote the measured-best tile
+            # or abort the caller's trace.
+            ok = True
+            cacheable = False
+    _probe_memory[key] = ok
+    if cacheable:
+        disk = _load_disk_cache()
+        disk[key] = ok
+        _store_disk_cache(disk)
+    return ok
+
+
+def _build_flash(b: int, hq: int, hkv: int, sq: int, sk: int, d: int,
+                 q_dtype, k_dtype, v_dtype, *, causal: bool, normalize: bool,
+                 tq: int, tk: int):
+    """Construct the pallas_call closure for one tile config; shared by the
+    dispatch path and the compile probe."""
     g = hq // hkv
-    # tq doubles as the stats blocks' LANE dim: must be 128-divisible (or
-    # the full Sq) — _fit_tiles/pick_tile(align=128) guarantee it, and the
-    # caps degrade until the working set fits VMEM (same policy as
-    # flash_supported, so a dispatched shape always fits).
-    fitted = _fit_tiles(sq, sk, d, q4.dtype, k4.dtype, tile_q, tile_k)
-    if fitted is None:
-        raise ValueError(
-            f"no tile configuration fits VMEM for Sq={sq} Sk={sk} d={d} — "
-            "guard calls with flash_supported()")
-    tq, tk = fitted
     nq, nk = sq // tq, sk // tk
     scale = d ** -0.5
-
-    offs = jnp.stack([jnp.asarray(q_offset, jnp.int32).reshape(()),
-                      jnp.asarray(k_offset, jnp.int32).reshape(())])
-
     kernel = functools.partial(_flash_kernel, g, nk, tq, tk, scale,
                                causal, normalize)
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -252,9 +322,12 @@ def _flash_call(q4, k4, v4, q_offset, k_offset, *, causal: bool,
             pltpu.VMEM((tq, 128), jnp.float32),
         ],
     )
-    out_dtype = q4.dtype if normalize else jnp.float32
+    out_dtype = jnp.dtype(q_dtype) if normalize else jnp.float32
     interpret = _interpret_params() if use_interpret() else False
-    out, m, l = pl.pallas_call(
+    nbytes = (jnp.dtype(q_dtype).itemsize * b * hq * sq * d
+              + jnp.dtype(k_dtype).itemsize * b * hkv * sk * d
+              + jnp.dtype(v_dtype).itemsize * b * hkv * sk * d)
+    return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=(
@@ -268,12 +341,57 @@ def _flash_call(q4, k4, v4, q_offset, k_offset, *, causal: bool,
         ),
         cost_estimate=pl.CostEstimate(
             flops=4 * b * hq * sq * sk * d,
-            bytes_accessed=(q4.size + k4.size + v4.size) * q4.dtype.itemsize
+            bytes_accessed=nbytes
             + b * hq * sq * d * jnp.dtype(out_dtype).itemsize,
             transcendentals=b * hq * sq * sk,
         ),
         interpret=interpret,
-    )(offs, q4, k4, v4)
+    )
+
+
+def _flash_call(q4, k4, v4, q_offset, k_offset, *, causal: bool,
+                normalize: bool, tile_q: int, tile_k: int):
+    """Head-major flash attention. q4: (B, hq, Sq, d); k4/v4: (B, hkv, Sk, d).
+    Returns (out (B,hq,Sq,d), m (B,hq,Sq), l (B,hq,Sq)).
+
+    Tile selection: the best VMEM-modeled config from :func:`_fit_ladder`;
+    on real TPU a config modeled inside the risk band (> _PROBE_SAFE) is
+    probe-compiled first and the ladder degrades past configs Mosaic
+    rejects — a mis-modeled shape falls down to a smaller tile (or raises
+    :class:`FlashCompileError` for the dense fallback) instead of
+    hard-failing the whole jit (round-4 advisor finding).
+    """
+    b, hq, sq, d = q4.shape
+    hkv, sk = k4.shape[1], k4.shape[2]
+    # tq doubles as the stats blocks' LANE dim: must be 128-divisible (or
+    # the full Sq) — _fit_ladder/pick_tile(align=128) guarantee it.
+    ladder = _fit_ladder(sq, sk, d, q4.dtype, k4.dtype, tile_q, tile_k)
+    if not ladder:
+        raise FlashCompileError(
+            f"no tile configuration fits VMEM for Sq={sq} Sk={sk} d={d} — "
+            "guard calls with flash_supported()")
+    itemsize = max(q4.dtype.itemsize, k4.dtype.itemsize)
+    probing = not use_interpret()
+    chosen = None
+    for cand in ladder:
+        if (not probing
+                or (cand[0], cand[1], d, itemsize) in _KNOWN_GOOD
+                or _tile_estimate(cand[0], cand[1], d, itemsize) <= _PROBE_SAFE
+                or _probe_ok(hq, hkv, sq, sk, d, q4.dtype, k4.dtype, v4.dtype,
+                             causal, normalize, cand[0], cand[1])):
+            chosen = cand
+            break
+    if chosen is None:
+        raise FlashCompileError(
+            f"no tile configuration compiles for Sq={sq} Sk={sk} d={d} "
+            "(every probed candidate hit Mosaic VMEM limits)")
+    tq, tk = chosen
+
+    offs = jnp.stack([jnp.asarray(q_offset, jnp.int32).reshape(()),
+                      jnp.asarray(k_offset, jnp.int32).reshape(())])
+    call = _build_flash(b, hq, hkv, sq, sk, d, q4.dtype, k4.dtype, v4.dtype,
+                        causal=causal, normalize=normalize, tq=tq, tk=tk)
+    out, m, l = call(offs, q4, k4, v4)
     return out, m[:, :, 0, :], l[:, :, 0, :]
 
 
@@ -346,30 +464,36 @@ def shard_attention_partial(q, k, v, *, q_offset=0, k_offset=0,
     if tiles is not None:
         tile_q, tile_k = tiles
     if flash_supported(q, k):
-        return flash_attention_partial(q, k, v, q_offset=q_offset,
-                                       k_offset=k_offset, causal=causal,
-                                       tile_q=tile_q, tile_k=tile_k)
+        try:
+            return flash_attention_partial(q, k, v, q_offset=q_offset,
+                                           k_offset=k_offset, causal=causal,
+                                           tile_q=tile_q, tile_k=tile_k)
+        except FlashCompileError:
+            pass      # probed ladder exhausted — dense path below
     mask = _positional_mask(q.shape[1], k.shape[1], q_offset, k_offset,
                             causal)
     return _block_attn(q, k, v, mask)
 
 
 def resolve_flash_tiles(sq: int, sk: int, hq: int, hkv: int, d: int,
-                        dtype) -> tuple[int, int]:
+                        dtype, *, cache_only: bool = False,
+                        q_offset: int = 0) -> tuple[int, int]:
     """Tile caps for the SP wrappers: on-chip autotuned when tuning is on
     (runtime/autotuner.tuned_flash_tiles — the S=4k optimum measured
     512x1024 while S=32k measured 1024x1024), swept defaults otherwise.
 
-    Call at the HOST level — either inside a jit-cache make() (the SP
-    wrappers) or at TRACE time of a jitted layer fn (tp_attn prefill):
-    tracing is host-side Python, shapes are concrete, and the tuner's
-    measurements run eagerly on its own concrete arrays. Either way the
-    first call for a new (shape, dtype, chip) blocks on real measurements
-    (~30s/candidate through the compile relay) and every later call is a
-    disk-cache hit."""
+    Call at the HOST level — inside a jit-cache make() (the SP wrappers,
+    Engine._prefill_jit): the first call for a new (shape, dtype, chip)
+    blocks on real measurements (~30s/candidate through the compile relay)
+    and every later call is a disk-cache hit. At TRACE time of an outer
+    jit pass ``cache_only=True`` — tuned caps are used when already
+    cached, swept defaults otherwise, and measurements are NEVER launched
+    mid-trace (round-4 advisor: tuning during Engine tracing stalled the
+    default path for minutes)."""
     from triton_distributed_tpu.runtime.autotuner import tuned_flash_tiles
 
-    tiles = tuned_flash_tiles(sq, sk, hq, hkv, d, dtype)
+    tiles = tuned_flash_tiles(sq, sk, hq, hkv, d, dtype,
+                              cache_only=cache_only, q_offset=q_offset)
     return tiles if tiles else (DEFAULT_TILE_Q, DEFAULT_TILE_K)
 
 
@@ -384,8 +508,11 @@ def shard_attention(q, k, v, *, causal: bool = True,
     if tiles is not None:
         tile_q, tile_k = tiles
     if flash_supported(q, k):
-        return flash_attention(q, k, v, causal=causal, tile_q=tile_q,
-                               tile_k=tile_k)
+        try:
+            return flash_attention(q, k, v, causal=causal, tile_q=tile_q,
+                                   tile_k=tile_k)
+        except FlashCompileError:
+            pass      # probed ladder exhausted — dense path below
     mask = _positional_mask(q.shape[1], k.shape[1], 0, 0, causal)
     acc, _, l = _block_attn(q, k, v, mask)
     return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)

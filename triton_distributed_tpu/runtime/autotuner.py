@@ -206,6 +206,7 @@ def contextual_autotune(
     iters: int = 3,
     use_disk_cache: bool = True,
     method: str = "auto",
+    cache_only: bool = False,
 ) -> tuple[Any, TuneReport | None]:
     """Pick the fastest candidate config for thunk-in-context ``build(cfg)``.
 
@@ -217,6 +218,12 @@ def contextual_autotune(
     axon relay where block_until_ready doesn't fence), "block"
     (block_until_ready wall time), or "auto" (chain on real TPU, block
     elsewhere).
+
+    ``cache_only``: never measure — return (None, None) on a cache miss.
+    For callers running at TRACE time of an outer jit, where launching
+    eager on-chip measurements would stall the trace for minutes (round-4
+    advisor finding on tp_attn's prefill path); ``build``/``args`` may be
+    None/() in this mode.
     """
     if method == "auto":
         method = "chain" if jax.default_backend() == "tpu" else "block"
@@ -237,6 +244,9 @@ def contextual_autotune(
         elif isinstance(entry, int) and 0 <= entry < len(candidates):
             # legacy bare-index entry: ignore (candidate order may differ)
             pass
+
+    if cache_only:
+        return None, None
 
     if method == "chain":
         fns: list = []
@@ -349,10 +359,17 @@ def tuned_matmul_tiles(m: int, k: int, ncols: int, dtype) -> tuple | None:
     # (~30s each through the remote-compile relay), so the measured set is
     # kept small — the model ranking retains the winner (test_perf_model).
     cands = rank_gemm_tiles(base, m, ncols, k, itemsize, top=4)
-    # Keep the static default in the race so tuning can only help.
-    default = (512, 1024, 512)
-    if default not in cands:
-        cands = [default] + list(cands)
+    # Keep the static default AND the documented cross-window best in the
+    # race so tuning can only help: if the model's top-4 excluded the
+    # pinned (1024, 1024, 512) from docs/gemm_core.md, the tuner would
+    # otherwise never measure it and its winner would silently override
+    # bench's pinned fallback (round-4 advisor finding).
+    for pinned in ((1024, 1024, 512), (512, 1024, 512)):
+        tm, tn, tk = pinned
+        fits = (tm <= m and tn <= ncols and tk <= k
+                and not (m % tm or ncols % tn or k % tk))
+        if fits and pinned not in cands:
+            cands = [pinned] + list(cands)
     rng = np.random.default_rng(0)
     a = jnp.asarray(rng.standard_normal((m, k)) * 0.05, dtype)
     bb = jnp.asarray(rng.standard_normal((k, ncols)) * 0.05, dtype)
@@ -374,7 +391,8 @@ def tuned_matmul_tiles(m: int, k: int, ncols: int, dtype) -> tuple | None:
 
 
 def tuned_flash_tiles(sq: int, sk: int, hq: int, hkv: int, d: int,
-                      dtype) -> tuple | None:
+                      dtype, *, cache_only: bool = False,
+                      q_offset: int = 0) -> tuple | None:
     """(tile_q, tile_k) for ops/flash_attention at this shape, measured
     on-chip over the VMEM-fitting candidate caps, disk-cached by
     (shape, dtype, chip). None when tuning is off — callers fall back to
@@ -382,6 +400,14 @@ def tuned_flash_tiles(sq: int, sk: int, hq: int, hkv: int, d: int,
 
     The round-3 sweep at S=32k picked 1024x1024 (33% over 512x1024); this
     entry exists for shapes where that static choice may not hold.
+
+    ``cache_only``: consult the caches but never measure (None on a miss)
+    — the contract for trace-time callers (layers/tp_attn.py).
+
+    ``q_offset``: the positional offset to measure at. Matters when
+    sq << sk (chunked prefill): at q_offset=0 the causal skip hides almost
+    every KV tile and the timing ranks DMA, not compute — callers pass the
+    compute-dominant late-chunk offset (sk - sq) instead.
     """
     if not autotune_enabled():
         return None
@@ -404,7 +430,12 @@ def tuned_flash_tiles(sq: int, sk: int, hq: int, hkv: int, d: int,
 
     chip = jax.devices()[0].device_kind
     space_tag = zlib.crc32(repr(caps).encode())
-    key = (sq, sk, hq, hkv, d, str(jnp.dtype(dtype)), chip, space_tag)
+    key = (sq, sk, hq, hkv, d, str(jnp.dtype(dtype)), chip, space_tag,
+           q_offset)
+    if cache_only:
+        best, _ = contextual_autotune("flash_attention", key, caps, None,
+                                      (), cache_only=True)
+        return best
     rng = np.random.default_rng(0)
     q = jnp.asarray(rng.standard_normal((1, sq, hq, d)) * 0.3, dtype)
     k = jnp.asarray(rng.standard_normal((1, sk, hkv, d)) * 0.3, dtype)
@@ -415,6 +446,7 @@ def tuned_flash_tiles(sq: int, sk: int, hq: int, hkv: int, d: int,
         # measure_chain applies its standard zero-scalar coupling; the
         # kernel runs on the same q every iteration (fine for timing).
         return lambda qq, kk, vv: flash_attention(qq, kk, vv, causal=True,
+                                                  q_offset=q_offset,
                                                   tile_q=tq, tile_k=tk)
 
     try:
