@@ -102,6 +102,28 @@ def main() -> int:
     check("all_reduce", lambda: all_reduce(x1, ctx))
     check("reduce_scatter", lambda: reduce_scatter(x1, ctx))
 
+    # 2-D torus collectives, single-axis-degenerate (1,1) mesh: validates
+    # the multi-axis dispatch + fallback contract compiles on-chip (the
+    # 2-axis kernel itself needs >1 device per axis; its golden runs on
+    # the virtual (2,4) mesh — tests/test_multi_axis.py).
+    def torus_degenerate():
+        from triton_distributed_tpu.ops import (
+            all_gather_torus, all_reduce_torus, reduce_scatter_torus,
+        )
+        from triton_distributed_tpu.runtime.context import (
+            initialize_distributed, set_context,
+        )
+
+        ctxt = initialize_distributed(mesh_shape=(1, 1),
+                                      axis_names=("x", "y"))
+        g = all_gather_torus(a, ctxt)
+        r = all_reduce_torus(x1[:, None], ctxt)
+        s = reduce_scatter_torus(x1[:, None], ctxt)
+        set_context(ctx)
+        return g, r, s
+
+    check("torus collectives (degenerate 1x1)", torus_degenerate)
+
     q = jnp.asarray(rng.standard_normal((2, 16, 128)) * 0.1, jnp.float32)
     k = jnp.asarray(rng.standard_normal((2, 64, 8, 128)) * 0.1, jnp.float32)
     v = jnp.asarray(rng.standard_normal((2, 64, 8, 128)) * 0.1, jnp.float32)

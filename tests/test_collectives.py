@@ -51,7 +51,8 @@ def test_reduce_scatter(ctx):
 
 
 @pytest.mark.parametrize("method", [AllReduceMethod.ONE_SHOT,
-                                    AllReduceMethod.TWO_SHOT])
+                                    AllReduceMethod.TWO_SHOT,
+                                    AllReduceMethod.TREE])
 def test_all_reduce(ctx, method):
     n = ctx.num_ranks
     for it in range(2):
@@ -59,6 +60,27 @@ def test_all_reduce(ctx, method):
         got = all_reduce(x, ctx, method=method)
         expected = np.asarray(x).sum(axis=0)
         np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-5, atol=1e-5)
+
+
+def test_all_reduce_tree_single_tree_fallback(ctx):
+    """Rows that cannot split into two aligned halves run the one-tree
+    variant (m=8 fp32: 8 % (2·8) != 0)."""
+    n = ctx.num_ranks
+    x = _rand((n, 8, 128), seed=25)
+    got = all_reduce(x, ctx, method=AllReduceMethod.TREE)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(x).sum(axis=0),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_all_reduce_tree_bf16(ctx):
+    """Double tree on bf16: partials round per level (like ring RS), so
+    compare with a loose tolerance."""
+    n = ctx.num_ranks
+    x = _rand((n, 32, 128), jnp.bfloat16, seed=26)
+    got = all_reduce(x, ctx, method=AllReduceMethod.TREE)
+    expected = np.asarray(x, dtype=np.float32).sum(axis=0)
+    np.testing.assert_allclose(
+        np.asarray(got, dtype=np.float32), expected, rtol=5e-2, atol=5e-2)
 
 
 def test_all_reduce_bf16_one_shot(ctx):

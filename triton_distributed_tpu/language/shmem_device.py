@@ -158,6 +158,31 @@ def sync_all(axis: str = "tp"):
     barrier_all(axis)
 
 
+def barrier_grid(axes):
+    """Full barrier across the PRODUCT group of ``axes`` — the entry
+    barrier for multi-axis (2-D/3-D torus) kernels (ops/multi_axis.py),
+    where a single-axis :func:`barrier_all` only orders one ring.
+
+    Every device signals every device in the grid (itself included — the
+    self-signal avoids a traced-coordinate comparison and arrives like any
+    other) and waits for the full count. Requires ``uses_barrier=True`` on
+    the enclosing kernel. Reference: the team-scoped ``barrier_all`` over
+    an NVSHMEM team spanning the 2-D rank grid (allgather.py:293-378 uses
+    it around its 2-D inter-node combo)."""
+    sizes = [jax.lax.axis_size(a) for a in axes]
+    sem = pltpu.get_barrier_semaphore()
+    import itertools
+
+    total = 1
+    for s in sizes:
+        total *= s
+    for coord in itertools.product(*[range(s) for s in sizes]):
+        pltpu.semaphore_signal(
+            sem, inc=1, device_id=dict(zip(axes, coord)),
+            device_id_type=pltpu.DeviceIdType.MESH)
+    pltpu.semaphore_wait(sem, total)
+
+
 def fence():
     """Ordering fence between puts to the same peer. TPU DMAs on one device
     complete in issue order per destination; explicit fences are expressed by

@@ -252,12 +252,16 @@ def tp_attn_prefill_chunk(params: dict, cfg: ModelConfig, x: jax.Array,
     )
     # Tile caps: host-resolved ``flash_tiles`` when given, else a
     # cache-only tuner lookup (never measure mid-trace — see
-    # tp_attn_prefill). Mid-length chunks have a different optimum than
-    # the S=32k sweep's.
+    # tp_attn_prefill). The lookup keys the LATE-chunk offset (sk - sq):
+    # that is the offset Engine._flash_tiles measures and caches under
+    # (offset-0 chunked timings rank DMA, not compute), so an offset-0
+    # lookup here could never hit.
     if flash_tiles is None:
+        cap = kv_slice.k.shape[1]
         flash_tiles = resolve_flash_tiles(
-            chunk_len, kv_slice.k.shape[1], q.shape[2], k.shape[2],
-            q.shape[3], q.dtype, cache_only=True)
+            chunk_len, cap, q.shape[2], k.shape[2],
+            q.shape[3], q.dtype, cache_only=True,
+            q_offset=max(cap - chunk_len, 0))
     tq_cap, tk_cap = flash_tiles
     acc, m, l = shard_attention_partial(
         q, new_kv.k.astype(q.dtype), new_kv.v.astype(q.dtype),

@@ -95,3 +95,11 @@ from triton_distributed_tpu.ops.two_level import (  # noqa: F401
     all_reduce_2d,
     reduce_scatter_2d,
 )
+from triton_distributed_tpu.ops.multi_axis import (  # noqa: F401
+    all_gather_torus,
+    all_gather_torus_local,
+    all_reduce_torus,
+    all_reduce_torus_local,
+    reduce_scatter_torus,
+    reduce_scatter_torus_local,
+)

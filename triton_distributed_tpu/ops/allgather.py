@@ -143,6 +143,20 @@ def all_gather_local(x_local: jax.Array, axis: str = "tp", num_ranks: int | None
     """Device-local AllGather for use *inside* an existing shard_map region
     (the composition point for layers). ``x_local``: (m, cols) per device →
     (num_ranks*m, cols) per device."""
+    if isinstance(axis, (tuple, list)):
+        # Multi-axis form: drive both torus axes in one kernel
+        # (ops/multi_axis.py; round-4 VERDICT #4). num_ranks: (n0, n1).
+        if num_ranks is None:
+            raise ValueError("num_ranks (n0, n1) required inside shard_map")
+        mk = method.value if isinstance(method, AllGatherMethod) else str(method)
+        if mk == "xla":
+            return jax.lax.all_gather(x_local, tuple(axis), tiled=True)
+        from triton_distributed_tpu.ops.multi_axis import (
+            all_gather_torus_local,
+        )
+
+        return all_gather_torus_local(x_local, axes=tuple(axis),
+                                      dims=tuple(num_ranks))
     method = AllGatherMethod(method) if not isinstance(method, AllGatherMethod) else method
     if num_ranks is None:
         raise ValueError("num_ranks required inside shard_map")

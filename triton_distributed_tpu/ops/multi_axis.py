@@ -1,0 +1,374 @@
+"""Multi-axis ICI collectives — one kernel driving TWO torus axes.
+
+Reference: the 2-D NUMA-aware rings of
+``python/triton_dist/kernels/nvidia/allgather.py:140-262`` (intra-node 2-D
+ring push) and ``:293-378`` (2-D inter-node combo): the reference splits its
+rank grid into (NUMA group × intra-group) and keeps both link classes busy.
+A TPU v5p slice is the same shape problem with better fabric: the ICI is a
+physical 2-D/3-D torus (``runtime/topology.py``), and a collective that
+drives only one named mesh axis leaves the other axes' links idle — round-4
+VERDICT's top structural gap (#4).
+
+Method space (single kernel each, both axes live concurrently):
+
+- ``all_gather_torus``: pipelined ring-of-rings AG. The inner-axis ring
+  gathers this device's row of shards; *as each shard lands it is
+  immediately forwarded onto the outer-axis ring* — inner and outer links
+  run concurrently, so wall time ≈ max(inner phase, outer phase) instead of
+  their sum. Rank order is row-major over (outer, inner), matching
+  ``P((ax0, ax1))`` sharding.
+- ``all_reduce_torus(method="one_shot")``: hierarchical one-shot — one-shot
+  AR along the inner axis, then one-shot of the reduced block along the
+  outer axis, in one kernel. Two hops of m bytes per link class vs the flat
+  one-shot's (n-1) pushes that must physically route *through* intermediate
+  torus chips (oversubscribing links the flat method pretends are
+  point-to-point): the latency class for decode activations on a 2-D mesh.
+- ``all_reduce_torus(method="two_shot")``: reduce_scatter_torus +
+  all_gather_torus — the bandwidth class.
+- ``reduce_scatter_torus``: outer-axis ring RS on super-chunks, then
+  inner-axis ring RS — each phase keeps every link of its axis busy; phases
+  are sequential because reduction carries a true dependency.
+
+Degenerate meshes (either axis of size 1) fall back to the 1-D kernels, and
+``n0 == n1 == 1`` is the identity — the single-axis-degenerate contract the
+on-chip compile gate checks.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu import language as dl
+from triton_distributed_tpu.language import shmem_device as shmem
+from triton_distributed_tpu.language.core import kernel_call, any_spec
+from triton_distributed_tpu.ops.allreduce import _reduce_slots
+from triton_distributed_tpu.ops.tiling import pick_tile, sublane_align
+from triton_distributed_tpu.runtime.context import DistContext, get_context
+from triton_distributed_tpu.runtime.jit_cache import cached_shard_jit
+
+
+# ---------------------------------------------------------------------------
+# AllGather: pipelined ring-of-rings.
+# ---------------------------------------------------------------------------
+
+def _ag_torus_kernel(n0: int, n1: int, ax0: str, ax1: str, m: int,
+                     x_ref, out_ref,
+                     y_send_sems, x_send_sems, y_recv_sem, x_recv_sems,
+                     copy_sem):
+    """Shard S(a,b) lands at out rows [(a·n1+b)·m, …). Schedule:
+
+    - inner (ax1) ring, step t: forward own-row shard S(a, b-t) right —
+      the 1-D ring of ops/allgather.py on the inner links.
+    - outer (ax0) ring, round (u, t): forward S(a-u, b-t) right along ax0.
+      Round (0, t) fires the moment S(a, b-t) exists locally (own shard at
+      t=0, else the y-delivery just waited) — this is the pipelining: the
+      outer ring starts n1-1 steps before the inner ring finishes.
+
+    Ordering invariants: deliveries between one (src, dst) pair arrive in
+    issue order (the same assumption the 1-D ring forwards on), and each
+    outer-ring chunk class t has its own recv semaphore so classes never
+    miscount each other. Send-semaphore slots are reused across u-rounds
+    only after ``wait_send`` of the previous round.
+    """
+    a = dl.rank(ax0)
+    b = dl.rank(ax1)
+    shmem.barrier_grid((ax0, ax1))
+    right0 = jax.lax.rem(a + 1, n0)
+    right1 = jax.lax.rem(b + 1, n1)
+
+    def slot(row, col):
+        return out_ref.at[pl.ds((row * n1 + col) * m, m)]
+
+    own = slot(a, b)
+    local = pltpu.make_async_copy(x_ref, own, copy_sem)
+    local.start()
+    local.wait()
+
+    x_handles: list = [None] * n1
+    y_handles: list = [None] * max(n1 - 1, 1)
+    # Inner ring step t interleaved with outer round (0, t).
+    for t in range(n1):
+        c = jax.lax.rem(b - t + n1, n1)
+        s_c = slot(a, c)
+        if t > 0:
+            shmem.wait_deliveries(x_ref, y_recv_sem, 1)
+        if t < n1 - 1:
+            y_handles[t] = shmem.putmem_nbi_block(
+                s_c, s_c, y_send_sems.at[t], y_recv_sem, right1, ax1)
+        if n0 > 1:
+            x_handles[t] = shmem.putmem_nbi_block(
+                s_c, s_c, x_send_sems.at[t], x_recv_sems.at[t], right0, ax0)
+    # Outer rounds u >= 1: relay what the left x-neighbor delivered.
+    for u in range(1, n0 - 1):
+        for t in range(n1):
+            c = jax.lax.rem(b - t + n1, n1)
+            row = jax.lax.rem(a - u + n0, n0)
+            s_rc = slot(row, c)
+            shmem.wait_deliveries(x_ref, x_recv_sems.at[t], 1)
+            x_handles[t].wait_send()
+            x_handles[t] = shmem.putmem_nbi_block(
+                s_rc, s_rc, x_send_sems.at[t], x_recv_sems.at[t], right0,
+                ax0)
+    # Final arrivals: one un-consumed delivery per chunk class (round
+    # u = n0-1's incoming relay), then drain sends.
+    if n0 > 1:
+        for t in range(n1):
+            shmem.wait_deliveries(x_ref, x_recv_sems.at[t], 1)
+        for h in x_handles:
+            if h is not None:
+                h.wait_send()
+    for h in y_handles:
+        if h is not None:
+            h.wait_send()
+
+
+def all_gather_torus_local(x_local: jax.Array, *, axes: tuple[str, str],
+                           dims: tuple[int, int]) -> jax.Array:
+    """Device-local 2-axis AllGather inside shard_map. ``x_local``:
+    (m, cols) → (n0·n1·m, cols), rank-major over (axes[0], axes[1])."""
+    ax0, ax1 = axes
+    n0, n1 = dims
+    if n0 * n1 == 1:
+        return x_local
+    if n0 == 1 or n1 == 1:
+        from triton_distributed_tpu.ops.allgather import (
+            AllGatherMethod, all_gather_local,
+        )
+
+        axis, n = (ax1, n1) if n0 == 1 else (ax0, n0)
+        return all_gather_local(x_local, axis=axis, num_ranks=n,
+                                method=AllGatherMethod.RING_1D)
+    m, cols = x_local.shape
+    kernel = functools.partial(_ag_torus_kernel, n0, n1, ax0, ax1, m)
+    return kernel_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n0 * n1 * m, cols), x_local.dtype),
+        in_specs=[any_spec()],
+        out_specs=any_spec(),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((max(n1 - 1, 1),)),   # inner sends
+            pltpu.SemaphoreType.DMA((n1,)),               # outer sends
+            pltpu.SemaphoreType.DMA(()),                  # inner recv
+            pltpu.SemaphoreType.DMA((n1,)),               # outer recv/class
+            pltpu.SemaphoreType.DMA(()),                  # local copy
+        ],
+        uses_barrier=True,
+    )(x_local)
+
+
+# ---------------------------------------------------------------------------
+# AllReduce: hierarchical one-shot (latency) / RS+AG composition (bandwidth).
+# ---------------------------------------------------------------------------
+
+def _ar_one_shot_torus_kernel(n0: int, n1: int, ax0: str, ax1: str,
+                              m: int, tile_m: int,
+                              x_ref, out_ref, ws1, ws0, mid, va, vacc,
+                              y_send_sems, x_send_sems, y_recv_sem,
+                              x_recv_sem, copy_sem):
+    """Phase 1: one-shot AR along ax1 (push into slot b of every inner
+    peer's ws1, reduce → mid). Phase 2: the same along ax0 on the reduced
+    block (ws0, slot a → out). Each phase is the 1-D one-shot of
+    ops/allreduce.py:61; the hierarchy keeps every push a single physical
+    hop on its torus ring."""
+    a = dl.rank(ax0)
+    b = dl.rank(ax1)
+    shmem.barrier_grid((ax0, ax1))
+
+    # Phase 1 (inner axis).
+    local = pltpu.make_async_copy(x_ref, ws1.at[b], copy_sem)
+    local.start()
+    handles = []
+    for i in range(n1 - 1):
+        peer = jax.lax.rem(b + 1 + i, n1)
+        handles.append(shmem.putmem_nbi_block(
+            x_ref, ws1.at[b], y_send_sems.at[i], y_recv_sem, peer, ax1))
+    local.wait()
+    shmem.quiet(*handles)
+    shmem.wait_deliveries(x_ref, y_recv_sem, n1 - 1)
+    _reduce_slots(n1, m, tile_m, ws1, mid, va, vacc, copy_sem)
+
+    # Phase 2 (outer axis) on the inner-reduced block.
+    local = pltpu.make_async_copy(mid, ws0.at[a], copy_sem)
+    local.start()
+    handles = []
+    for i in range(n0 - 1):
+        peer = jax.lax.rem(a + 1 + i, n0)
+        handles.append(shmem.putmem_nbi_block(
+            mid, ws0.at[a], x_send_sems.at[i], x_recv_sem, peer, ax0))
+    local.wait()
+    shmem.quiet(*handles)
+    shmem.wait_deliveries(x_ref, x_recv_sem, n0 - 1)
+    _reduce_slots(n0, m, tile_m, ws0, out_ref, va, vacc, copy_sem)
+
+
+def all_reduce_torus_local(x_local: jax.Array, *, axes: tuple[str, str],
+                           dims: tuple[int, int],
+                           method: str = "one_shot") -> jax.Array:
+    """Device-local 2-axis AllReduce inside shard_map. ``x_local``:
+    (m, cols) → (m, cols) summed over the n0·n1 grid."""
+    ax0, ax1 = axes
+    n0, n1 = dims
+    if n0 * n1 == 1:
+        return x_local
+    if n0 == 1 or n1 == 1:
+        from triton_distributed_tpu.ops.allreduce import all_reduce_local
+
+        axis, n = (ax1, n1) if n0 == 1 else (ax0, n0)
+        return all_reduce_local(x_local, axis=axis, num_ranks=n,
+                                method=method)
+    if method == "two_shot":
+        total = n0 * n1
+        m = x_local.shape[0]
+        if m % total:
+            raise ValueError(
+                f"two_shot requires rows {m} divisible by n0*n1 {total}")
+        scattered = reduce_scatter_torus_local(x_local, axes=axes,
+                                               dims=dims)
+        return all_gather_torus_local(scattered, axes=axes, dims=dims)
+    if method != "one_shot":
+        raise ValueError(f"unknown torus AR method {method!r}")
+    m, cols = x_local.shape
+    tile_m = pick_tile(m, 512, sublane_align(x_local.dtype))
+    kernel = functools.partial(_ar_one_shot_torus_kernel, n0, n1, ax0, ax1,
+                               m, tile_m)
+    return kernel_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((m, cols), x_local.dtype),
+        in_specs=[any_spec()],
+        out_specs=any_spec(),
+        workspaces=[
+            jax.ShapeDtypeStruct((n1, m, cols), x_local.dtype),
+            jax.ShapeDtypeStruct((n0, m, cols), x_local.dtype),
+            jax.ShapeDtypeStruct((m, cols), x_local.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tile_m, cols), x_local.dtype),
+            pltpu.VMEM((tile_m, cols), jnp.float32),
+            pltpu.SemaphoreType.DMA((max(n1 - 1, 1),)),
+            pltpu.SemaphoreType.DMA((max(n0 - 1, 1),)),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        uses_barrier=True,
+    )(x_local)
+
+
+# ---------------------------------------------------------------------------
+# ReduceScatter: outer-ring RS on super-chunks, inner-ring RS on chunks.
+# ---------------------------------------------------------------------------
+
+def reduce_scatter_torus_local(x_local: jax.Array, *,
+                               axes: tuple[str, str],
+                               dims: tuple[int, int]) -> jax.Array:
+    """Device-local 2-axis ReduceScatter inside shard_map. ``x_local``:
+    (n0·n1·mo, cols) contributions → (mo, cols); device (a, b) owns chunk
+    a·n1+b, summed over the whole grid.
+
+    Phase 1: ring RS along ``axes[0]`` treating the rows as n0 super-chunks
+    of n1·mo — afterwards this device holds super-chunk ``a`` summed over
+    its torus column. Phase 2: ring RS of that block along ``axes[1]``.
+    Phases reuse the flow-controlled 1-D ring kernel
+    (ops/reduce_scatter._rs_ring_kernel); sequencing is a true data
+    dependency (a chunk cannot leave on the inner ring before its outer
+    reduction finished), so unlike the AG there is no cross-phase pipeline.
+    """
+    from triton_distributed_tpu.ops.reduce_scatter import (
+        reduce_scatter_local,
+    )
+
+    ax0, ax1 = axes
+    n0, n1 = dims
+    if n0 * n1 == 1:
+        return x_local
+    if n0 == 1:
+        return reduce_scatter_local(x_local, axis=ax1, num_ranks=n1)
+    if n1 == 1:
+        return reduce_scatter_local(x_local, axis=ax0, num_ranks=n0)
+    mt = x_local.shape[0]
+    if mt % (n0 * n1):
+        raise ValueError(f"rows {mt} not divisible by n0*n1 {n0 * n1}")
+    mid = reduce_scatter_local(x_local, axis=ax0, num_ranks=n0)
+    return reduce_scatter_local(mid, axis=ax1, num_ranks=n1)
+
+
+# ---------------------------------------------------------------------------
+# Host-level wrappers (golden-testable; the layer composition point is the
+# *_local family above).
+# ---------------------------------------------------------------------------
+
+def _resolve_axes(ctx: DistContext, axes) -> tuple[tuple[str, str],
+                                                   tuple[int, int]]:
+    if axes is None:
+        names = tuple(ctx.mesh.axis_names)
+        if len(names) != 2:
+            raise ValueError(
+                f"torus collectives need two mesh axes; mesh has {names} — "
+                "pass axes=(outer, inner) explicitly on bigger meshes")
+        axes = names
+    ax0, ax1 = axes
+    return (ax0, ax1), (ctx.axis_size(ax0), ctx.axis_size(ax1))
+
+
+def all_gather_torus(x: jax.Array, ctx: DistContext | None = None,
+                     axes: tuple[str, str] | None = None) -> jax.Array:
+    """Host-level 2-axis AllGather: ``x`` (n0·n1·m, cols) sharded row-major
+    over ``axes`` → replicated."""
+    ctx = ctx or get_context()
+    (ax0, ax1), dims = _resolve_axes(ctx, axes)
+    key = ("ag_torus", ax0, ax1, x.shape, str(x.dtype))
+
+    def make():
+        return functools.partial(all_gather_torus_local, axes=(ax0, ax1),
+                                 dims=dims)
+
+    jfn = cached_shard_jit(ctx, "all_gather_torus", key, make,
+                           P((ax0, ax1)), P(None),
+                           ici_axes=(ax0, ax1))
+    return jfn(x)
+
+
+def all_reduce_torus(x: jax.Array, ctx: DistContext | None = None,
+                     axes: tuple[str, str] | None = None,
+                     method: str = "one_shot") -> jax.Array:
+    """Host-level 2-axis AllReduce: ``x`` (n0, n1, m, cols) stacked
+    contributions → replicated (m, cols) sum."""
+    ctx = ctx or get_context()
+    (ax0, ax1), dims = _resolve_axes(ctx, axes)
+    key = ("ar_torus", ax0, ax1, method, x.shape, str(x.dtype))
+
+    def make():
+        fn = functools.partial(all_reduce_torus_local, axes=(ax0, ax1),
+                               dims=dims, method=method)
+        return lambda xl: fn(xl[0, 0])
+
+    jfn = cached_shard_jit(ctx, "all_reduce_torus", key, make,
+                           P(ax0, ax1), P(None, None),
+                           ici_axes=(ax0, ax1))
+    return jfn(x)
+
+
+def reduce_scatter_torus(x: jax.Array, ctx: DistContext | None = None,
+                         axes: tuple[str, str] | None = None) -> jax.Array:
+    """Host-level 2-axis ReduceScatter: ``x`` (n0, n1, N·mo, cols) stacked
+    contributions (N = n0·n1) → (N·mo, cols) scattered row-major over
+    ``axes``."""
+    ctx = ctx or get_context()
+    (ax0, ax1), dims = _resolve_axes(ctx, axes)
+    key = ("rs_torus", ax0, ax1, x.shape, str(x.dtype))
+
+    def make():
+        fn = functools.partial(reduce_scatter_torus_local, axes=(ax0, ax1),
+                               dims=dims)
+        return lambda xl: fn(xl[0, 0])
+
+    jfn = cached_shard_jit(ctx, "reduce_scatter_torus", key, make,
+                           P(ax0, ax1), P((ax0, ax1)),
+                           ici_axes=(ax0, ax1))
+    return jfn(x)

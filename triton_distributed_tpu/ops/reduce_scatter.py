@@ -108,6 +108,16 @@ def reduce_scatter_local(x_local: jax.Array, axis: str = "tp",
     """Device-local ring reduce-scatter inside an existing shard_map region.
     ``x_local``: (n*m, cols) per device → (m, cols) per device (chunk ``me``
     summed over all devices)."""
+    if isinstance(axis, (tuple, list)):
+        # Multi-axis form (ops/multi_axis.py; round-4 VERDICT #4).
+        if num_ranks is None:
+            raise ValueError("num_ranks (n0, n1) required inside shard_map")
+        from triton_distributed_tpu.ops.multi_axis import (
+            reduce_scatter_torus_local,
+        )
+
+        return reduce_scatter_torus_local(x_local, axes=tuple(axis),
+                                          dims=tuple(num_ranks))
     if num_ranks is None:
         raise ValueError("num_ranks required inside shard_map")
     n = num_ranks

@@ -523,10 +523,16 @@ def tuned_allreduce_method(x: Any, ctx, axis: str = "tp",
 
     n = ctx.axis_size(axis)
     chip = jax.devices()[0].device_kind
-    cands = ["one_shot", "two_shot", "xla"]
+    cands = ["one_shot", "two_shot", "tree", "xla"]
     if x.shape[1] % n:
         cands.remove("two_shot")     # needs rows divisible by n
-    key = (tuple(x.shape), str(x.dtype), n, chip)
+    # Candidate-space fingerprint in the key: a cached winner written
+    # before a method was added (r5: "tree") must not suppress measuring
+    # the new candidate (same contract as tuned_matmul_tiles).
+    import zlib
+
+    key = (tuple(x.shape), str(x.dtype), n, chip,
+           zlib.crc32(repr(cands).encode()))
 
     def build(m):
         return lambda xv: all_reduce(xv, ctx, axis=axis, method=m)

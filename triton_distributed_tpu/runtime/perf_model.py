@@ -169,9 +169,13 @@ def reduce_scatter_ring_time_s(nbytes: int, n: int,
 
 
 def allreduce_time_s(nbytes: int, n: int, method: str = "two_shot",
-                     spec: ChipSpec | None = None) -> float:
+                     spec: ChipSpec | None = None,
+                     tree_halves: int = 2) -> float:
     """AR cost: one_shot = every rank pulls all n-1 remote copies;
-    two_shot = ring RS + ring AG (bandwidth-optimal)."""
+    two_shot = ring RS + ring AG (bandwidth-optimal); tree = double binary
+    tree (``tree_halves=1`` models the single full-payload tree the kernel
+    falls back to when the rows cannot split into aligned halves — without
+    it AUTO would underestimate tree cost 2× on exactly those shapes)."""
     spec = spec or chip_spec()
     if n <= 1:
         return 0.0
@@ -185,6 +189,17 @@ def allreduce_time_s(nbytes: int, n: int, method: str = "two_shot",
     if method == "two_shot":
         return (reduce_scatter_ring_time_s(nbytes, n, spec)
                 + allgather_ring_time_s(nbytes, n, spec))
+    if method == "tree":
+        # Double binary tree (ops/allreduce._ar_tree_kernel): two
+        # complementary trees each reduce-then-broadcast HALF the payload;
+        # serial depth 2·ceil(log2 n) hops of nbytes/2. The latency class
+        # between one_shot (1 hop, (n-1)× traffic) and two_shot (2(n-1)
+        # hops, 1/n chunks) — reference allreduce.py:1101 selects it for
+        # exactly this middle band.
+        depth = max(1, math.ceil(math.log2(n)))
+        half = nbytes / max(tree_halves, 1)
+        return 2 * depth * (half / _ici_step_bw(spec)
+                            + spec.ici_hop_latency_s)
     raise ValueError(f"unknown allreduce method {method!r}")
 
 
