@@ -16,7 +16,35 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from triton_distributed_tpu.ops.p2p import p2p_shift_local
+from triton_distributed_tpu.ops.p2p import p2p_permute_local, p2p_shift_local
+
+
+class CommOp:
+    """Arbitrary-pair stage transport — the reference's PP ``CommOp``
+    (layers/nvidia/p2p.py:30-132: send/recv between any two ranks with
+    per-pair signals) as a device-local layer.
+
+    ``exchange(x, perm)`` runs one static set of (src, dst) sends with
+    per-pair semaphores (ops/p2p.p2p_permute_local); uniform ring perms
+    dispatch the single-semaphore shift fast path. Non-ring PP schedules
+    (uneven stage maps, skip connections, bidirectional pipelines) compose
+    their tick's sends as a perm."""
+
+    def __init__(self, axis: str = "pp", num_ranks: int | None = None):
+        if num_ranks is None:
+            raise ValueError("num_ranks required inside shard_map")
+        self.axis = axis
+        self.n = num_ranks
+
+    def exchange(self, x: jax.Array, perm) -> jax.Array:
+        if self.n == 1:
+            return x
+        return p2p_permute_local(x, perm, axis=self.axis, num_ranks=self.n)
+
+    def send(self, x: jax.Array, src: int, dst: int) -> jax.Array:
+        """Single-pair send: ``dst`` receives src's block, everyone else
+        zeros (SPMD — call on every rank)."""
+        return self.exchange(x, [(src, dst)])
 
 
 class PPStream:
@@ -88,4 +116,65 @@ def pp_pipeline_forward(stage_fn, x_microbatches: jax.Array, *,
         # cross-stage barrier) entirely.
         if t < num_mb + n - 2:
             carry = stream.send_next(y)
+    return out
+
+
+def pp_pipeline_interleaved(stage_fn, x_microbatches: jax.Array, *,
+                            chunks: int, axis: str = "pp",
+                            num_ranks: int | None = None):
+    """Interleaved-chunk pipeline forward (device-local): each device hosts
+    ``chunks`` model chunks round-robin — virtual stage σ = c·n + d lives
+    on device d — the interleaved-1F1B stage map (reference
+    test_pp.py's CommOp schedules; Megatron-style virtual stages) applied
+    to the forward pass.
+
+    stage_fn(c, mb) — this device's chunk ``c`` applied to one microbatch
+    (static c: each chunk has its own weights).
+    x_microbatches: (num_mb, mb, cols) — virtual stage 0's inputs.
+
+    Per tick every device runs its active chunks (several at once in
+    steady state — the interleave) and ships each chunk's output one
+    device right; device n-1's output wraps to device 0 where it enters
+    the NEXT chunk — that cross-chunk wraparound is the bookkeeping
+    difference from the plain GPipe schedule above. Returns the last
+    virtual stage's outputs (num_mb, mb, cols); other devices' rows are
+    garbage, mask at the caller.
+    """
+    if num_ranks is None:
+        raise ValueError("num_ranks required inside shard_map")
+    n = num_ranks
+    stream = PPStream(axis=axis, num_ranks=n)
+    me = jax.lax.axis_index(axis)
+    num_mb, mb, cols = x_microbatches.shape
+    total = chunks * n
+    out = jnp.zeros_like(x_microbatches)
+    # carry[c]: the activation this device will feed chunk c next tick.
+    carry = [jnp.zeros((mb, cols), x_microbatches.dtype)
+             for _ in range(chunks)]
+
+    for t in range(num_mb + total - 1):
+        ys = []
+        for c in range(chunks):
+            sigma = c * n + me          # this chunk's virtual stage index
+            mb_idx = t - sigma
+            active = (mb_idx >= 0) & (mb_idx < num_mb)
+            safe_idx = jnp.clip(mb_idx, 0, num_mb - 1)
+            x_in = carry[c]
+            if c == 0:
+                # Virtual stage 0 (device 0, chunk 0) reads the inputs.
+                x_in = jnp.where(me == 0, x_microbatches[safe_idx], x_in)
+            y = stage_fn(c, x_in)
+            y = jnp.where(active, y, jnp.zeros_like(y))
+            if c == chunks - 1:
+                out = jnp.where((me == n - 1) & active,
+                                out.at[safe_idx].set(y), out)
+            ys.append(y)
+        if t == num_mb + total - 2:
+            break
+        shifted = [stream.send_next(y) for y in ys]
+        for c in range(chunks):
+            # Device 0's inbound for chunk c comes from device n-1's chunk
+            # c-1 (the cross-chunk wrap); other devices stay within c.
+            prev = shifted[c - 1] if c > 0 else jnp.zeros_like(shifted[0])
+            carry[c] = jnp.where(me == 0, prev, shifted[c])
     return out

@@ -34,7 +34,12 @@ from triton_distributed_tpu.ops.gemm_allreduce import (  # noqa: F401
     gemm_allreduce,
     gemm_ar_local,
 )
-from triton_distributed_tpu.ops.p2p import p2p_shift, p2p_shift_local  # noqa: F401
+from triton_distributed_tpu.ops.p2p import (  # noqa: F401
+    p2p_permute,
+    p2p_permute_local,
+    p2p_shift,
+    p2p_shift_local,
+)
 from triton_distributed_tpu.ops.all_to_all import (  # noqa: F401
     a2a_stream_workspace,
     fast_all_to_all,

@@ -151,6 +151,12 @@ def all_gather_local(x_local: jax.Array, axis: str = "tp", num_ranks: int | None
         mk = method.value if isinstance(method, AllGatherMethod) else str(method)
         if mk == "xla":
             return jax.lax.all_gather(x_local, tuple(axis), tiled=True)
+        if mk not in ("auto", "ring_1d"):
+            # Reject rather than silently substituting a different kernel
+            # for a pinned method (benchmark callers rely on the pin).
+            raise ValueError(
+                f"method {mk!r} has no multi-axis form; tuple-axis AG "
+                "supports auto (ring-of-rings) or xla")
         from triton_distributed_tpu.ops.multi_axis import (
             all_gather_torus_local,
         )

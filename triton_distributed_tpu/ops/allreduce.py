@@ -338,9 +338,11 @@ def all_reduce_local(x_local: jax.Array, axis: str = "tp",
         m = method.value if isinstance(method, AllReduceMethod) else str(method)
         if m == "xla":
             return jax.lax.psum(x_local, tuple(axis))
+        # "auto" passes through: the torus op maps it to the hierarchical
+        # one-shot on a real 2-D grid but lets the 1-D AUTO cost model run
+        # on degenerate (n,1)/(1,n) meshes.
         return all_reduce_torus_local(
-            x_local, axes=tuple(axis), dims=tuple(num_ranks),
-            method="one_shot" if m == "auto" else m)
+            x_local, axes=tuple(axis), dims=tuple(num_ranks), method=m)
     method = AllReduceMethod(method) if not isinstance(method, AllReduceMethod) else method
     if num_ranks is None:
         raise ValueError("num_ranks required inside shard_map")

@@ -211,17 +211,26 @@ def all_reduce_torus_local(x_local: jax.Array, *, axes: tuple[str, str],
                            dims: tuple[int, int],
                            method: str = "one_shot") -> jax.Array:
     """Device-local 2-axis AllReduce inside shard_map. ``x_local``:
-    (m, cols) → (m, cols) summed over the n0·n1 grid."""
+    (m, cols) → (m, cols) summed over the n0·n1 grid. ``method``:
+    one_shot (hierarchical, latency class), two_shot (RS+AG, bandwidth
+    class), or auto (one_shot on a real grid; 1-D cost-model AUTO on
+    degenerate meshes)."""
     ax0, ax1 = axes
     n0, n1 = dims
     if n0 * n1 == 1:
         return x_local
     if n0 == 1 or n1 == 1:
+        # Degenerate mesh → the 1-D op, with "auto" preserved so its
+        # cost-model selection (one/two-shot/tree) still runs.
         from triton_distributed_tpu.ops.allreduce import all_reduce_local
 
         axis, n = (ax1, n1) if n0 == 1 else (ax0, n0)
         return all_reduce_local(x_local, axis=axis, num_ranks=n,
                                 method=method)
+    if method == "auto":
+        # On a real 2-D grid the hierarchical one-shot is the torus
+        # method (every push a single physical hop; see module docstring).
+        method = "one_shot"
     if method == "two_shot":
         total = n0 * n1
         m = x_local.shape[0]

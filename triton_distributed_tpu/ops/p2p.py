@@ -16,6 +16,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
@@ -70,3 +71,139 @@ def p2p_shift(x: jax.Array, ctx: DistContext | None = None, shift: int = 1,
                                  num_ranks=n)
 
     return cached_shard_jit(ctx, "p2p_shift", key, make, P(axis), P(axis))(x)
+
+
+# ---------------------------------------------------------------------------
+# Arbitrary-pair P2P (round-4 VERDICT #7). Reference: p2p_copy_kernel
+# push/pull between ANY two ranks (kernels/nvidia/p2p.py:31,54), wrapped by
+# the PP CommOp layer (layers/nvidia/p2p.py:30-132). The ring shift above
+# remains the fast path for the uniform-adjacent case.
+# ---------------------------------------------------------------------------
+
+def _as_shift(perm, n: int) -> int | None:
+    """The uniform shift amount when ``perm`` is exactly a full ring shift
+    (the fast-path detection), else None."""
+    if len(perm) != n:
+        return None
+    shifts = {(d - s) % n for s, d in perm}
+    if len(shifts) != 1:
+        return None
+    if {s for s, _ in perm} != set(range(n)):
+        return None
+    return shifts.pop()
+
+
+def _p2p_permute_kernel(n: int, axis: str, perm: tuple, tile_m: int,
+                        x_ref, out_ref, vz, send_sems, recv_sems, copy_sem):
+    """Static-pair permutation: pair i = (src, dst) pushes src's block into
+    dst's output. Per-SOURCE recv semaphores disambiguate concurrent
+    transfers (a dst waits exactly the semaphore its src signals — the
+    per-pair signal of the reference's CommOp); per-pair send semaphores
+    let one src multicast to several dsts. Non-receiving devices zero
+    their output (``jax.lax.ppermute`` semantics, which is the golden)."""
+    me = dl.rank(axis)
+    shmem.barrier_all(axis)
+    m = x_ref.shape[0]
+    dsts = sorted({d for _, d in perm})
+    is_recv = functools.reduce(
+        lambda a, b: a | b, [me == d for d in dsts], me < 0)
+
+    # Zero non-receivers FIRST-and-only: a receiver's delivery may already
+    # be in flight, so receivers must never touch their output.
+    @pl.when(~is_recv)
+    def _():
+        vz[...] = jnp.zeros_like(vz)
+        for t in range(m // tile_m):
+            rows = pl.ds(t * tile_m, tile_m)
+            cp = pltpu.make_async_copy(vz, out_ref.at[rows], copy_sem)
+            cp.start()
+            cp.wait()
+
+    # Starts, receives, and send-drains are three passes with IDENTICAL
+    # predicates: a wait must run under the same predicate as the start it
+    # matches (an unpredicated wait for a predicated start deadlocks), and
+    # keeping the drains last lets one src's multicast sends overlap.
+    for i, (s, d) in enumerate(perm):
+
+        @pl.when(me == s)
+        def _(i=i, s=s, d=d):
+            shmem.putmem_nbi_block(
+                x_ref, out_ref, send_sems.at[i], recv_sems.at[s], d, axis)
+
+    for s, d in perm:
+
+        @pl.when(me == d)
+        def _(s=s):
+            shmem.wait_deliveries(x_ref, recv_sems.at[s], 1)
+
+    for i, (s, d) in enumerate(perm):
+
+        @pl.when(me == s)
+        def _(i=i):
+            # wait_send: drain the pair's send semaphore (same
+            # equal-shape-handle idiom as wait_deliveries).
+            pltpu.make_async_copy(x_ref, x_ref, send_sems.at[i]).wait()
+
+
+def p2p_permute_local(x_local: jax.Array, perm, axis: str = "tp",
+                      num_ranks: int | None = None) -> jax.Array:
+    """Device-local arbitrary-pair exchange inside shard_map.
+
+    ``perm``: static sequence of (src, dst) rank pairs — any pairs, not
+    just a ring: partial sends (idle devices allowed), multicast (one src,
+    several dsts). Each dst appears at most once. Devices that receive
+    nothing get zeros (``jax.lax.ppermute`` semantics). A perm that is a
+    full uniform ring shift dispatches the single-semaphore shift kernel.
+    """
+    if num_ranks is None:
+        raise ValueError("num_ranks required inside shard_map")
+    n = num_ranks
+    perm = tuple((int(s), int(d)) for s, d in perm)
+    dsts = [d for _, d in perm]
+    if len(set(dsts)) != len(dsts):
+        raise ValueError(f"duplicate destination in perm {perm}")
+    for s, d in perm:
+        if not (0 <= s < n and 0 <= d < n):
+            raise ValueError(f"pair ({s}, {d}) outside 0..{n - 1}")
+    if n == 1:
+        # Same ppermute semantics as n>1: zeros unless the (0, 0)
+        # self-pair is present.
+        return x_local if (0, 0) in perm else jnp.zeros_like(x_local)
+    shift = _as_shift(perm, n)
+    if shift is not None:
+        return p2p_shift_local(x_local, shift=shift, axis=axis,
+                               num_ranks=n)
+    from triton_distributed_tpu.ops.tiling import pick_tile, sublane_align
+
+    m, cols = x_local.shape
+    tile_m = pick_tile(m, 512, sublane_align(x_local.dtype))
+    kernel = functools.partial(_p2p_permute_kernel, n, axis, perm, tile_m)
+    return kernel_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x_local.shape, x_local.dtype),
+        in_specs=[any_spec()],
+        out_specs=any_spec(),
+        scratch_shapes=[
+            pltpu.VMEM((tile_m, cols), x_local.dtype),
+            pltpu.SemaphoreType.DMA((max(len(perm), 1),)),
+            pltpu.SemaphoreType.DMA((n,)),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        uses_barrier=True,
+    )(x_local)
+
+
+def p2p_permute(x: jax.Array, perm, ctx: DistContext | None = None,
+                axis: str = "tp") -> jax.Array:
+    """Host-level arbitrary-pair exchange (x sharded over ``axis``)."""
+    ctx = ctx or get_context()
+    n = ctx.axis_size(axis)
+    perm = tuple((int(s), int(d)) for s, d in perm)
+    key = (axis, perm, x.shape, str(x.dtype))
+
+    def make():
+        return functools.partial(p2p_permute_local, perm=perm, axis=axis,
+                                 num_ranks=n)
+
+    return cached_shard_jit(ctx, "p2p_permute", key, make, P(axis),
+                            P(axis), ici_axes=(axis,))(x)
