@@ -383,6 +383,45 @@ def main() -> int:
 
     check("megakernel paged-attention task", mega_paged)
 
+    # MoE tasks: MOE_TOPK (in-VMEM top-k + softmax) + MOE_FFN — the FFN's
+    # inactive-expert skip is a DATA-DEPENDENT pl.when on a vector-reduced
+    # scalar, the one construct in the MoE design Mosaic could reject;
+    # this gate is its on-chip proof.
+    def mega_moe():
+        from triton_distributed_tpu.megakernel.models import (
+            build_decode_step, rope_tables,
+        )
+
+        E, topk, ffn_l, hid = 8, 2, 256, 256
+        progm = build_decode_step(
+            hidden=hid, hq_local=1, hkv_local=1, ffn_local=ffn_l,
+            num_layers=1, max_seq=256, pos=100, num_ranks=1,
+            moe_experts=E, moe_topk=topk, batch=4)
+        compm = progm.mb.compile()
+        hm = progm.layers[0]
+        cosf, sinf = rope_tables(100, MTILE, 1e6)
+        feeds = {progm.x: rng.standard_normal((MTILE, hid)) * 0.3,
+                 progm.cos: cosf, progm.sin: sinf}
+        import dataclasses as _dc
+
+        for f in _dc.fields(hm):
+            h_ = getattr(hm, f.name)
+            if f.name in ("w_gate", "w_up", "w_down") or h_ is None:
+                continue
+            if isinstance(h_, list):
+                for hh in h_:
+                    feeds[hh] = rng.standard_normal(
+                        (hh.rows, hh.cols)) * 0.1
+            else:
+                feeds[h_] = rng.standard_normal((h_.rows, h_.cols)) * 0.1
+        feeds = {h_: jnp.asarray(np.asarray(v_, np.float32))
+                 for h_, v_ in feeds.items()}
+        (res,) = compm.run(feeds, outputs=[progm.x_out])
+        assert np.isfinite(np.asarray(res)).all()
+        return res
+
+    check("megakernel MoE decode (topk + expert-skip FFN)", mega_moe)
+
     if failures:
         print(f"\n{total[0] - len(failures)}/{total[0]} passed — "
               f"{len(failures)} FAILURES: {failures}")

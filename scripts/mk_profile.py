@@ -1,0 +1,212 @@
+"""Per-task-type megakernel profile — attribute the decode-step residual.
+
+Round-4 VERDICT #1: the megakernel trails jit ~5.6-6.1x with the ~0.35 ms
+residual un-attributed ("task-body serialized load/store round-trips" was
+a hypothesis, not a measurement). This script measures each task TYPE at
+its Qwen3-8B TP=8 decode shape by building a queue of L identical tasks
+and timing R replays of the whole launch at three R values — the same
+chain-differential discipline as benchmark/bench_megakernel.py (the only
+method that survives the shared chip's dispatch swing).
+
+Per-task cost = d(total)/dR / L. The layer total predicted from the
+per-type costs × the real 27-task layer composition is printed against the
+measured layer step, so the attribution can be checked for completeness.
+
+    python scripts/mk_profile.py              # CPU smoke (tiny shapes)
+    TDTPU_BENCH_ON_TPU=1 python scripts/mk_profile.py
+"""
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "benchmark"))
+
+from _common import bootstrap  # noqa: E402
+
+jax, ON_TPU = bootstrap(n_devices=1)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from triton_distributed_tpu.megakernel.builder import MegaKernelBuilder  # noqa: E402
+from triton_distributed_tpu.megakernel.models import rope_tables  # noqa: E402
+from triton_distributed_tpu.megakernel.tasks import TILE  # noqa: E402
+
+
+def time_replays(compiled, ws0, lengths, trials=5):
+    """min-of-trials wall time of R queue replays, per R in lengths."""
+
+    @functools.partial(jax.jit, static_argnums=1)
+    def chain(ws, r, salt):
+        return jax.lax.fori_loop(0, r, lambda i, w_: compiled.step(w_),
+                                 ws + salt.astype(ws.dtype))
+
+    t = {r: float("inf") for r in lengths}
+    salt = [0]
+
+    def once(r):
+        salt[0] += 1
+        t0 = time.perf_counter()
+        out = chain(ws0, r, jnp.float32(salt[0] * 1e-6))
+        _ = np.asarray(jnp.sum(out))
+        return time.perf_counter() - t0
+
+    for r in lengths:
+        once(r)           # compile + warm
+    for _ in range(trials):
+        for r in lengths:
+            t[r] = min(t[r], once(r))
+    return t
+
+
+def per_task_seconds(compiled, ws0, n_tasks, lengths):
+    t = time_replays(compiled, ws0, lengths)
+    r1, r2, r3 = lengths
+    t1, t2, t3 = t[r1], t[r2], t[r3]
+    if not (t3 > t2 > t1):
+        return None, f"non-monotone {t1:.4f}/{t2:.4f}/{t3:.4f}"
+    d21 = (t2 - t1) / (r2 - r1)
+    d32 = (t3 - t2) / (r3 - r2)
+    if not (0.33 < d21 / max(d32, 1e-12) < 3.0):
+        return None, f"inconsistent {d21:.3e} vs {d32:.3e}"
+    return (t3 - t1) / (r3 - r1) / n_tasks, None
+
+
+def build_case(name, emit, L, feeds_fn, dtype):
+    """Build a queue of L identical tasks; emit(mb, handles) appends one."""
+    mb = MegaKernelBuilder()
+    handles = feeds_fn(mb)
+    for _ in range(L):
+        emit(mb, handles)
+    compiled = mb.compile(dtype=dtype)
+    rng = np.random.default_rng(0)
+    feeds = {}
+    for h in handles.values():
+        if isinstance(h, list):
+            for hh in h:
+                feeds[hh] = rng.standard_normal(
+                    (hh.rows, hh.cols)).astype(np.float32) * 0.05
+        else:
+            feeds[h] = rng.standard_normal(
+                (h.rows, h.cols)).astype(np.float32) * 0.05
+    return compiled, compiled.make_workspace(
+        {k: jnp.asarray(v) for k, v in feeds.items()})
+
+
+def main():
+    if ON_TPU:
+        hidden, hq, hkv, ffn, S = 4096, 4, 1, 1536, 1024
+        L = 48
+        lengths_heavy = (2, 8, 14)      # gemm-class tasks (~50us+ each)
+        lengths_light = (8, 32, 56)     # cheap tasks
+        dtype = jnp.bfloat16
+    else:
+        hidden, hq, hkv, ffn, S = 512, 2, 1, 256, 256
+        L = 4
+        lengths_heavy = lengths_light = (1, 2, 3)
+        dtype = jnp.float32
+    ht, ft = hidden // TILE, ffn // TILE
+    d = TILE
+
+    cases = []
+
+    def add_case(name, count_per_layer, lengths, emit, feeds_fn):
+        cases.append((name, count_per_layer, lengths, emit, feeds_fn))
+
+    # -- GEMM_WIDE at the layer's four shapes -------------------------------
+    def gemm_feeds(kt, nt):
+        def f(mb):
+            return {"a": mb.tensor(TILE, kt * TILE),
+                    "b": mb.tensor(kt * TILE, nt * TILE),
+                    "o": mb.tensor(TILE, nt * TILE)}
+        return f
+
+    def gemm_emit(mb, h):
+        mb.gemm(h["o"], h["a"], h["b"])
+
+    add_case(f"gemm k={ht} w=8 (gate/up, {ft}t out)", 2 * (ft + 7) // 8,
+             lengths_heavy, gemm_emit, gemm_feeds(ht, ft))
+    add_case(f"gemm k={ft} w=8 (down, {ht}t out)", (ht + 7) // 8,
+             lengths_heavy, gemm_emit, gemm_feeds(ft, ht))
+    add_case(f"gemm k={hq} w=8 (o-proj)", (ht + 7) // 8,
+             lengths_heavy, gemm_emit, gemm_feeds(hq, ht))
+    add_case(f"gemm k={ht} w={hq} (wq)", 1,
+             lengths_heavy, gemm_emit, gemm_feeds(ht, hq))
+    add_case(f"gemm k={ht} w={hkv} (wk/wv)", 2,
+             lengths_heavy, gemm_emit, gemm_feeds(ht, hkv))
+
+    # -- RMS_NORM / elementwise over the hidden row -------------------------
+    def row_feeds(mb):
+        return {"a": mb.tensor(TILE, hidden), "b": mb.tensor(TILE, hidden),
+                "o": mb.tensor(TILE, hidden)}
+
+    add_case(f"rms_norm k={ht}", 2, lengths_light,
+             lambda mb, h: mb.rms_norm(h["o"], h["a"], h["b"]), row_feeds)
+    add_case(f"add k={ht}", 2, lengths_light,
+             lambda mb, h: mb.add(h["o"], h["a"], h["b"]), row_feeds)
+
+    def ffn_row_feeds(mb):
+        return {"a": mb.tensor(TILE, ffn), "b": mb.tensor(TILE, ffn),
+                "o": mb.tensor(TILE, ffn)}
+
+    add_case(f"silu_mul k={ft}", 1, lengths_light,
+             lambda mb, h: mb.silu_mul(h["o"], h["a"], h["b"]),
+             ffn_row_feeds)
+
+    # -- NORM_ROPE (per q+k head) ------------------------------------------
+    def nr_feeds(mb):
+        return {"a": mb.tensor(TILE, TILE), "w": mb.tensor(TILE, TILE),
+                "c": mb.tensor(TILE, TILE), "s": mb.tensor(TILE, TILE),
+                "o": mb.tensor(TILE, TILE)}
+
+    add_case("norm_rope", hq + hkv, lengths_light,
+             lambda mb, h: mb.norm_rope(h["o"], h["a"], h["w"], h["c"],
+                                        h["s"]), nr_feeds)
+
+    # -- ATTN_DECODE_GQA over the full cache --------------------------------
+    def attn_feeds(mb):
+        return {"q": mb.tensor(TILE, hq * d), "kT": mb.tensor(d, S),
+                "v": mb.tensor(S, d), "kn": mb.tensor(TILE, d),
+                "vn": mb.tensor(TILE, d), "o": mb.tensor(TILE, hq * d)}
+
+    add_case(f"attn_gqa g={hq} S={S}", hkv, lengths_light,
+             lambda mb, h: mb.attn_decode_gqa(
+                 h["o"], 0, h["q"], 0, hq, h["kT"], h["v"],
+                 valid_len=S - 1, scale=d ** -0.5, k_new=h["kn"],
+                 v_new=h["vn"]), attn_feeds)
+
+    # -- APPEND_KV ----------------------------------------------------------
+    def app_feeds(mb):
+        return {"kT": mb.tensor(d, S), "v": mb.tensor(S, d),
+                "kn": mb.tensor(TILE, d), "vn": mb.tensor(TILE, d)}
+
+    add_case("append_kv", hkv, lengths_light,
+             lambda mb, h: mb.append_kv(h["kT"], h["v"], S - 1, h["kn"],
+                                        h["vn"]), app_feeds)
+
+    print(f"# per-task profile at hidden={hidden} hq={hq} hkv={hkv} "
+          f"ffn={ffn} S={S} dtype={jnp.dtype(dtype).name} L={L} "
+          f"({'TPU' if ON_TPU else 'CPU smoke'})")
+    total = 0.0
+    rows = []
+    for name, count, lengths, emit, feeds_fn in cases:
+        compiled, ws0 = build_case(name, emit, L, feeds_fn, dtype)
+        per, err = per_task_seconds(compiled, ws0, L, lengths)
+        if per is None:
+            print(f"{name:36} UNRELIABLE ({err})")
+            rows.append((name, count, None))
+            continue
+        rows.append((name, count, per))
+        total += count * per
+        print(f"{name:36} {per * 1e6:9.2f} us/task x{count:3d}/layer "
+              f"= {count * per * 1e6:9.1f} us")
+    print(f"{'PREDICTED layer-step total':36} {total * 1e3:9.3f} ms "
+          "(compare bench_megakernel measured step)")
+
+
+if __name__ == "__main__":
+    main()

@@ -192,3 +192,73 @@ def test_moe_ep_overflow_reporting(ctx, moe_case):
     assert int(lay.overflow) == 16
     assert int(lay.send_splits.sum()) == 16
     assert (np.asarray(lay.send_splits)[0] <= 16).all()
+
+
+def test_moe_reduce_rs_overlap_matches_sequential(ctx, moe_case):
+    """The overlapped tail (RS hops under later chunks' down-proj GEMMs)
+    must produce the same row-sharded result as the sequential
+    grouped-GEMM → combine → ring-RS path."""
+    from triton_distributed_tpu.ops.moe import (
+        grouped_mlp_gate_up, moe_reduce_rs_local,
+        moe_reduce_rs_overlap_local, route_and_sort,
+    )
+
+    c = moe_case
+    n, topk = c["n"], c["topk"]
+    M = c["x"].shape[0]
+
+    def tail(x, router, wg, wu, wd, overlap):
+        x_sorted, sort_idx, gsz, _, tw = route_and_sort(x, router, topk)
+        act = grouped_mlp_gate_up(x_sorted, gsz, wg, wu)
+        if overlap:
+            return moe_reduce_rs_overlap_local(
+                act, sort_idx, gsz, wd, tw.astype(x.dtype), M,
+                axis="tp", num_ranks=n)
+        return moe_reduce_rs_local(
+            act, sort_idx, gsz, wd, tw.astype(x.dtype), M,
+            axis="tp", num_ranks=n, mode="overlap")
+
+    args = tuple(jnp.asarray(c[k]) for k in ("x", "router", "wg", "wu",
+                                             "wd"))
+    specs = (P(), P(), P(None, None, "tp"), P(None, None, "tp"),
+             P(None, "tp", None))
+    seq = shard_map_on(ctx, lambda *a: tail(*a, overlap=False),
+                       specs, P("tp"))(*args)
+    ovl = shard_map_on(ctx, lambda *a: tail(*a, overlap=True),
+                       specs, P("tp"))(*args)
+    np.testing.assert_allclose(np.asarray(ovl), np.asarray(seq),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_ag_group_gemm_ring_matches_sequential(ctx, moe_case):
+    """Per-source-readiness AG+GroupGEMM returns the identical global
+    expert-sorted output as the gather-then-compute form."""
+    from triton_distributed_tpu.ops.moe import (
+        ag_group_gemm_local, ag_group_gemm_ring_local,
+    )
+
+    c = moe_case
+    n, topk, E = c["n"], c["topk"], c["E"]
+    M = c["x"].shape[0]
+    rng = np.random.default_rng(42)
+    expert_ids = jnp.asarray(
+        rng.integers(0, E, size=(M * topk,)), jnp.int32)
+    tw = jnp.asarray(rng.random((M, topk)), jnp.float32)
+
+    def run(xl, ring):
+        fn = ag_group_gemm_ring_local if ring else ag_group_gemm_local
+        y, sidx, gsz = fn(xl, expert_ids, jnp.asarray(c["wg"]), tw,
+                          axis="tp", num_ranks=n)
+        return y, sidx, gsz
+
+    x = jnp.asarray(c["x"])
+    specs_in = P("tp")
+    specs_out = (P(), P(), P())
+    y0, s0, g0 = shard_map_on(ctx, lambda xl: run(xl, False),
+                              specs_in, specs_out)(x)
+    y1, s1, g1 = shard_map_on(ctx, lambda xl: run(xl, True),
+                              specs_in, specs_out)(x)
+    np.testing.assert_array_equal(np.asarray(s0), np.asarray(s1))
+    np.testing.assert_array_equal(np.asarray(g0), np.asarray(g1))
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
+                               rtol=1e-4, atol=1e-4)

@@ -37,8 +37,10 @@ class CommOp:
         self.n = num_ranks
 
     def exchange(self, x: jax.Array, perm) -> jax.Array:
-        if self.n == 1:
-            return x
+        # No n==1 shortcut: p2p_permute_local's degenerate branch keeps
+        # the ppermute semantics (zeros unless the (0,0) self-pair is in
+        # the perm) — an early `return x` would silently feed a stale
+        # activation where every n>1 run feeds zeros.
         return p2p_permute_local(x, perm, axis=self.axis, num_ranks=self.n)
 
     def send(self, x: jax.Array, src: int, dst: int) -> jax.Array:

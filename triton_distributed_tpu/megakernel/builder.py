@@ -431,6 +431,77 @@ class MegaKernelBuilder:
             reads, [out.tile(0, 0)])
         self._task_tables[tid] = flat
 
+    def moe_topk(self, out_wt: TensorHandle, logits: TensorHandle,
+                 topk: int, num_experts: int, batch: int):
+        """Router top-k + softmax-over-selected into the dense (E, B)
+        TRANSPOSED weight tile ``out_wt`` (E = num_experts <= TILE).
+        Rows >= ``batch`` and cols >= ``num_experts`` of the logits tile
+        are masked (padded regions must not elect experts — an unmasked
+        zero-logit pad row would mark ~every expert active and defeat
+        MOE_FFN's skip)."""
+        self._no_fp8(out_wt, logits)
+        if not 1 <= topk <= num_experts <= TILE:
+            raise ValueError(
+                f"need 1 <= topk ({topk}) <= E ({num_experts}) <= {TILE}")
+        if not 1 <= batch <= TILE:
+            raise ValueError(f"batch {batch} out of range")
+        if logits.rt != 1 or logits.ct != 1 or out_wt.rt != 1 \
+                or out_wt.ct != 1:
+            raise ValueError("logits/out_wt must be single (TILE, TILE) "
+                             "tiles (E <= 128 experts)")
+        self._emit(
+            Task(TaskType.MOE_TOPK, out_wt.tile(0, 0),
+                 a0=logits.tile(0, 0), b_stride=num_experts, arg=topk,
+                 d0=batch),
+            [logits.tile(0, 0)], [out_wt.tile(0, 0)])
+
+    def moe_ffn(self, out: TensorHandle, xn: TensorHandle,
+                wt: TensorHandle, w_gate: TensorHandle, w_up: TensorHandle,
+                w_down: TensorHandle, num_experts: int):
+        """One task = one layer's whole expert MLP (see tasks.py MOE_FFN).
+
+        xn/out: (TILE, hidden); wt: the (E, B) weight tile from
+        :meth:`moe_topk`; w_gate/w_up: (E·hidden, ffn_local) stacked expert
+        weights; w_down: (E·ffn_local, hidden). Inactive experts are
+        skipped in-kernel before any weight DMA.
+
+        Hazard note: expert weights are host-scattered once and never
+        task-written, so their read set is recorded via each tensor's base
+        tile (a full per-tile list would be E·HT·FT entries per layer with
+        no extra edges to find)."""
+        self._no_fp8(out, xn, wt, w_gate, w_up, w_down)
+        if out.rt != 1 or xn.rt != 1 or out.ct != xn.ct:
+            raise ValueError("xn/out must be (TILE, hidden) rows of equal "
+                             "width")
+        if wt.rt != 1 or wt.ct != 1:
+            raise ValueError("wt must be the single MOE_TOPK output tile")
+        ht = xn.ct
+        if w_gate.rt % num_experts or w_gate.rt // num_experts != ht:
+            raise ValueError(
+                f"w_gate rows {w_gate.rows} != E*hidden "
+                f"({num_experts}*{xn.cols})")
+        ft = w_gate.ct
+        if w_up.rt != w_gate.rt or w_up.ct != ft:
+            raise ValueError("w_up shape mismatch with w_gate")
+        if w_down.rt != num_experts * ft or w_down.ct != ht:
+            raise ValueError(
+                f"w_down must be (E*ffn_local, hidden), got "
+                f"({w_down.rows}, {w_down.cols})")
+        if num_experts > TILE:
+            raise ValueError(f"E {num_experts} > {TILE} needs multi-tile "
+                             "router output (unsupported)")
+        reads = ([xn.tile(0, j) for j in range(ht)]
+                 + [wt.tile(0, 0), w_gate.tile(0, 0), w_up.tile(0, 0),
+                    w_down.tile(0, 0)])
+        self._emit(
+            Task(TaskType.MOE_FFN, out.tile(0, 0), a0=xn.tile(0, 0),
+                 b0=wt.tile(0, 0), k_tiles=ht, a_stride=w_gate.tile(0, 0),
+                 b_stride=w_up.tile(0, 0),
+                 arg=num_experts | (ft << 16), c0=w_down.tile(0, 0)),
+            reads, [out.tile(0, j) for j in range(ht)])
+        self._max_moe_h = max(getattr(self, "_max_moe_h", 0), ht)
+        self._max_moe_f = max(getattr(self, "_max_moe_f", 0), ft)
+
     # -- compile / run -------------------------------------------------------
     def compile(self, num_ranks: int = 1, axis: str = "tp",
                 dtype=jnp.float32) -> "CompiledMegaKernel":
@@ -478,7 +549,9 @@ class MegaKernelBuilder:
                                   max_gqa=getattr(self, "_max_gqa", 1),
                                   max_gemm_width=getattr(
                                       self, "_max_gemm_width", 1),
-                                  num_tiles8=self._num_tiles8)
+                                  num_tiles8=self._num_tiles8,
+                                  max_moe_h=getattr(self, "_max_moe_h", 0),
+                                  max_moe_f=getattr(self, "_max_moe_f", 0))
 
 
 @dataclasses.dataclass
@@ -494,6 +567,8 @@ class CompiledMegaKernel:
     max_gqa: int = 1              # largest GQA group (sizes VMEM scratch)
     max_gemm_width: int = 1       # widest GEMM strip (sizes acc scratch)
     num_tiles8: int = 0           # fp8 weight-workspace tiles (0 = unused)
+    max_moe_h: int = 0            # MoE hidden tiles (0 = no MoE tasks)
+    max_moe_f: int = 0            # MoE ffn_local tiles
 
     def scatter_input(self, ws: jax.Array, h: TensorHandle,
                       value: jax.Array) -> jax.Array:
@@ -519,10 +594,12 @@ class CompiledMegaKernel:
 
     @property
     def _strip_pad(self) -> int:
-        """GEMM_WIDE fetches B strips at the STATIC max width even for
-        narrower edge strips (traced-size DMAs are illegal); padding the
-        workspaces by width-1 tiles keeps that overfetch in bounds."""
-        return max(self.max_gemm_width - 1, 0)
+        """GEMM_WIDE (and the MoE strip fetches, which reuse its buffer at
+        the same static width) fetch B strips at the STATIC max width even
+        for narrower edge strips (traced-size DMAs are illegal); padding
+        the workspaces by width-1 tiles keeps that overfetch in bounds."""
+        return max(self.max_gemm_width, self.max_moe_h,
+                   self.max_moe_f, 1) - 1
 
     def make_workspace(self, inputs: dict) -> jax.Array:
         """Build the tiled MAIN workspace once (weights + caches +
@@ -566,7 +643,8 @@ class CompiledMegaKernel:
                          num_ranks=self.num_ranks, axis=self.axis,
                          num_tasks=self.num_exec, max_gqa=self.max_gqa,
                          max_gemm_width=self.max_gemm_width,
-                         workspace8=ws8)
+                         workspace8=ws8, max_moe_h=self.max_moe_h,
+                         max_moe_f=self.max_moe_f)
 
     def run(self, inputs: dict, outputs: list[TensorHandle],
             _device_local: bool = True):

@@ -38,6 +38,7 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
                  max_gemm_width: int,
                  queue_ref, ws_in, ws8, ws_out, slots, va2, vb2, vb8, vbw,
                  vbw8, vacc, vq, vstat, vqg, vaccg, vstatg, vaccw,
+                 vaccw_wdt, vxn, vmoe_a, vmoe_b, vmoe_o,
                  copy_sem, pipe_sems, send_sems, recv_sem):
     wdt = ws_out.dtype   # workspace dtype (fp32 or bf16); compute is fp32
     step = pl.program_id(0)
@@ -175,6 +176,13 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
                                   if b_strip is vbw else vb8.at[PIPE_DEPTH],
                                   pipe_sems.at[2 * PIPE_DEPTH]).wait()
 
+        # Strip pipeline at FULL depth: with only 2 outstanding strips the
+        # per-DMA issue/completion latency (~1-2 us) gated every k-step —
+        # at 0.3 us of actual strip transfer that latency was the decode
+        # GEMMs' real bound (round-5 attribution; the round-4 diagnosis
+        # "neither dispatch count nor B granularity" pointed here).
+        depth = b_strip.shape[0]
+
         def sdesc(j, slot):
             return pltpu.make_async_copy(
                 b_ws.at[pl.ds(b0 + j * b_stride, b_strip.shape[1])],
@@ -185,16 +193,14 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
                                          va2.at[slot],
                                          pipe_sems.at[slot * 2])
 
-        adesc(0, 0).start()
-        sdesc(0, 0).start()
-
-        @pl.when(k_tiles > 1)
-        def _():
-            adesc(1, 1).start()
-            sdesc(1, 1).start()
+        for jj in range(PIPE_DEPTH - 1):
+            @pl.when(jj < k_tiles)
+            def _(jj=jj):
+                adesc(jj, jj).start()
+                sdesc(jj, jj).start()
 
         def jbody(j, _):
-            slot = jax.lax.rem(j, 2)
+            slot = jax.lax.rem(j, depth)
             adesc(j, slot).wait()
             sdesc(j, slot).wait()
 
@@ -206,21 +212,37 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
 
             jax.lax.fori_loop(0, width, wbody, 0)
 
-            @pl.when(j + 2 < k_tiles)
+            @pl.when(j + depth - 1 < k_tiles)
             def _():
-                adesc(j + 2, slot).start()
-                sdesc(j + 2, slot).start()
+                nslot = jax.lax.rem(j + depth - 1, depth)
+                adesc(j + depth - 1, nslot).start()
+                sdesc(j + depth - 1, nslot).start()
 
             return 0
 
         jax.lax.fori_loop(0, k_tiles, jbody, 0)
 
-        def store_w(w, _):
-            va[...] = vaccw[w].astype(wdt)
-            store(va, out + w)
+        # Result stores overlap each other (start all, then drain the
+        # byte-counting semaphore) instead of a blocking round-trip per
+        # output tile.
+        def cast_w(w, _):
+            vaccw_wdt[w, :, :] = vaccw[w].astype(wdt)
             return 0
 
+        def store_w(w, _):
+            pltpu.make_async_copy(vaccw_wdt.at[w], ws_out.at[out + w],
+                                  copy_sem).start()
+            return 0
+
+        jax.lax.fori_loop(0, width, cast_w, 0)
         jax.lax.fori_loop(0, width, store_w, 0)
+
+        def drain_w(w, _):
+            pltpu.make_async_copy(vaccw_wdt.at[w], ws_out.at[out + w],
+                                  copy_sem).wait()
+            return 0
+
+        jax.lax.fori_loop(0, width, drain_w, 0)
 
     def t_gemm_wide():
         _gemm_wide_body(ws_out, vbw)
@@ -484,16 +506,204 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
                            ).astype(wdt)
                 store(va, out + h)
 
+    def t_moe_topk():
+        # Router top-k + softmax over the selected logits (the
+        # ops/moe.route_and_sort convention), producing the dense (E, B)
+        # TRANSPOSED weight tile MOE_FFN's skip predicate reads. Pure VPU:
+        # iterative leftmost-argmax selection, no data-dependent control
+        # flow, one transpose at the end.
+        load(a0, va)
+        lg = va[...].astype(jnp.float32)
+        num_e = b_stride
+        batch = d0
+        colio = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 1)
+        rowio = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 0)
+        neg = jnp.float32(-1e30)
+        lg = jnp.where((colio < num_e) & (rowio < batch), lg, neg)
+        m0 = jnp.max(lg, axis=1, keepdims=True)
+
+        def body(i, carry):
+            work, selmask = carry
+            m = jnp.max(work, axis=1, keepdims=True)
+            is_m = (work == m) & (work > neg * 0.5)
+            idx = jnp.min(jnp.where(is_m, colio, TILE), axis=1,
+                          keepdims=True)
+            pick = colio == idx
+            return jnp.where(pick, neg, work), \
+                jnp.where(pick, 1.0, selmask)
+
+        _, selmask = jax.lax.fori_loop(
+            0, arg, body, (lg, jnp.zeros((TILE, TILE), jnp.float32)))
+        wgt = jnp.where(selmask > 0, jnp.exp(lg - m0), 0.0)
+        z = jnp.sum(wgt, axis=1, keepdims=True)
+        wgt = wgt / jnp.maximum(z, 1e-30)
+        va[...] = wgt.T.astype(wdt)           # (E, B) transposed
+        store(va, out)
+
+    def t_moe_ffn():
+        # One layer's whole expert MLP: loop experts, SKIP inactive ones
+        # before any weight DMA — active experts (≈ B·topk of E) stream
+        # gate/up strips per hidden tile and down strips per ffn tile,
+        # silu(x@wg)·(x@wu) weighted per token, accumulated into the
+        # output row. See tasks.py MOE_FFN for the word layout.
+        ht = k_tiles
+        num_e = arg & 0xFFFF
+        ft = arg >> 16
+        wg_base, wu_base, wd_base = a_stride, b_stride, c0
+        strip_w = vbw.shape[1]
+
+        load(b0, vq)                           # WT (E, B) weight tile
+
+        def ld_x(j, _):
+            cp = pltpu.make_async_copy(ws_out.at[a0 + j], vxn.at[j],
+                                       copy_sem)
+            cp.start()
+            cp.wait()
+            vmoe_o[j, :, :] = jnp.zeros((TILE, TILE), jnp.float32)
+            return 0
+
+        jax.lax.fori_loop(0, ht, ld_x, 0)
+        rowio = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 0)
+        eye = rowio == jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 1)
+
+        def ebody(e, _):
+            wt = vq[...].astype(jnp.float32)
+            w_tok = jnp.sum(jnp.where(rowio == e, wt, 0.0), axis=0)  # (B,)
+            active = jnp.sum(w_tok) > 0.0
+
+            @pl.when(active)
+            def _():
+                # Per-token weight as a column (lane -> sublane via the
+                # eye-mask reduction, the flash _col_to_row idiom).
+                w_col = jnp.sum(
+                    jnp.where(eye, jnp.broadcast_to(w_tok[None, :],
+                                                    (TILE, TILE)), 0.0),
+                    axis=1, keepdims=True)
+
+                def zf(f, _):
+                    vmoe_a[f, :, :] = jnp.zeros((TILE, TILE), jnp.float32)
+                    vmoe_b[f, :, :] = jnp.zeros((TILE, TILE), jnp.float32)
+                    return 0
+
+                jax.lax.fori_loop(0, ft, zf, 0)
+
+                # Gate/up strips PIPELINED as slot pairs (gate in slot
+                # 2p, up in 2p+1; two pairs in flight) — the per-DMA
+                # issue latency would otherwise gate every k-step, the
+                # exact bound the GEMM_WIDE depth-4 rework removed.
+                def gu_desc(j, sp):
+                    g = pltpu.make_async_copy(
+                        ws_out.at[pl.ds(wg_base + (e * ht + j) * ft,
+                                        strip_w)],
+                        vbw.at[sp], pipe_sems.at[sp * 2 + 1])
+                    u = pltpu.make_async_copy(
+                        ws_out.at[pl.ds(wu_base + (e * ht + j) * ft,
+                                        strip_w)],
+                        vbw.at[sp + 1], pipe_sems.at[sp * 2 + 3])
+                    return g, u
+
+                def gu_start(j, sp):
+                    g, u = gu_desc(j, sp)
+                    g.start()
+                    u.start()
+
+                gu_start(0, 0)
+
+                @pl.when(ht > 1)
+                def _():
+                    gu_start(1, 2)
+
+                def jbody(j, _):
+                    sp = jax.lax.rem(j, 2) * 2
+                    g, u = gu_desc(j, sp)
+                    g.wait()
+                    u.wait()
+                    a = vxn[j]
+
+                    def fbody(f, _):
+                        vmoe_a[f, :, :] = vmoe_a[f] + jnp.dot(
+                            a, vbw[sp, f].astype(a.dtype),
+                            preferred_element_type=jnp.float32)
+                        vmoe_b[f, :, :] = vmoe_b[f] + jnp.dot(
+                            a, vbw[sp + 1, f].astype(a.dtype),
+                            preferred_element_type=jnp.float32)
+                        return 0
+
+                    jax.lax.fori_loop(0, ft, fbody, 0)
+
+                    @pl.when(j + 2 < ht)
+                    def _():
+                        gu_start(j + 2, sp)
+
+                    return 0
+
+                jax.lax.fori_loop(0, ht, jbody, 0)
+
+                def actf(f, _):
+                    vmoe_a[f, :, :] = (jax.nn.silu(vmoe_a[f]) * vmoe_b[f]
+                                       * w_col)
+                    return 0
+
+                jax.lax.fori_loop(0, ft, actf, 0)
+
+                # Down strips pipelined over all four slots.
+                def d_desc(f, slot):
+                    return pltpu.make_async_copy(
+                        ws_out.at[pl.ds(wd_base + (e * ft + f) * ht,
+                                        strip_w)],
+                        vbw.at[slot], pipe_sems.at[slot * 2 + 1])
+
+                for ff in range(PIPE_DEPTH - 1):
+                    @pl.when(ff < ft)
+                    def _(ff=ff):
+                        d_desc(ff, ff).start()
+
+                def fdown(f, _):
+                    slot = jax.lax.rem(f, PIPE_DEPTH)
+                    d_desc(f, slot).wait()
+                    af = vmoe_a[f].astype(wdt)
+
+                    def jh(j, _):
+                        vmoe_o[j, :, :] = vmoe_o[j] + jnp.dot(
+                            af, vbw[slot, j].astype(af.dtype),
+                            preferred_element_type=jnp.float32)
+                        return 0
+
+                    jax.lax.fori_loop(0, ht, jh, 0)
+
+                    @pl.when(f + PIPE_DEPTH - 1 < ft)
+                    def _():
+                        d_desc(f + PIPE_DEPTH - 1,
+                               jax.lax.rem(f + PIPE_DEPTH - 1,
+                                           PIPE_DEPTH)).start()
+
+                    return 0
+
+                jax.lax.fori_loop(0, ft, fdown, 0)
+
+            return 0
+
+        jax.lax.fori_loop(0, num_e, ebody, 0)
+
+        def st(j, _):
+            va[...] = vmoe_o[j].astype(wdt)
+            store(va, out + j)
+            return 0
+
+        jax.lax.fori_loop(0, ht, st, 0)
+
     jax.lax.switch(w(0), [t_copy, t_add, t_silu_mul, t_retired, t_allreduce,
                           t_scale, t_rms_norm, t_retired, t_attn_decode,
                           t_attn_decode_paged, t_prefetch,
                           t_attn_decode_gqa, t_gemm_wide, t_norm_rope,
-                          t_append_kv, t_gemm_wide_w8, t_prefetch_w8])
+                          t_append_kv, t_gemm_wide_w8, t_prefetch_w8,
+                          t_moe_topk, t_moe_ffn])
 
 
 def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
               num_tasks: int | None = None, max_gqa: int = 1,
-              max_gemm_width: int = 1, workspace8=None):
+              max_gemm_width: int = 1, workspace8=None,
+              max_moe_h: int = 0, max_moe_f: int = 0):
     """Execute the packed task queue over the workspace in ONE pallas_call.
 
     queue: (n_rows, WORDS) int32; workspace: (T, TILE, TILE) fp32 or bf16
@@ -520,7 +730,12 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
     T = workspace.shape[0]
     wdt = workspace.dtype
     G = max(max_gqa, 1)
-    W = max(max_gemm_width, 1)
+    # MoE strips share the GEMM_WIDE strip buffer: it must span the wider
+    # of the ffn strips (gate/up, max_moe_f tiles) and the hidden strips
+    # (down, max_moe_h tiles). ``max_moe_*=0`` = program has no MoE.
+    MH = max(max_moe_h, 1)
+    MF = max(max_moe_f, 1)
+    W = max(max_gemm_width, max_moe_h, max_moe_f, 1)
     w8_absent = workspace8 is None
     if workspace8 is None:
         workspace8 = jnp.zeros((1, TILE, TILE), jnp.float8_e4m3fn)
@@ -544,11 +759,11 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
             pltpu.VMEM((PIPE_DEPTH + 1, TILE, TILE), wdt),  # vb2 (+pf slot)
             pltpu.VMEM((PIPE_DEPTH + 1, TILE, TILE),
                        jnp.float8_e4m3fn),                  # vb8 (+pf slot)
-            pltpu.VMEM((2, W, TILE, TILE), wdt),            # vbw (B strips)
+            pltpu.VMEM((PIPE_DEPTH, W, TILE, TILE), wdt),   # vbw (B strips)
             # fp8 strip buffer shrinks to 1 tile when the program has no
             # fp8 workspace (the W8 branch still compiles; it adapts via
             # b_strip.shape[1]) — ~0.5 MB of VMEM saved at W=8.
-            pltpu.VMEM((2, W if not w8_absent else 1, TILE, TILE),
+            pltpu.VMEM((PIPE_DEPTH, W if not w8_absent else 1, TILE, TILE),
                        jnp.float8_e4m3fn),                  # vbw8
             pltpu.VMEM((TILE, TILE), jnp.float32),      # vacc (fp32 accum)
             pltpu.VMEM((TILE, TILE), wdt),              # vq: rope/attn operand
@@ -557,6 +772,11 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
             pltpu.VMEM((G, TILE, TILE), jnp.float32),   # vaccg
             pltpu.VMEM((G, TILE, 128), jnp.float32),    # vstatg
             pltpu.VMEM((W, TILE, TILE), jnp.float32),   # vaccw (wide GEMM)
+            pltpu.VMEM((W, TILE, TILE), wdt),           # vaccw_wdt (stores)
+            pltpu.VMEM((MH, TILE, TILE), wdt),          # vxn (MoE x row)
+            pltpu.VMEM((MF, TILE, TILE), jnp.float32),  # vmoe_a (gate/act)
+            pltpu.VMEM((MF, TILE, TILE), jnp.float32),  # vmoe_b (up)
+            pltpu.VMEM((MH, TILE, TILE), jnp.float32),  # vmoe_o (out acc)
             pltpu.SemaphoreType.DMA(()),               # copy_sem
             pltpu.SemaphoreType.DMA((2 * PIPE_DEPTH + 1,)),  # pipe (+pf sem)
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),

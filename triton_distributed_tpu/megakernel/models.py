@@ -77,6 +77,12 @@ class DecodeLayerHandles:
     v: list[TensorHandle]       # per kv head: (S, d)
     k_new: TensorHandle         # (TILE, hkv_local*d) — this step's k (out)
     v_new: TensorHandle
+    # MoE FFN (Qwen3-MoE decode; None = dense MLP). Router cols padded to
+    # TILE (zero weights → zero logits, masked by MOE_TOPK's E bound).
+    moe_router: TensorHandle | None = None   # (hidden, TILE)
+    moe_w_gate: TensorHandle | None = None   # (E·hidden, ffn_local)
+    moe_w_up: TensorHandle | None = None
+    moe_w_down: TensorHandle | None = None   # (E·ffn_local, hidden)
 
 
 @dataclasses.dataclass
@@ -155,7 +161,9 @@ def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
                        sin: TensorHandle, *, hq_local: int, hkv_local: int,
                        pos: int, num_ranks: int,
                        eps: float = 1e-6, paged: bool = False,
-                       inkernel_append: bool = False) -> TensorHandle:
+                       inkernel_append: bool = False,
+                       moe_experts: int = 0, moe_topk: int = 0,
+                       batch: int = 1) -> TensorHandle:
     """Emit one transformer layer's decode tasks; returns the output x."""
     hidden = x.cols
     d = TILE
@@ -231,15 +239,26 @@ def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
 
     x1n = mb.tensor(TILE, hidden)
     mb.rms_norm(x1n, x1, h.mlp_norm, eps)
-    ffn_local = h.w_gate.cols
-    gate = mb.tensor(TILE, ffn_local)
-    up = mb.tensor(TILE, ffn_local)
-    act = mb.tensor(TILE, ffn_local)
-    mb.gemm(gate, x1n, h.w_gate)
-    mb.gemm(up, x1n, h.w_up)
-    mb.silu_mul(act, gate, up)
     down = mb.tensor(TILE, hidden)
-    mb.gemm(down, act, h.w_down)
+    if h.moe_w_gate is not None:
+        # Qwen3-MoE FFN: router GEMM → in-kernel top-k/softmax → ONE
+        # expert-loop task with data-dependent skipping (tasks.py MOE_FFN;
+        # only ~B·topk of E experts stream their weights).
+        logits = mb.tensor(TILE, TILE)
+        mb.gemm(logits, x1n, h.moe_router)
+        wt = mb.tensor(TILE, TILE)
+        mb.moe_topk(wt, logits, moe_topk, moe_experts, batch)
+        mb.moe_ffn(down, x1n, wt, h.moe_w_gate, h.moe_w_up, h.moe_w_down,
+                   moe_experts)
+    else:
+        ffn_local = h.w_gate.cols
+        gate = mb.tensor(TILE, ffn_local)
+        up = mb.tensor(TILE, ffn_local)
+        act = mb.tensor(TILE, ffn_local)
+        mb.gemm(gate, x1n, h.w_gate)
+        mb.gemm(up, x1n, h.w_up)
+        mb.silu_mul(act, gate, up)
+        mb.gemm(down, act, h.w_down)
     if num_ranks > 1:
         mb.all_reduce(down)
     x2 = mb.tensor(TILE, hidden)
@@ -253,7 +272,9 @@ def build_decode_step(*, hidden: int, hq_local: int, hkv_local: int,
                       eps: float = 1e-6,
                       paged: bool = False,
                       inkernel_append: bool = False,
-                      fp8_weights: bool = False) -> DecodeStepProgram:
+                      fp8_weights: bool = False,
+                      moe_experts: int = 0, moe_topk: int = 0,
+                      batch: int = 1) -> DecodeStepProgram:
     """Assemble a full num_layers decode step (per-device TP view).
 
     ``hq_local``/``hkv_local``/``ffn_local`` are this device's shards;
@@ -261,7 +282,15 @@ def build_decode_step(*, hidden: int, hq_local: int, hkv_local: int,
     reference megakernel also serves the transformer stack; sampling is
     host-side). ``fp8_weights``: projection/MLP weights live in the
     float8_e4m3fn weight workspace (GEMM_WIDE_W8 streams them at half the
-    bytes; quality is the e4m3 quantization's)."""
+    bytes; quality is the e4m3 quantization's).
+
+    ``moe_experts`` > 0 replaces the dense FFN with the Qwen3-MoE expert
+    MLP (router GEMM → MOE_TOPK → one expert-skipping MOE_FFN task per
+    layer; ``ffn_local`` becomes the per-expert moe_intermediate shard).
+    ``batch`` is the real token count — MOE_TOPK masks padded rows, which
+    would otherwise elect experts and defeat the in-kernel skip. MoE
+    weights stay in the main workspace (the fp8 lane covers dense
+    projections only)."""
     if hidden % TILE or ffn_local % TILE or max_seq % TILE:
         raise ValueError("hidden/ffn_local/max_seq must be TILE multiples")
     if not 0 <= pos < max_seq:
@@ -274,6 +303,12 @@ def build_decode_step(*, hidden: int, hq_local: int, hkv_local: int,
     layers: list[DecodeLayerHandles] = []
     d = TILE
     for _ in range(num_layers):
+        moe = moe_experts > 0
+        if moe:
+            moe_w_gate = mb.tensor(moe_experts * hidden, ffn_local)
+            moe_w_up = mb.tensor(moe_experts * hidden, ffn_local)
+            moe_w_down = mb.tensor(moe_experts * ffn_local, hidden)
+            moe_router = mb.tensor(hidden, TILE)
         layers.append(DecodeLayerHandles(
             attn_norm=mb.tensor(TILE, hidden),
             mlp_norm=mb.tensor(TILE, hidden),
@@ -283,13 +318,23 @@ def build_decode_step(*, hidden: int, hq_local: int, hkv_local: int,
             wk=mb.tensor(hidden, hkv_local * d, fp8=fp8_weights),
             wv=mb.tensor(hidden, hkv_local * d, fp8=fp8_weights),
             wo=mb.tensor(hq_local * d, hidden, fp8=fp8_weights),
-            w_gate=mb.tensor(hidden, ffn_local, fp8=fp8_weights),
-            w_up=mb.tensor(hidden, ffn_local, fp8=fp8_weights),
-            w_down=mb.tensor(ffn_local, hidden, fp8=fp8_weights),
+            # On the MoE path the dense-FFN fields alias the expert stacks
+            # (unused by the MoE branch; the dataclass keeps them
+            # non-optional for the dense majority).
+            w_gate=moe_w_gate if moe else mb.tensor(hidden, ffn_local,
+                                                    fp8=fp8_weights),
+            w_up=moe_w_up if moe else mb.tensor(hidden, ffn_local,
+                                                fp8=fp8_weights),
+            w_down=moe_w_down if moe else mb.tensor(ffn_local, hidden,
+                                                    fp8=fp8_weights),
             kT=[mb.tensor(d, max_seq) for _ in range(hkv_local)],
             v=[mb.tensor(max_seq, d) for _ in range(hkv_local)],
             k_new=mb.tensor(TILE, hkv_local * d),
             v_new=mb.tensor(TILE, hkv_local * d),
+            moe_router=moe_router if moe else None,
+            moe_w_gate=moe_w_gate if moe else None,
+            moe_w_up=moe_w_up if moe else None,
+            moe_w_down=moe_w_down if moe else None,
         ))
 
     cur = x
@@ -297,6 +342,8 @@ def build_decode_step(*, hidden: int, hq_local: int, hkv_local: int,
         cur = build_decode_layer(mb, cur, h, cos, sin, hq_local=hq_local,
                                  hkv_local=hkv_local, pos=pos,
                                  num_ranks=num_ranks, eps=eps, paged=paged,
-                                 inkernel_append=inkernel_append)
+                                 inkernel_append=inkernel_append,
+                                 moe_experts=moe_experts,
+                                 moe_topk=moe_topk, batch=batch)
     return DecodeStepProgram(mb=mb, x=x, layers=layers, cos=cos, sin=sin,
                              x_out=cur)

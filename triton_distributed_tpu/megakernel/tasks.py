@@ -97,6 +97,30 @@ class TaskType(enum.IntEnum):
     #                 weight payloads (README.md:96-97).
     PREFETCH_W8 = 16  # PREFETCH of an fp8 weight-workspace tile into the
     #                 fp8 reserved slot (consumed by GEMM_WIDE_W8 c0 == 1).
+    MOE_TOPK = 17   # Router top-k + softmax-over-selected, one tile: reads
+    #                 the (B, E) logits tile a0 (E <= TILE), masks rows >= B
+    #                 (d0) and cols >= E (b_stride), picks arg = topk experts
+    #                 per row (leftmost tie-break), softmaxes the selected
+    #                 logits, and stores the DENSE (E, B) TRANSPOSED weight
+    #                 tile to ``out`` — zeros for unselected experts, which
+    #                 is what lets MOE_FFN skip inactive experts by a
+    #                 column-sum predicate. Matches ops/moe.route_and_sort
+    #                 (Qwen norm_topk_prob semantics).
+    MOE_FFN = 18    # One task = one layer's ENTIRE expert MLP: loops the E
+    #                 experts; an expert whose (E, B) weight column is all
+    #                 zero is SKIPPED before any weight DMA issues — the
+    #                 data-dependent sparsity that makes MoE decode stream
+    #                 only ~B*topk experts' weights instead of all E.
+    #                 Active experts stream gate/up strips (k-major) and
+    #                 down strips (f-major) double-use of the GEMM_WIDE
+    #                 strip buffer, accumulate silu(x@wg)*(x@wu) per-token-
+    #                 weighted into the output row. Words: out = x_out base,
+    #                 a0 = xn base, b0 = WT tile (from MOE_TOPK), k_tiles =
+    #                 hidden tiles HT, a_stride = w_gate base, b_stride =
+    #                 w_up base, arg = E | (ffn_tiles << 16), c0 = w_down
+    #                 base. Expert weights are stacked handles:
+    #                 w_gate/w_up (E·hidden, ffn_local), w_down
+    #                 (E·ffn_local, hidden).
 
 
 @dataclasses.dataclass(frozen=True)

@@ -84,6 +84,8 @@ from triton_distributed_tpu.ops.gemm import (  # noqa: F401
 )
 from triton_distributed_tpu.ops.moe import (  # noqa: F401
     ag_group_gemm_local,
+    ag_group_gemm_ring_local,
+    moe_reduce_rs_overlap_local,
     grouped_mlp,
     moe_reduce_rs_local,
     moe_tp_fwd,

@@ -96,6 +96,73 @@ def ag_group_gemm_local(x_local: jax.Array, expert_ids: jax.Array,
     return y_sorted.astype(x_local.dtype), sort_idx, group_sizes
 
 
+def ag_group_gemm_ring_local(x_local: jax.Array, expert_ids: jax.Array,
+                             w_experts: jax.Array,
+                             topk_weights: jax.Array | None = None, *,
+                             axis: str = "tp",
+                             num_ranks: int | None = None):
+    """AG+GroupGEMM with PER-SOURCE readiness: each source's token chunk
+    runs its grouped GEMM the moment it arrives on the ring, instead of
+    after the full AllGather (round-4 VERDICT #6; reference consumers wait
+    per-chunk inside the grouped GEMM,
+    ``allgather_group_gemm.py:201-608``). Same contract as
+    :func:`ag_group_gemm_local` — (y_sorted (M·topk, ffn_local), sort_idx,
+    group_sizes) in GLOBAL expert-sorted order — so the two are drop-in
+    interchangeable; the cost of per-source compute is one extra row
+    permutation pair (chunk-local scatter + global gather).
+    """
+    if num_ranks is None:
+        raise ValueError("num_ranks required inside shard_map")
+    n = num_ranks
+    E = w_experts.shape[0]
+    if n == 1:
+        return ag_group_gemm_local(x_local, expert_ids, w_experts,
+                                   topk_weights, axis=axis, num_ranks=1)
+    me = jax.lax.axis_index(axis)
+    mc = x_local.shape[0]
+    M = mc * n
+    topk = expert_ids.shape[0] // M
+    ffn = w_experts.shape[2]
+    w_flat = (None if topk_weights is None else topk_weights.reshape(-1))
+
+    def chunk_gemm(src, xc):
+        """One source chunk: sort ITS tokens by expert, grouped GEMM,
+        un-sort back to flat (token-major) order."""
+        f0 = src * mc * topk
+        e_c = jax.lax.dynamic_slice_in_dim(expert_ids, f0, mc * topk)
+        sidx_c, gsz_c = sort_by_expert(e_c, E)
+        y_c = jax.lax.ragged_dot(xc[sidx_c // topk], w_experts, gsz_c)
+        if w_flat is not None:
+            wf = jax.lax.dynamic_slice_in_dim(w_flat, f0, mc * topk)
+            y_c = y_c * wf[sidx_c][:, None]
+        return jnp.zeros((mc * topk, ffn), y_c.dtype).at[sidx_c].set(y_c)
+
+    out = jnp.zeros((n, mc * topk, ffn), x_local.dtype)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def compute_into(out, src, xc):
+        y = chunk_gemm(src, xc).astype(x_local.dtype)
+        return jax.lax.dynamic_update_slice(out, y[None], (src, 0, 0))
+
+    # Ring rotation with compute under the DMA (the moe_ring schedule):
+    # own chunk computes while hop 1 is in flight, etc.
+    xc = jax.lax.ppermute(x_local, axis, perm)
+    out = compute_into(out, me, x_local)
+
+    def body(i, carry):
+        out, xc = carry
+        xc_next = jax.lax.ppermute(xc, axis, perm)
+        src = jax.lax.rem(me - i + n, n)
+        return compute_into(out, src, xc), xc_next
+
+    out, xc = jax.lax.fori_loop(1, n - 1, body, (out, xc))
+    out = compute_into(out, jax.lax.rem(me - (n - 1) + n, n), xc)
+
+    y_flat = out.reshape(M * topk, ffn)        # flat token-major order
+    sort_idx, group_sizes = sort_by_expert(expert_ids, E)
+    return y_flat[sort_idx], sort_idx, group_sizes
+
+
 def moe_reduce_rs_local(y_sorted: jax.Array, sort_idx: jax.Array,
                         group_sizes: jax.Array, w_down: jax.Array,
                         topk_weights: jax.Array, num_tokens: int, *,
@@ -138,6 +205,74 @@ def moe_reduce_rs_local(y_sorted: jax.Array, sort_idx: jax.Array,
     if mode == "xla_rep":
         return jax.lax.psum(combined, axis)
     raise ValueError(f"unknown MoE mode {mode!r}")
+
+
+def moe_reduce_rs_overlap_local(act_sorted: jax.Array, sort_idx: jax.Array,
+                                group_sizes: jax.Array, w_down: jax.Array,
+                                topk_weights: jax.Array, num_tokens: int, *,
+                                axis: str = "tp",
+                                num_ranks: int | None = None) -> jax.Array:
+    """Overlapped MoE tail: the RS accumulator leaves on the ring while
+    LATER chunks' expert down-projections still compute — replacing the
+    sequential grouped-GEMM → combine → ring-RS of
+    :func:`moe_reduce_rs_local` (round-4 VERDICT #6; reference fuses the
+    reduce into the grouped GEMM, ``moe_reduce_rs.py:167,293-546``).
+
+    Schedule (the ``moe_ring_fwd_local`` trick applied to the OUTPUT side):
+    the M token rows split into n ring chunks; at step s this device
+    computes the down-proj + topk-combine partial for chunk (me-2-s) while
+    the running ring-RS accumulator for the previous chunk is in flight
+    via ``ppermute`` — XLA's async collective permute runs the DMA under
+    the ragged_dot. After n-1 hops the accumulator this device holds is
+    its own fully-reduced chunk.
+
+    act_sorted: (M·topk, ffn_local) expert-sorted SwiGLU activations (the
+    global sort of ``route_and_sort``); returns (M/n, h) row-sharded —
+    the ``mode="overlap"`` layout of moe_reduce_rs_local.
+    """
+    if num_ranks is None:
+        raise ValueError("num_ranks required inside shard_map")
+    n = num_ranks
+    M = num_tokens
+    topk = sort_idx.shape[0] // M
+    E = w_down.shape[0]
+    if n == 1 or M % n:
+        out = moe_reduce_rs_local(act_sorted, sort_idx, group_sizes, w_down,
+                                  topk_weights, M, axis=axis, num_ranks=n,
+                                  mode="overlap" if n > 1 else "ar")
+        return out
+    me = jax.lax.axis_index(axis)
+    mc = M // n
+    # inv[f]: sorted position of flat slot f; expert of a sorted position
+    # recovered from the group prefix sums (no expert_ids arg needed).
+    inv = jnp.argsort(sort_idx)
+    csum = jnp.cumsum(group_sizes)
+    w_flat = topk_weights.reshape(-1)
+
+    def chunk_partial(c):
+        """Down-proj + topk-combine for token chunk c: re-sort just this
+        chunk's topk rows by expert and ragged_dot them — the chunk's
+        grouped GEMM starts without waiting for any other chunk."""
+        f0 = c * mc * topk
+        fr = f0 + jnp.arange(mc * topk)           # flat slots, token-major
+        pos = inv[fr]                              # their sorted positions
+        e_c = jnp.searchsorted(csum, pos, side="right").astype(jnp.int32)
+        sidx_c, gsz_c = sort_by_expert(e_c, E)
+        rows = act_sorted[pos[sidx_c]]
+        part = jax.lax.ragged_dot(rows, w_down, gsz_c)
+        part = part * w_flat[fr][sidx_c][:, None]
+        tloc = (fr // topk - c * mc)[sidx_c]
+        return jax.ops.segment_sum(part, tloc, num_segments=mc
+                                   ).astype(act_sorted.dtype)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    # Step 0: compute chunk me-1 (the accumulator this device originates).
+    carry = chunk_partial(jax.lax.rem(me - 1 + n, n))
+    for s in range(n - 1):
+        sent = jax.lax.ppermute(carry, axis, perm)     # DMA in flight...
+        nxt = chunk_partial(jax.lax.rem(me - 2 - s + 2 * n, n))  # ...under this GEMM
+        carry = sent + nxt
+    return carry
 
 
 def route_and_sort(x: jax.Array, gate_w: jax.Array, topk: int):
@@ -250,6 +385,13 @@ def moe_tp_fwd_local(x_local: jax.Array, gate_w: jax.Array,
     x_sorted, sort_idx, group_sizes, _, topk_weights = route_and_sort(
         x_full, gate_w, topk)
     act = grouped_mlp_gate_up(x_sorted, group_sizes, w_gate, w_up)
+    if mode == "overlap" and n > 1 and M % n == 0:
+        # Overlapped tail: RS accumulator hops ride under the next chunk's
+        # down-proj grouped GEMM (VERDICT r4 #6) — replaces the sequential
+        # combine-then-RS below on the row-sharded path.
+        return moe_reduce_rs_overlap_local(
+            act, sort_idx, group_sizes, w_down,
+            topk_weights.astype(x_local.dtype), M, axis=axis, num_ranks=n)
     return moe_reduce_rs_local(
         act, sort_idx, group_sizes, w_down,
         topk_weights.astype(x_local.dtype), M, axis=axis, num_ranks=n,
