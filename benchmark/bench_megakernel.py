@@ -136,10 +136,10 @@ def main():
         hidden, hq, hkv, ffn = 4096, 4, 1, 1536
         S = args.seq or 1024
         # Per-chain triples sized so each differential clears ~30 ms of
-        # relay dispatch swing: the strip-fetch megakernel step is
-        # ~0.1-0.5 ms, the jitted eager step can be ~0.05 ms at boost
-        # clocks.
-        mega_lengths, eager_lengths = (24, 120, 216), (48, 240, 432)
+        # relay dispatch swing: the round-5 row-resident/super-strip
+        # megakernel step is ~0.1-0.2 ms, the jitted eager step can be
+        # ~0.05 ms at boost clocks.
+        mega_lengths, eager_lengths = (48, 240, 432), (96, 480, 864)
     else:
         hidden, hq, hkv, ffn = 256, 2, 1, 256
         S = args.seq or 256

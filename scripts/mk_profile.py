@@ -100,9 +100,13 @@ def build_case(name, emit, L, feeds_fn, dtype):
 def main():
     if ON_TPU:
         hidden, hq, hkv, ffn, S = 4096, 4, 1, 1536, 1024
-        L = 48
-        lengths_heavy = (2, 8, 14)      # gemm-class tasks (~50us+ each)
-        lengths_light = (8, 32, 56)     # cheap tasks
+        # Post-rework tasks run ~3-20 us: the differential needs tens of
+        # thousands of task-executions to clear the relay's dispatch
+        # swing (first measurement pass came back all-UNRELIABLE at
+        # L=48 x 16 replays).
+        L = 192
+        lengths_heavy = (4, 24, 44)
+        lengths_light = (4, 24, 44)
         dtype = jnp.bfloat16
     else:
         hidden, hq, hkv, ffn, S = 512, 2, 1, 256, 256

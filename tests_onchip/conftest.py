@@ -1,0 +1,28 @@
+"""REAL-TPU capacity gates (`pytest tests_onchip -m onchip`).
+
+Unlike tests/conftest.py this does NOT force the CPU backend: every test
+here is a red/green gate for a "compiles and runs on real TPU" claim in
+COVERAGE.md (round-4 VERDICT #8: those claims lived in scripts outside
+the suite). Off-TPU the whole directory skips.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "onchip: real-TPU capacity/compile gates")
+
+
+def pytest_collection_modifyitems(config, items):
+    if jax.default_backend() == "tpu":
+        return
+    skip = pytest.mark.skip(reason="no real TPU backend")
+    for item in items:
+        item.add_marker(skip)

@@ -1,0 +1,127 @@
+"""Capacity-shape gates: the exact configurations COVERAGE.md claims
+compile and run on real TPU, as pytest red/green (round-4 VERDICT #8;
+reference pattern: test/stress/stress_test_ag_gemm.py's real-shape
+sweeps)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+pytestmark = pytest.mark.onchip
+
+
+def test_kernel_families_check():
+    """Every kernel family compiles + executes (scripts/check_on_chip.py
+    as a gate: 28 checks incl. parity streams, megakernel task set, MoE,
+    torus degenerates)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_on_chip",
+        __file__.replace("tests_onchip/test_capacity.py",
+                         "scripts/check_on_chip.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main() == 0
+
+
+def test_gemm_vmem_edge_tiles():
+    """The documented cross-window-best GEMM config (1024, 1024, 512) at
+    the north-star shape sits at the measured VMEM edge — it must keep
+    compiling (docs/gemm_core.md pins it; a Mosaic regression here would
+    silently fall back and cost ~10%)."""
+    from triton_distributed_tpu.ops.gemm import pallas_matmul
+
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.standard_normal((2048, 5120)) * 0.05, jnp.bfloat16)
+    b = jnp.asarray(rng.standard_normal((5120, 5120)) * 0.05, jnp.bfloat16)
+    out = pallas_matmul(a, b, tile_m=1024, tile_n=1024, tile_k=512)
+    assert np.isfinite(np.asarray(out, np.float32)).all()
+
+
+def test_flash_attention_s32k():
+    """S=32k flash prefill at the swept-best 1024x1024 tiles — the
+    long-context capacity claim (bf16, 8 q heads / 1 kv, d=128)."""
+    from triton_distributed_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.default_rng(1)
+    S = 32768
+    q = jnp.asarray(rng.standard_normal((1, S, 8, 128)) * 0.3, jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((1, S, 1, 128)) * 0.3, jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((1, S, 1, 128)) * 0.3, jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True)
+    assert np.isfinite(np.asarray(out[:, -64:], np.float32)).all()
+
+
+def test_paged_attention_real_pool():
+    """Paged decode over a REAL-sized shared pool (512 pages x 128 rows =
+    64k cached positions) — the serving capacity shape, not the toy-pool
+    interpret tests."""
+    from triton_distributed_tpu.ops.paged_attention import (
+        init_paged_kv_cache, paged_append, paged_decode_attention,
+    )
+
+    rng = np.random.default_rng(2)
+    B, hkv, hq, d, P_, n_pages = 4, 2, 8, 128, 128, 512
+    cache = init_paged_kv_cache(B, num_pages=n_pages, page_size=P_,
+                                num_kv_heads=hkv, head_dim=d,
+                                max_pages=64, dtype=jnp.bfloat16)
+    cache = cache._replace(
+        kv_lens=jnp.asarray([700, 1, 4000, 2500], jnp.int32))
+    k1 = jnp.asarray(rng.standard_normal((B, hkv, d)) * 0.3, jnp.bfloat16)
+    v1 = jnp.asarray(rng.standard_normal((B, hkv, d)) * 0.3, jnp.bfloat16)
+    cache = paged_append(cache, k1, v1)
+    q = jnp.asarray(rng.standard_normal((B, hq, d)) * 0.3, jnp.bfloat16)
+    out = paged_decode_attention(q, cache)
+    assert np.isfinite(np.asarray(out, np.float32)).all()
+
+
+def test_moe_capacity_overflow_reporting():
+    """EP A2A dispatch at the capacity edge: overflow must be REPORTED
+    (not silently dropped) on the real chip exactly as the interpret
+    suite asserts."""
+    from triton_distributed_tpu.ops.all_to_all import dispatch_layout
+
+    rng2 = np.random.default_rng(9)
+    tokens = jnp.asarray(rng2.standard_normal((16, 64)), jnp.float32)
+    expert_ids = jnp.zeros((16,), jnp.int32)       # all -> expert 0
+    layout = dispatch_layout(tokens, expert_ids, num_experts=4,
+                             num_ranks=1, cap=8)
+    assert int(np.asarray(layout.overflow).sum()) > 0
+
+
+def test_megakernel_decode_qwen3_shard_shapes():
+    """The bench's Qwen3-8B TP=8 shard decode program (hidden=4096,
+    S=1024, bf16) compiles and steps on-chip — the flagship claim's
+    compile gate at the REAL shape (bench only gates it when timing)."""
+    from triton_distributed_tpu.megakernel.models import (
+        build_decode_step, rope_tables,
+    )
+    from triton_distributed_tpu.megakernel.tasks import TILE
+
+    rng = np.random.default_rng(3)
+    prog = build_decode_step(hidden=4096, hq_local=4, hkv_local=1,
+                             ffn_local=1536, num_layers=1, max_seq=1024,
+                             pos=1023, num_ranks=1)
+    compiled = prog.mb.compile(dtype=jnp.bfloat16)
+    feeds = {prog.x: rng.standard_normal((TILE, 4096)) * 0.1}
+    cos, sin = rope_tables(1023, TILE, 1e6)
+    feeds[prog.cos], feeds[prog.sin] = cos, sin
+    h = prog.layers[0]
+    import dataclasses
+
+    for f in dataclasses.fields(h):
+        hh = getattr(h, f.name)
+        if hh is None or f.name.startswith("moe"):
+            continue
+        if isinstance(hh, list):
+            for t in hh:
+                feeds[t] = rng.standard_normal((t.rows, t.cols)) * 0.05
+        else:
+            feeds[hh] = rng.standard_normal((hh.rows, hh.cols)) * 0.05
+    feeds = {k: jnp.asarray(np.asarray(v, np.float32))
+             for k, v in feeds.items()}
+    (out,) = compiled.run(feeds, outputs=[prog.x_out])
+    assert np.isfinite(np.asarray(out, np.float32)).all()

@@ -134,6 +134,7 @@ class MegaKernelBuilder:
                             b0=b.tile(i, 0) if b else a.tile(i, 0),
                             k_tiles=a.ct, arg=arg),
                        reads, [out.tile(i, j) for j in range(out.ct)])
+            self._max_row = max(getattr(self, "_max_row", 1), a.ct)
 
     def prefetch(self, weight_tile: int, fp8: bool = False):
         """Start warming ``weight_tile`` into the reserved pipeline slot
@@ -158,13 +159,15 @@ class MegaKernelBuilder:
         self._pending_pf = (int(weight_tile), fp8)
 
     def gemm(self, out: TensorHandle, a: TensorHandle, b: TensorHandle,
-             prefetch_first: bool = False, width: int = 8):
+             prefetch_first: bool = False, width: int = 16):
         """out (M,N) = a (M,K) @ b (K,N) as GEMM_WIDE strips of up to
         ``width`` output column tiles per task (reference make_linear emits
-        multi-tile work per task the same way). One task streams the A row
-        once for its whole strip — the round-3 single-tile version re-
-        fetched it per output tile and paid ~2.8us of queue-walk overhead
-        per tile.
+        multi-tile work per task the same way). The A row loads ONCE into
+        the kernel's resident row buffer; a task that spans B's FULL width
+        with k % 4 == 0 additionally gets the 4-row SUPER-strip fetch
+        (d0 = 4: four k-rows are contiguous when b_stride == width) — the
+        round-5 fix for the per-k-step DMA-overhead bound the per-task
+        profile measured.
 
         ``prefetch_first``: the first task's f=0 weight tile was warmed by a
         preceding :meth:`prefetch` — it reads the reserved slot instead of
@@ -192,6 +195,7 @@ class MegaKernelBuilder:
             j = 0
             while j < out.ct:
                 wd = min(width, out.ct - j)
+                su = 4 if (wd == b.ct and kt % 4 == 0 and kt >= 4) else 0
                 reads = [a.tile(i, q) for q in range(kt)]
                 reads += [b.tile(q, j + w) + b_off for q in range(kt)
                           for w in range(wd)]
@@ -202,10 +206,13 @@ class MegaKernelBuilder:
                     Task(tt, out.tile(i, j),
                          a0=a.tile(i, 0), b0=b.tile(0, j),
                          k_tiles=kt, a_stride=1, b_stride=b.ct,
-                         arg=wd, c0=1 if use_pf else 0),
+                         arg=wd, c0=1 if use_pf else 0, d0=su),
                     reads, [out.tile(i, j + w) for w in range(wd)])
                 self._max_gemm_width = max(
                     getattr(self, "_max_gemm_width", 1), wd)
+                self._max_strip = max(getattr(self, "_max_strip", 1),
+                                      (su or 1) * wd)
+                self._max_row = max(getattr(self, "_max_row", 1), kt)
                 first = False
                 j += wd
 
@@ -283,6 +290,7 @@ class MegaKernelBuilder:
                      b0=w.tile(0, 0), k_tiles=a.ct,
                      arg=int(round(eps * 1e9))),
                 reads, [out.tile(i, j) for j in range(out.ct)])
+            self._max_row = max(getattr(self, "_max_row", 1), a.ct)
 
     def attn_decode(self, out: TensorHandle, q: TensorHandle,
                     kT: TensorHandle, v: TensorHandle, valid_len: int,
@@ -501,6 +509,11 @@ class MegaKernelBuilder:
             reads, [out.tile(0, j) for j in range(ht)])
         self._max_moe_h = max(getattr(self, "_max_moe_h", 0), ht)
         self._max_moe_f = max(getattr(self, "_max_moe_f", 0), ft)
+        self._max_row = max(getattr(self, "_max_row", 1), ht)
+        # MoE strips double-buffer via offset pairs inside the strip
+        # buffer: it must hold two gate/up (ft) and two down (ht) strips.
+        self._max_strip = max(getattr(self, "_max_strip", 1),
+                              2 * ft, 2 * ht)
 
     # -- compile / run -------------------------------------------------------
     def compile(self, num_ranks: int = 1, axis: str = "tp",
@@ -551,7 +564,9 @@ class MegaKernelBuilder:
                                       self, "_max_gemm_width", 1),
                                   num_tiles8=self._num_tiles8,
                                   max_moe_h=getattr(self, "_max_moe_h", 0),
-                                  max_moe_f=getattr(self, "_max_moe_f", 0))
+                                  max_moe_f=getattr(self, "_max_moe_f", 0),
+                                  max_row=getattr(self, "_max_row", 1),
+                                  max_strip=getattr(self, "_max_strip", 1))
 
 
 @dataclasses.dataclass
@@ -569,6 +584,8 @@ class CompiledMegaKernel:
     num_tiles8: int = 0           # fp8 weight-workspace tiles (0 = unused)
     max_moe_h: int = 0            # MoE hidden tiles (0 = no MoE tasks)
     max_moe_f: int = 0            # MoE ffn_local tiles
+    max_row: int = 1              # widest resident row (tiles)
+    max_strip: int = 1            # widest strip fetch (tiles)
 
     def scatter_input(self, ws: jax.Array, h: TensorHandle,
                       value: jax.Array) -> jax.Array:
@@ -594,12 +611,12 @@ class CompiledMegaKernel:
 
     @property
     def _strip_pad(self) -> int:
-        """GEMM_WIDE (and the MoE strip fetches, which reuse its buffer at
-        the same static width) fetch B strips at the STATIC max width even
-        for narrower edge strips (traced-size DMAs are illegal); padding
-        the workspaces by width-1 tiles keeps that overfetch in bounds."""
-        return max(self.max_gemm_width, self.max_moe_h,
-                   self.max_moe_f, 1) - 1
+        """Static-size fetches may overrun the last real tile: B strips
+        (up to max_strip tiles), the 8-tile row-load chunks, and the MoE
+        strip fetches. Padding the workspaces by the worst overfetch keeps
+        every read in bounds (stores are always exact)."""
+        return max(self.max_strip, self.max_gemm_width, self.max_moe_h,
+                   self.max_moe_f, 8) - 1
 
     def make_workspace(self, inputs: dict) -> jax.Array:
         """Build the tiled MAIN workspace once (weights + caches +
@@ -644,7 +661,8 @@ class CompiledMegaKernel:
                          num_tasks=self.num_exec, max_gqa=self.max_gqa,
                          max_gemm_width=self.max_gemm_width,
                          workspace8=ws8, max_moe_h=self.max_moe_h,
-                         max_moe_f=self.max_moe_f)
+                         max_moe_f=self.max_moe_f, max_row=self.max_row,
+                         max_strip=self.max_strip)
 
     def run(self, inputs: dict, outputs: list[TensorHandle],
             _device_local: bool = True):

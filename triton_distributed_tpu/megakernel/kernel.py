@@ -38,21 +38,19 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
                  max_gemm_width: int,
                  queue_ref, ws_in, ws8, ws_out, slots, va2, vb2, vb8, vbw,
                  vbw8, vacc, vq, vstat, vqg, vaccg, vstatg, vaccw,
-                 vaccw_wdt, vxn, vmoe_a, vmoe_b, vmoe_o,
-                 copy_sem, pipe_sems, send_sems, recv_sem):
+                 vaccw_wdt, vrow_a, vrow_b, vrow_o, vmoe_a, vmoe_b,
+                 vmoe_o, copy_sem, pipe_sems, send_sems, recv_sem):
     wdt = ws_out.dtype   # workspace dtype (fp32 or bf16); compute is fp32
     step = pl.program_id(0)
     # Double-buffer views: slot 0 is the default for unpipelined tasks.
     va, vb = va2.at[0], vb2.at[0]
 
-    # Step 0: materialize the workspace into the output buffer all tasks
-    # read/write (results chain task-to-task within one launch).
-    @pl.when(step == 0)
-    def _():
-        cp = pltpu.make_async_copy(ws_in, ws_out, copy_sem)
-        cp.start()
-        cp.wait()
-        if n > 1:
+    # Step 0: the workspace input is ALIASED to the output (run_queue
+    # input_output_aliases) — tasks read and write ws_out in place, no
+    # staging copy. Only the cross-device entry barrier remains.
+    if n > 1:
+        @pl.when(step == 0)
+        def _():
             shmem.barrier_all(axis)
 
     def w(j):
@@ -114,18 +112,24 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
 
         return jax.lax.fori_loop(0, n_iters, body, init)
 
-    # Elementwise tasks stream a whole tile row (k_tiles tiles) per task,
-    # pipelined; unary ops stream a single buffer.
+    # Elementwise tasks stage the whole row(s) into the resident buffers
+    # (chunked DMAs), compute tile-by-tile from VMEM, and store the row
+    # back chunked — ~10 DMAs per task instead of a load/store round trip
+    # per tile (the round-5 per-task profile's overhead class).
     def _ew_task(fn, binary=True):
-        def body(j, a_ref, b_ref, _):
-            vq[...] = fn(a_ref[...].astype(jnp.float32),
-                         b_ref[...].astype(jnp.float32)).astype(wdt)
-            store(vq, out + j)
+        if binary:
+            _row_load2(a0, vrow_a, b0, vrow_b, k_tiles)
+        else:
+            _row_load(a0, vrow_a, k_tiles)
+
+        def body(t, _):
+            a = vrow_a[t].astype(jnp.float32)
+            b = vrow_b[t].astype(jnp.float32) if binary else a
+            vrow_o[t, :, :] = fn(a, b).astype(wdt)
             return 0
 
-        pipelined_pairs(lambda j: a0 + j,
-                        (lambda j: b0 + j) if binary else None,
-                        k_tiles, body, 0)
+        jax.lax.fori_loop(0, k_tiles, body, 0)
+        _row_store(vrow_o, out, k_tiles)
 
     def t_copy():
         _ew_task(lambda a, b: a, binary=False)
@@ -148,22 +152,102 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
         pltpu.make_async_copy(ws_out.at[a0], vb2.at[PIPE_DEPTH],
                               pipe_sems.at[2 * PIPE_DEPTH]).start()
 
+    # -- whole-row staging (round-5 attribution: the per-task profile
+    # measured GEMM tasks at ~1.6 us per k-step + ~6 us fixed, so a w=1
+    # task cost the same as w=8 — per-iteration DMA/semaphore OVERHEAD,
+    # not bytes, was the decode bound; the fix is fewer, bigger DMAs) ----
+    _AC = 8   # tiles per row-chunk DMA (static size; pad covers overfetch)
+
+    def _row_desc(base, buf, c):
+        return pltpu.make_async_copy(
+            ws_out.at[pl.ds(base + c * _AC, _AC)],
+            buf.at[pl.ds(c * _AC, _AC)], copy_sem)
+
+    def _row_load(base, buf, nt):
+        """Chunked load of ``nt`` contiguous workspace tiles into ``buf``:
+        ceil(nt/8) static-size DMAs, all in flight before the first wait."""
+        n_c = (nt + _AC - 1) // _AC
+
+        def st(c, _):
+            _row_desc(base, buf, c).start()
+            return 0
+
+        def wt(c, _):
+            _row_desc(base, buf, c).wait()
+            return 0
+
+        jax.lax.fori_loop(0, n_c, st, 0)
+        jax.lax.fori_loop(0, n_c, wt, 0)
+
+    def _row_load2(base_a, buf_a, base_b, buf_b, nt):
+        """Two rows loaded with ALL chunks of both in flight before any
+        wait — the binary elementwise / rms tasks would otherwise pay two
+        serial drain latencies."""
+        n_c = (nt + _AC - 1) // _AC
+
+        def st(c, _):
+            _row_desc(base_a, buf_a, c).start()
+            _row_desc(base_b, buf_b, c).start()
+            return 0
+
+        def wt(c, _):
+            _row_desc(base_a, buf_a, c).wait()
+            _row_desc(base_b, buf_b, c).wait()
+            return 0
+
+        jax.lax.fori_loop(0, n_c, st, 0)
+        jax.lax.fori_loop(0, n_c, wt, 0)
+
+    def _row_store(buf, base, nt):
+        """Chunked store of ``nt`` tiles from ``buf``: full 8-tile chunks
+        (exact — a chunked OVERstore would clobber neighboring tensors)
+        plus per-tile remainder, all overlapped then drained."""
+        n_full = nt // _AC
+
+        def cdesc(c):
+            return pltpu.make_async_copy(
+                buf.at[pl.ds(c * _AC, _AC)],
+                ws_out.at[pl.ds(base + c * _AC, _AC)], copy_sem)
+
+        def rdesc(t):
+            return pltpu.make_async_copy(buf.at[n_full * _AC + t],
+                                         ws_out.at[base + n_full * _AC + t],
+                                         copy_sem)
+
+        def st(c, _):
+            cdesc(c).start()
+            return 0
+
+        def str_(t, _):
+            rdesc(t).start()
+            return 0
+
+        def wt(c, _):
+            cdesc(c).wait()
+            return 0
+
+        def wtr(t, _):
+            rdesc(t).wait()
+            return 0
+
+        jax.lax.fori_loop(0, n_full, st, 0)
+        jax.lax.fori_loop(0, nt - n_full * _AC, str_, 0)
+        jax.lax.fori_loop(0, n_full, wt, 0)
+        jax.lax.fori_loop(0, nt - n_full * _AC, wtr, 0)
+
     def _gemm_wide_body(b_ws, b_strip):
-        # One task computes ``width`` contiguous output column tiles: the A
-        # row tiles stream ONCE for the strip and width-1 task dispatches
-        # disappear. The strip's B tiles are CONTIGUOUS workspace tiles
-        # (b0 + j*b_stride + w), so each k-step fetches the whole
-        # (W, TILE, TILE) strip in ONE DMA — the round-4 retraction's
-        # diagnosis was ~2000 per-tile fetches per layer-step against a
-        # ~55 us streaming roofline, and strip DMAs divide that count by
-        # the width. The DMA size is STATIC (full W even for narrower edge
-        # strips — compile() pads the workspaces so the overfetch stays in
-        # bounds); ``b_strip`` double-buffers over its leading dim (vbw in
-        # workspace dtype, vbw8 for GEMM_WIDE_W8 — fp8 tiles upcast at the
-        # dot). Per-column fp32 accumulators live in vaccw's leading dim
-        # (dynamic leading-dim indexing — lane-dim slicing would not
-        # lower).
+        # One task computes ``width`` contiguous output column tiles. The
+        # A row loads ONCE into the resident row buffer (chunked DMAs),
+        # then each pipeline step fetches ONE B strip: a (width,) row for
+        # ordinary tasks, or a 4-row SUPER-strip (d0 == 4) when the task
+        # spans B's full width (b_stride == width makes 4 consecutive
+        # k-rows contiguous) — 4x fewer iterations for the byte-dominant
+        # full-width GEMMs. Strip DMA sizes are STATIC (max_gemm_width /
+        # the full super width; compile() pads the workspaces so edge
+        # overfetch stays in bounds). Per-column fp32 accumulators live in
+        # vaccw's leading dim.
         width = arg
+        su = d0 == 4
         vaccw[...] = jnp.zeros_like(vaccw)
 
         # A PREFETCH warm (c0 == 1) targeted the single-tile reserved slot
@@ -176,51 +260,90 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
                                   if b_strip is vbw else vb8.at[PIPE_DEPTH],
                                   pipe_sems.at[2 * PIPE_DEPTH]).wait()
 
-        # Strip pipeline at FULL depth: with only 2 outstanding strips the
-        # per-DMA issue/completion latency (~1-2 us) gated every k-step —
-        # at 0.3 us of actual strip transfer that latency was the decode
-        # GEMMs' real bound (round-5 attribution; the round-4 diagnosis
-        # "neither dispatch count nor B granularity" pointed here).
-        depth = b_strip.shape[0]
+        _row_load(a0, vrow_a, k_tiles)
 
-        def sdesc(j, slot):
+        depth = b_strip.shape[0]
+        n_steps = jnp.where(su, k_tiles // 4, k_tiles)
+
+        def sdesc_su(j, slot):
             return pltpu.make_async_copy(
-                b_ws.at[pl.ds(b0 + j * b_stride, b_strip.shape[1])],
+                b_ws.at[pl.ds(b0 + j * 4 * b_stride, b_strip.shape[1])],
                 b_strip.at[slot], pipe_sems.at[slot * 2 + 1])
 
-        def adesc(j, slot):
-            return pltpu.make_async_copy(ws_out.at[a0 + j * a_stride],
-                                         va2.at[slot],
-                                         pipe_sems.at[slot * 2])
+        # Plain fetch width adapts to the buffer (the W8 branch traces in
+        # every program; with no fp8 workspace its buffer is 1 tile wide
+        # and a static max_gemm_width slice would be out of bounds).
+        wpl = min(max_gemm_width, b_strip.shape[1])
 
-        for jj in range(PIPE_DEPTH - 1):
-            @pl.when(jj < k_tiles)
+        def sdesc_pl(j, slot):
+            return pltpu.make_async_copy(
+                b_ws.at[pl.ds(b0 + j * b_stride, wpl)],
+                b_strip.at[slot].at[pl.ds(0, wpl)],
+                pipe_sems.at[slot * 2 + 1])
+
+        def s_start(j, slot):
+            @pl.when(su)
+            def _():
+                sdesc_su(j, slot).start()
+
+            @pl.when(~su)
+            def _():
+                sdesc_pl(j, slot).start()
+
+        def s_wait(j, slot):
+            @pl.when(su)
+            def _():
+                sdesc_su(j, slot).wait()
+
+            @pl.when(~su)
+            def _():
+                sdesc_pl(j, slot).wait()
+
+        for jj in range(depth - 1):
+            @pl.when(jj < n_steps)
             def _(jj=jj):
-                adesc(jj, jj).start()
-                sdesc(jj, jj).start()
+                s_start(jj, jj)
 
+        # Dots are STATICALLY unrolled over the max width with w < width
+        # predication: each (r, w) dot hits a different static vaccw slot,
+        # so consecutive dots are independent and Mosaic can keep the MXU
+        # pipeline full — the dynamic-trip fori version serialized them at
+        # ~0.1 us each (round-5 profile: the post-DMA-fix residual).
         def jbody(j, _):
             slot = jax.lax.rem(j, depth)
-            adesc(j, slot).wait()
-            sdesc(j, slot).wait()
+            s_wait(j, slot)
 
-            def wbody(w, _):
-                vaccw[w, :, :] = vaccw[w] + jnp.dot(
-                    va2[slot], b_strip[slot, w].astype(va2.dtype),
-                    preferred_element_type=jnp.float32)
-                return 0
-
-            jax.lax.fori_loop(0, width, wbody, 0)
-
-            @pl.when(j + depth - 1 < k_tiles)
+            @pl.when(su)
             def _():
-                nslot = jax.lax.rem(j + depth - 1, depth)
-                adesc(j + depth - 1, nslot).start()
-                sdesc(j + depth - 1, nslot).start()
+                for r in range(4):
+                    a_t = vrow_a[4 * j + r]
+                    for w in range(min(max_gemm_width,
+                                       b_strip.shape[1] // 4 or 1)):
+                        @pl.when(w < width)
+                        def _(w=w, r=r, a_t=a_t):
+                            vaccw[w, :, :] = vaccw[w] + jnp.dot(
+                                a_t, b_strip[slot, r * width + w
+                                             ].astype(a_t.dtype),
+                                preferred_element_type=jnp.float32)
+
+            @pl.when(~su)
+            def _():
+                a_t = vrow_a[j]
+                for w in range(wpl):
+                    @pl.when(w < width)
+                    def _(w=w, a_t=a_t):
+                        vaccw[w, :, :] = vaccw[w] + jnp.dot(
+                            a_t, b_strip[slot, w].astype(a_t.dtype),
+                            preferred_element_type=jnp.float32)
+
+            @pl.when(j + depth - 1 < n_steps)
+            def _():
+                s_start(j + depth - 1,
+                        jax.lax.rem(j + depth - 1, depth))
 
             return 0
 
-        jax.lax.fori_loop(0, k_tiles, jbody, 0)
+        jax.lax.fori_loop(0, n_steps, jbody, 0)
 
         # Result stores overlap each other (start all, then drain the
         # byte-counting semaphore) instead of a blocking round-trip per
@@ -333,28 +456,28 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
         # One task normalizes a whole row block: k_tiles column tiles of x
         # starting at a0, scaled by the weight tiles at b0 (weight stored as
         # a broadcast (TILE, cols) tensor), written to out. eps arrives
-        # fixed-point 1e-9 in arg. Reference tasks/rms_norm.py. Both passes
-        # stream (x_j, w_j) pairs double-buffered.
+        # fixed-point 1e-9 in arg. Reference tasks/rms_norm.py. The row
+        # loads ONCE into the resident buffer; both passes run from VMEM.
+        _row_load2(a0, vrow_a, b0, vrow_b, k_tiles)
         vacc[...] = jnp.zeros_like(vacc)
 
-        def pass1(j, a_ref, _w_ref, _):
-            af = a_ref[...].astype(jnp.float32)
+        def pass1(t, _):
+            af = vrow_a[t].astype(jnp.float32)
             vacc[:, :1] += jnp.sum(af * af, axis=1, keepdims=True)
             return 0
 
-        pipelined_pairs(lambda j: a0 + j, None, k_tiles, pass1, 0)
+        jax.lax.fori_loop(0, k_tiles, pass1, 0)
         cols = (k_tiles * TILE).astype(jnp.float32)
         eps = arg.astype(jnp.float32) * 1e-9
         scale = jax.lax.rsqrt(vacc[:, :1] / cols + eps)
 
-        def pass2(j, a_ref, w_ref, _):
-            vq[...] = (a_ref[...].astype(jnp.float32) * scale
-                       * w_ref[...].astype(jnp.float32)).astype(wdt)
-            store(vq, out + j)
+        def pass2(t, _):
+            vrow_o[t, :, :] = (vrow_a[t].astype(jnp.float32) * scale
+                               * vrow_b[t].astype(jnp.float32)).astype(wdt)
             return 0
 
-        pipelined_pairs(lambda j: a0 + j, lambda j: b0 + j, k_tiles,
-                        pass2, 0)
+        jax.lax.fori_loop(0, k_tiles, pass2, 0)
+        _row_store(vrow_o, out, k_tiles)
 
     def _attn_softmax(kt_of, v_of):
         """Shared online-softmax body: streams (kT_j, V_j) tile pairs by the
@@ -512,6 +635,12 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
         # TRANSPOSED weight tile MOE_FFN's skip predicate reads. Pure VPU:
         # iterative leftmost-argmax selection, no data-dependent control
         # flow, one transpose at the end.
+        # Precision scope: the logits tile arrives in the WORKSPACE dtype
+        # — on a bf16 workspace the top-k compares bf16-rounded logits,
+        # so experts within ~0.4% relative can swap vs the fp32 router
+        # convention (token-identity to the layer path is exact on fp32
+        # workspaces; bf16 serving accepts the quantized-router variant,
+        # the same class of deviation as its bf16 activations).
         load(a0, va)
         lg = va[...].astype(jnp.float32)
         num_e = b_stride
@@ -550,19 +679,15 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
         num_e = arg & 0xFFFF
         ft = arg >> 16
         wg_base, wu_base, wd_base = a_stride, b_stride, c0
-        strip_w = vbw.shape[1]
 
         load(b0, vq)                           # WT (E, B) weight tile
+        _row_load(a0, vrow_a, ht)              # xn row resident
 
-        def ld_x(j, _):
-            cp = pltpu.make_async_copy(ws_out.at[a0 + j], vxn.at[j],
-                                       copy_sem)
-            cp.start()
-            cp.wait()
+        def zo(j, _):
             vmoe_o[j, :, :] = jnp.zeros((TILE, TILE), jnp.float32)
             return 0
 
-        jax.lax.fori_loop(0, ht, ld_x, 0)
+        jax.lax.fori_loop(0, ht, zo, 0)
         rowio = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 0)
         eye = rowio == jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 1)
 
@@ -587,23 +712,27 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
 
                 jax.lax.fori_loop(0, ft, zf, 0)
 
-                # Gate/up strips PIPELINED as slot pairs (gate in slot
-                # 2p, up in 2p+1; two pairs in flight) — the per-DMA
-                # issue latency would otherwise gate every k-step, the
-                # exact bound the GEMM_WIDE depth-4 rework removed.
-                def gu_desc(j, sp):
+                # Gate/up strips double-buffered as FOUR regions of the
+                # 2-slot strip buffer — (slot, offset) pairs: gate lives
+                # in slot 0 at offsets {0, MF}, up in slot 1 — exact
+                # static-size (MF-tile) fetches, two (gate, up) pairs in
+                # flight, so the per-DMA issue latency rides under the
+                # previous step's dots.
+                mf = vmoe_a.shape[0]
+
+                def gu_desc(j, p):
                     g = pltpu.make_async_copy(
-                        ws_out.at[pl.ds(wg_base + (e * ht + j) * ft,
-                                        strip_w)],
-                        vbw.at[sp], pipe_sems.at[sp * 2 + 1])
+                        ws_out.at[pl.ds(wg_base + (e * ht + j) * ft, mf)],
+                        vbw.at[0].at[pl.ds(p * mf, mf)],
+                        pipe_sems.at[1 + p])
                     u = pltpu.make_async_copy(
-                        ws_out.at[pl.ds(wu_base + (e * ht + j) * ft,
-                                        strip_w)],
-                        vbw.at[sp + 1], pipe_sems.at[sp * 2 + 3])
+                        ws_out.at[pl.ds(wu_base + (e * ht + j) * ft, mf)],
+                        vbw.at[1].at[pl.ds(p * mf, mf)],
+                        pipe_sems.at[3 + p])
                     return g, u
 
-                def gu_start(j, sp):
-                    g, u = gu_desc(j, sp)
+                def gu_start(j, p):
+                    g, u = gu_desc(j, p)
                     g.start()
                     u.start()
 
@@ -611,21 +740,21 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
 
                 @pl.when(ht > 1)
                 def _():
-                    gu_start(1, 2)
+                    gu_start(1, 1)
 
                 def jbody(j, _):
-                    sp = jax.lax.rem(j, 2) * 2
-                    g, u = gu_desc(j, sp)
+                    p = jax.lax.rem(j, 2)
+                    g, u = gu_desc(j, p)
                     g.wait()
                     u.wait()
-                    a = vxn[j]
+                    a = vrow_a[j]
 
                     def fbody(f, _):
                         vmoe_a[f, :, :] = vmoe_a[f] + jnp.dot(
-                            a, vbw[sp, f].astype(a.dtype),
+                            a, vbw[0, p * mf + f].astype(a.dtype),
                             preferred_element_type=jnp.float32)
                         vmoe_b[f, :, :] = vmoe_b[f] + jnp.dot(
-                            a, vbw[sp + 1, f].astype(a.dtype),
+                            a, vbw[1, p * mf + f].astype(a.dtype),
                             preferred_element_type=jnp.float32)
                         return 0
 
@@ -633,7 +762,7 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
 
                     @pl.when(j + 2 < ht)
                     def _():
-                        gu_start(j + 2, sp)
+                        gu_start(j + 2, p)
 
                     return 0
 
@@ -646,36 +775,38 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
 
                 jax.lax.fori_loop(0, ft, actf, 0)
 
-                # Down strips pipelined over all four slots.
-                def d_desc(f, slot):
-                    return pltpu.make_async_copy(
-                        ws_out.at[pl.ds(wd_base + (e * ft + f) * ht,
-                                        strip_w)],
-                        vbw.at[slot], pipe_sems.at[slot * 2 + 1])
+                # Down strips: (slot, offset) regions again, MH-tile
+                # static fetches, two in flight.
+                mh = vmoe_o.shape[0]
 
-                for ff in range(PIPE_DEPTH - 1):
-                    @pl.when(ff < ft)
-                    def _(ff=ff):
-                        d_desc(ff, ff).start()
+                def d_desc(f, p):
+                    return pltpu.make_async_copy(
+                        ws_out.at[pl.ds(wd_base + (e * ft + f) * ht, mh)],
+                        vbw.at[p].at[pl.ds(0, mh)],
+                        pipe_sems.at[5 + p])
+
+                d_desc(0, 0).start()
+
+                @pl.when(ft > 1)
+                def _():
+                    d_desc(1, 1).start()
 
                 def fdown(f, _):
-                    slot = jax.lax.rem(f, PIPE_DEPTH)
-                    d_desc(f, slot).wait()
+                    p = jax.lax.rem(f, 2)
+                    d_desc(f, p).wait()
                     af = vmoe_a[f].astype(wdt)
 
                     def jh(j, _):
                         vmoe_o[j, :, :] = vmoe_o[j] + jnp.dot(
-                            af, vbw[slot, j].astype(af.dtype),
+                            af, vbw[p, j].astype(af.dtype),
                             preferred_element_type=jnp.float32)
                         return 0
 
                     jax.lax.fori_loop(0, ht, jh, 0)
 
-                    @pl.when(f + PIPE_DEPTH - 1 < ft)
+                    @pl.when(f + 2 < ft)
                     def _():
-                        d_desc(f + PIPE_DEPTH - 1,
-                               jax.lax.rem(f + PIPE_DEPTH - 1,
-                                           PIPE_DEPTH)).start()
+                        d_desc(f + 2, p).start()
 
                     return 0
 
@@ -703,7 +834,8 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
 def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
               num_tasks: int | None = None, max_gqa: int = 1,
               max_gemm_width: int = 1, workspace8=None,
-              max_moe_h: int = 0, max_moe_f: int = 0):
+              max_moe_h: int = 0, max_moe_f: int = 0,
+              max_row: int = 1, max_strip: int = 0):
     """Execute the packed task queue over the workspace in ONE pallas_call.
 
     queue: (n_rows, WORDS) int32; workspace: (T, TILE, TILE) fp32 or bf16
@@ -736,16 +868,24 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
     MH = max(max_moe_h, 1)
     MF = max(max_moe_f, 1)
     W = max(max_gemm_width, max_moe_h, max_moe_f, 1)
+    # Resident row buffers: ceil to the 8-tile chunk the row loads use.
+    R = -(-max(max_row, 1) // 8) * 8
+    # Strip buffer width: the widest fetch any task issues (4-row super
+    # strips of full-width GEMMs, or the plain max width); two slots in
+    # flight — super strips are big enough that transfer, not issue
+    # latency, dominates. Floor 2*MF / MH: the (undispatched) MoE branch
+    # still TRACES its static region offsets in every program.
+    SW = max(max_strip, W, 2 * MF, MH)
     w8_absent = workspace8 is None
     if workspace8 is None:
         workspace8 = jnp.zeros((1, TILE, TILE), jnp.float8_e4m3fn)
-    if workspace8.shape[0] < W + 1:
-        # The compiled GEMM_WIDE_W8 branch statically slices W-tile strips
-        # (and exists in the switch even for programs that never dispatch
-        # it) — an undersized placeholder must pad so the slice bound
-        # checks out.
+    if workspace8.shape[0] < SW + 1:
+        # The compiled GEMM_WIDE_W8 branch statically slices strips (and
+        # exists in the switch even for programs that never dispatch it)
+        # — an undersized placeholder must pad so the slice bound checks
+        # out.
         workspace8 = jnp.pad(
-            workspace8, ((0, W + 1 - workspace8.shape[0]), (0, 0), (0, 0)))
+            workspace8, ((0, SW + 1 - workspace8.shape[0]), (0, 0), (0, 0)))
 
     # AR slots ride as a second output: Mosaic has no HBM scratch (see
     # language/core.py kernel_call ``workspaces``).
@@ -759,11 +899,11 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
             pltpu.VMEM((PIPE_DEPTH + 1, TILE, TILE), wdt),  # vb2 (+pf slot)
             pltpu.VMEM((PIPE_DEPTH + 1, TILE, TILE),
                        jnp.float8_e4m3fn),                  # vb8 (+pf slot)
-            pltpu.VMEM((PIPE_DEPTH, W, TILE, TILE), wdt),   # vbw (B strips)
+            pltpu.VMEM((2, SW, TILE, TILE), wdt),           # vbw (B strips)
             # fp8 strip buffer shrinks to 1 tile when the program has no
             # fp8 workspace (the W8 branch still compiles; it adapts via
-            # b_strip.shape[1]) — ~0.5 MB of VMEM saved at W=8.
-            pltpu.VMEM((PIPE_DEPTH, W if not w8_absent else 1, TILE, TILE),
+            # b_strip.shape[1]).
+            pltpu.VMEM((2, SW if not w8_absent else 1, TILE, TILE),
                        jnp.float8_e4m3fn),                  # vbw8
             pltpu.VMEM((TILE, TILE), jnp.float32),      # vacc (fp32 accum)
             pltpu.VMEM((TILE, TILE), wdt),              # vq: rope/attn operand
@@ -773,7 +913,9 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
             pltpu.VMEM((G, TILE, 128), jnp.float32),    # vstatg
             pltpu.VMEM((W, TILE, TILE), jnp.float32),   # vaccw (wide GEMM)
             pltpu.VMEM((W, TILE, TILE), wdt),           # vaccw_wdt (stores)
-            pltpu.VMEM((MH, TILE, TILE), wdt),          # vxn (MoE x row)
+            pltpu.VMEM((R, TILE, TILE), wdt),           # vrow_a (resident)
+            pltpu.VMEM((R, TILE, TILE), wdt),           # vrow_b
+            pltpu.VMEM((R, TILE, TILE), wdt),           # vrow_o
             pltpu.VMEM((MF, TILE, TILE), jnp.float32),  # vmoe_a (gate/act)
             pltpu.VMEM((MF, TILE, TILE), jnp.float32),  # vmoe_b (up)
             pltpu.VMEM((MH, TILE, TILE), jnp.float32),  # vmoe_o (out acc)
@@ -810,5 +952,13 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
         ),
         compiler_params=pltpu.CompilerParams(has_side_effects=True, **params),
         interpret=interpret_arg,
+        # The workspace input IS the output buffer: without the alias the
+        # kernel's step-0 staging copy moved the whole multi-GB workspace
+        # every step (~140 us at the bench shape — round-5 attribution:
+        # the gap between the per-task profile sum and the measured
+        # step). Callers in a loop donate the carried workspace and XLA
+        # runs the step fully in place; undonated callers get one
+        # XLA-level defensive copy instead of an in-kernel one.
+        input_output_aliases={1: 0},
     )(queue, workspace, workspace8)
     return ws_out

