@@ -23,7 +23,7 @@ import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
 from triton_distributed_tpu.megakernel.models import (  # noqa: E402
-    broadcast_rows, build_decode_step, rope_tables,
+    broadcast_rows, build_decode_step, feed_layer_weights, rope_tables,
 )
 from triton_distributed_tpu.megakernel.tasks import TILE  # noqa: E402
 
@@ -137,9 +137,11 @@ def main():
         S = args.seq or 1024
         # Per-chain triples sized so each differential clears ~30 ms of
         # relay dispatch swing: the round-5 row-resident/super-strip
-        # megakernel step is ~0.1-0.2 ms, the jitted eager step can be
-        # ~0.05 ms at boost clocks.
-        mega_lengths, eager_lengths = (48, 240, 432), (96, 480, 864)
+        # megakernel step measured ~0.07-0.1 ms (the first window at the
+        # old (48, 240, 432) lengths tripped the consistency gate — its
+        # 192-step differentials only spanned ~13 ms), the jitted eager
+        # step can be ~0.05 ms at boost clocks.
+        mega_lengths, eager_lengths = (128, 640, 1152), (128, 640, 1152)
     else:
         hidden, hq, hkv, ffn = 256, 2, 1, 256
         S = args.seq or 256
@@ -189,23 +191,26 @@ def main():
         feeds.update({h.attn_norm: broadcast_rows(w["attn_norm"]),
                       h.mlp_norm: broadcast_rows(w["mlp_norm"]),
                       h.q_norm: broadcast_rows(w["q_norm"]),
-                      h.k_norm: broadcast_rows(w["k_norm"]),
-                      h.wq: w["wq"], h.wk: w["wk"], h.wv: w["wv"],
-                      h.wo: w["wo"], h.w_gate: w["w_gate"],
-                      h.w_up: w["w_up"], h.w_down: w["w_down"]})
+                      h.k_norm: broadcast_rows(w["k_norm"])})
+        feed_layer_weights(feeds, h, wq=w["wq"], wk=w["wk"], wv=w["wv"],
+                           wo=w["wo"], w_gate=w["w_gate"], w_up=w["w_up"],
+                           w_down=w["w_down"])
         for i, (tk, tv) in enumerate(zip(h.kT, h.v)):
             feeds[tk] = kT[i]
             feeds[tv] = v[i]
         eager_layers.append((w, kT, v))
-    feeds = {k: jnp.asarray(val) for k, val in feeds.items()}
 
     # ---- megakernel chain: workspace built ONCE, N queue replays --------
-    ws0 = compiled.make_workspace(feeds)
+    main_f, _w8, mat_f = compiled.split_feeds(feeds)
+    ws0 = compiled.make_workspace(
+        {k: jnp.asarray(val) for k, val in main_f.items()})
+    wsm0 = compiled.make_workspace_mat(mat_f) if mat_f else None
 
-    @functools.partial(jax.jit, static_argnums=1)
-    def mega_chain(ws, n, salt):
-        return jax.lax.fori_loop(0, n, lambda i, w_: compiled.step(w_),
-                                 ws + salt.astype(ws.dtype))
+    @functools.partial(jax.jit, static_argnums=2)
+    def mega_chain(ws, wsm, n, salt):
+        return jax.lax.fori_loop(
+            0, n, lambda i, w_: compiled.step(w_, wsm=wsm),
+            ws + salt.astype(ws.dtype))
 
     # ---- eager chain: identical math, x carried ------------------------
     def cast(t):
@@ -230,7 +235,7 @@ def main():
               + 3 * hidden * ffn) * jnp.dtype(wdt).itemsize * args.layers
     floor_s = wbytes / 2.5e12
     t_mega, t_eager = per_step_seconds_interleaved(
-        [lambda n, s_: mega_chain(ws0, n, s_),
+        [lambda n, s_: mega_chain(ws0, wsm0, n, s_),
          lambda n, s_: eager_chain(xj, n, s_)],
         [mega_lengths, eager_lengths], floor_s=floor_s)
 

@@ -294,9 +294,9 @@ def main() -> int:
 
     # MegaKernel: a full decode step in one launch (fp32 + bf16).
     from triton_distributed_tpu.megakernel.models import (
-        broadcast_rows, build_decode_step, rope_tables,
+        broadcast_rows, build_decode_step, feed_layer_weights, rope_tables,
     )
-    from triton_distributed_tpu.megakernel.tasks import TILE
+    from triton_distributed_tpu.megakernel.tasks import TILE, MatHandle
 
     def mega(dtype):
         hidden, hq, hkv, ffn, S, pos = 256, 2, 1, 256, 256, 100
@@ -312,18 +312,22 @@ def main() -> int:
                  h.attn_norm: broadcast_rows(ones),
                  h.mlp_norm: broadcast_rows(ones),
                  h.q_norm: broadcast_rows(np.ones(TILE, np.float32)),
-                 h.k_norm: broadcast_rows(np.ones(TILE, np.float32)),
-                 h.wq: rng.standard_normal((hidden, hq * TILE)) * 0.05,
-                 h.wk: rng.standard_normal((hidden, hkv * TILE)) * 0.05,
-                 h.wv: rng.standard_normal((hidden, hkv * TILE)) * 0.05,
-                 h.wo: rng.standard_normal((hq * TILE, hidden)) * 0.05,
-                 h.w_gate: rng.standard_normal((hidden, ffn)) * 0.05,
-                 h.w_up: rng.standard_normal((hidden, ffn)) * 0.05,
-                 h.w_down: rng.standard_normal((ffn, hidden)) * 0.05}
+                 h.k_norm: broadcast_rows(np.ones(TILE, np.float32))}
+        feed_layer_weights(
+            feeds, h,
+            wq=rng.standard_normal((hidden, hq * TILE)) * 0.05,
+            wk=rng.standard_normal((hidden, hkv * TILE)) * 0.05,
+            wv=rng.standard_normal((hidden, hkv * TILE)) * 0.05,
+            wo=rng.standard_normal((hq * TILE, hidden)) * 0.05,
+            w_gate=rng.standard_normal((hidden, ffn)) * 0.05,
+            w_up=rng.standard_normal((hidden, ffn)) * 0.05,
+            w_down=rng.standard_normal((ffn, hidden)) * 0.05)
         for tk, tv in zip(h.kT, h.v):
             feeds[tk] = rng.standard_normal((TILE, S)) * 0.3
             feeds[tv] = rng.standard_normal((S, TILE)) * 0.3
-        feeds = {kk_: jnp.asarray(np.asarray(vv_, np.float32))
+        feeds = {kk_: (tuple(jnp.asarray(np.asarray(x_, np.float32))
+                             for x_ in vv_) if isinstance(vv_, tuple)
+                       else jnp.asarray(np.asarray(vv_, np.float32)))
                  for kk_, vv_ in feeds.items()}
         (out,) = comp.run(feeds, outputs=[prog.x_out])
         assert np.isfinite(np.asarray(out, np.float32)).all()
@@ -412,6 +416,8 @@ def main() -> int:
                 for hh in h_:
                     feeds[hh] = rng.standard_normal(
                         (hh.rows, hh.cols)) * 0.1
+            elif isinstance(h_, MatHandle):
+                feeds[h_] = rng.standard_normal((h_.k, h_.n)) * 0.1
             else:
                 feeds[h_] = rng.standard_normal((h_.rows, h_.cols)) * 0.1
         feeds = {h_: jnp.asarray(np.asarray(v_, np.float32))

@@ -34,16 +34,17 @@ import numpy as np  # noqa: E402
 
 from triton_distributed_tpu.megakernel.builder import MegaKernelBuilder  # noqa: E402
 from triton_distributed_tpu.megakernel.models import rope_tables  # noqa: E402
-from triton_distributed_tpu.megakernel.tasks import TILE  # noqa: E402
+from triton_distributed_tpu.megakernel.tasks import TILE, MatHandle  # noqa: E402
 
 
-def time_replays(compiled, ws0, lengths, trials=5):
+def time_replays(compiled, ws0, wsm0, lengths, trials=5):
     """min-of-trials wall time of R queue replays, per R in lengths."""
 
-    @functools.partial(jax.jit, static_argnums=1)
-    def chain(ws, r, salt):
-        return jax.lax.fori_loop(0, r, lambda i, w_: compiled.step(w_),
-                                 ws + salt.astype(ws.dtype))
+    @functools.partial(jax.jit, static_argnums=2)
+    def chain(ws, wsm, r, salt):
+        return jax.lax.fori_loop(
+            0, r, lambda i, w_: compiled.step(w_, wsm=wsm),
+            ws + salt.astype(ws.dtype))
 
     t = {r: float("inf") for r in lengths}
     salt = [0]
@@ -51,7 +52,7 @@ def time_replays(compiled, ws0, lengths, trials=5):
     def once(r):
         salt[0] += 1
         t0 = time.perf_counter()
-        out = chain(ws0, r, jnp.float32(salt[0] * 1e-6))
+        out = chain(ws0, wsm0, r, jnp.float32(salt[0] * 1e-6))
         _ = np.asarray(jnp.sum(out))
         return time.perf_counter() - t0
 
@@ -63,8 +64,8 @@ def time_replays(compiled, ws0, lengths, trials=5):
     return t
 
 
-def per_task_seconds(compiled, ws0, n_tasks, lengths):
-    t = time_replays(compiled, ws0, lengths)
+def per_task_seconds(compiled, ws0, wsm0, n_tasks, lengths):
+    t = time_replays(compiled, ws0, wsm0, lengths)
     r1, r2, r3 = lengths
     t1, t2, t3 = t[r1], t[r2], t[r3]
     if not (t3 > t2 > t1):
@@ -90,11 +91,18 @@ def build_case(name, emit, L, feeds_fn, dtype):
             for hh in h:
                 feeds[hh] = rng.standard_normal(
                     (hh.rows, hh.cols)).astype(np.float32) * 0.05
+        elif isinstance(h, MatHandle):
+            mk = lambda: rng.standard_normal(
+                (h.k, h.n)).astype(np.float32) * 0.05
+            feeds[h] = (mk(), mk()) if h.pair else mk()
         else:
             feeds[h] = rng.standard_normal(
                 (h.rows, h.cols)).astype(np.float32) * 0.05
-    return compiled, compiled.make_workspace(
-        {k: jnp.asarray(v) for k, v in feeds.items()})
+    main, _w8, wm = compiled.split_feeds(feeds)
+    ws = compiled.make_workspace(
+        {k: jnp.asarray(v) for k, v in main.items()})
+    wsm = compiled.make_workspace_mat(wm) if wm else None
+    return compiled, ws, wsm
 
 
 def main():
@@ -121,7 +129,32 @@ def main():
     def add_case(name, count_per_layer, lengths, emit, feeds_fn):
         cases.append((name, count_per_layer, lengths, emit, feeds_fn))
 
-    # -- GEMM_WIDE at the layer's four shapes -------------------------------
+    # -- GEMM_MAT at the layer's four shapes (round-5 matrix path) ----------
+    def mat_feeds(k, n, pair=False, resid=False):
+        def f(mb):
+            h = {"a": mb.tensor(TILE, k),
+                 "w": mb.tensor_mat(k, n, pair=pair),
+                 "o": mb.tensor(TILE, n)}
+            if resid:
+                h["r"] = mb.tensor(TILE, n)
+            return h
+        return f
+
+    def mat_emit(mb, h):
+        mb.gemm_mat(h["o"], h["a"], h["w"], residual=h.get("r"))
+
+    qkv_n = (hq + 2 * hkv) * d
+    add_case(f"qkv_mat fused ({qkv_n} out)", 1,
+             lengths_heavy, mat_emit, mat_feeds(hidden, qkv_n))
+    add_case(f"gateup_mat pair+silu ({ffn} act)", 1,
+             lengths_heavy, mat_emit, mat_feeds(hidden, ffn, pair=True))
+    add_case("down_mat +resid", 1,
+             lengths_heavy, mat_emit, mat_feeds(ffn, hidden, resid=True))
+    add_case("o_mat +resid", 1,
+             lengths_heavy, mat_emit, mat_feeds(hq * d, hidden, resid=True))
+
+    # -- legacy GEMM_WIDE (tile path) for comparison (0/layer in the
+    # matrix-path decode assembly) -----------------------------------------
     def gemm_feeds(kt, nt):
         def f(mb):
             return {"a": mb.tensor(TILE, kt * TILE),
@@ -132,16 +165,8 @@ def main():
     def gemm_emit(mb, h):
         mb.gemm(h["o"], h["a"], h["b"])
 
-    add_case(f"gemm k={ht} w=8 (gate/up, {ft}t out)", 2 * (ft + 7) // 8,
+    add_case(f"gemm k={ht} w=8 legacy (gate-shape)", 0,
              lengths_heavy, gemm_emit, gemm_feeds(ht, ft))
-    add_case(f"gemm k={ft} w=8 (down, {ht}t out)", (ht + 7) // 8,
-             lengths_heavy, gemm_emit, gemm_feeds(ft, ht))
-    add_case(f"gemm k={hq} w=8 (o-proj)", (ht + 7) // 8,
-             lengths_heavy, gemm_emit, gemm_feeds(hq, ht))
-    add_case(f"gemm k={ht} w={hq} (wq)", 1,
-             lengths_heavy, gemm_emit, gemm_feeds(ht, hq))
-    add_case(f"gemm k={ht} w={hkv} (wk/wv)", 2,
-             lengths_heavy, gemm_emit, gemm_feeds(ht, hkv))
 
     # -- RMS_NORM / elementwise over the hidden row -------------------------
     def row_feeds(mb):
@@ -198,8 +223,8 @@ def main():
     total = 0.0
     rows = []
     for name, count, lengths, emit, feeds_fn in cases:
-        compiled, ws0 = build_case(name, emit, L, feeds_fn, dtype)
-        per, err = per_task_seconds(compiled, ws0, L, lengths)
+        compiled, ws0, wsm0 = build_case(name, emit, L, feeds_fn, dtype)
+        per, err = per_task_seconds(compiled, ws0, wsm0, L, lengths)
         if per is None:
             print(f"{name:36} UNRELIABLE ({err})")
             rows.append((name, count, None))

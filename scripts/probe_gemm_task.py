@@ -1,0 +1,284 @@
+"""On-chip probe: which inner-loop structure lets a megakernel GEMM task
+reach the HBM roofline?
+
+Round-5 megakernel attribution (scripts/mk_profile.py): the gate/up
+GEMM_WIDE task measures ~61 us against a ~15 us weight-streaming roofline
+at (128, 4096) @ (4096, 1536) bf16.  Hypothesis: the statically-unrolled
+PREDICATED 128x128x128 dot pile (4-row super-strip x width @pl.when dots
+per k-step, ~384 predicated dots per task) is the bound, not the DMA
+schedule.  This probe times three bodies, all streaming B from HBM with a
+depth-2 double buffer:
+
+  tiles  — B as (T, 128, 128) tile-of-tiles, 4-row super-strips,
+           per-(r, w) predicated 128^3 dots   (= current GEMM_WIDE body)
+  ktile  — B as a 2D (K, N) matrix, (512, 1024)-row chunk fetches,
+           per-k-tile (128,128)@(128,1024) dots (A stays in tile form)
+  mat    — same fetches, A resident as a (128, K) matrix,
+           per-chunk (128,512)@(512,1024) dots (fewest, deepest dots)
+
+    TDTPU_BENCH_ON_TPU=1 python scripts/probe_gemm_task.py
+"""
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "benchmark"))
+
+from _common import bootstrap  # noqa: E402
+
+jax, ON_TPU = bootstrap(n_devices=1)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.experimental import pallas as pl  # noqa: E402
+from jax.experimental.pallas import tpu as pltpu  # noqa: E402
+
+TILE = 128
+if ON_TPU:
+    K, N = 4096, 1536          # gate/up per-device shard shape
+else:
+    K, N = 512, 256
+KT, NT = K // TILE, N // TILE
+KCH = min(512, K)              # B chunk rows for the matrix bodies
+NSTRIP = 1024 if N % 1024 == 0 or N > 1024 else N
+NS = -(-N // NSTRIP)           # strips per matrix
+
+
+def _tiles_kernel(a_ref, b_ref, o_ref, vrow, vbw, vacc, vout, csem, psems):
+    """Current GEMM_WIDE structure: super-strips + predicated 128^3 dots."""
+    width = NT
+    # resident A row (chunked DMAs)
+    ch = min(8, KT)
+    nc = (KT + ch - 1) // ch
+
+    def ld(c):
+        return pltpu.make_async_copy(a_ref.at[pl.ds(c * ch, ch)],
+                                     vrow.at[pl.ds(c * ch, ch)], csem)
+    for c in range(nc):
+        ld(c).start()
+    for c in range(nc):
+        ld(c).wait()
+    vacc[...] = jnp.zeros_like(vacc)
+    n_steps = KT // 4
+
+    def sdesc(j, slot):
+        return pltpu.make_async_copy(
+            b_ref.at[pl.ds(j * 4 * width, 4 * width)],
+            vbw.at[slot], psems.at[slot])
+
+    sdesc(0, 0).start()
+
+    @pl.when(n_steps > 1)
+    def _():
+        sdesc(1, 1).start()
+
+    def jbody(j, _):
+        slot = jax.lax.rem(j, 2)
+        sdesc(j, slot).wait()
+        for r in range(4):
+            a_t = vrow[4 * j + r]
+            for w in range(width):
+                @pl.when(w < width)   # predication as in the real kernel
+                def _(w=w, r=r, a_t=a_t):
+                    vacc[w, :, :] = vacc[w] + jnp.dot(
+                        a_t, vbw[slot, r * width + w],
+                        preferred_element_type=jnp.float32)
+
+        @pl.when(j + 2 < n_steps)
+        def _():
+            sdesc(j + 2, jax.lax.rem(j + 2, 2)).start()
+        return 0
+
+    jax.lax.fori_loop(0, n_steps, jbody, 0)
+    for w in range(width):
+        vout[w, :, :] = vacc[w].astype(o_ref.dtype)
+    cp = pltpu.make_async_copy(vout, o_ref, csem)
+    cp.start()
+    cp.wait()
+
+
+def _ktile_kernel(a_ref, b_ref, o_ref, vrow, vbm, vacc, vout, csem, psems):
+    """Matrix-B chunks, per-k-tile (128,128)@(128,NSTRIP) dots."""
+    ch = min(8, KT)
+    nc = (KT + ch - 1) // ch
+
+    def ld(c):
+        return pltpu.make_async_copy(a_ref.at[pl.ds(c * ch, ch)],
+                                     vrow.at[pl.ds(c * ch, ch)], csem)
+    for c in range(nc):
+        ld(c).start()
+    for c in range(nc):
+        ld(c).wait()
+    n_ch = K // KCH
+
+    for s in range(NS):
+        def sdesc(j, slot, s=s):
+            return pltpu.make_async_copy(
+                b_ref.at[pl.ds((s * n_ch + j) * KCH, KCH)],
+                vbm.at[slot], psems.at[slot])
+
+        sdesc(0, 0).start()
+
+        @pl.when(n_ch > 1)
+        def _(s=s):
+            sdesc(1, 1).start()
+
+        vacc[...] = jnp.zeros_like(vacc)
+
+        def jbody(j, _, s=s):
+            slot = jax.lax.rem(j, 2)
+            sdesc(j, slot).wait()
+            for q in range(KCH // TILE):
+                vacc[...] += jnp.dot(
+                    vrow[j * (KCH // TILE) + q],
+                    vbm[slot, pl.ds(q * TILE, TILE), :],
+                    preferred_element_type=jnp.float32)
+
+            @pl.when(j + 2 < n_ch)
+            def _():
+                sdesc(j + 2, jax.lax.rem(j + 2, 2)).start()
+            return 0
+
+        jax.lax.fori_loop(0, n_ch, jbody, 0)
+        vout[...] = vacc[...].astype(o_ref.dtype)
+        cp = pltpu.make_async_copy(
+            vout, o_ref.at[:, pl.ds(s * NSTRIP, NSTRIP)], csem)
+        cp.start()
+        cp.wait()
+
+
+def _mat_kernel(a_ref, b_ref, o_ref, vam, vbm, vacc, vout, csem, psems):
+    """Matrix A and B: per-chunk (128, KCH)@(KCH, NSTRIP) dots."""
+    for q in range(KT):   # A tiles -> matrix columns, all DMAs in flight
+        pltpu.make_async_copy(a_ref.at[q], vam.at[:, pl.ds(q * TILE, TILE)],
+                              psems.at[2]).start()
+    for q in range(KT):
+        pltpu.make_async_copy(a_ref.at[q], vam.at[:, pl.ds(q * TILE, TILE)],
+                              psems.at[2]).wait()
+    n_ch = K // KCH
+
+    for s in range(NS):
+        def sdesc(j, slot, s=s):
+            return pltpu.make_async_copy(
+                b_ref.at[pl.ds((s * n_ch + j) * KCH, KCH)],
+                vbm.at[slot], psems.at[slot])
+
+        sdesc(0, 0).start()
+
+        @pl.when(n_ch > 1)
+        def _(s=s):
+            sdesc(1, 1).start()
+
+        vacc[...] = jnp.zeros_like(vacc)
+
+        def jbody(j, _, s=s):
+            slot = jax.lax.rem(j, 2)
+            sdesc(j, slot).wait()
+            vacc[...] += jnp.dot(
+                vam[:, pl.ds(j * KCH, KCH)], vbm[slot],
+                preferred_element_type=jnp.float32)
+
+            @pl.when(j + 2 < n_ch)
+            def _():
+                sdesc(j + 2, jax.lax.rem(j + 2, 2)).start()
+            return 0
+
+        jax.lax.fori_loop(0, n_ch, jbody, 0)
+        vout[...] = vacc[...].astype(o_ref.dtype)
+        cp = pltpu.make_async_copy(
+            vout, o_ref.at[:, pl.ds(s * NSTRIP, NSTRIP)], csem)
+        cp.start()
+        cp.wait()
+
+
+def build(kind):
+    dt = jnp.bfloat16
+    any_ = pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY)
+    if kind == "tiles":
+        kernel, b_shape = _tiles_kernel, (KT * NT, TILE, TILE)
+        scratch = [pltpu.VMEM((KT, TILE, TILE), dt),
+                   pltpu.VMEM((2, 4 * NT, TILE, TILE), dt),
+                   pltpu.VMEM((NT, TILE, TILE), jnp.float32),
+                   pltpu.VMEM((NT, TILE, TILE), dt)]
+        o_shape = (NT, TILE, TILE)
+    elif kind == "ktile":
+        kernel, b_shape = _ktile_kernel, (NS * K, NSTRIP)
+        scratch = [pltpu.VMEM((KT, TILE, TILE), dt),
+                   pltpu.VMEM((2, KCH, NSTRIP), dt),
+                   pltpu.VMEM((TILE, NSTRIP), jnp.float32),
+                   pltpu.VMEM((TILE, NSTRIP), dt)]
+        o_shape = (TILE, NS * NSTRIP)
+    else:
+        kernel, b_shape = _mat_kernel, (NS * K, NSTRIP)
+        scratch = [pltpu.VMEM((TILE, K), dt),
+                   pltpu.VMEM((2, KCH, NSTRIP), dt),
+                   pltpu.VMEM((TILE, NSTRIP), jnp.float32),
+                   pltpu.VMEM((TILE, NSTRIP), dt)]
+        o_shape = (TILE, NS * NSTRIP)
+    scratch += [pltpu.SemaphoreType.DMA(()), pltpu.SemaphoreType.DMA((3,))]
+
+    f = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=0, grid=(1,), in_specs=[any_, any_],
+            out_specs=any_, scratch_shapes=scratch),
+        out_shape=jax.ShapeDtypeStruct(o_shape, dt),
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        interpret=not ON_TPU,
+    )
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.standard_normal((KT, TILE, TILE)) * 0.1, dt)
+    b = jnp.asarray(rng.standard_normal(b_shape) * 0.1, dt)
+
+    @functools.partial(jax.jit, static_argnums=2)
+    def chain(a, b, n):
+        def body(i, cur):
+            o = f(cur, b)
+            # fold a scalar of the output back into A: data dependency
+            s = (o[0, 0, 0] if o.ndim == 3 else o[0, 0]).astype(a.dtype)
+            return cur + s * 1e-6
+
+        return jax.lax.fori_loop(0, n, body, a)
+
+    return chain, a, b
+
+
+def time_kind(kind, lengths=(64, 320, 576), trials=5):
+    chain, a, b = build(kind)
+    t = {n: float("inf") for n in lengths}
+    for n in lengths:
+        jax.block_until_ready(chain(a, b, n))
+    for _ in range(trials):
+        for n in lengths:
+            t0 = time.perf_counter()
+            _ = np.asarray(jnp.sum(chain(a, b, n)))
+            t[n] = min(t[n], time.perf_counter() - t0)
+    n1, n2, n3 = lengths
+    d21 = (t[n2] - t[n1]) / (n2 - n1)
+    d32 = (t[n3] - t[n2]) / (n3 - n2)
+    per = (t[n3] - t[n1]) / (n3 - n1)
+    ok = t[n3] > t[n2] > t[n1] and 0.33 < d21 / max(d32, 1e-12) < 3.0
+    return per, ok, (d21, d32)
+
+
+def main():
+    gb = KT * NT * TILE * TILE * 2 / 1e9
+    gb_pad = NS * K * NSTRIP * 2 / 1e9   # ktile/mat stream strip padding
+    print(f"# ({TILE},{K}) @ ({K},{N}) bf16; B bytes {gb*1e3:.1f} MB "
+          f"(~{gb/0.819*1e6:.1f} us roofline); ktile/mat stream "
+          f"{gb_pad*1e3:.1f} MB incl. strip pad "
+          f"(~{gb_pad/0.819*1e6:.1f} us) "
+          f"({'TPU' if ON_TPU else 'CPU smoke'})")
+    for kind in ("tiles", "ktile", "mat"):
+        per, ok, (d21, d32) = time_kind(kind)
+        flag = "" if ok else "  [INCONSISTENT]"
+        print(f"{kind:6} {per*1e6:9.2f} us/iter  "
+              f"(d21 {d21*1e6:.2f} d32 {d32*1e6:.2f}){flag}")
+
+
+if __name__ == "__main__":
+    main()

@@ -10,10 +10,16 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu.megakernel.models import (
-    broadcast_rows, build_decode_step, rope_tables,
+    broadcast_rows, build_decode_step, feed_layer_weights, rope_tables,
 )
 from triton_distributed_tpu.megakernel.tasks import TILE
 from triton_distributed_tpu.runtime import shard_map_on
+
+
+def _j(v):
+    """asarray that passes (gate, up) pair-feed tuples through."""
+    return (tuple(jnp.asarray(x) for x in v) if isinstance(v, tuple)
+            else jnp.asarray(v))
 
 
 def _golden_layer(x, w, pos, kT, v, hq, hkv, eps=1e-6):
@@ -82,9 +88,10 @@ def _feed_layer(prog, h, w, kT_np, v_np):
         h.mlp_norm: broadcast_rows(w["mlp_norm"]),
         h.q_norm: broadcast_rows(w["q_norm"]),
         h.k_norm: broadcast_rows(w["k_norm"]),
-        h.wq: w["wq"], h.wk: w["wk"], h.wv: w["wv"], h.wo: w["wo"],
-        h.w_gate: w["w_gate"], h.w_up: w["w_up"], h.w_down: w["w_down"],
     }
+    feed_layer_weights(feeds, h, wq=w["wq"], wk=w["wk"], wv=w["wv"],
+                       wo=w["wo"], w_gate=w["w_gate"], w_up=w["w_up"],
+                       w_down=w["w_down"])
     for i, (tk, tv) in enumerate(zip(h.kT, h.v)):
         feeds[tk] = kT_np[i]
         feeds[tv] = v_np[i]
@@ -108,7 +115,7 @@ def test_decode_step_single_device():
     compiled = prog.mb.compile()
     feeds = {prog.x: jnp.asarray(x), prog.cos: jnp.asarray(w["cos_full"]),
              prog.sin: jnp.asarray(w["sin_full"])}
-    feeds.update({k: jnp.asarray(val) for k, val in
+    feeds.update({k: _j(val) for k, val in
                   _feed_layer(prog, prog.layers[0], w, kT_np, v_np).items()})
     out, k_new, v_new = compiled.run(
         feeds, outputs=[prog.x_out, prog.layers[0].k_new,
@@ -144,7 +151,7 @@ def test_decode_step_bf16_workspace():
     compiled = prog.mb.compile(dtype=jnp.bfloat16)
     feeds = {prog.x: jnp.asarray(x), prog.cos: jnp.asarray(w["cos_full"]),
              prog.sin: jnp.asarray(w["sin_full"])}
-    feeds.update({k: jnp.asarray(val) for k, val in
+    feeds.update({k: _j(val) for k, val in
                   _feed_layer(prog, prog.layers[0], w, kT_np, v_np).items()})
     (out,) = compiled.run(feeds, outputs=[prog.x_out])
     assert out.dtype == jnp.bfloat16
@@ -184,7 +191,7 @@ def test_decode_queue_reuse_across_positions():
                                                            pos))
         feeds = {prog.x: jnp.asarray(x), prog.cos: jnp.asarray(cos_full),
                  prog.sin: jnp.asarray(sin_full)}
-        feeds.update({k: jnp.asarray(val) for k, val in _feed_layer(
+        feeds.update({k: _j(val) for k, val in _feed_layer(
             prog, prog.layers[0], w, kT_np, v_np).items()})
         (out,) = step.run(feeds, outputs=[prog.x_out])
 
@@ -285,9 +292,11 @@ def test_paged_decode_step_matches_linear():
                  h.attn_norm: ones_h, h.mlp_norm: ones_h,
                  h.q_norm: ones_d, h.k_norm: ones_d,
                  h.kT[0]: feed_vals["kT"], h.v[0]: feed_vals["v"]}
-        for name, val in feed_vals["w"].items():
-            feeds[getattr(h, name)] = val
-        feeds = {k_: jnp.asarray(np.asarray(v_, np.float32))
+        feed_layer_weights(feeds, h, **{
+            n_: np.asarray(v_, np.float32)
+            for n_, v_ in feed_vals["w"].items()})
+        feeds = {k_: _j(v_) if isinstance(v_, tuple)
+                 else jnp.asarray(np.asarray(v_, np.float32))
                  for k_, v_ in feeds.items()}
         (out,) = comp.run(feeds, outputs=[prog.x_out])
         return np.asarray(out)
@@ -352,7 +361,7 @@ def test_decode_step_moe_single_device():
     base[h.moe_w_gate] = wg.reshape(E * hidden, ffn)
     base[h.moe_w_up] = wu.reshape(E * hidden, ffn)
     base[h.moe_w_down] = wd.reshape(E * ffn, hidden)
-    feeds.update({k: jnp.asarray(val) for k, val in base.items()})
+    feeds.update({k: _j(val) for k, val in base.items()})
     out, = compiled.run(feeds, outputs=[prog.x_out])
 
     # Golden: attention part from _golden_layer with zeroed FFN, plus the
@@ -433,9 +442,11 @@ def test_decode_step_moe_tp2_virtual_mesh():
         devices=jax.devices()[:n], axis_names=("tp",))
 
     def local(*vals):
-        ws = compiled.make_workspace(
+        main, _w8, wm = compiled.split_feeds(
             {k: v[0] for k, v in zip(keys, vals)})
-        ws = compiled.step(ws)
+        ws = compiled.make_workspace(main)
+        wsm = compiled.make_workspace_mat(wm) if wm else None
+        ws = compiled.step(ws, wsm=wsm)
         return compiled.gather_output(ws, prog.x_out)[None]
 
     out = shard_map_on(ctx, local, tuple(P("tp") for _ in keys),

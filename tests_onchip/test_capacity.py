@@ -99,7 +99,7 @@ def test_megakernel_decode_qwen3_shard_shapes():
     from triton_distributed_tpu.megakernel.models import (
         build_decode_step, rope_tables,
     )
-    from triton_distributed_tpu.megakernel.tasks import TILE
+    from triton_distributed_tpu.megakernel.tasks import TILE, MatHandle
 
     rng = np.random.default_rng(3)
     prog = build_decode_step(hidden=4096, hq_local=4, hkv_local=1,
@@ -119,9 +119,15 @@ def test_megakernel_decode_qwen3_shard_shapes():
         if isinstance(hh, list):
             for t in hh:
                 feeds[t] = rng.standard_normal((t.rows, t.cols)) * 0.05
+        elif isinstance(hh, MatHandle):
+            feeds[hh] = (tuple(rng.standard_normal((hh.k, hh.n)) * 0.05
+                               for _ in range(2)) if hh.pair
+                         else rng.standard_normal((hh.k, hh.n)) * 0.05)
         else:
             feeds[hh] = rng.standard_normal((hh.rows, hh.cols)) * 0.05
-    feeds = {k: jnp.asarray(np.asarray(v, np.float32))
+    feeds = {k: (tuple(jnp.asarray(np.asarray(x, np.float32)) for x in v)
+                 if isinstance(v, tuple)
+                 else jnp.asarray(np.asarray(v, np.float32)))
              for k, v in feeds.items()}
     (out,) = compiled.run(feeds, outputs=[prog.x_out])
     assert np.isfinite(np.asarray(out, np.float32)).all()

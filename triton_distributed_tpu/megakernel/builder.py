@@ -27,7 +27,8 @@ import numpy as np
 from triton_distributed_tpu.megakernel.kernel import run_queue
 from triton_distributed_tpu.megakernel.scheduler import topo_schedule
 from triton_distributed_tpu.megakernel.tasks import (
-    TILE, WORDS, Task, TaskType, TensorHandle,
+    MAT_COLS, TILE, WORDS, MatHandle, MatSpec, Task, TaskType, TensorHandle,
+    mat_chunk_rows,
 )
 
 
@@ -40,10 +41,14 @@ class MegaKernelBuilder:
     # in a separate space, so dependency bookkeeping must not collide them
     # with main-workspace ids.
     _W8_HAZARD = 1 << 30
+    # Same for 2D matrix-workspace rows (GEMM_MAT B operands).
+    _WM_HAZARD = 1 << 29
 
     def __init__(self):
         self._num_tiles = 0
         self._num_tiles8 = 0
+        self._num_mrows = 0
+        self._mat_specs: list[MatSpec] = []
         self._tasks: list[Task] = []
         self._edges: list[tuple[int, int]] = []
         self._last_writer: dict[int, int] = {}
@@ -70,6 +75,18 @@ class MegaKernelBuilder:
             return h
         h = TensorHandle(self._num_tiles, rows, cols)
         self._num_tiles += h.rt * h.ct
+        return h
+
+    def tensor_mat(self, k: int, n: int, pair: bool = False) -> MatHandle:
+        """A (k, n) weight matrix in the 2D MATRIX workspace (GEMM_MAT B
+        operand; ``pair=True`` = interleaved gate|up layout, n per half —
+        see tasks.py MatHandle)."""
+        if k % TILE or n % TILE:
+            raise ValueError(f"dims must be multiples of {TILE}, got "
+                             f"({k}, {n})")
+        mat_chunk_rows(k)   # raises early on an unchunkable K
+        h = MatHandle(self._num_mrows, k, n, pair=pair)
+        self._num_mrows += h.rows
         return h
 
     @staticmethod
@@ -215,6 +232,53 @@ class MegaKernelBuilder:
                 self._max_row = max(getattr(self, "_max_row", 1), kt)
                 first = False
                 j += wd
+
+    def gemm_mat(self, out: TensorHandle, a: TensorHandle, w: MatHandle,
+                 residual: TensorHandle | None = None):
+        """out (TILE, N) = a (TILE, K) @ w — ONE task over the 2D matrix
+        workspace, compiled as a STATIC specialized branch (see tasks.py
+        GEMM_MAT). ``w.pair``: w holds interleaved gate|up halves and the
+        task stores silu(gate_half) * up_half (the fused gate/up/act path —
+        out is the (TILE, w.n) activation). ``residual``: fuse ``+=
+        residual`` into the store (mutually exclusive with pair)."""
+        self._no_fp8(out, a, residual)
+        if not isinstance(w, MatHandle):
+            raise TypeError("gemm_mat weight must be a tensor_mat handle")
+        if a.rt != 1 or out.rt != 1:
+            raise ValueError("gemm_mat operates on single activation rows")
+        if a.cols != w.k or out.cols != w.n:
+            raise ValueError(
+                f"gemm_mat shape mismatch: a ({a.rows},{a.cols}) @ w "
+                f"({w.k},{w.n}{' pair' if w.pair else ''}) -> out "
+                f"({out.rows},{out.cols})")
+        if w.pair and residual is not None:
+            raise ValueError("pair (silu) and residual epilogues are "
+                             "mutually exclusive")
+        if residual is not None and (residual.rt != 1
+                                     or residual.cols != out.cols):
+            # An unchecked narrower residual would read tiles of whatever
+            # tensor was allocated after it and silently add garbage.
+            raise ValueError(
+                f"residual ({residual.rows},{residual.cols}) must match "
+                f"out ({out.rows},{out.cols})")
+        epi = 1 if w.pair else (2 if residual is not None else 0)
+        spec = MatSpec(kt=a.ct, ns=w.n_strips, nt_out=out.ct,
+                       kch=mat_chunk_rows(w.k), epi=epi)
+        try:
+            si = self._mat_specs.index(spec)
+        except ValueError:
+            si = len(self._mat_specs)
+            self._mat_specs.append(spec)
+        reads = [a.tile(0, q) for q in range(a.ct)]
+        reads.append(self._WM_HAZARD + w.base)
+        if residual is not None:
+            reads += [residual.tile(0, q) for q in range(out.ct)]
+        self._emit(
+            Task(TaskType.GEMM_MAT, out.tile(0, 0), a0=a.tile(0, 0),
+                 b0=w.base, k_tiles=a.ct, a_stride=si, arg=epi,
+                 c0=residual.tile(0, 0) if residual is not None else 0),
+            reads, [out.tile(0, j) for j in range(out.ct)])
+        self._max_row = max(getattr(self, "_max_row", 1), a.ct, out.ct)
 
     def norm_rope(self, out: TensorHandle, a: TensorHandle,
                   w: TensorHandle, cos: TensorHandle, sin: TensorHandle,
@@ -566,7 +630,9 @@ class MegaKernelBuilder:
                                   max_moe_h=getattr(self, "_max_moe_h", 0),
                                   max_moe_f=getattr(self, "_max_moe_f", 0),
                                   max_row=getattr(self, "_max_row", 1),
-                                  max_strip=getattr(self, "_max_strip", 1))
+                                  max_strip=getattr(self, "_max_strip", 1),
+                                  num_mrows=self._num_mrows,
+                                  mat_specs=tuple(self._mat_specs))
 
 
 @dataclasses.dataclass
@@ -586,6 +652,8 @@ class CompiledMegaKernel:
     max_moe_f: int = 0            # MoE ffn_local tiles
     max_row: int = 1              # widest resident row (tiles)
     max_strip: int = 1            # widest strip fetch (tiles)
+    num_mrows: int = 0            # 2D matrix-workspace rows (0 = unused)
+    mat_specs: tuple = ()         # static GEMM_MAT shapes (kernel branches)
 
     def scatter_input(self, ws: jax.Array, h: TensorHandle,
                       value: jax.Array) -> jax.Array:
@@ -626,11 +694,67 @@ class CompiledMegaKernel:
         ws = jnp.zeros((max(self.num_tiles, 1) + self._strip_pad,
                         TILE, TILE), self.dtype)
         for h, v in inputs.items():
+            if isinstance(h, MatHandle):
+                raise ValueError("matrix handle in main workspace feeds — "
+                                 "pass it to make_workspace_mat (or use "
+                                 "split_feeds)")
             if h.fp8:
                 raise ValueError("fp8 handle in main workspace feeds — "
                                  "pass it to make_workspace8")
             ws = self.scatter_input(ws, h, v)
         return ws
+
+    @staticmethod
+    def split_feeds(feeds: dict) -> tuple[dict, dict, dict]:
+        """Split a mixed feeds dict into (main, fp8, matrix) workspace
+        feeds — the one-liner every caller of make_workspace* wants."""
+        main = {h: v for h, v in feeds.items()
+                if not isinstance(h, MatHandle) and not h.fp8}
+        w8 = {h: v for h, v in feeds.items()
+              if not isinstance(h, MatHandle) and h.fp8}
+        wm = {h: v for h, v in feeds.items() if isinstance(h, MatHandle)}
+        return main, w8, wm
+
+    def scatter_mat(self, wsm: jax.Array, h: MatHandle,
+                    value) -> jax.Array:
+        """Write a weight matrix into the 2D matrix workspace. ``value``:
+        (k, n) array, or for ``h.pair`` a (first, second) tuple of (k, n)
+        arrays (gate, up) interleaved per strip."""
+        half = MAT_COLS // 2
+        if h.pair:
+            g, u = value
+            g = jnp.asarray(g, self.dtype)
+            u = jnp.asarray(u, self.dtype)
+            if g.shape != (h.k, h.n) or u.shape != (h.k, h.n):
+                raise ValueError(
+                    f"pair values must each be ({h.k}, {h.n})")
+            pad = h.n_strips * half - h.n
+            g = jnp.pad(g, ((0, 0), (0, pad)))
+            u = jnp.pad(u, ((0, 0), (0, pad)))
+            strips = [jnp.concatenate(
+                [g[:, s * half:(s + 1) * half],
+                 u[:, s * half:(s + 1) * half]], axis=1)
+                for s in range(h.n_strips)]
+        else:
+            v = jnp.asarray(value, self.dtype)
+            if v.shape != (h.k, h.n):
+                raise ValueError(f"value must be ({h.k}, {h.n})")
+            v = jnp.pad(v, ((0, 0), (0, h.n_strips * MAT_COLS - h.n)))
+            strips = [v[:, s * MAT_COLS:(s + 1) * MAT_COLS]
+                      for s in range(h.n_strips)]
+        return jax.lax.dynamic_update_slice(
+            wsm, jnp.concatenate(strips, axis=0), (h.base, 0))
+
+    def make_workspace_mat(self, inputs: dict) -> jax.Array:
+        """Build the 2D matrix weight workspace (read-only input of every
+        step; pair handles take (gate, up) value tuples)."""
+        wsm = jnp.zeros((max(self.num_mrows, 1), MAT_COLS), self.dtype)
+        for h, v in inputs.items():
+            if not isinstance(h, MatHandle):
+                raise ValueError("non-matrix handle in matrix workspace "
+                                 "feeds")
+            wsm = self.scatter_mat(wsm, h, v)
+        return wsm
 
     def make_workspace8(self, inputs: dict) -> jax.Array:
         """Build the float8_e4m3fn weight workspace (read-only input of
@@ -644,11 +768,13 @@ class CompiledMegaKernel:
         return ws8
 
     def step(self, ws: jax.Array, queue: jax.Array | None = None,
-             ws8: jax.Array | None = None) -> jax.Array:
+             ws8: jax.Array | None = None,
+             wsm: jax.Array | None = None) -> jax.Array:
         """One queue execution over a prebuilt workspace (jittable; pass an
         advance_queue_pos-updated ``queue`` to retarget without recompile).
         Device-local: wrap in shard_map when num_ranks > 1. ``ws8``: the
-        fp8 weight workspace when the program uses one."""
+        fp8 weight workspace when the program uses one; ``wsm``: the 2D
+        matrix weight workspace when the program has GEMM_MAT tasks."""
         if self.num_tiles8 and ws8 is None:
             # The placeholder run_queue substitutes is ONE tile — a W8
             # program would DMA weight tiles from out-of-bounds indices
@@ -656,20 +782,30 @@ class CompiledMegaKernel:
             raise ValueError(
                 f"program uses {self.num_tiles8} fp8 weight tiles but no "
                 "ws8 was passed — build it with make_workspace8")
+        if self.num_mrows and wsm is None:
+            raise ValueError(
+                f"program uses {self.num_mrows} matrix-workspace rows but "
+                "no wsm was passed — build it with make_workspace_mat")
         return run_queue(self.queue if queue is None else queue, ws,
                          num_ranks=self.num_ranks, axis=self.axis,
                          num_tasks=self.num_exec, max_gqa=self.max_gqa,
                          max_gemm_width=self.max_gemm_width,
                          workspace8=ws8, max_moe_h=self.max_moe_h,
                          max_moe_f=self.max_moe_f, max_row=self.max_row,
-                         max_strip=self.max_strip)
+                         max_strip=self.max_strip,
+                         workspace_m=wsm, mat_specs=self.mat_specs)
 
     def run(self, inputs: dict, outputs: list[TensorHandle],
             _device_local: bool = True):
         """Device-local execution (inside shard_map when num_ranks > 1).
-        fp8-space handles in ``inputs`` feed the weight workspace."""
-        main = {h: v for h, v in inputs.items() if not h.fp8}
-        w8 = {h: v for h, v in inputs.items() if h.fp8}
+        fp8-space handles in ``inputs`` feed the fp8 weight workspace;
+        MatHandle keys feed the 2D matrix workspace."""
+        main = {h: v for h, v in inputs.items()
+                if not h.fp8 and not isinstance(h, MatHandle)}
+        w8 = {h: v for h, v in inputs.items()
+              if h.fp8 and not isinstance(h, MatHandle)}
+        wm = {h: v for h, v in inputs.items() if isinstance(h, MatHandle)}
         ws8 = self.make_workspace8(w8) if w8 else None
-        ws = self.step(self.make_workspace(main), ws8=ws8)
+        wsm = self.make_workspace_mat(wm) if wm else None
+        ws = self.step(self.make_workspace(main), ws8=ws8, wsm=wsm)
         return [self.gather_output(ws, h) for h in outputs]

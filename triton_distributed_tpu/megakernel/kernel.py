@@ -28,18 +28,19 @@ from jax.experimental.pallas import tpu as pltpu
 from triton_distributed_tpu import language as dl
 from triton_distributed_tpu.language import shmem_device as shmem
 from triton_distributed_tpu.language.core import any_spec
-from triton_distributed_tpu.megakernel.tasks import TILE, WORDS
+from triton_distributed_tpu.megakernel.tasks import MAT_COLS, TILE, WORDS
 
 PIPE_DEPTH = 4  # outstanding tile-pair loads per task stream
 from triton_distributed_tpu.runtime.context import use_interpret
 
 
 def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
-                 max_gemm_width: int,
-                 queue_ref, ws_in, ws8, ws_out, slots, va2, vb2, vb8, vbw,
-                 vbw8, vacc, vq, vstat, vqg, vaccg, vstatg, vaccw,
+                 max_gemm_width: int, mat_specs: tuple, kch_max: int,
+                 queue_ref, ws_in, ws8, wm, ws_out, slots, va2, vb2, vb8,
+                 vbw, vbw8, vacc, vq, vstat, vqg, vaccg, vstatg, vaccw,
                  vaccw_wdt, vrow_a, vrow_b, vrow_o, vmoe_a, vmoe_b,
-                 vmoe_o, copy_sem, pipe_sems, send_sems, recv_sem):
+                 vmoe_o, vbm, vaccm, voutm,
+                 copy_sem, pipe_sems, send_sems, recv_sem):
     wdt = ws_out.dtype   # workspace dtype (fp32 or bf16); compute is fp32
     step = pl.program_id(0)
     # Double-buffer views: slot 0 is the default for unpipelined tasks.
@@ -823,19 +824,118 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
 
         jax.lax.fori_loop(0, ht, st, 0)
 
+    def _mat_body(sp):
+        """One STATIC specialized GEMM_MAT body (tasks.py GEMM_MAT): every
+        trip count, fetch size, dot shape, and store offset is a Python
+        constant from the spec — the probe-measured cure for the dynamic-
+        predication tax (scripts/probe_gemm_task.py). Fully unrolled."""
+        n_ch = sp.n_ch
+        total = sp.ns * n_ch
+        kq = sp.kch // TILE
+        spt = (MAT_COLS // 2 if sp.epi == 1 else MAT_COLS) // TILE
+
+        def body():
+            _row_load(a0, vrow_a, sp.kt)
+
+            def cdesc(t, slot):
+                dst = (vbm.at[slot] if sp.kch == kch_max
+                       else vbm.at[slot].at[pl.ds(0, sp.kch)])
+                # Row offset written as (x * 8) so Mosaic can prove the
+                # sublane-tiling divisibility of the dynamic base (every
+                # MatHandle base is a multiple of TILE = 128).
+                row = (b0 // 8 + t * (sp.kch // 8)) * 8
+                return pltpu.make_async_copy(
+                    wm.at[pl.ds(row, sp.kch)], dst,
+                    pipe_sems.at[slot * 2 + 1])
+
+            def rdesc(s, w_):
+                return pltpu.make_async_copy(
+                    ws_out.at[c0 + s * spt + w_], vrow_b.at[w_], copy_sem)
+
+            def odesc(s, w_):
+                return pltpu.make_async_copy(
+                    voutm.at[:, pl.ds(w_ * TILE, TILE)],
+                    ws_out.at[out + s * spt + w_], copy_sem)
+
+            cdesc(0, 0).start()
+            if total > 1:
+                cdesc(1, 1).start()
+            for t in range(total):
+                s, j = divmod(t, n_ch)
+                slot = t % 2
+                rw = min(spt, sp.nt_out - s * spt)
+                cdesc(t, slot).wait()
+                if sp.epi == 2 and j == 0:
+                    # residual strip tiles arrive under the dots
+                    for w_ in range(rw):
+                        rdesc(s, w_).start()
+                # fp32 workspaces ask for HIGHEST so the one-kernel step
+                # tracks the XLA jit golden (Mosaic's default f32 matmul
+                # is a single bf16 pass, ~1e-2 relative at K=1024 — the
+                # multi-pass matches XLA's f32 class). bf16 serving keeps
+                # the default: operands are bf16 either way.
+                prec = (jax.lax.Precision.HIGHEST
+                        if wdt == jnp.float32 else None)
+                for q in range(kq):
+                    d_ = jnp.dot(vrow_a[j * kq + q],
+                                 vbm[slot, pl.ds(q * TILE, TILE), :],
+                                 preferred_element_type=jnp.float32,
+                                 precision=prec)
+                    if j == 0 and q == 0:
+                        vaccm[...] = d_
+                    else:
+                        vaccm[...] = vaccm[...] + d_
+                if t + 2 < total:
+                    cdesc(t + 2, slot).start()
+                if j == n_ch - 1:
+                    if sp.epi == 1:
+                        half = MAT_COLS // 2
+                        voutm[:, :half] = (
+                            jax.nn.silu(vaccm[:, :half])
+                            * vaccm[:, half:]).astype(wdt)
+                    elif sp.epi == 2:
+                        for w_ in range(rw):
+                            rdesc(s, w_).wait()
+                        for w_ in range(rw):
+                            voutm[:, pl.ds(w_ * TILE, TILE)] = (
+                                vaccm[:, pl.ds(w_ * TILE, TILE)]
+                                + vrow_b[w_].astype(jnp.float32)
+                            ).astype(wdt)
+                    else:
+                        voutm[...] = vaccm[...].astype(wdt)
+                    for w_ in range(rw):
+                        odesc(s, w_).start()
+                    # Drain before the next strip's epilogue rewrites
+                    # voutm (dots in between hide most of the latency).
+                    for w_ in range(rw):
+                        odesc(s, w_).wait()
+            return None
+
+        return body
+
+    def t_gemm_mat():
+        if not mat_specs:
+            return
+        bodies = [_mat_body(sp) for sp in mat_specs]
+        if len(bodies) == 1:
+            bodies[0]()
+        else:
+            jax.lax.switch(a_stride, bodies)
+
     jax.lax.switch(w(0), [t_copy, t_add, t_silu_mul, t_retired, t_allreduce,
                           t_scale, t_rms_norm, t_retired, t_attn_decode,
                           t_attn_decode_paged, t_prefetch,
                           t_attn_decode_gqa, t_gemm_wide, t_norm_rope,
                           t_append_kv, t_gemm_wide_w8, t_prefetch_w8,
-                          t_moe_topk, t_moe_ffn])
+                          t_moe_topk, t_moe_ffn, t_gemm_mat])
 
 
 def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
               num_tasks: int | None = None, max_gqa: int = 1,
               max_gemm_width: int = 1, workspace8=None,
               max_moe_h: int = 0, max_moe_f: int = 0,
-              max_row: int = 1, max_strip: int = 0):
+              max_row: int = 1, max_strip: int = 0,
+              workspace_m=None, mat_specs: tuple = ()):
     """Execute the packed task queue over the workspace in ONE pallas_call.
 
     queue: (n_rows, WORDS) int32; workspace: (T, TILE, TILE) fp32 or bf16
@@ -876,6 +976,12 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
     # latency, dominates. Floor 2*MF / MH: the (undispatched) MoE branch
     # still TRACES its static region offsets in every program.
     SW = max(max_strip, W, 2 * MF, MH)
+    # Matrix-workspace geometry: chunk buffer sized to the largest spec;
+    # a one-row placeholder rides along when the program has no GEMM_MAT
+    # tasks (the branch body is then empty — nothing reads it).
+    kch_max = max((sp.kch for sp in mat_specs), default=TILE)
+    if workspace_m is None:
+        workspace_m = jnp.zeros((1, MAT_COLS), wdt)
     w8_absent = workspace8 is None
     if workspace8 is None:
         workspace8 = jnp.zeros((1, TILE, TILE), jnp.float8_e4m3fn)
@@ -892,7 +998,7 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_tasks,),
-        in_specs=[any_spec(), any_spec()],
+        in_specs=[any_spec(), any_spec(), any_spec()],
         out_specs=(any_spec(), any_spec()),
         scratch_shapes=[
             pltpu.VMEM((PIPE_DEPTH, TILE, TILE), wdt),      # va2
@@ -919,13 +1025,17 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
             pltpu.VMEM((MF, TILE, TILE), jnp.float32),  # vmoe_a (gate/act)
             pltpu.VMEM((MF, TILE, TILE), jnp.float32),  # vmoe_b (up)
             pltpu.VMEM((MH, TILE, TILE), jnp.float32),  # vmoe_o (out acc)
+            pltpu.VMEM((2, kch_max, MAT_COLS), wdt),    # vbm (mat chunks)
+            pltpu.VMEM((TILE, MAT_COLS), jnp.float32),  # vaccm (mat accum)
+            pltpu.VMEM((TILE, MAT_COLS), wdt),          # voutm (mat stores)
             pltpu.SemaphoreType.DMA(()),               # copy_sem
             pltpu.SemaphoreType.DMA((2 * PIPE_DEPTH + 1,)),  # pipe (+pf sem)
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
             pltpu.SemaphoreType.DMA(()),
         ],
     )
-    kernel = functools.partial(_mega_kernel, n, axis, n_tasks, G, W)
+    kernel = functools.partial(_mega_kernel, n, axis, n_tasks, G, W,
+                               tuple(mat_specs), kch_max)
     interpret = use_interpret()
     if interpret:
         from triton_distributed_tpu.runtime.interpret_workarounds import (
@@ -960,5 +1070,5 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
         # runs the step fully in place; undonated callers get one
         # XLA-level defensive copy instead of an in-kernel one.
         input_output_aliases={1: 0},
-    )(queue, workspace, workspace8)
+    )(queue, workspace, workspace8, workspace_m)
     return ws_out

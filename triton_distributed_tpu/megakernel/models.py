@@ -33,7 +33,9 @@ import numpy as np
 
 from triton_distributed_tpu.layers.common import rope_cos_sin
 from triton_distributed_tpu.megakernel.builder import MegaKernelBuilder
-from triton_distributed_tpu.megakernel.tasks import TILE, TensorHandle
+from triton_distributed_tpu.megakernel.tasks import (
+    TILE, MatHandle, TensorHandle,
+)
 
 
 def broadcast_rows(vec: np.ndarray) -> np.ndarray:
@@ -60,19 +62,25 @@ def _col(t: TensorHandle, j: int) -> TensorHandle:
 
 @dataclasses.dataclass
 class DecodeLayerHandles:
-    """Workspace handles for one layer's weights + caches + outputs."""
+    """Workspace handles for one layer's weights + caches + outputs.
+
+    Two weight layouts exist (use :func:`feed_layer_weights` to feed
+    either): the round-5 MATRIX layout (default for dense bf16/fp32 —
+    ``wqkv``/``w_gateup`` are fused MatHandles, ``wo``/``w_down`` are
+    MatHandles, and ``wq/wk/wv/w_gate/w_up`` are None) and the tiled
+    layout (fp8 / MoE-FFN — every field is a TensorHandle)."""
 
     attn_norm: TensorHandle     # (TILE, hidden) broadcast
     mlp_norm: TensorHandle
     q_norm: TensorHandle        # (TILE, d) broadcast (Qwen3 qk-norm)
     k_norm: TensorHandle
-    wq: TensorHandle            # (hidden, hq_local*d)
-    wk: TensorHandle            # (hidden, hkv_local*d)
-    wv: TensorHandle
-    wo: TensorHandle            # (hq_local*d, hidden)
-    w_gate: TensorHandle        # (hidden, ffn_local)
-    w_up: TensorHandle
-    w_down: TensorHandle        # (ffn_local, hidden)
+    wq: TensorHandle | None     # (hidden, hq_local*d)
+    wk: TensorHandle | None     # (hidden, hkv_local*d)
+    wv: TensorHandle | None
+    wo: TensorHandle | MatHandle    # (hq_local*d, hidden)
+    w_gate: TensorHandle | None     # (hidden, ffn_local)
+    w_up: TensorHandle | None
+    w_down: TensorHandle | MatHandle  # (ffn_local, hidden)
     kT: list[TensorHandle]      # per kv head: (d, S) keys transposed
     v: list[TensorHandle]       # per kv head: (S, d)
     k_new: TensorHandle         # (TILE, hkv_local*d) — this step's k (out)
@@ -83,6 +91,41 @@ class DecodeLayerHandles:
     moe_w_gate: TensorHandle | None = None   # (E·hidden, ffn_local)
     moe_w_up: TensorHandle | None = None
     moe_w_down: TensorHandle | None = None   # (E·ffn_local, hidden)
+    # Matrix-workspace layout (round 5 — see class docstring):
+    wqkv: MatHandle | None = None       # (hidden, (hq+2*hkv)*d) fused
+    w_gateup: MatHandle | None = None   # (hidden, ffn_local) pair
+    qkv_out: TensorHandle | None = None  # (TILE, (hq+2*hkv)*d) q|k|v row
+
+
+def feed_layer_weights(feeds: dict, h: DecodeLayerHandles, *, wq, wk, wv,
+                       wo, w_gate=None, w_up=None, w_down=None) -> dict:
+    """Insert one layer's projection/MLP weights into ``feeds`` in
+    whichever layout the program was built with (matrix or tiled) —
+    callers pass the natural per-matrix values and never see the fused
+    qkv / interleaved gate|up storage."""
+    if h.wqkv is not None:
+        feeds[h.wqkv] = jnp.concatenate(
+            [jnp.asarray(wq), jnp.asarray(wk), jnp.asarray(wv)], axis=1)
+    else:
+        feeds[h.wq] = wq
+        feeds[h.wk] = wk
+        feeds[h.wv] = wv
+    feeds[h.wo] = wo
+    if h.moe_w_gate is not None:
+        # MoE layer: the expert FFN feeds through the moe_w_* handles;
+        # dense-FFN values passed here are ignored (h.w_gate may be None
+        # in the matrix layout — keying feeds by None would surface later
+        # as an opaque split_feeds crash).
+        return feeds
+    if w_gate is not None:
+        if h.w_gateup is not None:
+            feeds[h.w_gateup] = (w_gate, w_up)
+        else:
+            feeds[h.w_gate] = w_gate
+            feeds[h.w_up] = w_up
+    if w_down is not None:
+        feeds[h.w_down] = w_down
+    return feeds
 
 
 @dataclasses.dataclass
@@ -178,10 +221,18 @@ def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
     # for direct builder use; reference weight-prefetch, SURVEY.md §2.7.)
     mb.rms_norm(xn, x, h.attn_norm, eps)
 
-    q = mb.tensor(TILE, hq_local * d)
-    mb.gemm(q, xn, h.wq)
-    mb.gemm(h.k_new, xn, h.wk)
-    mb.gemm(h.v_new, xn, h.wv)
+    if h.wqkv is not None:
+        # Matrix path (round 5): ONE fused qkv GEMM_MAT task — the q|k|v
+        # output row is contiguous (k_new/v_new are views into qkv_out),
+        # the A row loads once for all three projections, and the task
+        # body is a static specialized branch (tasks.py GEMM_MAT).
+        q = TensorHandle(h.qkv_out.base, TILE, hq_local * d)
+        mb.gemm_mat(h.qkv_out, xn, h.wqkv)
+    else:
+        q = mb.tensor(TILE, hq_local * d)
+        mb.gemm(q, xn, h.wq)
+        mb.gemm(h.k_new, xn, h.wk)
+        mb.gemm(h.v_new, xn, h.wv)
 
     # Per-head qk-norm + RoPE, fused into one task per head (head_dim ==
     # TILE → the norm reduces over the single head tile).
@@ -230,17 +281,25 @@ def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
             mb.append_kv(h.kT[kv], h.v[kv], pos, _col(h.k_new, kv),
                          _col(h.v_new, kv))
 
-    o = mb.tensor(TILE, hidden)
-    mb.gemm(o, attn, h.wo)
-    if num_ranks > 1:
-        mb.all_reduce(o)
+    mat = isinstance(h.wo, MatHandle)
     x1 = mb.tensor(TILE, hidden)
-    mb.add(x1, x, o)
+    if mat and num_ranks == 1:
+        # Fused o-proj + residual add (epilogue 2).
+        mb.gemm_mat(x1, attn, h.wo, residual=x)
+    else:
+        o = mb.tensor(TILE, hidden)
+        if mat:
+            mb.gemm_mat(o, attn, h.wo)
+        else:
+            mb.gemm(o, attn, h.wo)
+        if num_ranks > 1:
+            mb.all_reduce(o)
+        mb.add(x1, x, o)
 
     x1n = mb.tensor(TILE, hidden)
     mb.rms_norm(x1n, x1, h.mlp_norm, eps)
-    down = mb.tensor(TILE, hidden)
     if h.moe_w_gate is not None:
+        down = mb.tensor(TILE, hidden)
         # Qwen3-MoE FFN: router GEMM → in-kernel top-k/softmax → ONE
         # expert-loop task with data-dependent skipping (tasks.py MOE_FFN;
         # only ~B·topk of E experts stream their weights).
@@ -250,7 +309,19 @@ def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
         mb.moe_topk(wt, logits, moe_topk, moe_experts, batch)
         mb.moe_ffn(down, x1n, wt, h.moe_w_gate, h.moe_w_up, h.moe_w_down,
                    moe_experts)
+    elif h.w_gateup is not None:
+        # Fused gate/up/act: one GEMM_MAT over the interleaved pair with
+        # the silu epilogue, then down (+residual when no AR follows).
+        act = mb.tensor(TILE, h.w_gateup.n)
+        mb.gemm_mat(act, x1n, h.w_gateup)
+        if num_ranks == 1:
+            x2 = mb.tensor(TILE, hidden)
+            mb.gemm_mat(x2, act, h.w_down, residual=x1)
+            return x2
+        down = mb.tensor(TILE, hidden)
+        mb.gemm_mat(down, act, h.w_down)
     else:
+        down = mb.tensor(TILE, hidden)
         ffn_local = h.w_gate.cols
         gate = mb.tensor(TILE, ffn_local)
         up = mb.tensor(TILE, ffn_local)
@@ -302,6 +373,9 @@ def build_decode_step(*, hidden: int, hq_local: int, hkv_local: int,
     sin = mb.tensor(TILE, TILE)
     layers: list[DecodeLayerHandles] = []
     d = TILE
+    # Matrix weight layout (round 5) is the default; the fp8 lane keeps
+    # the tiled layout (GEMM_WIDE_W8 streams from the fp8 tile workspace).
+    use_mat = not fp8_weights
     for _ in range(num_layers):
         moe = moe_experts > 0
         if moe:
@@ -309,32 +383,51 @@ def build_decode_step(*, hidden: int, hq_local: int, hkv_local: int,
             moe_w_up = mb.tensor(moe_experts * hidden, ffn_local)
             moe_w_down = mb.tensor(moe_experts * ffn_local, hidden)
             moe_router = mb.tensor(hidden, TILE)
+        if use_mat:
+            wqkv = mb.tensor_mat(hidden, (hq_local + 2 * hkv_local) * d)
+            wo = mb.tensor_mat(hq_local * d, hidden)
+            qkv_out = mb.tensor(TILE, (hq_local + 2 * hkv_local) * d)
+            k_new = TensorHandle(qkv_out.base + hq_local, TILE,
+                                 hkv_local * d)
+            v_new = TensorHandle(qkv_out.base + hq_local + hkv_local,
+                                 TILE, hkv_local * d)
+            w_gateup = (None if moe
+                        else mb.tensor_mat(hidden, ffn_local, pair=True))
+            w_down = (moe_w_down if moe
+                      else mb.tensor_mat(ffn_local, hidden))
+            wq = wk = wv = w_gate = w_up = None
+        else:
+            wqkv = w_gateup = qkv_out = None
+            wq = mb.tensor(hidden, hq_local * d, fp8=fp8_weights)
+            wk = mb.tensor(hidden, hkv_local * d, fp8=fp8_weights)
+            wv = mb.tensor(hidden, hkv_local * d, fp8=fp8_weights)
+            wo = mb.tensor(hq_local * d, hidden, fp8=fp8_weights)
+            # On the MoE path the dense-FFN fields alias the expert stacks
+            # (unused by the MoE branch; the dataclass keeps them
+            # non-optional for the dense majority).
+            w_gate = moe_w_gate if moe else mb.tensor(
+                hidden, ffn_local, fp8=fp8_weights)
+            w_up = moe_w_up if moe else mb.tensor(
+                hidden, ffn_local, fp8=fp8_weights)
+            w_down = moe_w_down if moe else mb.tensor(
+                ffn_local, hidden, fp8=fp8_weights)
+            k_new = mb.tensor(TILE, hkv_local * d)
+            v_new = mb.tensor(TILE, hkv_local * d)
         layers.append(DecodeLayerHandles(
             attn_norm=mb.tensor(TILE, hidden),
             mlp_norm=mb.tensor(TILE, hidden),
             q_norm=mb.tensor(TILE, d),
             k_norm=mb.tensor(TILE, d),
-            wq=mb.tensor(hidden, hq_local * d, fp8=fp8_weights),
-            wk=mb.tensor(hidden, hkv_local * d, fp8=fp8_weights),
-            wv=mb.tensor(hidden, hkv_local * d, fp8=fp8_weights),
-            wo=mb.tensor(hq_local * d, hidden, fp8=fp8_weights),
-            # On the MoE path the dense-FFN fields alias the expert stacks
-            # (unused by the MoE branch; the dataclass keeps them
-            # non-optional for the dense majority).
-            w_gate=moe_w_gate if moe else mb.tensor(hidden, ffn_local,
-                                                    fp8=fp8_weights),
-            w_up=moe_w_up if moe else mb.tensor(hidden, ffn_local,
-                                                fp8=fp8_weights),
-            w_down=moe_w_down if moe else mb.tensor(ffn_local, hidden,
-                                                    fp8=fp8_weights),
+            wq=wq, wk=wk, wv=wv, wo=wo,
+            w_gate=w_gate, w_up=w_up, w_down=w_down,
             kT=[mb.tensor(d, max_seq) for _ in range(hkv_local)],
             v=[mb.tensor(max_seq, d) for _ in range(hkv_local)],
-            k_new=mb.tensor(TILE, hkv_local * d),
-            v_new=mb.tensor(TILE, hkv_local * d),
+            k_new=k_new, v_new=v_new,
             moe_router=moe_router if moe else None,
             moe_w_gate=moe_w_gate if moe else None,
             moe_w_up=moe_w_up if moe else None,
             moe_w_down=moe_w_down if moe else None,
+            wqkv=wqkv, w_gateup=w_gateup, qkv_out=qkv_out,
         ))
 
     cur = x

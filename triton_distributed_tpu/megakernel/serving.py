@@ -33,9 +33,9 @@ import numpy as np
 from triton_distributed_tpu.layers.common import rms_norm
 from triton_distributed_tpu.megakernel.models import (
     DecodeStepProgram, advance_queue_pos, broadcast_rows, build_decode_step,
-    rope_tables,
+    feed_layer_weights, rope_tables,
 )
-from triton_distributed_tpu.megakernel.tasks import TILE
+from triton_distributed_tpu.megakernel.tasks import MAT_COLS, TILE
 from triton_distributed_tpu.models.config import ModelConfig
 
 
@@ -82,14 +82,16 @@ def weight_feeds(prog: DecodeStepProgram, cfg: ModelConfig,
               else np.ones(cfg.head_dim, np.float32))
         feeds[h.q_norm] = broadcast_rows(qn)
         feeds[h.k_norm] = broadcast_rows(kn)
-        feeds[h.wq] = cols(attn["wq"], hq_l * d)
-        feeds[h.wk] = cols(attn["wk"], hkv_l * d)
-        feeds[h.wv] = cols(attn["wv"], hkv_l * d)
-        feeds[h.wo] = rows(attn["wo"], hq_l * d)
         mlp = layer["mlp"]
-        feeds[h.w_gate] = cols(mlp["w_gate"], ffn_l)
-        feeds[h.w_up] = cols(mlp["w_up"], ffn_l)
-        feeds[h.w_down] = rows(mlp["w_down"], ffn_l)
+        feed_layer_weights(
+            feeds, h,
+            wq=cols(attn["wq"], hq_l * d),
+            wk=cols(attn["wk"], hkv_l * d),
+            wv=cols(attn["wv"], hkv_l * d),
+            wo=rows(attn["wo"], hq_l * d),
+            w_gate=cols(mlp["w_gate"], ffn_l),
+            w_up=cols(mlp["w_up"], ffn_l),
+            w_down=rows(mlp["w_down"], ffn_l))
     return feeds
 
 
@@ -189,29 +191,36 @@ class MegakernelDecoder:
             mesh = ctx.mesh
 
             def sharded(ws, embed, final_norm, lm_head, queue, cos, sin,
-                        token, ws8):
+                        token, ws8, wsm):
                 # fp8_weights is a static python flag: without it ws8 is a
-                # placeholder tile the kernel never reads.
+                # placeholder tile the kernel never reads (and vice versa
+                # for the matrix workspace, which the fp8 layout forgoes).
                 ws, tok = self._step(ws[0], embed, final_norm, lm_head,
                                      queue, cos, sin, token,
                                      ws8=ws8[0] if self.fp8_weights
+                                     else None,
+                                     wsm=wsm[0] if self.comp.num_mrows
                                      else None)
                 return ws[None], tok
 
             fn = jax.shard_map(
                 sharded, mesh=mesh,
                 in_specs=(P(axis), P(), P(), P(), P(), P(), P(), P(),
-                          P(axis)),
+                          P(axis), P(axis)),
                 out_specs=(P(axis), P()), check_vma=False)
             self._step_jit = jax.jit(fn, donate_argnums=(0,))
+            from jax.sharding import NamedSharding
+
             if not fp8_weights:
                 # Placeholder fp8 operand allocated ONCE with its final
                 # sharding — a fresh per-step array would add a host
                 # allocation + reshard to every token.
-                from jax.sharding import NamedSharding
-
                 self._ws8 = jax.device_put(
                     jnp.zeros((n, 1, TILE, TILE), jnp.float8_e4m3fn),
+                    NamedSharding(mesh, P(axis)))
+            if not self.comp.num_mrows:
+                self._wsm = jax.device_put(
+                    jnp.zeros((n, 1, MAT_COLS), self.comp.dtype),
                     NamedSharding(mesh, P(axis)))
 
     # -- workspace ----------------------------------------------------------
@@ -228,10 +237,11 @@ class MegakernelDecoder:
         if self.n == 1:
             feeds = dict(self._weight_feeds[0])
             feeds.update(cache_feeds(self.prog, cache))
-            main = {h: v for h, v in feeds.items() if not h.fp8}
-            self._ws8 = (self.comp.make_workspace8(
-                {h: v for h, v in feeds.items() if h.fp8})
-                if self.fp8_weights else None)
+            main, w8, wm = self.comp.split_feeds(feeds)
+            self._ws8 = (self.comp.make_workspace8(w8)
+                         if self.fp8_weights else None)
+            self._wsm = (self.comp.make_workspace_mat(wm)
+                         if self.comp.num_mrows else None)
             return self.comp.make_workspace(main)
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -241,22 +251,29 @@ class MegakernelDecoder:
         devices = list(mesh.devices.flat)
         shards = []
         ws8_shards = []
+        wsm_shards = []
         for r in range(self.n):
             feeds = dict(self._weight_feeds[r])
             feeds.update(cache_feeds(self.prog, cache, rank=r,
                                      num_ranks=self.n))
-            main = {h: v for h, v in feeds.items() if not h.fp8}
+            main, w8, wm = self.comp.split_feeds(feeds)
             ws_r = self.comp.make_workspace(main)
             shards.append(jax.device_put(ws_r[None], devices[r]))
             if self.fp8_weights:
-                ws8_r = self.comp.make_workspace8(
-                    {h: v for h, v in feeds.items() if h.fp8})
+                ws8_r = self.comp.make_workspace8(w8)
                 ws8_shards.append(jax.device_put(ws8_r[None], devices[r]))
+            if self.comp.num_mrows:
+                wsm_r = self.comp.make_workspace_mat(wm)
+                wsm_shards.append(jax.device_put(wsm_r[None], devices[r]))
         shape = (self.n,) + shards[0].shape[1:]
         if self.fp8_weights:
             s8 = (self.n,) + ws8_shards[0].shape[1:]
             self._ws8 = jax.make_array_from_single_device_arrays(
                 s8, NamedSharding(mesh, P(self.axis)), ws8_shards)
+        if self.comp.num_mrows:
+            sm = (self.n,) + wsm_shards[0].shape[1:]
+            self._wsm = jax.make_array_from_single_device_arrays(
+                sm, NamedSharding(mesh, P(self.axis)), wsm_shards)
         # (fp8 off: keep the __init__-time placeholder — shard_map still
         # needs its array operand.)
         return jax.make_array_from_single_device_arrays(
@@ -264,7 +281,7 @@ class MegakernelDecoder:
 
     # -- one token ----------------------------------------------------------
     def _step(self, ws, embed, final_norm, lm_head, queue, cos, sin, token,
-              ws8=None):
+              ws8=None, wsm=None):
         # embed / final_norm / lm_head arrive as ARGUMENTS: closed over,
         # jit would bake them into the trace as inline constants (multi-GB
         # for real checkpoints — the exact hazard bench.py documents).
@@ -276,7 +293,7 @@ class MegakernelDecoder:
         ws = self.comp.scatter_input(ws, self.prog.x, x)
         ws = self.comp.scatter_input(ws, self.prog.cos, cos)
         ws = self.comp.scatter_input(ws, self.prog.sin, sin)
-        ws = self.comp.step(ws, queue, ws8=ws8)
+        ws = self.comp.step(ws, queue, ws8=ws8, wsm=wsm)
         x_out = self.comp.gather_output(ws, self.prog.x_out)[0:1]
         xn = rms_norm(x_out.astype(jnp.float32),
                       final_norm.astype(jnp.float32),
@@ -297,6 +314,7 @@ class MegakernelDecoder:
                                   num_exec=self.comp.num_exec)
         cos, sin = rope_tables(pos, TILE, self.cfg.rope_theta)
         ws8 = getattr(self, "_ws8", None)
+        wsm = getattr(self, "_wsm", None)
         return self._step_jit(ws, self.embed, self.final_norm, self.lm_head,
                               queue, jnp.asarray(cos), jnp.asarray(sin),
-                              token, ws8)
+                              token, ws8, wsm)

@@ -19,6 +19,7 @@ import enum
 
 TILE = 128      # square fp32 tile (8×128 sublane-aligned, MXU-shaped)
 WORDS = 10      # int32 words per task
+MAT_COLS = 1024  # matrix weight workspace width (strip columns)
 
 
 class TaskType(enum.IntEnum):
@@ -106,6 +107,29 @@ class TaskType(enum.IntEnum):
     #                 is what lets MOE_FFN skip inactive experts by a
     #                 column-sum predicate. Matches ops/moe.route_and_sort
     #                 (Qwen norm_topk_prob semantics).
+    GEMM_MAT = 19   # GEMM whose B lives in the 2D MATRIX weight workspace
+    #                 (wsm, shape (rows, MAT_COLS)): the round-5 answer to
+    #                 the genericity tax the on-chip probe measured
+    #                 (scripts/probe_gemm_task.py: the GEMM_WIDE body hits
+    #                 21us in isolation but 61us in the megakernel — the
+    #                 dynamic width/trip-count predication from queue
+    #                 scalars is the difference). Weight matrices store as
+    #                 vertical 1024-col strips; the kernel fetches (kch,
+    #                 1024) 2D chunks and runs few, DEEP dots ((128, kch) @
+    #                 (kch, 1024)) in a fully STATIC body selected by spec
+    #                 index — the builder registers each distinct (k_tiles,
+    #                 n_strips, out_tiles, kch, epilogue) shape and the
+    #                 kernel compiles one specialized branch per spec, the
+    #                 TPU analog of the reference's per-model generated
+    #                 dispatch chain (mega_triton_kernel/core/
+    #                 code_generator.py:31-89). Words: out = output row
+    #                 tile base, a0 = A row tile base, b0 = wsm ROW base,
+    #                 k_tiles (runtime copy), a_stride = SPEC INDEX,
+    #                 arg = epilogue (runtime copy), c0 = residual row
+    #                 tile base (epilogue 2). Epilogues: 0 = plain store;
+    #                 1 = silu-pair (strips interleave [gate|up] 512-col
+    #                 halves; stores silu(gate)*up — the fused gate/up/act
+    #                 path); 2 = += residual (fused o-proj/down + add).
     MOE_FFN = 18    # One task = one layer's ENTIRE expert MLP: loops the E
     #                 experts; an expert whose (E, B) weight column is all
     #                 zero is SKIPPED before any weight DMA issues — the
@@ -170,3 +194,61 @@ class TensorHandle:
 
     def tiles(self) -> list[int]:
         return list(range(self.base, self.base + self.rt * self.ct))
+
+
+@dataclasses.dataclass(frozen=True)
+class MatHandle:
+    """A weight matrix in the 2D MATRIX workspace (wsm, (rows, MAT_COLS)).
+
+    A (K, N) matrix stores as ``n_strips`` vertical strips of MAT_COLS
+    columns (the last zero-padded), stacked: strip ``s`` occupies wsm rows
+    ``[base + s*K, base + (s+1)*K)``. ``pair=True`` marks the interleaved
+    gate|up layout: each strip's left MAT_COLS/2 columns come from the
+    FIRST matrix of the pair and the right half from the second, so the
+    silu-pair epilogue consumes both halves from one fetched chunk."""
+
+    base: int        # starting row in wsm
+    k: int           # contraction rows (== K)
+    n: int           # real output columns (per matrix; for pair: of EACH)
+    pair: bool = False
+
+    fp8 = False      # never lives in the fp8 tile workspace
+
+    @property
+    def n_strips(self) -> int:
+        if self.pair:
+            return -(-self.n // (MAT_COLS // 2))
+        return -(-self.n // MAT_COLS)
+
+    @property
+    def rows(self) -> int:
+        return self.n_strips * self.k
+
+
+@dataclasses.dataclass(frozen=True)
+class MatSpec:
+    """Static shape of a GEMM_MAT task — one specialized kernel branch per
+    distinct spec (the per-model code generation the reference does in
+    core/code_generator.py, expressed as a lax.switch over static bodies).
+
+    ``kch``: contraction rows per fetched chunk (the largest of 512/256/128
+    dividing K, capped at K). ``epi``: 0 plain, 1 silu-pair, 2 +residual.
+    ``nt_out``: output width in TILE columns (for pair epi: of the act)."""
+
+    kt: int          # A-row tiles (K / TILE)
+    ns: int          # strips
+    nt_out: int      # output tiles
+    kch: int         # chunk rows
+    epi: int         # epilogue kind
+
+    @property
+    def n_ch(self) -> int:
+        return (self.kt * TILE) // self.kch
+
+
+def mat_chunk_rows(k: int) -> int:
+    """Largest power-of-two chunk row count (<= 512) dividing ``k``."""
+    for c in (512, 256, 128):
+        if k % c == 0:
+            return min(c, k)
+    raise ValueError(f"K {k} not a multiple of {TILE}")
