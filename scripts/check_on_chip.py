@@ -417,7 +417,9 @@ def main() -> int:
                     feeds[hh] = rng.standard_normal(
                         (hh.rows, hh.cols)) * 0.1
             elif isinstance(h_, MatHandle):
-                feeds[h_] = rng.standard_normal((h_.k, h_.n)) * 0.1
+                feeds[h_] = (tuple(rng.standard_normal((h_.k, h_.n)) * 0.1
+                                   for _ in range(2)) if h_.pair
+                             else rng.standard_normal((h_.k, h_.n)) * 0.1)
             else:
                 feeds[h_] = rng.standard_normal((h_.rows, h_.cols)) * 0.1
         feeds = {h_: jnp.asarray(np.asarray(v_, np.float32))

@@ -1,0 +1,134 @@
+"""GEMM_MAT unit tests — the round-5 matrix-workspace GEMM path (static
+per-spec specialized branches; tasks.py GEMM_MAT, builder.gemm_mat).
+
+The decode-step tests exercise the fused model assembly; these cover the
+task in isolation at edge shapes: multi-strip, pair (silu) epilogue,
+residual epilogue, sub-512 K chunks, spec dedup, and validation errors.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from triton_distributed_tpu.megakernel.builder import MegaKernelBuilder
+from triton_distributed_tpu.megakernel.tasks import (
+    MAT_COLS, TILE, MatHandle, MatSpec, mat_chunk_rows,
+)
+
+
+def _run_one(k, n, pair=False, resid=False, dtype=jnp.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    mb = MegaKernelBuilder()
+    a = mb.tensor(TILE, k)
+    w = mb.tensor_mat(k, n, pair=pair)
+    o = mb.tensor(TILE, n)
+    r = mb.tensor(TILE, n) if resid else None
+    mb.gemm_mat(o, a, w, residual=r)
+    comp = mb.compile(dtype=dtype)
+    av = rng.standard_normal((TILE, k)).astype(np.float32) * 0.1
+    feeds = {a: av}
+    if pair:
+        g = rng.standard_normal((k, n)).astype(np.float32) * 0.1
+        u = rng.standard_normal((k, n)).astype(np.float32) * 0.1
+        feeds[w] = (g, u)
+        gx = av @ g
+        want = gx / (1 + np.exp(-gx)) * (av @ u)
+    else:
+        wv = rng.standard_normal((k, n)).astype(np.float32) * 0.1
+        feeds[w] = wv
+        want = av @ wv
+    if resid:
+        rv = rng.standard_normal((TILE, n)).astype(np.float32) * 0.1
+        feeds[r] = rv
+        want = want + rv
+    (out,) = comp.run(feeds, outputs=[o])
+    return np.asarray(out, np.float32), want
+
+
+@pytest.mark.parametrize("k,n", [(256, 512), (512, 1024), (1024, 2048),
+                                 (384, 256), (512, 1152)])
+def test_plain_shapes(k, n):
+    out, want = _run_one(k, n)
+    np.testing.assert_allclose(out, want, rtol=2e-4, atol=2e-4)
+
+
+def test_pair_silu_multi_strip():
+    out, want = _run_one(512, 1536, pair=True)
+    np.testing.assert_allclose(out, want, rtol=2e-4, atol=2e-4)
+
+
+def test_residual_multi_strip():
+    out, want = _run_one(512, 2048, resid=True)
+    np.testing.assert_allclose(out, want, rtol=2e-4, atol=2e-4)
+
+
+def test_bf16_workspace():
+    out, want = _run_one(512, 1024, dtype=jnp.bfloat16)
+    np.testing.assert_allclose(out, want, rtol=5e-2, atol=5e-2)
+
+
+def test_spec_dedup_and_queue_words():
+    mb = MegaKernelBuilder()
+    a = mb.tensor(TILE, 512)
+    w1 = mb.tensor_mat(512, 1024)
+    w2 = mb.tensor_mat(512, 1024)
+    w3 = mb.tensor_mat(512, 2048)
+    o1, o2 = mb.tensor(TILE, 1024), mb.tensor(TILE, 1024)
+    o3 = mb.tensor(TILE, 2048)
+    mb.gemm_mat(o1, a, w1)
+    mb.gemm_mat(o2, a, w2)     # same spec -> deduped
+    mb.gemm_mat(o3, a, w3)     # new spec (ns/nt differ)
+    comp = mb.compile()
+    assert len(comp.mat_specs) == 2
+    assert comp.mat_specs[0] == MatSpec(kt=4, ns=1, nt_out=8, kch=512,
+                                        epi=0)
+
+
+def test_mat_handle_geometry():
+    h = MatHandle(0, 512, 1536, pair=False)
+    assert h.n_strips == 2 and h.rows == 1024          # 1024 + pad strip
+    hp = MatHandle(0, 512, 1536, pair=True)
+    assert hp.n_strips == 3 and hp.rows == 1536        # 512-col halves
+    assert mat_chunk_rows(4096) == 512
+    assert mat_chunk_rows(1536) == 512
+    assert mat_chunk_rows(256) == 256
+    assert mat_chunk_rows(384) == 128
+
+
+def test_validation_errors():
+    mb = MegaKernelBuilder()
+    a = mb.tensor(TILE, 512)
+    w = mb.tensor_mat(512, 1024)
+    o = mb.tensor(TILE, 1024)
+    bad_r = mb.tensor(TILE, 512)
+    with pytest.raises(ValueError, match="residual"):
+        mb.gemm_mat(o, a, w, residual=bad_r)
+    wp = mb.tensor_mat(512, 1024, pair=True)
+    good_r = mb.tensor(TILE, 1024)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        mb.gemm_mat(o, a, wp, residual=good_r)
+    with pytest.raises(ValueError, match="shape mismatch"):
+        mb.gemm_mat(o, mb.tensor(TILE, 256), w)
+    with pytest.raises(TypeError):
+        mb.gemm(o, a, w)     # tile-path gemm rejects a MatHandle
+
+
+def test_step_requires_wsm():
+    mb = MegaKernelBuilder()
+    a = mb.tensor(TILE, 256)
+    w = mb.tensor_mat(256, 256)
+    o = mb.tensor(TILE, 256)
+    mb.gemm_mat(o, a, w)
+    comp = mb.compile()
+    ws = comp.make_workspace({a: np.zeros((TILE, 256), np.float32)})
+    with pytest.raises(ValueError, match="wsm"):
+        comp.step(ws)
+
+
+def test_pad_strip_columns_are_inert():
+    """A 1152-wide matrix pads its second strip to MAT_COLS; the pad
+    columns must not leak into the stored output tiles."""
+    out, want = _run_one(256, 1152, seed=3)
+    np.testing.assert_allclose(out, want, rtol=2e-4, atol=2e-4)
+    assert MAT_COLS == 1024

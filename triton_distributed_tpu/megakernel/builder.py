@@ -189,6 +189,9 @@ class MegaKernelBuilder:
         ``prefetch_first``: the first task's f=0 weight tile was warmed by a
         preceding :meth:`prefetch` — it reads the reserved slot instead of
         issuing its own DMA (queue word c0 = 1)."""
+        if isinstance(b, MatHandle):
+            raise TypeError("matrix-workspace weights go through gemm_mat, "
+                            "not gemm")
         if a.cols != b.rows or out.rows != a.rows or out.cols != b.cols:
             raise ValueError("gemm shape mismatch")
         if not 1 <= width <= 16:
