@@ -57,3 +57,20 @@ def per_iter_chain(make_chain, lengths=(4, 36), iters: int = 3):
         t0 = time.perf_counter(); _ = np.asarray(f2())
         t2 = min(t2, time.perf_counter() - t0)
     return max((t2 - t1) / (n2 - n1), 0.0)
+
+
+def gated_differential(t: dict, lengths):
+    """The repo's standard 3-length consistency gate over min-timings.
+
+    ``t``: length -> min wall seconds. Returns (per_iter_seconds, ok):
+    ok is False when timings are non-monotone or the two sub-differentials
+    disagree beyond 3x (dispatch-swing / elision contamination). One
+    definition so every evidence script measures identically."""
+    n1, n2, n3 = lengths
+    t1, t2, t3 = t[n1], t[n2], t[n3]
+    per = (t3 - t1) / (n3 - n1)
+    if not t3 > t2 > t1:
+        return per, False
+    d21 = (t2 - t1) / (n2 - n1)
+    d32 = (t3 - t2) / (n3 - n2)
+    return per, bool(0.33 < d21 / max(d32, 1e-12) < 3.0)

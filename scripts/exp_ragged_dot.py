@@ -1,0 +1,114 @@
+"""On-chip evidence for the grouped-GEMM choice (r4 VERDICT weak #8).
+
+``ops/moe.grouped_mlp`` rides ``jax.lax.ragged_dot`` where the reference
+ships a hand-tuned grouped GEMM (moe_reduce_rs.py:167). This experiment
+measures, at Qwen3-MoE per-device expert shapes, whether XLA's ragged_dot
+is actually leaving performance on the table:
+
+  ragged    — jax.lax.ragged_dot (the grouped_mlp path)
+  dense     — ONE dense (m, k) @ (k, n) dot of the same total FLOPs
+              (upper bound: what a perfect grouped kernel could approach
+              if group switching were free)
+  unrolled  — per-expert dynamic-slice + dense dot loop (the naive
+              alternative a custom kernel must beat)
+
+Chain-differential timing (bench.py method).
+
+    TDTPU_BENCH_ON_TPU=1 python scripts/exp_ragged_dot.py
+"""
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "benchmark"))
+
+from _common import bootstrap, gated_differential  # noqa: E402
+
+jax, ON_TPU = bootstrap(n_devices=1)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+if ON_TPU:
+    # Qwen3-30B-A3B EP=8 decode-ish: 16 local experts, hidden 2048,
+    # moe_intermediate 768; m = tokens*topk landing on this device.
+    CASES = [("decode-ish m=1024", 1024, 2048, 768, 16),
+             ("prefill-ish m=8192", 8192, 2048, 768, 16),
+             ("fat experts m=4096", 4096, 4096, 1536, 8)]
+    LENGTHS = (8, 48, 88)
+else:
+    CASES = [("smoke", 64, 128, 64, 4)]
+    LENGTHS = (1, 2, 3)
+
+
+def measure(fn, a, w, gs, lengths, trials=5):
+    @functools.partial(jax.jit, static_argnums=3)
+    def chain(a, w, gs, n, salt):
+        def body(i, x):
+            o = fn(x, w, gs)
+            # fold the WHOLE output back in: a partial fold (o[0, :1])
+            # let XLA dead-code-eliminate every group but the first
+            # (observed: "5470 TFLOP/s" from the unrolled lane)
+            return x + jnp.sum(o).astype(x.dtype) * 1e-9
+
+        return jax.lax.fori_loop(0, n, body, a + salt)
+
+    t = {n: float("inf") for n in lengths}
+    for n in lengths:
+        jax.block_until_ready(chain(a, w, gs, n, jnp.bfloat16(0)))
+    s = [0]
+    for _ in range(trials):
+        for n in lengths:
+            s[0] += 1
+            t0 = time.perf_counter()
+            _ = np.asarray(jnp.sum(chain(a, w, gs, n,
+                                         jnp.bfloat16(s[0] * 1e-6))))
+            t[n] = min(t[n], time.perf_counter() - t0)
+    return gated_differential(t, lengths)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    for name, m, k, n, G in CASES:
+        a = jnp.asarray(rng.standard_normal((m, k)) * 0.05, jnp.bfloat16)
+        w = jnp.asarray(rng.standard_normal((G, k, n)) * 0.05, jnp.bfloat16)
+        # equal group sizes (the padded-capacity layout grouped_mlp feeds)
+        gs = jnp.full((G,), m // G, jnp.int32)
+        wd = jnp.asarray(rng.standard_normal((k, n)) * 0.05, jnp.bfloat16)
+
+        def ragged(x, w, gs):
+            return jax.lax.ragged_dot(x, w, gs)
+
+        def dense(x, w, gs, wd=wd):
+            return x @ wd
+
+        def unrolled(x, w, gs, m=m, G=G):
+            rows = m // G
+            outs = [jax.lax.dynamic_slice(x, (g * rows, 0), (rows, x.shape[1])
+                                          ) @ w[g] for g in range(G)]
+            return jnp.concatenate(outs, axis=0)
+
+        # Lane-equivalence guard: the DCE incident below proved a lane
+        # can silently compute a subset; ragged and unrolled must agree
+        # exactly (equal group sizes) before any timing is trusted.
+        assert bool(jnp.allclose(ragged(a, w, gs).astype(jnp.float32),
+                                 unrolled(a, w, gs).astype(jnp.float32),
+                                 atol=1e-2)), "lane mismatch"
+        flops = 2.0 * m * k * n
+        print(f"# {name}: ({m},{k}) x {G}x({k},{n}) bf16, "
+              f"{flops/1e9:.1f} GFLOP")
+        for label, fn in (("ragged_dot", ragged), ("dense-bound", dense),
+                          ("unrolled", unrolled)):
+            per, ok = measure(fn, a, w, gs, LENGTHS)
+            tf = flops / per / 1e12
+            flag = "" if ok else "  [INCONSISTENT]"
+            print(f"  {label:12} {per*1e6:9.1f} us/iter "
+                  f"{tf:7.1f} TFLOP/s{flag}")
+
+
+if __name__ == "__main__":
+    main()
