@@ -4,7 +4,6 @@ Reference pattern: test_ag_gemm.py / test_gemm_rs.py compare against
 torch.distributed all_gather + matmul goldens with inputs mutated across
 iterations (test_ag_gemm.py:86-92)."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

@@ -5,7 +5,6 @@ directly — models/utils.py:108; this is the parity proof)."""
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 
 torch = pytest.importorskip("torch")

@@ -1,7 +1,6 @@
 """MegaKernel tests: scheduler, single-device task programs, and the
 cross-device AllReduce task (TP MLP block in ONE kernel launch)."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -9,7 +8,7 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu.megakernel import (
-    MegaKernelBuilder, TensorHandle, topo_schedule, using_native_scheduler,
+    MegaKernelBuilder, topo_schedule, using_native_scheduler,
 )
 from triton_distributed_tpu.runtime.context import shard_map_on
 

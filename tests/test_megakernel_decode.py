@@ -3,7 +3,6 @@ mega_triton_kernel/test/test_qwen3.py role: assemble the model path, run
 the single launch, compare against the eager implementation)."""
 
 import numpy as np
-import pytest
 
 import jax
 import jax.numpy as jnp

@@ -1,7 +1,6 @@
 """MoE tests: TP-MoE (AG+GroupGEMM → MoE+RS) and EP-MoE (AllToAll dispatch)
 vs a dense single-device reference on the 8-CPU mesh."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -9,7 +8,7 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu.layers.ep_moe import (
-    init_ep_moe, ep_moe_specs, ep_moe_fwd,
+    ep_moe_specs, ep_moe_fwd,
 )
 from triton_distributed_tpu.ops.moe import moe_tp_fwd
 from triton_distributed_tpu.runtime.context import shard_map_on

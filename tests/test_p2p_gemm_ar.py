@@ -41,7 +41,6 @@ def test_gemm_ar_stream_matches_compose(ctx):
     sequential dot+AR compose and to the dense golden across repeated
     calls (parity flip), including a ragged row count that exercises the
     sublane padding."""
-    import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 

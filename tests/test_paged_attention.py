@@ -3,11 +3,10 @@ SURVEY.md §2.7) — append + attention vs numpy golden, ragged lengths."""
 
 import numpy as np
 
-import jax
 import jax.numpy as jnp
 
 from triton_distributed_tpu.ops.paged_attention import (
-    PagedKVCache, init_paged_kv_cache, paged_append, paged_decode_attention,
+    init_paged_kv_cache, paged_append, paged_decode_attention,
     paged_decode_attention_golden,
 )
 

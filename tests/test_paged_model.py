@@ -6,7 +6,6 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu.models.config import tiny_config
 from triton_distributed_tpu.models.dense import (

@@ -4,7 +4,6 @@ distributed flash-decode — goldens vs full dense attention on the 8-CPU mesh.
 
 import math
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

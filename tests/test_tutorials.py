@@ -47,9 +47,15 @@ def test_tutorial_runs(script):
 
 
 @pytest.mark.tutorials
+@pytest.mark.slow
 @pytest.mark.parametrize("script", [s for s in _ALL if s not in _FAST])
 def test_tutorial_runs_full_sweep(script):
-    """The remaining 8 tutorials — nightly tier (`pytest -m tutorials`)."""
+    """The remaining 8 tutorials — nightly tier (`pytest -m tutorials`).
+
+    Also marked ``slow``: a ``-m`` on the command line *replaces* the
+    addopts-level ``-m 'not tutorials'``, so without this the tier-1
+    sweep (``-m 'not slow'``) would silently run all 12 fresh-process
+    tutorials."""
     _run(script)
 
 

@@ -1,7 +1,6 @@
 """Two-level (ICI intra + DCN inter) collectives on a (2, 4) CPU mesh —
 the inter-slice tier the reference covers with NVSHMEM/IB (SURVEY.md §7)."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

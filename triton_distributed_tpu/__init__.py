@@ -22,6 +22,10 @@ ByteDance's Triton-distributed (reference layer map in SURVEY.md §1):
 
 __version__ = "0.1.0"
 
+from triton_distributed_tpu.runtime.jax_compat import ensure_jax_compat
+
+ensure_jax_compat()
+
 from triton_distributed_tpu.runtime import (  # noqa: F401
     initialize_distributed,
     get_context,

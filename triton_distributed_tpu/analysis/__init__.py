@@ -1,0 +1,26 @@
+"""comm-lint: static semaphore-protocol analysis for distributed kernels.
+
+The TPU port's protocols are *stricter* than the reference NVSHMEM ones:
+``semaphore_wait`` consumes counts, so every producer signal must be matched
+by exactly one consumer wait in deltas (language/distributed_ops.py module
+docstring). This package checks that — without TPU hardware — by replaying
+kernels per rank under a record layer that shims the device API surface
+(language/instrument.py) and then verifying protocol invariants over the
+resulting N-rank event logs:
+
+1. **delta-balance** — signals delivered to a rank equal counts waited;
+2. **deadlock** — cycle detection over the cross-rank wait-for graph;
+3. **un-awaited DMAs** — every ``start()`` has its fence/quiet obligation
+   discharged before kernel exit;
+4. **misuse lints** — ``SignalOp.SET``, waits on never-signalled
+   semaphores, signals addressed to a bad peer/axis.
+
+Entry points: :func:`analysis.commlint.main` (CLI:
+``python -m triton_distributed_tpu.analysis.commlint``),
+:func:`analysis.registry.analyze_op`, and the lower-level
+:class:`analysis.tracer.ReplaySession` for tracing ad-hoc kernels.
+"""
+
+from triton_distributed_tpu.analysis.checker import Report, Violation, check  # noqa: F401
+from triton_distributed_tpu.analysis.events import Event, TraceSet  # noqa: F401
+from triton_distributed_tpu.analysis.tracer import ReplaySession, trace_op  # noqa: F401

@@ -1,0 +1,259 @@
+"""Protocol-invariant checker over N-rank event traces.
+
+Four invariant classes (see ISSUE/docs/commlint.md):
+
+1. **delta-balance** — for every (rank, semaphore), the total amount
+   signalled/delivered to that rank equals the total amount its waits
+   consume. TPU ``semaphore_wait`` subtracts, so any imbalance is a real
+   protocol defect: leftover counts poison the next launch that reuses the
+   semaphore; overdrawn waits hang.
+2. **deadlock** — a greedy semaphore-machine replay of the traces. Signals
+   and DMA starts always retire (the fabric makes progress independently of
+   waiters); a wait retires only when its semaphore holds enough. If the
+   machine wedges, the blocked waits are reported, and a cycle in the
+   cross-rank wait-for graph is reported as a deadlock (the greedy schedule
+   is exact for this machine: retiring a signal early can only enable more
+   waits, never fewer, so a wedge is schedule-independent).
+3. **un-awaited DMAs** — leftover bytes on a send-role semaphore at kernel
+   exit: a ``start()`` whose fence/quiet obligation (``wait_send`` /
+   ``quiet`` / the equal-shape-handle wait idiom) was never discharged.
+4. **misuse lints** — ``SignalOp.SET`` (no TPU lowering), waits on
+   semaphores no rank ever signals, and peers addressed along a wrong axis
+   or out of range (collected during tracing + from the static pass).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import defaultdict
+from typing import Any
+
+from triton_distributed_tpu.analysis import events as ev
+
+# Violation kinds, most severe first (used for report ordering).
+KIND_ORDER = (
+    "deadlock",
+    "delta-imbalance",
+    "unawaited-dma",
+    "lint-set-signal",
+    "lint-unsignalled-wait",
+    "lint-bad-peer",
+    "lint-bad-axis",
+)
+
+
+@dataclasses.dataclass
+class Violation:
+    kind: str
+    message: str
+    rank: int | None = None
+    sem: str | None = None
+    site: str = ""
+
+    def to_json(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Report:
+    op: str
+    axes: tuple[str, ...]
+    dims: tuple[int, ...]
+    violations: list[Violation]
+    n_events: int
+    n_kernels: int
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "op": self.op,
+            "mesh": dict(zip(self.axes, self.dims)),
+            "ok": self.ok,
+            "n_events": self.n_events,
+            "n_kernels": self.n_kernels,
+            "violations": [v.to_json() for v in self.violations],
+        }
+
+
+def _fmt_amount(sem: str, amount: int, roles: set[str]) -> str:
+    unit = "bytes" if ({"send", "recv"} & roles) else "counts"
+    return f"{amount} {unit}"
+
+
+def check(ts: ev.TraceSet) -> Report:
+    violations: list[Violation] = []
+    n = ts.nranks
+
+    # --- misuse lints collected while tracing ------------------------------
+    for lint in ts.lints:
+        violations.append(Violation(kind=f"lint-{lint.kind}",
+                                    message=lint.message, rank=lint.rank,
+                                    site=lint.site))
+
+    # --- static accounting -------------------------------------------------
+    credits: dict[tuple[int, str], int] = defaultdict(int)
+    debits: dict[tuple[int, str], int] = defaultdict(int)
+    roles: dict[str, set[str]] = defaultdict(set)
+    first_site: dict[tuple[str, str], str] = {}
+    n_events = 0
+    n_kernels = 0
+    for rank_events in ts.events:
+        for e in rank_events:
+            n_events += 1
+            if e.kind == ev.ENTER:
+                n_kernels += 1
+            elif e.kind == ev.SIGNAL:
+                credits[(e.peer, e.sem)] += e.amount
+                roles[e.sem].add("signal")
+                first_site.setdefault(("signal", e.sem), e.site)
+            elif e.kind == ev.WAIT:
+                debits[(e.rank, e.sem)] += e.amount
+                roles[e.sem].add("wait")
+                first_site.setdefault(("wait", e.sem), e.site)
+            elif e.kind == ev.DMA_START:
+                if e.send_sem is not None:
+                    credits[(e.rank, e.send_sem)] += e.amount
+                    roles[e.send_sem].add("send")
+                    first_site.setdefault(("signal", e.send_sem), e.site)
+                credits[(e.peer, e.recv_sem)] += e.amount
+                roles[e.recv_sem].add("recv")
+                first_site.setdefault(("signal", e.recv_sem), e.site)
+
+    for key in sorted(set(credits) | set(debits)):
+        rank, sem = key
+        delta = credits.get(key, 0) - debits.get(key, 0)
+        if delta == 0:
+            continue
+        role = roles[sem]
+        if delta > 0 and "send" in role:
+            violations.append(Violation(
+                kind="unawaited-dma", rank=rank, sem=sem,
+                site=first_site.get(("signal", sem), ""),
+                message=(f"rank {rank}: {_fmt_amount(sem, delta, role)} of "
+                         f"DMA sends on {sem!r} never waited — missing "
+                         "wait_send()/quiet() before kernel exit")))
+        elif delta > 0:
+            what = "deliveries" if "recv" in role else "signals"
+            violations.append(Violation(
+                kind="delta-imbalance", rank=rank, sem=sem,
+                site=first_site.get(("signal", sem), ""),
+                message=(f"rank {rank}: {_fmt_amount(sem, delta, role)} of "
+                         f"{what} on {sem!r} never consumed — the wait "
+                         "delta undercounts its producers")))
+        else:
+            violations.append(Violation(
+                kind="delta-imbalance", rank=rank, sem=sem,
+                site=first_site.get(("wait", sem), ""),
+                message=(f"rank {rank}: waits on {sem!r} overdraw their "
+                         f"producers by {_fmt_amount(sem, -delta, role)} — "
+                         "the kernel hangs waiting for signals nobody "
+                         "sends")))
+
+    # Waits on semaphores that are never signalled anywhere, by anyone.
+    for sem, role in sorted(roles.items()):
+        if "wait" in role and not ({"signal", "send", "recv"} & role):
+            violations.append(Violation(
+                kind="lint-unsignalled-wait", sem=sem,
+                site=first_site.get(("wait", sem), ""),
+                message=(f"semaphore {sem!r} is waited but no rank ever "
+                         "signals it")))
+
+    # --- greedy semaphore-machine replay (schedulability) ------------------
+    counts: dict[tuple[int, str], int] = defaultdict(int)
+    pos = [0] * n
+    progress = True
+    while progress:
+        progress = False
+        for r in range(n):
+            while pos[r] < len(ts.events[r]):
+                e = ts.events[r][pos[r]]
+                if e.kind == ev.SIGNAL:
+                    counts[(e.peer, e.sem)] += e.amount
+                elif e.kind == ev.DMA_START:
+                    if e.send_sem is not None:
+                        counts[(r, e.send_sem)] += e.amount
+                    counts[(e.peer, e.recv_sem)] += e.amount
+                elif e.kind == ev.WAIT:
+                    if counts[(r, e.sem)] >= e.amount:
+                        counts[(r, e.sem)] -= e.amount
+                    else:
+                        break
+                pos[r] += 1
+                progress = True
+    stuck = [r for r in range(n) if pos[r] < len(ts.events[r])]
+    if stuck:
+        # Wait-for edges: a stuck rank waits for any rank holding future
+        # (unretired) events that would credit its semaphore.
+        blocked: dict[int, ev.Event] = {r: ts.events[r][pos[r]] for r in stuck}
+        edges: dict[int, set[int]] = {r: set() for r in stuck}
+        for r, w in blocked.items():
+            for p in range(n):
+                for e in ts.events[p][pos[p]:]:
+                    if ((e.kind == ev.SIGNAL and e.peer == r
+                         and e.sem == w.sem)
+                        or (e.kind == ev.DMA_START
+                            and ((e.peer == r and e.recv_sem == w.sem)
+                                 or (p == r and e.send_sem == w.sem)))):
+                        edges[r].add(p)
+                        break
+        cycle = _find_cycle(edges)
+        if cycle:
+            path = " -> ".join(str(r) for r in cycle + [cycle[0]])
+            details = "; ".join(
+                f"rank {r} blocked on {blocked[r].sem!r} "
+                f"needing {blocked[r].amount} at {blocked[r].site}"
+                for r in cycle)
+            violations.append(Violation(
+                kind="deadlock", rank=cycle[0], sem=blocked[cycle[0]].sem,
+                site=blocked[cycle[0]].site,
+                message=(f"signal/wait cycle across ranks {path}: "
+                         f"{details}")))
+        for r in stuck:
+            w = blocked[r]
+            if not edges[r]:
+                violations.append(Violation(
+                    kind="deadlock", rank=r, sem=w.sem, site=w.site,
+                    message=(f"rank {r} wedges waiting {w.amount} on "
+                             f"{w.sem!r} with no pending producer "
+                             "anywhere (starvation)")))
+
+    violations.sort(key=lambda v: (KIND_ORDER.index(v.kind)
+                                   if v.kind in KIND_ORDER else len(KIND_ORDER),
+                                   v.rank if v.rank is not None else -1,
+                                   v.sem or ""))
+    return Report(op=ts.op, axes=ts.axes, dims=ts.dims,
+                  violations=violations, n_events=n_events,
+                  n_kernels=n_kernels)
+
+
+def _find_cycle(edges: dict[int, set[int]]) -> list[int] | None:
+    """First cycle in the wait-for graph (DFS), restricted to stuck ranks."""
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {r: WHITE for r in edges}
+    stack: list[int] = []
+
+    def dfs(r: int) -> list[int] | None:
+        color[r] = GREY
+        stack.append(r)
+        for p in edges.get(r, ()):
+            if p not in color:
+                continue  # edge to a non-stuck rank cannot close a cycle
+            if color[p] == GREY:
+                return stack[stack.index(p):]
+            if color[p] == WHITE:
+                found = dfs(p)
+                if found:
+                    return found
+        color[r] = BLACK
+        stack.pop()
+        return None
+
+    for r in edges:
+        if color[r] == WHITE:
+            found = dfs(r)
+            if found:
+                return found
+    return None

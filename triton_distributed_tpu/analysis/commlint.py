@@ -1,0 +1,113 @@
+"""comm-lint CLI — sweep the ops library for protocol violations.
+
+Usage::
+
+    python -m triton_distributed_tpu.analysis.commlint --all
+    python -m triton_distributed_tpu.analysis.commlint --op allgather --op moe
+    python -m triton_distributed_tpu.analysis.commlint --all --ranks 2,4 \
+        --json /tmp/commlint.json
+
+Exit status 0 iff every analyzed op is protocol-clean. The JSON report is
+machine-readable (one entry per (op, mesh) with the violation list) for CI
+artifact upload.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _setup_jax() -> None:
+    import jax
+
+    # The analyzer replays on the host — never let a TPU plugin grab the
+    # process (the sandbox sitecustomize force-registers one).
+    jax.config.update("jax_platforms", "cpu")
+    from triton_distributed_tpu.runtime.interpret_workarounds import (
+        apply_interpret_workarounds,
+    )
+
+    apply_interpret_workarounds()
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="commlint",
+        description="Static semaphore-protocol analyzer for the distributed "
+                    "ops library (see docs/commlint.md).")
+    parser.add_argument("--all", action="store_true",
+                        help="analyze every registered op")
+    parser.add_argument("--op", action="append", default=[],
+                        help="analyze one op (repeatable)")
+    parser.add_argument("--ranks", default="2,4,8",
+                        help="comma-separated 1-D mesh sizes (default 2,4,8)")
+    parser.add_argument("--json", dest="json_path", default=None,
+                        help="write the machine-readable report here")
+    parser.add_argument("--list", action="store_true",
+                        help="list registered ops and exit")
+    parser.add_argument("-v", "--verbose", action="store_true",
+                        help="print per-violation details")
+    args = parser.parse_args(argv)
+
+    _setup_jax()
+    from triton_distributed_tpu.analysis.registry import analyze_op, build_registry
+
+    ranks = tuple(int(r) for r in args.ranks.split(",") if r)
+    registry = build_registry(ranks)
+    if args.list:
+        for name in sorted(registry):
+            meshes = ", ".join("x".join(map(str, dims))
+                               for _, dims in registry[name].meshes)
+            print(f"{name:24s} meshes: {meshes}")
+        return 0
+
+    names = sorted(registry) if args.all or not args.op else args.op
+    unknown = [n for n in names if n not in registry]
+    if unknown:
+        parser.error(f"unknown ops: {unknown}; --list shows the registry")
+
+    reports = []
+    failed = 0
+    for name in names:
+        t0 = time.time()
+        try:
+            reps = analyze_op(name, ranks)
+        except Exception as exc:  # a driver crash is a finding, not a pass
+            failed += 1
+            print(f"ERROR {name}: replay failed: {type(exc).__name__}: {exc}")
+            reports.append({"op": name, "ok": False,
+                            "error": f"{type(exc).__name__}: {exc}"})
+            continue
+        dt = time.time() - t0
+        for rep in reps:
+            reports.append(rep.to_json())
+            mesh = "x".join(map(str, rep.dims))
+            status = "OK " if rep.ok else "FAIL"
+            print(f"{status} {rep.op:32s} mesh={mesh:5s} "
+                  f"kernels={rep.n_kernels:3d} events={rep.n_events:6d} "
+                  f"violations={len(rep.violations)}  [{dt:.1f}s]")
+            if not rep.ok:
+                failed += 1
+                shown = rep.violations if args.verbose else rep.violations[:8]
+                for v in shown:
+                    where = f" @ {v.site}" if v.site else ""
+                    print(f"     [{v.kind}] {v.message}{where}")
+                if len(rep.violations) > len(shown):
+                    print(f"     ... {len(rep.violations) - len(shown)} more "
+                          "(use -v)")
+
+    if args.json_path:
+        with open(args.json_path, "w") as f:
+            json.dump({"ok": failed == 0, "reports": reports}, f, indent=2)
+        print(f"report written to {args.json_path}")
+
+    total = len(reports)
+    print(f"commlint: {total - failed}/{total} clean")
+    return 0 if failed == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

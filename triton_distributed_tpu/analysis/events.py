@@ -1,0 +1,105 @@
+"""Typed event model for the comm-lint tracer.
+
+One :class:`Event` is one protocol-relevant action observed while replaying
+a kernel on one rank. The unified currency is the semaphore **amount**:
+counts for regular semaphores (notify/wait), bytes for DMA semaphores
+(puts, local copies, wait_deliveries, wait_send) — matching the TPU
+semantics where DMA semaphores count bytes and regular ones count signals.
+The checker never needs to distinguish the two: balance and schedulability
+are the same arithmetic either way.
+
+Event kinds
+-----------
+``signal``     add ``amount`` to ``sem`` on rank ``peer`` (peer may be the
+               emitter itself — e.g. the re-signal of level-semantics waits).
+``wait``       block until own ``sem`` holds ``amount``, then subtract it.
+``dma_start``  begin an async copy of ``amount`` bytes: on completion the
+               fabric adds ``amount`` to ``send_sem`` on the emitter and to
+               ``recv_sem`` on ``peer`` (peer == emitter for local copies;
+               ``send_sem`` is None for local copies, which only carry a
+               completion semaphore).
+``xla``        an XLA-managed collective (ppermute/all_gather/...) — no
+               semaphore effect; recorded so traces document every
+               cross-rank dependency.
+``enter``/``exit``  kernel boundary markers (``note`` = kernel label); the
+               un-awaited-DMA obligation is evaluated at ``exit``.
+``straggle``   fault-injection spin observed (informational).
+
+Semaphore identity is a string label stable across ranks: scratch position
+within the kernel invocation plus concrete element indices (SPMD symmetry
+makes the same label name the same physical semaphore on every device).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+SIGNAL = "signal"
+WAIT = "wait"
+DMA_START = "dma_start"
+XLA = "xla"
+ENTER = "enter"
+EXIT = "exit"
+STRAGGLE = "straggle"
+
+KINDS = (SIGNAL, WAIT, DMA_START, XLA, ENTER, EXIT, STRAGGLE)
+
+
+@dataclasses.dataclass
+class Event:
+    kind: str
+    rank: int                    # flat rank id of the emitter
+    seq: int                     # per-rank program order
+    sem: str | None = None       # wait/signal semaphore label
+    peer: int | None = None      # target flat rank (signal / dma_start)
+    amount: int = 0              # counts (regular) or bytes (DMA)
+    send_sem: str | None = None  # dma_start only
+    recv_sem: str | None = None  # dma_start only
+    op: str = "add"              # signal op ("add" | "set")
+    site: str = ""               # kernel-source file:line of the call
+    note: str = ""               # kernel label / collective name
+
+    def to_json(self) -> dict[str, Any]:
+        # Drop only absent fields — peer=0 / amount=0 are meaningful
+        # (rank 0 is a real target), so filter on None/"" alone.
+        d = dataclasses.asdict(self)
+        return {k: v for k, v in d.items() if v is not None and v != ""}
+
+
+@dataclasses.dataclass
+class Lint:
+    """A misuse observation made *during* tracing (kind: ``set-signal``,
+    ``bad-peer``, ``bad-axis``)."""
+
+    kind: str
+    rank: int
+    message: str
+    site: str = ""
+
+
+@dataclasses.dataclass
+class TraceSet:
+    """The N-rank event logs of one op replay over one mesh."""
+
+    op: str
+    axes: tuple[str, ...]
+    dims: tuple[int, ...]
+    events: list[list[Event]]    # indexed by flat rank
+    lints: list[Lint]
+
+    @property
+    def nranks(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "op": self.op,
+            "axes": list(self.axes),
+            "dims": list(self.dims),
+            "events": [[e.to_json() for e in rank] for rank in self.events],
+            "lints": [dataclasses.asdict(lint) for lint in self.lints],
+        }

@@ -1,0 +1,264 @@
+"""Registered op drivers for the comm-lint sweep.
+
+Each driver invokes one op family's ``*_local`` entry points with small,
+deterministic, rank-independent inputs (the SPMD contract the tracer
+replays under — see tracer.trace_op). Shapes are chosen tiny but aligned
+(f32 sublane 8 / lane 128) so every protocol path is exercised with
+negligible compute; drivers cover each op's method variants, including the
+barrier-free parity streams (two calls, one per parity).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Sequence
+
+import numpy as np
+
+from triton_distributed_tpu.analysis.checker import Report, check
+from triton_distributed_tpu.analysis.tracer import trace_op
+
+
+def _arr(*shape, dtype=np.float32):
+    n = int(np.prod(shape))
+    return (np.arange(n, dtype=np.float32).reshape(shape) % 7).astype(dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class OpDriver:
+    name: str
+    run: Callable[[dict[str, int]], None]
+    meshes: tuple[tuple[tuple[str, ...], tuple[int, ...]], ...]
+
+
+def _meshes_1d(ranks: Sequence[int]):
+    return tuple((("tp",), (int(r),)) for r in ranks)
+
+
+_MESHES_2D = ((("x", "y"), (2, 2)), (("x", "y"), (2, 4)))
+_MESHES_DCN = ((("dcn", "tp"), (2, 2)), (("dcn", "tp"), (2, 4)))
+
+
+# ---------------------------------------------------------------------------
+# Drivers.
+# ---------------------------------------------------------------------------
+
+def _drv_allgather(d):
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.ops.allgather import (
+        AllGatherMethod, ag_stream_workspace, all_gather_local,
+        all_gather_stream,
+    )
+
+    n = d["tp"]
+    x = _arr(16, 128)
+    all_gather_local(x, axis="tp", num_ranks=n,
+                     method=AllGatherMethod.FULL_MESH_PUSH)
+    all_gather_local(x, axis="tp", num_ranks=n,
+                     method=AllGatherMethod.RING_1D)
+    ws, idx = ag_stream_workspace(n, 16, 128, jnp.float32)
+    _, ws, idx = all_gather_stream(x, ws, idx, axis="tp", num_ranks=n)
+    all_gather_stream(x, ws, idx, axis="tp", num_ranks=n)
+
+
+def _drv_reduce_scatter(d):
+    from triton_distributed_tpu.ops.reduce_scatter import reduce_scatter_local
+
+    n = d["tp"]
+    reduce_scatter_local(_arr(n * 16, 128), axis="tp", num_ranks=n)
+
+
+def _drv_allreduce(d):
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.ops.allreduce import (
+        AllReduceMethod, all_reduce_local, all_reduce_stream,
+        ar_stream_workspace,
+    )
+
+    n = d["tp"]
+    x = _arr(16, 128)
+    all_reduce_local(x, "tp", n, AllReduceMethod.ONE_SHOT)
+    all_reduce_local(x, "tp", n, AllReduceMethod.TWO_SHOT)
+    all_reduce_local(x, "tp", n, AllReduceMethod.TREE)
+    ws, idx = ar_stream_workspace(n, 16, 128, jnp.float32)
+    _, ws, idx = all_reduce_stream(x, ws, idx, axis="tp", num_ranks=n)
+    all_reduce_stream(x, ws, idx, axis="tp", num_ranks=n)
+
+
+def _drv_all_to_all(d):
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.ops.all_to_all import (
+        a2a_stream_workspace, fast_all_to_all_local, fast_all_to_all_stream,
+    )
+
+    n = d["tp"]
+    cap, hidden, epr = 32, 128, 2
+    send_buf = _arr(n, cap, hidden)
+    splits = jnp.asarray(np.full((n, epr), 3, np.int32))
+    fast_all_to_all_local(send_buf, splits, axis="tp", num_ranks=n)
+    ws, idx = a2a_stream_workspace(n, cap, hidden, jnp.float32)
+    _, _, ws, idx = fast_all_to_all_stream(send_buf, splits, ws, idx,
+                                           axis="tp", num_ranks=n)
+    fast_all_to_all_stream(send_buf, splits, ws, idx, axis="tp", num_ranks=n)
+
+
+def _drv_p2p(d):
+    from triton_distributed_tpu.ops.p2p import p2p_permute_local, p2p_shift_local
+
+    n = d["tp"]
+    x = _arr(16, 128)
+    p2p_shift_local(x, shift=1, axis="tp", num_ranks=n)
+    # A perm that is NOT a uniform ring shift, so the static-pair kernel
+    # (per-pair send sems, per-source recv sems) is the one traced.
+    perm = ((0, 1),) if n == 2 else ((0, 1), (1, 2), (2, 0))
+    p2p_permute_local(x, perm, axis="tp", num_ranks=n)
+
+
+def _drv_allgather_gemm(d):
+    from triton_distributed_tpu.ops.allgather_gemm import ag_gemm_local
+
+    n = d["tp"]
+    ag_gemm_local(_arr(16, 128), _arr(128, 128), axis="tp", num_ranks=n)
+
+
+def _drv_gemm_reduce_scatter(d):
+    from triton_distributed_tpu.ops.gemm_reduce_scatter import gemm_rs_local
+
+    n = d["tp"]
+    gemm_rs_local(_arr(n * 16, 128), _arr(128, 128), axis="tp", num_ranks=n)
+
+
+def _drv_gemm_allreduce(d):
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.ops.gemm_allreduce import (
+        gemm_ar_stream, gemm_ar_stream_workspace,
+    )
+
+    n = d["tp"]
+    x, b = _arr(8, 128), _arr(128, 256)
+    ws, idx = gemm_ar_stream_workspace(n, 8, 256, jnp.float32, n_chunks=2)
+    _, ws, idx = gemm_ar_stream(x, b, ws, idx, axis="tp", num_ranks=n,
+                                n_chunks=2)
+    gemm_ar_stream(x, b, ws, idx, axis="tp", num_ranks=n, n_chunks=2)
+
+
+def _drv_flash_decode(d):
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.ops.flash_decode import flash_decode_local
+
+    n = d["tp"]
+    b, hq, hkv, dh, s = 2, 4, 2, 64, 8  # d % 128 != 0 -> dense partial path
+    q = _arr(b, hq, dh)
+    k = _arr(b, s, hkv, dh)
+    flash_decode_local(q, k, k, jnp.int32(s), axis="tp", num_ranks=n,
+                       method="pallas")
+
+
+def _drv_moe(d):
+    from triton_distributed_tpu.ops.moe import moe_tp_fwd_local
+
+    n = d["tp"]
+    h, ffn, E, topk, M = 128, 128, 4, 2, n * 8
+    x = _arr(M // n, h)
+    gate_w = _arr(h, E)
+    wg, wu = _arr(E, h, ffn), _arr(E, h, ffn)
+    wd = _arr(E, ffn, h)
+    # ring: ppermute rotation + Pallas ring ReduceScatter combine.
+    moe_tp_fwd_local(x, gate_w, wg, wu, wd, topk, axis="tp", num_ranks=n,
+                     mode="ring")
+    # overlap: Pallas full-mesh AllGather + overlapped RS tail.
+    moe_tp_fwd_local(x, gate_w, wg, wu, wd, topk, axis="tp", num_ranks=n,
+                     mode="overlap")
+
+
+def _drv_ulysses(d):
+    from triton_distributed_tpu.ops.ulysses import ulysses_attention_local
+
+    n = d["tp"]
+    q = _arr(1, 16, 8, 64)
+    ulysses_attention_local(q, q, q, axis="tp", num_ranks=n)
+
+
+def _drv_ring_attention(d):
+    from triton_distributed_tpu.ops.ring_attention import ring_attention_local
+
+    n = d["tp"]
+    q = _arr(1, 16, 2, 64)
+    ring_attention_local(q, q, q, axis="tp", num_ranks=n)
+
+
+def _drv_sp_ag_attention(d):
+    from triton_distributed_tpu.ops.sp_ag_attention import sp_ag_attention_local
+
+    n = d["tp"]
+    q = _arr(1, 8, 2, 64)
+    sp_ag_attention_local(q, q, q, axis="tp", num_ranks=n)
+
+
+def _drv_two_level(d):
+    from triton_distributed_tpu.ops.two_level import (
+        all_gather_2d_local, all_reduce_2d_local, reduce_scatter_2d_local,
+    )
+
+    n_inter, n_intra = d["dcn"], d["tp"]
+    kw = dict(intra_axis="tp", inter_axis="dcn", n_intra=n_intra,
+              n_inter=n_inter)
+    all_gather_2d_local(_arr(16, 128), **kw)
+    reduce_scatter_2d_local(_arr(n_inter * n_intra * 8, 128), **kw)
+    all_reduce_2d_local(_arr(16, 128), **kw)
+
+
+def _drv_multi_axis(d):
+    from triton_distributed_tpu.ops.multi_axis import (
+        all_gather_torus_local, all_reduce_torus_local,
+        reduce_scatter_torus_local,
+    )
+
+    n0, n1 = d["x"], d["y"]
+    dims = (n0, n1)
+    all_gather_torus_local(_arr(8, 128), axes=("x", "y"), dims=dims)
+    all_reduce_torus_local(_arr(16, 128), axes=("x", "y"), dims=dims,
+                           method="one_shot")
+    all_reduce_torus_local(_arr(16, 128), axes=("x", "y"), dims=dims,
+                           method="two_shot")
+    reduce_scatter_torus_local(_arr(n0 * n1 * 8, 128), axes=("x", "y"),
+                               dims=dims)
+
+
+def build_registry(ranks: Sequence[int] = (2, 4, 8)) -> dict[str, OpDriver]:
+    m1 = _meshes_1d(ranks)
+    return {
+        "allgather": OpDriver("allgather", _drv_allgather, m1),
+        "reduce_scatter": OpDriver("reduce_scatter", _drv_reduce_scatter, m1),
+        "allreduce": OpDriver("allreduce", _drv_allreduce, m1),
+        "all_to_all": OpDriver("all_to_all", _drv_all_to_all, m1),
+        "p2p": OpDriver("p2p", _drv_p2p, m1),
+        "allgather_gemm": OpDriver("allgather_gemm", _drv_allgather_gemm, m1),
+        "gemm_reduce_scatter": OpDriver("gemm_reduce_scatter",
+                                        _drv_gemm_reduce_scatter, m1),
+        "gemm_allreduce": OpDriver("gemm_allreduce", _drv_gemm_allreduce, m1),
+        "flash_decode": OpDriver("flash_decode", _drv_flash_decode, m1),
+        "moe": OpDriver("moe", _drv_moe, m1),
+        "ulysses": OpDriver("ulysses", _drv_ulysses, m1),
+        "ring_attention": OpDriver("ring_attention", _drv_ring_attention, m1),
+        "sp_ag_attention": OpDriver("sp_ag_attention", _drv_sp_ag_attention,
+                                    m1),
+        "two_level": OpDriver("two_level", _drv_two_level, _MESHES_DCN),
+        "multi_axis": OpDriver("multi_axis", _drv_multi_axis, _MESHES_2D),
+    }
+
+
+def analyze_op(name: str, ranks: Sequence[int] = (2, 4, 8)) -> list[Report]:
+    """Trace + check one registered op across its meshes."""
+    driver = build_registry(ranks)[name]
+    reports = []
+    for axes, dims in driver.meshes:
+        ts = trace_op(driver.run, axes=axes, dims=dims,
+                      name=f"{name}@{'x'.join(map(str, dims))}")
+        reports.append(check(ts))
+    return reports

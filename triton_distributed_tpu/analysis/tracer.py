@@ -1,0 +1,888 @@
+"""Record/replay tracer: per-rank event extraction without TPU hardware.
+
+How it works
+------------
+A distributed Pallas kernel here is SPMD: every rank runs the same program,
+parameterized only by ``rank(axis)``. The tracer exploits that: instead of
+executing the kernel under shard_map, it replays the op's ``*_local``
+function once per rank with the device API surface shimmed
+(language/instrument.py lists the patch points):
+
+* ``rank``/``axis_index`` return a *concrete* int (the rank being replayed),
+  so every peer computation, ``pl.when`` predicate and loop bound is
+  concrete Python arithmetic;
+* ``pl.pallas_call`` (for grid-less comm kernels) returns a harness that
+  allocates numpy-backed :class:`FakeRef` buffers for inputs/outputs/
+  scratch and runs the kernel body eagerly — compute runs as ordinary
+  eager jnp on the fake buffers, while every put/signal/wait/copy shim
+  appends a typed :class:`~.events.Event` to the current rank's log;
+* grid/grid_spec kernels (pure-compute GEMM/flash/paged) pass through to
+  the real interpret-mode ``pallas_call`` — they emit no protocol events;
+* XLA collectives (``ppermute``/``all_gather``/``all_to_all``/``psum*``)
+  are emulated shape-faithfully under the SPMD-identical-input assumption
+  (every replayed rank is fed the same arrays, so "receive from peer p"
+  returns the local value) and recorded as informational events.
+
+Semaphore identity: scratch position within the kernel invocation plus
+concrete element indices (``"k_ag#0/sem1[2]"``). SPMD symmetry makes the
+same label name the same physical semaphore on every rank, which is what
+lets the checker match rank r's waits against peers' signals.
+
+Data values on remote paths are NOT propagated (rank r's replay never sees
+rank p's buffers) — the analyzer checks protocols, not numerics; the
+numeric goldens live in tests/.
+"""
+
+from __future__ import annotations
+
+import itertools
+import sys
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from triton_distributed_tpu.analysis import events as ev
+from triton_distributed_tpu.language import instrument
+
+_SESSION: "ReplaySession | None" = None
+_ORIG: dict[str, Any] = {}
+
+
+def _concrete(v) -> int:
+    """Best-effort int() of a replay value (python/np/concrete jax)."""
+    return int(v)
+
+
+def _np_dtype(dt):
+    import jax.numpy as jnp
+
+    return np.dtype(jnp.dtype(dt))
+
+
+def _site() -> str:
+    f = sys._getframe(2)
+    for _ in range(30):
+        if f is None:
+            return ""
+        fn = f.f_code.co_filename
+        if ("/analysis/" not in fn and "/jax/" not in fn
+                and "site-packages" not in fn and fn != "<string>"):
+            marker = "triton_distributed_tpu/"
+            cut = fn.rfind(marker)
+            short = fn[cut:] if cut >= 0 else fn.rsplit("/", 2)[-1]
+            return f"{short}:{f.f_lineno}"
+        f = f.f_back
+    return ""
+
+
+# ---------------------------------------------------------------------------
+# Fake device objects.
+# ---------------------------------------------------------------------------
+
+def _norm_index(idx) -> tuple:
+    if not isinstance(idx, tuple):
+        idx = (idx,)
+    out = []
+    for x in idx:
+        if isinstance(x, slice) or x is Ellipsis or x is None:
+            out.append(x)
+        elif hasattr(x, "start") and hasattr(x, "size"):
+            start = _concrete(x.start)
+            out.append(slice(start, start + _concrete(x.size)))
+        else:
+            out.append(_concrete(x))
+    return tuple(out)
+
+
+class FakeRef:
+    """Numpy-backed stand-in for a Pallas memory ref (HBM/VMEM/SMEM).
+
+    Supports the idioms kernels use: ``ref[...]`` reads (returns the numpy
+    view), ``ref[...] = v`` writes, ``ref.at[i, pl.ds(a, b)]`` sub-refs
+    (numpy views, so writes alias through), shape/dtype/nbytes.
+    """
+
+    __slots__ = ("_arr",)
+
+    def __init__(self, arr: np.ndarray):
+        self._arr = arr
+
+    @property
+    def shape(self):
+        return self._arr.shape
+
+    @property
+    def dtype(self):
+        return self._arr.dtype
+
+    @property
+    def ndim(self):
+        return self._arr.ndim
+
+    @property
+    def nbytes(self) -> int:
+        return int(self._arr.nbytes)
+
+    @property
+    def at(self):
+        return _RefAt(self)
+
+    def __getitem__(self, idx):
+        return self._arr[_norm_index(idx)]
+
+    def __setitem__(self, idx, val):
+        self._arr[_norm_index(idx)] = np.asarray(val).astype(
+            self._arr.dtype, copy=False)
+
+    def __array__(self, dtype=None):
+        return self._arr if dtype is None else self._arr.astype(dtype)
+
+    def __repr__(self):
+        return f"FakeRef(shape={self.shape}, dtype={self.dtype})"
+
+
+class _RefAt:
+    __slots__ = ("_ref",)
+
+    def __init__(self, ref: FakeRef):
+        self._ref = ref
+
+    def __getitem__(self, idx) -> FakeRef:
+        return FakeRef(self._ref._arr[_norm_index(idx)])
+
+
+class FakeSem:
+    """A semaphore (or sub-element of a semaphore array) named by a label
+    stable across ranks."""
+
+    __slots__ = ("label",)
+
+    def __init__(self, label: str):
+        self.label = label
+
+    @property
+    def at(self):
+        return _SemAt(self)
+
+    def __repr__(self):
+        return f"FakeSem({self.label})"
+
+
+class _SemAt:
+    __slots__ = ("_sem",)
+
+    def __init__(self, sem: FakeSem):
+        self._sem = sem
+
+    def __getitem__(self, idx) -> FakeSem:
+        ii = _norm_index(idx)
+        return FakeSem(self._sem.label + "".join(f"[{i}]" for i in ii))
+
+
+def _sem_label(sem) -> str:
+    return sem.label if isinstance(sem, FakeSem) else str(sem)
+
+
+class LocalHandle:
+    """Handle of a local ``make_async_copy`` (one completion semaphore,
+    byte-counting). Also models the unstarted equal-shape wait idiom."""
+
+    def __init__(self, sess, src: FakeRef, dst, sem):
+        self._s = sess
+        self._src = src
+        self._dst = dst
+        self._sem = _sem_label(sem)
+        self.nbytes = src.nbytes
+
+    def start(self):
+        self._s.emit(ev.DMA_START, recv_sem=self._sem, peer=self._s.flat,
+                     amount=self.nbytes)
+        if isinstance(self._dst, FakeRef) and self._dst.shape == self._src.shape:
+            self._dst._arr[...] = self._src._arr.astype(
+                self._dst._arr.dtype, copy=False)
+        return self
+
+    def wait(self):
+        self._s.emit(ev.WAIT, sem=self._sem, amount=self.nbytes)
+
+    wait_recv = wait
+    # A local copy has one completion semaphore; draining it is what
+    # quiet()/wait_send means for this handle in the replay model.
+    wait_send = wait
+
+
+class RemoteHandle:
+    """Handle of a remote put: send semaphore credits the source on
+    completion, recv semaphore credits the destination on delivery."""
+
+    def __init__(self, sess, send_sem, recv_sem, nbytes: int, peer: int):
+        self._s = sess
+        self.send_label = _sem_label(send_sem) if send_sem is not None else None
+        self.recv_label = _sem_label(recv_sem)
+        self.nbytes = nbytes
+        self.peer = peer
+
+    def start(self):
+        self._s.emit(ev.DMA_START, send_sem=self.send_label,
+                     recv_sem=self.recv_label, peer=self.peer,
+                     amount=self.nbytes)
+        return self
+
+    def wait_send(self):
+        self._s.emit(ev.WAIT, sem=self.send_label, amount=self.nbytes)
+
+    def wait_recv(self):
+        self._s.emit(ev.WAIT, sem=self.recv_label, amount=self.nbytes)
+
+    def wait(self):
+        self.wait_send()
+        self.wait_recv()
+
+
+# ---------------------------------------------------------------------------
+# The replay session.
+# ---------------------------------------------------------------------------
+
+class ReplaySession:
+    """Per-mesh replay state: current rank, per-rank event logs, kernel
+    invocation counters (semaphore label scope), pipeline coords."""
+
+    def __init__(self, axes: Sequence[str], dims: Sequence[int]):
+        self.axes = tuple(axes)
+        self.dims = tuple(int(d) for d in dims)
+        self.nranks = int(np.prod(self.dims))
+        self.traces: list[list[ev.Event]] = [[] for _ in range(self.nranks)]
+        self.lints: list[ev.Lint] = []
+        self.coords: dict[str, int] = {}
+        self.flat = 0
+        self.seq = 0
+        self.kcount = 0
+        self.kstack: list[str] = []
+        self.pipe: list[tuple[tuple, tuple]] = []   # (grid, current idx)
+
+    def begin_rank(self, coords: dict[str, int]) -> None:
+        self.coords = dict(coords)
+        self.flat = self.flat_of(coords)
+        self.seq = 0
+        self.kcount = 0
+        self.kstack = []
+        self.pipe = []
+
+    def flat_of(self, coords: dict[str, int]) -> int:
+        flat = 0
+        for ax, d in zip(self.axes, self.dims):
+            flat = flat * d + int(coords[ax]) % d
+        return flat
+
+    def emit(self, kind: str, **kw) -> ev.Event:
+        e = ev.Event(kind=kind, rank=self.flat, seq=self.seq,
+                     site=_site(), **kw)
+        self.seq += 1
+        self.traces[self.flat].append(e)
+        return e
+
+    def lint(self, kind: str, message: str) -> None:
+        self.lints.append(ev.Lint(kind=kind, rank=self.flat,
+                                  message=message, site=_site()))
+
+    def kernel_prefix(self) -> str:
+        return self.kstack[-1] if self.kstack else "host"
+
+    def resolve_peer(self, peer, axis: str | None = None) -> int:
+        """Translate a peer spec (index-along-axis, mesh-coordinate dict,
+        or raw logical id) into a flat rank, recording misuse lints."""
+        if axis is not None:
+            if axis not in self.axes:
+                self.lint("bad-axis",
+                          f"peer addressed along axis {axis!r} which is not "
+                          f"in the mesh {self.axes}")
+                return self.flat
+            p = _concrete(peer)
+            d = self.dims[self.axes.index(axis)]
+            if not 0 <= p < d:
+                self.lint("bad-peer",
+                          f"peer {p} outside axis {axis!r} of size {d}")
+                p %= d
+            coords = dict(self.coords)
+            coords[axis] = p
+            return self.flat_of(coords)
+        if isinstance(peer, dict):
+            coords = dict(self.coords)
+            for ax, v in peer.items():
+                if ax not in self.axes:
+                    self.lint("bad-axis",
+                              f"mesh coordinate names unknown axis {ax!r} "
+                              f"(mesh axes: {self.axes})")
+                    continue
+                d = self.dims[self.axes.index(ax)]
+                v = _concrete(v)
+                if not 0 <= v < d:
+                    self.lint("bad-peer",
+                              f"coordinate {v} outside axis {ax!r} of size {d}")
+                    v %= d
+                coords[ax] = v
+            return self.flat_of(coords)
+        p = _concrete(peer)
+        if not 0 <= p < self.nranks:
+            self.lint("bad-peer",
+                      f"logical device id {p} outside mesh of {self.nranks}")
+            p %= self.nranks
+        return p
+
+    def traceset(self, op: str) -> ev.TraceSet:
+        return ev.TraceSet(op=op, axes=self.axes, dims=self.dims,
+                           events=self.traces, lints=self.lints)
+
+
+# ---------------------------------------------------------------------------
+# Shims. Each delegates to the captured original whenever it is not
+# operating on replay objects (so real interpret-mode kernels traced
+# *inside* a replay — flash/GEMM compute — keep working).
+# ---------------------------------------------------------------------------
+
+def _is_tracer(x) -> bool:
+    import jax
+
+    return isinstance(x, jax.core.Tracer)
+
+
+def _trace_clean() -> bool:
+    import jax
+
+    try:
+        return jax.core.trace_state_clean()
+    except AttributeError:  # pragma: no cover - newer jax
+        return True
+
+
+def _sh_rank(axis: str = "tp"):
+    s = _SESSION
+    if s is None or axis not in s.coords:
+        return _ORIG["rank"](axis)
+    return s.coords[axis]
+
+
+def _sh_num_ranks(axis: str = "tp"):
+    s = _SESSION
+    if s is None or axis not in s.coords:
+        return _ORIG["num_ranks"](axis)
+    return s.dims[s.axes.index(axis)]
+
+
+def _sh_wait(sem, value: int = 1):
+    s = _SESSION
+    if s is None or not isinstance(sem, FakeSem):
+        return _ORIG["wait"](sem, value)
+    s.emit(ev.WAIT, sem=sem.label, amount=_concrete(value))
+    return 0
+
+
+def _lint_signal_op(s: "ReplaySession", op) -> str:
+    """Shared lint-path twin of distributed_ops.check_signal_op: record
+    (instead of raise) the SET misuse and return the op name for the
+    event."""
+    from triton_distributed_tpu.language.distributed_ops import SignalOp
+
+    if op is not None and op is not SignalOp.ADD:
+        s.lint("set-signal",
+               "SignalOp.SET signalled — TPU semaphores only ADD; "
+               "rewrite the protocol in deltas")
+        return "set"
+    return "add"
+
+
+def _sh_notify(sem, peer, inc: int = 1, axis_type=None, op=None):
+    s = _SESSION
+    if s is None or not isinstance(sem, FakeSem):
+        kw = {} if op is None else {"op": op}
+        if axis_type is None:
+            return _ORIG["notify"](sem, peer, inc, **kw)
+        return _ORIG["notify"](sem, peer, inc, axis_type, **kw)
+    s.emit(ev.SIGNAL, sem=sem.label, peer=s.resolve_peer(peer),
+           amount=_concrete(inc), op=_lint_signal_op(s, op))
+
+
+def _sh_maybe_straggle(straggler, me):
+    s = _SESSION
+    if s is None:
+        return _ORIG["maybe_straggle"](straggler, me)
+    if straggler is None:
+        return
+    try:
+        s_rank = _concrete(straggler[0])
+    except (TypeError, ValueError):
+        return  # symbolic ("rotate" unresolved) — no event
+    if _concrete(me) == s_rank:
+        s.emit(ev.STRAGGLE, amount=_concrete(straggler[1]))
+
+
+def _sh_putmem_nbi_block(src_ref, dst_ref, send_sem, recv_sem, peer,
+                         axis: str | None = None):
+    s = _SESSION
+    if s is None or not isinstance(src_ref, FakeRef):
+        return _ORIG["putmem_nbi_block"](src_ref, dst_ref, send_sem,
+                                         recv_sem, peer, axis)
+    h = RemoteHandle(s, send_sem, recv_sem, src_ref.nbytes,
+                     s.resolve_peer(peer, axis))
+    return h.start()
+
+
+def _sh_putmem_block(src_ref, dst_ref, send_sem, recv_sem, peer,
+                     axis: str | None = None):
+    s = _SESSION
+    if s is None or not isinstance(src_ref, FakeRef):
+        return _ORIG["putmem_block"](src_ref, dst_ref, send_sem, recv_sem,
+                                     peer, axis)
+    h = _sh_putmem_nbi_block(src_ref, dst_ref, send_sem, recv_sem, peer, axis)
+    h.wait_send()
+    return h
+
+
+def _sh_putmem_signal_nbi_block(src_ref, dst_ref, send_sem, recv_sem, peer,
+                                axis: str | None = None):
+    s = _SESSION
+    if s is None or not isinstance(src_ref, FakeRef):
+        return _ORIG["putmem_signal_nbi_block"](src_ref, dst_ref, send_sem,
+                                                recv_sem, peer, axis)
+    return _sh_putmem_nbi_block(src_ref, dst_ref, send_sem, recv_sem, peer,
+                                axis)
+
+
+def _sh_signal_op(sem, peer, inc: int = 1, axis: str | None = None, op=None):
+    s = _SESSION
+    if s is None or not isinstance(sem, FakeSem):
+        return _ORIG["signal_op"](sem, peer, inc, axis, op=op)
+    s.emit(ev.SIGNAL, sem=sem.label, peer=s.resolve_peer(peer, axis),
+           amount=_concrete(inc), op=_lint_signal_op(s, op))
+
+
+def _sh_signal_wait_until(sem, value: int, consume: bool = True):
+    s = _SESSION
+    if s is None or not isinstance(sem, FakeSem):
+        return _ORIG["signal_wait_until"](sem, value, consume)
+    v = _concrete(value)
+    s.emit(ev.WAIT, sem=sem.label, amount=v)
+    if not consume:
+        s.emit(ev.SIGNAL, sem=sem.label, peer=s.flat, amount=v)
+
+
+def _sh_barrier_all(axis: str = "tp"):
+    s = _SESSION
+    if s is None:
+        return _ORIG["barrier_all"](axis)
+    if axis not in s.coords:
+        s.lint("bad-axis", f"barrier_all over unknown axis {axis!r}")
+        return
+    label = f"{s.kernel_prefix()}/barrier"
+    n = s.dims[s.axes.index(axis)]
+    me = s.coords[axis]
+    for i in range(n - 1):
+        s.emit(ev.SIGNAL, sem=label, amount=1,
+               peer=s.resolve_peer((me + 1 + i) % n, axis))
+    s.emit(ev.WAIT, sem=label, amount=n - 1)
+
+
+def _sh_sync_all(axis: str = "tp"):
+    s = _SESSION
+    if s is None:
+        return _ORIG["sync_all"](axis)
+    _sh_barrier_all(axis)
+
+
+def _sh_barrier_grid(axes):
+    s = _SESSION
+    if s is None:
+        return _ORIG["barrier_grid"](axes)
+    label = f"{s.kernel_prefix()}/barrier"
+    dims = []
+    for ax in axes:
+        if ax not in s.coords:
+            s.lint("bad-axis", f"barrier_grid over unknown axis {ax!r}")
+            return
+        dims.append(s.dims[s.axes.index(ax)])
+    total = int(np.prod(dims))
+    for coord in itertools.product(*[range(d) for d in dims]):
+        s.emit(ev.SIGNAL, sem=label, amount=1,
+               peer=s.resolve_peer(dict(zip(axes, coord))))
+    s.emit(ev.WAIT, sem=label, amount=total)
+
+
+def _sh_quiet(*handles):
+    s = _SESSION
+    if s is None:
+        return _ORIG["quiet"](*handles)
+    for h in handles:
+        h.wait_send()
+
+
+def _sh_wait_deliveries(like_ref, sem, count: int):
+    s = _SESSION
+    if s is None or not isinstance(sem, FakeSem):
+        return _ORIG["wait_deliveries"](like_ref, sem, count)
+    s.emit(ev.WAIT, sem=sem.label,
+           amount=_concrete(count) * int(like_ref.nbytes))
+
+
+# --- pallas/pallas-tpu shims ------------------------------------------------
+
+def _sh_make_async_copy(src_ref, dst_ref, sem):
+    s = _SESSION
+    if s is None or not isinstance(src_ref, FakeRef):
+        return _ORIG["make_async_copy"](src_ref, dst_ref, sem)
+    return LocalHandle(s, src_ref, dst_ref, sem)
+
+
+def _sh_make_async_remote_copy(src_ref=None, dst_ref=None, send_sem=None,
+                               recv_sem=None, device_id=None,
+                               device_id_type=None, **kw):
+    s = _SESSION
+    if s is None or not isinstance(src_ref, FakeRef):
+        return _ORIG["make_async_remote_copy"](
+            src_ref=src_ref, dst_ref=dst_ref, send_sem=send_sem,
+            recv_sem=recv_sem, device_id=device_id,
+            device_id_type=device_id_type, **kw)
+    return RemoteHandle(s, send_sem, recv_sem, src_ref.nbytes,
+                        s.resolve_peer(device_id))
+
+
+def _sh_semaphore_signal(sem, inc: int = 1, *, device_id=None,
+                         device_id_type=None, **kw):
+    s = _SESSION
+    if s is None or not isinstance(sem, FakeSem):
+        return _ORIG["semaphore_signal"](sem, inc, device_id=device_id,
+                                         device_id_type=device_id_type, **kw)
+    peer = s.flat if device_id is None else s.resolve_peer(device_id)
+    s.emit(ev.SIGNAL, sem=sem.label, peer=peer, amount=_concrete(inc))
+
+
+def _sh_semaphore_wait(sem, value: int = 1):
+    s = _SESSION
+    if s is None or not isinstance(sem, FakeSem):
+        return _ORIG["semaphore_wait"](sem, value)
+    s.emit(ev.WAIT, sem=sem.label, amount=_concrete(value))
+
+
+def _sh_get_barrier_semaphore():
+    s = _SESSION
+    if s is None:
+        return _ORIG["get_barrier_semaphore"]()
+    return FakeSem(f"{s.kernel_prefix()}/barrier")
+
+
+def _sh_when(condition):
+    s = _SESSION
+    if s is None or _is_tracer(condition):
+        return _ORIG["when"](condition)
+
+    def _wrapped(f):
+        if bool(condition):
+            f()
+
+    return _wrapped
+
+
+def _sh_program_id(axis: int):
+    s = _SESSION
+    if s is None or not s.pipe:
+        return _ORIG["program_id"](axis)
+    return s.pipe[-1][1][axis]
+
+
+def _sh_num_programs(axis: int):
+    s = _SESSION
+    if s is None or not s.pipe:
+        return _ORIG["num_programs"](axis)
+    return s.pipe[-1][0][axis]
+
+
+def _block_view(ref: FakeRef, spec, idx) -> FakeRef:
+    bs = getattr(spec, "block_shape", None)
+    im = getattr(spec, "index_map", None)
+    if bs is None or im is None:
+        return ref
+    coords = im(*idx)
+    if not isinstance(coords, tuple):
+        coords = (coords,)
+    slices = tuple(slice(_concrete(c) * b, (_concrete(c) + 1) * b)
+                   for c, b in zip(coords, bs))
+    return FakeRef(ref._arr[slices])
+
+
+def _sh_emit_pipeline(body, *, grid, in_specs=None, out_specs=None, **kw):
+    def run(*refs, scratches=(), **rkw):
+        s = _SESSION
+        if s is None or not any(isinstance(r, FakeRef) for r in refs):
+            return _ORIG["emit_pipeline"](
+                body, grid=grid, in_specs=in_specs, out_specs=out_specs,
+                **kw)(*refs, scratches=scratches, **rkw)
+        specs = list(in_specs or []) + list(out_specs or [])
+        grid_t = tuple(_concrete(g) for g in grid)
+        s.pipe.append((grid_t, (0,) * len(grid_t)))
+        try:
+            for idx in np.ndindex(*grid_t):
+                s.pipe[-1] = (grid_t, idx)
+                views = [_block_view(r, sp, idx)
+                         for r, sp in zip(refs, specs)]
+                body(*views, *scratches)
+        finally:
+            s.pipe.pop()
+
+    return run
+
+
+def _fake_scratch(obj, prefix: str, i: int):
+    dt = getattr(obj, "dtype", None)
+    if type(obj).__name__ == "SemaphoreType":  # bare enum member, shape ()
+        return FakeSem(f"{prefix}/sem{i}")
+    if dt is not None and "sem" in str(dt).lower():
+        return FakeSem(f"{prefix}/sem{i}")
+    return FakeRef(np.zeros(obj.shape, _np_dtype(dt)))
+
+
+def _sh_pallas_call(*args, **kwargs):
+    import jax.numpy as jnp
+
+    s = _SESSION
+    kernel = args[0] if args else kwargs.get("kernel")
+    if (s is None or kwargs.get("grid") or kwargs.get("grid_spec") is not None
+            or (len(args) > 1)):
+        return _ORIG["pallas_call"](*args, **kwargs)
+    out_shape = kwargs.get("out_shape")
+    scratch_shapes = kwargs.get("scratch_shapes") or ()
+    io_aliases = dict(kwargs.get("input_output_aliases") or {})
+    kname = getattr(getattr(kernel, "func", kernel), "__name__", "kernel")
+
+    def call(*op_args):
+        kidx = s.kcount
+        s.kcount += 1
+        prefix = f"{kname}#{kidx}"
+        ins = [FakeRef(np.array(np.asarray(a))) for a in op_args]
+        single = not isinstance(out_shape, (tuple, list))
+        out_structs = [out_shape] if single else list(out_shape)
+        outs = [FakeRef(np.zeros(o.shape, _np_dtype(o.dtype)))
+                for o in out_structs]
+        for i_in, i_out in io_aliases.items():
+            outs[i_out]._arr[...] = ins[i_in]._arr.astype(
+                outs[i_out]._arr.dtype, copy=False)
+        scratch = [_fake_scratch(o, prefix, i)
+                   for i, o in enumerate(scratch_shapes)]
+        s.kstack.append(prefix)
+        s.emit(ev.ENTER, note=prefix)
+        try:
+            kernel(*ins, *outs, *scratch)
+        finally:
+            s.emit(ev.EXIT, note=prefix)
+            s.kstack.pop()
+        if single:
+            return jnp.asarray(outs[0]._arr)
+        return tuple(jnp.asarray(o._arr) for o in outs)
+
+    return call
+
+
+# --- jax.lax shims ----------------------------------------------------------
+
+def _sh_axis_index(axis):
+    s = _SESSION
+    if s is None or isinstance(axis, (tuple, list)) or axis not in s.coords:
+        return _ORIG["axis_index"](axis)
+    return s.coords[axis]
+
+
+def _axis_total(s: "ReplaySession", axis_name) -> int:
+    names = axis_name if isinstance(axis_name, (tuple, list)) else (axis_name,)
+    total = 1
+    for ax in names:
+        total *= s.dims[s.axes.index(ax)]
+    return total
+
+
+def _sh_axis_size(axis):
+    s = _SESSION
+    names = axis if isinstance(axis, (tuple, list)) else (axis,)
+    if s is None or any(ax not in s.coords for ax in names):
+        return _ORIG["axis_size"](axis)
+    return _axis_total(s, axis)
+
+
+def _sh_fori_loop(lower, upper, body, init_val, **kw):
+    import jax.numpy as jnp
+
+    s = _SESSION
+    if s is None or not _trace_clean() or _is_tracer(lower) or _is_tracer(upper):
+        return _ORIG["fori_loop"](lower, upper, body, init_val, **kw)
+    val = init_val
+    for i in range(_concrete(lower), _concrete(upper)):
+        # Pass the index as a jax scalar: loop bodies are written for the
+        # traced form (e.g. ``(r != me).astype(...)``) and a python int
+        # would hand them python bools.
+        val = body(jnp.int32(i), val)
+    return val
+
+
+def _known_axes(s, axis_name) -> bool:
+    names = axis_name if isinstance(axis_name, (tuple, list)) else (axis_name,)
+    return all(ax in s.coords for ax in names)
+
+
+def _group_index(s, axis_name) -> int:
+    """This rank's index within the collective group named by
+    ``axis_name`` (a single axis or an ordered tuple of axes) — row-major
+    over the named axes in THEIR order, matching XLA's group numbering."""
+    names = axis_name if isinstance(axis_name, (tuple, list)) else (axis_name,)
+    idx = 0
+    for ax in names:
+        idx = idx * s.dims[s.axes.index(ax)] + s.coords[ax]
+    return idx
+
+
+def _sh_ppermute(x, axis_name, perm):
+    import jax.numpy as jnp
+
+    s = _SESSION
+    if s is None or not _known_axes(s, axis_name):
+        return _ORIG["ppermute"](x, axis_name, perm)
+    me = _group_index(s, axis_name)
+    s.emit(ev.XLA, note=f"ppermute@{axis_name}")
+    receives = any(_concrete(d) == me for _, d in perm)
+    return x if receives else jnp.zeros_like(x)
+
+
+def _sh_all_gather(x, axis_name, **kw):
+    import jax.numpy as jnp
+
+    s = _SESSION
+    if s is None or not _known_axes(s, axis_name):
+        return _ORIG["all_gather"](x, axis_name, **kw)
+    n = _axis_total(s, axis_name)
+    ax = kw.get("axis", 0)
+    s.emit(ev.XLA, note=f"all_gather@{axis_name}")
+    if kw.get("tiled", False):
+        return jnp.concatenate([jnp.asarray(x)] * n, axis=ax)
+    return jnp.stack([jnp.asarray(x)] * n, axis=ax)
+
+
+def _sh_all_to_all(x, axis_name, split_axis, concat_axis, **kw):
+    import jax.numpy as jnp
+
+    s = _SESSION
+    if s is None or not _known_axes(s, axis_name):
+        return _ORIG["all_to_all"](x, axis_name, split_axis, concat_axis, **kw)
+    n = _axis_total(s, axis_name)
+    me = _group_index(s, axis_name)
+    s.emit(ev.XLA, note=f"all_to_all@{axis_name}")
+    x = jnp.asarray(x)
+    # SPMD-identical inputs: every peer's piece ``me`` equals the local one.
+    pieces = jnp.split(x, n, axis=split_axis)
+    mine = pieces[me]
+    if kw.get("tiled", False):
+        return jnp.concatenate([mine] * n, axis=concat_axis)
+    mine = jnp.squeeze(mine, axis=split_axis)
+    return jnp.stack([mine] * n, axis=concat_axis)
+
+
+def _sh_psum(x, axis_name, **kw):
+    s = _SESSION
+    if s is None or not _known_axes(s, axis_name):
+        return _ORIG["psum"](x, axis_name, **kw)
+    s.emit(ev.XLA, note=f"psum@{axis_name}")
+    return x * _axis_total(s, axis_name)
+
+
+def _sh_psum_scatter(x, axis_name, *, scatter_dimension=0, tiled=False, **kw):
+    import jax.numpy as jnp
+
+    s = _SESSION
+    if s is None or not _known_axes(s, axis_name):
+        return _ORIG["psum_scatter"](x, axis_name,
+                                     scatter_dimension=scatter_dimension,
+                                     tiled=tiled, **kw)
+    n = _axis_total(s, axis_name)
+    me = _group_index(s, axis_name)
+    s.emit(ev.XLA, note=f"psum_scatter@{axis_name}")
+    x = jnp.asarray(x)
+    if tiled:
+        m = x.shape[scatter_dimension] // n
+        sl = [slice(None)] * x.ndim
+        sl[scatter_dimension] = slice(me * m, (me + 1) * m)
+        return x[tuple(sl)] * n
+    return jnp.take(x, me, axis=scatter_dimension) * n
+
+
+def _build_shims() -> dict[str, Callable]:
+    shims = {
+        "putmem_nbi_block": _sh_putmem_nbi_block,
+        "putmem_block": _sh_putmem_block,
+        "putmem_signal_nbi_block": _sh_putmem_signal_nbi_block,
+        "signal_op": _sh_signal_op,
+        "signal_wait_until": _sh_signal_wait_until,
+        "barrier_all": _sh_barrier_all,
+        "sync_all": _sh_sync_all,
+        "barrier_grid": _sh_barrier_grid,
+        "quiet": _sh_quiet,
+        "wait_deliveries": _sh_wait_deliveries,
+        "my_pe": _sh_rank,
+        "n_pes": _sh_num_ranks,
+        "rank": _sh_rank,
+        "num_ranks": _sh_num_ranks,
+        "wait": _sh_wait,
+        "notify": _sh_notify,
+        "maybe_straggle": _sh_maybe_straggle,
+        "pkg_rank": _sh_rank,
+        "pkg_num_ranks": _sh_num_ranks,
+        "pkg_wait": _sh_wait,
+        "pkg_notify": _sh_notify,
+        "pkg_maybe_straggle": _sh_maybe_straggle,
+        "pallas_call": _sh_pallas_call,
+        "when": _sh_when,
+        "program_id": _sh_program_id,
+        "num_programs": _sh_num_programs,
+        "make_async_copy": _sh_make_async_copy,
+        "make_async_remote_copy": _sh_make_async_remote_copy,
+        "semaphore_signal": _sh_semaphore_signal,
+        "semaphore_wait": _sh_semaphore_wait,
+        "get_barrier_semaphore": _sh_get_barrier_semaphore,
+        "emit_pipeline": _sh_emit_pipeline,
+        "axis_index": _sh_axis_index,
+        "axis_size": _sh_axis_size,
+        "fori_loop": _sh_fori_loop,
+        "ppermute": _sh_ppermute,
+        "all_gather": _sh_all_gather,
+        "all_to_all": _sh_all_to_all,
+        "psum": _sh_psum,
+        "psum_scatter": _sh_psum_scatter,
+    }
+    return shims
+
+
+def trace_op(driver: Callable[[dict[str, int]], Any],
+             axes: Sequence[str] = ("tp",), dims: Sequence[int] = (2,),
+             name: str = "op") -> ev.TraceSet:
+    """Replay ``driver`` once per rank of the (axes, dims) mesh and return
+    the recorded N-rank trace.
+
+    ``driver(dims_by_axis)`` must invoke the op's ``*_local`` entry point
+    with deterministic, rank-independent inputs (the SPMD contract). It is
+    called with the replay shims installed and the current-rank context
+    set; everything it does through the device API surface lands in the
+    trace.
+    """
+    global _SESSION, _ORIG
+    session = ReplaySession(axes, dims)
+    # Capture originals BEFORE install, but only publish them to _ORIG
+    # after install succeeds: install() rejects nesting, and a rejected
+    # nested call must not clobber _ORIG with the outer session's shims
+    # (every fall-through path would then recurse into itself).
+    originals = instrument.originals()
+    instrument.install(_build_shims())
+    _ORIG = originals
+    _SESSION = session
+    try:
+        dims_by_axis = dict(zip(session.axes, session.dims))
+        for coords in itertools.product(*[range(d) for d in session.dims]):
+            session.begin_rank(dict(zip(session.axes, coords)))
+            driver(dims_by_axis)
+    finally:
+        _SESSION = None
+        instrument.uninstall()
+    return session.traceset(name)

@@ -95,11 +95,30 @@ def consume_token(value, token):
     return value
 
 
-def notify(sem, peer, inc: int = 1, axis_type=pltpu.DeviceIdType.LOGICAL):
+def check_signal_op(op) -> None:
+    """Reject signal ops without a TPU lowering. Shared by every signal
+    entry point (``notify``, ``shmem_device.signal_op``) so the policy —
+    and its message — lives in one place; the comm-lint tracer reports the
+    same condition as a misuse lint instead of raising."""
+    if op is not None and op is not SignalOp.ADD:
+        raise NotImplementedError(
+            "SignalOp.SET has no TPU lowering (semaphores are counters — "
+            "only ADD); redesign the protocol in deltas — see "
+            "docs/commlint.md")
+
+
+def notify(sem, peer, inc: int = 1, axis_type=pltpu.DeviceIdType.LOGICAL,
+           op: SignalOp = SignalOp.ADD):
     """Signal ``sem`` on device ``peer`` (reference distributed_ops.py:103
     ``notify(ptr, rank, signal, sig_op, comm_scope)`` → nvshmemx_signal_op /
-    remote st; DistributedOpToLLVM.cpp:233-343). ADD semantics only.
+    remote st; DistributedOpToLLVM.cpp:233-343).
+
+    ``op`` mirrors the reference's ``sig_op``; only ``SignalOp.ADD`` has a
+    TPU lowering (semaphores are counters — a SET would race every
+    concurrent increment). SET raises here and is reported as a misuse
+    lint by the comm-lint analyzer when it appears in a traced kernel.
     """
+    check_signal_op(op)
     pltpu.semaphore_signal(sem, inc=inc, device_id=peer, device_id_type=axis_type)
 
 

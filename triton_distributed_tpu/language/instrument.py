@@ -1,0 +1,143 @@
+"""Instrumentation patch points for the device API surface.
+
+The comm-lint analyzer (``triton_distributed_tpu/analysis/``) records a
+per-rank event trace by *shimming* the device API while a kernel replays on
+the CPU. This module is the single registry of what may be shimmed and the
+generic install/uninstall machinery, so the language layer — not the
+analyzer — owns the contract of which names constitute the instrumentable
+surface. Anything not listed here is not part of the protocol surface and
+the analyzer must not touch it.
+
+Every patch target is a ``(module, attribute)`` pair resolved lazily (so
+importing this module never imports jax eagerly beyond what the language
+package already did). ``install`` swaps attributes and returns an undo
+token; ``uninstall`` restores the originals in reverse order. Nesting is
+rejected — one active instrumentation session at a time keeps semantics
+obvious (the analyzer replays ranks sequentially anyway).
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Callable, Iterable
+
+
+# The instrumentable protocol surface. Keys are shim names the analyzer
+# provides; values are the (module, attribute) locations whose call sites
+# constitute communication events. ``jax.*`` entries cover primitives that
+# kernels use directly (handles, pipelines, control flow) and the XLA
+# collectives that ride outside Pallas.
+PATCH_POINTS: dict[str, tuple[str, str]] = {
+    # SHMEM-style device API (language/shmem_device.py).
+    "putmem_nbi_block": ("triton_distributed_tpu.language.shmem_device", "putmem_nbi_block"),
+    "putmem_block": ("triton_distributed_tpu.language.shmem_device", "putmem_block"),
+    "putmem_signal_nbi_block": ("triton_distributed_tpu.language.shmem_device", "putmem_signal_nbi_block"),
+    "signal_op": ("triton_distributed_tpu.language.shmem_device", "signal_op"),
+    "signal_wait_until": ("triton_distributed_tpu.language.shmem_device", "signal_wait_until"),
+    "barrier_all": ("triton_distributed_tpu.language.shmem_device", "barrier_all"),
+    "sync_all": ("triton_distributed_tpu.language.shmem_device", "sync_all"),
+    "barrier_grid": ("triton_distributed_tpu.language.shmem_device", "barrier_grid"),
+    "quiet": ("triton_distributed_tpu.language.shmem_device", "quiet"),
+    "wait_deliveries": ("triton_distributed_tpu.language.shmem_device", "wait_deliveries"),
+    "my_pe": ("triton_distributed_tpu.language.shmem_device", "my_pe"),
+    "n_pes": ("triton_distributed_tpu.language.shmem_device", "n_pes"),
+    # Core distributed primitives (language/distributed_ops.py). ``rank``
+    # and friends are also re-exported from the package __init__, so both
+    # bindings are listed (ops modules call them as ``dl.rank`` where dl is
+    # the language package).
+    "rank": ("triton_distributed_tpu.language.distributed_ops", "rank"),
+    "num_ranks": ("triton_distributed_tpu.language.distributed_ops", "num_ranks"),
+    "wait": ("triton_distributed_tpu.language.distributed_ops", "wait"),
+    "notify": ("triton_distributed_tpu.language.distributed_ops", "notify"),
+    "maybe_straggle": ("triton_distributed_tpu.language.distributed_ops", "maybe_straggle"),
+    "pkg_rank": ("triton_distributed_tpu.language", "rank"),
+    "pkg_num_ranks": ("triton_distributed_tpu.language", "num_ranks"),
+    "pkg_wait": ("triton_distributed_tpu.language", "wait"),
+    "pkg_notify": ("triton_distributed_tpu.language", "notify"),
+    "pkg_maybe_straggle": ("triton_distributed_tpu.language", "maybe_straggle"),
+    # Pallas entry points the kernels go through.
+    "pallas_call": ("jax.experimental.pallas", "pallas_call"),
+    "when": ("jax.experimental.pallas", "when"),
+    "program_id": ("jax.experimental.pallas", "program_id"),
+    "num_programs": ("jax.experimental.pallas", "num_programs"),
+    "make_async_copy": ("jax.experimental.pallas.tpu", "make_async_copy"),
+    "make_async_remote_copy": ("jax.experimental.pallas.tpu", "make_async_remote_copy"),
+    "semaphore_signal": ("jax.experimental.pallas.tpu", "semaphore_signal"),
+    "semaphore_wait": ("jax.experimental.pallas.tpu", "semaphore_wait"),
+    "get_barrier_semaphore": ("jax.experimental.pallas.tpu", "get_barrier_semaphore"),
+    "emit_pipeline": ("jax.experimental.pallas.tpu", "emit_pipeline"),
+    # Mesh queries + control flow + XLA collectives used around kernels.
+    "axis_index": ("jax.lax", "axis_index"),
+    "axis_size": ("jax.lax", "axis_size"),
+    "fori_loop": ("jax.lax", "fori_loop"),
+    "ppermute": ("jax.lax", "ppermute"),
+    "all_gather": ("jax.lax", "all_gather"),
+    "all_to_all": ("jax.lax", "all_to_all"),
+    "psum": ("jax.lax", "psum"),
+    "psum_scatter": ("jax.lax", "psum_scatter"),
+}
+
+
+class InstrumentationError(RuntimeError):
+    pass
+
+
+_active_token: list | None = None
+
+# Sentinel for a patch point whose attribute does not exist in the installed
+# jax (the surface moves between releases; e.g. ``jax.lax.axis_size`` is
+# absent in older versions). The shim is still installed — replayed kernels
+# may reference the name — and the attribute is deleted again on uninstall.
+MISSING = object()
+
+
+def originals(names: Iterable[str] | None = None) -> dict[str, Any]:
+    """Current (pre-shim) values of the requested patch points; ``MISSING``
+    for attributes the installed jax does not define."""
+    out = {}
+    for name in names if names is not None else PATCH_POINTS:
+        mod_name, attr = PATCH_POINTS[name]
+        out[name] = getattr(importlib.import_module(mod_name), attr, MISSING)
+    return out
+
+
+def install(shims: dict[str, Callable]) -> None:
+    """Swap in ``shims`` (a mapping from patch-point name to replacement).
+
+    Unknown names are rejected so a typo cannot silently leave part of the
+    surface uninstrumented. Call :func:`uninstall` to restore.
+    """
+    global _active_token
+    if _active_token is not None:
+        raise InstrumentationError("instrumentation already installed")
+    unknown = set(shims) - set(PATCH_POINTS)
+    if unknown:
+        raise InstrumentationError(f"unknown patch points: {sorted(unknown)}")
+    token = []
+    try:
+        for name, shim in shims.items():
+            mod_name, attr = PATCH_POINTS[name]
+            mod = importlib.import_module(mod_name)
+            token.append((mod, attr, getattr(mod, attr, MISSING)))
+            setattr(mod, attr, shim)
+    except Exception:
+        _restore(token)
+        raise
+    _active_token = token
+
+
+def _restore(token) -> None:
+    for mod, attr, orig in reversed(token):
+        if orig is MISSING:
+            if hasattr(mod, attr):
+                delattr(mod, attr)
+        else:
+            setattr(mod, attr, orig)
+
+
+def uninstall() -> None:
+    global _active_token
+    if _active_token is None:
+        return
+    _restore(_active_token)
+    _active_token = None

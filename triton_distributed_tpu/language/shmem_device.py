@@ -25,7 +25,6 @@ All helpers are *device-side*: call them inside a Pallas kernel that runs under
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -102,10 +101,17 @@ def putmem_signal_nbi_block(src_ref, dst_ref, send_sem, recv_sem, peer,
     return putmem_nbi_block(src_ref, dst_ref, send_sem, recv_sem, peer, axis)
 
 
-def signal_op(sem, peer, inc: int = 1, axis: str | None = None):
+def signal_op(sem, peer, inc: int = 1, axis: str | None = None, op=None):
     """Remote signal: add ``inc`` to ``sem`` on ``peer``
-    (reference ``libshmem_device.signal_op`` / NotifyOp ADD path)."""
-    from triton_distributed_tpu.language.distributed_ops import peer_id
+    (reference ``libshmem_device.signal_op`` / NotifyOp ADD path).
+
+    ``op`` mirrors NVSHMEM's signal-op argument; only ADD (the default)
+    exists on TPU — ``SignalOp.SET`` raises (and is flagged by comm-lint)."""
+    from triton_distributed_tpu.language.distributed_ops import (
+        check_signal_op, peer_id,
+    )
+
+    check_signal_op(op)
 
     id_type = LOGICAL
     if axis is not None:

@@ -23,7 +23,6 @@ All functions are device-local: call inside ``shard_map`` over ``axis``.
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 
 from triton_distributed_tpu.layers.common import swiglu
 from triton_distributed_tpu.ops.allgather_gemm import ag_gemm_local

@@ -24,7 +24,7 @@ from triton_distributed_tpu.models.dense import (
     dense_decode_step_paged,
 )
 from triton_distributed_tpu.models.kv_cache import (
-    KVCache, PagedModelCache, init_kv_cache, init_paged_model_cache,
+    KVCache, PagedModelCache, init_kv_cache,
     kv_cache_specs, paged_cache_specs,
 )
 from triton_distributed_tpu.models import sampling

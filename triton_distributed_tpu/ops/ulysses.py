@@ -27,7 +27,6 @@ from __future__ import annotations
 import functools
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu.runtime.context import DistContext, get_context

@@ -19,13 +19,12 @@ For most single-slice uses a 1-D mesh ("tp",) suffices.
 from __future__ import annotations
 
 import dataclasses
-import functools
 import os
 from typing import Any, Callable, Sequence
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 _GLOBAL_CONTEXT: "DistContext | None" = None
 

@@ -35,10 +35,26 @@ def apply_interpret_workarounds() -> None:
     if _APPLIED:
         return
     _APPLIED = True
+    # Each patch targets jax internals that move between releases; a jax
+    # without the targeted module simply does not need (or cannot take)
+    # that workaround, so degrade per-patch instead of failing import.
     if os.environ.get("TDTPU_DETECT_RACES", "0") != "1":
-        _patch_semaphore_wait()
-    _patch_io_callback_device_put()
-    _patch_tpu_generation_probe()
+        _try(_patch_semaphore_wait)
+    _try(_patch_io_callback_device_put)
+    _try(_patch_tpu_generation_probe)
+
+
+def _try(patch) -> None:
+    try:
+        patch()
+    except (ImportError, AttributeError) as exc:
+        # Degrade, but loudly: on a jax that SHOULD have these internals
+        # (current versions), a skipped workaround means interpret-mode
+        # hangs/livelocks with no other clue.
+        import warnings
+
+        warnings.warn(f"interpret workaround {patch.__name__} skipped: "
+                      f"{type(exc).__name__}: {exc}", RuntimeWarning)
 
 
 def _patch_semaphore_wait() -> None:

@@ -1,0 +1,74 @@
+"""Compatibility aliases for pallas/jax API names that move across releases.
+
+The kernel library is written against the current pallas-TPU surface
+(``pltpu.CompilerParams``, ``pltpu.InterpretParams``, ``pl.delay``,
+``jax.lax.axis_size``). Older jax releases spell these differently or lack
+them; this module installs forward-compatible aliases at package import so
+the library (and the comm-lint replay, which needs kernels merely to
+*trace*) degrades gracefully instead of failing at attribute lookup.
+
+Only additive aliasing happens here — nothing existing is overwritten.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+def ensure_jax_compat() -> None:
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if not hasattr(pltpu, "CompilerParams"):
+        if hasattr(pltpu, "TPUCompilerParams"):
+            fields = {f.name for f in
+                      dataclasses.fields(pltpu.TPUCompilerParams)}
+
+            def _compiler_params(**kw):
+                # Old TPUCompilerParams lacks e.g. has_side_effects; drop
+                # unknown knobs (side effects only matter for DCE of real
+                # launches, which an old jax cannot run anyway).
+                return pltpu.TPUCompilerParams(
+                    **{k: v for k, v in kw.items() if k in fields})
+
+            pltpu.CompilerParams = _compiler_params
+
+    if not hasattr(pltpu, "InterpretParams"):
+        @dataclasses.dataclass(frozen=True)
+        class InterpretParams:  # truthy stand-in accepted as interpret=...
+            dma_execution_mode: str = "eager"
+            detect_races: bool = False
+
+            def __bool__(self) -> bool:
+                return True
+
+        pltpu.InterpretParams = InterpretParams
+
+    if not hasattr(pl, "delay") and hasattr(pltpu, "delay"):
+        pl.delay = pltpu.delay
+
+    try:
+        jax.shard_map
+    except AttributeError:
+        # Pre-0.5 jax: shard_map lives in jax.experimental.shard_map and
+        # spells today's ``check_vma`` flag ``check_rep``.
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def _shard_map_compat(f, *a, **kw):
+            if "check_vma" in kw:
+                kw["check_rep"] = kw.pop("check_vma")
+            return _shard_map(f, *a, **kw)
+
+        jax.shard_map = _shard_map_compat
+
+    if not hasattr(jax.lax, "axis_size"):
+        def _axis_size(axis_name):
+            try:
+                from jax._src import core as jcore
+
+                return jcore.get_axis_env().axis_size(axis_name)
+            except Exception:
+                return jax.lax.psum(1, axis_name)
+
+        jax.lax.axis_size = _axis_size
