@@ -64,7 +64,8 @@ def test_registry_covers_issue_surface():
     required = {"allgather", "reduce_scatter", "allreduce", "all_to_all",
                 "p2p", "allgather_gemm", "gemm_reduce_scatter",
                 "flash_decode", "moe", "ulysses", "two_level", "multi_axis",
-                "ring_attention", "sp_ag_attention"}
+                "ring_attention", "sp_ag_attention",
+                "hierarchical", "hierarchical_sp"}
     assert required <= names
 
 

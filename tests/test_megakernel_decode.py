@@ -468,3 +468,27 @@ def test_decode_step_moe_tp2_virtual_mesh():
     ref = x1 + _golden_moe_ffn(x1n, router, wg, wu, wd, topk)
     for r in range(n):
         np.testing.assert_allclose(out[r][:B], ref, rtol=2e-3, atol=2e-3)
+
+
+def test_feed_layer_weights_rejects_lone_gate_or_up():
+    """Exactly one of w_gate/w_up must fail at the call site, not later
+    as an opaque jnp.asarray(None) crash inside scatter_mat."""
+    import pytest
+
+    prog = build_decode_step(hidden=256, hq_local=2, hkv_local=1,
+                             ffn_local=256, num_layers=1, max_seq=128,
+                             pos=0)
+    h = prog.layers[0]
+    d = 128
+    wq = np.zeros((256, 2 * d), np.float32)
+    wkv = np.zeros((256, d), np.float32)
+    wo = np.zeros((2 * d, 256), np.float32)
+    with pytest.raises(ValueError, match="BOTH w_gate and w_up"):
+        feed_layer_weights({}, h, wq=wq, wk=wkv, wv=wkv, wo=wo,
+                           w_gate=np.zeros((256, 256), np.float32),
+                           w_up=None,
+                           w_down=np.zeros((256, 256), np.float32))
+    with pytest.raises(ValueError, match="BOTH w_gate and w_up"):
+        feed_layer_weights({}, h, wq=wq, wk=wkv, wv=wkv, wo=wo,
+                           w_gate=None,
+                           w_up=np.zeros((256, 256), np.float32))

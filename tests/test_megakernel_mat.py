@@ -126,6 +126,25 @@ def test_step_requires_wsm():
         comp.step(ws)
 
 
+def test_step_validates_wsm_shape_and_dtype():
+    """A stale/undersized wsm (built for a different program) must fail
+    loudly instead of DMAing weight rows from out-of-bounds indices."""
+    mb = MegaKernelBuilder()
+    a = mb.tensor(TILE, 256)
+    w = mb.tensor_mat(256, 256)
+    o = mb.tensor(TILE, 256)
+    mb.gemm_mat(o, a, w)
+    comp = mb.compile()
+    ws = comp.make_workspace({a: np.zeros((TILE, 256), np.float32)})
+    good = comp.make_workspace_mat({w: np.zeros((256, 256), np.float32)})
+    with pytest.raises(ValueError, match="does not fit"):
+        comp.step(ws, wsm=good[: comp.num_mrows - 1])       # undersized
+    with pytest.raises(ValueError, match="does not fit"):
+        comp.step(ws, wsm=good[:, : MAT_COLS // 2])         # wrong width
+    with pytest.raises(ValueError, match="dtype"):
+        comp.step(ws, wsm=good.astype(jnp.bfloat16))        # wrong dtype
+
+
 def test_pad_strip_columns_are_inert():
     """A 1152-wide matrix pads its second strip to MAT_COLS; the pad
     columns must not leak into the stored output tiles."""

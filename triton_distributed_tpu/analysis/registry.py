@@ -37,6 +37,8 @@ def _meshes_1d(ranks: Sequence[int]):
 
 _MESHES_2D = ((("x", "y"), (2, 2)), (("x", "y"), (2, 4)))
 _MESHES_DCN = ((("dcn", "tp"), (2, 2)), (("dcn", "tp"), (2, 4)))
+# The hierarchical fused ops sweep both tier aspect ratios (ISSUE 2).
+_MESHES_HIER = ((("dcn", "tp"), (2, 4)), (("dcn", "tp"), (4, 2)))
 
 
 # ---------------------------------------------------------------------------
@@ -213,6 +215,37 @@ def _drv_two_level(d):
     all_reduce_2d_local(_arr(16, 128), **kw)
 
 
+def _drv_hierarchical(d):
+    """Two-tier fused ops (ops/hierarchical.py): the intra-slice Pallas
+    protocol (push-AG feeding the consumer GEMM / fused GEMM+RS) replayed
+    under the DCN ppermute rotation — the checker sees the full two-tier
+    schedule: per-slice kernel launches interleaved with the XLA hops."""
+    from triton_distributed_tpu.ops.hierarchical import (
+        ag_gemm_2d_local, gemm_rs_2d_local,
+    )
+
+    n_inter, n_intra = d["dcn"], d["tp"]
+    kw = dict(intra_axis="tp", inter_axis="dcn", n_intra=n_intra,
+              n_inter=n_inter)
+    ag_gemm_2d_local(_arr(16, 128), _arr(128, 128), **kw)
+    gemm_rs_2d_local(_arr(n_inter * n_intra * 8, 128), _arr(128, 128), **kw)
+
+
+def _drv_hierarchical_sp(d):
+    """Pipelined two-tier SP attention (per-slice flash merges under the
+    DCN rotation). Separate driver: each replayed rank runs real
+    interpret-mode flash partials per chunk, so it sweeps one small mesh
+    (the CLI meshes stay (2,2)-sized to bound cost)."""
+    from triton_distributed_tpu.ops.hierarchical import (
+        sp_ag_attention_2d_local,
+    )
+
+    n_inter, n_intra = d["dcn"], d["tp"]
+    q = _arr(1, 8, 2, 64)
+    sp_ag_attention_2d_local(q, q, q, intra_axis="tp", inter_axis="dcn",
+                             n_intra=n_intra, n_inter=n_inter)
+
+
 def _drv_multi_axis(d):
     from triton_distributed_tpu.ops.multi_axis import (
         all_gather_torus_local, all_reduce_torus_local,
@@ -249,6 +282,10 @@ def build_registry(ranks: Sequence[int] = (2, 4, 8)) -> dict[str, OpDriver]:
         "sp_ag_attention": OpDriver("sp_ag_attention", _drv_sp_ag_attention,
                                     m1),
         "two_level": OpDriver("two_level", _drv_two_level, _MESHES_DCN),
+        "hierarchical": OpDriver("hierarchical", _drv_hierarchical,
+                                 _MESHES_HIER),
+        "hierarchical_sp": OpDriver("hierarchical_sp", _drv_hierarchical_sp,
+                                    ((("dcn", "tp"), (2, 2)),)),
         "multi_axis": OpDriver("multi_axis", _drv_multi_axis, _MESHES_2D),
     }
 

@@ -53,3 +53,20 @@ class KVSlice(__import__("typing").NamedTuple):
 
     k: "jax.Array"
     v: "jax.Array"
+
+
+def tp_reduce(y: jax.Array, *, axis: str, n: int,
+              inter_axis: str = "dcn", n_inter: int = 1) -> jax.Array:
+    """Default full AllReduce of a TP partial: the fused Pallas AR within
+    one slice, the two-tier hierarchical AR (intra Pallas RS → DCN psum →
+    intra Pallas AG, ops/two_level.py) when the TP group spans a DCN axis
+    (``n_inter`` > 1 — the multi-slice deployment ops/hierarchical.py
+    serves). The ``ar_fn`` hooks on the layer entry points override this."""
+    if n_inter > 1:
+        from triton_distributed_tpu.ops.two_level import all_reduce_2d_local
+
+        return all_reduce_2d_local(y, intra_axis=axis, inter_axis=inter_axis,
+                                   n_intra=n, n_inter=n_inter)
+    from triton_distributed_tpu.ops.allreduce import all_reduce_local
+
+    return all_reduce_local(y, axis=axis, num_ranks=n)

@@ -28,14 +28,13 @@ import jax
 import jax.numpy as jnp
 
 from triton_distributed_tpu.layers.common import (
-    KVSlice, apply_rope, rms_norm, rope_cos_sin,
+    KVSlice, apply_rope, rms_norm, rope_cos_sin, tp_reduce,
 )
 
 if TYPE_CHECKING:  # annotation-only: models imports layers, not vice versa
     from triton_distributed_tpu.models.config import ModelConfig
 from triton_distributed_tpu.ops.allgather_gemm import ag_gemm_local
 from triton_distributed_tpu.ops.gemm_reduce_scatter import gemm_rs_local
-from triton_distributed_tpu.ops.allreduce import all_reduce_local
 
 
 def init_tp_attn(rng: jax.Array, cfg: ModelConfig, dtype) -> dict:
@@ -65,10 +64,22 @@ def tp_attn_specs(cfg: ModelConfig, axis: str = "tp") -> dict:
     return specs
 
 
-def _project_qkv(params, cfg: ModelConfig, x, batch, seq, *, axis, n, mode):
+def _project_qkv(params, cfg: ModelConfig, x, batch, seq, *, axis, n, mode,
+                 inter_axis="dcn", n_inter=1):
     """x → q (B,S,hq,d), k/v (B,S,hkv,d) with qk-norm + heads split.
-    In overlap/xla modes this also regathers the full sequence."""
-    if mode in ("overlap", "xla") and n > 1:
+    In overlap/xla/overlap2d modes this also regathers the full sequence."""
+    if mode == "overlap2d" and n * n_inter > 1:
+        # Hierarchical DCN×ICI: rows sharded over both tiers; the AG+GEMM
+        # regathers them with slice blocks rotating over DCN under the
+        # consumer GEMM (ops/hierarchical.py).
+        from triton_distributed_tpu.ops.hierarchical import ag_gemm_2d_local
+
+        kw = dict(intra_axis=axis, inter_axis=inter_axis, n_intra=n,
+                  n_inter=n_inter)
+        q = ag_gemm_2d_local(x, params["wq"], **kw)
+        k = ag_gemm_2d_local(x, params["wk"], **kw)
+        v = ag_gemm_2d_local(x, params["wv"], **kw)
+    elif mode in ("overlap", "xla") and n > 1:
         if mode == "overlap":
             q = ag_gemm_local(x, params["wq"], axis=axis, num_ranks=n)
             k = ag_gemm_local(x, params["wk"], axis=axis, num_ranks=n)
@@ -121,15 +132,18 @@ def tp_attn_prefill(params: dict, cfg: ModelConfig, x: jax.Array,
                     batch: int, seq: int, kv_slice: KVSlice | None = None, *,
                     axis: str = "tp", num_ranks: int = 1,
                     mode: str = "overlap",
+                    inter_axis: str = "dcn", n_inter: int = 1,
                     flash_tiles: tuple[int, int] | None = None):
-    """Causal prefill. x: (B·S/n, h) row-sharded (overlap/xla) or (B·S, h)
-    replicated (ar). Returns (out, KVSlice of the full prompt written into
+    """Causal prefill. x: (B·S/n, h) row-sharded (overlap/xla; over both
+    tiers — B·S/(n·n_inter) rows — in overlap2d) or (B·S, h) replicated
+    (ar). Returns (out, KVSlice of the full prompt written into
     ``kv_slice`` at positions [0, S))."""
     n = num_ranks
-    if n == 1:
+    if n * n_inter == 1:
         mode = "local"
     q, k, v = _project_qkv(params, cfg, x, batch, seq,
-                           axis=axis, n=n, mode=mode)
+                           axis=axis, n=n, mode=mode,
+                           inter_axis=inter_axis, n_inter=n_inter)
     cos, sin = rope_cos_sin(jnp.arange(seq), cfg.head_dim, cfg.rope_theta)
     q = apply_rope(q, cos[None], sin[None])
     k = apply_rope(k, cos[None], sin[None])
@@ -165,24 +179,33 @@ def tp_attn_prefill(params: dict, cfg: ModelConfig, x: jax.Array,
                            tile_k=tk_cap)
     attn = attn.reshape(batch * seq, -1)
 
-    if n == 1:
+    if n * n_inter == 1:
         out = attn @ params["wo"]
+    elif mode == "overlap2d":
+        from triton_distributed_tpu.ops.hierarchical import gemm_rs_2d_local
+
+        out = gemm_rs_2d_local(attn, params["wo"], intra_axis=axis,
+                               inter_axis=inter_axis, n_intra=n,
+                               n_inter=n_inter)
     elif mode == "overlap":
         out = gemm_rs_local(attn, params["wo"], axis=axis, num_ranks=n)
     elif mode == "xla":
         out = jax.lax.psum_scatter(attn @ params["wo"], axis,
                                    scatter_dimension=0, tiled=True)
     elif mode == "ar":
-        out = all_reduce_local(attn @ params["wo"], axis=axis, num_ranks=n)
+        out = tp_reduce(attn @ params["wo"], axis=axis, n=n,
+                        inter_axis=inter_axis, n_inter=n_inter)
     elif mode == "xla_rep":
-        out = jax.lax.psum(attn @ params["wo"], axis)
+        out = jax.lax.psum(attn @ params["wo"],
+                           (inter_axis, axis) if n_inter > 1 else axis)
     else:
         raise ValueError(f"unknown TP attn mode {mode!r}")
     return out, new_kv
 
 
 def _out_proj(attn: jax.Array, params: dict, *, axis: str, n: int,
-              mode: str, ar_fn=None, gemm_ar_fn=None) -> jax.Array:
+              mode: str, inter_axis: str = "dcn", n_inter: int = 1,
+              ar_fn=None, gemm_ar_fn=None) -> jax.Array:
     """Row-parallel output projection + TP reduction (decode modes).
 
     ``ar_fn``: optional replacement for the default fused AllReduce — the
@@ -192,8 +215,10 @@ def _out_proj(attn: jax.Array, params: dict, *, axis: str, n: int,
     GEMM+AR (ops/gemm_allreduce.gemm_ar_stream). At n=1 supplied hooks
     still run (the force_ar_kernel bench path measures the loopback
     kernel's overhead — without this, every reduction site early-returns
-    and the 'with AR kernel' number silently measures the bare chain)."""
-    if n == 1:
+    and the 'with AR kernel' number silently measures the bare chain).
+    ``n_inter`` > 1: the TP group spans a DCN axis, so the default
+    reduction is the two-tier hierarchical AR (layers/common.tp_reduce)."""
+    if n * n_inter == 1:
         if gemm_ar_fn is not None:
             return gemm_ar_fn(attn, params["wo"])
         y = attn @ params["wo"]
@@ -204,9 +229,11 @@ def _out_proj(attn: jax.Array, params: dict, *, axis: str, n: int,
         y = attn @ params["wo"]
         if ar_fn is not None:
             return ar_fn(y)
-        return all_reduce_local(y, axis=axis, num_ranks=n)
+        return tp_reduce(y, axis=axis, n=n, inter_axis=inter_axis,
+                         n_inter=n_inter)
     if mode == "xla_rep":
-        return jax.lax.psum(attn @ params["wo"], axis)
+        return jax.lax.psum(attn @ params["wo"],
+                            (inter_axis, axis) if n_inter > 1 else axis)
     raise ValueError(f"decode supports modes 'ar'/'xla_rep', got {mode!r}")
 
 
@@ -214,6 +241,7 @@ def tp_attn_prefill_chunk(params: dict, cfg: ModelConfig, x: jax.Array,
                           kv_slice: KVSlice, start: jax.Array,
                           chunk_len: int, *, axis: str = "tp",
                           num_ranks: int = 1, mode: str = "ar",
+                          inter_axis: str = "dcn", n_inter: int = 1,
                           flash_tiles: tuple[int, int] | None = None):
     """Chunked-prefill attention: the chunk's queries (positions
     [start, start+chunk_len)) attend the cached prefix — the flash kernel's
@@ -269,12 +297,14 @@ def tp_attn_prefill_chunk(params: dict, cfg: ModelConfig, x: jax.Array,
         tile_k=tk_cap)
     attn = (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
     attn = attn.reshape(batch * chunk_len, -1)
-    return _out_proj(attn, params, axis=axis, n=n, mode=mode), new_kv
+    return _out_proj(attn, params, axis=axis, n=n, mode=mode,
+                     inter_axis=inter_axis, n_inter=n_inter), new_kv
 
 
 def tp_attn_decode_paged(params: dict, cfg: ModelConfig, x: jax.Array,
                          cache, *, axis: str = "tp", num_ranks: int = 1,
-                         mode: str = "ar", ar_fn=None):
+                         mode: str = "ar", inter_axis: str = "dcn",
+                         n_inter: int = 1, ar_fn=None):
     """Single-token decode over a paged KV cache — per-SEQUENCE positions
     (``cache.kv_lens``), so a continuous batch of sequences at different
     lengths decodes in one step (the modern-serving shape the reference's
@@ -297,12 +327,14 @@ def tp_attn_decode_paged(params: dict, cfg: ModelConfig, x: jax.Array,
     attn = attn.reshape(batch, -1).astype(x.dtype)
 
     return _out_proj(attn, params, axis=axis, n=n, mode=mode,
+                     inter_axis=inter_axis, n_inter=n_inter,
                      ar_fn=ar_fn), cache
 
 
 def tp_attn_decode(params: dict, cfg: ModelConfig, x: jax.Array,
                    kv_slice: KVSlice, pos: jax.Array, *,
                    axis: str = "tp", num_ranks: int = 1, mode: str = "ar",
+                   inter_axis: str = "dcn", n_inter: int = 1,
                    ar_fn=None, gemm_ar_fn=None):
     """Single-token decode step. x: (B, h) replicated (ar modes only — a
     1-row activation cannot be row-sharded; reference dense.py uses the AR
@@ -328,4 +360,5 @@ def tp_attn_decode(params: dict, cfg: ModelConfig, x: jax.Array,
     attn = attn.reshape(batch, -1)
 
     return _out_proj(attn, params, axis=axis, n=n, mode=mode,
+                     inter_axis=inter_axis, n_inter=n_inter,
                      ar_fn=ar_fn, gemm_ar_fn=gemm_ar_fn), new_kv

@@ -27,9 +27,10 @@ import jax
 from triton_distributed_tpu.layers.common import swiglu
 from triton_distributed_tpu.ops.allgather_gemm import ag_gemm_local
 from triton_distributed_tpu.ops.gemm_reduce_scatter import gemm_rs_local
-from triton_distributed_tpu.ops.allreduce import all_reduce_local
 
-ROW_SHARDED_MODES = ("overlap", "xla")
+# "overlap2d": rows sharded over BOTH mesh tiers (n·n_inter shards) — the
+# hierarchical DCN×ICI path (ops/hierarchical.py) on 2-axis meshes.
+ROW_SHARDED_MODES = ("overlap", "xla", "overlap2d")
 REPLICATED_MODES = ("ar", "xla_rep")
 
 
@@ -52,7 +53,8 @@ def tp_mlp_specs(axis: str = "tp") -> dict:
 
 
 def pick_mode(mode: str, m_total: int, n: int, *, hidden: int | None = None,
-              ffn: int | None = None, itemsize: int = 2) -> str:
+              ffn: int | None = None, itemsize: int = 2,
+              n_inter: int = 1) -> str:
     """Resolve ``auto`` (reference models/dense.py:84-99 mode dispatch).
 
     With layer dims supplied, the choice is perf-model-driven: the overlap
@@ -60,27 +62,67 @@ def pick_mode(mode: str, m_total: int, n: int, *, hidden: int | None = None,
     GEMM + fused AllReduce path (runtime/perf_model.py — the analog of the
     reference's get_auto_* selectors, allgather.py:57 / allreduce.py:1101).
     Without dims, small decode-like rows fall back to ``ar``.
+
+    ``n_inter`` > 1 (a 2-axis DCN×ICI mesh) adds the hierarchical
+    ``overlap2d`` candidate (ops/hierarchical.py): rows shard over both
+    tiers and slice blocks rotate over DCN under the consumer GEMM. Its
+    modeled time carries the DCN hop latency, so AUTO declines it at small
+    row counts (the DCN-tier crossover) and falls back to the
+    slice-replicated single-axis choice.
     """
     if mode != "auto":
         return mode
-    if n <= 1 or m_total % n or m_total // n < 8:
+    N = n * n_inter
+    # Candidate eligibility: each overlap form needs its shard count to
+    # divide the rows with ≥ 8 rows per shard. The 2d form is gated on the
+    # JOINT degree N, not n — on a degenerate-intra (n_inter, 1) mesh the
+    # intra degree is 1 but the hierarchical path is still real.
+    can_1d = n > 1 and m_total % n == 0 and m_total // n >= 8
+    can_2d = (n_inter > 1 and N > 1 and m_total % N == 0
+              and m_total // N >= 8)
+    if not can_1d and not can_2d:
         return "ar"
     if hidden is not None and ffn is not None:
         from triton_distributed_tpu.runtime.perf_model import (
-            ag_gemm_time_s, allreduce_time_s, gemm_rs_time_s, gemm_time_s,
+            ag_gemm_2d_time_s, ag_gemm_time_s, allreduce_time_s,
+            gemm_rs_2d_time_s, gemm_rs_time_s, gemm_time_s,
         )
 
-        t_overlap = (ag_gemm_time_s(m_total, ffn, hidden, n, itemsize)
-                     + gemm_rs_time_s(m_total, hidden, ffn, n, itemsize))
         t_ar = (gemm_time_s(m_total, ffn, hidden, itemsize)
                 + gemm_time_s(m_total, hidden, ffn, itemsize)
                 + allreduce_time_s(m_total * hidden * itemsize, n))
-        return "overlap" if t_overlap <= t_ar else "ar"
-    return "overlap"
+        if n_inter > 1:
+            # On a 2-axis engine the replicated path's reduction is the
+            # TWO-TIER AR (common.tp_reduce): the partial sum also
+            # crosses DCN — without this term "ar" looks free at n=1 and
+            # the hierarchical path could never win on (n_inter, 1)
+            # meshes.
+            from triton_distributed_tpu.runtime.perf_model import (
+                dcn_collective_time_s,
+            )
+
+            t_ar += dcn_collective_time_s(m_total * hidden * itemsize,
+                                          n_inter)
+        best, t_best = "ar", t_ar
+        if can_1d:
+            t_overlap = (ag_gemm_time_s(m_total, ffn, hidden, n, itemsize)
+                         + gemm_rs_time_s(m_total, hidden, ffn, n, itemsize))
+            if t_overlap <= t_best:
+                best, t_best = "overlap", t_overlap
+        if can_2d:
+            t_2d = (ag_gemm_2d_time_s(m_total, ffn, hidden, n, n_inter,
+                                      itemsize)
+                    + gemm_rs_2d_time_s(m_total, hidden, ffn, n, n_inter,
+                                        itemsize))
+            if t_2d < t_best:
+                return "overlap2d"
+        return best
+    return "overlap2d" if can_2d else "overlap"
 
 
 def tp_mlp_fwd(params: dict, x: jax.Array, *, axis: str = "tp",
                num_ranks: int = 1, mode: str = "overlap",
+               inter_axis: str = "dcn", n_inter: int = 1,
                ar_fn=None, gemm_ar_fn=None) -> jax.Array:
     """Device-local TP MLP forward with a concrete mode (models resolve
     ``auto`` via :func:`pick_mode` — the input layout depends on it).
@@ -92,7 +134,7 @@ def tp_mlp_fwd(params: dict, x: jax.Array, *, axis: str = "tp",
     (ops/gemm_allreduce.gemm_ar_stream)."""
     n = num_ranks
     wg, wu, wd = params["w_gate"], params["w_up"], params["w_down"]
-    if n == 1:
+    if n * n_inter == 1:
         act = swiglu(x @ wg, x @ wu)
         # Supplied hooks still run at n=1: the force_ar_kernel bench path
         # measures the loopback kernel overhead here. gemm_ar_fn is the
@@ -110,6 +152,20 @@ def tp_mlp_fwd(params: dict, x: jax.Array, *, axis: str = "tp",
         gate = ag_gemm_local(x, wg, axis=axis, num_ranks=n)
         up = ag_gemm_local(x, wu, axis=axis, num_ranks=n)
         return gemm_rs_local(swiglu(gate, up), wd, axis=axis, num_ranks=n)
+    if mode == "overlap2d":
+        # Hierarchical DCN×ICI path: x is row-sharded over BOTH tiers
+        # ((m/(n·n_inter), h) in/out); the AG regathers all rows with slice
+        # blocks rotating over DCN under the consumer GEMM, GEMM+RS
+        # reshards them the same way (ops/hierarchical.py).
+        from triton_distributed_tpu.ops.hierarchical import (
+            ag_gemm_2d_local, gemm_rs_2d_local,
+        )
+
+        kw = dict(intra_axis=axis, inter_axis=inter_axis, n_intra=n,
+                  n_inter=n_inter)
+        gate = ag_gemm_2d_local(x, wg, **kw)
+        up = ag_gemm_2d_local(x, wu, **kw)
+        return gemm_rs_2d_local(swiglu(gate, up), wd, **kw)
     if mode == "xla":
         full = jax.lax.all_gather(x, axis, tiled=True)
         h = swiglu(full @ wg, full @ wu)
@@ -122,7 +178,11 @@ def tp_mlp_fwd(params: dict, x: jax.Array, *, axis: str = "tp",
         partial = act @ wd
         if ar_fn is not None:
             return ar_fn(partial)
-        return all_reduce_local(partial, axis=axis, num_ranks=n)
+        from triton_distributed_tpu.layers.common import tp_reduce
+
+        return tp_reduce(partial, axis=axis, n=n,
+                         inter_axis=inter_axis, n_inter=n_inter)
     if mode == "xla_rep":
-        return jax.lax.psum(swiglu(x @ wg, x @ wu) @ wd, axis)
+        ax = (inter_axis, axis) if n_inter > 1 else axis
+        return jax.lax.psum(swiglu(x @ wg, x @ wu) @ wd, ax)
     raise ValueError(f"unknown TP MLP mode {mode!r}")

@@ -789,6 +789,21 @@ class CompiledMegaKernel:
             raise ValueError(
                 f"program uses {self.num_mrows} matrix-workspace rows but "
                 "no wsm was passed — build it with make_workspace_mat")
+        if wsm is not None:
+            # A stale/undersized wsm (e.g. built from a different program)
+            # would DMA weight rows from out-of-bounds indices — silent
+            # garbage on hardware. Validate against the program instead.
+            if wsm.ndim != 2 or wsm.shape[1] != MAT_COLS \
+                    or wsm.shape[0] < max(self.num_mrows, 1):
+                raise ValueError(
+                    f"wsm shape {tuple(wsm.shape)} does not fit this "
+                    f"program: need (>= {max(self.num_mrows, 1)}, "
+                    f"{MAT_COLS}) — was it built by make_workspace_mat of "
+                    "a different program?")
+            if wsm.dtype != jnp.dtype(self.dtype):
+                raise ValueError(
+                    f"wsm dtype {wsm.dtype} != program dtype "
+                    f"{jnp.dtype(self.dtype)}")
         return run_queue(self.queue if queue is None else queue, ws,
                          num_ranks=self.num_ranks, axis=self.axis,
                          num_tasks=self.num_exec, max_gqa=self.max_gqa,

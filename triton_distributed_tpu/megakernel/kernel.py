@@ -978,8 +978,16 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
     SW = max(max_strip, W, 2 * MF, MH)
     # Matrix-workspace geometry: chunk buffer sized to the largest spec;
     # a one-row placeholder rides along when the program has no GEMM_MAT
-    # tasks (the branch body is then empty — nothing reads it).
+    # tasks (the branch body is then empty — nothing reads it). Same
+    # pattern as vbw8 below: with mat_specs empty the GEMM_MAT branch
+    # never dispatches, so its vbm/vaccm/voutm scratch shrinks to minimal
+    # aligned shapes (8-row sublane, 128-lane) instead of holding ~2 MB of
+    # VMEM in every fp8/MoE program (round-5 ADVICE).
+    mat_absent = not mat_specs
     kch_max = max((sp.kch for sp in mat_specs), default=TILE)
+    m_kch = kch_max if not mat_absent else 8
+    m_rows = TILE if not mat_absent else 8
+    m_cols = MAT_COLS if not mat_absent else 128
     if workspace_m is None:
         workspace_m = jnp.zeros((1, MAT_COLS), wdt)
     w8_absent = workspace8 is None
@@ -1025,9 +1033,9 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
             pltpu.VMEM((MF, TILE, TILE), jnp.float32),  # vmoe_a (gate/act)
             pltpu.VMEM((MF, TILE, TILE), jnp.float32),  # vmoe_b (up)
             pltpu.VMEM((MH, TILE, TILE), jnp.float32),  # vmoe_o (out acc)
-            pltpu.VMEM((2, kch_max, MAT_COLS), wdt),    # vbm (mat chunks)
-            pltpu.VMEM((TILE, MAT_COLS), jnp.float32),  # vaccm (mat accum)
-            pltpu.VMEM((TILE, MAT_COLS), wdt),          # voutm (mat stores)
+            pltpu.VMEM((2, m_kch, m_cols), wdt),        # vbm (mat chunks)
+            pltpu.VMEM((m_rows, m_cols), jnp.float32),  # vaccm (mat accum)
+            pltpu.VMEM((m_rows, m_cols), wdt),          # voutm (mat stores)
             pltpu.SemaphoreType.DMA(()),               # copy_sem
             pltpu.SemaphoreType.DMA((2 * PIPE_DEPTH + 1,)),  # pipe (+pf sem)
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),

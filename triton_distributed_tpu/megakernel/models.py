@@ -117,6 +117,13 @@ def feed_layer_weights(feeds: dict, h: DecodeLayerHandles, *, wq, wk, wv,
         # in the matrix layout — keying feeds by None would surface later
         # as an opaque split_feeds crash).
         return feeds
+    if (w_gate is None) != (w_up is None):
+        # A lone half would surface much later as an opaque
+        # jnp.asarray(None) crash inside scatter_mat — fail at the call.
+        raise ValueError(
+            "feed_layer_weights needs BOTH w_gate and w_up (or neither); "
+            f"got w_gate={'set' if w_gate is not None else None}, "
+            f"w_up={'set' if w_up is not None else None}")
     if w_gate is not None:
         if h.w_gateup is not None:
             feeds[h.w_gateup] = (w_gate, w_up)

@@ -86,29 +86,35 @@ def dense_llm_specs(cfg: ModelConfig, axis: str = "tp") -> dict:
 
 
 def _logits(params: dict, cfg: ModelConfig, x: jax.Array, *, axis: str,
-            n: int) -> jax.Array:
+            n: int, inter_axis: str = "dcn", n_inter: int = 1) -> jax.Array:
     """Final norm + vocab-col-parallel lm_head; logits gathered to full
-    vocab (reference dense.py lm_head path)."""
+    vocab (reference dense.py lm_head path). ``n_inter`` > 1: the head is
+    column-sharded over BOTH mesh tiers (the hierarchical engine layout),
+    so the gather spans (inter, intra)."""
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T  # tied: replicated → full vocab locally
         return x @ head
     local = x @ head
-    if n == 1:
+    if n * n_inter == 1:
         return local
-    return jax.lax.all_gather(local, axis, axis=1, tiled=True)
+    gather_axis = (inter_axis, axis) if n_inter > 1 else axis
+    return jax.lax.all_gather(local, gather_axis, axis=1, tiled=True)
 
 
 def _mlp_or_moe(layer: dict, cfg: ModelConfig, h: jax.Array, *, axis: str,
-                n: int, mode: str, ar_fn=None, gemm_ar_fn=None) -> jax.Array:
+                n: int, mode: str, inter_axis: str = "dcn",
+                n_inter: int = 1, ar_fn=None, gemm_ar_fn=None) -> jax.Array:
     """FFN block dispatch: dense SwiGLU TP-MLP or TP-MoE (Qwen3-MoE)."""
     if "moe" in layer:
         from triton_distributed_tpu.ops.moe import moe_tp_fwd_local
 
         p = layer["moe"]
         # Prefill "overlap" rides the ring pipeline (chunk rotation under
-        # expert compute — VERDICT r2 #4); other modes map through.
+        # expert compute — VERDICT r2 #4); other modes map through. The
+        # hierarchical engine never selects overlap2d for MoE configs
+        # (models/engine.py), so no 2-tier mapping is needed here.
         moe_mode = "ring" if mode == "overlap" and n > 1 else (
             mode if n > 1 else "overlap")
         return moe_tp_fwd_local(
@@ -116,51 +122,67 @@ def _mlp_or_moe(layer: dict, cfg: ModelConfig, h: jax.Array, *, axis: str,
             cfg.num_experts_per_tok, axis=axis, num_ranks=n, mode=moe_mode,
             ar_fn=ar_fn)
     return tp_mlp_fwd(layer["mlp"], h, axis=axis, num_ranks=n, mode=mode,
+                      inter_axis=inter_axis, n_inter=n_inter,
                       ar_fn=ar_fn, gemm_ar_fn=gemm_ar_fn)
 
 
 def dense_prefill(params: dict, cfg: ModelConfig, input_ids: jax.Array,
                   cache: KVCache, *, axis: str = "tp", num_ranks: int = 1,
-                  mode: str = "overlap",
+                  mode: str = "overlap", inter_axis: str = "dcn",
+                  n_inter: int = 1,
                   flash_tiles: tuple[int, int] | None = None):
     """Device-local causal prefill.
 
     input_ids: (B, S) replicated. Activations run row-sharded over B·S in
-    overlap/xla modes ((B·S)/n rows per device), replicated otherwise.
+    overlap/xla modes ((B·S)/n rows per device; over BOTH mesh tiers —
+    (B·S)/(n·n_inter) rows, global shard g = inter·n+intra — in the
+    hierarchical ``overlap2d`` mode), replicated otherwise.
     Returns (last-token logits (B, vocab), cache filled for [0, S)).
     ``flash_tiles``: host-resolved flash tile caps (Engine passes the
     autotuned pair; None = cache-only lookup inside the layer).
     """
     n = num_ranks
+    N = n * n_inter
     batch, seq = input_ids.shape
     x = params["embed"][input_ids.reshape(-1)]  # (B·S, h)
-    row_sharded = n > 1 and mode in ("overlap", "xla")
+    row_sharded = (n > 1 and mode in ("overlap", "xla")) or (
+        N > 1 and mode == "overlap2d")
     if row_sharded:
         me = jax.lax.axis_index(axis)
-        rows = (batch * seq) // n
+        shards = n
+        if mode == "overlap2d":
+            me = jax.lax.axis_index(inter_axis) * n + me
+            shards = N
+        rows = (batch * seq) // shards
         x = jax.lax.dynamic_slice_in_dim(x, me * rows, rows, axis=0)
 
     for i, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
         attn_out, kv = tp_attn_prefill(
             layer["attn"], cfg, h, batch, seq, cache.layer(i),
-            axis=axis, num_ranks=n, mode=mode, flash_tiles=flash_tiles)
+            axis=axis, num_ranks=n, mode=mode, inter_axis=inter_axis,
+            n_inter=n_inter, flash_tiles=flash_tiles)
         cache = cache.with_layer(i, kv)
         x = x + attn_out
         h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp_or_moe(layer, cfg, h, axis=axis, n=n, mode=mode)
+        x = x + _mlp_or_moe(layer, cfg, h, axis=axis, n=n, mode=mode,
+                            inter_axis=inter_axis, n_inter=n_inter)
 
     if row_sharded:
-        x = jax.lax.all_gather(x, axis, tiled=True)  # (B·S, h)
+        gather_axis = ((inter_axis, axis) if mode == "overlap2d"
+                       and n_inter > 1 else axis)
+        x = jax.lax.all_gather(x, gather_axis, tiled=True)  # (B·S, h)
     last = x.reshape(batch, seq, -1)[:, -1]
-    logits = _logits(params, cfg, last, axis=axis, n=n)
+    logits = _logits(params, cfg, last, axis=axis, n=n,
+                     inter_axis=inter_axis, n_inter=n_inter)
     return logits, cache._replace(offset=jnp.int32(seq))
 
 
 def dense_prefill_chunked(params: dict, cfg: ModelConfig,
                           input_ids: jax.Array, cache: KVCache, *,
                           chunk: int, axis: str = "tp", num_ranks: int = 1,
-                          mode: str = "ar",
+                          mode: str = "ar", inter_axis: str = "dcn",
+                          n_inter: int = 1,
                           flash_tiles: tuple[int, int] | None = None):
     """Bounded-memory causal prefill: the prompt is processed ``chunk``
     tokens at a time, each chunk's queries attending the whole cached
@@ -201,19 +223,22 @@ def dense_prefill_chunked(params: dict, cfg: ModelConfig,
             attn_out, kv = tp_attn_prefill_chunk(
                 layer["attn"], cfg, h, cache.layer(i), start, chunk,
                 axis=axis, num_ranks=n, mode=attn_mode,
+                inter_axis=inter_axis, n_inter=n_inter,
                 flash_tiles=flash_tiles)
             cache = cache.with_layer(i, kv)
             x = x + attn_out
             h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
             x = x + _mlp_or_moe(layer, cfg, h, axis=axis, n=n,
-                                mode=attn_mode)
+                                mode=attn_mode, inter_axis=inter_axis,
+                                n_inter=n_inter)
         return cache, x
 
     x0 = jnp.zeros((batch * chunk, cfg.hidden_size),
                    params["embed"].dtype)
     cache, x_last = jax.lax.fori_loop(0, seq // chunk, body, (cache, x0))
     last = x_last.reshape(batch, chunk, -1)[:, -1]
-    logits = _logits(params, cfg, last, axis=axis, n=n)
+    logits = _logits(params, cfg, last, axis=axis, n=n,
+                     inter_axis=inter_axis, n_inter=n_inter)
     return logits, cache._replace(offset=jnp.int32(seq))
 
 
@@ -269,6 +294,7 @@ def make_gemm_ar_stream_fn(state0, *, axis: str, n: int,
 
 def _decode_body(params: dict, cfg: ModelConfig, tokens: jax.Array,
                  attend, *, axis: str, n: int, mode: str,
+                 inter_axis: str = "dcn", n_inter: int = 1,
                  ar_fn=None, gemm_ar_fn=None) -> jax.Array:
     """Shared one-token transformer walk; ``attend(i, attn_params, h)``
     supplies the attention (and threads its cache via closure)."""
@@ -279,14 +305,17 @@ def _decode_body(params: dict, cfg: ModelConfig, tokens: jax.Array,
         h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp_or_moe(
             layer, cfg, h, axis=axis, n=n,
-            mode=mode if mode in ("ar", "xla_rep") else "ar", ar_fn=ar_fn,
+            mode=mode if mode in ("ar", "xla_rep") else "ar",
+            inter_axis=inter_axis, n_inter=n_inter, ar_fn=ar_fn,
             gemm_ar_fn=gemm_ar_fn)
-    return _logits(params, cfg, x, axis=axis, n=n)
+    return _logits(params, cfg, x, axis=axis, n=n,
+                   inter_axis=inter_axis, n_inter=n_inter)
 
 
 def dense_decode_step(params: dict, cfg: ModelConfig, tokens: jax.Array,
                       cache: KVCache, *, axis: str = "tp",
                       num_ranks: int = 1, mode: str = "ar",
+                      inter_axis: str = "dcn", n_inter: int = 1,
                       ar_state=None, force_ar_kernel: bool = False,
                       fused_gemm_ar: bool = False):
     """Device-local one-token decode. tokens: (B,) replicated. Returns
@@ -317,12 +346,14 @@ def dense_decode_step(params: dict, cfg: ModelConfig, tokens: jax.Array,
         nonlocal cache
         out, kv = tp_attn_decode(attn_params, cfg, h, cache.layer(i), pos,
                                  axis=axis, num_ranks=n, mode=mode,
+                                 inter_axis=inter_axis, n_inter=n_inter,
                                  ar_fn=ar_fn, gemm_ar_fn=gemm_ar_fn)
         cache = cache.with_layer(i, kv)
         return out
 
     logits = _decode_body(params, cfg, tokens, attend,
-                          axis=axis, n=n, mode=mode, ar_fn=ar_fn,
+                          axis=axis, n=n, mode=mode, inter_axis=inter_axis,
+                          n_inter=n_inter, ar_fn=ar_fn,
                           gemm_ar_fn=gemm_ar_fn)
     cache = cache._replace(offset=pos + 1)
     if ar_state is not None:
@@ -333,6 +364,7 @@ def dense_decode_step(params: dict, cfg: ModelConfig, tokens: jax.Array,
 def dense_decode_step_paged(params: dict, cfg: ModelConfig,
                             tokens: jax.Array, cache, *, axis: str = "tp",
                             num_ranks: int = 1, mode: str = "ar",
+                            inter_axis: str = "dcn", n_inter: int = 1,
                             ar_state=None):
     """One-token decode over a :class:`PagedModelCache` — per-sequence
     positions (continuous batching: every sequence in the batch may be at
@@ -354,12 +386,14 @@ def dense_decode_step_paged(params: dict, cfg: ModelConfig,
         layer_cache = cache.layer(i)._replace(kv_lens=start_lens)
         out, layer_cache = tp_attn_decode_paged(
             attn_params, cfg, h, layer_cache,
-            axis=axis, num_ranks=n, mode=mode, ar_fn=ar_fn)
+            axis=axis, num_ranks=n, mode=mode, inter_axis=inter_axis,
+            n_inter=n_inter, ar_fn=ar_fn)
         cache = cache.with_layer_pools(i, layer_cache)
         return out
 
     logits = _decode_body(params, cfg, tokens, attend,
-                          axis=axis, n=n, mode=mode, ar_fn=ar_fn)
+                          axis=axis, n=n, mode=mode, inter_axis=inter_axis,
+                          n_inter=n_inter, ar_fn=ar_fn)
     # Saturated sequences (at pool capacity) drop the paged_append write, so
     # do NOT advance their kv_lens — an unclamped advance would silently
     # attend a cache missing the newest tokens with drifting RoPE positions.

@@ -46,6 +46,7 @@ class Engine:
                  ctx: DistContext | None = None, *, axis: str = "tp",
                  backend: str = "auto", max_seq: int = 256,
                  page_size: int | None = None,
+                 inter_axis: str | None = None,
                  prefill_fn: Callable = dense_prefill,
                  decode_fn: Callable = dense_decode_step):
         self.cfg = cfg
@@ -54,6 +55,34 @@ class Engine:
         self.n = self.ctx.axis_size(axis)
         self.backend = backend
         self.max_seq = max_seq
+        # Hierarchical DCN×ICI path (ops/hierarchical.py): on a 2-axis
+        # mesh the TP group spans BOTH tiers — weights/cache shard over
+        # (inter, intra) jointly, prefill can run the overlapped
+        # ``overlap2d`` mode (slice blocks rotating over DCN under the
+        # consumer GEMM) and replicated-mode reductions become the
+        # two-tier AR. ``inter_axis=None`` auto-detects (first non-tp
+        # mesh axis of size > 1); ``inter_axis=""`` opts OUT — the old
+        # single-axis layout with the second axis purely replicated (for
+        # meshes whose extra axis is data-parallel, not a DCN tier).
+        # Backends xla/megakernel and MoE configs keep the single-axis
+        # layout regardless.
+        if inter_axis == "":
+            inter_axis = None
+        elif inter_axis is None:
+            inter_axis = next(
+                (a for a in self.ctx.mesh.axis_names
+                 if a != axis and self.ctx.axis_size(a) > 1), None)
+        self.inter_axis = inter_axis
+        self.n_inter = (self.ctx.axis_size(inter_axis)
+                        if inter_axis is not None else 1)
+        self.hierarchical = (
+            self.n_inter > 1 and backend in ("auto", "overlap")
+            and not cfg.is_moe and prefill_fn is dense_prefill
+            and decode_fn is dense_decode_step
+            and cfg.num_kv_heads % (self.n * self.n_inter) == 0)
+        if not self.hierarchical:
+            self.n_inter = 1
+        self.n_total = self.n * self.n_inter
         # page_size switches decode to the paged cache (continuous
         # batching; reference PagedKVCache path). Prefill still runs the
         # fast batched path into a linear cache, then mirrors into pages.
@@ -64,12 +93,16 @@ class Engine:
         self._decode_fn = (dense_decode_step_paged
                            if page_size is not None and
                            decode_fn is dense_decode_step else decode_fn)
-        if cfg.num_kv_heads % self.n:
+        if cfg.num_kv_heads % self.n_total:
             raise ValueError(
                 f"num_kv_heads {cfg.num_kv_heads} not divisible by TP "
-                f"degree {self.n}")
+                f"degree {self.n_total}")
 
-        self.param_specs = dense_llm_specs(cfg, axis)
+        # Joint (inter, intra) sharding when hierarchical — a tuple in a
+        # PartitionSpec dim shards over both mesh axes.
+        self.shard_axes = ((self.inter_axis, axis) if self.hierarchical
+                           else axis)
+        self.param_specs = dense_llm_specs(cfg, self.shard_axes)
         mesh = self.ctx.mesh
         self.params = jax.device_put(
             params, jax.tree.map(lambda s: NamedSharding(mesh, s),
@@ -83,6 +116,20 @@ class Engine:
             return "ar"   # replicated prefill; decode goes through the MK
         if self.backend == "xla":
             return "xla" if (batch * seq) % self.n == 0 else "xla_rep"
+        if self.hierarchical:
+            # Joint (inter, intra) weight sharding: valid modes are the
+            # hierarchical overlap and replicated-ar (two-tier AR). AUTO
+            # runs the DCN-crossover perf model; "overlap" forces the
+            # hierarchical path whenever the rows divide.
+            if self.backend == "overlap":
+                return ("overlap2d" if (batch * seq) % self.n_total == 0
+                        else "ar")
+            m = pick_mode("auto", batch * seq, self.n,
+                          hidden=self.cfg.hidden_size,
+                          ffn=self.cfg.intermediate_size,
+                          itemsize=jnp.dtype(self.cfg.dtype).itemsize,
+                          n_inter=self.n_inter)
+            return m if m == "overlap2d" else "ar"
         m = pick_mode("auto", batch * seq, self.n,
                       hidden=self.cfg.hidden_size,
                       ffn=self.cfg.intermediate_size,
@@ -114,17 +161,20 @@ class Engine:
         )
 
         return resolve_flash_tiles(
-            sq, sk, self.cfg.num_heads // self.n,
-            self.cfg.num_kv_heads // self.n, self.cfg.head_dim,
+            sq, sk, self.cfg.num_heads // self.n_total,
+            self.cfg.num_kv_heads // self.n_total, self.cfg.head_dim,
             jnp.dtype(self.cfg.dtype), q_offset=max(sk - sq, 0))
 
     def _prefill_jit(self, batch: int, seq: int):
         key = ("prefill", batch, seq)
         if key not in self._jit_cache:
             mode = self._prefill_mode(batch, seq)
-            cspecs = kv_cache_specs(self.axis)
+            cspecs = kv_cache_specs(self.shard_axes)
             extra = ({"flash_tiles": self._flash_tiles(seq, seq)}
                      if self._prefill_fn is dense_prefill else {})
+            if self.hierarchical:
+                extra.update(inter_axis=self.inter_axis,
+                             n_inter=self.n_inter)
 
             def step(params, ids, cache):
                 return self._prefill_fn(
@@ -141,10 +191,13 @@ class Engine:
     def _use_ar_stream(self) -> bool:
         """Barrier-free parity AR on the decode path: mode='ar', real TP,
         dense decode fns only — a user-supplied decode_fn has no ar_state
-        contract (opt out with TDTPU_AR_STREAM=0)."""
+        contract (opt out with TDTPU_AR_STREAM=0). Hierarchical engines
+        opt out: the parity-stream protocol is intra-slice only, their
+        reductions run the two-tier AR (layers/common.tp_reduce)."""
         import os
 
-        return (self.n > 1 and self._decode_mode() == "ar"
+        return (self.n > 1 and self.n_inter == 1
+                and self._decode_mode() == "ar"
                 and self._decode_fn in (dense_decode_step,
                                         dense_decode_step_paged)
                 and os.environ.get("TDTPU_AR_STREAM", "1") != "0")
@@ -225,8 +278,8 @@ class Engine:
         key = ("decode", ar_stream, self._use_fused_gemm_ar())
         if key not in self._jit_cache:
             mode = self._decode_mode()
-            cspecs = (paged_cache_specs(self.axis) if self.page_size
-                      else kv_cache_specs(self.axis))
+            cspecs = (paged_cache_specs(self.shard_axes) if self.page_size
+                      else kv_cache_specs(self.shard_axes))
 
             if ar_stream:
                 fused = self._use_fused_gemm_ar()
@@ -246,10 +299,15 @@ class Engine:
                     out_specs=(P(), cspecs, P(self.axis), P()))
                 self._jit_cache[key] = jax.jit(fn, donate_argnums=(2, 3))
             else:
+                extra = ({"inter_axis": self.inter_axis,
+                          "n_inter": self.n_inter}
+                         if self.hierarchical else {})
+
                 def step(params, tokens, cache):
                     logits, cache = self._decode_fn(
                         params, self.cfg, tokens, cache,
-                        axis=self.axis, num_ranks=self.n, mode=mode)
+                        axis=self.axis, num_ranks=self.n, mode=mode,
+                        **extra)
                     return sampling.greedy(logits), cache
 
                 fn = self._shard(
@@ -265,7 +323,7 @@ class Engine:
         mesh = self.ctx.mesh
         return jax.device_put(
             cache, jax.tree.map(lambda s: NamedSharding(mesh, s),
-                                kv_cache_specs(self.axis),
+                                kv_cache_specs(self.shard_axes),
                                 is_leaf=lambda x: isinstance(x, P)))
 
     def to_paged(self, cache: KVCache) -> PagedModelCache:
@@ -281,7 +339,7 @@ class Engine:
             mesh = self.ctx.mesh
             shardings = jax.tree.map(
                 lambda sp: NamedSharding(mesh, sp),
-                paged_cache_specs(self.axis),
+                paged_cache_specs(self.shard_axes),
                 is_leaf=lambda x: isinstance(x, P))
 
             def convert(c: KVCache) -> PagedModelCache:
@@ -326,17 +384,20 @@ class Engine:
 
         key = ("prefill_chunked", batch, seq, chunk)
         if key not in self._jit_cache:
-            cspecs = kv_cache_specs(self.axis)
+            cspecs = kv_cache_specs(self.shard_axes)
             # Replicated-activation mode matching the backend: 'xla' engines
             # must not silently run Pallas collectives.
             mode = self._decode_mode()
             tiles = self._flash_tiles(chunk, self.max_seq)
+            extra = ({"inter_axis": self.inter_axis,
+                      "n_inter": self.n_inter}
+                     if self.hierarchical else {})
 
             def step(params, ids, cache):
                 return dense_prefill_chunked(
                     params, self.cfg, ids, cache, chunk=chunk,
                     axis=self.axis, num_ranks=self.n, mode=mode,
-                    flash_tiles=tiles)
+                    flash_tiles=tiles, **extra)
 
             fn = self._shard(
                 step,

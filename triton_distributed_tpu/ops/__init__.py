@@ -102,6 +102,14 @@ from triton_distributed_tpu.ops.two_level import (  # noqa: F401
     all_reduce_2d,
     reduce_scatter_2d,
 )
+from triton_distributed_tpu.ops.hierarchical import (  # noqa: F401
+    ag_gemm_2d,
+    ag_gemm_2d_local,
+    gemm_rs_2d,
+    gemm_rs_2d_local,
+    sp_ag_attention_2d,
+    sp_ag_attention_2d_local,
+)
 from triton_distributed_tpu.ops.multi_axis import (  # noqa: F401
     all_gather_torus,
     all_gather_torus_local,

@@ -130,11 +130,19 @@ def _ag_gemm_kernel(n: int, axis: str, m: int, k: int, ncols: int,
 
 def ag_gemm_local(x_local: jax.Array, b_local: jax.Array, axis: str = "tp",
                   num_ranks: int | None = None,
-                  cfg: AGGemmConfig = AGGemmConfig()) -> jax.Array:
+                  cfg: AGGemmConfig = AGGemmConfig(),
+                  return_gathered: bool = False):
     """Device-local overlapped AG+GEMM inside an existing shard_map region.
 
     x_local: (m, k) A shard; b_local: (k, ncols) local B columns.
     Returns (num_ranks·m, ncols) = all_gather(A) @ B_local.
+
+    ``return_gathered``: also return the gathered A block (num_ranks·m, k)
+    the kernel assembled in its landing workspace — the hierarchical ops
+    (ops/hierarchical.py) ship exactly this block over DCN, so exposing it
+    avoids a second intra-slice gather. The workspace is already a kernel
+    output buffer (Mosaic has no HBM scratch, language/core.py); this flag
+    just stops dropping it.
     """
     if num_ranks is None:
         raise ValueError("num_ranks required inside shard_map")
@@ -148,8 +156,9 @@ def ag_gemm_local(x_local: jax.Array, b_local: jax.Array, axis: str = "tp",
         # compute core so single-chip compile checks exercise the kernel path.
         from triton_distributed_tpu.ops.gemm import pallas_matmul
 
-        return pallas_matmul(x_local, b_local, tile_m=cfg.tile_m,
-                             tile_n=cfg.tile_n, tile_k=cfg.tile_k)
+        out = pallas_matmul(x_local, b_local, tile_m=cfg.tile_m,
+                            tile_n=cfg.tile_n, tile_k=cfg.tile_k)
+        return (out, x_local) if return_gathered else out
     sub = _ag_sub_chunks(m, cfg.sub_chunks, x_local.dtype)
     # Tiles derive from the SUB-BLOCK rows: a tile that divides m but not
     # m/sub would make matmul_tiles' floored grid silently drop the
@@ -157,14 +166,17 @@ def ag_gemm_local(x_local: jax.Array, b_local: jax.Array, axis: str = "tp",
     tm, tk, tn = gemm_tiles(m // sub, k, ncols, x_local.dtype, cfg)
     kernel = functools.partial(_ag_gemm_kernel, n, axis, m, k, ncols,
                                (tm, tk, tn), cfg.straggler, sub)
+    ws = jax.ShapeDtypeStruct((n * m, k), x_local.dtype)  # AG landing ws
+    out_shape = jax.ShapeDtypeStruct((n * m, ncols), x_local.dtype)
+    # With return_gathered the landing workspace is promoted to a real
+    # output — the ref ordering the kernel sees is identical either way
+    # (workspaces are appended after the real outputs, language/core.py).
     out = kernel_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((n * m, ncols), x_local.dtype),
+        out_shape=(out_shape, ws) if return_gathered else out_shape,
         in_specs=[any_spec(), any_spec()],
-        out_specs=any_spec(),
-        workspaces=[
-            jax.ShapeDtypeStruct((n * m, k), x_local.dtype),  # AG landing ws
-        ],
+        out_specs=(any_spec(), any_spec()) if return_gathered else any_spec(),
+        workspaces=() if return_gathered else (ws,),
         scratch_shapes=[
             pltpu.VMEM((tm, tn), jnp.float32),
             pltpu.SemaphoreType.DMA((max((n - 1) * sub, 1),)),

@@ -257,6 +257,46 @@ def gemm_rs_time_s(m_global: int, n_cols: int, k: int, n_ranks: int,
     return max(t_gemm, t_rs) + fill
 
 
+def _dcn_hop_time_s(nbytes: int, spec: ChipSpec) -> float:
+    """One DCN ring hop: per-hop latency + payload over the DCN pipe."""
+    return nbytes / (spec.dcn_gbps * 1e9) + spec.dcn_latency_s
+
+
+def ag_gemm_2d_time_s(m_global: int, n_cols: int, k: int, n_intra: int,
+                      n_inter: int, itemsize: int,
+                      spec: ChipSpec | None = None) -> float:
+    """Hierarchical AG+GEMM (ops/hierarchical.ag_gemm_2d): the intra-slice
+    fused leg fills the pipeline, then each of the n_inter-1 DCN hops
+    overlaps one slice block's consumer GEMM — per remote slice the cost
+    is max(DCN hop, slice GEMM). The DCN latency term (10 µs/hop vs 1 µs
+    on ICI) is what makes AUTO decline the path at small row counts."""
+    spec = spec or chip_spec()
+    m_slice = max(m_global // max(n_inter, 1), 1)
+    t_intra = ag_gemm_time_s(m_slice, n_cols, k, n_intra, itemsize, spec)
+    if n_inter <= 1:
+        return t_intra
+    t_slice_gemm = gemm_time_s(m_slice, n_cols, k, itemsize, spec)
+    t_hop = _dcn_hop_time_s(m_slice * k * itemsize, spec)
+    return t_intra + (n_inter - 1) * max(t_hop, t_slice_gemm)
+
+
+def gemm_rs_2d_time_s(m_global: int, n_cols: int, k: int, n_intra: int,
+                      n_inter: int, itemsize: int,
+                      spec: ChipSpec | None = None) -> float:
+    """Hierarchical GEMM+RS (ops/hierarchical.gemm_rs_2d): per slice chunk
+    the fused intra GEMM+RS runs, and the chunk's DCN ring hop (already
+    ICI-reduced — 1/n_intra of the bytes) overlaps the next chunk's
+    compute. First chunk fills the pipeline."""
+    spec = spec or chip_spec()
+    m_slice = max(m_global // max(n_inter, 1), 1)
+    t_chunk = gemm_rs_time_s(m_slice, n_cols, k, n_intra, itemsize, spec)
+    if n_inter <= 1:
+        return t_chunk
+    t_hop = _dcn_hop_time_s(m_slice // max(n_intra, 1) * n_cols * itemsize,
+                            spec)
+    return t_chunk + (n_inter - 1) * max(t_hop, t_chunk)
+
+
 def rank_gemm_tiles(candidates, m: int, n: int, k: int, itemsize: int,
                     spec: ChipSpec | None = None, top: int | None = None):
     """Rank (tile_m, tile_n, tile_k) configs by modeled time, best first.
