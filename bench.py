@@ -87,7 +87,7 @@ def _timed_once(fn, a, b, n):
     return time.perf_counter() - t0
 
 
-def _timed_interleaved(fns, a, b, lengths, trials):
+def _timed_interleaved(fns, a, b, lengths, trials, samples=None):
     """best-of-``trials`` per (fn, length), with all candidates interleaved
     round-robin inside every trial round.
 
@@ -95,6 +95,11 @@ def _timed_interleaved(fns, a, b, lengths, trials):
     candidate to completion then the next bakes that drift into the
     vs_baseline ratio. Interleaving means each round compares candidates
     under the same chip conditions, and min-per-cell discards slow rounds.
+
+    ``samples``: optional dict accumulating every trial's raw second-count
+    per (fn index, length) — the spread feeds runtime.utils.PerfStats so
+    the report can show how hard the window swung (the dispatch-swing
+    evidence previously discarded by the min).
     """
     best = {(i, n): float("inf") for i in range(len(fns)) for n in lengths}
     for i, fn in enumerate(fns):  # warmup / compile
@@ -103,7 +108,10 @@ def _timed_interleaved(fns, a, b, lengths, trials):
     for _t in range(trials):
         for i, fn in enumerate(fns):
             for n in lengths:
-                best[(i, n)] = min(best[(i, n)], _timed_once(fn, a, b, n))
+                t = _timed_once(fn, a, b, n)
+                best[(i, n)] = min(best[(i, n)], t)
+                if samples is not None:
+                    samples.setdefault((i, n), []).append(t)
     return [[best[(i, n)] for n in lengths] for i in range(len(fns))]
 
 
@@ -134,18 +142,30 @@ def _per_iter_seconds(times, lengths, flops, strict=True):
 
 
 def main():
+    # Observability hook: TDTPU_OBS_DIR=<dir> makes every bench run leave
+    # artifacts (host spans incl. autotuner sweeps, metrics snapshot) that
+    # `python -m triton_distributed_tpu.obs.report <dir>` renders.
+    from triton_distributed_tpu import obs
+
+    obs_on = obs.run_from_env()
     # The sandbox's remote-compile helper 500s intermittently and the shared
     # chip occasionally produces a non-monotone round; both are transient.
     # Retry the whole measurement rather than reporting nothing.
     last = None
-    for attempt in range(4):
-        try:
-            return _measure_and_report()
-        except Exception as e:  # BenchError or transient compile failure
-            last = e
-            print(f"# bench attempt {attempt} failed: {e}", file=sys.stderr)
-            time.sleep(5)
-    raise last
+    try:
+        for attempt in range(4):
+            try:
+                with obs.trace.span("bench.round", attempt=attempt):
+                    return _measure_and_report()
+            except Exception as e:  # BenchError or transient compile failure
+                last = e
+                print(f"# bench attempt {attempt} failed: {e}",
+                      file=sys.stderr)
+                time.sleep(5)
+        raise last
+    finally:
+        if obs_on:
+            obs.finish_run()
 
 
 def _measure_and_report():
@@ -210,12 +230,15 @@ def _measure_and_report():
     # chip comes in bursts longer than one interleaved round, so a single
     # pass can be entirely inside a bad window; the min estimator
     # converges to the clean-window reading for every candidate equally.
+    window_samples: dict = {}
     times = _timed_interleaved(fns, a, b, lengths,
-                               trials=4 if on_tpu else 1)
+                               trials=4 if on_tpu else 1,
+                               samples=window_samples)
     if on_tpu:
         for _pass in range(2):
             time.sleep(3)
-            t2 = _timed_interleaved(fns, a, b, lengths, trials=4)
+            t2 = _timed_interleaved(fns, a, b, lengths, trials=4,
+                                    samples=window_samples)
             times = [[min(x, y) for x, y in zip(row, row2)]
                      for row, row2 in zip(times, t2)]
     t_xla = _per_iter_seconds(times[0], lengths, flops, strict=strict)
@@ -233,6 +256,20 @@ def _measure_and_report():
     winner = min(live, key=live.get)
     t_pallas = live[winner]
 
+    # Window-spread evidence via the shared PerfStats type (the stats
+    # perf_func now returns — the satellite: bench consumes it instead of
+    # re-implementing): spread of the LONGEST chain's raw trial times, per
+    # candidate. A wide p95/min ratio flags a contended window.
+    from triton_distributed_tpu.runtime.utils import PerfStats
+
+    def spread(i):
+        cell = window_samples.get((i, lengths[-1]))
+        if not cell:
+            return None
+        st = PerfStats([s * 1e3 for s in cell])
+        return {"p50_ms": round(st.p50, 2), "p95_ms": round(st.p95, 2),
+                "min_ms": round(st.min, 2), "n": len(st.samples)}
+
     result = {
         "metric": "pallas_gemm_tflops_qwen3_tp8_shape",
         "value": round(flops / t_pallas / 1e12, 3),
@@ -243,6 +280,8 @@ def _measure_and_report():
         "headline_candidates_vs_xla": {
             nm: (round(t_xla / t, 4) if t else "dropped (gates)")
             for nm, t in per_cand.items()},
+        "window_spread": {
+            nm: spread(i) for i, nm in enumerate(["xla"] + names)},
     }
     if on_tpu:
         try:

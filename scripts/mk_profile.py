@@ -14,6 +14,9 @@ measured layer step, so the attribution can be checked for completeness.
 
     python scripts/mk_profile.py              # CPU smoke (tiny shapes)
     TDTPU_BENCH_ON_TPU=1 python scripts/mk_profile.py
+    python scripts/mk_profile.py --json costs.json   # measured per-type
+        # costs in the obs.kernel_profile.attach_durations(measured=...)
+        # form — feed them to KernelProfile for measured (not est:) lanes
 """
 
 import functools
@@ -106,6 +109,14 @@ def build_case(name, emit, L, feeds_fn, dtype):
 
 
 def main():
+    # Parse --json BEFORE measuring: a malformed invocation must fail in
+    # milliseconds, not after minutes of on-chip profiling.
+    json_out = None
+    if "--json" in sys.argv:
+        i = sys.argv.index("--json")
+        if i + 1 >= len(sys.argv) or sys.argv[i + 1].startswith("-"):
+            sys.exit("usage: mk_profile.py [--json OUT_PATH]")
+        json_out = sys.argv[i + 1]
     if ON_TPU:
         hidden, hq, hkv, ffn, S = 4096, 4, 1, 1536, 1024
         # Post-rework tasks run ~3-20 us: the differential needs tens of
@@ -125,6 +136,15 @@ def main():
     d = TILE
 
     cases = []
+
+    # TaskType dispatched by each case (for the --json per-type export).
+    _CASE_TYPE = {
+        "qkv_mat": "GEMM_MAT", "gateup_mat": "GEMM_MAT",
+        "down_mat": "GEMM_MAT", "o_mat": "GEMM_MAT",
+        "gemm": "GEMM_WIDE", "rms_norm": "RMS_NORM", "add": "ADD",
+        "silu_mul": "SILU_MUL", "norm_rope": "NORM_ROPE",
+        "attn_gqa": "ATTN_DECODE_GQA", "append_kv": "APPEND_KV",
+    }
 
     def add_case(name, count_per_layer, lengths, emit, feeds_fn):
         cases.append((name, count_per_layer, lengths, emit, feeds_fn))
@@ -235,6 +255,31 @@ def main():
               f"= {count * per * 1e6:9.1f} us")
     print(f"{'PREDICTED layer-step total':36} {total * 1e3:9.3f} ms "
           "(compare bench_megakernel measured step)")
+
+    if json_out is not None:
+        # Measured per-TaskType costs in the form
+        # obs.kernel_profile.attach_durations(measured=...) consumes
+        # (KernelProfile then renders measured, not `est:`, lanes).
+        # Multiple cases per type (the four GEMM_MAT shapes) reduce by
+        # median — the representative per-task cost, robust to one
+        # outlier shape.
+        import json
+
+        out_path = json_out
+        by_type: dict = {}
+        for name, _count, per in rows:
+            if per is None:
+                continue
+            tt = _CASE_TYPE.get(name.split()[0])
+            if tt:
+                by_type.setdefault(tt, []).append(per)
+        per_type = {tt: sorted(v)[len(v) // 2] for tt, v in by_type.items()}
+        with open(out_path, "w") as f:
+            json.dump({"per_type_seconds": per_type,
+                       "cases": [{"case": n, "count_per_layer": c,
+                                  "seconds": p} for n, c, p in rows]},
+                      f, indent=2)
+        print(f"wrote {out_path}")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,390 @@
+"""Observability layer tests (ISSUE 3): span tracer (incl. the
+zero-overhead disabled fast path), metrics registry, megakernel
+profile=True per-task timelines, replay-event JSONL lanes, report merge,
+and the instrumented Engine leaving a complete run directory."""
+
+import json
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_distributed_tpu import obs
+from triton_distributed_tpu.obs import metrics as obs_metrics
+from triton_distributed_tpu.obs import trace as obs_trace
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_run():
+    """Every test starts and ends with the tracer disabled."""
+    obs_trace.disable()
+    yield
+    obs_trace.disable()
+
+
+# ---------------------------------------------------------------------------
+# Tracer.
+# ---------------------------------------------------------------------------
+
+def test_disabled_span_is_shared_noop_singleton():
+    s1 = obs_trace.span("anything", key=1)
+    s2 = obs_trace.span("else")
+    assert s1 is s2              # no allocation on the disabled path
+    assert not obs_trace.is_enabled()
+    obs_trace.instant("x")       # no-ops, no error
+    obs_trace.counter("y", 1.0)
+
+
+def test_disabled_span_overhead_is_negligible():
+    """The acceptance criterion's testable form: with the tracer off, the
+    instrumented pattern (`with span(...)`) costs single-digit
+    microseconds per call at most — decode-step timing is unchanged
+    within noise. Bound is deliberately loose (CI machines swing) yet far
+    below any real decode step."""
+    n = 50_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with obs_trace.span("decode_step"):
+            pass
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 20e-6, f"disabled span costs {per_call * 1e6:.2f} us"
+
+
+def test_spans_nest_and_export_chrome(tmp_path):
+    t = obs_trace.enable(str(tmp_path))
+    with obs_trace.span("outer", a=1):
+        with obs_trace.span("inner"):
+            time.sleep(0.002)
+    obs_trace.instant("marker")
+    obs_trace.counter("queue_depth", 3)
+    obs_trace.disable()
+    path = t.save()
+    with open(path) as f:
+        data = json.load(f)
+    evs = {e["name"]: e for e in data["traceEvents"]}
+    assert "outer" in evs and "inner" in evs and "marker" in evs
+    outer, inner = evs["outer"], evs["inner"]
+    # Complete events: inner nests inside outer on the same lane. ts is
+    # rebased to unix-epoch us (~1.7e15), where float64 granularity is
+    # ~0.25 us — allow 1 us of rounding slack.
+    assert inner["ts"] >= outer["ts"] - 1.0
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1.0
+    assert inner["dur"] >= 2_000 * 0.9   # >= ~2 ms in us
+    assert outer["args"]["a"] == 1
+    # Valid chrome trace per the report's validator.
+    from triton_distributed_tpu.obs.report import validate_chrome
+
+    assert validate_chrome(data) == []
+
+
+def test_span_records_error_and_reraises(tmp_path):
+    t = obs_trace.enable(str(tmp_path))
+    with pytest.raises(ValueError):
+        with obs_trace.span("boom"):
+            raise ValueError("x")
+    obs_trace.disable()
+    ev = [e for e in t.events() if e["name"] == "boom"][0]
+    assert ev["args"]["error"] == "ValueError"
+
+
+# ---------------------------------------------------------------------------
+# Metrics.
+# ---------------------------------------------------------------------------
+
+def test_metrics_counter_gauge_histogram():
+    reg = obs_metrics.Registry()
+    c = reg.counter("tok_total", "tokens")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = reg.gauge("tps", "tokens/s")
+    g.set(12.5)
+    assert g.value == 12.5
+    h = reg.histogram("lat_ms", "latency")
+    for v in (1.0, 2.0, 3.0, 100.0):
+        h.observe(v)
+    assert h.count == 4
+    assert h.quantile(50) == 2.0
+    snap = reg.snapshot()
+    assert snap["tok_total"]["value"] == 5
+    assert snap["lat_ms"]["p50"] == 2.0
+    assert snap["lat_ms"]["count"] == 4
+    # Bucket counts (incl. the +Inf overflow bucket) must sum to count.
+    assert sum(snap["lat_ms"]["buckets"].values()) == 4
+    h_over = reg.histogram("over_ms", buckets=(1.0, 10.0))
+    h_over.observe(2000.0)
+    over = reg.snapshot()["over_ms"]
+    assert over["buckets"]["+Inf"] == 1
+    assert sum(over["buckets"].values()) == over["count"] == 1
+    # Same name returns the same series; wrong kind raises.
+    assert reg.counter("tok_total") is c
+    with pytest.raises(TypeError):
+        reg.gauge("tok_total")
+
+
+def test_metrics_prometheus_exposition():
+    reg = obs_metrics.Registry()
+    reg.counter("a_total", "help a").inc(3)
+    h = reg.histogram("b_ms", "help b", buckets=(1.0, 10.0))
+    h.observe(0.5)
+    h.observe(5.0)
+    h.observe(50.0)
+    text = reg.to_prometheus()
+    assert "# TYPE a_total counter" in text
+    assert "a_total 3" in text
+    assert "# TYPE b_ms histogram" in text
+    assert 'b_ms_bucket{le="1.0"} 1' in text
+    assert 'b_ms_bucket{le="10.0"} 2' in text
+    assert 'b_ms_bucket{le="+Inf"} 3' in text
+    assert "b_ms_count 3" in text
+
+
+def test_metrics_save(tmp_path):
+    reg = obs_metrics.Registry()
+    reg.counter("x_total").inc()
+    path = reg.save(str(tmp_path))
+    with open(path) as f:
+        assert json.load(f)["x_total"]["value"] == 1
+    assert (tmp_path / "metrics.prom").exists()
+
+
+# ---------------------------------------------------------------------------
+# Megakernel profile=True. ONE shared program + ONE profiled step feed
+# every test below (interpret-mode compiles dominate tier-1 wall time).
+# ---------------------------------------------------------------------------
+
+def _synthetic_prof():
+    """A valid profile dump built by hand (the stamp format is
+    [exec_index, type, out, a0, b0, k_tiles, a_stride, b_stride, arg, c0,
+    d0] in lanes 0..10, -1 elsewhere) — lets the decode/render tests run
+    without paying an interpret-mode kernel step; the slow-marked test
+    below proves the kernel stamps exactly this."""
+    from triton_distributed_tpu.megakernel.tasks import TaskType
+
+    prof = np.full((2, 128), -1, np.int32)
+    #          seq  type                        out a0 b0 kt as bs arg c0 d0
+    prof[0, :11] = [0, int(TaskType.GEMM_WIDE), 3, 0, 1, 1, 1, 2, 2, 0, 0]
+    prof[1, :11] = [1, int(TaskType.ADD), 5, 3, 3, 2, 0, 0, 0, 0, 0]
+    return prof
+
+
+@pytest.mark.slow
+def test_megakernel_profile_step_stamp_and_parity():
+    """The REAL kernel (interpret mode): profile=True stamps each grid
+    step's queue row into its dump row, and does not perturb the
+    computation (checked vs the analytic golden 2 * (x @ w))."""
+    from triton_distributed_tpu.megakernel import MegaKernelBuilder
+    from triton_distributed_tpu.obs.kernel_profile import decode_records
+
+    mb = MegaKernelBuilder()
+    m, h, f = 128, 128, 256
+    x = mb.tensor(m, h)
+    w = mb.tensor(h, f)
+    gate = mb.tensor(m, f)
+    act = mb.tensor(m, f)
+    mb.gemm(gate, x, w)
+    mb.add(act, gate, gate)
+    comp = mb.compile()
+    rng = np.random.default_rng(0)
+    feeds = {t: rng.standard_normal((t.rows, t.cols)).astype(np.float32)
+             * 0.1 for t in (x, w)}
+    ws = comp.make_workspace({k: jnp.asarray(v) for k, v in feeds.items()})
+    ws_p, prof = comp.step(ws, profile=True)
+    prof = np.asarray(prof)
+    assert prof.shape == (comp.num_exec, 128)
+    recs = decode_records(prof)
+    queue = np.asarray(comp.queue)
+    assert [r.seq for r in recs] == list(range(comp.num_exec))
+    for r in recs:   # the stamp IS the queue row
+        assert r.type == int(queue[r.seq, 0])
+        assert r.words["out"] == int(queue[r.seq, 1])
+        assert r.words["k_tiles"] == int(queue[r.seq, 4])
+    np.testing.assert_allclose(
+        np.asarray(comp.gather_output(ws_p, act)),
+        2.0 * (feeds[x] @ feeds[w]), rtol=1e-4, atol=1e-5)
+
+
+def test_kernel_profile_decode_and_summary():
+    from triton_distributed_tpu.obs.kernel_profile import (
+        KernelProfile, decode_records,
+    )
+
+    prof = _synthetic_prof()
+    recs = decode_records(prof)
+    assert [r.type_name for r in recs] == ["GEMM_WIDE", "ADD"]
+    assert recs[0].words == {"out": 3, "a0": 0, "b0": 1, "k_tiles": 1,
+                             "a_stride": 1, "b_stride": 2, "arg": 2,
+                             "c0": 0, "d0": 0}
+    kp = KernelProfile.from_dump(prof, itemsize=4)
+    summary = kp.summary()
+    assert summary["n_tasks"] == 2
+    assert set(summary["classes"]) == {"gemm", "elementwise"}
+    assert summary["task_sum_s"] > 0
+
+
+def test_kernel_profile_chrome_lanes_and_roundtrip(tmp_path):
+    from triton_distributed_tpu.obs.kernel_profile import (
+        KernelProfile, load_profile,
+    )
+    from triton_distributed_tpu.obs.report import validate_chrome
+
+    kp = KernelProfile.from_dump(_synthetic_prof(), itemsize=4,
+                                 measured_step_s=1.0, label="t")
+    evs = kp.to_chrome_events()
+    assert validate_chrome({"traceEvents": evs}) == []
+    lanes = {e["args"]["name"] for e in evs
+             if e.get("name") == "thread_name"}
+    assert "gemm" in lanes and "elementwise" in lanes
+    # measured_step_s >> task sum: the gap renders as a stall slice.
+    assert any(e["name"] == "unattributed/stall" for e in evs)
+    path = kp.save(str(tmp_path))
+    kp2 = load_profile(path)
+    assert kp2.summary() == kp.summary()
+
+
+def test_measured_durations_override_estimates():
+    from triton_distributed_tpu.obs.kernel_profile import (
+        KernelProfile,
+    )
+
+    kp = KernelProfile.from_dump(_synthetic_prof(), itemsize=4,
+                                 measured={"GEMM_WIDE": 42e-6})
+    gemm = [r for r in kp.records if r.type_name == "GEMM_WIDE"]
+    assert gemm and all(r.duration_kind == "measured"
+                        and r.duration_s == 42e-6 for r in gemm)
+    other = [r for r in kp.records if r.type_name != "GEMM_WIDE"]
+    assert all(r.duration_kind == "estimated" for r in other)
+
+
+# ---------------------------------------------------------------------------
+# Replay-event JSONL + report lanes.
+# ---------------------------------------------------------------------------
+
+def test_traceset_jsonl_and_commlint_lanes(tmp_path):
+    from triton_distributed_tpu.analysis.registry import build_registry
+    from triton_distributed_tpu.analysis.tracer import trace_op
+    from triton_distributed_tpu.obs.report import (
+        commlint_lanes, commlint_metrics, validate_chrome,
+    )
+
+    drv = build_registry((2,))["allgather"]
+    axes, dims = drv.meshes[0]
+    ts = trace_op(drv.run, axes=axes, dims=dims, name="allgather@2")
+    path = str(tmp_path / "allgather.events.jsonl")
+    n = ts.to_jsonl(path)
+    assert n == sum(len(r) for r in ts.events)
+    with open(path) as f:
+        first = json.loads(f.readline())
+    assert first["kind"] == "trace_header"
+    assert first["op"] == "allgather@2"
+    assert first["dims"] == [2]
+
+    evs = commlint_lanes(path, pid_base=95_000)
+    assert validate_chrome({"traceEvents": evs}) == []
+    pids = {e["pid"] for e in evs}
+    assert pids == {95_000, 95_001}          # one pid per rank
+    track_names = {e["args"]["name"] for e in evs
+                   if e.get("name") == "thread_name"}
+    assert any("sem" in t or "barrier" in t for t in track_names)
+
+    m = commlint_metrics(str(tmp_path))
+    assert m["tdtpu_commlint_dma_bytes_total"] > 0
+    assert m["tdtpu_commlint_semaphore_waits_total"] > 0
+
+
+def test_commlint_cli_events_dir(tmp_path):
+    from triton_distributed_tpu.analysis.commlint import main as cl_main
+
+    rc = cl_main(["--op", "allgather", "--ranks", "2",
+                  "--events-dir", str(tmp_path / "ev")])
+    assert rc == 0
+    files = list((tmp_path / "ev").glob("*.events.jsonl"))
+    assert len(files) == 1 and files[0].name == "allgather@2.events.jsonl"
+
+
+# ---------------------------------------------------------------------------
+# Instrumented Engine + report end-to-end.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_engine_serve_leaves_run_artifacts(ctx, tmp_path):
+    """Slow tier: the CI observability smoke exercises the same path
+    end-to-end (obs.report --dryrun serves a traced Engine and --check
+    asserts the metrics series); tier-1 keeps only sub-second obs tests —
+    the suite rides the edge of its 870 s budget."""
+    import jax
+
+    from triton_distributed_tpu.models import (
+        Engine, init_dense_llm, tiny_config,
+    )
+
+    cfg = tiny_config()
+    params = init_dense_llm(jax.random.key(0), cfg)
+    eng = Engine(cfg, params, ctx, backend="xla", max_seq=32)
+    ids = jax.random.randint(jax.random.key(1), (2, 8), 0, cfg.vocab_size)
+
+    run_dir = str(tmp_path / "run")
+    obs.start_run(run_dir)
+    try:
+        toks = eng.serve(ids, gen_len=3)
+    finally:
+        assert obs.finish_run() == run_dir
+    assert toks.shape == (2, 3)
+
+    with open(tmp_path / "run" / "metrics.json") as f:
+        snap = json.load(f)
+    # Token counter equals what serve() returned: batch 2 x gen_len 3
+    # (2 decode steps + the prefill-sampled first token). The FIRST
+    # prefill and first decode call compile — their wall times are routed
+    # to the jit-compile series so the serving percentiles stay honest.
+    assert snap["tdtpu_tokens_generated_total"]["value"] == 6
+    assert snap["tdtpu_prefill_tokens_total"]["value"] == 16
+    assert snap["tdtpu_decode_step_latency_ms"]["count"] == 1
+    assert snap["tdtpu_jit_compile_ms"]["count"] == 2
+    assert snap["tdtpu_serve_tokens_per_s"]["value"] > 0
+    with open(tmp_path / "run" / "host.spans.json") as f:
+        names = {e.get("name") for e in json.load(f)["traceEvents"]}
+    assert {"engine.serve", "engine.prefill", "engine.decode_step",
+            "jit_compile"} <= names
+
+
+def test_report_merges_run_dir(tmp_path):
+    """report.main on a run dir containing all three obs tiers exits 0
+    with --check and writes a Perfetto-valid merged trace."""
+    from triton_distributed_tpu.analysis.registry import build_registry
+    from triton_distributed_tpu.analysis.tracer import trace_op
+    from triton_distributed_tpu.obs.kernel_profile import KernelProfile
+    from triton_distributed_tpu.obs.report import main as report_main
+    from triton_distributed_tpu.obs.report import validate_chrome
+
+    run_dir = str(tmp_path / "run")
+    obs.start_run(run_dir)
+    with obs_trace.span("unit_span"):
+        pass
+    obs_metrics.registry().counter("tdtpu_tokens_generated_total").inc(3)
+    obs_metrics.registry().histogram(
+        "tdtpu_decode_step_latency_ms").observe(1.5)
+    obs.finish_run()
+
+    drv = build_registry((2,))["allreduce"]
+    axes, dims = drv.meshes[0]
+    trace_op(drv.run, axes=axes, dims=dims, name="allreduce@2").to_jsonl(
+        f"{run_dir}/allreduce.events.jsonl")
+
+    KernelProfile.from_dump(_synthetic_prof(), itemsize=4).save(run_dir)
+
+    rc = report_main([run_dir, "--check",
+                      "--require-lanes", "host,commlint,kernel"])
+    assert rc == 0
+    with open(f"{run_dir}/merged.trace.json") as f:
+        merged = json.load(f)
+    assert validate_chrome(merged) == []
+    names = {e.get("name") for e in merged["traceEvents"]}
+    assert "unit_span" in names
+    # Missing-series check fails loudly.
+    rc = report_main([run_dir, "--check",
+                      "--require-series", "definitely_not_a_series"])
+    assert rc == 1

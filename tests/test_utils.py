@@ -1,0 +1,158 @@
+"""runtime/utils.py coverage: perf_func stats, group_profile,
+merge_profiles (pid-offset disambiguation, .json.gz handling, empty-dir
+behavior, host-span source kind) — ISSUE 3 satellite (none of this was
+tested before)."""
+
+import gzip
+import json
+import os
+
+import jax.numpy as jnp
+import pytest
+
+from triton_distributed_tpu.runtime.utils import (
+    PerfStats, group_profile, merge_profiles, perf_func,
+)
+
+
+# ---------------------------------------------------------------------------
+# perf_func
+# ---------------------------------------------------------------------------
+
+def test_perf_func_returns_stats_and_mean_float():
+    out, stats = perf_func(lambda: jnp.arange(8) * 2, iters=5,
+                           warmup_iters=1)
+    assert jnp.array_equal(out, jnp.arange(8) * 2)
+    # Backward compatible: the stats object IS the mean in ms.
+    assert isinstance(stats, float)
+    assert isinstance(stats, PerfStats)
+    assert len(stats.samples) == 5
+    assert stats.mean == pytest.approx(sum(stats.samples) / 5)
+    assert float(stats) == stats.mean
+    # Percentile/extreme consistency.
+    assert stats.min <= stats.p50 <= stats.p95 <= stats.max
+    assert stats.min == min(stats.samples)
+    assert 2 * stats > 0  # arithmetic like any float
+
+
+def test_perf_stats_percentiles_exact():
+    st = PerfStats([1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0, 8.0, 9.0, 10.0])
+    assert st.p50 == 5.0     # nearest-rank: ceil(0.5*10) = 5th value
+    assert st.p95 == 10.0
+    assert st.min == 1.0
+    assert float(st) == pytest.approx(5.5)
+    with pytest.raises(ValueError):
+        PerfStats([])
+
+
+def test_perf_stats_pickle_and_deepcopy():
+    """float subclass round-trips: the default float reduce would rebuild
+    via cls(mean) and crash __new__."""
+    import copy
+    import pickle
+
+    st = PerfStats([1.0, 3.0])
+    for st2 in (pickle.loads(pickle.dumps(st)), copy.deepcopy(st)):
+        assert float(st2) == 2.0
+        assert st2.samples == (1.0, 3.0)
+        assert st2.p95 == 3.0
+
+
+# ---------------------------------------------------------------------------
+# group_profile
+# ---------------------------------------------------------------------------
+
+def test_group_profile_disabled_is_noop(tmp_path):
+    with group_profile("x", do_prof=False, log_dir=str(tmp_path)):
+        pass
+    assert list(tmp_path.iterdir()) == []
+    with group_profile(None, do_prof=True, log_dir=str(tmp_path)):
+        pass
+    assert list(tmp_path.iterdir()) == []
+
+
+# ---------------------------------------------------------------------------
+# merge_profiles
+# ---------------------------------------------------------------------------
+
+def _fake_trace(path, pid=7, name="proc", gz=False):
+    data = {"traceEvents": [
+        {"name": "process_name", "ph": "M", "pid": pid,
+         "args": {"name": name}},
+        {"name": "work", "ph": "X", "pid": pid, "tid": 1, "ts": 1.0,
+         "dur": 2.0},
+    ]}
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    if gz:
+        with gzip.open(path, "wt") as f:
+            json.dump(data, f)
+    else:
+        with open(path, "w") as f:
+            json.dump(data, f)
+
+
+def test_merge_profiles_empty_dir_skips_writing(tmp_path):
+    d = tmp_path / "empty"
+    d.mkdir()
+    out = tmp_path / "merged.json"
+    with pytest.warns(RuntimeWarning, match="no trace sources"):
+        n = merge_profiles([str(d)], str(out))
+    assert n == 0
+    assert not out.exists()   # no empty merge shipped
+
+
+def test_merge_profiles_missing_dir_warns(tmp_path):
+    out = tmp_path / "merged.json"
+    with pytest.warns(RuntimeWarning):
+        n = merge_profiles([str(tmp_path / "nope")], str(out))
+    assert n == 0
+    assert not out.exists()
+
+
+def test_merge_profiles_pid_offsets_and_gz(tmp_path):
+    # Two source dirs, one .json + one .json.gz, identical pids: the merge
+    # must disambiguate pids per source and tag the process names.
+    _fake_trace(str(tmp_path / "h0" / "a.trace.json"), pid=7, name="host0")
+    _fake_trace(str(tmp_path / "h1" / "b.trace.json.gz"), pid=7,
+                name="host1", gz=True)
+    out = tmp_path / "merged.json"
+    n = merge_profiles([str(tmp_path / "h0"), str(tmp_path / "h1")],
+                       str(out))
+    assert n == 2
+    with open(out) as f:
+        merged = json.load(f)["traceEvents"]
+    pids = sorted({e["pid"] for e in merged})
+    assert pids == [100_007, 200_007]   # (d_i + 1) * 100_000 offsets
+    names = {e["args"]["name"] for e in merged
+             if e.get("name") == "process_name"}
+    assert names == {"[a] host0", "[b] host1"}
+
+
+def test_merge_profiles_gz_output(tmp_path):
+    _fake_trace(str(tmp_path / "h0" / "a.trace.json"))
+    out = tmp_path / "merged.json.gz"
+    assert merge_profiles([str(tmp_path / "h0")], str(out)) == 1
+    with gzip.open(out, "rt") as f:
+        assert len(json.load(f)["traceEvents"]) == 2
+
+
+def test_merge_profiles_accepts_host_span_files(tmp_path):
+    """The obs tracer's *.spans.json is a first-class source kind: host
+    and device lanes merge into one Perfetto view."""
+    from triton_distributed_tpu.obs.trace import Tracer
+
+    import time as _time
+
+    t = Tracer(run_dir=str(tmp_path / "run"), name="host")
+    t0 = _time.perf_counter_ns()
+    t._emit_complete("engine.prefill", t0, t0 + 5000, {"batch": 1})
+    span_path = t.save()
+    assert span_path.endswith("host.spans.json")
+    _fake_trace(str(tmp_path / "run" / "dev.trace.json"), pid=3,
+                name="device")
+    out = tmp_path / "merged.json"
+    n = merge_profiles([str(tmp_path / "run")], str(out))
+    assert n == 2
+    with open(out) as f:
+        names = {e.get("name") for e in json.load(f)["traceEvents"]}
+    assert "engine.prefill" in names and "work" in names

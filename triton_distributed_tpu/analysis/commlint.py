@@ -46,6 +46,10 @@ def main(argv: list[str] | None = None) -> int:
                         help="comma-separated 1-D mesh sizes (default 2,4,8)")
     parser.add_argument("--json", dest="json_path", default=None,
                         help="write the machine-readable report here")
+    parser.add_argument("--events-dir", dest="events_dir", default=None,
+                        help="dump each (op, mesh) replay log as "
+                             "*.events.jsonl here — obs.report renders "
+                             "them as Perfetto protocol lanes")
     parser.add_argument("--list", action="store_true",
                         help="list registered ops and exit")
     parser.add_argument("-v", "--verbose", action="store_true",
@@ -69,12 +73,17 @@ def main(argv: list[str] | None = None) -> int:
     if unknown:
         parser.error(f"unknown ops: {unknown}; --list shows the registry")
 
+    if args.events_dir:
+        import os
+
+        os.makedirs(args.events_dir, exist_ok=True)
+
     reports = []
     failed = 0
     for name in names:
         t0 = time.time()
         try:
-            reps = analyze_op(name, ranks)
+            reps = analyze_op(name, ranks, events_dir=args.events_dir)
         except Exception as exc:  # a driver crash is a finding, not a pass
             failed += 1
             print(f"ERROR {name}: replay failed: {type(exc).__name__}: {exc}")

@@ -103,3 +103,30 @@ class TraceSet:
             "events": [[e.to_json() for e in rank] for rank in self.events],
             "lints": [dataclasses.asdict(lint) for lint in self.lints],
         }
+
+    def to_jsonl(self, path: str) -> int:
+        """Write the replay log in the STABLE JSONL form obs.report
+        consumes (``*.events.jsonl``): line 1 is a ``trace_header`` object
+        (op/axes/dims), then one event object per line in (rank, seq)
+        order — each with its ``rank`` inlined so a line is
+        self-describing. Returns the number of event lines written.
+
+        This is the contract that renders commlint protocol timelines as
+        Perfetto lanes (per-rank pid, semaphore label as track); extend it
+        additively — report tooling keys on field names, not positions.
+        """
+        import json
+        import os
+
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        n = 0
+        with open(path, "w") as f:
+            f.write(json.dumps({
+                "kind": "trace_header", "op": self.op,
+                "axes": list(self.axes), "dims": list(self.dims),
+                "nranks": self.nranks, "version": 1}) + "\n")
+            for rank_events in self.events:
+                for e in rank_events:
+                    f.write(json.dumps(e.to_json()) + "\n")
+                    n += 1
+        return n

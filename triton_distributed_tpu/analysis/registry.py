@@ -290,12 +290,22 @@ def build_registry(ranks: Sequence[int] = (2, 4, 8)) -> dict[str, OpDriver]:
     }
 
 
-def analyze_op(name: str, ranks: Sequence[int] = (2, 4, 8)) -> list[Report]:
-    """Trace + check one registered op across its meshes."""
+def analyze_op(name: str, ranks: Sequence[int] = (2, 4, 8),
+               events_dir: str | None = None) -> list[Report]:
+    """Trace + check one registered op across its meshes.
+
+    ``events_dir``: also dump each mesh's replay log as
+    ``<op>@<mesh>.events.jsonl`` (events.TraceSet.to_jsonl — the stable
+    form obs.report renders as Perfetto protocol lanes)."""
+    import os
+
     driver = build_registry(ranks)[name]
     reports = []
     for axes, dims in driver.meshes:
-        ts = trace_op(driver.run, axes=axes, dims=dims,
-                      name=f"{name}@{'x'.join(map(str, dims))}")
+        label = f"{name}@{'x'.join(map(str, dims))}"
+        ts = trace_op(driver.run, axes=axes, dims=dims, name=label)
+        if events_dir is not None:
+            ts.to_jsonl(os.path.join(events_dir,
+                                     f"{label}.events.jsonl"))
         reports.append(check(ts))
     return reports

@@ -772,12 +772,17 @@ class CompiledMegaKernel:
 
     def step(self, ws: jax.Array, queue: jax.Array | None = None,
              ws8: jax.Array | None = None,
-             wsm: jax.Array | None = None) -> jax.Array:
+             wsm: jax.Array | None = None,
+             profile: bool = False) -> jax.Array:
         """One queue execution over a prebuilt workspace (jittable; pass an
         advance_queue_pos-updated ``queue`` to retarget without recompile).
         Device-local: wrap in shard_map when num_ranks > 1. ``ws8``: the
         fp8 weight workspace when the program uses one; ``wsm``: the 2D
-        matrix weight workspace when the program has GEMM_MAT tasks."""
+        matrix weight workspace when the program has GEMM_MAT tasks.
+        ``profile=True``: the observability mode (ISSUE 3) — the kernel
+        additionally stamps each task's execution record into an int32
+        (num_exec, 128) dump and the return becomes ``(ws, prof)``;
+        decode it with ``obs.kernel_profile.KernelProfile.from_dump``."""
         if self.num_tiles8 and ws8 is None:
             # The placeholder run_queue substitutes is ONE tile — a W8
             # program would DMA weight tiles from out-of-bounds indices
@@ -811,7 +816,8 @@ class CompiledMegaKernel:
                          workspace8=ws8, max_moe_h=self.max_moe_h,
                          max_moe_f=self.max_moe_f, max_row=self.max_row,
                          max_strip=self.max_strip,
-                         workspace_m=wsm, mat_specs=self.mat_specs)
+                         workspace_m=wsm, mat_specs=self.mat_specs,
+                         profile=profile)
 
     def run(self, inputs: dict, outputs: list[TensorHandle],
             _device_local: bool = True):

@@ -930,12 +930,31 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
                           t_moe_topk, t_moe_ffn, t_gemm_mat])
 
 
+def _stamp_profile(queue_ref, prof_ref):
+    """profile=True: stamp this grid step's execution record — the step
+    index plus the task's full queue row (SMEM scalars) — into the step's
+    (1, 128) profile-output block. Grid steps run sequentially on the
+    core, so the dump is the core's actual in-order dispatch record
+    (obs/kernel_profile.py decodes it into per-task timeline lanes).
+    Scalar values land in lanes 0..WORDS via lane-masked selects (a plain
+    scalar store into a VMEM row is not portably supported); unused lanes
+    hold -1."""
+    step = pl.program_id(0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
+    row = jnp.full((1, 128), -1, jnp.int32)
+    vals = [step] + [queue_ref[step, j] for j in range(WORDS)]
+    for i, v in enumerate(vals):
+        row = jnp.where(lane == i, v, row)
+    prof_ref[...] = row
+
+
 def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
               num_tasks: int | None = None, max_gqa: int = 1,
               max_gemm_width: int = 1, workspace8=None,
               max_moe_h: int = 0, max_moe_f: int = 0,
               max_row: int = 1, max_strip: int = 0,
-              workspace_m=None, mat_specs: tuple = ()):
+              workspace_m=None, mat_specs: tuple = (),
+              profile: bool = False):
     """Execute the packed task queue over the workspace in ONE pallas_call.
 
     queue: (n_rows, WORDS) int32; workspace: (T, TILE, TILE) fp32 or bf16
@@ -954,6 +973,10 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
     ``workspace8``: optional (T8, TILE, TILE) float8_e4m3fn READ-ONLY
     weight workspace (GEMM_WIDE_W8 / PREFETCH_W8 B-tile source — half the
     weight-streaming bytes of bf16).
+    ``profile``: add an int32 (n_tasks, 128) profile OUTPUT — each grid
+    step stamps [exec_index, *queue_row] into its row (the observability
+    per-task dispatch record, obs/kernel_profile.py); the return becomes
+    ``(workspace, profile_dump)``.
     Returns the post-execution workspace.
     """
     n_tasks = num_tasks if num_tasks is not None else queue.shape[0]
@@ -1003,11 +1026,16 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
 
     # AR slots ride as a second output: Mosaic has no HBM scratch (see
     # language/core.py kernel_call ``workspaces``).
+    # profile adds a third: the (n_tasks, 128) int32 stamp buffer, blocked
+    # one row per grid step so each task writes only its own record.
+    out_specs = [any_spec(), any_spec()]
+    if profile:
+        out_specs.append(pl.BlockSpec((1, 128), lambda t, *_pf: (t, 0)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_tasks,),
         in_specs=[any_spec(), any_spec(), any_spec()],
-        out_specs=(any_spec(), any_spec()),
+        out_specs=tuple(out_specs),
         scratch_shapes=[
             pltpu.VMEM((PIPE_DEPTH, TILE, TILE), wdt),      # va2
             pltpu.VMEM((PIPE_DEPTH + 1, TILE, TILE), wdt),  # vb2 (+pf slot)
@@ -1044,6 +1072,14 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
     )
     kernel = functools.partial(_mega_kernel, n, axis, n_tasks, G, W,
                                tuple(mat_specs), kch_max)
+    if profile:
+        base_kernel = kernel
+
+        def kernel(queue_ref, ws_in, ws8_ref, wm_ref, ws_o, slots_o,
+                   prof_ref, *scratch):
+            _stamp_profile(queue_ref, prof_ref)
+            base_kernel(queue_ref, ws_in, ws8_ref, wm_ref, ws_o, slots_o,
+                        *scratch)
     interpret = use_interpret()
     if interpret:
         from triton_distributed_tpu.runtime.interpret_workarounds import (
@@ -1061,13 +1097,16 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
         from triton_distributed_tpu.language.core import next_collective_id
 
         params["collective_id"] = next_collective_id(key=_mega_kernel)
-    ws_out, _slots = pl.pallas_call(
+    out_shape = [
+        jax.ShapeDtypeStruct((T, TILE, TILE), wdt),
+        jax.ShapeDtypeStruct((max(n, 1), TILE, TILE), wdt),
+    ]
+    if profile:
+        out_shape.append(jax.ShapeDtypeStruct((n_tasks, 128), jnp.int32))
+    outs = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=(
-            jax.ShapeDtypeStruct((T, TILE, TILE), wdt),
-            jax.ShapeDtypeStruct((max(n, 1), TILE, TILE), wdt),
-        ),
+        out_shape=tuple(out_shape),
         compiler_params=pltpu.CompilerParams(has_side_effects=True, **params),
         interpret=interpret_arg,
         # The workspace input IS the output buffer: without the alias the
@@ -1079,4 +1118,6 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
         # XLA-level defensive copy instead of an in-kernel one.
         input_output_aliases={1: 0},
     )(queue, workspace, workspace8, workspace_m)
-    return ws_out
+    if profile:
+        return outs[0], outs[2]
+    return outs[0]

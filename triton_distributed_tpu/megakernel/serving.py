@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from triton_distributed_tpu.layers.common import rms_norm
+from triton_distributed_tpu.obs import trace as obs_trace
 from triton_distributed_tpu.megakernel.models import (
     DecodeStepProgram, advance_queue_pos, broadcast_rows, build_decode_step,
     feed_layer_weights, rope_tables,
@@ -124,8 +125,14 @@ class MegakernelDecoder:
 
     def __init__(self, cfg: ModelConfig, params: dict, *, max_seq: int,
                  dtype=jnp.float32, ctx=None, axis: str = "tp",
-                 num_ranks: int = 1, fp8_weights: bool = False):
+                 num_ranks: int = 1, fp8_weights: bool = False,
+                 profile: bool = False):
         validate_megakernel_cfg(cfg, max_seq)
+        if profile and num_ranks > 1:
+            raise ValueError(
+                "profile=True is single-rank for now — the per-task dump "
+                "is a per-core record and the TP shard_map step does not "
+                "yet carry a sharded profile output")
         n = num_ranks
         if cfg.num_heads % n or cfg.num_kv_heads % n or \
                 cfg.intermediate_size % n:
@@ -152,6 +159,16 @@ class MegakernelDecoder:
         # weight bytes; outputs carry the e4m3 quantization — opt-in,
         # token-identity with the bf16 ar path is NOT expected).
         self.fp8_weights = fp8_weights
+        # profile: every step also returns the kernel's per-task dispatch
+        # dump (obs/kernel_profile.py); the newest dump is kept on
+        # ``last_profile`` so serving loops stay (ws, tok)-shaped.
+        self.profile = profile
+        self.last_profile = None
+        # Observability: the first step() of a fresh decoder pays the jit
+        # compile; ``last_step_cold`` lets metric recorders keep that
+        # sample out of the step-latency percentiles.
+        self.warm = False
+        self.last_step_cold = True
         self.prog = build_decode_step(
             hidden=cfg.hidden_size, hq_local=cfg.num_heads // n,
             hkv_local=cfg.num_kv_heads // n,
@@ -228,6 +245,10 @@ class MegakernelDecoder:
         """Workspace(s) with weights + the prefilled KV cache loaded:
         (T, TILE, TILE) at TP=1, (n, T, TILE, TILE) sharded over the axis
         otherwise."""
+        with obs_trace.span("mk_start", num_ranks=self.n):
+            return self._start(cache)
+
+    def _start(self, cache) -> jax.Array:
         if cache.k.shape[1] != 1:
             raise ValueError("megakernel decode is batch-1 "
                              f"(cache batch {cache.k.shape[1]})")
@@ -293,14 +314,22 @@ class MegakernelDecoder:
         ws = self.comp.scatter_input(ws, self.prog.x, x)
         ws = self.comp.scatter_input(ws, self.prog.cos, cos)
         ws = self.comp.scatter_input(ws, self.prog.sin, sin)
-        ws = self.comp.step(ws, queue, ws8=ws8, wsm=wsm)
+        prof = None
+        if self.profile:
+            ws, prof = self.comp.step(ws, queue, ws8=ws8, wsm=wsm,
+                                      profile=True)
+        else:
+            ws = self.comp.step(ws, queue, ws8=ws8, wsm=wsm)
         x_out = self.comp.gather_output(ws, self.prog.x_out)[0:1]
         xn = rms_norm(x_out.astype(jnp.float32),
                       final_norm.astype(jnp.float32),
                       self.cfg.rms_norm_eps)
         head = lm_head if lm_head is not None else embed.T
         logits = xn @ head.astype(jnp.float32)
-        return ws, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if self.profile:
+            return ws, tok, prof
+        return ws, tok
 
     def step(self, ws: jax.Array, token: jax.Array, pos: int):
         """token: (1,) int32; pos: host int (current cache length). Returns
@@ -315,6 +344,15 @@ class MegakernelDecoder:
         cos, sin = rope_tables(pos, TILE, self.cfg.rope_theta)
         ws8 = getattr(self, "_ws8", None)
         wsm = getattr(self, "_wsm", None)
-        return self._step_jit(ws, self.embed, self.final_norm, self.lm_head,
-                              queue, jnp.asarray(cos), jnp.asarray(sin),
-                              token, ws8, wsm)
+        self.last_step_cold = not self.warm
+        with obs_trace.span("mk_step", pos=pos):
+            out = self._step_jit(ws, self.embed, self.final_norm,
+                                 self.lm_head, queue, jnp.asarray(cos),
+                                 jnp.asarray(sin), token, ws8, wsm)
+        # Warm only after a SUCCESSFUL step: if the compiling first call
+        # raises, the retry still classifies (and routes) as cold.
+        self.warm = True
+        if self.profile:
+            ws, tok, self.last_profile = out
+            return ws, tok
+        return out

@@ -10,6 +10,7 @@ cache donated so updates happen in place.
 
 from __future__ import annotations
 
+import time
 from typing import Callable
 
 import jax
@@ -18,6 +19,8 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from triton_distributed_tpu.layers.tp_mlp import pick_mode
+from triton_distributed_tpu.obs import metrics as obs_metrics
+from triton_distributed_tpu.obs import trace as obs_trace
 from triton_distributed_tpu.models.config import ModelConfig
 from triton_distributed_tpu.models.dense import (
     dense_llm_specs, dense_prefill, dense_decode_step,
@@ -145,6 +148,39 @@ class Engine:
         return jax.shard_map(f, mesh=self.ctx.mesh, in_specs=in_specs,
                              out_specs=out_specs, check_vma=False)
 
+    def _first_call_span(self, cache_key, fn, what: str):
+        """jax.jit compiles lazily at the first CALL, so the compile event
+        is observable only there: the first invocation runs under a
+        ``jit_compile`` span, then the raw executable is swapped back into
+        the jit cache — steady-state calls pay nothing."""
+
+        def first(*args):
+            # Flag the enclosing prefill/decode wrapper: this call's wall
+            # time is compile-dominated and must not land in the serving
+            # latency histograms (a 40-step run would otherwise report a
+            # p95 that is really XLA compile time).
+            self._jit_compiled_last_call = True
+            with obs_trace.span("jit_compile", what=what,
+                                key=str(cache_key)):
+                out = fn(*args)
+            self._jit_cache[cache_key] = fn
+            return out
+
+        return first
+
+    @staticmethod
+    def _observe_step(reg, dt_ms: float, cold: bool, series: str,
+                      help_: str) -> None:
+        """The single compile-vs-serving routing switch every
+        instrumented loop (prefill, decode, megakernel step) shares:
+        compile-dominated samples (``cold``) land in the jit-compile
+        series, warm ones in the named latency histogram."""
+        if cold:
+            reg.histogram("tdtpu_jit_compile_ms",
+                          "first-call compile+run wall time").observe(dt_ms)
+        else:
+            reg.histogram(series, help_).observe(dt_ms)
+
     def _flash_tiles(self, sq: int, sk: int) -> tuple[int, int]:
         """Host-level flash tile resolution for the prefill paths — the
         autotuner measures HERE (make() time, before the jit call traces),
@@ -185,7 +221,8 @@ class Engine:
                 step,
                 in_specs=(self.param_specs, P(), cspecs),
                 out_specs=(P(), cspecs))
-            self._jit_cache[key] = jax.jit(fn, donate_argnums=(2,))
+            self._jit_cache[key] = self._first_call_span(
+                key, jax.jit(fn, donate_argnums=(2,)), "prefill")
         return self._jit_cache[key]
 
     def _use_ar_stream(self) -> bool:
@@ -274,8 +311,13 @@ class Engine:
             self._jit_cache[key] = (ws, idx)
         return self._jit_cache[key]
 
-    def _decode_jit(self, ar_stream: bool):
-        key = ("decode", ar_stream, self._use_fused_gemm_ar())
+    def _decode_jit(self, ar_stream: bool, batch: int):
+        # batch is in the key for OBSERVABILITY, not correctness: one
+        # shared jax.jit would silently retrace at a new batch size and
+        # that compile would be misclassified as a warm decode step
+        # (first-call routing lives in the _first_call_span wrapper,
+        # which only fires once per cache key).
+        key = ("decode", ar_stream, self._use_fused_gemm_ar(), batch)
         if key not in self._jit_cache:
             mode = self._decode_mode()
             cspecs = (paged_cache_specs(self.shard_axes) if self.page_size
@@ -297,7 +339,8 @@ class Engine:
                     in_specs=(self.param_specs, P(), cspecs,
                               P(self.axis), P()),
                     out_specs=(P(), cspecs, P(self.axis), P()))
-                self._jit_cache[key] = jax.jit(fn, donate_argnums=(2, 3))
+                self._jit_cache[key] = self._first_call_span(
+                    key, jax.jit(fn, donate_argnums=(2, 3)), "decode")
             else:
                 extra = ({"inter_axis": self.inter_axis,
                           "n_inter": self.n_inter}
@@ -314,7 +357,8 @@ class Engine:
                     step,
                     in_specs=(self.param_specs, P(), cspecs),
                     out_specs=(P(), cspecs))
-                self._jit_cache[key] = jax.jit(fn, donate_argnums=(2,))
+                self._jit_cache[key] = self._first_call_span(
+                    key, jax.jit(fn, donate_argnums=(2,)), "decode")
         return self._jit_cache[key]
 
     # -- public API ---------------------------------------------------------
@@ -354,8 +398,9 @@ class Engine:
                                           ).reshape(batch, mp),
                     kv_lens=jnp.full((batch,), c.offset, jnp.int32))
 
-            self._jit_cache[key] = jax.jit(
-                convert, donate_argnums=0, out_shardings=shardings)
+            self._jit_cache[key] = self._first_call_span(
+                key, jax.jit(convert, donate_argnums=0,
+                             out_shardings=shardings), "to_paged")
         return self._jit_cache[key](cache)
 
     def prefill(self, input_ids: jax.Array, cache: KVCache | None = None,
@@ -366,6 +411,31 @@ class Engine:
         ``chunk`` tokens at a time with each chunk attending the cached
         prefix (flash positional causality); peak activation memory drops
         from O(S) to O(chunk) per layer. Requires S % chunk == 0."""
+        t_obs = obs_trace.get_tracer()
+        if t_obs is None:          # zero-overhead disabled fast path
+            return self._prefill_run(input_ids, cache, chunk)
+        batch, seq = input_ids.shape
+        with obs_trace.span("engine.prefill", batch=int(batch),
+                            seq=int(seq), chunk=chunk or 0,
+                            backend=self.backend):
+            self._jit_compiled_last_call = False
+            t0 = time.perf_counter()
+            out = self._prefill_run(input_ids, cache, chunk)
+            if t_obs.sync:
+                jax.block_until_ready(out[0])
+            dt_ms = (time.perf_counter() - t0) * 1e3
+        reg = obs_metrics.registry()
+        reg.counter("tdtpu_prefill_tokens_total",
+                    "prompt tokens prefilled").inc(batch * seq)
+        self._observe_step(
+            reg, dt_ms, self._jit_compiled_last_call,
+            "tdtpu_prefill_latency_ms",
+            "prefill wall latency (device-synced only in sync runs)")
+        return out
+
+    def _prefill_run(self, input_ids: jax.Array,
+                     cache: KVCache | None = None,
+                     chunk: int | None = None):
         batch, seq = input_ids.shape
         if seq > self.max_seq:
             raise ValueError(f"prompt {seq} exceeds max_seq {self.max_seq}")
@@ -403,7 +473,8 @@ class Engine:
                 step,
                 in_specs=(self.param_specs, P(), cspecs),
                 out_specs=(P(), cspecs))
-            self._jit_cache[key] = jax.jit(fn, donate_argnums=(2,))
+            self._jit_cache[key] = self._first_call_span(
+                key, jax.jit(fn, donate_argnums=(2,)), "prefill_chunked")
         return self._jit_cache[key]
 
     def decode(self, tokens: jax.Array, cache):
@@ -414,17 +485,37 @@ class Engine:
         CUDA-graph analog). With TP > 1 on the ar path, every in-step
         AllReduce runs the barrier-free parity-stream kernel over a
         persistent workspace threaded here."""
+        t_obs = obs_trace.get_tracer()
+        if t_obs is None:          # zero-overhead disabled fast path
+            return self._decode_run(tokens, cache)
+        with obs_trace.span("engine.decode_step"):
+            self._jit_compiled_last_call = False
+            t0 = time.perf_counter()
+            out = self._decode_run(tokens, cache)
+            if t_obs.sync:
+                jax.block_until_ready(out[0])
+            dt_ms = (time.perf_counter() - t0) * 1e3
+        reg = obs_metrics.registry()
+        reg.counter("tdtpu_tokens_generated_total",
+                    "decode tokens generated").inc(int(tokens.shape[0]))
+        self._observe_step(
+            reg, dt_ms, self._jit_compiled_last_call,
+            "tdtpu_decode_step_latency_ms",
+            "one decode step, wall (device-synced only in sync runs)")
+        return out
+
+    def _decode_run(self, tokens: jax.Array, cache):
         if self.page_size is not None and isinstance(cache, KVCache):
             cache = self.to_paged(cache)
         batch = int(tokens.shape[0])
         if self._use_ar_stream():
             ws, idx = self._ar_state(batch)
-            tok, cache, ws, idx = self._decode_jit(True)(
+            tok, cache, ws, idx = self._decode_jit(True, batch)(
                 self.params, tokens, cache, ws, idx)
             self._jit_cache[("ar_ws", batch,
                              self._use_fused_gemm_ar())] = (ws, idx)
             return tok, cache
-        return self._decode_jit(False)(self.params, tokens, cache)
+        return self._decode_jit(False, batch)(self.params, tokens, cache)
 
     def serve(self, input_ids: jax.Array, gen_len: int,
               profile_dir: str | None = None) -> jax.Array:
@@ -434,7 +525,45 @@ class Engine:
         reference's optional 64-step profile → trace_static.json,
         engine.py:153-179); merge per-host traces with
         ``runtime.merge_profiles``. Returns (B, gen_len) token ids.
+
+        Under an active obs run (obs.start_run) the whole call is a span,
+        every decode step records into the serving metrics registry, and
+        tokens/s lands as a gauge — docs/observability.md.
         """
+        t_obs = obs_trace.get_tracer()
+        if t_obs is None:          # zero-overhead disabled fast path
+            return self._serve_run(input_ids, gen_len, profile_dir)
+        batch = int(jnp.asarray(input_ids).shape[0])
+        reg = obs_metrics.registry()
+        compile_h = reg.histogram("tdtpu_jit_compile_ms",
+                                  "first-call compile+run wall time")
+        compile_ms0 = compile_h.sum
+        with obs_trace.span("engine.serve", gen_len=int(gen_len),
+                            batch=batch, backend=self.backend):
+            t0 = time.perf_counter()
+            out = self._serve_run(input_ids, gen_len, profile_dir)
+            jax.block_until_ready(out)
+            wall_s = time.perf_counter() - t0
+        # The first token comes from the PREFILL logits — decode() never
+        # sees it, so count it here; the counter then equals the tokens
+        # serve() actually returns (batch * gen_len per call).
+        reg.counter("tdtpu_tokens_generated_total",
+                    "decode tokens generated").inc(batch)
+        # Exclude jit compile time (routed to its own series by the step
+        # wrappers) from the throughput denominator — a first serve would
+        # otherwise report a compile-dominated tokens/s ~100x below the
+        # steady state the gauge is meant to describe.
+        compile_s = (compile_h.sum - compile_ms0) / 1e3
+        serving_s = max(wall_s - compile_s, 1e-9)
+        reg.gauge(
+            "tdtpu_serve_tokens_per_s",
+            "generated tokens/s over the last serve() call, excluding "
+            "first-call jit compilation"
+        ).set(batch * gen_len / serving_s)
+        return out
+
+    def _serve_run(self, input_ids: jax.Array, gen_len: int,
+                   profile_dir: str | None = None) -> jax.Array:
         from triton_distributed_tpu.runtime.utils import group_profile
 
         logits, cache = self.prefill(jnp.asarray(input_ids))
@@ -473,24 +602,91 @@ class Engine:
         if self.page_size is not None:
             raise ValueError("megakernel backend uses its own workspace "
                              "cache, not the paged cache")
-        if getattr(self, "_mk", None) is None:
-            self._mk = MegakernelDecoder(self.cfg, self.params,
-                                         max_seq=self.max_seq,
-                                         ctx=self.ctx, axis=self.axis,
-                                         num_ranks=self.n)
+        t_obs = obs_trace.get_tracer()
+        # Under an active obs run on one rank, the decoder runs in profile
+        # mode: every step dumps the kernel's per-task dispatch record and
+        # serve() saves the last one as a timeline (obs/kernel_profile.py).
+        # The cached decoder is REBUILT whenever that state flips — a
+        # profiled decoder left over after finish_run() would keep paying
+        # the per-step stamp + extra output + host transfer with the dumps
+        # silently discarded (and the inverse would never profile). The
+        # rebuild recompiles the step, so it costs one compile per
+        # transition, not per serve.
+        want_profile = t_obs is not None and self.n == 1
+        if (getattr(self, "_mk", None) is None
+                or self._mk.profile != want_profile):
+            self._mk = MegakernelDecoder(
+                self.cfg, self.params, max_seq=self.max_seq,
+                ctx=self.ctx, axis=self.axis, num_ranks=self.n,
+                profile=want_profile)
+            self._mk_serve_count = 0
         pos = int(cache.offset)
         if pos + gen_len - 1 > self.max_seq:
             raise ValueError(
                 f"prompt ({pos}) + gen_len ({gen_len}) exceeds max_seq "
                 f"{self.max_seq} — reject up front rather than dying "
                 "mid-generation")
+        reg = obs_metrics.registry() if t_obs is not None else None
+        cold_start = not self._mk.warm
+        t_start = time.perf_counter() if reg is not None else 0.0
         ws = self._mk.start(cache)
+        if reg is not None and cold_start:
+            # The first start() after a (re)build compiles the workspace
+            # scatter/placement path; record it as compile time so the
+            # serve gauge's denominator exclusion accounts for it.
+            jax.block_until_ready(ws)
+            reg.histogram(
+                "tdtpu_jit_compile_ms",
+                "first-call compile+run wall time"
+            ).observe((time.perf_counter() - t_start) * 1e3)
         outs = [tok]
+        step_s: list[float] = []
         with group_profile("mk_decode", do_prof=profile_dir is not None,
                            log_dir=profile_dir or "."):
             for _ in range(gen_len - 1):
+                t0 = time.perf_counter() if reg is not None else 0.0
                 ws, tok = self._mk.step(ws, tok, pos)
+                if reg is not None:
+                    if t_obs.sync:
+                        jax.block_until_ready(tok)
+                    dt = time.perf_counter() - t0
+                    reg.counter("tdtpu_tokens_generated_total",
+                                "decode tokens generated"
+                                ).inc(int(tok.shape[0]))
+                    if not self._mk.last_step_cold:
+                        step_s.append(dt)
+                    self._observe_step(
+                        reg, dt * 1e3, self._mk.last_step_cold,
+                        "tdtpu_decode_step_latency_ms",
+                        "one decode step, wall (device-synced only in "
+                        "sync runs)")
                 pos += 1
                 outs.append(tok)
             jax.block_until_ready(tok)
+        self._maybe_save_kernel_profile(step_s)
         return jnp.stack(outs, axis=1)
+
+    def _maybe_save_kernel_profile(self, step_s: list[float]) -> None:
+        """Dump the profiled decoder's last per-task record into the
+        active obs run directory — one timeline per serve call, indexed by
+        a per-decoder serve counter so consecutive serves in one run don't
+        overwrite each other's file."""
+        from triton_distributed_tpu import obs
+
+        mk = getattr(self, "_mk", None)
+        run_dir = obs.active_run_dir()
+        if (mk is None or not getattr(mk, "profile", False)
+                or mk.last_profile is None or run_dir is None):
+            return
+        from triton_distributed_tpu.obs.kernel_profile import KernelProfile
+
+        measured = (sorted(step_s)[len(step_s) // 2]
+                    if step_s and obs_trace.get_tracer() is not None
+                    and obs_trace.get_tracer().sync else None)
+        serve_idx = getattr(self, "_mk_serve_count", 0)
+        self._mk_serve_count = serve_idx + 1
+        KernelProfile.from_dump(
+            np.asarray(mk.last_profile),
+            itemsize=jnp.dtype(mk.comp.dtype).itemsize,
+            measured_step_s=measured, step_index=serve_idx,
+            label="serve_megakernel").save(run_dir)

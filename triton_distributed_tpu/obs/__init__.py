@@ -1,0 +1,75 @@
+"""Unified observability layer: span tracer + metrics + kernel timelines.
+
+Three tiers, one Perfetto timeline and one metrics snapshot (ISSUE 3):
+
+* :mod:`~triton_distributed_tpu.obs.trace` — host span tracer (engine
+  steps, jit compiles, autotuner sweeps) with a zero-overhead disabled
+  fast path;
+* :mod:`~triton_distributed_tpu.obs.metrics` — serving metrics registry
+  (tokens/s, step-latency histograms, commlint protocol totals) with
+  Prometheus + JSON export;
+* :mod:`~triton_distributed_tpu.obs.kernel_profile` — per-task megakernel
+  timelines (``CompiledMegaKernel.step(profile=True)``).
+
+``python -m triton_distributed_tpu.obs.report RUN_DIR`` renders a run
+directory into one merged Perfetto view — docs/observability.md.
+
+A *run* couples the three: ``start_run(dir)`` installs a fresh tracer and
+metrics registry; ``finish_run()`` writes ``host.spans.json``,
+``metrics.json`` and ``metrics.prom`` into the directory. Library
+instrumentation is always present but free when no run is active.
+"""
+
+from __future__ import annotations
+
+import os
+
+from triton_distributed_tpu.obs import metrics, trace  # noqa: F401
+from triton_distributed_tpu.obs.metrics import Registry
+from triton_distributed_tpu.obs.trace import Tracer
+
+__all__ = ["trace", "metrics", "start_run", "finish_run", "active_run_dir",
+           "run_from_env"]
+
+_RUN_DIR: str | None = None
+
+
+def start_run(run_dir: str, *, sync: bool = False) -> Tracer:
+    """Enable observability into ``run_dir``: fresh tracer + fresh metrics
+    registry (so the snapshot covers exactly this run). ``sync=True`` asks
+    instrumented loops to block per step for true per-step latencies (an
+    observer effect — see docs/observability.md)."""
+    global _RUN_DIR
+    os.makedirs(run_dir, exist_ok=True)
+    _RUN_DIR = run_dir
+    metrics.set_registry(Registry())
+    return trace.enable(run_dir, sync=sync)
+
+
+def finish_run() -> str | None:
+    """Write the run artifacts (span trace + metrics snapshot) and disable
+    the tracer; returns the run directory (None if no run was active)."""
+    global _RUN_DIR
+    t = trace.disable()
+    run_dir = _RUN_DIR
+    _RUN_DIR = None
+    if t is None or run_dir is None:
+        return None
+    t.save()
+    metrics.registry().save(run_dir)
+    return run_dir
+
+
+def active_run_dir() -> str | None:
+    return _RUN_DIR if trace.is_enabled() else None
+
+
+def run_from_env(var: str = "TDTPU_OBS_DIR") -> bool:
+    """Start a run if the env var names a directory (the bench.py /
+    scripts hook: every bench invocation leaves obs artifacts when the
+    driver exports ``TDTPU_OBS_DIR``). Sync mode via ``TDTPU_OBS_SYNC=1``."""
+    d = os.environ.get(var)
+    if not d:
+        return False
+    start_run(d, sync=os.environ.get("TDTPU_OBS_SYNC", "0") == "1")
+    return True

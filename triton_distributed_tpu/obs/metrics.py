@@ -1,0 +1,273 @@
+"""Serving metrics registry — counters / gauges / histograms.
+
+One process-wide :class:`Registry` holds the serving series (tokens/s,
+step-latency percentiles, prefill vs decode split, DMA bytes and
+semaphore-wait counts replayed by commlint) and exports two ways:
+
+* ``to_prometheus()`` — Prometheus text exposition (0.0.4), scrapeable by
+  any collector or pushable to a gateway;
+* ``snapshot()`` / ``save()`` — a JSON snapshot (``metrics.json`` in the
+  run directory) that ``obs.report`` renders and CI asserts against.
+
+Histograms keep BOTH cumulative bucket counts (the Prometheus contract)
+and a bounded reservoir of raw samples for exact small-N percentiles —
+serving runs observe thousands of step latencies, not millions, so the
+reservoir is simply "the most recent ``max_samples``".
+
+Like the tracer, recording costs nothing when no run is active: callers
+gate on ``obs.trace.is_enabled()`` (one global check) before touching the
+registry.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Iterable
+
+# Default latency buckets (milliseconds): decode steps land ~0.1-100 ms.
+DEFAULT_BUCKETS_MS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+                      100.0, 250.0, 1000.0)
+
+
+def _fmt_labels(labels: dict[str, str] | None) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def percentile(samples: Iterable[float], q: float) -> float | None:
+    """Nearest-rank percentile (q in [0, 100]); None on no samples."""
+    xs = sorted(samples)
+    if not xs:
+        return None
+    if len(xs) == 1:
+        return xs[0]
+    rank = max(1, -(-int(q) * len(xs) // 100))  # ceil(q/100 * n), >= 1
+    rank = min(rank, len(xs))
+    return xs[rank - 1]
+
+
+class Counter:
+    """Monotone cumulative count (``_total`` convention)."""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def to_prometheus(self) -> str:
+        return (f"# HELP {self.name} {self.help}\n"
+                f"# TYPE {self.name} counter\n"
+                f"{self.name} {self._value}\n")
+
+    def snapshot(self) -> dict[str, Any]:
+        return {"type": "counter", "value": self._value, "help": self.help}
+
+
+class Gauge:
+    """A value that goes up and down."""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def to_prometheus(self) -> str:
+        return (f"# HELP {self.name} {self.help}\n"
+                f"# TYPE {self.name} gauge\n"
+                f"{self.name} {self._value}\n")
+
+    def snapshot(self) -> dict[str, Any]:
+        return {"type": "gauge", "value": self._value, "help": self.help}
+
+
+class Histogram:
+    """Cumulative-bucket histogram + recent-sample reservoir.
+
+    ``buckets`` are upper bounds (le); +Inf is implicit. Percentiles come
+    from the reservoir (exact for runs shorter than ``max_samples``),
+    bucket counts feed Prometheus.
+    """
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: tuple[float, ...] = DEFAULT_BUCKETS_MS,
+                 max_samples: int = 65536):
+        self.name = name
+        self.help = help
+        self.buckets = tuple(sorted(buckets))
+        self.max_samples = max_samples
+        self._bucket_counts = [0] * (len(self.buckets) + 1)  # +Inf last
+        self._count = 0
+        self._sum = 0.0
+        self._samples: list[float] = []
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            i = 0
+            for i, ub in enumerate(self.buckets):
+                if v <= ub:
+                    break
+            else:
+                i = len(self.buckets)
+            self._bucket_counts[i] += 1
+            if len(self._samples) >= self.max_samples:
+                # Keep the most recent window: serving dashboards care
+                # about current behavior, not the warmup tail.
+                self._samples = self._samples[self.max_samples // 2:]
+            self._samples.append(v)
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def quantile(self, q: float) -> float | None:
+        with self._lock:
+            return percentile(self._samples, q)
+
+    def to_prometheus(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} histogram"]
+        cum = 0
+        with self._lock:
+            for ub, c in zip(self.buckets, self._bucket_counts):
+                cum += c
+                lines.append(
+                    f'{self.name}_bucket{_fmt_labels({"le": repr(ub)})} {cum}')
+            cum += self._bucket_counts[-1]
+            lines.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
+            lines.append(f"{self.name}_sum {self._sum}")
+            lines.append(f"{self.name}_count {self._count}")
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            mean = self._sum / self._count if self._count else None
+            return {
+                "type": "histogram", "help": self.help,
+                "count": self._count, "sum": self._sum, "mean": mean,
+                "p50": percentile(self._samples, 50),
+                "p95": percentile(self._samples, 95),
+                "p99": percentile(self._samples, 99),
+                "min": min(self._samples) if self._samples else None,
+                "max": max(self._samples) if self._samples else None,
+                # +Inf overflow bucket included: without it the bucket
+                # counts would not sum to ``count`` for observations above
+                # the top bound and JSON consumers would under-plot.
+                "buckets": {**{str(ub): c for ub, c in
+                               zip(self.buckets, self._bucket_counts)},
+                            "+Inf": self._bucket_counts[-1]},
+            }
+
+
+class Registry:
+    """Named metric store; ``counter``/``gauge``/``histogram`` create on
+    first use and return the existing series after (so callers never
+    coordinate registration order)."""
+
+    def __init__(self):
+        self._metrics: dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_make(self, name: str, cls, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, **kw)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, not {cls.__name__}")
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_make(name, Counter, help=help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_make(name, Gauge, help=help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: tuple[float, ...] = DEFAULT_BUCKETS_MS
+                  ) -> Histogram:
+        return self._get_or_make(name, Histogram, help=help, buckets=buckets)
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def to_prometheus(self) -> str:
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+        return "".join(m.to_prometheus() for m in metrics)
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            metrics = dict(sorted(self._metrics.items()))
+        return {name: m.snapshot() for name, m in metrics.items()}
+
+    def save(self, run_dir: str) -> str:
+        """Write ``metrics.json`` + ``metrics.prom`` into ``run_dir``;
+        returns the JSON path (the one CI asserts on)."""
+        os.makedirs(run_dir, exist_ok=True)
+        path = os.path.join(run_dir, "metrics.json")
+        with open(path, "w") as f:
+            json.dump(self.snapshot(), f, indent=2)
+        with open(os.path.join(run_dir, "metrics.prom"), "w") as f:
+            f.write(self.to_prometheus())
+        return path
+
+
+# The process-default registry. obs.start_run() swaps in a fresh one so
+# every run's snapshot starts clean; direct users can also just use this.
+_REGISTRY = Registry()
+
+
+def registry() -> Registry:
+    return _REGISTRY
+
+
+def set_registry(r: Registry) -> Registry:
+    global _REGISTRY
+    _REGISTRY = r
+    return r

@@ -1,0 +1,472 @@
+"""obs.report — render a run directory into one Perfetto timeline + summary.
+
+A *run directory* is what an observed run leaves behind
+(``obs.start_run``/``finish_run``, ``bench.py``, CI smoke):
+
+* ``*.spans.json``           host span traces (obs/trace.py)
+* ``*.events.jsonl``         commlint replay event logs (analysis/events.py
+  ``TraceSet.to_jsonl`` — per-rank protocol timelines, no hardware needed)
+* ``*.kernel_profile.json``  megakernel per-task timelines
+  (obs/kernel_profile.py, from ``profile=True`` step dumps)
+* ``*.trace.json[.gz]``      jax.profiler device traces (group_profile)
+* ``metrics.json`` / ``metrics.prom``  the metrics snapshot (obs/metrics.py)
+
+``python -m triton_distributed_tpu.obs.report RUN_DIR`` merges every lane
+into ``RUN_DIR/merged.trace.json`` (valid chrome-trace JSON — loads in
+Perfetto / ui.perfetto.dev), prints a human summary, and with ``--check``
+exits nonzero when the merge is invalid or required lanes/series are
+missing (the CI smoke contract).
+
+``--dryrun`` first *produces* a run directory on CPU — a tiny Engine
+serve under the tracer, one commlint op replay, and a profiled
+interpret-mode megakernel step — so the whole pipeline is exercisable
+anywhere: ``python -m triton_distributed_tpu.obs.report --dryrun /tmp/r
+--check``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import sys
+from typing import Any
+
+# pid bases per lane family (span files carry their own HOST_PID; the
+# merge keeps every family disjoint per source file).
+COMMLINT_PID_BASE = 95_000
+DEVICE_PID_BASE = 100_000
+
+REQUIRED_SERIES_DEFAULT = (
+    "tdtpu_tokens_generated_total",
+    "tdtpu_decode_step_latency_ms",
+)
+
+
+# ---------------------------------------------------------------------------
+# Lane collectors.
+# ---------------------------------------------------------------------------
+
+# Top-level key stamped into every merge this module writes, so a rerun
+# over the same directory (with any --out name) never re-ingests its own
+# output as a device lane.
+MERGED_MARKER = "tdtpu_obs_report_merge"
+
+
+def _is_own_merge(path: str) -> bool:
+    try:
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rt") as f:
+            head = f.read(4096)
+        return MERGED_MARKER in head
+    except OSError:
+        return False
+
+
+def collect_span_events(run_dir: str) -> list[dict]:
+    from triton_distributed_tpu.runtime.utils import load_chrome_events
+
+    events: list[dict] = []
+    for i, p in enumerate(sorted(glob.glob(
+            os.path.join(run_dir, "**", "*.spans.json"), recursive=True))):
+        for ev in load_chrome_events(p):
+            if isinstance(ev.get("pid"), int):
+                ev = {**ev, "pid": ev["pid"] + i}   # disambiguate sources
+            events.append(ev)
+    return events
+
+
+def collect_device_events(run_dir: str) -> list[dict]:
+    """jax.profiler traces under the run dir (group_profile output)."""
+    from triton_distributed_tpu.runtime.utils import load_chrome_events
+
+    events: list[dict] = []
+    paths = sorted(
+        glob.glob(os.path.join(run_dir, "**", "*.trace.json.gz"),
+                  recursive=True)
+        + glob.glob(os.path.join(run_dir, "**", "*.trace.json"),
+                    recursive=True))
+    for i, p in enumerate(paths):
+        if _is_own_merge(p):
+            continue   # a previous report output (any --out name)
+        for ev in load_chrome_events(p):
+            if isinstance(ev.get("pid"), int):
+                ev = {**ev, "pid": ev["pid"] + DEVICE_PID_BASE
+                      + i * 10_000}
+            events.append(ev)
+    return events
+
+
+def commlint_lanes(path: str, pid_base: int) -> list[dict]:
+    """Render one ``*.events.jsonl`` replay log as Perfetto lanes.
+
+    Per-rank pid; semaphore label = track (tid); the per-rank ``seq``
+    program order is the time axis (1 event = 1 us — replay logs carry
+    causal order, not wall time). ENTER/EXIT become nesting B/E slices on
+    a ``kernel`` track; XLA collectives become instants.
+    """
+    header: dict = {}
+    rows: list[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            obj = json.loads(line)
+            if obj.get("kind") == "trace_header":
+                header = obj
+            else:
+                rows.append(obj)
+    op = header.get("op", os.path.basename(path).split(".")[0])
+    events: list[dict] = []
+    tids: dict[tuple[int, str], int] = {}
+    ranks = sorted({r.get("rank", 0) for r in rows})
+
+    def tid_of(rank: int, track: str) -> int:
+        key = (rank, track)
+        t = tids.get(key)
+        if t is None:
+            t = tids[key] = len([k for k in tids if k[0] == rank]) + 1
+        return t
+
+    for rank in ranks:
+        pid = pid_base + rank
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": f"commlint {op} rank {rank}"}})
+    for r in rows:
+        rank = r.get("rank", 0)
+        pid = pid_base + rank
+        ts = float(r.get("seq", 0))
+        kind = r.get("kind")
+        if kind in ("enter", "exit"):
+            events.append({"name": r.get("note", "kernel"),
+                           "ph": "B" if kind == "enter" else "E",
+                           "pid": pid, "tid": 0, "ts": ts})
+            continue
+        if kind == "xla":
+            events.append({"name": r.get("note", "xla"), "ph": "i",
+                           "s": "t", "pid": pid, "tid": 0, "ts": ts})
+            continue
+        sem = r.get("sem") or r.get("recv_sem") or r.get("send_sem") or "?"
+        label = {"signal": "signal", "wait": "wait",
+                 "dma_start": "dma", "straggle": "straggle"}.get(kind, kind)
+        args = {k: v for k, v in r.items()
+                if k in ("peer", "amount", "site", "send_sem", "recv_sem",
+                         "op")}
+        events.append({"name": f"{label} {sem}", "ph": "X", "pid": pid,
+                       "tid": tid_of(rank, sem), "ts": ts, "dur": 1.0,
+                       "args": args})
+    for (rank, track), tid in tids.items():
+        events.append({"name": "thread_name", "ph": "M",
+                       "pid": pid_base + rank, "tid": tid,
+                       "args": {"name": track}})
+    return events
+
+
+def commlint_metrics(run_dir: str) -> dict[str, float]:
+    """Protocol-level series from replay logs — DMA bytes and semaphore
+    waits with no hardware in the loop (the tentpole's dashboard feed)."""
+    dma_bytes = 0
+    waits = 0
+    signals = 0
+    for path in sorted(glob.glob(os.path.join(run_dir, "**",
+                                              "*.events.jsonl"),
+                                 recursive=True)):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                obj = json.loads(line)
+                k = obj.get("kind")
+                if k == "dma_start":
+                    dma_bytes += int(obj.get("amount", 0))
+                elif k == "wait":
+                    waits += 1
+                elif k == "signal":
+                    signals += 1
+    return {"tdtpu_commlint_dma_bytes_total": float(dma_bytes),
+            "tdtpu_commlint_semaphore_waits_total": float(waits),
+            "tdtpu_commlint_semaphore_signals_total": float(signals)}
+
+
+def kernel_profile_lanes(run_dir: str) -> tuple[list[dict], list[dict]]:
+    """(chrome events, per-file summaries) for every saved task profile."""
+    from triton_distributed_tpu.obs.kernel_profile import load_profile
+
+    events: list[dict] = []
+    summaries: list[dict] = []
+    paths = sorted(glob.glob(os.path.join(run_dir, "**",
+                                          "*.kernel_profile.json"),
+                             recursive=True))
+    for i, p in enumerate(paths):
+        prof = load_profile(p)
+        events += prof.to_chrome_events(
+            pid=92_000 + 100 * i + prof.rank)
+        summaries.append({"file": os.path.basename(p),
+                          **prof.summary()})
+    return events, summaries
+
+
+# ---------------------------------------------------------------------------
+# Merge + validate + summarize.
+# ---------------------------------------------------------------------------
+
+def merge_run(run_dir: str) -> tuple[dict, dict]:
+    """Merge every lane; returns (chrome trace dict, lane presence map)."""
+    span_ev = collect_span_events(run_dir)
+    dev_ev = collect_device_events(run_dir)
+    cl_ev: list[dict] = []
+    for i, p in enumerate(sorted(glob.glob(
+            os.path.join(run_dir, "**", "*.events.jsonl"), recursive=True))):
+        cl_ev += commlint_lanes(p, COMMLINT_PID_BASE + i * 100)
+    kp_ev, kp_summaries = kernel_profile_lanes(run_dir)
+    # MERGED_MARKER first so it lands in the file head (the rerun guard
+    # reads only the first 4 KB).
+    trace = {MERGED_MARKER: 1,
+             "traceEvents": span_ev + cl_ev + kp_ev + dev_ev,
+             "displayTimeUnit": "ms"}
+    lanes = {"host": bool(span_ev), "commlint": bool(cl_ev),
+             "kernel": bool(kp_ev), "device": bool(dev_ev),
+             "kernel_summaries": kp_summaries}
+    return trace, lanes
+
+
+def validate_chrome(trace: dict) -> list[str]:
+    """Structural validation of a chrome-trace object (what Perfetto's
+    importer requires of each event)."""
+    problems = []
+    evs = trace.get("traceEvents")
+    if not isinstance(evs, list):
+        return ["traceEvents is not a list"]
+    for i, ev in enumerate(evs):
+        if not isinstance(ev, dict):
+            problems.append(f"event {i} is not an object")
+            continue
+        ph = ev.get("ph")
+        if not isinstance(ph, str) or not ph:
+            problems.append(f"event {i} missing ph")
+        if ph in ("X", "B", "E", "i", "C") and "ts" not in ev:
+            problems.append(f"event {i} ({ev.get('name')}) missing ts")
+        if ph == "X" and not isinstance(ev.get("dur"), (int, float)):
+            problems.append(f"event {i} ({ev.get('name')}) X without dur")
+        if ph != "M" and not isinstance(ev.get("pid"), int):
+            problems.append(f"event {i} ({ev.get('name')}) missing pid")
+        if len(problems) > 20:
+            problems.append("... (truncated)")
+            break
+    return problems
+
+
+def load_metrics(run_dir: str) -> dict[str, Any] | None:
+    path = os.path.join(run_dir, "metrics.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def summarize(run_dir: str, lanes: dict, metrics: dict | None,
+              cl_metrics: dict[str, float]) -> str:
+    lines = [f"# obs report — {run_dir}", ""]
+    lines.append("lanes: " + ", ".join(
+        f"{k}={'yes' if v else 'no'}" for k, v in lanes.items()
+        if k != "kernel_summaries"))
+    if lanes["kernel_summaries"]:
+        lines.append("")
+        lines.append("megakernel per-task timelines:")
+        for s in lanes["kernel_summaries"]:
+            lines.append(f"  {s['file']}: {s['n_tasks']} tasks, "
+                         f"task-sum {s['task_sum_s'] * 1e3:.3f} ms"
+                         + (f", measured step "
+                            f"{s['measured_step_s'] * 1e3:.3f} ms"
+                            if s.get("measured_step_s") else ""))
+            for cls, d in s["classes"].items():
+                lines.append(f"    {cls:12s} x{d['tasks']:4d}  "
+                             f"{d['seconds'] * 1e6:10.1f} us "
+                             f"({d['duration_kind']})")
+    if cl_metrics and any(cl_metrics.values()):
+        lines.append("")
+        lines.append("commlint protocol totals (replayed, no hardware):")
+        for k, v in cl_metrics.items():
+            lines.append(f"  {k} = {v:g}")
+    if metrics:
+        lines.append("")
+        lines.append("metrics snapshot:")
+        for name, m in metrics.items():
+            if m["type"] == "histogram":
+                p50 = m.get("p50")
+                p95 = m.get("p95")
+                p99 = m.get("p99")
+                fmt = lambda x: f"{x:.3f}" if x is not None else "—"  # noqa: E731
+                lines.append(
+                    f"  {name}: n={m['count']} mean={fmt(m.get('mean'))} "
+                    f"p50={fmt(p50)} p95={fmt(p95)} p99={fmt(p99)}")
+            else:
+                lines.append(f"  {name} = {m['value']:g}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# The CPU dryrun producer.
+# ---------------------------------------------------------------------------
+
+def produce_dryrun(run_dir: str, gen_len: int = 6) -> None:
+    """Create a complete run directory on CPU: tiny Engine serve under the
+    tracer (host spans + serving metrics), one commlint op replay
+    (protocol lanes), one profiled interpret-mode megakernel step
+    (per-task lanes)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from triton_distributed_tpu.runtime.interpret_workarounds import (
+        apply_interpret_workarounds,
+    )
+
+    apply_interpret_workarounds()
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from triton_distributed_tpu import obs
+    from triton_distributed_tpu.models import (
+        Engine, init_dense_llm, tiny_config,
+    )
+    from triton_distributed_tpu.runtime import initialize_distributed
+
+    obs.start_run(run_dir, sync=True)
+
+    # 1) Host spans + serving metrics: tiny Engine on a 1-device mesh.
+    cfg = tiny_config()
+    params = init_dense_llm(jax.random.key(0), cfg)
+    ctx = initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                 devices=jax.devices()[:1])
+    eng = Engine(cfg, params, ctx, backend="xla", max_seq=64)
+    ids = jax.random.randint(jax.random.key(1), (1, 16), 0, cfg.vocab_size)
+    eng.serve(ids, gen_len=gen_len)
+
+    # 2) Commlint protocol lanes: replay one registered op and dump JSONL.
+    from triton_distributed_tpu.analysis.registry import build_registry
+    from triton_distributed_tpu.analysis.tracer import trace_op
+
+    drv = build_registry((2,))["allgather"]
+    axes, dims = drv.meshes[0]
+    ts = trace_op(drv.run, axes=axes, dims=dims, name="allgather@2")
+    ts.to_jsonl(os.path.join(run_dir, "allgather.events.jsonl"))
+
+    # 3) Megakernel per-task lanes: a small profiled interpret-mode step.
+    from triton_distributed_tpu.megakernel import MegaKernelBuilder
+    from triton_distributed_tpu.obs.kernel_profile import KernelProfile
+
+    mb = MegaKernelBuilder()
+    h, f = 256, 384
+    x = mb.tensor(128, h)
+    wg = mb.tensor(h, f)
+    wu = mb.tensor(h, f)
+    gate = mb.tensor(128, f)
+    up = mb.tensor(128, f)
+    act = mb.tensor(128, f)
+    nrm = mb.tensor(128, h)
+    wn = mb.tensor(128, h)
+    mb.rms_norm(nrm, x, wn)
+    mb.gemm(gate, nrm, wg)
+    mb.gemm(up, nrm, wu)
+    mb.silu_mul(act, gate, up)
+    comp = mb.compile()
+    rng = np.random.default_rng(0)
+    feeds = {t: rng.standard_normal((t.rows, t.cols)).astype(np.float32)
+             * 0.1 for t in (x, wg, wu, wn)}
+    ws = comp.make_workspace({k: jnp.asarray(v) for k, v in feeds.items()})
+    with obs.trace.span("megakernel_profiled_step"):
+        _ws, prof = comp.step(ws, profile=True)
+        prof = np.asarray(prof)
+    KernelProfile.from_dump(prof, itemsize=4, label="dryrun").save(run_dir)
+
+    obs.finish_run()
+
+
+# ---------------------------------------------------------------------------
+# CLI.
+# ---------------------------------------------------------------------------
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m triton_distributed_tpu.obs.report",
+        description="Merge an observability run directory into one "
+                    "Perfetto timeline and print a summary "
+                    "(docs/observability.md).")
+    ap.add_argument("run_dir", help="run directory to render")
+    ap.add_argument("--out", default=None,
+                    help="merged trace path (default "
+                         "RUN_DIR/merged.trace.json)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on invalid trace / missing lanes or "
+                         "series")
+    ap.add_argument("--require-lanes", default="",
+                    help="comma list of lanes that must be present "
+                         "(host,commlint,kernel,device)")
+    ap.add_argument("--require-series",
+                    default=",".join(REQUIRED_SERIES_DEFAULT),
+                    help="comma list of metric series --check asserts in "
+                         "metrics.json (empty string to skip)")
+    ap.add_argument("--dryrun", action="store_true",
+                    help="first produce a CPU dryrun into RUN_DIR "
+                         "(tiny traced Engine serve + commlint replay + "
+                         "profiled megakernel step)")
+    args = ap.parse_args(argv)
+
+    if args.dryrun:
+        produce_dryrun(args.run_dir)
+
+    if not os.path.isdir(args.run_dir):
+        print(f"error: run dir {args.run_dir} does not exist",
+              file=sys.stderr)
+        return 2
+
+    trace, lanes = merge_run(args.run_dir)
+    out_path = args.out or os.path.join(args.run_dir, "merged.trace.json")
+    with open(out_path, "w") as f:
+        json.dump(trace, f)
+    # Validate the ROUND-TRIPPED file (what Perfetto will actually load);
+    # validating the in-memory dict too would just duplicate messages.
+    with open(out_path) as f:
+        problems = validate_chrome(json.load(f))
+
+    metrics = load_metrics(args.run_dir)
+    cl_metrics = commlint_metrics(args.run_dir)
+    print(summarize(args.run_dir, lanes, metrics, cl_metrics))
+    print(f"\nmerged trace: {out_path} "
+          f"({len(trace['traceEvents'])} events) — load at "
+          "https://ui.perfetto.dev")
+
+    # Validation problems are warnings when just rendering; they become
+    # failures only under --check (the documented nonzero-exit contract).
+    if not args.check:
+        for p in problems:
+            print(f"warning: invalid chrome trace: {p}", file=sys.stderr)
+        return 0
+
+    failures: list[str] = [f"invalid chrome trace: {p}" for p in problems]
+    for lane in filter(None, args.require_lanes.split(",")):
+        if not lanes.get(lane.strip()):
+            failures.append(f"required lane missing: {lane}")
+    series = [s for s in args.require_series.split(",") if s]
+    if series:
+        if metrics is None:
+            failures.append("metrics.json missing")
+        else:
+            for s in series:
+                if s not in metrics:
+                    failures.append(f"required series missing: {s}")
+    if failures:
+        for msg in failures:
+            print(f"CHECK FAIL: {msg}", file=sys.stderr)
+        return 1
+    print("checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

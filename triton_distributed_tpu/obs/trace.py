@@ -1,0 +1,237 @@
+"""Host span tracer — nestable spans exported as chrome-trace JSON.
+
+Reference analog: the reference leans on ``torch.profiler`` wrapped by
+``group_profile`` (utils.py:505) and merges per-rank chrome traces with
+``ParallelJsonDumper`` (utils.py:400-504). ``jax.profiler`` covers the
+DEVICE side here; this module covers the HOST side — engine steps, jit
+compiles, autotuner sweeps, megakernel launches — as spans that land in
+the same Perfetto view (``runtime.utils.merge_profiles`` accepts the span
+files as a source kind, so device and host lanes merge into one timeline).
+
+Design constraints (ISSUE 3):
+
+* **Zero-overhead disabled fast path.** The tracer is OFF by default.
+  ``span(...)`` with no tracer active is one module-global load, one
+  ``None`` check, and a shared no-op context manager — no allocation, no
+  string formatting, no clock read. Instrumented hot paths (the decode
+  step) must cost nothing when nobody is watching.
+* **Nestable spans.** Spans stack per thread; the exported events are
+  chrome-trace complete events (``ph: "X"``) whose nesting Perfetto
+  reconstructs from timestamps, so no explicit parent ids are needed.
+* **Composable export.** ``save()`` writes ``<name>.spans.json`` — a
+  chrome-trace JSON object — into the run directory; ``obs.report`` and
+  ``merge_profiles`` both consume it.
+
+Usage::
+
+    from triton_distributed_tpu import obs
+
+    obs.start_run("runs/bench0")            # enables tracer + metrics
+    with obs.trace.span("prefill", batch=1, seq=128):
+        ...
+    obs.finish_run()                        # writes trace + metrics files
+
+Library code instruments unconditionally — the disabled path is free::
+
+    with trace.span("decode_step"):         # no-op unless a run is active
+        tok, cache = self.decode(tok, cache)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any
+
+# Host-lane pid for span events. merge_profiles offsets pids per SOURCE
+# (d_i * 100_000), so this only needs to be distinctive within one file
+# and small enough to survive the offset.
+HOST_PID = 90_001
+
+
+class _NullSpan:
+    """Shared no-op context manager: the disabled-tracer fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+# The active tracer. None = disabled (the fast path checks only this).
+_TRACER: "Tracer | None" = None
+
+
+def get_tracer() -> "Tracer | None":
+    return _TRACER
+
+
+def is_enabled() -> bool:
+    return _TRACER is not None
+
+
+def span(name: str, **args: Any):
+    """Context manager timing one nested span (no-op when disabled)."""
+    t = _TRACER
+    if t is None:
+        return _NULL_SPAN
+    return _Span(t, name, args)
+
+
+def instant(name: str, **args: Any) -> None:
+    """A zero-duration marker event (no-op when disabled)."""
+    t = _TRACER
+    if t is None:
+        return
+    t._emit_instant(name, args)
+
+
+def counter(name: str, value: float) -> None:
+    """A chrome-trace counter sample (renders as a value track)."""
+    t = _TRACER
+    if t is None:
+        return
+    t._emit_counter(name, value)
+
+
+def enable(run_dir: str | None = None, *, sync: bool = False) -> "Tracer":
+    """Install a fresh global tracer; returns it. ``sync=True`` asks
+    instrumented loops to block per step so span durations are true
+    device latencies (an observer effect — documented at each site)."""
+    global _TRACER
+    _TRACER = Tracer(run_dir=run_dir, sync=sync)
+    return _TRACER
+
+
+def disable() -> "Tracer | None":
+    """Uninstall the global tracer and return it (events retained so the
+    caller can still ``save()``)."""
+    global _TRACER
+    t = _TRACER
+    _TRACER = None
+    return t
+
+
+class _Span:
+    """One live span: records a complete event ("X") on exit."""
+
+    __slots__ = ("_t", "_name", "_args", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, args: dict):
+        self._t = tracer
+        self._name = name
+        self._args = args
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t1 = time.perf_counter_ns()
+        self._t._emit_complete(self._name, self._t0, t1, self._args,
+                               error=exc_type.__name__ if exc_type else None)
+        return False
+
+
+class Tracer:
+    """Collects chrome-trace events host-side.
+
+    Timestamps are microseconds relative to the tracer epoch
+    (``perf_counter_ns`` at construction), which is what Perfetto expects
+    of ``ts`` fields; one tracer = one consistent clock domain.
+    """
+
+    def __init__(self, run_dir: str | None = None, *, sync: bool = False,
+                 name: str = "host"):
+        self.run_dir = run_dir
+        self.sync = sync
+        self.name = name
+        self._epoch_ns = time.perf_counter_ns()
+        # Wall-clock anchor for the epoch: deltas come from perf_counter
+        # (monotonic, ns precision) but the exported ``ts`` values are
+        # rebased to unix-epoch microseconds, so host lanes share a clock
+        # domain with any device/profiler trace that stamps wall time.
+        # (Traces whose ts is trace-relative won't align with ANY external
+        # base; per-lane inspection still works — docs/observability.md.)
+        self._wall_epoch_us = time.time_ns() / 1e3
+        self._events: list[dict] = []
+        self._lock = threading.Lock()
+        self._tids: dict[int, int] = {}
+
+    # -- internals ----------------------------------------------------------
+    def _ts_us(self, t_ns: int) -> float:
+        return self._wall_epoch_us + (t_ns - self._epoch_ns) / 1e3
+
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        tid = self._tids.get(ident)
+        if tid is None:
+            with self._lock:
+                tid = self._tids.setdefault(ident, len(self._tids) + 1)
+        return tid
+
+    def _emit_complete(self, name: str, t0_ns: int, t1_ns: int,
+                       args: dict, error: str | None = None) -> None:
+        ev = {"name": name, "ph": "X", "pid": HOST_PID, "tid": self._tid(),
+              "ts": self._ts_us(t0_ns),
+              "dur": max((t1_ns - t0_ns) / 1e3, 0.001)}
+        if args or error:
+            a = dict(args)
+            if error:
+                a["error"] = error
+            ev["args"] = a
+        with self._lock:
+            self._events.append(ev)
+
+    def _emit_instant(self, name: str, args: dict) -> None:
+        ev = {"name": name, "ph": "i", "s": "t", "pid": HOST_PID,
+              "tid": self._tid(),
+              "ts": self._ts_us(time.perf_counter_ns())}
+        if args:
+            ev["args"] = dict(args)
+        with self._lock:
+            self._events.append(ev)
+
+    def _emit_counter(self, name: str, value: float) -> None:
+        with self._lock:
+            self._events.append(
+                {"name": name, "ph": "C", "pid": HOST_PID, "tid": 0,
+                 "ts": self._ts_us(time.perf_counter_ns()),
+                 "args": {"value": value}})
+
+    # -- export -------------------------------------------------------------
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def chrome_trace(self) -> dict:
+        """The chrome-trace JSON object (with process/thread metadata)."""
+        meta = [{"name": "process_name", "ph": "M", "pid": HOST_PID,
+                 "args": {"name": f"host spans ({self.name})"}}]
+        with self._lock:
+            for ident, tid in self._tids.items():
+                meta.append({"name": "thread_name", "ph": "M",
+                             "pid": HOST_PID, "tid": tid,
+                             "args": {"name": f"thread-{ident}"}})
+            events = list(self._events)
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+    def save(self, path: str | None = None) -> str:
+        """Write ``<run_dir>/<name>.spans.json`` (or ``path``); returns the
+        path written. The ``.spans.json`` suffix is the contract
+        ``merge_profiles`` and ``obs.report`` glob for."""
+        if path is None:
+            if self.run_dir is None:
+                raise ValueError("no run_dir configured and no path given")
+            path = os.path.join(self.run_dir, f"{self.name}.spans.json")
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        return path

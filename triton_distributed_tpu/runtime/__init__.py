@@ -35,6 +35,7 @@ from triton_distributed_tpu.runtime.perf_model import (  # noqa: F401
 from triton_distributed_tpu.runtime.utils import (  # noqa: F401
     dist_print,
     perf_func,
+    PerfStats,
     assert_allclose,
     cdiv,
     round_up,

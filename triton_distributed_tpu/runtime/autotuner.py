@@ -248,28 +248,35 @@ def contextual_autotune(
     if cache_only:
         return None, None
 
-    if method == "chain":
-        fns: list = []
-        for cfg in candidates:
-            try:
-                fns.append(build(cfg))
-            except Exception as e:
-                if _DEBUG:
-                    print(f"[autotune {name}] {cfg} failed to build: {e}")
-                fns.append(None)
-        # Interleaved rounds: every candidate sees the same chip windows
-        # (sequential timing let clock drift pick the winner — round 4).
-        timings = _measure_chain_interleaved(fns, args, trials=iters)
-    else:
-        timings = []
-        for cfg in candidates:
-            try:
-                t = measure(build(cfg), args, warmup=warmup, iters=iters)
-            except Exception as e:  # config doesn't compile/fit — prune
-                if _DEBUG:
-                    print(f"[autotune {name}] {cfg} failed: {e}")
-                t = None
-            timings.append(t)
+    from triton_distributed_tpu.obs import trace as obs_trace
+
+    with obs_trace.span("autotune_sweep", op=name, key=str(key),
+                        n_candidates=len(candidates), method=method):
+        if method == "chain":
+            fns: list = []
+            for cfg in candidates:
+                try:
+                    fns.append(build(cfg))
+                except Exception as e:
+                    if _DEBUG:
+                        print(f"[autotune {name}] {cfg} failed to build: "
+                              f"{e}")
+                    fns.append(None)
+            # Interleaved rounds: every candidate sees the same chip
+            # windows (sequential timing let clock drift pick the winner —
+            # round 4).
+            timings = _measure_chain_interleaved(fns, args, trials=iters)
+        else:
+            timings = []
+            for cfg in candidates:
+                try:
+                    t = measure(build(cfg), args, warmup=warmup,
+                                iters=iters)
+                except Exception as e:  # config doesn't compile/fit — prune
+                    if _DEBUG:
+                        print(f"[autotune {name}] {cfg} failed: {e}")
+                    t = None
+                timings.append(t)
 
     valid = [(t, i) for i, t in enumerate(timings) if t is not None]
     if not valid:

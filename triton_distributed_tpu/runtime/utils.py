@@ -34,26 +34,77 @@ def dist_print(*args, rank: int | None = None, prefix: bool = True, **kwargs):
     print(tag + " ".join(str(a) for a in args), **kwargs)
 
 
+class PerfStats(float):
+    """Per-iteration timing statistics that still *is* the mean (ms).
+
+    ``perf_func`` historically returned ``(out, mean_ms)``; every caller
+    doing arithmetic on the float keeps working, while new callers read
+    the spread — the dispatch-swing diagnosis bench.py re-implemented
+    ad hoc (min-of-trials windows) is one attribute away.
+    """
+
+    __slots__ = ("samples", "p50", "p95", "min", "max")
+
+    def __new__(cls, samples_ms):
+        # Shared nearest-rank percentile (one implementation repo-wide).
+        from triton_distributed_tpu.obs.metrics import percentile
+
+        samples_ms = [float(s) for s in samples_ms]
+        if not samples_ms:
+            raise ValueError("PerfStats needs at least one sample")
+        mean = sum(samples_ms) / len(samples_ms)
+        self = super().__new__(cls, mean)
+        self.samples = tuple(samples_ms)
+        self.p50 = percentile(samples_ms, 50)
+        self.p95 = percentile(samples_ms, 95)
+        self.min = min(samples_ms)
+        self.max = max(samples_ms)
+        return self
+
+    @property
+    def mean(self) -> float:
+        return float(self)
+
+    def __getnewargs__(self):
+        # float's default reduce would reconstruct via cls(mean_float),
+        # which __new__ rejects — rebuild from the samples instead so
+        # pickling / deepcopy of timing results keeps working.
+        return (list(self.samples),)
+
+    def __repr__(self) -> str:
+        return (f"PerfStats(mean={float(self):.4f} ms, p50={self.p50:.4f}, "
+                f"p95={self.p95:.4f}, min={self.min:.4f}, "
+                f"n={len(self.samples)})")
+
+
 def perf_func(
     fn: Callable[[], Any],
     iters: int = 10,
     warmup_iters: int = 3,
-) -> tuple[Any, float]:
-    """Measure mean wall-clock ms of ``fn`` with warmup (reference utils.py:274).
+) -> tuple[Any, PerfStats]:
+    """Measure wall-clock ms of ``fn`` with warmup (reference utils.py:274).
 
     Blocks on all output arrays each iteration (the jax analog of
-    cuda-event timing around a stream).
+    cuda-event timing around a stream), so every iteration yields an
+    independent sample. NOTE: earlier revisions synced ONCE after the
+    whole loop, letting dispatch pipeline across iterations — per-sample
+    syncing adds a host round-trip per iteration, so numbers from the two
+    protocols are not comparable for very small ops. Returns
+    ``(out, stats)`` where ``stats`` is a :class:`PerfStats` — a float
+    equal to the MEAN ms (the historical return value) carrying
+    ``samples``/``p50``/``p95``/``min``/``max``.
     """
     out = None
     for _ in range(warmup_iters):
         out = fn()
     jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
+    samples = []
+    for _ in range(max(iters, 1)):
+        t0 = time.perf_counter()
         out = fn()
-    jax.block_until_ready(out)
-    dt_ms = (time.perf_counter() - t0) * 1e3 / max(iters, 1)
-    return out, dt_ms
+        jax.block_until_ready(out)
+        samples.append((time.perf_counter() - t0) * 1e3)
+    return out, PerfStats(samples)
 
 
 def assert_allclose(x, y, atol: float = 1e-3, rtol: float = 1e-3, verbose: bool = True):
@@ -89,6 +140,23 @@ def group_profile(name: str | None = None, do_prof: bool = False, log_dir: str =
         yield
 
 
+def load_chrome_events(path: str) -> list:
+    """Parse one chrome-trace file (``.json`` or ``.json.gz``) into its
+    event list, accepting both legal forms: the Object Format (dict with
+    ``traceEvents``) and the bare Array Format some tools emit. The ONE
+    chrome-trace parser in the repo — ``merge_profiles`` and
+    ``obs.report`` both go through it."""
+    import gzip
+    import json as _json
+
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        data = _json.load(f)
+    if isinstance(data, dict):
+        return data.get("traceEvents", [])
+    return data if isinstance(data, list) else []
+
+
 def merge_profiles(log_dirs, out_path: str) -> int:
     """Merge per-host profiler traces into ONE chrome-trace JSON.
 
@@ -99,25 +167,34 @@ def merge_profiles(log_dirs, out_path: str) -> int:
     ``<log_dir>/plugins/profile/<run>/``; this collects every trace under
     each of ``log_dirs``, prefixes pids per source so hosts don't collide,
     and writes a single ``.json`` (or ``.json.gz``) loadable in Perfetto /
-    chrome://tracing. Returns the number of source traces merged.
+    chrome://tracing. Host-span traces (``*.spans.json``, obs/trace.py)
+    are accepted as a source kind, so host and device lanes land in one
+    Perfetto view. Returns the number of source traces merged; with ZERO
+    sources (empty or missing dirs) nothing is written — a warning is
+    issued and 0 returned, instead of silently shipping an empty merge.
     """
     import glob
     import gzip
     import json as _json
+    import warnings
 
     merged: list = []
     n_sources = 0
     for d_i, d in enumerate(log_dirs):
+        if not os.path.isdir(d):
+            warnings.warn(f"merge_profiles: {d!r} is not a directory — "
+                          "skipped", RuntimeWarning, stacklevel=2)
+            continue
         paths = sorted(glob.glob(os.path.join(d, "**", "*.trace.json.gz"),
                                  recursive=True))
         paths += sorted(glob.glob(os.path.join(d, "**", "*.trace.json"),
                                   recursive=True))
+        # Host span traces from the obs tracer ride along as a source
+        # kind: same chrome-trace JSON shape, host-pid lanes.
+        paths += sorted(glob.glob(os.path.join(d, "**", "*.spans.json"),
+                                  recursive=True))
         for p in paths:
-            opener = gzip.open if p.endswith(".gz") else open
-            with opener(p, "rt") as f:
-                data = _json.load(f)
-            events = data.get("traceEvents", data if isinstance(data, list)
-                              else [])
+            events = load_chrome_events(p)
             host = os.path.basename(p).split(".")[0]
             offset = (d_i + 1) * 100_000
             for ev in events:
@@ -128,6 +205,12 @@ def merge_profiles(log_dirs, out_path: str) -> int:
                     args["name"] = f"[{host}] {args.get('name', '')}"
                 merged.append(ev)
             n_sources += 1
+    if n_sources == 0:
+        warnings.warn(
+            f"merge_profiles: no trace sources under {list(log_dirs)!r} — "
+            "nothing written (was the profile actually collected?)",
+            RuntimeWarning, stacklevel=2)
+        return 0
     opener = gzip.open if out_path.endswith(".gz") else open
     os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
     with opener(out_path, "wt") as f:
