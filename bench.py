@@ -44,8 +44,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-# Generous ceiling: no current single TPU chip exceeds ~5 PFLOP/s dense bf16.
-_PEAK_TFLOPS_CEILING = 5000.0
+# Generous ceiling: no current single TPU chip exceeds ~5 PFLOP/s dense
+# bf16. Single-sourced from the ledger so its quarantine classifier and
+# this hard-fail can never disagree about which windows are physical.
+from triton_distributed_tpu.obs.history import (  # noqa: E402
+    PEAK_TFLOPS_CEILING as _PEAK_TFLOPS_CEILING,
+)
 
 
 class BenchError(RuntimeError):
@@ -297,7 +301,52 @@ def _measure_and_report():
         except Exception as e:
             result["megakernel_decode_error"] = (
                 f"{type(e).__name__}: {str(e)[:120]}")
+        _gate_and_record(result)
     print(json.dumps(result))
+
+
+def _gate_and_record(result: dict) -> None:
+    """Cross-round regression gate + ledger append (ISSUE 4): every TPU
+    bench run becomes a window-stamped record in BENCH_HISTORY.jsonl with
+    the gate verdict recorded IN the record — the shipped number is the
+    gated number. The verdict also rides the printed JSON (additive keys)
+    and the full table goes to stderr, fail-loud but non-fatal: a
+    regression must be visible everywhere, yet the measurement itself
+    still ships (the driver records rc and the parsed line)."""
+    try:
+        from triton_distributed_tpu.obs import gate as obs_gate
+        from triton_distributed_tpu.obs import history as obs_history
+
+        rec = obs_history.record_from_result(result)
+        try:
+            priors = obs_history.load_history()
+            report = obs_gate.evaluate(rec, priors)
+            rec.gate = report.to_json()
+            result["gate"] = {
+                "status": report.status,
+                "regressions": [
+                    f"{v.key}: {v.current:g} vs center {v.center:g} "
+                    f"(band ±{v.band_rel:.0%}, limit {v.limit:g})"
+                    for v in report.regressions]}
+            print(report.format_table(), file=sys.stderr)
+        except Exception as e:
+            # A gate bug must not cost the ledger the measurement window
+            # itself: the record still lands, verdict marked errored.
+            rec.gate = {"status": "error",
+                        "error": f"{type(e).__name__}: {str(e)[:120]}"}
+            result["gate"] = rec.gate
+        path = obs_history.append(rec)
+        print(f"# gate verdict ({rec.gate['status']}) recorded in {path}",
+              file=sys.stderr)
+    except Exception as e:  # the gate must never cost the measurement —
+        # and a late failure (e.g. the ledger append on a read-only
+        # checkout) must not clobber a regression verdict already shipped
+        # into the result.
+        result.setdefault(
+            "gate", {"status": "error",
+                     "error": f"{type(e).__name__}: {str(e)[:120]}"})
+        print(f"# gate/ledger step failed: {type(e).__name__}: "
+              f"{str(e)[:120]}", file=sys.stderr)
 
 
 def _fp8_gemm_metric(a_bf16, b_bf16, lengths):

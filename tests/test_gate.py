@@ -1,0 +1,452 @@
+"""Regression gate + bench history + SLO watchdog tests (ISSUE 4):
+ledger round-trip, noise-aware band behavior (quiet within band, loud on
+a seeded slip, direction-aware for latencies), the committed BENCH_r01-r05
+trajectory passing, CLI exit codes, SLO violation span emission, and the
+ledger-quote freshness contract."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from triton_distributed_tpu import obs
+from triton_distributed_tpu.obs import gate
+from triton_distributed_tpu.obs import history as hist
+from triton_distributed_tpu.obs import metrics as obs_metrics
+from triton_distributed_tpu.obs import slo
+from triton_distributed_tpu.obs import trace as obs_trace
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_run():
+    obs_trace.disable()
+    yield
+    obs_trace.disable()
+
+
+def _rec(rnd, **metrics):
+    return hist.Record(metrics=metrics, window=f"2026-07-{10 + rnd:02d} 12:00",
+                       round=rnd, source=f"synthetic r{rnd}")
+
+
+# ---------------------------------------------------------------------------
+# History ledger.
+# ---------------------------------------------------------------------------
+
+def test_ledger_roundtrip(tmp_path):
+    path = str(tmp_path / "hist.jsonl")
+    rec = _rec(1, vs_baseline=0.95, value=170.0)
+    rec.gate = {"status": "ok", "verdicts": []}
+    rec.fingerprint = {"jax": "0.4.37", "backend": "tpu"}
+    hist.append(rec, path)
+    hist.append(_rec(2, vs_baseline=0.96), path)
+    back = hist.load_jsonl(path)
+    assert [r.round for r in back] == [1, 2]
+    assert back[0].gate == {"status": "ok", "verdicts": []}
+    assert back[0].fingerprint["backend"] == "tpu"
+    assert back[0].value("vs_baseline") == 0.95
+    assert back[0].window == rec.window
+
+
+def test_load_history_merges_driver_round_files(tmp_path):
+    """A BENCH_rNN.json next to the ledger that the JSONL doesn't carry
+    is auto-backfilled — ledger/driver drift is structurally impossible."""
+    path = str(tmp_path / "hist.jsonl")
+    hist.append(_rec(1, vs_baseline=0.9), path)
+    with open(tmp_path / "BENCH_r02.json", "w") as f:
+        json.dump({"n": 2, "rc": 0,
+                   "tail": "WARNING:2026-08-01 10:00:00 ...\n{}",
+                   "parsed": {"metric": "m", "unit": "TFLOP/s",
+                              "value": 171.0, "vs_baseline": 0.93}}, f)
+    recs = hist.load_history(path)
+    assert [r.round for r in recs] == [1, 2]
+    assert recs[1].value("value") == 171.0
+    assert recs[1].window == "2026-08-01 10:00"
+    assert recs[1].quarantined is None
+
+
+def test_backfill_quarantines_elided_rounds(tmp_path):
+    """The round-1 failure mode (clamped differential → 17 EFLOP/s) is
+    kept in the ledger but excluded from gate trajectories."""
+    with open(tmp_path / "BENCH_r01.json", "w") as f:
+        json.dump({"n": 1, "rc": 0, "tail": "",
+                   "parsed": {"unit": "TFLOP/s", "value": 17179869.184,
+                              "vs_baseline": 1.0}}, f)
+    rec = hist.parse_bench_round_file(str(tmp_path / "BENCH_r01.json"))
+    assert rec.quarantined and "exceeds any real chip" in rec.quarantined
+    assert hist.trajectory([rec], "value") == []
+    assert hist.trajectory([rec], "value",
+                           include_quarantined=True) == [17179869.184]
+
+
+def test_unreliable_strings_stay_refused():
+    r = _rec(1, decode_step_ms_with_ar_kernel="unreliable this window")
+    assert r.value("decode_step_ms_with_ar_kernel") is None
+
+
+def test_window_spread_rel():
+    r = _rec(1, window_spread={
+        "xla": {"p50_ms": 100.0, "p95_ms": 120.0, "min_ms": 100.0, "n": 8},
+        "pinned": {"p50_ms": 100.0, "p95_ms": 110.0, "min_ms": 100.0,
+                   "n": 8}})
+    assert r.window_spread_rel() == pytest.approx(0.2)
+
+
+# ---------------------------------------------------------------------------
+# Gate bands.
+# ---------------------------------------------------------------------------
+
+def test_gate_quiet_within_band():
+    priors = [_rec(1, vs_baseline=0.93), _rec(2, vs_baseline=0.95),
+              _rec(3, vs_baseline=0.94)]
+    cur = _rec(4, vs_baseline=0.945)
+    report = gate.evaluate(cur, priors)
+    assert report.status == "ok"
+    v = {x.key: x for x in report.verdicts}["vs_baseline"]
+    assert v.status == "ok" and v.n_priors == 3
+
+
+def test_gate_fires_on_seeded_slip():
+    priors = [_rec(1, vs_baseline=0.95), _rec(2, vs_baseline=0.96),
+              _rec(3, vs_baseline=0.94)]
+    report = gate.evaluate(_rec(4, vs_baseline=0.70), priors)
+    assert report.status == "regression"
+    assert [v.key for v in report.regressions] == ["vs_baseline"]
+
+
+def test_gate_direction_aware_for_latencies():
+    priors = [_rec(1, decode_step_ms_megakernel=6.4),
+              _rec(2, decode_step_ms_megakernel=6.5)]
+    up = gate.evaluate(_rec(3, decode_step_ms_megakernel=9.8), priors)
+    assert up.status == "regression"
+    down = gate.evaluate(_rec(3, decode_step_ms_megakernel=4.1), priors)
+    assert down.status == "ok"
+    v = {x.key: x for x in down.verdicts}["decode_step_ms_megakernel"]
+    assert v.status == "improved"
+
+
+def test_gate_band_widens_with_trajectory_noise():
+    """A wild trajectory earns a wide band: the same absolute reading
+    that fires against a tight history passes against a noisy one."""
+    tight = [_rec(i, value=170.0 + i) for i in range(1, 4)]
+    noisy = [_rec(1, value=120.0), _rec(2, value=170.0),
+             _rec(3, value=210.0)]
+    cur = _rec(4, value=140.0)
+    assert gate.evaluate(cur, tight).status == "regression"
+    assert gate.evaluate(cur, noisy).status == "ok"
+
+
+def test_gate_insufficient_history_and_unreliable_pass():
+    priors = [_rec(1, vs_baseline=0.95)]
+    cur = _rec(2, vs_baseline=0.5,
+               decode_step_ms_megakernel="unreliable this window")
+    report = gate.evaluate(cur, priors)
+    assert report.status == "ok"
+    by = {v.key: v for v in report.verdicts}
+    assert by["vs_baseline"].status == "insufficient-history"
+    assert by["decode_step_ms_megakernel"].status == "unreliable"
+    assert by["value"].status == "absent"
+
+
+def test_gate_quarantined_priors_excluded():
+    bad = _rec(1, value=17179869.0)
+    bad.quarantined = "elided"
+    priors = [bad, _rec(2, value=165.0), _rec(3, value=172.0)]
+    report = gate.evaluate(_rec(4, value=168.0), priors)
+    v = {x.key: x for x in report.verdicts}["value"]
+    assert v.status == "ok" and v.n_priors == 2
+    assert v.center == pytest.approx(168.5)
+
+
+def test_gate_sustained_regression_keeps_firing():
+    """A prior that was itself gated as a regression on a rung is
+    excluded from the trajectory: the alarm record must not become the
+    'worst recent prior' that vouches for the next equally-bad window."""
+    priors = [_rec(1, vs_baseline=0.95), _rec(2, vs_baseline=0.96),
+              _rec(3, vs_baseline=0.94)]
+    slipped = _rec(4, vs_baseline=0.70)
+    first = gate.evaluate(slipped, priors)
+    assert first.status == "regression"
+    # bench.py appends the slipped record WITH its verdict — replay that.
+    slipped.gate = first.to_json()
+    second = gate.evaluate(_rec(5, vs_baseline=0.70),
+                           priors + [slipped])
+    v = {x.key: x for x in second.verdicts}["vs_baseline"]
+    assert second.status == "regression" and v.status == "regression"
+    # A recovered window still gates clean against the healthy priors.
+    recovered = gate.evaluate(_rec(5, vs_baseline=0.95),
+                              priors + [slipped])
+    assert recovered.status == "ok"
+
+
+def test_gate_quarantined_current_does_not_gate_clean(capsys):
+    """An elided/clamped current window (the round-1 1.7e7 TFLOP/s
+    class) must not exit 0 — its numbers are not measurements."""
+    cur = _rec(4, vs_baseline=0.96, value=17179869.0)
+    cur.quarantined = "elided measurement"
+    report = gate.evaluate(cur, [_rec(1, vs_baseline=0.95),
+                                 _rec(2, vs_baseline=0.96)])
+    assert report.status == "quarantined"
+    assert report.note == "elided measurement"
+    # CLI: gating the committed quarantined round 1 directly exits 2.
+    rc = gate.main(["--current", os.path.join(_ROOT, "BENCH_r01.json")])
+    assert rc == 2
+    assert "QUARANTINED" in capsys.readouterr().out
+
+
+def test_gate_real_trajectory_passes():
+    """Acceptance: the committed BENCH_r01-r05 trajectory gates clean
+    (r1 quarantined; the r4→r5 0.961→0.936 slip is within the noise
+    band, not a regression)."""
+    records = hist.load_history()
+    rounds = [r for r in records if r.round is not None]
+    assert len(rounds) >= 5
+    assert any(r.quarantined for r in rounds if r.round == 1)
+    report = gate.evaluate(rounds[-1], rounds[:-1])
+    assert report.status == "ok", report.format_table()
+
+
+def test_gate_cli_dryrun_real(capsys):
+    assert gate.main(["--dryrun"]) == 0
+    out = capsys.readouterr().out
+    assert "gate: OK" in out and "dryrun copy" in out
+
+
+def test_gate_cli_no_data_current_exits_2(tmp_path, capsys):
+    """A current file carrying none of the gated rungs (empty/truncated/
+    wrong-shaped JSON) must NOT read as a clean gate."""
+    path = str(tmp_path / "hist.jsonl")
+    for i, v in enumerate((0.95, 0.96, 0.94), start=1):
+        hist.append(_rec(i, vs_baseline=v), path)
+    cur = str(tmp_path / "current.json")
+    with open(cur, "w") as f:
+        json.dump({}, f)
+    assert gate.main(["--history", path, "--current", cur]) == 2
+    assert "NO-DATA" in capsys.readouterr().out
+
+
+def test_gate_cli_driver_format_current_unwrapped(tmp_path, capsys):
+    """A driver BENCH_rNN.json snapshot passed as --current gates the
+    rungs under its 'parsed' key — a seeded slip in the wrapper format
+    must exit 1, not pass vacuously with every rung absent."""
+    path = str(tmp_path / "hist.jsonl")
+    for i, v in enumerate((0.95, 0.96, 0.94), start=1):
+        hist.append(_rec(i, vs_baseline=v), path)
+    cur = str(tmp_path / "BENCH_r09.json")
+    with open(cur, "w") as f:
+        json.dump({"cmd": "python bench.py", "rc": 0, "tail": "",
+                   "parsed": {"vs_baseline": 0.70}}, f)
+    assert gate.main(["--history", path, "--current", cur]) == 1
+    assert "REGRESSION" in capsys.readouterr().out
+
+
+def test_gate_cli_current_already_in_ledger_not_its_own_prior(
+        tmp_path, capsys):
+    """A slipped live window that bench.py already appended (round-less)
+    must not vouch for itself when re-gated via --current: the ledger
+    copy is the same window, not trajectory evidence."""
+    path = str(tmp_path / "hist.jsonl")
+    for i, v in enumerate((0.95, 0.96, 0.94), start=1):
+        hist.append(_rec(i, vs_baseline=v), path)
+    slipped = hist.Record(metrics={"vs_baseline": 0.70},
+                          window="2026-08-01 09:00", round=None,
+                          source="bench.py",
+                          gate={"status": "error", "error": "io"})
+    hist.append(slipped, path)
+    cur = str(tmp_path / "current.json")
+    with open(cur, "w") as f:
+        json.dump({"vs_baseline": 0.70}, f)   # the same window, re-gated
+    assert gate.main(["--history", path, "--current", cur]) == 1
+    assert "REGRESSION" in capsys.readouterr().out
+
+
+def test_gate_cli_seeded_regression_exits_nonzero(tmp_path, capsys):
+    path = str(tmp_path / "hist.jsonl")
+    for i, v in enumerate((0.95, 0.96, 0.94), start=1):
+        hist.append(_rec(i, vs_baseline=v), path)
+    cur = str(tmp_path / "current.json")
+    with open(cur, "w") as f:
+        json.dump({"vs_baseline": 0.70}, f)
+    out_json = str(tmp_path / "verdict.json")
+    rc = gate.main(["--history", path, "--current", cur,
+                    "--json", out_json])
+    assert rc == 1
+    assert "REGRESSION" in capsys.readouterr().out
+    with open(out_json) as f:
+        verdict = json.load(f)
+    assert verdict["status"] == "regression"
+    keys = [v["key"] for v in verdict["verdicts"]
+            if v["status"] == "regression"]
+    assert keys == ["vs_baseline"]
+
+
+def test_gate_cli_dryrun_fails_on_regressed_committed_round(tmp_path):
+    """--dryrun copies the newest round but gates it against the rounds
+    BEFORE it — a regressed round committed to the history makes the CI
+    step fail instead of trivially passing against itself."""
+    path = str(tmp_path / "hist.jsonl")
+    for i, v in enumerate((0.95, 0.96, 0.94, 0.70), start=1):
+        hist.append(_rec(i, vs_baseline=v), path)
+    assert gate.main(["--dryrun", "--history", path]) == 1
+
+
+def test_gate_cli_latest_vs_priors(tmp_path):
+    path = str(tmp_path / "hist.jsonl")
+    for i, v in enumerate((0.95, 0.96, 0.94, 0.95), start=1):
+        hist.append(_rec(i, vs_baseline=v), path)
+    assert gate.main(["--history", path]) == 0
+
+
+# ---------------------------------------------------------------------------
+# SLO watchdog.
+# ---------------------------------------------------------------------------
+
+def test_slo_observed_without_thresholds():
+    reg = obs_metrics.Registry()
+    reg.gauge("tdtpu_serve_tokens_per_s").set(42.0)
+    section = slo.evaluate(slo.observed_from_registry(reg),
+                           slo.SLOConfig())
+    assert section["violations"] == 0
+    by = {r["rule"]: r for r in section["rules"]}
+    assert by["tokens_per_s_floor"]["status"] == "observed"
+    assert by["tokens_per_s_floor"]["observed"] == 42.0
+    assert by["step_latency_p99_ceiling"]["status"] == "no-data"
+
+
+def test_slo_violation_emits_span_and_counters(tmp_path):
+    run_dir = str(tmp_path / "run")
+    obs.start_run(run_dir)
+    reg = obs_metrics.registry()
+    reg.gauge("tdtpu_serve_tokens_per_s").set(5.0)
+    for ms in (1.0, 2.0, 50.0):
+        reg.histogram("tdtpu_decode_step_latency_ms").observe(ms)
+    section = slo.check_serving(
+        reg, cfg=slo.SLOConfig(tokens_per_s_min=10.0,
+                               step_p99_ms_max=20.0))
+    assert section["violations"] == 2
+    assert reg.get("tdtpu_slo_violations_total").value == 2
+    assert reg.get(
+        "tdtpu_slo_violation_tokens_per_s_floor_total").value == 1
+    obs.finish_run()
+    with open(tmp_path / "run" / "host.spans.json") as f:
+        events = json.load(f)["traceEvents"]
+    viol = [e for e in events if e.get("name") == "slo.violation"]
+    assert {e["args"]["rule"] for e in viol} == {
+        "tokens_per_s_floor", "step_latency_p99_ceiling"}
+
+
+def test_finish_run_embeds_slo_section(tmp_path, monkeypatch):
+    monkeypatch.setenv("TDTPU_SLO_TOKENS_S_MIN", "10")
+    run_dir = str(tmp_path / "run")
+    obs.start_run(run_dir)
+    obs_metrics.registry().gauge("tdtpu_serve_tokens_per_s").set(5.0)
+    obs.finish_run()
+    with open(tmp_path / "run" / "metrics.json") as f:
+        snap = json.load(f)
+    assert snap["slo"]["violations"] == 1
+    by = {r["rule"]: r for r in snap["slo"]["rules"]}
+    assert by["tokens_per_s_floor"]["status"] == "violation"
+    assert by["tokens_per_s_floor"]["threshold"] == 10.0
+
+
+def test_slo_env_typo_degrades_to_observed(monkeypatch):
+    """A malformed threshold must never crash the serve it watches —
+    it warns and the rule degrades to observed-only."""
+    monkeypatch.setenv("TDTPU_SLO_TOKENS_S_MIN", "5k")
+    with pytest.warns(RuntimeWarning, match="not a number"):
+        cfg = slo.SLOConfig.from_env()
+    assert cfg.tokens_per_s_min is None
+    reg = obs_metrics.Registry()
+    reg.gauge("tdtpu_serve_tokens_per_s").set(1.0)
+    section = slo.evaluate(slo.observed_from_registry(reg), cfg)
+    assert section["violations"] == 0
+
+
+def test_stall_fraction_from_summaries():
+    assert slo.stall_fraction_from_summaries([]) is None
+    s = [{"task_sum_s": 0.006, "measured_step_s": 0.010},
+         {"task_sum_s": 0.009, "measured_step_s": 0.010}]
+    assert slo.stall_fraction_from_summaries(s) == pytest.approx(0.4)
+
+
+def test_live_stall_fraction_uses_newest_profile(tmp_path):
+    """The live watchdog judges the serve that just happened: once a
+    clean profile lands, an old stalled window must stop violating."""
+    run = tmp_path / "run"
+    run.mkdir()
+
+    def profile(name, task_s, step_s, mtime):
+        p = run / f"{name}.kernel_profile.json"
+        with open(p, "w") as f:
+            json.dump({"summary": {"task_sum_s": task_s,
+                                   "measured_step_s": step_s}}, f)
+        os.utime(p, (mtime, mtime))
+
+    profile("stalled", 0.005, 0.010, 1000.0)   # stall fraction 0.5
+    profile("clean", 0.0098, 0.010, 2000.0)    # stall fraction 0.02
+    obs_d = slo.observed_from_registry(obs_metrics.Registry(),
+                                       run_dir=str(run))
+    assert obs_d["stall_fraction_ceiling"] == pytest.approx(0.02)
+
+
+def test_report_check_fails_on_slo_violation(tmp_path):
+    from triton_distributed_tpu.obs.report import main as report_main
+
+    run_dir = str(tmp_path / "run")
+    obs.start_run(run_dir)
+    reg = obs_metrics.registry()
+    reg.counter("tdtpu_tokens_generated_total").inc(3)
+    reg.histogram("tdtpu_decode_step_latency_ms").observe(1.5)
+    reg.gauge("tdtpu_serve_tokens_per_s").set(5.0)
+    obs.finish_run()
+    # Overwrite the snapshot with a violating slo section (the watchdog
+    # would have produced the same shape under a TDTPU_SLO_* env).
+    with open(tmp_path / "run" / "metrics.json") as f:
+        snap = json.load(f)
+    snap["slo"] = slo.evaluate(
+        slo.observed_from_snapshot(snap),
+        slo.SLOConfig(tokens_per_s_min=10.0))
+    assert snap["slo"]["violations"] == 1
+    with open(tmp_path / "run" / "metrics.json", "w") as f:
+        json.dump(snap, f)
+    assert report_main([run_dir, "--check"]) == 1
+    assert report_main([run_dir, "--check",
+                        "--allow-slo-violations"]) == 0
+
+
+def test_report_synthesizes_slo_for_legacy_runs(tmp_path, capsys):
+    """A run dir written before the watchdog (no slo section) still gets
+    one synthesized from the saved series."""
+    from triton_distributed_tpu.obs.report import main as report_main
+
+    run_dir = str(tmp_path / "run")
+    obs.start_run(run_dir)
+    reg = obs_metrics.registry()
+    reg.counter("tdtpu_tokens_generated_total").inc(1)
+    reg.histogram("tdtpu_decode_step_latency_ms").observe(2.0)
+    obs.finish_run()
+    with open(tmp_path / "run" / "metrics.json") as f:
+        snap = json.load(f)
+    snap.pop("slo")
+    with open(tmp_path / "run" / "metrics.json", "w") as f:
+        json.dump(snap, f)
+    assert report_main([run_dir, "--check"]) == 0
+    assert "slo (0 violation(s))" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# Ledger quotes (the doc-drift guard) — the same check CI runs.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_measurements_and_ledger_quotes_fresh():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "scripts",
+                                      "gen_measurements.py"), "--check"],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr

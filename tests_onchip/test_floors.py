@@ -1,0 +1,56 @@
+"""REAL-TPU perf floors (ISSUE 4): hardware regressions can't ship
+silently. Floor values live in ``obs/gate.py ON_CHIP_FLOORS`` (~2x slack
+off the measured trajectory — these catch half clocks / broken MXU paths /
+interpret-grade fallbacks, not window noise); the measurement functions
+are shared with ``scripts/check_on_chip.py``'s floors section so the
+script and the suite can never enforce different numbers.
+"""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.onchip
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _check_on_chip():
+    spec = importlib.util.spec_from_file_location(
+        "check_on_chip", os.path.join(_ROOT, "scripts", "check_on_chip.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("check_on_chip", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_floor_values_are_sane():
+    from triton_distributed_tpu.obs.gate import ON_CHIP_FLOORS
+
+    assert set(ON_CHIP_FLOORS) == {"gemm_tflops_min",
+                                   "flash32k_prefill_ms_max",
+                                   "megakernel_vs_jit_max"}
+    assert all(v > 0 for v in ON_CHIP_FLOORS.values())
+
+
+def test_gemm_tflops_floor():
+    mod = _check_on_chip()
+    tflops = mod.floor_gemm_tflops()     # raises FloorError on violation
+    assert tflops > 0
+
+
+def test_flash32k_prefill_ceiling():
+    mod = _check_on_chip()
+    ms = mod.floor_flash32k_ms()
+    assert ms > 0
+
+
+@pytest.mark.slow
+def test_megakernel_vs_jit_ceiling():
+    """Slow: compiles two 36-layer programs (the bench's own full-model
+    rungs). Run explicitly: pytest tests_onchip -m 'onchip and slow'."""
+    mod = _check_on_chip()
+    ratio = mod.floor_megakernel_vs_jit()
+    assert ratio > 0

@@ -28,20 +28,27 @@ class CommOp:
     per-pair semaphores (ops/p2p.p2p_permute_local); uniform ring perms
     dispatch the single-semaphore shift fast path. Non-ring PP schedules
     (uneven stage maps, skip connections, bidirectional pipelines) compose
-    their tick's sends as a perm."""
+    their tick's sends as a perm.
 
-    def __init__(self, axis: str = "pp", num_ranks: int | None = None):
+    ``force_kernel``: compile the Pallas kernels even at n=1 (self-push
+    loopback) — the on-chip compile gate (scripts/check_on_chip.py's
+    CommOp ping-pong)."""
+
+    def __init__(self, axis: str = "pp", num_ranks: int | None = None,
+                 force_kernel: bool = False):
         if num_ranks is None:
             raise ValueError("num_ranks required inside shard_map")
         self.axis = axis
         self.n = num_ranks
+        self.force_kernel = force_kernel
 
     def exchange(self, x: jax.Array, perm) -> jax.Array:
         # No n==1 shortcut: p2p_permute_local's degenerate branch keeps
         # the ppermute semantics (zeros unless the (0,0) self-pair is in
         # the perm) — an early `return x` would silently feed a stale
         # activation where every n>1 run feeds zeros.
-        return p2p_permute_local(x, perm, axis=self.axis, num_ranks=self.n)
+        return p2p_permute_local(x, perm, axis=self.axis, num_ranks=self.n,
+                                 force_kernel=self.force_kernel)
 
     def send(self, x: jax.Array, src: int, dst: int) -> jax.Array:
         """Single-pair send: ``dst`` receives src's block, everyone else

@@ -560,6 +560,22 @@ class Engine:
             "generated tokens/s over the last serve() call, excluding "
             "first-call jit compilation"
         ).set(batch * gen_len / serving_s)
+        # Live SLO watchdog (obs/slo.py): evaluate the registry this serve
+        # just fed — tokens/s floor, step-p99 ceiling, megakernel stall
+        # fraction — emitting slo.violation spans + counters on breach.
+        # Thresholds come from TDTPU_SLO_* env; unset = observed only.
+        # Guarded like bench's gate: the watchdog must never cost the
+        # serve result it watches.
+        try:
+            from triton_distributed_tpu import obs
+            from triton_distributed_tpu.obs import slo as obs_slo
+
+            obs_slo.check_serving(reg, run_dir=obs.active_run_dir())
+        except Exception as e:
+            import warnings
+
+            warnings.warn(f"SLO watchdog failed: {type(e).__name__}: {e}",
+                          RuntimeWarning, stacklevel=2)
         return out
 
     def _serve_run(self, input_ids: jax.Array, gen_len: int,

@@ -31,6 +31,18 @@ from triton_distributed_tpu.obs.trace import Tracer
 __all__ = ["trace", "metrics", "start_run", "finish_run", "active_run_dir",
            "run_from_env"]
 
+# Enforcement tier (ISSUE 4) — imported lazily by name to keep package
+# import light: obs.history (bench ledger), obs.gate (cross-round
+# regression gate), obs.slo (live SLO watchdog).
+
+
+def __getattr__(name: str):
+    if name in ("history", "gate", "slo"):
+        import importlib
+
+        return importlib.import_module(f"triton_distributed_tpu.obs.{name}")
+    raise AttributeError(name)
+
 _RUN_DIR: str | None = None
 
 
@@ -47,16 +59,32 @@ def start_run(run_dir: str, *, sync: bool = False) -> Tracer:
 
 
 def finish_run() -> str | None:
-    """Write the run artifacts (span trace + metrics snapshot) and disable
-    the tracer; returns the run directory (None if no run was active)."""
+    """Write the run artifacts (span trace + metrics snapshot + final SLO
+    section) and disable the tracer; returns the run directory (None if
+    no run was active)."""
     global _RUN_DIR
     t = trace.disable()
     run_dir = _RUN_DIR
     _RUN_DIR = None
     if t is None or run_dir is None:
         return None
+    reg = metrics.registry()
+    # Best-effort SLO section: a watchdog bug must never cost the run's
+    # artifacts (same contract as the serve-path guard in Engine.serve).
+    extra = None
+    try:
+        from triton_distributed_tpu.obs import slo as _slo
+
+        extra = {"slo": _slo.evaluate(
+            _slo.observed_from_registry(reg, run_dir),
+            _slo.SLOConfig.from_env())}
+    except Exception as e:
+        import warnings
+
+        warnings.warn(f"SLO section skipped: {type(e).__name__}: {e}",
+                      RuntimeWarning, stacklevel=2)
     t.save()
-    metrics.registry().save(run_dir)
+    reg.save(run_dir, extra=extra)
     return run_dir
 
 

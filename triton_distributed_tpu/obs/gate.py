@@ -1,0 +1,334 @@
+"""Cross-round perf-regression gate over the bench-history ledger.
+
+``python -m triton_distributed_tpu.obs.gate`` compares a *current*
+measurement window against the trajectory in ``BENCH_HISTORY.jsonl``
+(obs/history.py) with noise-aware bands and fails loudly — exit 1 and a
+per-rung verdict table — when a rung regresses beyond band.  bench.py
+runs the same :func:`evaluate` after its lanes and records the verdict in
+the history record it appends, so the shipped number and the gated number
+are one number.
+
+Band math (per rung, direction-aware):
+
+* ``center`` = median of the last :data:`TRAJ_WINDOW` non-quarantined
+  prior values;
+* relative band = ``max(BAND_FLOOR, half-range of those priors / center,
+  same-window spread evidence)`` capped at :data:`BAND_CAP` — the spread
+  evidence is the interleaved-lane p95/min swing bench.py records as
+  ``window_spread`` (PerfStats samples), i.e. the measured noise of the
+  very protocol that produced the numbers;
+* a reading only counts as a regression when it is beyond band against
+  the center AND beyond ``BAND_FLOOR`` against the *worst* recent prior —
+  a window that lands next to something the trajectory already contains
+  is chip weather, not a regression (the r3→r5 decode-chain protocol
+  change would otherwise fire forever);
+* a prior whose own recorded verdict flagged this rung as a regression
+  is excluded from the trajectory: a regressed window must not become
+  the "worst recent prior" that vouches for the next equally-bad window
+  — a sustained regression keeps firing until the level is accepted by
+  quarantining the alarm records (or the rung recovers);
+* fewer than 2 priors → ``insufficient-history`` (pass): one point is
+  not a trajectory.
+
+Strings like ``"unreliable this window"`` are the bench *refusing* a
+number; the gate treats them as absent, never as zero.
+
+:data:`ON_CHIP_FLOORS` — the hardware floors ``scripts/check_on_chip.py``
+and ``tests_onchip/test_floors.py`` enforce — lives here so the floor
+values are quoted from one place (docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import statistics
+from typing import Any
+
+from triton_distributed_tpu.obs import history as hist
+
+# The chip's documented same-window noise floor: interleaved-lane ratios
+# swing ~±8% even in clean windows (docs/gemm_core.md controlled runs
+# 1.04→1.18; BENCH r4→r5 vs_baseline 0.961→0.936 was within this).
+BAND_FLOOR = 0.08
+# Ceiling on the slack a wild trajectory can earn: however noisy the
+# priors, a rung never gets more than ±60% — the band is clamped here
+# (reported in the verdict row), not waived.
+BAND_CAP = 0.60
+# How many most-recent priors define the trajectory.
+TRAJ_WINDOW = 5
+
+# On-chip perf floors (scripts/check_on_chip.py --floors section and
+# tests_onchip/test_floors.py). Values are deliberately ~2x slack off the
+# measured trajectory: these catch *hardware/toolchain* regressions (half
+# clocks, a broken MXU path, interpret-grade fallbacks silently shipping),
+# not window noise.
+ON_CHIP_FLOORS: dict[str, float] = {
+    # Headline pinned-shape GEMM ((2048,5120)@(5120,5120) bf16, tiles
+    # (1024,1024,512)): trajectory 165.6–178.3 sustained TFLOP/s.
+    "gemm_tflops_min": 100.0,
+    # Flash prefill S=32k (B=1, 8q/1kv, d=128, causal, 1024x1024 tiles):
+    # measured ~12 ms (COVERAGE.md capacity table).
+    "flash32k_prefill_ms_max": 40.0,
+    # Full-model megakernel decode step vs the jitted bare-shard ladder:
+    # measured 1.58–1.76x (ledger r5: 6.421 ms vs 4.056 ms).
+    "megakernel_vs_jit_max": 2.0,
+}
+
+
+@dataclasses.dataclass
+class RungVerdict:
+    key: str
+    lane: str
+    status: str            # ok | improved | regression | insufficient-history
+    #                      # | absent | unreliable
+    current: float | None = None
+    center: float | None = None
+    band_rel: float | None = None
+    limit: float | None = None
+    n_priors: int = 0
+    note: str = ""
+
+    def to_json(self) -> dict[str, Any]:
+        return {k: v for k, v in dataclasses.asdict(self).items()
+                if v not in (None, "")}
+
+
+@dataclasses.dataclass
+class GateReport:
+    verdicts: list[RungVerdict]
+    status: str            # "ok" | "regression" | "no-data" | "quarantined"
+    current_window: str = ""
+    note: str = ""
+
+    @property
+    def regressions(self) -> list[RungVerdict]:
+        return [v for v in self.verdicts if v.status == "regression"]
+
+    def to_json(self) -> dict[str, Any]:
+        return {"status": self.status,
+                "current_window": self.current_window,
+                **({"note": self.note} if self.note else {}),
+                "band_floor": BAND_FLOOR, "band_cap": BAND_CAP,
+                "verdicts": [v.to_json() for v in self.verdicts]}
+
+    def format_table(self) -> str:
+        lines = [f"{'rung':38s} {'lane':10s} {'current':>10s} "
+                 f"{'center':>10s} {'band':>6s} {'verdict'}"]
+        for v in self.verdicts:
+            cur = "—" if v.current is None else f"{v.current:g}"
+            cen = "—" if v.center is None else f"{v.center:g}"
+            band = "—" if v.band_rel is None else f"±{v.band_rel:.0%}"
+            tail = f"  ({v.note})" if v.note else ""
+            lines.append(f"{v.key:38s} {v.lane:10s} {cur:>10s} "
+                         f"{cen:>10s} {band:>6s} {v.status}{tail}")
+        lines.append(f"gate: {self.status.upper()}"
+                     + (f" — {len(self.regressions)} rung(s) beyond band"
+                        if self.regressions else ""))
+        return "\n".join(lines)
+
+
+def _spread_evidence(current: hist.Record,
+                     priors: list[hist.Record]) -> float | None:
+    """Median same-window p95/min swing across records that carry
+    ``window_spread`` (current first — it measured *this* window)."""
+    rels = [r for rec in [current, *priors]
+            if (r := rec.window_spread_rel()) is not None]
+    if not rels:
+        return None
+    rels.sort()
+    return rels[len(rels) // 2]
+
+
+def _rung_regressed(rec: hist.Record, key: str) -> bool:
+    """Did this record's own recorded gate verdict flag ``key`` as a
+    regression?  (bench.py stores the full verdict in the ledger.)"""
+    verdicts = (rec.gate or {}).get("verdicts") or []
+    return any(v.get("key") == key and v.get("status") == "regression"
+               for v in verdicts if isinstance(v, dict))
+
+
+def evaluate_rung(spec: hist.MetricSpec, current: hist.Record,
+                  priors: list[hist.Record]) -> RungVerdict:
+    raw = current.metrics.get(spec.key)
+    cur = current.value(spec.key)
+    # A prior that was itself gated as a regression on this rung must not
+    # serve as trajectory evidence — otherwise a sustained regression
+    # alarms exactly once and then vouches for itself via the
+    # worst-recent-prior edge below.
+    usable = [r for r in priors
+              if not r.quarantined and not _rung_regressed(r, spec.key)]
+    vals = [v for r in usable if (v := r.value(spec.key)) is not None]
+    vals = vals[-TRAJ_WINDOW:]
+    if cur is None:
+        status = "unreliable" if isinstance(raw, str) else "absent"
+        return RungVerdict(spec.key, spec.lane, status, n_priors=len(vals),
+                           note=str(raw)[:60] if isinstance(raw, str)
+                           else "")
+    if len(vals) < 2:
+        return RungVerdict(spec.key, spec.lane, "insufficient-history",
+                           current=cur, n_priors=len(vals))
+    center = statistics.median(vals)
+    half_range = ((max(vals) - min(vals)) / (2 * abs(center))
+                  if center else 0.0)
+    spread = (_spread_evidence(current, usable)
+              if spec.lane == "headline" else None)
+    band = min(BAND_CAP, max(BAND_FLOOR, half_range, spread or 0.0))
+    if spec.direction == "higher":
+        limit = center * (1 - band)
+        # permissive edge: within noise floor of the worst recent prior
+        limit = min(limit, min(vals) * (1 - BAND_FLOOR))
+        regressed, improved = cur < limit, cur > center * (1 + band)
+    else:
+        limit = center * (1 + band)
+        limit = max(limit, max(vals) * (1 + BAND_FLOOR))
+        regressed, improved = cur > limit, cur < center * (1 - band)
+    status = ("regression" if regressed else
+              "improved" if improved else "ok")
+    return RungVerdict(spec.key, spec.lane, status, current=cur,
+                       center=round(center, 6), band_rel=round(band, 4),
+                       limit=round(limit, 6), n_priors=len(vals))
+
+
+def evaluate(current: hist.Record,
+             priors: list[hist.Record]) -> GateReport:
+    """Gate one record against its trajectory (``priors`` may include
+    ``current`` itself — it is excluded by identity)."""
+    priors = [p for p in priors if p is not current]
+    verdicts = [evaluate_rung(spec, current, priors)
+                for spec in hist.METRICS]
+    if current.quarantined:
+        # An elided/clamped current window (the round-1 1.7e7 TFLOP/s
+        # class) must not gate clean: its numbers are not measurements.
+        return GateReport(verdicts=verdicts, status="quarantined",
+                          current_window=current.window,
+                          note=current.quarantined)
+    if any(v.status == "regression" for v in verdicts):
+        status = "regression"
+    elif all(v.current is None for v in verdicts):
+        # A current record carrying NONE of the gated rungs (wrong file
+        # shape, truncated JSON, empty dict) must not read as a clean
+        # gate — that is the silent-pass failure mode this tool exists
+        # to prevent.
+        status = "no-data"
+    else:
+        status = "ok"
+    return GateReport(verdicts=verdicts, status=status,
+                      current_window=current.window)
+
+
+def _same_window(a: hist.Record, b: hist.Record) -> bool:
+    """Do two records describe the same measurement window?  Matched by
+    round number, by (window, source) stamp, or — for re-gated live
+    records whose wrapper re-stamped the window — by every gated rung
+    carrying identical values (full-precision floats across 12 rungs do
+    not collide across genuinely different windows)."""
+    if a.round is not None and a.round == b.round:
+        return True
+    if a.window and a.window == b.window and a.source == b.source:
+        return True
+    vals = [a.value(m.key) for m in hist.METRICS]
+    if all(v is None for v in vals):
+        return False
+    return vals == [b.value(m.key) for m in hist.METRICS]
+
+
+def synthesize_current(priors: list[hist.Record]) -> hist.Record:
+    """The CI dryrun's *current* record: a copy of the newest
+    non-quarantined round, explicitly fingerprinted as synthetic — it
+    exercises every band computation without a TPU in the loop."""
+    rounds = [r for r in priors if not r.quarantined
+              and r.round is not None]
+    if not rounds:
+        raise SystemExit("no usable rounds in the history to synthesize "
+                         "a dryrun record from")
+    last = rounds[-1]
+    return hist.Record(
+        metrics=dict(last.metrics), window=last.window, round=None,
+        source=f"dryrun copy of r{last.round}",
+        fingerprint={"synthetic": True, "copied_from_round": last.round})
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m triton_distributed_tpu.obs.gate",
+        description="Cross-round perf-regression gate over the bench "
+                    "history ledger (docs/observability.md).")
+    ap.add_argument("--history", default=None,
+                    help="ledger path (default BENCH_HISTORY.jsonl)")
+    ap.add_argument("--current", default=None,
+                    help="JSON file holding the current window — a bench "
+                         "result dict or a ledger record; default: the "
+                         "newest history record")
+    ap.add_argument("--dryrun", action="store_true",
+                    help="CPU-synthesize the current record from the "
+                         "newest committed round (the CI mode)")
+    ap.add_argument("--json", default=None,
+                    help="also write the verdict report as JSON")
+    args = ap.parse_args(argv)
+
+    records = hist.load_history(args.history)
+    if not records:
+        print("gate: history is empty — nothing to gate against")
+        return 2
+    if args.dryrun:
+        current: hist.Record = synthesize_current(records)
+        # Exclude the copied round from the trajectory — gating a copy of
+        # rN against priors that still contain rN can never fail, and the
+        # CI step exists precisely to fail if the newest committed round
+        # stops gating clean against the rounds before it.
+        src = current.fingerprint.get("copied_from_round")
+        priors = [r for r in records if r.round != src]
+    elif args.current:
+        with open(args.current) as f:
+            obj = json.load(f)
+        if "metrics" in obj:          # a ledger record
+            current = hist.Record.from_json(obj)
+        elif "parsed" in obj:         # a driver BENCH_rNN.json snapshot:
+            # the rungs live under "parsed" — gating the wrapper itself
+            # would read every rung as absent and pass vacuously.
+            current = hist.parse_bench_round_file(args.current)
+        else:                         # a bare bench result dict
+            current = hist.record_from_result(obj, source=args.current)
+        # load_history auto-merges driver BENCH_rNN.json files sitting
+        # next to the ledger, and bench.py appends every live window —
+        # when --current names a window the ledger already carries, the
+        # ledger copy must not serve as its own prior (a slipped window
+        # would widen the band and vouch for itself).
+        priors = [r for r in records if not _same_window(current, r)]
+    else:
+        current, priors = records[-1], records[:-1]
+        if len(priors) == 0:
+            print("gate: only one record in history — nothing to gate "
+                  "against")
+            return 2
+
+    report = evaluate(current, priors)
+    if report.status == "no-data":
+        print("gate: NO-DATA — the current record carries none of the "
+              "gated rungs (wrong file shape? truncated JSON?)")
+        print(report.format_table())
+        return 2
+    if report.status == "quarantined":
+        print("gate: QUARANTINED current window — not a measurement, "
+              f"not gated: {report.note}")
+        print(report.format_table())
+        return 2
+    print(f"gate: current = {current.source or 'latest record'}"
+          + (f" (round {current.round})" if current.round is not None
+             else "")
+          + (f", window {current.window}" if current.window else ""))
+    print(report.format_table())
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report.to_json(), f, indent=2)
+        print(f"wrote {args.json}")
+    return 1 if report.status == "regression" else 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

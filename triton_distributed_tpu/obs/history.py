@@ -1,0 +1,317 @@
+"""Bench-history store — the window-stamped measurement ledger (ISSUE 4).
+
+Every benchmark rung this repo ships is one *window* on a shared, drifting
+chip (bench.py's header: clocks swing ~±15%, dispatch cost ±50 ms).  Up
+to round 5 those windows lived in three places — the driver's per-round
+``BENCH_rNN.json`` snapshots, COVERAGE.md prose, and docs pages — and the
+same rung got quoted from *different* windows (6.42 vs 7.17 ms for the
+megakernel decode, VERDICT r5 weak #3).  This module makes the trajectory
+a single append-only JSONL ledger:
+
+* one :class:`Record` per measurement window — round number (when the
+  driver stamped one), window timestamp, the parsed bench metrics, a
+  jax/device fingerprint, optional window-spread evidence, and the
+  regression-gate verdict recorded at measurement time;
+* ``load_history()`` merges the committed ``BENCH_HISTORY.jsonl`` with
+  any driver ``BENCH_rNN.json`` not yet in it (auto-backfill: the ledger
+  can never silently miss a round the driver recorded);
+* ``bench.py`` appends a live record — gate verdict included — after
+  every TPU run, and ``scripts/gen_measurements.py`` renders docs *and*
+  the COVERAGE/docs rung quotes from this one source.
+
+CLI::
+
+    python -m triton_distributed_tpu.obs.history --show        # trajectory
+    python -m triton_distributed_tpu.obs.history --backfill    # (re)write
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import os
+import re
+import time
+from typing import Any, Iterable, NamedTuple
+
+SCHEMA = 1
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# The ceiling bench.py hard-fails on (it imports THIS constant — one
+# definition): no current single TPU chip exceeds ~5 PFLOP/s dense bf16.
+# A ledger record whose headline implies more was produced by an
+# elided/clamped measurement (the round-1 17 EFLOP/s bug) and is
+# quarantined from gate trajectories rather than dropped.
+PEAK_TFLOPS_CEILING = 5000.0
+
+
+class MetricSpec(NamedTuple):
+    """One gated bench rung: ledger key, human label, unit suffix,
+    direction ('higher' = bigger is better), and the bench lane it ships
+    from (the gate reports per-lane)."""
+
+    key: str
+    label: str
+    unit: str
+    direction: str
+    lane: str
+
+
+# The canonical rung table — gen_measurements renders rows in this order,
+# the gate evaluates exactly these keys, doc quotes resolve through it.
+METRICS: tuple[MetricSpec, ...] = (
+    MetricSpec("value", "GEMM core TFLOP/s (Qwen3-32B TP=8 shape)",
+               " TFLOP/s", "higher", "headline"),
+    MetricSpec("vs_baseline", "GEMM core vs XLA dot (target ≥ 0.95)",
+               "×", "higher", "headline"),
+    MetricSpec("fp8_gemm_tflops", "fp8 GEMM TFLOP/s",
+               " TFLOP/s", "higher", "fp8"),
+    MetricSpec("fp8_vs_bf16", "fp8 vs bf16 (square shape)",
+               "×", "higher", "fp8"),
+    MetricSpec("fp8_mixed_vs_bf16", "mixed bf16×fp8 vs bf16",
+               "×", "higher", "fp8"),
+    MetricSpec("fp8_mixed_resident_vs_bf16",
+               "mixed, fused-upcast tiling vs bf16", "×", "higher", "fp8"),
+    MetricSpec("fp8_vs_bf16_decode_shape", "fp8 vs bf16 (decode shape m=8)",
+               "×", "higher", "fp8"),
+    MetricSpec("decode_step_ms_qwen3_8b_tp8_shard",
+               "decode step ms (bare shard)", " ms", "lower", "decode"),
+    MetricSpec("decode_step_ms_with_ar_kernel",
+               "decode step ms (+AR kernel)", " ms", "lower", "decode"),
+    MetricSpec("decode_step_ms_with_fused_gemm_ar",
+               "decode step ms (+fused GEMM+AR)", " ms", "lower", "decode"),
+    MetricSpec("decode_step_ms_best_comm_variant",
+               "decode step ms (best comm variant)", " ms", "lower",
+               "decode"),
+    MetricSpec("decode_step_ms_megakernel", "decode step ms (megakernel)",
+               " ms", "lower", "megakernel"),
+)
+
+METRIC_BY_KEY = {m.key: m for m in METRICS}
+
+
+@dataclasses.dataclass
+class Record:
+    """One measurement window in the ledger."""
+
+    metrics: dict[str, Any]
+    window: str = ""                 # "YYYY-MM-DD HH:MM" (UTC)
+    round: int | None = None         # driver round number; None = live run
+    source: str = ""                 # producing file / program
+    fingerprint: dict[str, Any] = dataclasses.field(default_factory=dict)
+    quarantined: str | None = None   # reason to exclude from gate bands
+    gate: dict[str, Any] | None = None  # verdict recorded at bench time
+    schema: int = SCHEMA
+
+    def value(self, key: str) -> float | None:
+        """Numeric value for a rung key; None when absent or refused
+        ('unreliable this window' strings stay strings — the bench
+        refused the number, the ledger must not resurrect it)."""
+        v = self.metrics.get(key)
+        return float(v) if isinstance(v, (int, float)) else None
+
+    def window_spread_rel(self) -> float | None:
+        """Relative same-window swing evidence (p95/min − 1, median over
+        the bench's interleaved lanes) when this record carries the
+        ``window_spread`` block — the noise the gate's band must cover."""
+        ws = self.metrics.get("window_spread")
+        if not isinstance(ws, dict):
+            return None
+        rels = []
+        for lane in ws.values():
+            if (isinstance(lane, dict) and lane.get("min_ms")
+                    and lane.get("p95_ms")):
+                rels.append(lane["p95_ms"] / lane["min_ms"] - 1.0)
+        if not rels:
+            return None
+        rels.sort()
+        return rels[len(rels) // 2]
+
+    def to_json(self) -> dict[str, Any]:
+        d = dataclasses.asdict(self)
+        return {k: v for k, v in d.items()
+                if v is not None or k in ("round",)}
+
+    @classmethod
+    def from_json(cls, obj: dict[str, Any]) -> "Record":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in obj.items() if k in fields})
+
+
+def default_history_path() -> str:
+    """``TDTPU_BENCH_HISTORY`` env override, else the committed repo-root
+    ledger (this file lives at <root>/triton_distributed_tpu/obs/)."""
+    return (os.environ.get("TDTPU_BENCH_HISTORY")
+            or os.path.join(_REPO_ROOT, "BENCH_HISTORY.jsonl"))
+
+
+def _window_from_tail(tail: str) -> str:
+    m = re.search(r"(\d{4}-\d{2}-\d{2} \d{2}:\d{2})", tail or "")
+    return m.group(1) if m else ""
+
+
+def parse_bench_round_file(path: str) -> Record:
+    """One driver ``BENCH_rNN.json`` (cmd/rc/tail + parsed result) → a
+    ledger record, window-stamped from the run log's timestamp."""
+    with open(path) as f:
+        data = json.load(f)
+    name = os.path.basename(path)
+    m = re.fullmatch(r"BENCH_r(\d+)\.json", name)
+    rnd = int(m.group(1)) if m else data.get("n")
+    parsed = data.get("parsed") or {}
+    tail = data.get("tail", "")
+    plat = re.search(r"Platform '(\w+)'", tail)
+    quarantine = None
+    v = parsed.get("value")
+    if (parsed.get("unit") == "TFLOP/s" and isinstance(v, (int, float))
+            and v > PEAK_TFLOPS_CEILING):
+        quarantine = (f"implied {v:g} TFLOP/s exceeds any real chip — "
+                      "elided/clamped measurement (the round-1 failure "
+                      "mode bench.py now hard-fails on)")
+    return Record(metrics=parsed, window=_window_from_tail(tail),
+                  round=rnd, source=name,
+                  fingerprint={"backfilled": True,
+                               **({"platform": plat.group(1)} if plat
+                                  else {})},
+                  quarantined=quarantine)
+
+
+def record_from_result(result: dict[str, Any], *,
+                       source: str = "bench.py") -> Record:
+    """A live bench result dict → a ledger record stamped with the
+    current window and this process's jax/device fingerprint."""
+    fp: dict[str, Any] = {}
+    try:
+        import jax
+
+        fp["jax"] = jax.__version__
+        fp["backend"] = jax.default_backend()
+        fp["device"] = str(jax.devices()[0])
+    except Exception:  # fingerprint is evidence, never a failure
+        pass
+    window = time.strftime("%Y-%m-%d %H:%M", time.gmtime())
+    return Record(metrics=dict(result), window=window, round=None,
+                  source=source, fingerprint=fp)
+
+
+def load_jsonl(path: str) -> list[Record]:
+    records: list[Record] = []
+    if not os.path.exists(path):
+        return records
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(Record.from_json(json.loads(line)))
+    return records
+
+
+def bench_round_files(root: str | None = None) -> list[str]:
+    root = root or _REPO_ROOT
+    return sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+
+
+def load_history(path: str | None = None, *,
+                 root: str | None = None) -> list[Record]:
+    """The full trajectory: committed JSONL records plus an auto-backfill
+    of any driver ``BENCH_rNN.json`` round the JSONL doesn't carry yet —
+    drift between ledger and driver files is structurally impossible.
+    The driver files are scanned from the ledger's own directory (they
+    sit side by side in the repo root; a tmp-dir ledger stays isolated).
+    Sorted: numbered rounds first (ascending), then live records by
+    window stamp."""
+    path = path or default_history_path()
+    if root is None:
+        root = os.path.dirname(os.path.abspath(path)) or "."
+    records = load_jsonl(path)
+    have_rounds = {r.round for r in records if r.round is not None}
+    for p in bench_round_files(root):
+        m = re.fullmatch(r"BENCH_r(\d+)\.json", os.path.basename(p))
+        if m and int(m.group(1)) not in have_rounds:
+            records.append(parse_bench_round_file(p))
+    records.sort(key=lambda r: (r.round is None,
+                                r.round if r.round is not None else 0,
+                                r.window))
+    return records
+
+
+def append(record: Record, path: str | None = None) -> str:
+    path = path or default_history_path()
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(record.to_json(), sort_keys=True) + "\n")
+    return path
+
+
+def backfill(path: str | None = None, *, root: str | None = None) -> int:
+    """Append records for every driver round file not yet in the ledger
+    (idempotent); returns the number appended."""
+    path = path or default_history_path()
+    if root is None:
+        root = os.path.dirname(os.path.abspath(path)) or "."
+    have = {r.round for r in load_jsonl(path) if r.round is not None}
+    n = 0
+    for p in bench_round_files(root):
+        rec = parse_bench_round_file(p)
+        if rec.round not in have:
+            append(rec, path)
+            n += 1
+    return n
+
+
+def trajectory(records: Iterable[Record], key: str, *,
+               include_quarantined: bool = False) -> list[float]:
+    """Numeric values of one rung across records (ledger order)."""
+    return [v for r in records
+            if (include_quarantined or not r.quarantined)
+            and (v := r.value(key)) is not None]
+
+
+def format_table(records: list[Record]) -> str:
+    head = ["metric"] + [f"r{r.round}" if r.round is not None
+                         else (r.window or "live") for r in records]
+    lines = ["  ".join(f"{h:>12s}" for h in head)]
+    for spec in METRICS:
+        row = [spec.key[:36]]
+        for r in records:
+            v = r.value(spec.key)
+            row.append("—" if v is None else f"{v:g}")
+        lines.append("  ".join(f"{c:>12s}" for c in row))
+    quar = [f"r{r.round}" for r in records if r.quarantined]
+    if quar:
+        lines.append(f"quarantined from gate bands: {', '.join(quar)}")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m triton_distributed_tpu.obs.history",
+        description="Window-stamped bench-history ledger "
+                    "(docs/observability.md, Regression gates & SLOs).")
+    ap.add_argument("--path", default=None,
+                    help="ledger path (default BENCH_HISTORY.jsonl / "
+                         "$TDTPU_BENCH_HISTORY)")
+    ap.add_argument("--backfill", action="store_true",
+                    help="append records for driver BENCH_rNN.json rounds "
+                         "missing from the ledger")
+    ap.add_argument("--show", action="store_true",
+                    help="print the trajectory table")
+    args = ap.parse_args(argv)
+    if args.backfill:
+        n = backfill(args.path)
+        print(f"backfilled {n} round(s) into "
+              f"{args.path or default_history_path()}")
+    if args.show or not args.backfill:
+        print(format_table(load_history(args.path)))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

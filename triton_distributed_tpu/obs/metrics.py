@@ -246,13 +246,17 @@ class Registry:
             metrics = dict(sorted(self._metrics.items()))
         return {name: m.snapshot() for name, m in metrics.items()}
 
-    def save(self, run_dir: str) -> str:
+    def save(self, run_dir: str,
+             extra: dict[str, Any] | None = None) -> str:
         """Write ``metrics.json`` + ``metrics.prom`` into ``run_dir``;
-        returns the JSON path (the one CI asserts on)."""
+        returns the JSON path (the one CI asserts on). ``extra`` merges
+        additional top-level sections into the JSON — consumers must
+        treat keys whose value has no ``type`` field as sections, not
+        series (today: the ``slo`` section obs.finish_run embeds)."""
         os.makedirs(run_dir, exist_ok=True)
         path = os.path.join(run_dir, "metrics.json")
         with open(path, "w") as f:
-            json.dump(self.snapshot(), f, indent=2)
+            json.dump({**self.snapshot(), **(extra or {})}, f, indent=2)
         with open(os.path.join(run_dir, "metrics.prom"), "w") as f:
             f.write(self.to_prometheus())
         return path

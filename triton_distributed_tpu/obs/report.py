@@ -269,7 +269,8 @@ def load_metrics(run_dir: str) -> dict[str, Any] | None:
 
 
 def summarize(run_dir: str, lanes: dict, metrics: dict | None,
-              cl_metrics: dict[str, float]) -> str:
+              cl_metrics: dict[str, float],
+              slo: dict | None = None) -> str:
     lines = [f"# obs report — {run_dir}", ""]
     lines.append("lanes: " + ", ".join(
         f"{k}={'yes' if v else 'no'}" for k, v in lanes.items()
@@ -306,6 +307,16 @@ def summarize(run_dir: str, lanes: dict, metrics: dict | None,
                     f"p50={fmt(p50)} p95={fmt(p95)} p99={fmt(p99)}")
             else:
                 lines.append(f"  {name} = {m['value']:g}")
+    if slo:
+        lines.append("")
+        lines.append(f"slo ({slo['violations']} violation(s)):")
+        for r in slo["rules"]:
+            obs_v = r["observed"]
+            thr = r["threshold"]
+            fmt = lambda x: f"{x:.3f}" if isinstance(x, (int, float)) \
+                else "—"  # noqa: E731
+            lines.append(f"  {r['rule']:28s} observed={fmt(obs_v)} "
+                         f"threshold={fmt(thr)}  {r['status']}")
     return "\n".join(lines)
 
 
@@ -415,6 +426,8 @@ def main(argv: list[str] | None = None) -> int:
                     help="first produce a CPU dryrun into RUN_DIR "
                          "(tiny traced Engine serve + commlint replay + "
                          "profiled megakernel step)")
+    ap.add_argument("--allow-slo-violations", action="store_true",
+                    help="report SLO violations without failing --check")
     args = ap.parse_args(argv)
 
     if args.dryrun:
@@ -436,7 +449,24 @@ def main(argv: list[str] | None = None) -> int:
 
     metrics = load_metrics(args.run_dir)
     cl_metrics = commlint_metrics(args.run_dir)
-    print(summarize(args.run_dir, lanes, metrics, cl_metrics))
+    # The slo section: written by obs.finish_run into metrics.json; for
+    # run dirs from before the watchdog (or bare snapshots), synthesize
+    # it from the saved series so --check can still watchdog the dir.
+    slo_section = None
+    if metrics is not None:
+        from triton_distributed_tpu.obs import slo as slo_mod
+
+        slo_section = metrics.pop("slo", None)
+        if slo_section is None:
+            # Same stall semantics as the live watchdog / finish_run:
+            # newest measured profile by mtime — a recovered stall must
+            # not fail --check here while passing the watchdog.
+            observed = slo_mod.observed_from_snapshot(metrics)
+            observed["stall_fraction_ceiling"] = (
+                slo_mod.stall_fraction_for_run_dir(args.run_dir))
+            slo_section = slo_mod.evaluate(observed,
+                                           slo_mod.SLOConfig.from_env())
+    print(summarize(args.run_dir, lanes, metrics, cl_metrics, slo_section))
     print(f"\nmerged trace: {out_path} "
           f"({len(trace['traceEvents'])} events) — load at "
           "https://ui.perfetto.dev")
@@ -460,6 +490,13 @@ def main(argv: list[str] | None = None) -> int:
             for s in series:
                 if s not in metrics:
                     failures.append(f"required series missing: {s}")
+    if (slo_section and slo_section.get("violations")
+            and not args.allow_slo_violations):
+        for r in slo_section["rules"]:
+            if r["status"] == "violation":
+                failures.append(
+                    f"SLO violation: {r['rule']} observed "
+                    f"{r['observed']:g} vs threshold {r['threshold']:g}")
     if failures:
         for msg in failures:
             print(f"CHECK FAIL: {msg}", file=sys.stderr)

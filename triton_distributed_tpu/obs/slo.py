@@ -1,0 +1,210 @@
+"""Live SLO watchdog over the serving metrics registry.
+
+Three serving objectives, evaluated from the same series the metrics
+registry already records (obs/metrics.py) — no new instrumentation on the
+hot path:
+
+* **tokens/s floor** — the ``tdtpu_serve_tokens_per_s`` gauge;
+* **decode-step p99 ceiling** — the ``tdtpu_decode_step_latency_ms``
+  histogram's reservoir p99;
+* **stall-fraction ceiling** — the megakernel timeline's
+  ``unattributed/stall`` slice: ``(measured_step − Σ task time) /
+  measured_step`` from the newest kernel profile that carries a measured
+  step (obs/kernel_profile.py).
+
+Thresholds come from :class:`SLOConfig` (env: ``TDTPU_SLO_TOKENS_S_MIN``,
+``TDTPU_SLO_STEP_P99_MS_MAX``, ``TDTPU_SLO_STALL_FRAC_MAX``).  An unset
+threshold means *observed, not enforced* — the rule still reports what it
+saw, so every metrics snapshot carries an ``slo`` section whether or not
+anyone configured limits.
+
+``Engine.serve`` calls :func:`check_serving` after each call under an
+active obs run: violations become ``slo.violation`` spans in the trace
+plus ``tdtpu_slo_violations_total`` (+ per-rule) counters, and
+``obs.finish_run`` embeds the final section into ``metrics.json`` where
+``obs.report --check`` fails on any violation (docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import os
+from typing import Any
+
+from triton_distributed_tpu.obs import metrics as obs_metrics
+from triton_distributed_tpu.obs import trace as obs_trace
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOConfig:
+    tokens_per_s_min: float | None = None
+    step_p99_ms_max: float | None = None
+    stall_fraction_max: float | None = None
+
+    @classmethod
+    def from_env(cls) -> "SLOConfig":
+        def f(var: str) -> float | None:
+            v = os.environ.get(var)
+            if v in (None, ""):
+                return None
+            try:
+                return float(v)
+            except ValueError:
+                # A typo'd threshold must not crash the serve it watches
+                # (the watchdog runs inside Engine.serve): warn, treat as
+                # unset — the rule degrades to observed-only.
+                import warnings
+
+                warnings.warn(f"{var}={v!r} is not a number — SLO rule "
+                              "disabled (observed-only)", RuntimeWarning,
+                              stacklevel=3)
+                return None
+
+        return cls(tokens_per_s_min=f("TDTPU_SLO_TOKENS_S_MIN"),
+                   step_p99_ms_max=f("TDTPU_SLO_STEP_P99_MS_MAX"),
+                   stall_fraction_max=f("TDTPU_SLO_STALL_FRAC_MAX"))
+
+    def to_json(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+# (rule name, config field, direction) — direction 'min' = observed must
+# stay ABOVE the threshold, 'max' = below.
+_RULES = (
+    ("tokens_per_s_floor", "tokens_per_s_min", "min"),
+    ("step_latency_p99_ceiling", "step_p99_ms_max", "max"),
+    ("stall_fraction_ceiling", "stall_fraction_max", "max"),
+)
+
+
+def stall_fraction_from_summaries(summaries: list[dict]) -> float | None:
+    """Worst unattributed/stall share across kernel-profile summaries
+    that carry a measured step (None when nothing measured)."""
+    fracs = []
+    for s in summaries or []:
+        meas = s.get("measured_step_s")
+        if meas:
+            task = s.get("task_sum_s") or 0.0
+            fracs.append(max(0.0, meas - task) / meas)
+    return max(fracs) if fracs else None
+
+
+# (path -> (mtime, summary)) parse cache: check_serving runs per serve()
+# and a profiled megakernel engine adds one profile file per serve, so
+# re-parsing every prior file would be O(n^2) JSON I/O over a session.
+_PROFILE_CACHE: dict[str, tuple[float, dict]] = {}
+
+
+def stall_fraction_for_run_dir(run_dir: str | None) -> float | None:
+    """Stall fraction of the NEWEST measured kernel profile in the run
+    dir (by mtime) — the live watchdog judges the serve that just
+    happened, not the worst window the session ever saw (a recovered
+    stall must stop violating once a clean profile lands)."""
+    if not run_dir:
+        return None
+    newest: tuple[float, dict] | None = None
+    for p in glob.glob(os.path.join(run_dir, "**",
+                                    "*.kernel_profile.json"),
+                       recursive=True):
+        try:
+            mtime = os.path.getmtime(p)
+            cached = _PROFILE_CACHE.get(p)
+            if cached is not None and cached[0] == mtime:
+                s = cached[1]
+            else:
+                with open(p) as f:
+                    data = json.load(f)
+                s = data.get("summary") or {}
+                s.setdefault("measured_step_s",
+                             data.get("measured_step_s"))
+                _PROFILE_CACHE[p] = (mtime, s)
+        except Exception:
+            # A malformed profile file (wrong top-level type, missing
+            # keys) is evidence lost, not a reason to break the serve
+            # or finish_run that asked for the stall fraction.
+            continue
+        if s.get("measured_step_s") and (newest is None
+                                         or mtime > newest[0]):
+            newest = (mtime, s)
+    return (stall_fraction_from_summaries([newest[1]])
+            if newest else None)
+
+
+def observed_from_registry(reg: obs_metrics.Registry,
+                           run_dir: str | None = None
+                           ) -> dict[str, float | None]:
+    """The three observed values from a live registry (+ optional run dir
+    for kernel-profile stall evidence)."""
+    g = reg.get("tdtpu_serve_tokens_per_s")
+    h = reg.get("tdtpu_decode_step_latency_ms")
+    return {
+        "tokens_per_s_floor": g.value if g is not None else None,
+        "step_latency_p99_ceiling":
+            h.quantile(99) if h is not None and h.count else None,
+        "stall_fraction_ceiling": stall_fraction_for_run_dir(run_dir),
+    }
+
+
+def observed_from_snapshot(snapshot: dict[str, Any],
+                           kernel_summaries: list[dict] | None = None
+                           ) -> dict[str, float | None]:
+    """Same values from a saved ``metrics.json`` snapshot — what
+    ``obs.report`` uses to watchdog an already-written run directory."""
+    g = snapshot.get("tdtpu_serve_tokens_per_s") or {}
+    h = snapshot.get("tdtpu_decode_step_latency_ms") or {}
+    return {
+        "tokens_per_s_floor": g.get("value"),
+        "step_latency_p99_ceiling": h.get("p99"),
+        "stall_fraction_ceiling":
+            stall_fraction_from_summaries(kernel_summaries or []),
+    }
+
+
+def evaluate(observed: dict[str, float | None],
+             cfg: SLOConfig) -> dict[str, Any]:
+    """The ``slo`` section: per-rule observed/threshold/status plus a
+    violation count. Statuses: ``ok`` / ``violation`` (threshold set),
+    ``observed`` (no threshold), ``no-data`` (series absent)."""
+    rules = []
+    violations = 0
+    for name, field, direction in _RULES:
+        thr = getattr(cfg, field)
+        obs_v = observed.get(name)
+        if obs_v is None:
+            status = "no-data"
+        elif thr is None:
+            status = "observed"
+        else:
+            bad = obs_v < thr if direction == "min" else obs_v > thr
+            status = "violation" if bad else "ok"
+            violations += bad
+        rules.append({"rule": name, "direction": direction,
+                      "observed": obs_v, "threshold": thr,
+                      "status": status})
+    return {"config": cfg.to_json(), "rules": rules,
+            "violations": violations}
+
+
+def check_serving(reg: obs_metrics.Registry | None = None,
+                  run_dir: str | None = None,
+                  cfg: SLOConfig | None = None) -> dict[str, Any]:
+    """The live watchdog step (Engine.serve calls this after each serve
+    under an active run): evaluate, emit one ``slo.violation`` span per
+    violated rule into the host trace, and bump the violation counters."""
+    reg = reg or obs_metrics.registry()
+    cfg = cfg or SLOConfig.from_env()
+    section = evaluate(observed_from_registry(reg, run_dir), cfg)
+    for rule in section["rules"]:
+        if rule["status"] != "violation":
+            continue
+        with obs_trace.span("slo.violation", rule=rule["rule"],
+                            observed=rule["observed"],
+                            threshold=rule["threshold"]):
+            pass
+        reg.counter("tdtpu_slo_violations_total",
+                    "SLO rule violations observed by the watchdog").inc()
+        reg.counter(f"tdtpu_slo_violation_{rule['rule']}_total",
+                    "violations of this SLO rule").inc()
+    return section

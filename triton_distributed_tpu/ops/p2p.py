@@ -40,13 +40,19 @@ def _p2p_shift_kernel(n: int, axis: str, shift: int, x_ref, out_ref,
 
 
 def p2p_shift_local(x_local: jax.Array, shift: int = 1, axis: str = "tp",
-                    num_ranks: int | None = None) -> jax.Array:
+                    num_ranks: int | None = None,
+                    force_kernel: bool = False) -> jax.Array:
     """Device-local ring shift: out on device (d+shift)%n = x from device d.
-    The PP stage-boundary transport (activations flow stage d → d+1)."""
+    The PP stage-boundary transport (activations flow stage d → d+1).
+
+    ``force_kernel``: compile the Pallas kernel even at n=1 (self-push
+    loopback) — the on-chip compile gate for this family
+    (scripts/check_on_chip.py), same idiom as ag_gemm / the parity
+    streams."""
     if num_ranks is None:
         raise ValueError("num_ranks required inside shard_map")
     n = num_ranks
-    if n == 1:
+    if n == 1 and not force_kernel:
         return x_local
     kernel = functools.partial(_p2p_shift_kernel, n, axis, shift)
     return kernel_call(
@@ -146,7 +152,8 @@ def _p2p_permute_kernel(n: int, axis: str, perm: tuple, tile_m: int,
 
 
 def p2p_permute_local(x_local: jax.Array, perm, axis: str = "tp",
-                      num_ranks: int | None = None) -> jax.Array:
+                      num_ranks: int | None = None,
+                      force_kernel: bool = False) -> jax.Array:
     """Device-local arbitrary-pair exchange inside shard_map.
 
     ``perm``: static sequence of (src, dst) rank pairs — any pairs, not
@@ -154,6 +161,10 @@ def p2p_permute_local(x_local: jax.Array, perm, axis: str = "tp",
     several dsts). Each dst appears at most once. Devices that receive
     nothing get zeros (``jax.lax.ppermute`` semantics). A perm that is a
     full uniform ring shift dispatches the single-semaphore shift kernel.
+
+    ``force_kernel``: compile the per-pair-semaphore kernel even at n=1
+    (self-push loopback — the on-chip gate; at n=1 the ring fast path is
+    suppressed so THIS kernel's structure is what compiles).
     """
     if num_ranks is None:
         raise ValueError("num_ranks required inside shard_map")
@@ -165,14 +176,17 @@ def p2p_permute_local(x_local: jax.Array, perm, axis: str = "tp",
     for s, d in perm:
         if not (0 <= s < n and 0 <= d < n):
             raise ValueError(f"pair ({s}, {d}) outside 0..{n - 1}")
-    if n == 1:
+    if n == 1 and not force_kernel:
         # Same ppermute semantics as n>1: zeros unless the (0, 0)
         # self-pair is present.
         return x_local if (0, 0) in perm else jnp.zeros_like(x_local)
     shift = _as_shift(perm, n)
-    if shift is not None:
+    # At n=1 every non-empty perm is the full ring ((0,0)); the forced
+    # gate must still compile THIS kernel's per-pair semaphore structure,
+    # not fall through to the shift kernel (which has its own gate).
+    if shift is not None and not (force_kernel and n == 1):
         return p2p_shift_local(x_local, shift=shift, axis=axis,
-                               num_ranks=n)
+                               num_ranks=n, force_kernel=force_kernel)
     from triton_distributed_tpu.ops.tiling import pick_tile, sublane_align
 
     m, cols = x_local.shape
