@@ -132,7 +132,8 @@ def floor_flash32k_ms() -> float:
 def floor_megakernel_vs_jit() -> float:
     """Full-model megakernel decode step vs the jitted bare-shard ladder
     (bench.py's own rungs — same fail-loud chains) must stay under
-    ON_CHIP_FLOORS['megakernel_vs_jit_max'] (ledger r5: 6.421/4.056 =
+    ON_CHIP_FLOORS['megakernel_vs_jit_max'] (tightened 2.0 -> 1.5 in
+    round 6 with the cross-layer fused assembly; r5 pre-fusion measured
     1.58x). Slow: compiles two 36-layer programs."""
     import bench
     from triton_distributed_tpu.obs.gate import ON_CHIP_FLOORS
@@ -624,6 +625,72 @@ def main() -> int:
         return res
 
     check("megakernel MoE decode (topk + expert-skip FFN)", mega_moe)
+
+    # Forced in-kernel AR at n=1 (the round-6 cross-device rung's pricing
+    # mode): ALLREDUCE_ROW runs the full loopback protocol — remote
+    # self-push, delivery wait, slab reduce — the one new Mosaic surface
+    # of the rung. Token-identical to the AR-free program (AR of 1 rank
+    # is identity).
+    def mega_forced_ar():
+        from triton_distributed_tpu.megakernel.models import (
+            build_decode_step, rope_tables,
+        )
+
+        hidden, hq, hkv, ffn, S, pos = 256, 2, 1, 256, 256, 100
+        rng2 = np.random.default_rng(7)
+
+        def build(force):
+            prog = build_decode_step(
+                hidden=hidden, hq_local=hq, hkv_local=hkv, ffn_local=ffn,
+                num_layers=1, max_seq=S, pos=pos, num_ranks=1,
+                force_ar_tasks=force)
+            comp = prog.mb.compile(dtype=jnp.bfloat16, force_ar=force)
+            h = prog.layers[0]
+            cos, sin = rope_tables(pos, TILE, 1e6)
+            feeds = {prog.x: rng2.standard_normal((TILE, hidden)) * 0.3,
+                     prog.cos: cos, prog.sin: sin,
+                     h.attn_norm: broadcast_rows(np.ones(hidden, np.float32)),
+                     h.mlp_norm: broadcast_rows(np.ones(hidden, np.float32)),
+                     h.q_norm: broadcast_rows(np.ones(TILE, np.float32)),
+                     h.k_norm: broadcast_rows(np.ones(TILE, np.float32))}
+            feed_layer_weights(
+                feeds, h,
+                wq=rng2.standard_normal((hidden, hq * TILE)) * 0.05,
+                wk=rng2.standard_normal((hidden, hkv * TILE)) * 0.05,
+                wv=rng2.standard_normal((hidden, hkv * TILE)) * 0.05,
+                wo=rng2.standard_normal((hq * TILE, hidden)) * 0.05,
+                w_gate=rng2.standard_normal((hidden, ffn)) * 0.05,
+                w_up=rng2.standard_normal((hidden, ffn)) * 0.05,
+                w_down=rng2.standard_normal((ffn, hidden)) * 0.05)
+            for tk, tv in zip(h.kT, h.v):
+                feeds[tk] = rng2.standard_normal((TILE, S)) * 0.3
+                feeds[tv] = rng2.standard_normal((S, TILE)) * 0.3
+            feeds = {kk_: (tuple(jnp.asarray(np.asarray(x_, np.float32))
+                                 for x_ in vv_) if isinstance(vv_, tuple)
+                           else jnp.asarray(np.asarray(vv_, np.float32)))
+                     for kk_, vv_ in feeds.items()}
+            return prog, comp, feeds
+
+        rng2 = np.random.default_rng(7)
+        prog_a, comp_a, feeds_a = build(False)
+        base = np.asarray(comp_a.run(feeds_a, outputs=[prog_a.x_out])[0],
+                          np.float32)
+        rng2 = np.random.default_rng(7)
+        prog_b, comp_b, feeds_b = build(True)
+
+        def run_forced(*vals):
+            keys = list(feeds_b.keys())
+            feeds = {k_: v_ for k_, v_ in zip(keys, vals)}
+            return comp_b.run(feeds, outputs=[prog_b.x_out])[0]
+
+        vals = list(feeds_b.values())
+        out = shard_map_on(ctx, run_forced,
+                           tuple(_P() for _ in vals), _P())(*vals)
+        np.testing.assert_allclose(np.asarray(out, np.float32), base,
+                                   rtol=5e-2, atol=5e-2)
+        return out
+
+    check("megakernel forced in-kernel AR (n=1 loopback)", mega_forced_ar)
 
     if os.environ.get("TDTPU_SKIP_FLOORS"):
         print("\nperf floors skipped (TDTPU_SKIP_FLOORS)")

@@ -17,6 +17,13 @@ measured layer step, so the attribution can be checked for completeness.
     python scripts/mk_profile.py --json costs.json   # measured per-type
         # costs in the obs.kernel_profile.attach_durations(measured=...)
         # form — feed them to KernelProfile for measured (not est:) lanes
+    python scripts/mk_profile.py --full-model [--json out.json]
+        # round-6 FULL-MODEL attribution: build the whole num_layers
+        # decode queue (the bench rung's program), decode its per-task
+        # composition, attach measured/estimated per-type costs, and
+        # account the measured step into per-class lanes + the host
+        # embed/logits slice + the unattributed/stall residual — where
+        # the extra milliseconds beyond layer-scale live.
 """
 
 import functools
@@ -108,6 +115,147 @@ def build_case(name, emit, L, feeds_fn, dtype):
     return compiled, ws, wsm
 
 
+def _full_model_program(dtype):
+    """The bench rung's full-model program (TPU: bench.py's OWN builder,
+    so the attribution measures exactly the program the rung ships) or
+    the CPU-smoke miniature — returns (prog, comp, ws, wsm, embed,
+    shapes); ``embed`` is None off-TPU (the smoke path never times the
+    whole-model chain)."""
+    from triton_distributed_tpu.megakernel.models import (
+        broadcast_rows, build_decode_step, feed_layer_weights, rope_tables,
+    )
+
+    if ON_TPU:
+        import bench
+
+        prog, comp, ws, wsm, embed, hidden = bench._build_mega_program()
+        return prog, comp, ws, wsm, embed, (hidden, 4, 1, 1536, 36, 512)
+    hidden, hq, hkv, ffn, L, S, pos = 256, 2, 1, 256, 2, 256, 100
+    d = TILE
+    rng = np.random.default_rng(0)
+    prog = build_decode_step(hidden=hidden, hq_local=hq, hkv_local=hkv,
+                             ffn_local=ffn, num_layers=L, max_seq=S,
+                             pos=pos, num_ranks=1, final_norm=True)
+    comp = prog.mb.compile(dtype=dtype)
+    cos, sin = rope_tables(pos, d, 1e6)
+    feeds = {prog.cos: cos, prog.sin: sin,
+             prog.x: rng.standard_normal((TILE, hidden)).astype(np.float32)
+             * 0.05,
+             prog.fnorm: broadcast_rows(np.ones(hidden, np.float32))}
+    for h in prog.layers:
+        for nh, width in ((h.attn_norm, hidden), (h.mlp_norm, hidden),
+                          (h.q_norm, d), (h.k_norm, d)):
+            feeds[nh] = broadcast_rows(
+                rng.standard_normal(width).astype(np.float32) * .1 + 1)
+        feed_layer_weights(
+            feeds, h,
+            wq=rng.standard_normal((hidden, hq * d)).astype(np.float32) * .02,
+            wk=rng.standard_normal((hidden, hkv * d)).astype(np.float32) * .02,
+            wv=rng.standard_normal((hidden, hkv * d)).astype(np.float32) * .02,
+            wo=rng.standard_normal((hq * d, hidden)).astype(np.float32) * .02,
+            w_gate=rng.standard_normal((hidden, ffn)).astype(np.float32) * .02,
+            w_up=rng.standard_normal((hidden, ffn)).astype(np.float32) * .02,
+            w_down=rng.standard_normal((ffn, hidden)).astype(np.float32) * .02)
+        for tk, tv in zip(h.kT, h.v):
+            feeds[tk] = rng.standard_normal((d, S)).astype(np.float32) * .3
+            feeds[tv] = rng.standard_normal((S, d)).astype(np.float32) * .3
+    main_f, _w8, mat_f = comp.split_feeds(feeds)
+    ws = comp.make_workspace(
+        {k: jnp.asarray(v) for k, v in main_f.items()})
+    wsm = comp.make_workspace_mat(mat_f)
+    return prog, comp, ws, wsm, None, (hidden, hq, hkv, ffn, L, S)
+
+
+def full_model_main(json_out, measured=None):
+    """Round-6 full-model attribution: per-task accounting of the whole
+    num_layers decode queue — where the extra milliseconds beyond
+    layer-scale live (ISSUE 5 tentpole step 1)."""
+    import collections
+    import json
+
+    from triton_distributed_tpu.obs.kernel_profile import (
+        KernelProfile, attach_durations, decode_records, records_from_queue,
+    )
+
+    dtype = jnp.bfloat16 if ON_TPU else jnp.float32
+    prog, comp, ws0, wsm0, embed, shapes = _full_model_program(dtype)
+    hidden, hq, hkv, ffn, L, S = shapes
+    itemsize = jnp.dtype(dtype).itemsize
+
+    # The queue IS the dispatch plan — composition needs no device run.
+    recs = records_from_queue(comp.queue, comp.num_exec)
+    composition = dict(collections.Counter(r.type_name for r in recs))
+
+    step_s = host_s = None
+    if ON_TPU:
+        # Kernel-only step (differential over replay chains — the only
+        # method that survives the relay's dispatch swing).
+        t = time_replays(comp, ws0, wsm0, (4, 14, 24))
+        r1, r2, r3 = sorted(t)
+        if t[r3] > t[r2] > t[r1]:
+            step_s = (t[r3] - t[r1]) / (r3 - r1)
+        # Whole-model step (embed + in-kernel final norm + logits argmax):
+        # host_s = whole - kernel-only, the embed/logits lane.
+        whole = _whole_model_seconds(comp, prog, ws0, wsm0, embed, hidden)
+        if whole is not None and step_s is not None:
+            host_s = max(whole - step_s, 0.0)
+    else:
+        # CPU smoke: one profiled interpret-mode step — the stamped dump
+        # must agree with the queue-derived plan (the attribution's own
+        # regression check, also gated by tests/test_megakernel_decode).
+        ws, prof = comp.step(ws0, wsm=wsm0, profile=True)
+        jax.block_until_ready(ws)
+        stamped = decode_records(np.asarray(prof))
+        assert len(stamped) == len(recs), \
+            f"stamped {len(stamped)} records vs queue {len(recs)}"
+
+    attach_durations(recs, itemsize=itemsize, measured=measured)
+    kp = KernelProfile(records=recs, measured_step_s=step_s,
+                       label="full_model")
+    acct = kp.accounting(host_s=host_s)
+    acct["composition"] = composition
+    acct["shapes"] = {"hidden": hidden, "hq_local": hq, "hkv_local": hkv,
+                      "ffn_local": ffn, "num_layers": L, "max_seq": S,
+                      "dtype": jnp.dtype(dtype).name}
+
+    print(f"# full-model per-task accounting ({L} layers, "
+          f"{acct['n_tasks']} tasks, "
+          f"{'TPU' if ON_TPU else 'CPU smoke — est: lanes'})")
+    for cls, d_ in sorted(acct["classes"].items()):
+        print(f"{cls:16} {d_['tasks']:5d} tasks  "
+              f"{d_['seconds'] * 1e3:9.3f} ms  [{d_['duration_kind']}]")
+    print(f"{'task sum':16} {'':5s}        {acct['task_sum_s'] * 1e3:9.3f} ms")
+    if step_s is not None:
+        print(f"{'measured step':16} {'':5s}        {step_s * 1e3:9.3f} ms  "
+              f"(unattributed/stall "
+              f"{acct.get('unattributed_stall_s', 0) * 1e3:.3f} ms)")
+    if host_s is not None:
+        print(f"{'host embed/logits':16} {'':4s}        "
+              f"{host_s * 1e3:9.3f} ms")
+    assert acct["unclassified"] == 0, \
+        "full-model queue contains unclassified task types"
+    if json_out is not None:
+        with open(json_out, "w") as f:
+            json.dump({"full_model": acct,
+                       "per_type_seconds": dict(measured or {})}, f,
+                      indent=2, default=str)
+        print(f"wrote {json_out}")
+
+
+def _whole_model_seconds(comp, prog, ws0, wsm0, embed, hidden,
+                         gen=(4, 14, 24)):
+    """Differential seconds/step of the whole-model chain (embed lookup +
+    kernel step + logits argmax) — bench.py's OWN harness
+    (_mega_chain_times / _mega_per_step_ms), so the attribution times
+    exactly the chain the rung ships, at profile-sized chain lengths."""
+    import bench
+
+    best = bench._mega_chain_times(prog, comp, ws0, wsm0, embed, hidden,
+                                   gen)
+    out = bench._mega_per_step_ms(best, gen, "s")
+    return out["s"] / 1e3 if isinstance(out["s"], float) else None
+
+
 def main():
     # Parse --json BEFORE measuring: a malformed invocation must fail in
     # milliseconds, not after minutes of on-chip profiling.
@@ -115,8 +263,22 @@ def main():
     if "--json" in sys.argv:
         i = sys.argv.index("--json")
         if i + 1 >= len(sys.argv) or sys.argv[i + 1].startswith("-"):
-            sys.exit("usage: mk_profile.py [--json OUT_PATH]")
+            sys.exit("usage: mk_profile.py [--full-model] [--json OUT_PATH]")
         json_out = sys.argv[i + 1]
+    measured = None
+    if "--costs" in sys.argv:
+        # Per-type costs from a prior `--json costs.json` run: the
+        # full-model accounting then renders measured (not est:) lanes.
+        import json as _json
+
+        i = sys.argv.index("--costs")
+        if i + 1 >= len(sys.argv) or sys.argv[i + 1].startswith("-"):
+            sys.exit("usage: mk_profile.py [--full-model] [--costs IN] "
+                     "[--json OUT]")
+        with open(sys.argv[i + 1]) as f:
+            measured = _json.load(f).get("per_type_seconds") or None
+    if "--full-model" in sys.argv:
+        return full_model_main(json_out, measured=measured)
     if ON_TPU:
         hidden, hq, hkv, ffn, S = 4096, 4, 1, 1536, 1024
         # Post-rework tasks run ~3-20 us: the differential needs tens of
@@ -141,7 +303,9 @@ def main():
     _CASE_TYPE = {
         "qkv_mat": "GEMM_MAT", "gateup_mat": "GEMM_MAT",
         "down_mat": "GEMM_MAT", "o_mat": "GEMM_MAT",
+        "down_mat3": "GEMM_MAT", "o_mat3": "GEMM_MAT",
         "gemm": "GEMM_WIDE", "rms_norm": "RMS_NORM", "add": "ADD",
+        "add_norm": "ADD_NORM", "norm_rope_qkv": "NORM_ROPE_QKV",
         "silu_mul": "SILU_MUL", "norm_rope": "NORM_ROPE",
         "attn_gqa": "ATTN_DECODE_GQA", "append_kv": "APPEND_KV",
     }
@@ -149,28 +313,39 @@ def main():
     def add_case(name, count_per_layer, lengths, emit, feeds_fn):
         cases.append((name, count_per_layer, lengths, emit, feeds_fn))
 
-    # -- GEMM_MAT at the layer's four shapes (round-5 matrix path) ----------
-    def mat_feeds(k, n, pair=False, resid=False):
+    # -- GEMM_MAT at the layer's four shapes (round-5 matrix path; round 6
+    # adds the epilogue-3 +resid+norm forms the fused assembly dispatches)
+    def mat_feeds(k, n, pair=False, resid=False, norm=False):
         def f(mb):
             h = {"a": mb.tensor(TILE, k),
                  "w": mb.tensor_mat(k, n, pair=pair),
                  "o": mb.tensor(TILE, n)}
             if resid:
                 h["r"] = mb.tensor(TILE, n)
+            if norm:
+                h["nw"] = mb.tensor(TILE, n)
+                h["no"] = mb.tensor(TILE, n)
             return h
         return f
 
     def mat_emit(mb, h):
-        mb.gemm_mat(h["o"], h["a"], h["w"], residual=h.get("r"))
+        mb.gemm_mat(h["o"], h["a"], h["w"], residual=h.get("r"),
+                    norm_w=h.get("nw"), norm_out=h.get("no"))
 
     qkv_n = (hq + 2 * hkv) * d
     add_case(f"qkv_mat fused ({qkv_n} out)", 1,
              lengths_heavy, mat_emit, mat_feeds(hidden, qkv_n))
     add_case(f"gateup_mat pair+silu ({ffn} act)", 1,
              lengths_heavy, mat_emit, mat_feeds(hidden, ffn, pair=True))
-    add_case("down_mat +resid", 1,
+    add_case("down_mat3 +resid+norm (epi3)", 1, lengths_heavy, mat_emit,
+             mat_feeds(ffn, hidden, resid=True, norm=True))
+    add_case("o_mat3 +resid+norm (epi3)", 1, lengths_heavy, mat_emit,
+             mat_feeds(hq * d, hidden, resid=True, norm=True))
+    # Legacy epilogue-2 forms (0/layer in the round-6 fused assembly) for
+    # before/after comparison of the fused-norm epilogue.
+    add_case("down_mat +resid", 0,
              lengths_heavy, mat_emit, mat_feeds(ffn, hidden, resid=True))
-    add_case("o_mat +resid", 1,
+    add_case("o_mat +resid", 0,
              lengths_heavy, mat_emit, mat_feeds(hq * d, hidden, resid=True))
 
     # -- legacy GEMM_WIDE (tile path) for comparison (0/layer in the
@@ -193,28 +368,58 @@ def main():
         return {"a": mb.tensor(TILE, hidden), "b": mb.tensor(TILE, hidden),
                 "o": mb.tensor(TILE, hidden)}
 
-    add_case(f"rms_norm k={ht}", 2, lengths_light,
+    # Round-6 fused assembly: the standalone rms_norm/add pairs are folded
+    # into GEMM_MAT epilogue 3 / ADD_NORM — 0/layer here; counts reflect
+    # the CURRENT n=1 matrix-path decode queue.
+    add_case(f"rms_norm k={ht}", 0, lengths_light,
              lambda mb, h: mb.rms_norm(h["o"], h["a"], h["b"]), row_feeds)
-    add_case(f"add k={ht}", 2, lengths_light,
+    add_case(f"add k={ht}", 0, lengths_light,
              lambda mb, h: mb.add(h["o"], h["a"], h["b"]), row_feeds)
+
+    def an_feeds(mb):
+        return {"a": mb.tensor(TILE, hidden), "b": mb.tensor(TILE, hidden),
+                "w": mb.tensor(TILE, hidden), "o": mb.tensor(TILE, hidden),
+                "on": mb.tensor(TILE, hidden)}
+
+    # 0/layer at n=1 matrix path (epi-3 covers both fusion sites); 2/layer
+    # on the multi-rank path, where an AllReduce sits between GEMM and add.
+    add_case(f"add_norm k={ht}", 0, lengths_light,
+             lambda mb, h: mb.add_norm(h["o"], h["a"], h["b"], h["w"],
+                                       h["on"]), an_feeds)
 
     def ffn_row_feeds(mb):
         return {"a": mb.tensor(TILE, ffn), "b": mb.tensor(TILE, ffn),
                 "o": mb.tensor(TILE, ffn)}
 
-    add_case(f"silu_mul k={ft}", 1, lengths_light,
+    add_case(f"silu_mul k={ft}", 0, lengths_light,
              lambda mb, h: mb.silu_mul(h["o"], h["a"], h["b"]),
              ffn_row_feeds)
 
-    # -- NORM_ROPE (per q+k head) ------------------------------------------
+    # -- NORM_ROPE (per q+k head; 0/layer since the round-6 whole-row
+    # NORM_ROPE_QKV task) ---------------------------------------------------
     def nr_feeds(mb):
         return {"a": mb.tensor(TILE, TILE), "w": mb.tensor(TILE, TILE),
                 "c": mb.tensor(TILE, TILE), "s": mb.tensor(TILE, TILE),
                 "o": mb.tensor(TILE, TILE)}
 
-    add_case("norm_rope", hq + hkv, lengths_light,
+    add_case("norm_rope", 0, lengths_light,
              lambda mb, h: mb.norm_rope(h["o"], h["a"], h["w"], h["c"],
                                         h["s"]), nr_feeds)
+
+    def nrq_feeds(mb):
+        qkv = mb.tensor(TILE, (hq + 2 * hkv) * d)
+        return {"qkv": qkv, "qn": mb.tensor(TILE, TILE),
+                "kn": mb.tensor(TILE, TILE), "c": mb.tensor(TILE, TILE),
+                "s": mb.tensor(TILE, TILE)}
+
+    def nrq_emit(mb, h):
+        from triton_distributed_tpu.megakernel.tasks import TensorHandle
+        q = TensorHandle(h["qkv"].base, TILE, hq * d)
+        k = TensorHandle(h["qkv"].base + hq, TILE, hkv * d)
+        mb.norm_rope_qkv(q, hq, k, hkv, h["qn"], h["kn"], h["c"], h["s"])
+
+    add_case(f"norm_rope_qkv hq={hq} hkv={hkv}", 1, lengths_light,
+             nrq_emit, nrq_feeds)
 
     # -- ATTN_DECODE_GQA over the full cache --------------------------------
     def attn_feeds(mb):
@@ -233,7 +438,9 @@ def main():
         return {"kT": mb.tensor(d, S), "v": mb.tensor(S, d),
                 "kn": mb.tensor(TILE, d), "vn": mb.tensor(TILE, d)}
 
-    add_case("append_kv", hkv, lengths_light,
+    # 0/layer in the bench rung (fixed-pos steady state, host append);
+    # hkv/layer when serving with inkernel_append=True.
+    add_case("append_kv", 0, lengths_light,
              lambda mb, h: mb.append_kv(h["kT"], h["v"], S - 1, h["kn"],
                                         h["vn"]), app_feeds)
 

@@ -1,0 +1,136 @@
+"""fp8 (e4m3) weight serving on the jit decode ladder — round 6.
+
+The `decode_step_ms_fp8` bench rung serves the shard with e4m3
+projection/MLP weights and PURE fp8 dots (models/fp8.fp8_dot — the
+configuration that measured 1.81x bf16 at the weight-streaming m=8
+decode shape). These tests pin the lane's correctness contract:
+token-parity of the fp8 dot path vs the SAME-quantized fp32-emulated
+math (e4m3 products are exactly representable in fp32), and the
+quantizer's scope (projections only — norms/embed/lm_head keep the
+model dtype).
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+import jax.random as jrandom
+
+from triton_distributed_tpu.models.config import ModelConfig
+from triton_distributed_tpu.models.dense import (
+    dense_decode_step, init_dense_llm,
+)
+from triton_distributed_tpu.models.fp8 import (
+    E4M3, fp8_dot, fp8_emulated_dot, quantize_dense_weights,
+)
+from triton_distributed_tpu.models.kv_cache import init_kv_cache
+
+
+def _cfg():
+    return ModelConfig(hidden_size=256, intermediate_size=256,
+                       num_layers=2, num_heads=2, num_kv_heads=1,
+                       head_dim=128, vocab_size=512, qk_norm=True)
+
+
+def test_quantize_scope():
+    cfg = _cfg()
+    params = init_dense_llm(jrandom.PRNGKey(0), cfg)
+    p8 = quantize_dense_weights(params)
+    layer = p8["layers"][0]
+    for k in ("wq", "wk", "wv", "wo"):
+        assert layer["attn"][k].dtype == E4M3
+    for k in ("w_gate", "w_up", "w_down"):
+        assert layer["mlp"][k].dtype == E4M3
+    # Norms, embed and lm_head stay in the model dtype (the fp8 lane
+    # covers the weight-streaming projections, like the megakernel's
+    # fp8 weight workspace).
+    assert p8["embed"].dtype == params["embed"].dtype
+    assert layer["attn_norm"].dtype == params["layers"][0][
+        "attn_norm"].dtype
+    assert layer["attn"]["q_norm"].dtype != E4M3
+
+
+def test_fp8_dot_matches_emulation():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((8, 64)) * 0.3, jnp.bfloat16)
+    w = jnp.asarray(rng.standard_normal((64, 32)) * 0.1, jnp.bfloat16)
+    got = fp8_dot(x, w)
+    ref = fp8_emulated_dot(x, w)
+    assert got.dtype == x.dtype
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=1e-2, atol=1e-2)
+
+
+def test_fp8_decode_token_parity():
+    """The fp8 decode chain must produce the SAME tokens as the fp32
+    emulation of the identical quantized math — the lane's token-parity
+    contract vs the bf16-path-on-quantized-weights golden (VERDICT r5
+    #6)."""
+    cfg = _cfg()
+    params = init_dense_llm(jrandom.PRNGKey(0), cfg)
+    p8 = quantize_dense_weights(params)
+    cache0 = init_kv_cache(cfg, 1, 128)
+    cache0 = cache0._replace(offset=jnp.int32(16))
+
+    def run(dot_fn, steps=6):
+        cache, tok = cache0, jnp.zeros((1,), jnp.int32)
+        toks = []
+        for _ in range(steps):
+            logits, cache = dense_decode_step(p8, cfg, tok, cache,
+                                              num_ranks=1, mode="ar",
+                                              dot_fn=dot_fn)
+            tok = jnp.argmax(logits, -1).astype(jnp.int32)
+            toks.append(int(tok[0]))
+        return toks
+
+    assert run(fp8_dot) == run(fp8_emulated_dot)
+
+
+def test_fp8_decode_differs_from_bf16_only_by_quantization():
+    """Sanity: the fp8 path's logits track the unquantized bf16 path
+    within e4m3 quantization error (no wiring bug silently zeroing a
+    projection)."""
+    cfg = _cfg()
+    params = init_dense_llm(jrandom.PRNGKey(0), cfg)
+    p8 = quantize_dense_weights(params)
+    cache = init_kv_cache(cfg, 1, 128)
+    cache = cache._replace(offset=jnp.int32(16))
+    tok = jnp.zeros((1,), jnp.int32)
+    l8, _ = dense_decode_step(p8, cfg, tok, cache, num_ranks=1,
+                              mode="ar", dot_fn=fp8_dot)
+    lb, _ = dense_decode_step(params, cfg, tok, cache, num_ranks=1,
+                              mode="ar")
+    np.testing.assert_allclose(np.asarray(l8, np.float32),
+                               np.asarray(lb, np.float32),
+                               rtol=0.35, atol=0.35)
+
+
+def test_fp8_dot_saturates_instead_of_nan():
+    """jnp's float->e4m3fn conversion produces NaN (not saturation)
+    beyond +-448; one hot activation element must saturate, not NaN the
+    whole output row (the silent-argmax-to-token-0 failure)."""
+    x = jnp.asarray([[500.0, -1000.0, 2.0, 0.5]], jnp.float32)
+    w = jnp.eye(4, dtype=jnp.float32)
+    for fn in (fp8_dot, fp8_emulated_dot):
+        out = np.asarray(fn(x, w), np.float32)
+        assert np.isfinite(out).all(), fn.__name__
+        np.testing.assert_allclose(out[0, :2], [448.0, -448.0])
+
+
+def test_quantize_skips_moe_experts():
+    """MoE expert weights share leaf names (w_gate/w_up/w_down) with the
+    dense MLP but their GEMMs (ragged_dot) never receive dot_fn —
+    quantizing them would silently run the losing mixed bf16xfp8
+    configuration. The quantizer's scope excludes the 'moe' subtree."""
+    cfg = ModelConfig(hidden_size=256, intermediate_size=256,
+                      num_layers=1, num_heads=2, num_kv_heads=1,
+                      head_dim=128, vocab_size=512, qk_norm=True,
+                      num_experts=4, num_experts_per_tok=2,
+                      moe_intermediate_size=128)
+    params = init_dense_llm(jrandom.PRNGKey(0), cfg)
+    p8 = quantize_dense_weights(params)
+    moe = p8["layers"][0]["moe"]
+    for k in ("w_gate", "w_up", "w_down"):
+        assert moe[k].dtype != E4M3, k
+    # Dense attention projections in the same layer DO quantize.
+    assert p8["layers"][0]["attn"]["wo"].dtype == E4M3

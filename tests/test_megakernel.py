@@ -297,3 +297,30 @@ def test_fp8_handles_rejected_outside_gemm_b():
         mb.gemm(w8, x, x)     # fp8 as output
     with pytest.raises(ValueError, match="fp8"):
         mb.gemm(x, w8, x)     # fp8 as activation
+
+
+def test_compiled_program_prunes_unused_handler_branches():
+    """compile() records the queue's task-type set and step() compiles
+    every other switch branch as a no-op (round-6 build-latency lever).
+    The pruned program must still execute its own tasks correctly, and
+    advance_queue_pos (the only sanctioned queue mutation) must never
+    introduce a type outside the recorded set."""
+    from triton_distributed_tpu.megakernel.tasks import TaskType
+
+    mb = MegaKernelBuilder()
+    a = mb.tensor(128, 128)
+    b = mb.tensor(128, 128)
+    out = mb.tensor(128, 128)
+    mb.add(out, a, b)
+    prog = mb.compile()
+    assert prog.used_types == (int(TaskType.ADD),)
+
+    rng = np.random.default_rng(11)
+    av = rng.standard_normal((128, 128)).astype(np.float32)
+    bv = rng.standard_normal((128, 128)).astype(np.float32)
+    (res,) = prog.run({a: jnp.asarray(av), b: jnp.asarray(bv)},
+                      outputs=[out])
+    np.testing.assert_allclose(np.asarray(res), av + bv, rtol=1e-6)
+
+    queue_types = set(np.asarray(prog.queue)[:prog.num_exec, 0].tolist())
+    assert queue_types == set(prog.used_types)

@@ -65,7 +65,7 @@ def tp_attn_specs(cfg: ModelConfig, axis: str = "tp") -> dict:
 
 
 def _project_qkv(params, cfg: ModelConfig, x, batch, seq, *, axis, n, mode,
-                 inter_axis="dcn", n_inter=1):
+                 inter_axis="dcn", n_inter=1, dot_fn=None):
     """x → q (B,S,hq,d), k/v (B,S,hkv,d) with qk-norm + heads split.
     In overlap/xla/overlap2d modes this also regathers the full sequence."""
     if mode == "overlap2d" and n * n_inter > 1:
@@ -88,7 +88,11 @@ def _project_qkv(params, cfg: ModelConfig, x, batch, seq, *, axis, n, mode,
             full = jax.lax.all_gather(x, axis, tiled=True)
             q, k, v = full @ params["wq"], full @ params["wk"], full @ params["wv"]
     else:  # replicated input (ar modes) or single rank
-        q, k, v = x @ params["wq"], x @ params["wk"], x @ params["wv"]
+        # ``dot_fn`` replaces the projection dot (decode modes only — the
+        # fp8 weight-serving lane, models/fp8.fp8_dot).
+        dot = dot_fn if dot_fn is not None else (lambda a, w: a @ w)
+        q, k, v = (dot(x, params["wq"]), dot(x, params["wk"]),
+                   dot(x, params["wv"]))
     hq = q.shape[-1] // cfg.head_dim
     hkv = k.shape[-1] // cfg.head_dim
     q = q.reshape(batch, seq, hq, cfg.head_dim)
@@ -205,7 +209,7 @@ def tp_attn_prefill(params: dict, cfg: ModelConfig, x: jax.Array,
 
 def _out_proj(attn: jax.Array, params: dict, *, axis: str, n: int,
               mode: str, inter_axis: str = "dcn", n_inter: int = 1,
-              ar_fn=None, gemm_ar_fn=None) -> jax.Array:
+              ar_fn=None, gemm_ar_fn=None, dot_fn=None) -> jax.Array:
     """Row-parallel output projection + TP reduction (decode modes).
 
     ``ar_fn``: optional replacement for the default fused AllReduce — the
@@ -218,21 +222,22 @@ def _out_proj(attn: jax.Array, params: dict, *, axis: str, n: int,
     and the 'with AR kernel' number silently measures the bare chain).
     ``n_inter`` > 1: the TP group spans a DCN axis, so the default
     reduction is the two-tier hierarchical AR (layers/common.tp_reduce)."""
+    dot = dot_fn if dot_fn is not None else (lambda a, w: a @ w)
     if n * n_inter == 1:
         if gemm_ar_fn is not None:
             return gemm_ar_fn(attn, params["wo"])
-        y = attn @ params["wo"]
+        y = dot(attn, params["wo"])
         return ar_fn(y) if ar_fn is not None else y
     if mode == "ar":
         if gemm_ar_fn is not None:
             return gemm_ar_fn(attn, params["wo"])
-        y = attn @ params["wo"]
+        y = dot(attn, params["wo"])
         if ar_fn is not None:
             return ar_fn(y)
         return tp_reduce(y, axis=axis, n=n, inter_axis=inter_axis,
                          n_inter=n_inter)
     if mode == "xla_rep":
-        return jax.lax.psum(attn @ params["wo"],
+        return jax.lax.psum(dot(attn, params["wo"]),
                             (inter_axis, axis) if n_inter > 1 else axis)
     raise ValueError(f"decode supports modes 'ar'/'xla_rep', got {mode!r}")
 
@@ -335,7 +340,7 @@ def tp_attn_decode(params: dict, cfg: ModelConfig, x: jax.Array,
                    kv_slice: KVSlice, pos: jax.Array, *,
                    axis: str = "tp", num_ranks: int = 1, mode: str = "ar",
                    inter_axis: str = "dcn", n_inter: int = 1,
-                   ar_fn=None, gemm_ar_fn=None):
+                   ar_fn=None, gemm_ar_fn=None, dot_fn=None):
     """Single-token decode step. x: (B, h) replicated (ar modes only — a
     1-row activation cannot be row-sharded; reference dense.py uses the AR
     path for decode too). ``pos``: scalar current position. Returns
@@ -343,7 +348,7 @@ def tp_attn_decode(params: dict, cfg: ModelConfig, x: jax.Array,
     n = num_ranks
     batch = x.shape[0]
     q, k, v = _project_qkv(params, cfg, x, batch, 1,
-                           axis=axis, n=n, mode="ar")
+                           axis=axis, n=n, mode="ar", dot_fn=dot_fn)
     cos, sin = rope_cos_sin(pos[None], cfg.head_dim, cfg.rope_theta)
     q = apply_rope(q, cos[None], sin[None])
     k = apply_rope(k, cos[None], sin[None])
@@ -361,4 +366,5 @@ def tp_attn_decode(params: dict, cfg: ModelConfig, x: jax.Array,
 
     return _out_proj(attn, params, axis=axis, n=n, mode=mode,
                      inter_axis=inter_axis, n_inter=n_inter,
-                     ar_fn=ar_fn, gemm_ar_fn=gemm_ar_fn), new_kv
+                     ar_fn=ar_fn, gemm_ar_fn=gemm_ar_fn,
+                     dot_fn=dot_fn), new_kv

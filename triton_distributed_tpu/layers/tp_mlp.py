@@ -123,7 +123,7 @@ def pick_mode(mode: str, m_total: int, n: int, *, hidden: int | None = None,
 def tp_mlp_fwd(params: dict, x: jax.Array, *, axis: str = "tp",
                num_ranks: int = 1, mode: str = "overlap",
                inter_axis: str = "dcn", n_inter: int = 1,
-               ar_fn=None, gemm_ar_fn=None) -> jax.Array:
+               ar_fn=None, gemm_ar_fn=None, dot_fn=None) -> jax.Array:
     """Device-local TP MLP forward with a concrete mode (models resolve
     ``auto`` via :func:`pick_mode` — the input layout depends on it).
     See module docstring for layouts. ``ar_fn`` optionally replaces the
@@ -134,15 +134,20 @@ def tp_mlp_fwd(params: dict, x: jax.Array, *, axis: str = "tp",
     (ops/gemm_allreduce.gemm_ar_stream)."""
     n = num_ranks
     wg, wu, wd = params["w_gate"], params["w_up"], params["w_down"]
+    # ``dot_fn`` replaces every projection dot in the replicated-input
+    # modes (n=1 / "ar" / "xla_rep" — the fp8 weight-serving lane,
+    # models/fp8.fp8_dot). The overlap/xla modes fuse the projection INTO
+    # a comm kernel, so there is no standalone dot to replace there.
+    dot = dot_fn if dot_fn is not None else (lambda a, w: a @ w)
     if n * n_inter == 1:
-        act = swiglu(x @ wg, x @ wu)
+        act = swiglu(dot(x, wg), dot(x, wu))
         # Supplied hooks still run at n=1: the force_ar_kernel bench path
         # measures the loopback kernel overhead here. gemm_ar_fn is the
         # FUSED matmul+AR (ops/gemm_allreduce.gemm_ar_stream) — it
         # replaces the dot itself, not just the reduction.
         if gemm_ar_fn is not None:
             return gemm_ar_fn(act, wd)
-        y = act @ wd
+        y = dot(act, wd)
         return ar_fn(y) if ar_fn is not None else y
 
     if mode == "auto":
@@ -172,10 +177,10 @@ def tp_mlp_fwd(params: dict, x: jax.Array, *, axis: str = "tp",
         return jax.lax.psum_scatter(h @ wd, axis, scatter_dimension=0,
                                     tiled=True)
     if mode == "ar":
-        act = swiglu(x @ wg, x @ wu)
+        act = swiglu(dot(x, wg), dot(x, wu))
         if gemm_ar_fn is not None:
             return gemm_ar_fn(act, wd)
-        partial = act @ wd
+        partial = dot(act, wd)
         if ar_fn is not None:
             return ar_fn(partial)
         from triton_distributed_tpu.layers.common import tp_reduce
@@ -184,5 +189,5 @@ def tp_mlp_fwd(params: dict, x: jax.Array, *, axis: str = "tp",
                          inter_axis=inter_axis, n_inter=n_inter)
     if mode == "xla_rep":
         ax = (inter_axis, axis) if n_inter > 1 else axis
-        return jax.lax.psum(swiglu(x @ wg, x @ wu) @ wd, ax)
+        return jax.lax.psum(dot(swiglu(dot(x, wg), dot(x, wu)), wd), ax)
     raise ValueError(f"unknown TP MLP mode {mode!r}")

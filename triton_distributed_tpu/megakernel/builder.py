@@ -237,14 +237,23 @@ class MegaKernelBuilder:
                 j += wd
 
     def gemm_mat(self, out: TensorHandle, a: TensorHandle, w: MatHandle,
-                 residual: TensorHandle | None = None):
+                 residual: TensorHandle | None = None,
+                 norm_w: TensorHandle | None = None,
+                 norm_out: TensorHandle | None = None,
+                 eps: float = 1e-6):
         """out (TILE, N) = a (TILE, K) @ w — ONE task over the 2D matrix
         workspace, compiled as a STATIC specialized branch (see tasks.py
         GEMM_MAT). ``w.pair``: w holds interleaved gate|up halves and the
         task stores silu(gate_half) * up_half (the fused gate/up/act path —
         out is the (TILE, w.n) activation). ``residual``: fuse ``+=
-        residual`` into the store (mutually exclusive with pair)."""
-        self._no_fp8(out, a, residual)
+        residual`` into the store (mutually exclusive with pair).
+        ``norm_w``/``norm_out`` (epilogue 3, requires ``residual``): the
+        task ALSO stores ``norm_out = rms_norm(out) * norm_w`` — the
+        round-6 cross-layer fusion that folds the consuming norm (the next
+        layer's attn norm, or this layer's mlp norm after o-proj) into the
+        producing GEMM, so the residual row never round-trips HBM between
+        the add and the norm."""
+        self._no_fp8(out, a, residual, norm_w, norm_out)
         if not isinstance(w, MatHandle):
             raise TypeError("gemm_mat weight must be a tensor_mat handle")
         if a.rt != 1 or out.rt != 1:
@@ -264,7 +273,21 @@ class MegaKernelBuilder:
             raise ValueError(
                 f"residual ({residual.rows},{residual.cols}) must match "
                 f"out ({out.rows},{out.cols})")
-        epi = 1 if w.pair else (2 if residual is not None else 0)
+        if (norm_w is None) != (norm_out is None):
+            raise ValueError("epilogue 3 needs BOTH norm_w and norm_out")
+        if norm_w is not None:
+            if residual is None:
+                raise ValueError("norm epilogue requires residual (it "
+                                 "fuses the residual-chain add + norm)")
+            if norm_out.rt != 1 or norm_out.cols != out.cols:
+                raise ValueError(
+                    f"norm_out ({norm_out.rows},{norm_out.cols}) must "
+                    f"match out ({out.rows},{out.cols})")
+            if norm_w.rt != 1 or norm_w.ct != out.ct:
+                raise ValueError("norm_w must be the broadcast (TILE, N) "
+                                 "norm-weight tensor matching out's width")
+        epi = 1 if w.pair else (3 if norm_w is not None
+                                else 2 if residual is not None else 0)
         spec = MatSpec(kt=a.ct, ns=w.n_strips, nt_out=out.ct,
                        kch=mat_chunk_rows(w.k), epi=epi)
         try:
@@ -276,11 +299,21 @@ class MegaKernelBuilder:
         reads.append(self._WM_HAZARD + w.base)
         if residual is not None:
             reads += [residual.tile(0, q) for q in range(out.ct)]
+        writes = [out.tile(0, j) for j in range(out.ct)]
+        arg = epi
+        b_stride = d0 = 0
+        if epi == 3:
+            reads += [norm_w.tile(0, q) for q in range(out.ct)]
+            writes += [norm_out.tile(0, j) for j in range(out.ct)]
+            arg = epi | (int(round(eps * 1e9)) << 8)
+            b_stride, d0 = norm_w.tile(0, 0), norm_out.tile(0, 0)
         self._emit(
             Task(TaskType.GEMM_MAT, out.tile(0, 0), a0=a.tile(0, 0),
-                 b0=w.base, k_tiles=a.ct, a_stride=si, arg=epi,
-                 c0=residual.tile(0, 0) if residual is not None else 0),
-            reads, [out.tile(0, j) for j in range(out.ct)])
+                 b0=w.base, k_tiles=a.ct, a_stride=si, b_stride=b_stride,
+                 arg=arg,
+                 c0=residual.tile(0, 0) if residual is not None else 0,
+                 d0=d0),
+            reads, writes)
         self._max_row = max(getattr(self, "_max_row", 1), a.ct, out.ct)
 
     def norm_rope(self, out: TensorHandle, a: TensorHandle,
@@ -333,11 +366,82 @@ class MegaKernelBuilder:
             [k_new.tile(0, 0), v_new.tile(0, 0), kt_tile, v_tile],
             [kt_tile, v_tile])
 
+    def add_norm(self, out_x2: TensorHandle, a: TensorHandle,
+                 b: TensorHandle, w: TensorHandle,
+                 out_xn: TensorHandle, eps: float = 1e-6):
+        """Fused ``out_x2 = a + b`` and ``out_xn = rms_norm(out_x2) * w``
+        in ONE task (tasks.py ADD_NORM — the cross-layer residual-chain
+        fusion for paths where an AllReduce sits between the GEMM and the
+        add, so the GEMM's own epilogue can't fuse it). ``w`` is the
+        broadcast (TILE, cols) norm-weight tensor."""
+        self._no_fp8(out_x2, a, b, w, out_xn)
+        for t in (out_x2, a, b, out_xn):
+            if t.rt != 1 or (t.ct != a.ct):
+                raise ValueError("add_norm operates on single-row-tile "
+                                 "tensors of equal width")
+        if w.ct != a.ct:
+            raise ValueError("norm weight width must match the row")
+        reads = ([a.tile(0, j) for j in range(a.ct)]
+                 + [b.tile(0, j) for j in range(a.ct)]
+                 + [w.tile(0, j) for j in range(a.ct)])
+        writes = ([out_x2.tile(0, j) for j in range(a.ct)]
+                  + [out_xn.tile(0, j) for j in range(a.ct)])
+        self._emit(
+            Task(TaskType.ADD_NORM, out_x2.tile(0, 0), a0=a.tile(0, 0),
+                 b0=b.tile(0, 0), k_tiles=a.ct, b_stride=w.tile(0, 0),
+                 arg=int(round(eps * 1e9)), d0=out_xn.tile(0, 0)),
+            reads, writes)
+        self._max_row = max(getattr(self, "_max_row", 1), a.ct)
+
+    def norm_rope_qkv(self, q: TensorHandle, hq: int, k: TensorHandle,
+                      hkv: int, q_norm: TensorHandle, k_norm: TensorHandle,
+                      cos: TensorHandle, sin: TensorHandle,
+                      eps: float = 1e-6):
+        """Per-head qk-norm + RoPE over ALL hq q-heads and hkv k-heads in
+        ONE task (tasks.py NORM_ROPE_QKV): norm weights and rope tables
+        load once per layer instead of once per head. Requires the fused
+        qkv layout — k's head tiles contiguous after q's."""
+        self._no_fp8(q, k, q_norm, k_norm, cos, sin)
+        if q.rt != 1 or k.rt != 1:
+            raise ValueError("q/k must be single-row-tile activations")
+        if q.ct < hq or k.ct < hkv:
+            raise ValueError(f"head counts ({hq}, {hkv}) exceed tensor "
+                             f"widths ({q.ct}, {k.ct})")
+        if k.base != q.base + hq:
+            raise ValueError(
+                "norm_rope_qkv needs k's head tiles contiguous after q's "
+                f"(q base {q.base} + hq {hq} != k base {k.base}) — the "
+                "fused qkv_out layout; use per-head norm_rope otherwise")
+        for t in (q_norm, k_norm, cos, sin):
+            if t.rt != 1 or t.ct != 1:
+                raise ValueError("norm weights / rope tables must be "
+                                 "single (TILE, TILE) tiles")
+        head_tiles = [q.tile(0, j) for j in range(hq)] \
+            + [k.tile(0, j) for j in range(hkv)]
+        reads = head_tiles + [q_norm.tile(0, 0), k_norm.tile(0, 0),
+                              cos.tile(0, 0), sin.tile(0, 0)]
+        self._emit(
+            Task(TaskType.NORM_ROPE_QKV, q.tile(0, 0), a0=q.tile(0, 0),
+                 b0=q_norm.tile(0, 0), k_tiles=hq,
+                 a_stride=k_norm.tile(0, 0), b_stride=hkv,
+                 arg=int(round(eps * 1e9)), c0=cos.tile(0, 0),
+                 d0=sin.tile(0, 0)),
+            reads, head_tiles)
+
     def all_reduce(self, t: TensorHandle):
-        """Sum ``t`` over ranks in place (reference make_allreduce)."""
+        """Sum ``t`` over ranks in place (reference make_allreduce).
+
+        Emits one ALLREDUCE_ROW task per ROW of tiles (round 6): the whole
+        row pushes to each peer as one slab with one delivery wait and one
+        exit barrier, where the old per-tile task paid all three per tile
+        (the single-tile ALLREDUCE type remains dispatchable for queue-ABI
+        compatibility)."""
         self._no_fp8(t)
-        for tile in t.tiles():
-            self._emit(Task(TaskType.ALLREDUCE, tile), [tile], [tile])
+        for i in range(t.rt):
+            row = [t.tile(i, j) for j in range(t.ct)]
+            self._emit(Task(TaskType.ALLREDUCE_ROW, t.tile(i, 0),
+                            k_tiles=t.ct), row, row)
+        self._max_ar = max(getattr(self, "_max_ar", 1), t.ct)
 
     def rms_norm(self, out: TensorHandle, a: TensorHandle, w: TensorHandle,
                  eps: float = 1e-6):
@@ -584,7 +688,8 @@ class MegaKernelBuilder:
 
     # -- compile / run -------------------------------------------------------
     def compile(self, num_ranks: int = 1, axis: str = "tp",
-                dtype=jnp.float32) -> "CompiledMegaKernel":
+                dtype=jnp.float32,
+                force_ar: bool = False) -> "CompiledMegaKernel":
         if self._pending_pf is not None:
             raise ValueError(
                 f"prefetch of tile {self._pending_pf[0]} never consumed — "
@@ -621,6 +726,13 @@ class MegaKernelBuilder:
             for off in range(0, len(padded), WORDS):
                 rows.append(padded[off:off + WORDS])
         queue = np.asarray(rows, np.int32).reshape(-1, WORDS)
+        # The program's task-type set is static at compile time (the queue
+        # only ever changes pos words via advance_queue_pos): run_queue
+        # compiles no-op bodies for every OTHER switch branch, so a
+        # 3-task-type test program doesn't pay the trace+compile cost of
+        # all ~23 handlers (round 6 — the biggest single lever on build
+        # latency; the full switch remains the direct-run_queue default).
+        used_types = tuple(sorted({int(t.type) for t in self._tasks}))
         return CompiledMegaKernel(queue=jnp.asarray(queue),
                                   num_tiles=self._num_tiles,
                                   num_ranks=num_ranks, axis=axis,
@@ -635,7 +747,10 @@ class MegaKernelBuilder:
                                   max_row=getattr(self, "_max_row", 1),
                                   max_strip=getattr(self, "_max_strip", 1),
                                   num_mrows=self._num_mrows,
-                                  mat_specs=tuple(self._mat_specs))
+                                  mat_specs=tuple(self._mat_specs),
+                                  max_ar=getattr(self, "_max_ar", 1),
+                                  force_ar=force_ar,
+                                  used_types=used_types)
 
 
 @dataclasses.dataclass
@@ -657,6 +772,11 @@ class CompiledMegaKernel:
     max_strip: int = 1            # widest strip fetch (tiles)
     num_mrows: int = 0            # 2D matrix-workspace rows (0 = unused)
     mat_specs: tuple = ()         # static GEMM_MAT shapes (kernel branches)
+    max_ar: int = 1               # widest ALLREDUCE_ROW slab (tiles)
+    force_ar: bool = False        # run AR protocol at n=1 (self loopback)
+    used_types: tuple | None = None  # task types in the queue (switch
+    #                                  branches for the rest compile as
+    #                                  no-ops; None = keep every branch)
 
     def scatter_input(self, ws: jax.Array, h: TensorHandle,
                       value: jax.Array) -> jax.Array:
@@ -683,11 +803,12 @@ class CompiledMegaKernel:
     @property
     def _strip_pad(self) -> int:
         """Static-size fetches may overrun the last real tile: B strips
-        (up to max_strip tiles), the 8-tile row-load chunks, and the MoE
-        strip fetches. Padding the workspaces by the worst overfetch keeps
-        every read in bounds (stores are always exact)."""
+        (up to max_strip tiles), the 8-tile row-load chunks, the MoE
+        strip fetches, and ALLREDUCE_ROW's static max_ar slab push.
+        Padding the workspaces by the worst overfetch keeps every read in
+        bounds (stores are always exact)."""
         return max(self.max_strip, self.max_gemm_width, self.max_moe_h,
-                   self.max_moe_f, 8) - 1
+                   self.max_moe_f, self.max_ar, 8) - 1
 
     def make_workspace(self, inputs: dict) -> jax.Array:
         """Build the tiled MAIN workspace once (weights + caches +
@@ -817,7 +938,8 @@ class CompiledMegaKernel:
                          max_moe_f=self.max_moe_f, max_row=self.max_row,
                          max_strip=self.max_strip,
                          workspace_m=wsm, mat_specs=self.mat_specs,
-                         profile=profile)
+                         max_ar=self.max_ar, force_ar=self.force_ar,
+                         used_types=self.used_types, profile=profile)
 
     def run(self, inputs: dict, outputs: list[TensorHandle],
             _device_local: bool = True):

@@ -36,6 +36,7 @@ from triton_distributed_tpu.runtime.context import use_interpret
 
 def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
                  max_gemm_width: int, mat_specs: tuple, kch_max: int,
+                 max_ar: int, force_ar: bool, used_types: tuple | None,
                  queue_ref, ws_in, ws8, wm, ws_out, slots, va2, vb2, vb8,
                  vbw, vbw8, vacc, vq, vstat, vqg, vaccg, vstatg, vaccw,
                  vaccw_wdt, vrow_a, vrow_b, vrow_o, vmoe_a, vmoe_b,
@@ -425,29 +426,83 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
         # One-shot AR of tile ``out`` (reference tasks/allreduce.py, minus
         # multimem): push to every peer's slot ``me``, reduce all slots,
         # exit barrier so slot reuse by the next AR task is race-free.
+        # (Kept for direct builder programs; the decode assembly emits
+        # ALLREDUCE_ROW — whole rows per task — since round 6.)
         if n == 1:
             return
         me = dl.rank(axis)
         src = ws_out.at[out]
-        local = pltpu.make_async_copy(src, slots.at[me], copy_sem)
+        local = pltpu.make_async_copy(src, slots.at[me].at[0], copy_sem)
         local.start()
         handles = []
         for i in range(n - 1):
             peer = jax.lax.rem(me + 1 + i, n)
             handles.append(shmem.putmem_nbi_block(
-                src, slots.at[me], send_sems.at[i], recv_sem, peer, axis))
+                src, slots.at[me].at[0], send_sems.at[i], recv_sem, peer,
+                axis))
         local.wait()
         shmem.quiet(*handles)
         shmem.wait_deliveries(src, recv_sem, n - 1)
         vacc[...] = jnp.zeros_like(vacc)
         for r in range(n):
-            load_slot = pltpu.make_async_copy(slots.at[r], va, copy_sem)
+            load_slot = pltpu.make_async_copy(slots.at[r].at[0], va,
+                                              copy_sem)
             load_slot.start()
             load_slot.wait()
             vacc[...] = vacc[...] + va[...].astype(jnp.float32)
         va[...] = vacc[...].astype(wdt)
         store(va, out)
         shmem.barrier_all(axis)
+
+    def t_allreduce_row():
+        # AllReduce over a whole k_tiles-wide activation row in ONE task:
+        # the slab (max_ar static tiles; edge tasks overfetch into the
+        # workspace pad) pushes to each peer ONCE, one delivery wait per
+        # peer, one exit barrier — vs per-tile push/wait/barrier of the
+        # single-tile task (32x fewer remote DMAs and barriers at
+        # hidden=4096; the round-6 cross-device queue compaction).
+        # ``force_ar`` at n == 1: the full loopback protocol runs against
+        # self (one remote self-push + delivery wait per task — the same
+        # n=1-loopback discipline as the jit ladder's force_ar_kernel),
+        # so single-chip benches can price the in-kernel AR rung.
+        if n == 1 and not force_ar:
+            return
+        me = dl.rank(axis)
+        src = ws_out.at[pl.ds(out, max_ar)]
+        npush = n - 1 if n > 1 else 1
+        if n > 1:
+            local = pltpu.make_async_copy(src, slots.at[me], copy_sem)
+            local.start()
+        handles = []
+        for i in range(npush):
+            peer = jax.lax.rem(me + 1 + i, n)   # n == 1: peer is self
+            handles.append(shmem.putmem_nbi_block(
+                src, slots.at[me], send_sems.at[i], recv_sem, peer, axis))
+        if n > 1:
+            local.wait()
+        shmem.quiet(*handles)
+        shmem.wait_deliveries(src, recv_sem, npush)
+
+        def tbody(t, _):
+            vacc[...] = jnp.zeros_like(vacc)
+            for r in range(n):
+                load_slot = pltpu.make_async_copy(slots.at[r].at[t], va,
+                                                  copy_sem)
+                load_slot.start()
+                load_slot.wait()
+                vacc[...] = vacc[...] + va[...].astype(jnp.float32)
+            va[...] = vacc[...].astype(wdt)
+            store(va, out + t)
+            return 0
+
+        jax.lax.fori_loop(0, k_tiles, tbody, 0)
+        if n > 1:
+            # Exit barrier: slot reuse by the next AR task must not race a
+            # straggler's delivery. At n == 1 (force_ar loopback) the core
+            # runs tasks sequentially and the delivery wait above already
+            # drained — no barrier (the parity-stream jit rung is likewise
+            # barrier-free in steady state).
+            shmem.barrier_all(axis)
 
     def t_scale():
         factor = arg.astype(jnp.float32) * 1e-6
@@ -479,6 +534,70 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
 
         jax.lax.fori_loop(0, k_tiles, pass2, 0)
         _row_store(vrow_o, out, k_tiles)
+
+    def t_add_norm():
+        # Fused residual add + RMSNorm (round-6 cross-layer fusion for the
+        # multi-rank path): x2 = a + b stays VMEM-resident between the
+        # add's store and the norm's read — one dispatch and one fewer
+        # full-row HBM read than the add + rms_norm task pair. The norm
+        # reads the STORED (wdt-rounded) x2 so the result is bit-identical
+        # to the unfused pair.
+        _row_load2(a0, vrow_a, b0, vrow_b, k_tiles)
+        vacc[...] = jnp.zeros_like(vacc)
+
+        def pass1(t, _):
+            s = (vrow_a[t].astype(jnp.float32)
+                 + vrow_b[t].astype(jnp.float32))
+            vrow_o[t, :, :] = s.astype(wdt)
+            sf = vrow_o[t].astype(jnp.float32)
+            vacc[:, :1] += jnp.sum(sf * sf, axis=1, keepdims=True)
+            return 0
+
+        jax.lax.fori_loop(0, k_tiles, pass1, 0)
+        _row_store(vrow_o, out, k_tiles)
+        _row_load(b_stride, vrow_b, k_tiles)       # norm weight row
+        cols = (k_tiles * TILE).astype(jnp.float32)
+        eps = arg.astype(jnp.float32) * 1e-9
+        scale_n = jax.lax.rsqrt(vacc[:, :1] / cols + eps)
+
+        def pass2(t, _):
+            vrow_a[t, :, :] = (vrow_o[t].astype(jnp.float32) * scale_n
+                               * vrow_b[t].astype(jnp.float32)).astype(wdt)
+            return 0
+
+        jax.lax.fori_loop(0, k_tiles, pass2, 0)
+        _row_store(vrow_a, d0, k_tiles)
+
+    def t_norm_rope_qkv():
+        # All q+k heads of the fused qkv row in ONE task (round-6 queue
+        # compaction): the q/k norm weights and the cos/sin tables load
+        # ONCE for the layer; a dynamic fori walks the contiguous head
+        # tiles (k heads start at a0 + hq — builder-checked layout).
+        load(b0, va2.at[1])         # q_norm weight
+        load(a_stride, va2.at[2])   # k_norm weight
+        load(c0, va2.at[3])         # cos
+        load(d0, vb2.at[1])         # sin
+        hq = k_tiles
+        eps = arg.astype(jnp.float32) * 1e-9
+        cosf = va2[3].astype(jnp.float32)
+        sinf = vb2[1].astype(jnp.float32)
+        qwf = va2[1].astype(jnp.float32)
+        kwf = va2[2].astype(jnp.float32)
+        half = TILE // 2
+
+        def hbody(h, _):
+            load(a0 + h, vq)
+            af = vq[...].astype(jnp.float32)
+            w_n = jnp.where(h < hq, qwf, kwf)
+            scale_r = jax.lax.rsqrt(
+                jnp.mean(af * af, axis=1, keepdims=True) + eps)
+            xn = af * scale_r * w_n
+            rot = jnp.concatenate([-xn[:, half:], xn[:, :half]], axis=1)
+            va[...] = (xn * cosf + rot * sinf).astype(wdt)
+            store(va, a0 + h)
+            return 0
+
+        jax.lax.fori_loop(0, hq + b_stride, hbody, 0)
 
     def _attn_softmax(kt_of, v_of):
         """Shared online-softmax body: streams (kT_j, V_j) tile pairs by the
@@ -835,8 +954,6 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
         spt = (MAT_COLS // 2 if sp.epi == 1 else MAT_COLS) // TILE
 
         def body():
-            _row_load(a0, vrow_a, sp.kt)
-
             def cdesc(t, slot):
                 dst = (vbm.at[slot] if sp.kch == kch_max
                        else vbm.at[slot].at[pl.ds(0, sp.kch)])
@@ -857,15 +974,23 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
                     voutm.at[:, pl.ds(w_ * TILE, TILE)],
                     ws_out.at[out + s * spt + w_], copy_sem)
 
+            # Layer-seam prefetch (round 6): the first weight chunks start
+            # streaming BEFORE the A row loads — the A row of a seam task
+            # is the previous task's freshly stored output, but the weight
+            # chunks are static inputs, so their DMA hides under the A-row
+            # landing instead of serializing after it.
             cdesc(0, 0).start()
             if total > 1:
                 cdesc(1, 1).start()
+            _row_load(a0, vrow_a, sp.kt)
+            if sp.epi == 3:
+                vacc[...] = jnp.zeros_like(vacc)
             for t in range(total):
                 s, j = divmod(t, n_ch)
                 slot = t % 2
                 rw = min(spt, sp.nt_out - s * spt)
                 cdesc(t, slot).wait()
-                if sp.epi == 2 and j == 0:
+                if sp.epi in (2, 3) and j == 0:
                     # residual strip tiles arrive under the dots
                     for w_ in range(rw):
                         rdesc(s, w_).start()
@@ -893,7 +1018,7 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
                         voutm[:, :half] = (
                             jax.nn.silu(vaccm[:, :half])
                             * vaccm[:, half:]).astype(wdt)
-                    elif sp.epi == 2:
+                    elif sp.epi in (2, 3):
                         for w_ in range(rw):
                             rdesc(s, w_).wait()
                         for w_ in range(rw):
@@ -901,6 +1026,17 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
                                 vaccm[:, pl.ds(w_ * TILE, TILE)]
                                 + vrow_b[w_].astype(jnp.float32)
                             ).astype(wdt)
+                        if sp.epi == 3:
+                            # Keep the x2 strip VMEM-resident for the fused
+                            # norm pass and accumulate its sum-of-squares
+                            # (from the STORED wdt values — bit-identical
+                            # to an unfused rms_norm reading x2 back).
+                            for w_ in range(rw):
+                                x2t = voutm[:, w_ * TILE:(w_ + 1) * TILE]
+                                vrow_o[s * spt + w_, :, :] = x2t
+                                x2f = x2t.astype(jnp.float32)
+                                vacc[:, :1] += jnp.sum(
+                                    x2f * x2f, axis=1, keepdims=True)
                     else:
                         voutm[...] = vaccm[...].astype(wdt)
                     for w_ in range(rw):
@@ -909,6 +1045,24 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
                     # voutm (dots in between hide most of the latency).
                     for w_ in range(rw):
                         odesc(s, w_).wait()
+            if sp.epi == 3:
+                # Epilogue-3 norm pass (cross-layer fusion): xn =
+                # rms_norm(x2) * w written to the d0 row — the x2 row
+                # never re-reads from HBM, and the consuming layer's norm
+                # task disappears from the queue.
+                _row_load(b_stride, vrow_b, sp.nt_out)
+                cols = jnp.float32(sp.nt_out * TILE)
+                eps = (arg >> 8).astype(jnp.float32) * 1e-9
+                scale_n = jax.lax.rsqrt(vacc[:, :1] / cols + eps)
+
+                def npass(t2, _):
+                    vrow_a[t2, :, :] = (
+                        vrow_o[t2].astype(jnp.float32) * scale_n
+                        * vrow_b[t2].astype(jnp.float32)).astype(wdt)
+                    return 0
+
+                jax.lax.fori_loop(0, sp.nt_out, npass, 0)
+                _row_store(vrow_a, d0, sp.nt_out)
             return None
 
         return body
@@ -922,12 +1076,24 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
         else:
             jax.lax.switch(a_stride, bodies)
 
-    jax.lax.switch(w(0), [t_copy, t_add, t_silu_mul, t_retired, t_allreduce,
-                          t_scale, t_rms_norm, t_retired, t_attn_decode,
-                          t_attn_decode_paged, t_prefetch,
-                          t_attn_decode_gqa, t_gemm_wide, t_norm_rope,
-                          t_append_kv, t_gemm_wide_w8, t_prefetch_w8,
-                          t_moe_topk, t_moe_ffn, t_gemm_mat])
+    bodies = [t_copy, t_add, t_silu_mul, t_retired, t_allreduce,
+              t_scale, t_rms_norm, t_retired, t_attn_decode,
+              t_attn_decode_paged, t_prefetch,
+              t_attn_decode_gqa, t_gemm_wide, t_norm_rope,
+              t_append_kv, t_gemm_wide_w8, t_prefetch_w8,
+              t_moe_topk, t_moe_ffn, t_gemm_mat, t_add_norm,
+              t_norm_rope_qkv, t_allreduce_row]
+    if used_types is not None:
+        # Branch pruning (round 6): a compiled program's task-type set is
+        # static — every absent type's handler compiles as the no-op, so
+        # build latency scales with the types a program USES, not the
+        # whole handler library. Queue positions stay ABI-stable (a row
+        # naming a pruned type would silently no-op, exactly like the
+        # retired slots — builder.compile derives the set from its own
+        # queue, which advance_queue_pos never changes).
+        bodies = [b if i in used_types else t_retired
+                  for i, b in enumerate(bodies)]
+    jax.lax.switch(w(0), bodies)
 
 
 def _stamp_profile(queue_ref, prof_ref):
@@ -954,6 +1120,8 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
               max_moe_h: int = 0, max_moe_f: int = 0,
               max_row: int = 1, max_strip: int = 0,
               workspace_m=None, mat_specs: tuple = (),
+              max_ar: int = 1, force_ar: bool = False,
+              used_types: tuple | None = None,
               profile: bool = False):
     """Execute the packed task queue over the workspace in ONE pallas_call.
 
@@ -973,6 +1141,14 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
     ``workspace8``: optional (T8, TILE, TILE) float8_e4m3fn READ-ONLY
     weight workspace (GEMM_WIDE_W8 / PREFETCH_W8 B-tile source — half the
     weight-streaming bytes of bf16).
+    ``force_ar``: run the ALLREDUCE_ROW protocol even at num_ranks == 1
+    (remote self-push loopback — the cross-device rung's single-chip
+    pricing mode; call inside shard_map over a 1-device mesh).
+    ``used_types``: the task types the queue dispatches (ints) — every
+    other switch branch compiles as a no-op, cutting trace+compile time
+    to the handlers a program actually uses. ``None`` (raw callers)
+    keeps the full handler library. Rows naming a pruned type silently
+    no-op, like the retired slots — pass the set your queue uses.
     ``profile``: add an int32 (n_tasks, 128) profile OUTPUT — each grid
     step stamps [exec_index, *queue_row] into its row (the observability
     per-task dispatch record, obs/kernel_profile.py); the return becomes
@@ -985,6 +1161,7 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
     T = workspace.shape[0]
     wdt = workspace.dtype
     G = max(max_gqa, 1)
+    AR = max(max_ar, 1)   # ALLREDUCE_ROW slab width (slots second dim)
     # MoE strips share the GEMM_WIDE strip buffer: it must span the wider
     # of the ffn strips (gate/up, max_moe_f tiles) and the hidden strips
     # (down, max_moe_h tiles). ``max_moe_*=0`` = program has no MoE.
@@ -1071,7 +1248,10 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
         ],
     )
     kernel = functools.partial(_mega_kernel, n, axis, n_tasks, G, W,
-                               tuple(mat_specs), kch_max)
+                               tuple(mat_specs), kch_max, AR,
+                               bool(force_ar),
+                               None if used_types is None
+                               else tuple(sorted(set(used_types))))
     if profile:
         base_kernel = kernel
 
@@ -1093,13 +1273,17 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
     else:
         interpret_arg = False
     params = {}
-    if n > 1:
+    if n > 1 or force_ar:
+        # force_ar at n == 1 still issues remote (self) DMAs + semaphores
+        # and needs the collective id like any cross-device kernel.
         from triton_distributed_tpu.language.core import next_collective_id
 
         params["collective_id"] = next_collective_id(key=_mega_kernel)
     out_shape = [
         jax.ShapeDtypeStruct((T, TILE, TILE), wdt),
-        jax.ShapeDtypeStruct((max(n, 1), TILE, TILE), wdt),
+        # AR slots: one max_ar-tile slab per rank (ALLREDUCE_ROW pushes a
+        # whole activation row per peer; the single-tile task uses slab 0).
+        jax.ShapeDtypeStruct((max(n, 1), AR, TILE, TILE), wdt),
     ]
     if profile:
         out_shape.append(jax.ShapeDtypeStruct((n_tasks, 128), jnp.int32))

@@ -145,6 +145,10 @@ class DecodeStepProgram:
     cos: TensorHandle
     sin: TensorHandle
     x_out: TensorHandle
+    # build_decode_step(final_norm=True): the final RMSNorm weight handle
+    # (broadcast rows) — the norm runs IN-KERNEL, fused into the last
+    # layer's residual tail, and x_out is already normalized.
+    fnorm: TensorHandle | None = None
 
 
 def advance_queue_pos(base_queue, pos: int, num_exec: int | None = None):
@@ -213,20 +217,39 @@ def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
                        eps: float = 1e-6, paged: bool = False,
                        inkernel_append: bool = False,
                        moe_experts: int = 0, moe_topk: int = 0,
-                       batch: int = 1) -> TensorHandle:
-    """Emit one transformer layer's decode tasks; returns the output x."""
+                       batch: int = 1,
+                       xn: TensorHandle | None = None,
+                       out_norm: tuple[TensorHandle, TensorHandle] | None = None,
+                       force_ar_tasks: bool = False):
+    """Emit one transformer layer's decode tasks.
+
+    Round-6 cross-layer contract: ``xn`` is the already-NORMALIZED input
+    row (produced by the previous layer's fused tail); ``None`` emits the
+    standalone rms_norm (layer 0 / direct callers). ``out_norm`` is
+    ``(norm_w, norm_out)`` — the NEXT consumer's norm (the next layer's
+    attn norm, or the model's final norm) fused into this layer's
+    residual tail, so the residual row never round-trips HBM between the
+    add and the norm and the consuming norm task disappears from the
+    queue. ``force_ar_tasks`` emits the AllReduce sites even at
+    ``num_ranks == 1`` (the n=1-loopback cross-device rung — bench.py).
+
+    Returns ``(x2, x2n)``: the residual-stream output and its fused-norm
+    row (``None`` unless ``out_norm`` was given)."""
     hidden = x.cols
     d = TILE
     groups = hq_local // hkv_local
     scale = d ** -0.5
+    ar = num_ranks > 1 or force_ar_tasks
 
-    xn = mb.tensor(TILE, hidden)
-    # No weight prefetches since the strip-fetch GEMM (round 4): one
-    # (W, TILE, TILE) strip DMA replaced the per-tile stream, so a
-    # single-tile warm would be discarded — each prefetch would cost a
-    # dispatch plus a wasted tile fetch. (The PREFETCH task types remain
-    # for direct builder use; reference weight-prefetch, SURVEY.md §2.7.)
-    mb.rms_norm(xn, x, h.attn_norm, eps)
+    if xn is None:
+        xn = mb.tensor(TILE, hidden)
+        # No weight prefetches since the strip-fetch GEMM (round 4): one
+        # (W, TILE, TILE) strip DMA replaced the per-tile stream, so a
+        # single-tile warm would be discarded — each prefetch would cost a
+        # dispatch plus a wasted tile fetch. (The PREFETCH task types
+        # remain for direct builder use; reference weight-prefetch,
+        # SURVEY.md §2.7.)
+        mb.rms_norm(xn, x, h.attn_norm, eps)
 
     if h.wqkv is not None:
         # Matrix path (round 5): ONE fused qkv GEMM_MAT task — the q|k|v
@@ -235,19 +258,23 @@ def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
         # body is a static specialized branch (tasks.py GEMM_MAT).
         q = TensorHandle(h.qkv_out.base, TILE, hq_local * d)
         mb.gemm_mat(h.qkv_out, xn, h.wqkv)
+        # Round 6: qk-norm + RoPE over ALL q+k heads in ONE task — the
+        # norm weights and rope tables load once per layer instead of
+        # once per head (hq+hkv-1 dispatches disappear).
+        mb.norm_rope_qkv(q, hq_local, h.k_new, hkv_local, h.q_norm,
+                         h.k_norm, cos, sin, eps)
     else:
         q = mb.tensor(TILE, hq_local * d)
         mb.gemm(q, xn, h.wq)
         mb.gemm(h.k_new, xn, h.wk)
         mb.gemm(h.v_new, xn, h.wv)
-
-    # Per-head qk-norm + RoPE, fused into one task per head (head_dim ==
-    # TILE → the norm reduces over the single head tile).
-    for j in range(hq_local):
-        mb.norm_rope(_col(q, j), _col(q, j), h.q_norm, cos, sin, eps)
-    for j in range(hkv_local):
-        mb.norm_rope(_col(h.k_new, j), _col(h.k_new, j), h.k_norm, cos,
-                     sin, eps)
+        # Tiled/fp8 layout: k_new is not contiguous after q, so the fused
+        # whole-row task cannot apply — per-head qk-norm + RoPE.
+        for j in range(hq_local):
+            mb.norm_rope(_col(q, j), _col(q, j), h.q_norm, cos, sin, eps)
+        for j in range(hkv_local):
+            mb.norm_rope(_col(h.k_new, j), _col(h.k_new, j), h.k_norm,
+                         cos, sin, eps)
 
     attn = mb.tensor(TILE, hq_local * d)
     if paged:
@@ -289,22 +316,28 @@ def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
                          _col(h.v_new, kv))
 
     mat = isinstance(h.wo, MatHandle)
+    nw, nout = out_norm if out_norm is not None else (None, None)
     x1 = mb.tensor(TILE, hidden)
-    if mat and num_ranks == 1:
-        # Fused o-proj + residual add (epilogue 2).
-        mb.gemm_mat(x1, attn, h.wo, residual=x)
+    x1n = mb.tensor(TILE, hidden)
+    if mat and not ar:
+        # Fused o-proj + residual add + THIS layer's mlp norm (epilogue 3
+        # — the round-6 mid-layer fusion: the x1 row stays VMEM-resident
+        # between the add and the norm, and the rms_norm task disappears).
+        mb.gemm_mat(x1, attn, h.wo, residual=x, norm_w=h.mlp_norm,
+                    norm_out=x1n, eps=eps)
     else:
         o = mb.tensor(TILE, hidden)
         if mat:
             mb.gemm_mat(o, attn, h.wo)
         else:
             mb.gemm(o, attn, h.wo)
-        if num_ranks > 1:
+        if ar:
             mb.all_reduce(o)
-        mb.add(x1, x, o)
+        # Fused residual add + mlp norm (ADD_NORM — the cross-layer
+        # fusion's form for paths where an AllReduce sits between the
+        # GEMM and the add).
+        mb.add_norm(x1, x, o, h.mlp_norm, x1n, eps)
 
-    x1n = mb.tensor(TILE, hidden)
-    mb.rms_norm(x1n, x1, h.mlp_norm, eps)
     if h.moe_w_gate is not None:
         down = mb.tensor(TILE, hidden)
         # Qwen3-MoE FFN: router GEMM → in-kernel top-k/softmax → ONE
@@ -318,13 +351,19 @@ def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
                    moe_experts)
     elif h.w_gateup is not None:
         # Fused gate/up/act: one GEMM_MAT over the interleaved pair with
-        # the silu epilogue, then down (+residual when no AR follows).
+        # the silu epilogue, then down (+residual when no AR follows —
+        # with ``out_norm`` also fusing the NEXT consumer's norm, the
+        # round-6 cross-LAYER epilogue).
         act = mb.tensor(TILE, h.w_gateup.n)
         mb.gemm_mat(act, x1n, h.w_gateup)
-        if num_ranks == 1:
+        if not ar:
             x2 = mb.tensor(TILE, hidden)
+            if nw is not None:
+                mb.gemm_mat(x2, act, h.w_down, residual=x1, norm_w=nw,
+                            norm_out=nout, eps=eps)
+                return x2, nout
             mb.gemm_mat(x2, act, h.w_down, residual=x1)
-            return x2
+            return x2, None
         down = mb.tensor(TILE, hidden)
         mb.gemm_mat(down, act, h.w_down)
     else:
@@ -337,11 +376,70 @@ def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
         mb.gemm(up, x1n, h.w_up)
         mb.silu_mul(act, gate, up)
         mb.gemm(down, act, h.w_down)
-    if num_ranks > 1:
+    if ar:
         mb.all_reduce(down)
     x2 = mb.tensor(TILE, hidden)
+    if nw is not None:
+        # Cross-layer residual-chain fusion across the AR seam: one task
+        # produces BOTH x2 and the next layer's normalized input.
+        mb.add_norm(x2, x1, down, nw, nout, eps)
+        return x2, nout
     mb.add(x2, x1, down)
-    return x2
+    return x2, None
+
+
+def _check_decode_step_config(*, hidden, hq_local, hkv_local, ffn_local,
+                              num_layers, max_seq, pos, batch, head_dim,
+                              moe_experts, moe_topk) -> None:
+    """Named build-time validation: every TILE/geometry constraint raises
+    HERE, at build_decode_step time, naming the offending dimension AND
+    the ModelConfig field it derives from — not later as an opaque tile
+    arithmetic error inside the builder (VERDICT r5 weak #7)."""
+    if head_dim != TILE:
+        raise ValueError(
+            f"head_dim = {head_dim} unsupported: the megakernel decode "
+            f"assembly requires head_dim == TILE ({TILE}) — config field "
+            "head_dim (the Qwen3 value)")
+    if hidden % TILE:
+        raise ValueError(
+            f"hidden = {hidden} is not a multiple of TILE ({TILE}) — "
+            "config field hidden_size")
+    if ffn_local % TILE:
+        raise ValueError(
+            f"ffn_local = {ffn_local} is not a multiple of TILE ({TILE}) "
+            "— config field intermediate_size (per-rank shard: "
+            "intermediate_size / tp must stay a TILE multiple)")
+    if max_seq % TILE:
+        raise ValueError(
+            f"max_seq = {max_seq} is not a multiple of TILE ({TILE}) — "
+            "the KV cache is tiled; pad the cache capacity (max_seq "
+            "serving argument)")
+    if not 1 <= batch <= TILE:
+        raise ValueError(
+            f"batch = {batch} outside [1, {TILE}]: one decode step "
+            "processes at most one (TILE, hidden) activation row — "
+            "batch serving argument")
+    if num_layers < 1:
+        raise ValueError(f"num_layers = {num_layers} must be >= 1 — "
+                         "config field num_layers")
+    if hq_local < 1 or hkv_local < 1:
+        raise ValueError(
+            f"hq_local = {hq_local}, hkv_local = {hkv_local} must be "
+            ">= 1 — config fields num_heads / num_kv_heads (per-rank "
+            "shards: heads / tp)")
+    if hq_local % hkv_local:
+        raise ValueError(
+            f"hq_local = {hq_local} not divisible by hkv_local = "
+            f"{hkv_local}: GQA groups q-heads evenly over kv heads — "
+            "config fields num_heads / num_kv_heads")
+    if moe_experts and not 1 <= moe_topk <= moe_experts <= TILE:
+        raise ValueError(
+            f"MoE config needs 1 <= moe_topk ({moe_topk}) <= moe_experts "
+            f"({moe_experts}) <= TILE ({TILE}) — config fields "
+            "num_experts_per_tok / num_experts")
+    if not 0 <= pos < max_seq:
+        raise ValueError(f"pos {pos} outside cache capacity {max_seq} "
+                         "(the step appends this position's k/v)")
 
 
 def build_decode_step(*, hidden: int, hq_local: int, hkv_local: int,
@@ -352,7 +450,9 @@ def build_decode_step(*, hidden: int, hq_local: int, hkv_local: int,
                       inkernel_append: bool = False,
                       fp8_weights: bool = False,
                       moe_experts: int = 0, moe_topk: int = 0,
-                      batch: int = 1) -> DecodeStepProgram:
+                      batch: int = 1, head_dim: int = TILE,
+                      final_norm: bool = False,
+                      force_ar_tasks: bool = False) -> DecodeStepProgram:
     """Assemble a full num_layers decode step (per-device TP view).
 
     ``hq_local``/``hkv_local``/``ffn_local`` are this device's shards;
@@ -368,12 +468,19 @@ def build_decode_step(*, hidden: int, hq_local: int, hkv_local: int,
     ``batch`` is the real token count — MOE_TOPK masks padded rows, which
     would otherwise elect experts and defeat the in-kernel skip. MoE
     weights stay in the main workspace (the fp8 lane covers dense
-    projections only)."""
-    if hidden % TILE or ffn_local % TILE or max_seq % TILE:
-        raise ValueError("hidden/ffn_local/max_seq must be TILE multiples")
-    if not 0 <= pos < max_seq:
-        raise ValueError(f"pos {pos} outside cache capacity {max_seq} "
-                         "(the step appends this position's k/v)")
+    projections only).
+
+    ``final_norm=True`` (round 6): the model's final RMSNorm runs
+    IN-KERNEL, fused into the last layer's residual tail — ``x_out`` is
+    the already-normalized row and ``prog.fnorm`` is the norm-weight
+    handle to feed (broadcast rows). ``force_ar_tasks``: emit the
+    in-kernel AllReduce sites even at ``num_ranks == 1`` (the
+    n=1-loopback cross-device rung; compile with ``force_ar=True``)."""
+    _check_decode_step_config(
+        hidden=hidden, hq_local=hq_local, hkv_local=hkv_local,
+        ffn_local=ffn_local, num_layers=num_layers, max_seq=max_seq,
+        pos=pos, batch=batch, head_dim=head_dim, moe_experts=moe_experts,
+        moe_topk=moe_topk)
     mb = MegaKernelBuilder()
     x = mb.tensor(TILE, hidden)
     cos = mb.tensor(TILE, TILE)
@@ -437,13 +544,29 @@ def build_decode_step(*, hidden: int, hq_local: int, hkv_local: int,
             wqkv=wqkv, w_gateup=w_gateup, qkv_out=qkv_out,
         ))
 
+    fnorm = mb.tensor(TILE, hidden) if final_norm else None
     cur = x
-    for h in layers:
-        cur = build_decode_layer(mb, cur, h, cos, sin, hq_local=hq_local,
-                                 hkv_local=hkv_local, pos=pos,
-                                 num_ranks=num_ranks, eps=eps, paged=paged,
-                                 inkernel_append=inkernel_append,
-                                 moe_experts=moe_experts,
-                                 moe_topk=moe_topk, batch=batch)
+    curn = None   # layer 0 emits its own rms_norm (xn=None)
+    for i, h in enumerate(layers):
+        # Cross-layer residual-chain fusion (round 6): each layer's tail
+        # also produces the NEXT consumer's normalized row — the next
+        # layer's attn-norm input, or (final_norm) the model's final norm.
+        if i + 1 < num_layers:
+            nw = layers[i + 1].attn_norm
+        elif final_norm:
+            nw = fnorm
+        else:
+            nw = None
+        nout = mb.tensor(TILE, hidden) if nw is not None else None
+        cur, curn = build_decode_layer(
+            mb, cur, h, cos, sin, hq_local=hq_local,
+            hkv_local=hkv_local, pos=pos,
+            num_ranks=num_ranks, eps=eps, paged=paged,
+            inkernel_append=inkernel_append,
+            moe_experts=moe_experts,
+            moe_topk=moe_topk, batch=batch, xn=curn,
+            out_norm=(nw, nout) if nw is not None else None,
+            force_ar_tasks=force_ar_tasks)
     return DecodeStepProgram(mb=mb, x=x, layers=layers, cos=cos, sin=sin,
-                             x_out=cur)
+                             x_out=curn if final_norm else cur,
+                             fnorm=fnorm)

@@ -126,7 +126,7 @@ class MegakernelDecoder:
     def __init__(self, cfg: ModelConfig, params: dict, *, max_seq: int,
                  dtype=jnp.float32, ctx=None, axis: str = "tp",
                  num_ranks: int = 1, fp8_weights: bool = False,
-                 profile: bool = False):
+                 profile: bool = False, final_norm: bool = False):
         validate_megakernel_cfg(cfg, max_seq)
         if profile and num_ranks > 1:
             raise ValueError(
@@ -169,13 +169,20 @@ class MegakernelDecoder:
         # sample out of the step-latency percentiles.
         self.warm = False
         self.last_step_cold = True
+        # final_norm: the model's final RMSNorm runs IN-KERNEL, fused into
+        # the last layer's residual tail (round 6 — one fewer host op
+        # between kernel and lm_head). Opt-in: the in-kernel reduction's
+        # fp32 accumulation order differs from layers/common.rms_norm at
+        # the last ulp, so strict token-identity tests keep the host norm.
+        self.final_norm_inkernel = final_norm
         self.prog = build_decode_step(
             hidden=cfg.hidden_size, hq_local=cfg.num_heads // n,
             hkv_local=cfg.num_kv_heads // n,
             ffn_local=cfg.intermediate_size // n,
             num_layers=cfg.num_layers, max_seq=max_seq,
             pos=max_seq - 1, num_ranks=n, eps=cfg.rms_norm_eps,
-            inkernel_append=True, fp8_weights=fp8_weights)
+            inkernel_append=True, fp8_weights=fp8_weights,
+            final_norm=final_norm)
         self.comp = self.prog.mb.compile(num_ranks=n, axis=axis,
                                          dtype=dtype)
         # Weight feeds computed ONCE (per rank) — start() merges only the
@@ -184,6 +191,11 @@ class MegakernelDecoder:
             weight_feeds(self.prog, cfg, params, rank=r, num_ranks=n)
             for r in range(n)
         ]
+        if final_norm:
+            fn = broadcast_rows(np.asarray(params["final_norm"],
+                                           np.float32))
+            for wf in self._weight_feeds:
+                wf[self.prog.fnorm] = fn
         # embed / final_norm / lm_head replicated once up front: passing
         # the Engine's vocab-sharded lm_head through a replicated shard_map
         # spec would insert a full all-gather into every decode step.
@@ -321,9 +333,14 @@ class MegakernelDecoder:
         else:
             ws = self.comp.step(ws, queue, ws8=ws8, wsm=wsm)
         x_out = self.comp.gather_output(ws, self.prog.x_out)[0:1]
-        xn = rms_norm(x_out.astype(jnp.float32),
-                      final_norm.astype(jnp.float32),
-                      self.cfg.rms_norm_eps)
+        if self.final_norm_inkernel:
+            # x_out is already the normalized row (fused into the last
+            # layer's tail); the fnorm weight was fed with the workspace.
+            xn = x_out.astype(jnp.float32)
+        else:
+            xn = rms_norm(x_out.astype(jnp.float32),
+                          final_norm.astype(jnp.float32),
+                          self.cfg.rms_norm_eps)
         head = lm_head if lm_head is not None else embed.T
         logits = xn @ head.astype(jnp.float32)
         tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)

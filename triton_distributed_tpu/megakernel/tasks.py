@@ -126,10 +126,44 @@ class TaskType(enum.IntEnum):
     #                 tile base, a0 = A row tile base, b0 = wsm ROW base,
     #                 k_tiles (runtime copy), a_stride = SPEC INDEX,
     #                 arg = epilogue (runtime copy), c0 = residual row
-    #                 tile base (epilogue 2). Epilogues: 0 = plain store;
+    #                 tile base (epilogue 2/3). Epilogues: 0 = plain store;
     #                 1 = silu-pair (strips interleave [gate|up] 512-col
     #                 halves; stores silu(gate)*up — the fused gate/up/act
-    #                 path); 2 = += residual (fused o-proj/down + add).
+    #                 path); 2 = += residual (fused o-proj/down + add);
+    #                 3 = += residual then rms_norm(result) * w into a
+    #                 SECOND output row (b_stride = norm weight base, d0 =
+    #                 xn out base, arg = 3 | eps_1e9 << 8) — the round-6
+    #                 cross-layer fusion that folds the next norm read into
+    #                 the producing GEMM's epilogue.
+    ADD_NORM = 20   # Fused residual add + RMSNorm — the round-6 CROSS-LAYER
+    #                 fusion for the multi-rank path (x2 = x1 + down after an
+    #                 AllReduce, immediately re-read by the next norm): one
+    #                 task computes x2 = a + b, stores it, AND stores
+    #                 xn = rms_norm(x2) * w — the x2 row never round-trips
+    #                 HBM between the add and the norm, and one dispatch
+    #                 replaces two. Words: out = x2 row base, a0 = x1 base,
+    #                 b0 = addend base, k_tiles = row tiles, b_stride = norm
+    #                 weight row base (broadcast tensor), arg = eps 1e-9,
+    #                 d0 = xn output row base.
+    NORM_ROPE_QKV = 21  # NORM_ROPE over ALL q+k heads of one fused qkv row
+    #                 in ONE task: the q_norm/k_norm weights and the cos/sin
+    #                 tables load ONCE for the whole layer instead of once
+    #                 per head, and hq+hkv-1 dispatches disappear (round-6
+    #                 queue compaction: 5 tasks/layer -> 1 at the Qwen3-8B
+    #                 shard shape, 144 fewer dispatches at 36 layers).
+    #                 Requires the matrix layout's contiguous q|k head tiles
+    #                 (k base == q base + hq). Words: out = a0 = q head base
+    #                 tile, b0 = q_norm tile, a_stride = k_norm tile,
+    #                 k_tiles = hq (q-head count), b_stride = hkv (k-head
+    #                 count), arg = eps 1e-9, c0/d0 = cos/sin tiles.
+    ALLREDUCE_ROW = 22  # AllReduce over k_tiles CONTIGUOUS tiles (a whole
+    #                 activation row) in ONE task: one slab push per peer,
+    #                 one delivery wait, one exit barrier — where the
+    #                 single-tile ALLREDUCE paid all three PER TILE (32x the
+    #                 dispatches, remote DMAs, and barriers at hidden=4096;
+    #                 the round-6 cross-device queue compaction). Words:
+    #                 out = row base tile, k_tiles = row tiles (<= the
+    #                 program's max_ar slab width).
     MOE_FFN = 18    # One task = one layer's ENTIRE expert MLP: loops the E
     #                 experts; an expert whose (E, B) weight column is all
     #                 zero is SKIPPED before any weight DMA issues — the
@@ -232,7 +266,11 @@ class MatSpec:
     core/code_generator.py, expressed as a lax.switch over static bodies).
 
     ``kch``: contraction rows per fetched chunk (the largest of 512/256/128
-    dividing K, capped at K). ``epi``: 0 plain, 1 silu-pair, 2 +residual.
+    dividing K, capped at K). ``epi``: 0 plain, 1 silu-pair, 2 +residual,
+    3 +residual THEN rms_norm into a second output row (the round-6
+    cross-layer fusion: the o-proj/down-proj task also produces the NEXT
+    norm's output — queue word b_stride = norm weight row base, d0 = xn
+    output row base, arg = 3 | (eps_1e9 << 8)).
     ``nt_out``: output width in TILE columns (for pair epi: of the act)."""
 
     kt: int          # A-row tiles (K / TILE)

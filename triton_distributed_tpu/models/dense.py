@@ -105,7 +105,8 @@ def _logits(params: dict, cfg: ModelConfig, x: jax.Array, *, axis: str,
 
 def _mlp_or_moe(layer: dict, cfg: ModelConfig, h: jax.Array, *, axis: str,
                 n: int, mode: str, inter_axis: str = "dcn",
-                n_inter: int = 1, ar_fn=None, gemm_ar_fn=None) -> jax.Array:
+                n_inter: int = 1, ar_fn=None, gemm_ar_fn=None,
+                dot_fn=None) -> jax.Array:
     """FFN block dispatch: dense SwiGLU TP-MLP or TP-MoE (Qwen3-MoE)."""
     if "moe" in layer:
         from triton_distributed_tpu.ops.moe import moe_tp_fwd_local
@@ -123,7 +124,7 @@ def _mlp_or_moe(layer: dict, cfg: ModelConfig, h: jax.Array, *, axis: str,
             ar_fn=ar_fn)
     return tp_mlp_fwd(layer["mlp"], h, axis=axis, num_ranks=n, mode=mode,
                       inter_axis=inter_axis, n_inter=n_inter,
-                      ar_fn=ar_fn, gemm_ar_fn=gemm_ar_fn)
+                      ar_fn=ar_fn, gemm_ar_fn=gemm_ar_fn, dot_fn=dot_fn)
 
 
 def dense_prefill(params: dict, cfg: ModelConfig, input_ids: jax.Array,
@@ -295,7 +296,7 @@ def make_gemm_ar_stream_fn(state0, *, axis: str, n: int,
 def _decode_body(params: dict, cfg: ModelConfig, tokens: jax.Array,
                  attend, *, axis: str, n: int, mode: str,
                  inter_axis: str = "dcn", n_inter: int = 1,
-                 ar_fn=None, gemm_ar_fn=None) -> jax.Array:
+                 ar_fn=None, gemm_ar_fn=None, dot_fn=None) -> jax.Array:
     """Shared one-token transformer walk; ``attend(i, attn_params, h)``
     supplies the attention (and threads its cache via closure)."""
     x = params["embed"][tokens]  # (B, h)
@@ -307,7 +308,7 @@ def _decode_body(params: dict, cfg: ModelConfig, tokens: jax.Array,
             layer, cfg, h, axis=axis, n=n,
             mode=mode if mode in ("ar", "xla_rep") else "ar",
             inter_axis=inter_axis, n_inter=n_inter, ar_fn=ar_fn,
-            gemm_ar_fn=gemm_ar_fn)
+            gemm_ar_fn=gemm_ar_fn, dot_fn=dot_fn)
     return _logits(params, cfg, x, axis=axis, n=n,
                    inter_axis=inter_axis, n_inter=n_inter)
 
@@ -317,10 +318,14 @@ def dense_decode_step(params: dict, cfg: ModelConfig, tokens: jax.Array,
                       num_ranks: int = 1, mode: str = "ar",
                       inter_axis: str = "dcn", n_inter: int = 1,
                       ar_state=None, force_ar_kernel: bool = False,
-                      fused_gemm_ar: bool = False):
+                      fused_gemm_ar: bool = False, dot_fn=None):
     """Device-local one-token decode. tokens: (B,) replicated. Returns
     (logits (B, vocab), cache advanced by one); with ``ar_state`` given
     (barrier-free parity AR), returns (logits, cache, ar_state').
+
+    ``dot_fn``: replaces every projection/MLP dot (``x @ w``) in the
+    step — the fp8 weight-serving lane passes ``models/fp8.fp8_dot``
+    over an e4m3-quantized param tree (quantize_dense_weights).
 
     ``force_ar_kernel``: run the parity-stream AR kernel even at n=1 (the
     degenerate loopback grid) — single-chip benches use it so decode
@@ -347,14 +352,15 @@ def dense_decode_step(params: dict, cfg: ModelConfig, tokens: jax.Array,
         out, kv = tp_attn_decode(attn_params, cfg, h, cache.layer(i), pos,
                                  axis=axis, num_ranks=n, mode=mode,
                                  inter_axis=inter_axis, n_inter=n_inter,
-                                 ar_fn=ar_fn, gemm_ar_fn=gemm_ar_fn)
+                                 ar_fn=ar_fn, gemm_ar_fn=gemm_ar_fn,
+                                 dot_fn=dot_fn)
         cache = cache.with_layer(i, kv)
         return out
 
     logits = _decode_body(params, cfg, tokens, attend,
                           axis=axis, n=n, mode=mode, inter_axis=inter_axis,
                           n_inter=n_inter, ar_fn=ar_fn,
-                          gemm_ar_fn=gemm_ar_fn)
+                          gemm_ar_fn=gemm_ar_fn, dot_fn=dot_fn)
     cache = cache._replace(offset=pos + 1)
     if ar_state is not None:
         return logits, cache, (final() if final is not None else ar_state)

@@ -1,0 +1,104 @@
+"""fp8 (e4m3) weight serving for the jit decode ladder — round 6.
+
+Round 5 measured PURE fp8 (e4m3 × e4m3, fp32 accumulation) at **1.81×
+bf16 at the weight-streaming decode shape** (m=8; ledger
+``fp8_vs_bf16_decode_shape``) while the precision-preserving mixed
+bf16×fp8 configuration loses (~0.3×: the e4m3→bf16 conversion dominates
+on this chip generation — docs/gemm_core.md). This module serves that
+measured win end to end: the Qwen3 shard's projection/MLP weights live
+as ``float8_e4m3fn`` arrays and every decode GEMM runs the pure-fp8
+path — activations quantize to e4m3 at the dot, products accumulate in
+fp32 (reference: the fp8 payloads of the source's flagship kernels,
+README.md:96-97).
+
+The hook shape mirrors ``ar_fn``/``gemm_ar_fn``: ``dense_decode_step``
+threads ``dot_fn`` into ``tp_attn_decode``/``tp_mlp_fwd``, which call it
+for every projection in place of ``x @ w``. Quality is the e4m3
+quantization's (same contract as the megakernel's fp8 weight workspace);
+token-parity vs the same-quantized fp32-emulated math is exact — the
+e4m3×e4m3 products are exactly representable in fp32
+(tests/test_fp8_decode.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+E4M3 = jnp.float8_e4m3fn
+
+# Param-tree leaf names that hold decode-GEMM weights (the
+# weight-streaming-dominant bytes). Norms, embed, and lm_head stay in the
+# model dtype — the fp8 lane covers the per-layer projections, matching
+# the megakernel fp8 weight workspace's scope.
+_WEIGHT_KEYS = frozenset(
+    ["wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"])
+
+
+def _to_e4m3(a: jax.Array) -> jax.Array:
+    """Saturating e4m3 cast. jnp's float→float8_e4m3fn conversion
+    produces NaN — not saturation — beyond the ±448 finite range, so a
+    single hot activation element (attention outputs and swiglu products
+    routinely exceed 448 in real checkpoints) would silently NaN the
+    whole output row and degenerate argmax to token 0. Clamp first:
+    out-of-range values saturate to ±448 like hardware fp8 stores do."""
+    if a.dtype == E4M3:
+        return a
+    lim = float(jnp.finfo(E4M3).max)
+    return jnp.clip(a.astype(jnp.float32), -lim, lim).astype(E4M3)
+
+
+def quantize_dense_weights(params: dict) -> dict:
+    """The dense param tree with every per-layer projection/MLP weight
+    cast to ``float8_e4m3fn`` (half the bf16 bytes; values round to
+    e4m3). Non-weight leaves (norms, embed, lm_head, MoE router) are
+    shared, not copied."""
+    def q_layer(layer: dict) -> dict:
+        out = {}
+        for k, v in layer.items():
+            if k == "moe":
+                # MoE expert weights stay in the model dtype: the expert
+                # GEMMs (ragged_dot) never receive dot_fn, so quantizing
+                # them would silently run the mixed bf16×fp8 configuration
+                # this module's docstring documents as LOSING (~0.3×) —
+                # the lane's scope is the dense projections, like the
+                # megakernel's fp8 weight workspace.
+                out[k] = v
+            elif isinstance(v, dict):
+                out[k] = q_layer(v)
+            elif k in _WEIGHT_KEYS:
+                out[k] = _to_e4m3(jnp.asarray(v))
+            else:
+                out[k] = v
+        return out
+
+    return {**params, "layers": [q_layer(la) for la in params["layers"]]}
+
+
+def fp8_dot(x: jax.Array, w: jax.Array) -> jax.Array:
+    """Pure-fp8 projection: quantize the activation to e4m3 and run the
+    e4m3 × e4m3 dot with fp32 accumulation (the configuration that
+    measured 1.81× bf16 at m=8), returning the activation dtype. Weights
+    already in e4m3 pass through; bf16 weights are quantized on the fly
+    (the emulation/test path)."""
+    out_dt = x.dtype if x.dtype != E4M3 else jnp.float32
+    x8 = _to_e4m3(x)
+    w8 = _to_e4m3(jnp.asarray(w))
+    out = jax.lax.dot_general(
+        x8, w8, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return out.astype(out_dt)
+
+
+def fp8_emulated_dot(x: jax.Array, w: jax.Array) -> jax.Array:
+    """The same quantized math in fp32: both operands round to e4m3,
+    upcast, fp32 dot. Token-parity golden for :func:`fp8_dot` — e4m3
+    products are exactly representable in fp32, so the two paths agree
+    up to fp32 accumulation order."""
+    out_dt = x.dtype if x.dtype != E4M3 else jnp.float32
+    xf = _to_e4m3(x).astype(jnp.float32)
+    wf = _to_e4m3(jnp.asarray(w)).astype(jnp.float32)
+    out = jax.lax.dot_general(
+        xf, wf, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return out.astype(out_dt)

@@ -70,9 +70,13 @@ ON_CHIP_FLOORS: dict[str, float] = {
     # Flash prefill S=32k (B=1, 8q/1kv, d=128, causal, 1024x1024 tiles):
     # measured ~12 ms (COVERAGE.md capacity table).
     "flash32k_prefill_ms_max": 40.0,
-    # Full-model megakernel decode step vs the jitted bare-shard ladder:
-    # measured 1.58–1.76x (ledger r5: 6.421 ms vs 4.056 ms).
-    "megakernel_vs_jit_max": 2.0,
+    # Full-model megakernel decode step vs the jitted bare-shard ladder.
+    # r5 measured 1.58x (ledger: 6.421 ms vs 4.056 ms) under the
+    # pre-fusion assembly; the round-6 cross-layer fused queue (~6
+    # tasks/layer, in-kernel final norm) targets <= 1x, so the floor
+    # tightens 2.0 -> 1.5 (still slack over the target — the floor
+    # catches hardware/toolchain regressions, not window noise).
+    "megakernel_vs_jit_max": 1.5,
 }
 
 

@@ -86,7 +86,13 @@ METRICS: tuple[MetricSpec, ...] = (
     MetricSpec("decode_step_ms_best_comm_variant",
                "decode step ms (best comm variant)", " ms", "lower",
                "decode"),
+    MetricSpec("decode_step_ms_fp8",
+               "decode step ms (fp8 weights, pure-fp8 dots)", " ms",
+               "lower", "decode"),
     MetricSpec("decode_step_ms_megakernel", "decode step ms (megakernel)",
+               " ms", "lower", "megakernel"),
+    MetricSpec("decode_step_ms_megakernel_ar",
+               "decode step ms (megakernel, in-kernel AR n=1 loopback)",
                " ms", "lower", "megakernel"),
 )
 

@@ -68,6 +68,10 @@ TASK_CLASS: dict[TaskType, str] = {
     TaskType.MOE_FFN: "moe",
     TaskType.GEMM: "retired",
     TaskType.ROPE: "retired",
+    # Round-6 cross-layer fusion / queue-compaction types.
+    TaskType.ADD_NORM: "norm",
+    TaskType.NORM_ROPE_QKV: "norm",
+    TaskType.ALLREDUCE_ROW: "allreduce",
 }
 
 # Fixed per-task dispatch/DMA-issue overhead the round-5 profile measured
@@ -117,6 +121,32 @@ def decode_records(prof: Any) -> list[TaskRecord]:
     return records
 
 
+def records_from_queue(queue: Any, num_exec: int | None = None
+                       ) -> list[TaskRecord]:
+    """Decode a COMPILED queue's executable prefix into records without
+    running the kernel — the full-model attribution path (round 6): the
+    queue IS the dispatch plan (grid step t executes row t), so per-task
+    accounting at build time needs no device. Rows past ``num_exec`` are
+    page-table DATA and are skipped."""
+    arr = np.asarray(queue)
+    if arr.ndim != 2 or arr.shape[1] < 1 + len(_FIELDS):
+        raise ValueError(f"queue shape {arr.shape} is not a packed "
+                         "(rows, WORDS) task queue")
+    n = num_exec if num_exec is not None else arr.shape[0]
+    records = []
+    for seq, row in enumerate(arr[:n]):
+        tt = int(row[0])
+        try:
+            name = TaskType(tt).name
+            cls = TASK_CLASS.get(TaskType(tt), "other")
+        except ValueError:
+            name, cls = f"UNKNOWN_{tt}", "other"
+        words = {f: int(v) for f, v in zip(_FIELDS, row[1:1 + len(_FIELDS)])}
+        records.append(TaskRecord(seq=seq, type=tt, type_name=name,
+                                  task_class=cls, words=words))
+    return records
+
+
 def estimate_task_seconds(rec: TaskRecord, itemsize: int = 2,
                           spec: ChipSpec | None = None) -> float:
     """Bytes/flops roofline estimate of one task's duration.
@@ -156,6 +186,21 @@ def estimate_task_seconds(rec: TaskRecord, itemsize: int = 2,
         n_links = max(spec.ici_links_per_axis, 1)
         return (FIXED_TASK_OVERHEAD_S + 2 * spec.ici_hop_latency_s
                 + 2 * tile_b / (spec.ici_link_gbps * 1e9 * n_links))
+    elif t is TaskType.ALLREDUCE_ROW:
+        # Whole-row slab AR: one push + one delivery per peer for k_tiles
+        # contiguous tiles (the round-6 compaction of the per-tile task).
+        n_links = max(spec.ici_links_per_axis, 1)
+        return (FIXED_TASK_OVERHEAD_S + 2 * spec.ici_hop_latency_s
+                + 2 * kt * tile_b / (spec.ici_link_gbps * 1e9 * n_links))
+    elif t is TaskType.ADD_NORM:
+        # reads x1 + addend + norm weight, writes x2 + xn — five row
+        # passes over k_tiles tiles.
+        nbytes = 5 * kt * tile_b
+    elif t is TaskType.NORM_ROPE_QKV:
+        # hq (k_tiles) + hkv (b_stride) head tiles read+written, plus the
+        # 4 once-per-layer table tiles.
+        heads = kt + max(w["b_stride"], 0)
+        nbytes = (2 * heads + 4) * tile_b
     elif t is TaskType.MOE_FFN:
         e_active = 2  # topk-ish active experts; router outcome not in row
         ft = max(w["arg"] >> 16, 1)
@@ -270,6 +315,41 @@ class KernelProfile:
         return {"classes": out, "n_tasks": len(self.records),
                 "task_sum_s": round(total, 9),
                 "measured_step_s": self.measured_step_s}
+
+    def accounting(self, *, host_s: float | None = None,
+                   host_label: str = "host embed/final-norm/logits"
+                   ) -> dict[str, Any]:
+        """Full-model per-task accounting (round 6): the per-class table
+        plus the two lanes a whole-MODEL step carries beyond the in-kernel
+        queue — the host-side embed/logits work (``host_s``: measured
+        whole-step minus kernel-only step) and the ``unattributed/stall``
+        slice (measured kernel step minus the per-task sum). Every in-
+        kernel task must land in a named class; ``unclassified`` > 0
+        means a task type is missing from TASK_CLASS — the attribution
+        regression the profile test gates on."""
+        s = self.summary()
+        classes = dict(s["classes"])
+        total = s["task_sum_s"]
+        out: dict[str, Any] = {
+            "classes": classes, "n_tasks": s["n_tasks"],
+            "task_sum_s": total,
+            "measured_step_s": self.measured_step_s,
+            "unclassified": sum(d["tasks"] for c, d in classes.items()
+                                if c == "other"),
+        }
+        if self.measured_step_s is not None:
+            gap = self.measured_step_s - total
+            out["unattributed_stall_s"] = round(max(gap, 0.0), 9)
+            out["stall_fraction"] = round(
+                max(gap, 0.0) / self.measured_step_s, 6)
+        if host_s is not None:
+            out["host_s"] = round(host_s, 9)
+            out["host_label"] = host_label
+        denom = (self.measured_step_s or total) + (host_s or 0.0)
+        if denom > 0:
+            for c, d in classes.items():
+                d["share"] = round(d["seconds"] / denom, 4)
+        return out
 
     # -- persistence --------------------------------------------------------
     def save(self, run_dir: str) -> str:
