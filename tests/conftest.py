@@ -14,6 +14,12 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
 
+# No same-rung serve retries in the suite: a retry re-traces a backend
+# that just failed (expensive on the interpret-mode env-failure paths);
+# the demotion ladder itself is the recovery under test, and it fires on
+# the first failure when the budget is 0 (docs/resilience.md).
+os.environ.setdefault("TDTPU_STEP_RETRIES", "0")
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402

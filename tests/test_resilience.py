@@ -1,0 +1,384 @@
+"""Resilience subsystem tests (ISSUE 6): the fault matrix per class on
+allgather / allreduce / p2p / one fused op, deadline-bounded waits, and
+the Engine demotion ladder (degraded xla path token-identical).
+
+The fault-matrix cases run in the comm-lint replay lane (CPU, no
+hardware): a seeded FaultPlan overlays the tracer's patch-point shims and
+the chaos harness classifies the outcome — so the coverage here is the
+same machinery `python -m triton_distributed_tpu.resilience.chaos` gates
+in CI, pinned per (op, fault class).
+"""
+
+import dataclasses
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_distributed_tpu.resilience import (
+    CommTimeoutError,
+    FaultClass,
+    FaultInjectionError,
+    FaultPlan,
+    deadline,
+    is_transient,
+)
+from triton_distributed_tpu.resilience import chaos
+
+MATRIX_TEST_OPS = ("allgather", "allreduce", "p2p", "allgather_gemm")
+
+
+_BASELINES: dict = {}
+
+
+def _case(op: str, fault: FaultClass, seed: int = 0) -> chaos.CaseResult:
+    from triton_distributed_tpu.analysis.registry import build_registry
+
+    driver = build_registry((2,))[op]
+    axes, dims = ("tp",), (2,)
+    if op not in _BASELINES:   # one clean replay per op, shared by cases
+        _BASELINES[op] = chaos._clean_baseline(driver, axes, dims,
+                                               f"{op}@2")
+    return chaos.run_case(op, axes, dims, fault, seed=seed,
+                          baseline_hashes=_BASELINES[op], driver=driver)
+
+
+@pytest.mark.parametrize("fault", list(FaultClass),
+                         ids=[f.value for f in FaultClass])
+@pytest.mark.parametrize("op", MATRIX_TEST_OPS)
+def test_fault_matrix_case(op, fault):
+    """Every (op, fault-class) case lands on its expected verdict with a
+    fired-fault record; detections carry a named diagnostic."""
+    case = _case(op, fault)
+    assert case.ok, (case.verdict, case.expected, case.diagnostics)
+    assert case.n_fired >= 1
+    if case.verdict == "detected":
+        assert case.detected_by in ("commlint", "parity", "error")
+        assert case.diagnostics, "detection must carry a named diagnostic"
+
+
+def test_drop_fault_names_the_semaphore():
+    case = _case("allgather", FaultClass.DROP_SIGNAL)
+    assert case.verdict == "detected" and case.detected_by == "commlint"
+    text = "\n".join(case.diagnostics)
+    # The diagnostic names the starved semaphore and the wedged rank.
+    assert "sem" in text and "rank 1" in text
+
+
+def test_fault_cases_are_deterministic():
+    a = _case("allreduce", FaultClass.DUP_SIGNAL, seed=3)
+    b = _case("allreduce", FaultClass.DUP_SIGNAL, seed=3)
+    assert a.diagnostics == b.diagnostics
+    assert (a.verdict, a.n_violations) == (b.verdict, b.n_violations)
+
+
+def test_instrument_overlay_stacks_and_unwinds():
+    from triton_distributed_tpu.language import instrument
+
+    assert instrument.active_layers() == 0
+    base = instrument.originals(["rank"])["rank"]
+    instrument.install({"rank": lambda axis="tp": 7})
+    try:
+        with pytest.raises(instrument.InstrumentationError):
+            instrument.install({"rank": lambda axis="tp": 8})  # no overlay
+        instrument.install({"rank": lambda axis="tp": 8}, overlay=True)
+        from triton_distributed_tpu.language import distributed_ops
+
+        assert distributed_ops.rank() == 8
+        instrument.uninstall()
+        assert distributed_ops.rank() == 7
+    finally:
+        while instrument.active_layers():
+            instrument.uninstall()
+    from triton_distributed_tpu.language import distributed_ops
+
+    assert distributed_ops.rank is base
+
+
+# ---------------------------------------------------------------------------
+# Deadline-bounded waits.
+# ---------------------------------------------------------------------------
+
+def test_deadline_converts_hang_to_named_error():
+    sem = chaos._FakeInterpretSemaphore("tests/sem0")
+    deadline.drain_timeout_events()
+    with pytest.raises(CommTimeoutError) as ei:
+        deadline.semaphore_wait_with_deadline(sem, 3, 1, timeout_s=0.05,
+                                              nap_s=0.002)
+    err = ei.value
+    assert (err.sem, err.rank, err.expected, err.observed) == \
+        ("tests/sem0", 1, 3, 0)
+    msg = str(err)
+    assert "tests/sem0" in msg and "expected delta 3" in msg
+    events = deadline.drain_timeout_events()
+    assert len(events) == 1 and events[0].kind == "timeout"
+    assert events[0].sem == "tests/sem0" and events[0].amount == 3
+
+
+def test_deadline_signalled_wait_completes_and_consumes():
+    sem = chaos._FakeInterpretSemaphore()
+    threading.Timer(0.01, sem.signal, args=(0, 2)).start()
+    deadline.semaphore_wait_with_deadline(sem, 2, 0, timeout_s=5.0,
+                                          nap_s=0.002)
+    assert sem.count_by_core[0] == 0  # consumed
+    assert deadline.drain_timeout_events() == []
+
+
+def test_deadline_progress_resets_budget():
+    """A slow-but-live producer never trips the deadline: each increment
+    resets the progress budget even though the total wait exceeds it."""
+    sem = chaos._FakeInterpretSemaphore()
+    for i in range(4):
+        threading.Timer(0.01 * (i + 1), sem.signal, args=(0, 1)).start()
+    deadline.semaphore_wait_with_deadline(sem, 4, 0, timeout_s=0.03,
+                                          nap_s=0.002)
+    assert deadline.drain_timeout_events() == []
+
+
+def test_wait_budget_env_config(monkeypatch):
+    monkeypatch.setenv("TDTPU_WAIT_TIMEOUT_MS", "1500")
+    monkeypatch.setenv("TDTPU_WAIT_NAP_MS", "2")
+    assert deadline.wait_timeout_s() == pytest.approx(1.5)
+    assert deadline.wait_nap_s() == pytest.approx(0.002)
+    monkeypatch.setenv("TDTPU_WAIT_TIMEOUT_MS", "0")  # escape hatch
+    assert deadline.wait_timeout_s() == 0.0
+    monkeypatch.delenv("TDTPU_WAIT_TIMEOUT_MS")
+    assert deadline.wait_timeout_s() == pytest.approx(
+        deadline.DEFAULT_TIMEOUT_MS / 1e3)
+
+
+def test_wait_budget_context_config(ctx, monkeypatch):
+    from triton_distributed_tpu.runtime import context as ctx_mod
+
+    monkeypatch.delenv("TDTPU_WAIT_TIMEOUT_MS", raising=False)
+    ctx_mod.set_context(dataclasses.replace(ctx, wait_timeout_ms=250.0))
+    try:
+        assert deadline.wait_timeout_s() == pytest.approx(0.25)
+        # Env wins over the context field.
+        monkeypatch.setenv("TDTPU_WAIT_TIMEOUT_MS", "100")
+        assert deadline.wait_timeout_s() == pytest.approx(0.1)
+    finally:
+        ctx_mod.set_context(ctx)
+
+
+def test_wait_and_consume_token_accept_timeout():
+    from triton_distributed_tpu.language import distributed_ops as dl
+
+    assert dl.consume_token(5, 0, timeout_ns=10_000) == 5
+    # wait's timeout_ns is declarative (no TPU lowering) — the signature
+    # must accept it through the replay shim as well.
+    from triton_distributed_tpu.analysis.tracer import trace_op
+
+    def driver(d):
+        from triton_distributed_tpu.language import wait as pkg_wait
+        from triton_distributed_tpu.analysis.tracer import FakeSem
+
+        pkg_wait(FakeSem("t/sem"), 1, timeout_ns=1_000_000)
+
+    ts = trace_op(driver, ("tp",), (1,))
+    assert any(e.kind == "wait" for e in ts.events[0])
+
+
+# ---------------------------------------------------------------------------
+# Straggler rotation (shared resolver + fused-op acceptance).
+# ---------------------------------------------------------------------------
+
+def test_resolve_straggler_forms():
+    from triton_distributed_tpu.language.distributed_ops import (
+        resolve_straggler,
+    )
+
+    assert resolve_straggler(None, 4, 2) is None
+    assert resolve_straggler((1, 64), 4, 2) == (1, 64)
+    rank, cycles = resolve_straggler(("rotate", 64), 4, 6)
+    assert int(rank) == 2 and cycles == 64
+    rank, _ = resolve_straggler(("rotate", 64), 4, None)
+    assert int(rank) == 0
+
+
+def test_fused_ops_accept_rotating_straggler():
+    """allgather_gemm / gemm_reduce_scatter take ("rotate", cycles): the
+    straggle lands on rank (call_index % n) — verified in the replay lane
+    (uniform fault coverage with the stream collectives)."""
+    from triton_distributed_tpu.analysis.tracer import trace_op
+    from triton_distributed_tpu.ops.allgather_gemm import (
+        AGGemmConfig, ag_gemm_local,
+    )
+    from triton_distributed_tpu.ops.gemm_reduce_scatter import (
+        GemmRSConfig, gemm_rs_local,
+    )
+
+    def _arr(*shape):
+        n = int(np.prod(shape))
+        return (np.arange(n, dtype=np.float32).reshape(shape) % 7)
+
+    def driver(d):
+        n = d["tp"]
+        ag_gemm_local(_arr(16, 128), _arr(128, 128), axis="tp",
+                      num_ranks=n,
+                      cfg=AGGemmConfig(straggler=("rotate", 64),
+                                       call_index=1))
+        gemm_rs_local(_arr(n * 16, 128), _arr(128, 128), axis="tp",
+                      num_ranks=n,
+                      cfg=GemmRSConfig(straggler=("rotate", 64),
+                                       call_index=1))
+
+    ts = trace_op(driver, ("tp",), (2,), name="fused_rotate")
+    straggles = {r: [e for e in evs if e.kind == "straggle"]
+                 for r, evs in enumerate(ts.events)}
+    assert len(straggles[1]) == 2   # call_index 1 % 2 == rank 1, both ops
+    assert straggles[0] == []
+
+
+# ---------------------------------------------------------------------------
+# Engine degradation ladder.
+# ---------------------------------------------------------------------------
+
+def _tiny_engine_setup():
+    from triton_distributed_tpu.models import init_dense_llm, tiny_config
+
+    cfg = tiny_config()
+    params = init_dense_llm(jax.random.key(0), cfg)
+    ids = jax.random.randint(jax.random.key(1), (2, 8), 0, cfg.vocab_size)
+    return cfg, params, ids
+
+
+def _fresh_registry():
+    from triton_distributed_tpu.obs import metrics as obs_metrics
+
+    return obs_metrics.set_registry(obs_metrics.Registry())
+
+
+def test_engine_demotes_to_xla_with_token_parity(ctx):
+    """Acceptance: a persistent injected fault on the fused path demotes
+    to xla within the retry budget, and the degraded output is
+    token-identical to the healthy golden run."""
+    from triton_distributed_tpu.models import Engine
+
+    cfg, params, ids = _tiny_engine_setup()
+    reg = _fresh_registry()
+    golden = Engine(cfg, params, ctx, backend="xla", max_seq=32
+                    ).serve(ids, 4)
+
+    eng = Engine(cfg, params, ctx, backend="overlap", max_seq=32)
+    assert eng._ladder == ["overlap", "xla"]
+    # Persistent crash on the fused path's comm kernels (the AR family the
+    # overlap backend routes reductions through at this shape); the golden
+    # xla rung launches none of them.
+    plan = FaultPlan(FaultClass.CRASH, persistent=True, match="_ar_")
+    with plan.active(), pytest.warns(RuntimeWarning, match="demoted"):
+        out = eng.serve(ids, 4)
+
+    assert eng.backend == "xla" and eng._rung == 1
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(golden))
+    assert reg.get("tdtpu_engine_demotions_total").value == 1
+    assert reg.get("tdtpu_engine_step_retries_total").value >= 1
+    assert reg.get("tdtpu_engine_backend_rung").value == 1
+    assert plan.fired and plan.fired[0].cls == "crash"
+
+
+def test_engine_clean_run_never_demotes(ctx):
+    """Acceptance (no false positives): a clean serve keeps its backend
+    and registers no demotion."""
+    from triton_distributed_tpu.models import Engine
+
+    cfg, params, ids = _tiny_engine_setup()
+    reg = _fresh_registry()
+    eng = Engine(cfg, params, ctx, backend="xla", max_seq=32)
+    eng.serve(ids, 4)
+    assert eng.backend == "xla" and eng._rung == 0
+    assert reg.get("tdtpu_engine_demotions_total") is None
+
+
+def test_engine_nontransient_error_propagates(ctx):
+    """Programming errors are not degraded around: a bad argument raises
+    through the ladder untouched."""
+    from triton_distributed_tpu.models import Engine
+
+    cfg, params, _ = _tiny_engine_setup()
+    eng = Engine(cfg, params, ctx, backend="xla", max_seq=16)
+    with pytest.raises(ValueError, match="exceeds max_seq"):
+        eng.serve(jnp.zeros((1, 32), jnp.int32), 4)
+    assert eng._rung == 0
+
+
+def test_is_transient_classification():
+    assert is_transient(FaultInjectionError("x"))
+    assert is_transient(CommTimeoutError(sem="s", rank=0, expected=1,
+                                         observed=0, waited_s=1.0,
+                                         timeout_s=1.0))
+    assert is_transient(RuntimeError("backend blew up"))
+    assert not is_transient(ValueError("bad arg"))
+    assert not is_transient(TypeError("bad type"))
+
+
+def test_slo_streak_drives_demotion_and_repromotion(ctx, tmp_path,
+                                                    monkeypatch):
+    """A violation streak demotes (watchdog-driven), a clean streak
+    probes re-promotion; the streak itself is published as a registry
+    gauge (the satellite fix: the watchdog no longer only observes)."""
+    from triton_distributed_tpu import obs
+    from triton_distributed_tpu.models import Engine
+    from triton_distributed_tpu.obs import metrics as obs_metrics
+    from triton_distributed_tpu.runtime import initialize_distributed
+    from triton_distributed_tpu.runtime.context import set_context
+
+    cfg, params, _ = _tiny_engine_setup()
+    ids = jnp.zeros((1, 8), jnp.int32)
+    ctx1 = initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                  devices=jax.devices()[:1])
+    try:
+        eng = Engine(cfg, params, ctx1, backend="auto", max_seq=32)
+        assert eng._ladder == ["auto", "xla"]
+        monkeypatch.setenv("TDTPU_DEMOTE_AFTER", "2")
+        monkeypatch.setenv("TDTPU_PROMOTE_AFTER", "1")
+        monkeypatch.setenv("TDTPU_SLO_TOKENS_S_MIN", "1e15")  # unmeetable
+        obs.start_run(str(tmp_path / "run"))
+        try:
+            eng.serve(ids, 3)
+            reg = obs_metrics.registry()
+            assert reg.get("tdtpu_slo_violation_streak").value == 1
+            assert eng._rung == 0
+            with pytest.warns(RuntimeWarning, match="demoted"):
+                eng.serve(ids, 3)
+            assert eng._rung == 1 and eng.backend == "xla"
+            assert reg.get("tdtpu_engine_demotions_total").value == 1
+            # Clean streak (floor removed) probes re-promotion.
+            monkeypatch.delenv("TDTPU_SLO_TOKENS_S_MIN")
+            with pytest.warns(RuntimeWarning, match="promoted"):
+                eng.serve(ids, 3)
+            assert eng._rung == 0 and eng.backend == "auto"
+        finally:
+            run_dir = obs.finish_run()
+        # The degradation lane: the snapshot carries the demotion, and
+        # report --check fails on it unless explicitly allowed.
+        from triton_distributed_tpu.obs import report as obs_report
+
+        metrics = obs_report.load_metrics(run_dir)
+        assert obs_report.degradation_count(metrics) == 1
+        rc_fail = obs_report.main([run_dir, "--check", "--require-series",
+                                   "", "--allow-slo-violations"])
+        assert rc_fail == 1
+        rc_ok = obs_report.main([run_dir, "--check", "--require-series",
+                                 "", "--allow-slo-violations",
+                                 "--allow-degradation"])
+        assert rc_ok == 0
+    finally:
+        set_context(ctx)
+
+
+def test_chaos_json_report_shape(tmp_path):
+    """The CLI's machine-readable report (CI artifact contract)."""
+    rc = chaos.main(["--op", "allreduce", "--fault", "drop_signal",
+                     "--ranks", "2",
+                     "--json", str(tmp_path / "chaos.json")])
+    assert rc == 0
+    import json
+
+    rep = json.loads((tmp_path / "chaos.json").read_text())
+    assert rep["ok"] is True
+    verdicts = {(c["op"], c["fault"]): c["verdict"] for c in rep["cases"]}
+    assert verdicts[("allreduce", "drop_signal")] == "detected"
+    assert verdicts[("deadline", "hang_no_producer")] == "detected"

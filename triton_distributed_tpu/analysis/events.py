@@ -24,6 +24,10 @@ Event kinds
 ``enter``/``exit``  kernel boundary markers (``note`` = kernel label); the
                un-awaited-DMA obligation is evaluated at ``exit``.
 ``straggle``   fault-injection spin observed (informational).
+``timeout``    a deadline-bounded wait expired (``resilience/deadline.py``
+               converted a hang into a structured error): ``sem`` names
+               the semaphore, ``amount`` the expected delta, ``note`` the
+               observed count and waited time.
 
 Semaphore identity is a string label stable across ranks: scratch position
 within the kernel invocation plus concrete element indices (SPMD symmetry
@@ -42,8 +46,9 @@ XLA = "xla"
 ENTER = "enter"
 EXIT = "exit"
 STRAGGLE = "straggle"
+TIMEOUT = "timeout"
 
-KINDS = (SIGNAL, WAIT, DMA_START, XLA, ENTER, EXIT, STRAGGLE)
+KINDS = (SIGNAL, WAIT, DMA_START, XLA, ENTER, EXIT, STRAGGLE, TIMEOUT)
 
 
 @dataclasses.dataclass

@@ -369,7 +369,8 @@ def _sh_num_ranks(axis: str = "tp"):
     return s.dims[s.axes.index(axis)]
 
 
-def _sh_wait(sem, value: int = 1):
+def _sh_wait(sem, value: int = 1, timeout_ns=None):
+    del timeout_ns  # declarative on TPU; replay waits never block
     s = _SESSION
     if s is None or not isinstance(sem, FakeSem):
         return _ORIG["wait"](sem, value)

@@ -18,6 +18,7 @@ from triton_distributed_tpu.language.distributed_ops import (  # noqa: F401
     notify,
     consume_token,
     maybe_straggle,
+    resolve_straggler,
     SignalOp,
     CommScope,
 )

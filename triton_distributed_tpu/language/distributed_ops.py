@@ -78,20 +78,31 @@ def num_ranks(axis: str = "tp"):
     return jax.lax.axis_size(axis)
 
 
-def wait(sem, value: int = 1):
+def wait(sem, value: int = 1, timeout_ns: int | None = None):
     """Block until ``sem`` has been signalled ``value`` times, consuming them.
 
     Reference distributed_ops.py:57 ``wait(barrierPtrs, numBarriers, scope,
     semantic)`` → per-warp acquire spin loop (DistributedOpToLLVM.cpp:146-219).
     Returns a token (always 0) for ``consume_token`` parity.
+
+    ``timeout_ns``: the wait's deadline budget. TPU ``semaphore_wait`` has
+    no timeout lowering, so on hardware the value is declarative (the
+    static checker proves schedulability instead); in interpret mode every
+    wait is already bounded by the global deadline
+    (``resilience/deadline.py``, ``TDTPU_WAIT_TIMEOUT_MS`` /
+    ``DistContext.wait_timeout_ms``) and a hang raises a structured
+    ``CommTimeoutError`` naming the semaphore, rank, expected delta and
+    observed count.
     """
+    del timeout_ns
     pltpu.semaphore_wait(sem, value)
     return 0
 
 
-def consume_token(value, token):
-    """No-op on TPU (see module docstring); reference distributed_ops.py:74."""
-    del token
+def consume_token(value, token, timeout_ns: int | None = None):
+    """No-op on TPU (see module docstring); reference distributed_ops.py:74.
+    ``timeout_ns`` mirrors :func:`wait` for signature parity."""
+    del token, timeout_ns
     return value
 
 
@@ -122,10 +133,28 @@ def notify(sem, peer, inc: int = 1, axis_type=pltpu.DeviceIdType.LOGICAL,
     pltpu.semaphore_signal(sem, inc=inc, device_id=peer, device_id_type=axis_type)
 
 
+def resolve_straggler(straggler, n, call_index=None):
+    """Resolve the rotating straggler form to a concrete ``(rank, cycles)``.
+
+    ``straggler=("rotate", cycles)`` makes rank ``call_index % n`` the
+    straggler — the stress harness's worst case for workspace reuse (a
+    different rank lags every call, so every interleaving of slow-read vs
+    next-write occurs). One shared resolver instead of the branch
+    previously copy-pasted across the stream kernels; the fused one-shot
+    ops (allgather_gemm / gemm_reduce_scatter) pass their config's static
+    ``call_index``. Fixed ``(rank, cycles)`` and ``None`` pass through.
+    """
+    if straggler is None or straggler[0] != "rotate":
+        return straggler
+    idx = 0 if call_index is None else call_index
+    return (jax.lax.rem(idx, n), straggler[1])
+
+
 def maybe_straggle(straggler, me):
     """Fault injection: if ``straggler=(rank, cycles)``, that rank spins
     ``cycles`` before proceeding — widens race windows (reference
-    straggler_option via torch.cuda._sleep). No-op when None."""
+    straggler_option via torch.cuda._sleep). No-op when None. Rotating
+    plans resolve first via :func:`resolve_straggler`."""
     if straggler is None:
         return
     s_rank, cycles = straggler

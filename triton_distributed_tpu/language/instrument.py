@@ -11,9 +11,13 @@ the analyzer must not touch it.
 Every patch target is a ``(module, attribute)`` pair resolved lazily (so
 importing this module never imports jax eagerly beyond what the language
 package already did). ``install`` swaps attributes and returns an undo
-token; ``uninstall`` restores the originals in reverse order. Nesting is
-rejected — one active instrumentation session at a time keeps semantics
-obvious (the analyzer replays ranks sequentially anyway).
+token; ``uninstall`` restores the originals in reverse order. *Base*
+installs do not nest — one analyzer session at a time keeps semantics
+obvious (it replays ranks sequentially anyway) — but ``overlay=True``
+installs stack on TOP of whatever is active: the fault-injection plane
+(``resilience/faults.py``) wraps the tracer's shims this way, so any op
+runs under any fault with zero kernel changes. ``uninstall`` pops layers
+LIFO; an overlay must be removed before the session beneath it.
 """
 
 from __future__ import annotations
@@ -82,7 +86,10 @@ class InstrumentationError(RuntimeError):
     pass
 
 
-_active_token: list | None = None
+# LIFO stack of installed layers. Layer 0 (when present) is the base
+# session (the comm-lint tracer); later entries are overlays (the fault
+# plane). Each layer is the undo token of one install() call.
+_layers: list[list] = []
 
 # Sentinel for a patch point whose attribute does not exist in the installed
 # jax (the surface moves between releases; e.g. ``jax.lax.axis_size`` is
@@ -101,15 +108,21 @@ def originals(names: Iterable[str] | None = None) -> dict[str, Any]:
     return out
 
 
-def install(shims: dict[str, Callable]) -> None:
+def install(shims: dict[str, Callable], *, overlay: bool = False) -> None:
     """Swap in ``shims`` (a mapping from patch-point name to replacement).
 
     Unknown names are rejected so a typo cannot silently leave part of the
     surface uninstrumented. Call :func:`uninstall` to restore.
+
+    ``overlay=True`` stacks this layer on top of an already-installed
+    session instead of rejecting it: the shims replace the *current*
+    surface (typically the tracer's shims, which the overlay captured via
+    :func:`originals` and delegates to). Layers unwind LIFO — every
+    overlay must be uninstalled before the layer beneath it.
     """
-    global _active_token
-    if _active_token is not None:
-        raise InstrumentationError("instrumentation already installed")
+    if _layers and not overlay:
+        raise InstrumentationError("instrumentation already installed "
+                                   "(pass overlay=True to stack a layer)")
     unknown = set(shims) - set(PATCH_POINTS)
     if unknown:
         raise InstrumentationError(f"unknown patch points: {sorted(unknown)}")
@@ -123,7 +136,7 @@ def install(shims: dict[str, Callable]) -> None:
     except Exception:
         _restore(token)
         raise
-    _active_token = token
+    _layers.append(token)
 
 
 def _restore(token) -> None:
@@ -136,8 +149,11 @@ def _restore(token) -> None:
 
 
 def uninstall() -> None:
-    global _active_token
-    if _active_token is None:
+    """Remove the most recent layer (no-op when nothing is installed)."""
+    if not _layers:
         return
-    _restore(_active_token)
-    _active_token = None
+    _restore(_layers.pop())
+
+
+def active_layers() -> int:
+    return len(_layers)

@@ -43,6 +43,16 @@ class Engine:
     path, decode as ONE persistent Pallas kernel per token —
     megakernel/serving.py; the reference's MegaTritonKernel serving ladder,
     docs/mega_triton_kernel.md 3.33 ms row).
+
+    Resilience (docs/resilience.md): ``serve`` retries transient step
+    failures with bounded backoff and DEMOTES down a backend ladder
+    (megakernel → overlap → xla) rather than dying — the xla rung is the
+    golden path and produces token-identical output. A sustained SLO
+    violation streak also demotes; a clean streak probes re-promotion.
+    Env knobs: ``TDTPU_STEP_RETRIES`` (default 1 retry per rung),
+    ``TDTPU_RETRY_BACKOFF_S`` (0.05), ``TDTPU_DEMOTE_AFTER`` (3
+    violation-streak serves), ``TDTPU_PROMOTE_AFTER`` (8 clean serves),
+    ``TDTPU_DEMOTION_LADDER=0`` disables demotion entirely.
     """
 
     def __init__(self, cfg: ModelConfig, params: dict,
@@ -112,6 +122,28 @@ class Engine:
                                  self.param_specs,
                                  is_leaf=lambda x: isinstance(x, P)))
         self._jit_cache: dict = {}
+        # Backend demotion ladder (graceful degradation, ISSUE 6): the
+        # rungs this engine may fall through on persistent transient
+        # failure, best first, always ending at the golden xla path.
+        # Hierarchical engines opt out: their joint (inter, intra) weight
+        # sharding has no same-sharding xla twin to fall onto.
+        self._ladder = self._build_ladder(backend)
+        self._rung = 0
+        self._slo_violation_streak = 0
+        self._slo_clean_streak = 0
+        self._last_slo_section: dict | None = None
+
+    def _build_ladder(self, backend: str) -> list[str]:
+        import os
+
+        if (os.environ.get("TDTPU_DEMOTION_LADDER", "1") == "0"
+                or self.hierarchical):
+            return [backend]
+        if backend == "megakernel":
+            return ["megakernel", "overlap", "xla"]
+        if backend in ("auto", "overlap"):
+            return [backend, "xla"]
+        return [backend]
 
     # -- mode resolution ----------------------------------------------------
     def _prefill_mode(self, batch: int, seq: int) -> str:
@@ -517,9 +549,128 @@ class Engine:
             return tok, cache
         return self._decode_jit(False, batch)(self.params, tokens, cache)
 
+    # -- resilience: retry / demotion ladder --------------------------------
+    @staticmethod
+    def _resilience_cfg() -> dict:
+        import os
+
+        def _num(var, default, cast):
+            try:
+                return cast(os.environ.get(var, "") or default)
+            except ValueError:
+                return cast(default)
+
+        return {
+            "retries": _num("TDTPU_STEP_RETRIES", 1, int),
+            "backoff_s": _num("TDTPU_RETRY_BACKOFF_S", 0.05, float),
+            "demote_after": _num("TDTPU_DEMOTE_AFTER", 3, int),
+            "promote_after": _num("TDTPU_PROMOTE_AFTER", 8, int),
+        }
+
+    def _set_rung(self, rung: int, reason: str) -> None:
+        """Move to ladder rung ``rung``: swap the backend, drop every
+        backend-shaped cache (jit entries key on modes the old backend
+        chose; the megakernel decoder is rebuilt on demand), and record
+        the transition as a ``engine.degradation`` span + health
+        counters."""
+        old, new = self._ladder[self._rung], self._ladder[rung]
+        demoting = rung > self._rung
+        self._rung = rung
+        self.backend = new
+        self._jit_cache.clear()
+        self._mk = None
+        self._gemm_ar_choice = None
+        with obs_trace.span("engine.degradation", from_backend=old,
+                            to_backend=new, reason=reason,
+                            direction="demote" if demoting else "promote"):
+            pass
+        reg = obs_metrics.registry()
+        reg.counter("tdtpu_engine_demotions_total" if demoting
+                    else "tdtpu_engine_promotions_total",
+                    "backend ladder transitions").inc()
+        reg.gauge("tdtpu_engine_backend_rung",
+                  "current demotion-ladder rung (0 = requested backend)"
+                  ).set(self._rung)
+        import warnings
+
+        warnings.warn(
+            f"engine backend {'demoted' if demoting else 'promoted'}: "
+            f"{old} -> {new} ({reason})", RuntimeWarning, stacklevel=3)
+
+    def _slo_streak_update(self) -> None:
+        """Consume the SLO section the watchdog just computed: publish the
+        violation streak to the metrics registry (the gate and the
+        demotion logic both read it), demote on a sustained streak, and
+        probe re-promotion after a sustained clean streak."""
+        sec = self._last_slo_section
+        self._last_slo_section = None
+        if sec is None:
+            return
+        cfg = self._resilience_cfg()
+        if sec.get("violations", 0):
+            self._slo_violation_streak += 1
+            self._slo_clean_streak = 0
+        else:
+            self._slo_clean_streak += 1
+            self._slo_violation_streak = 0
+        reg = obs_metrics.registry()
+        reg.gauge("tdtpu_slo_violation_streak",
+                  "consecutive serve() calls with >=1 SLO violation"
+                  ).set(self._slo_violation_streak)
+        if (self._slo_violation_streak >= cfg["demote_after"]
+                and self._rung + 1 < len(self._ladder)):
+            self._set_rung(self._rung + 1, "slo_violation_streak")
+            self._slo_violation_streak = 0
+        elif (self._slo_clean_streak >= cfg["promote_after"]
+                and self._rung > 0):
+            self._set_rung(self._rung - 1, "slo_clean_streak")
+            self._slo_clean_streak = 0
+
     def serve(self, input_ids: jax.Array, gen_len: int,
               profile_dir: str | None = None) -> jax.Array:
-        """Greedy generation (reference Engine.serve, engine.py:113).
+        """Greedy generation (reference Engine.serve, engine.py:113) with
+        graceful degradation: transient step failures (injected faults,
+        comm deadline expiries, backend/runtime errors — see
+        ``resilience.is_transient``) are retried with bounded backoff and,
+        once the rung's retry budget is spent, demote the backend down the
+        ladder toward the golden xla path instead of killing the serve.
+        Greedy decode makes the demoted output token-identical. See
+        :meth:`_serve_once` for the observability contract."""
+        from triton_distributed_tpu import resilience
+
+        cfg = self._resilience_cfg()
+        attempt = 0
+        while True:
+            try:
+                out = self._serve_once(input_ids, gen_len, profile_dir)
+            except Exception as exc:
+                if not resilience.is_transient(exc):
+                    raise
+                reg = obs_metrics.registry()
+                reg.counter("tdtpu_engine_step_retries_total",
+                            "serve attempts retried on transient failure"
+                            ).inc()
+                with obs_trace.span("engine.step_failure",
+                                    backend=self.backend,
+                                    error=type(exc).__name__):
+                    pass
+                if attempt < cfg["retries"]:
+                    attempt += 1
+                    time.sleep(cfg["backoff_s"] * attempt)
+                    continue
+                if self._rung + 1 < len(self._ladder):
+                    self._set_rung(
+                        self._rung + 1,
+                        f"transient failure: {type(exc).__name__}")
+                    attempt = 0
+                    continue
+                raise
+            self._slo_streak_update()
+            return out
+
+    def _serve_once(self, input_ids: jax.Array, gen_len: int,
+                    profile_dir: str | None = None) -> jax.Array:
+        """One serve attempt (no retry/demotion).
 
         ``profile_dir`` wraps the decode loop in a jax.profiler trace (the
         reference's optional 64-step profile → trace_static.json,
@@ -570,7 +721,11 @@ class Engine:
             from triton_distributed_tpu import obs
             from triton_distributed_tpu.obs import slo as obs_slo
 
-            obs_slo.check_serving(reg, run_dir=obs.active_run_dir())
+            # The section is consumed by the resilient serve wrapper:
+            # the violation streak feeds the metrics registry and the
+            # demotion ladder (docs/resilience.md).
+            self._last_slo_section = obs_slo.check_serving(
+                reg, run_dir=obs.active_run_dir())
         except Exception as e:
             import warnings
 

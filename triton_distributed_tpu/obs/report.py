@@ -317,7 +317,27 @@ def summarize(run_dir: str, lanes: dict, metrics: dict | None,
                 else "—"  # noqa: E731
             lines.append(f"  {r['rule']:28s} observed={fmt(obs_v)} "
                          f"threshold={fmt(thr)}  {r['status']}")
+    demotions = degradation_count(metrics)
+    if demotions or (metrics and "tdtpu_engine_step_retries_total"
+                     in metrics):
+        lines.append("")
+        lines.append("degradation (docs/resilience.md):")
+        for name in ("tdtpu_engine_demotions_total",
+                     "tdtpu_engine_promotions_total",
+                     "tdtpu_engine_step_retries_total",
+                     "tdtpu_engine_backend_rung",
+                     "tdtpu_slo_violation_streak"):
+            m = (metrics or {}).get(name)
+            if m is not None:
+                lines.append(f"  {name} = {m.get('value', 0):g}")
     return "\n".join(lines)
+
+
+def degradation_count(metrics: dict | None) -> float:
+    """Backend demotions recorded in a metrics snapshot (0 when the
+    series is absent — an engine that never degraded registers nothing)."""
+    m = (metrics or {}).get("tdtpu_engine_demotions_total") or {}
+    return float(m.get("value") or 0.0)
 
 
 # ---------------------------------------------------------------------------
@@ -428,6 +448,10 @@ def main(argv: list[str] | None = None) -> int:
                          "profiled megakernel step)")
     ap.add_argument("--allow-slo-violations", action="store_true",
                     help="report SLO violations without failing --check")
+    ap.add_argument("--allow-degradation", action="store_true",
+                    help="report backend demotions without failing "
+                         "--check (by default an unexpected demotion in "
+                         "the snapshot fails the degradation lane)")
     args = ap.parse_args(argv)
 
     if args.dryrun:
@@ -497,6 +521,11 @@ def main(argv: list[str] | None = None) -> int:
                 failures.append(
                     f"SLO violation: {r['rule']} observed "
                     f"{r['observed']:g} vs threshold {r['threshold']:g}")
+    demotions = degradation_count(metrics)
+    if demotions and not args.allow_degradation:
+        failures.append(
+            f"degradation: {demotions:g} unexpected backend demotion(s) "
+            "in the snapshot (--allow-degradation to accept)")
     if failures:
         for msg in failures:
             print(f"CHECK FAIL: {msg}", file=sys.stderr)

@@ -195,8 +195,7 @@ def _a2a_parity_kernel(n: int, axis: str, cap: int, block: int, straggler,
     """
     me = dl.rank(axis)
     p = jax.lax.rem(idx_ref[0], 2)
-    if straggler is not None and straggler[0] == "rotate":
-        straggler = (jax.lax.rem(idx_ref[0], n), straggler[1])
+    straggler = dl.resolve_straggler(straggler, n, idx_ref[0])
     dl.maybe_straggle(straggler, me)
     slab = ws.at[p]                     # (n, cap, hidden) parity slab
     block_like = send_ref.at[0, pl.ds(0, block)]

@@ -196,8 +196,7 @@ def _ag_parity_kernel(n: int, axis: str, m: int, straggler,
 
     me = dl.rank(axis)
     p = jax.lax.rem(idx_ref[0], 2)
-    if straggler is not None and straggler[0] == "rotate":
-        straggler = (jax.lax.rem(idx_ref[0], n), straggler[1])
+    straggler = dl.resolve_straggler(straggler, n, idx_ref[0])
     dl.maybe_straggle(straggler, me)
     slab = ws.at[p]                       # (n·m, cols) parity slab
     my_slot = slab.at[pl.ds(me * m, m)]

@@ -49,6 +49,10 @@ class AGGemmConfig:
     ``straggler``: optional (rank, cycles) fault injection — that rank spins
     ``cycles`` before producing, widening race windows (reference
     straggler_option, allgather_gemm.py:602-603 via torch.cuda._sleep).
+    The rotating form ``("rotate", cycles)`` is accepted too (uniform
+    fault coverage with the stream collectives): it resolves against the
+    static ``call_index`` — rank ``call_index % n`` straggles; stress
+    harnesses vary ``call_index`` across calls.
 
     ``sub_chunks``: split each rank's shard into this many sub-blocks with
     per-sub-block delivery semaphores — the consumer starts on a remote
@@ -64,6 +68,7 @@ class AGGemmConfig:
     tile_n: int = 1024
     tile_k: int = 1024
     straggler: tuple | None = None
+    call_index: int = 0
     sub_chunks: int = 2
     # Run the degenerate 0-peer kernel at n=1 (single-chip Mosaic compile
     # check of the sub-chunk wait structure, scripts/check_on_chip.py).
@@ -164,8 +169,9 @@ def ag_gemm_local(x_local: jax.Array, b_local: jax.Array, axis: str = "tp",
     # m/sub would make matmul_tiles' floored grid silently drop the
     # sub-block's remainder rows.
     tm, tk, tn = gemm_tiles(m // sub, k, ncols, x_local.dtype, cfg)
+    straggler = dl.resolve_straggler(cfg.straggler, n, cfg.call_index)
     kernel = functools.partial(_ag_gemm_kernel, n, axis, m, k, ncols,
-                               (tm, tk, tn), cfg.straggler, sub)
+                               (tm, tk, tn), straggler, sub)
     ws = jax.ShapeDtypeStruct((n * m, k), x_local.dtype)  # AG landing ws
     out_shape = jax.ShapeDtypeStruct((n * m, ncols), x_local.dtype)
     # With return_gathered the landing workspace is promoted to a real

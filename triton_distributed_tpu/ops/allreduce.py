@@ -128,11 +128,7 @@ def _ar_one_shot_parity_kernel(n: int, axis: str, m: int, tile_m: int,
     """
     me = dl.rank(axis)
     p = jax.lax.rem(idx_ref[0], 2)
-    if straggler is not None and straggler[0] == "rotate":
-        # Rotating straggler: rank (call_index mod n) spins — the stress
-        # harness's worst case for parity reuse (a different rank lags every
-        # call, so every interleaving of slow-read vs next-write occurs).
-        straggler = (jax.lax.rem(idx_ref[0], n), straggler[1])
+    straggler = dl.resolve_straggler(straggler, n, idx_ref[0])
     dl.maybe_straggle(straggler, me)
     slots = ws.at[p]                          # (n, m, cols) parity slab
     local = pltpu.make_async_copy(x_ref, slots.at[me], copy_sem)

@@ -43,12 +43,15 @@ class GemmRSConfig:
     """Tile configuration (ReduceScatter2DContext analog,
     reduce_scatter.py:47-147). ``straggler``: optional (rank, cycles)
     fault injection — that rank spins before producing, widening race
-    windows (reference straggler_option; same hook as AGGemmConfig)."""
+    windows (reference straggler_option; same hook as AGGemmConfig,
+    including the rotating ``("rotate", cycles)`` form resolved against
+    the static ``call_index``)."""
 
     tile_m: int = 512
     tile_n: int = 1024
     tile_k: int = 1024
     straggler: tuple | None = None
+    call_index: int = 0
 
 
 def _gemm_rs_kernel(n: int, axis: str, m_total: int, k: int, ncols: int,
@@ -139,8 +142,9 @@ def gemm_rs_local(x_local: jax.Array, b_local: jax.Array, axis: str = "tp",
                              tile_n=cfg.tile_n, tile_k=cfg.tile_k)
     mc = m_total // n
     tm, tk, tn = gemm_tiles(mc, k, ncols, x_local.dtype, cfg)
+    straggler = dl.resolve_straggler(cfg.straggler, n, cfg.call_index)
     kernel = functools.partial(_gemm_rs_kernel, n, axis, m_total, k, ncols,
-                               (tm, tk, tn), cfg.straggler)
+                               (tm, tk, tn), straggler)
     out = kernel_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((mc, ncols), x_local.dtype),

@@ -1,0 +1,76 @@
+"""Resilience subsystem: fault injection, deadlines, graceful degradation.
+
+The reference's semaphore protocols fail by *hanging* or by silently
+corrupting a tile — the worst failure modes for a serving tier. The
+analysis layer (commlint) is the detection half; this package is the
+survival half (ISSUE 6):
+
+* :mod:`~triton_distributed_tpu.resilience.faults` — a seeded,
+  deterministic fault-injection plane layered over the same
+  ``language/instrument.py`` patch-point registry commlint uses, so any
+  op runs under any fault class with zero kernel changes;
+* :mod:`~triton_distributed_tpu.resilience.deadline` — deadline-bounded
+  semaphore waits: a hang becomes a structured :class:`CommTimeoutError`
+  naming the semaphore, rank, expected delta and observed count;
+* :mod:`~triton_distributed_tpu.resilience.chaos` — the chaos-sweep CLI
+  (``python -m triton_distributed_tpu.resilience.chaos --all``) driving
+  the fault matrix across the op registry: every injected fault must be
+  *tolerated* (bit-parity with the clean run) or *detected* (named
+  diagnostic) — never a hang, never silent corruption;
+* Engine degradation lives in ``models/engine.py`` (the backend demotion
+  ladder megakernel → overlap → xla with bounded retry), driven by
+  :func:`is_transient` and the SLO watchdog — docs/resilience.md.
+"""
+
+from __future__ import annotations
+
+from triton_distributed_tpu.resilience.deadline import (  # noqa: F401
+    CommTimeoutError,
+    drain_timeout_events,
+    wait_nap_s,
+    wait_timeout_s,
+)
+from triton_distributed_tpu.resilience.faults import (  # noqa: F401
+    FaultClass,
+    FaultInjectionError,
+    FaultPlan,
+)
+
+__all__ = [
+    "CommTimeoutError", "FaultClass", "FaultInjectionError", "FaultPlan",
+    "drain_timeout_events", "is_transient", "wait_nap_s", "wait_timeout_s",
+]
+
+
+def is_transient(exc: BaseException) -> bool:
+    """True when ``exc`` is a failure class the Engine demotion ladder may
+    retry/degrade around: injected faults, comm deadline expiries, and
+    runtime/backend errors (a Mosaic compile failure, an interpreter DMA
+    limit, an OOM). Programming errors (``ValueError``/``TypeError``/
+    ``KeyError``) propagate — demoting around a bad argument would only
+    mask the bug."""
+    if isinstance(exc, (FaultInjectionError, CommTimeoutError)):
+        return True
+    # Errors from inside the traced/compiled step carry jax's trace-time
+    # or runtime wrapper in their chain (XlaRuntimeError from jaxlib,
+    # JaxStackTraceBeforeTransformation on any error raised mid-trace,
+    # e.g. an interpreter DMA limit surfacing as TypeError deep in the
+    # discharge rules). Those are backend failures — demotable — whatever
+    # their surface type; match by name so no jaxlib import is needed.
+    names = {type(e).__name__ for e in _exc_chain(exc)}
+    if names & {"XlaRuntimeError", "JaxRuntimeError",
+                "JaxStackTraceBeforeTransformation"}:
+        return True
+    if isinstance(exc, (ValueError, TypeError, KeyError, AttributeError)):
+        return False
+    # OSError is deliberately NOT transient: a bad profile_dir or a full
+    # disk is a configuration problem — demoting backends won't fix it.
+    return isinstance(exc, (RuntimeError, NotImplementedError))
+
+
+def _exc_chain(exc: BaseException):
+    seen = set()
+    while exc is not None and id(exc) not in seen:
+        seen.add(id(exc))
+        yield exc
+        exc = exc.__cause__ or exc.__context__

@@ -1,0 +1,198 @@
+"""Deadline-bounded waits — a hang becomes a structured, named error.
+
+The TPU-interpret emulation's ``Semaphore.wait`` (patched by
+``runtime/interpret_workarounds.py``) used to nap 5 ms forever while a
+count stayed insufficient: an interpret-mode protocol deadlock surfaced
+as an 870 s tier-1 timeout with zero diagnostics. This module owns the
+bounded form:
+
+* :func:`semaphore_wait_with_deadline` — the wait loop itself, duck-typed
+  over the interpret ``Semaphore`` object (``cv`` / ``count_by_core`` /
+  ``shared_memory`` / ``id``) so it is unit-testable on any jax version,
+  including ones whose interpret machinery is absent;
+* :class:`CommTimeoutError` — raised when the budget expires, naming the
+  semaphore, rank/core, expected delta, observed count and waited time;
+* a checkable event log — every expiry also records an
+  ``analysis/events.py`` :class:`~.events.Event` of kind ``timeout``
+  (drain with :func:`drain_timeout_events`) so tests and the chaos sweep
+  can assert a hang was converted, not merely crashed.
+
+Budgets resolve env → context → default:
+
+* ``TDTPU_WAIT_TIMEOUT_MS`` — total budget per wait (default
+  ``DEFAULT_TIMEOUT_MS`` = 300 000 ms, a fail-loud ceiling well under the
+  tier-1 870 s budget; ``0`` or negative disables the deadline);
+* ``TDTPU_WAIT_NAP_MS`` — condition-variable nap interval (default 5 ms);
+* ``DistContext.wait_timeout_ms`` (``runtime/context.py``) — per-context
+  override consulted when the env var is unset.
+
+The budget is a *progress* deadline: it resets whenever the count moves
+or an executable task runs, so a slow-but-live protocol never trips it —
+only a wait that sees no progress for the whole budget does.
+
+On real TPU hardware none of this applies: ``pltpu.semaphore_wait`` has
+no timeout lowering, and deadlocks there are the domain of the static
+checker (commlint) which proves schedulability before the kernel ships.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any
+
+# Fail-loud default: interpret-mode deadlocks surface as structured errors
+# in minutes, not as the tier-1 suite's 870 s kill.
+DEFAULT_TIMEOUT_MS = 300_000.0
+DEFAULT_NAP_MS = 5.0
+
+# Bounded log of converted hangs (analysis.events.Event, kind="timeout").
+_TIMEOUT_EVENTS: list = []
+_TIMEOUT_EVENTS_MAX = 256
+_LOG_LOCK = threading.Lock()
+
+
+class CommTimeoutError(RuntimeError):
+    """A semaphore wait exceeded its deadline — the structured replacement
+    for an infinite spin. Carries every field a postmortem needs."""
+
+    def __init__(self, *, sem: Any, rank: int, expected: int,
+                 observed: int, waited_s: float, timeout_s: float):
+        self.sem = sem
+        self.rank = int(rank)
+        self.expected = int(expected)
+        self.observed = int(observed)
+        self.waited_s = float(waited_s)
+        self.timeout_s = float(timeout_s)
+        super().__init__(
+            f"semaphore wait deadline expired: sem={sem!r} rank/core="
+            f"{rank} expected delta {expected}, observed count {observed} "
+            f"after {waited_s:.1f}s (budget {timeout_s:.1f}s, "
+            "TDTPU_WAIT_TIMEOUT_MS) — the producer never signalled; see "
+            "docs/resilience.md for the fault taxonomy")
+
+
+def _env_ms(var: str, fallback: float) -> float:
+    v = os.environ.get(var)
+    if v in (None, ""):
+        return fallback
+    try:
+        return float(v)
+    except ValueError:
+        import warnings
+
+        warnings.warn(f"{var}={v!r} is not a number — using default "
+                      f"{fallback:g} ms", RuntimeWarning, stacklevel=3)
+        return fallback
+
+
+def wait_timeout_s() -> float:
+    """Resolved total wait budget in seconds; ``0.0`` = unbounded.
+
+    Resolution order: ``TDTPU_WAIT_TIMEOUT_MS`` env, then the active
+    ``DistContext.wait_timeout_ms`` (if a context is initialized), then
+    :data:`DEFAULT_TIMEOUT_MS`."""
+    v = os.environ.get("TDTPU_WAIT_TIMEOUT_MS")
+    if v not in (None, ""):
+        ms = _env_ms("TDTPU_WAIT_TIMEOUT_MS", DEFAULT_TIMEOUT_MS)
+        return max(ms, 0.0) / 1e3
+    try:
+        from triton_distributed_tpu.runtime.context import get_context
+
+        ctx_ms = getattr(get_context(), "wait_timeout_ms", None)
+        if ctx_ms is not None:
+            return max(float(ctx_ms), 0.0) / 1e3
+    except Exception:
+        pass  # no context initialized — the default ceiling stands
+    return DEFAULT_TIMEOUT_MS / 1e3
+
+
+def wait_nap_s() -> float:
+    """Condition-variable nap interval in seconds (>= 0.1 ms)."""
+    return max(_env_ms("TDTPU_WAIT_NAP_MS", DEFAULT_NAP_MS), 0.1) / 1e3
+
+
+def record_timeout(*, sem: Any, rank: int, expected: int,
+                   observed: int, waited_s: float) -> None:
+    """Append a checkable ``timeout`` event to the bounded module log."""
+    from triton_distributed_tpu.analysis import events as ev
+
+    e = ev.Event(kind=ev.TIMEOUT, rank=int(rank), seq=0, sem=str(sem),
+                 amount=int(expected),
+                 note=f"observed={int(observed)} waited_s={waited_s:.3f}")
+    with _LOG_LOCK:
+        _TIMEOUT_EVENTS.append(e)
+        del _TIMEOUT_EVENTS[:-_TIMEOUT_EVENTS_MAX]
+
+
+def drain_timeout_events() -> list:
+    """Return and clear the recorded timeout events."""
+    with _LOG_LOCK:
+        out = list(_TIMEOUT_EVENTS)
+        _TIMEOUT_EVENTS.clear()
+    return out
+
+
+def semaphore_wait_with_deadline(sem: Any, value, global_core_id, *,
+                                 has_tasks: bool = False,
+                                 timeout_s: float | None = None,
+                                 nap_s: float | None = None):
+    """Blocking-CV semaphore wait with a progress deadline.
+
+    Drop-in body for the interpret-mode ``Semaphore.wait`` patch
+    (``runtime/interpret_workarounds.py``): blocks on ``sem.cv`` until
+    ``sem.count_by_core[core] >= value`` then consumes, executing queued
+    interpreter tasks when ``has_tasks``. Duck-typed: ``sem`` needs
+    ``cv`` (a ``threading.Condition``), ``count_by_core`` (int mapping),
+    ``id``, and — only when ``has_tasks`` — ``shared_memory.lock`` /
+    ``shared_memory.tasks_by_sem``.
+
+    Raises :class:`CommTimeoutError` (after recording a checkable
+    ``timeout`` event) once no progress has been observed for the
+    resolved budget. Progress = the observed count changed or a queued
+    task ran; either resets the deadline.
+    """
+    if timeout_s is None:
+        timeout_s = wait_timeout_s()
+    if nap_s is None:
+        nap_s = wait_nap_s()
+    core = int(global_core_id)
+    value = int(value)
+    t_start = time.monotonic()
+    deadline = t_start + timeout_s if timeout_s > 0 else None
+    last_count = None
+    while True:
+        with sem.cv:
+            count = sem.count_by_core[core]
+            if count >= value:
+                sem.count_by_core[core] -= value
+                return
+        task = None
+        if has_tasks:
+            with sem.shared_memory.lock:
+                queue = sem.shared_memory.tasks_by_sem[(sem.id, core)]
+                if len(queue) > 0:
+                    task = queue.pop()
+        if task is not None:
+            task()
+            if deadline is not None:
+                deadline = time.monotonic() + timeout_s  # progress
+            continue
+        with sem.cv:
+            count = sem.count_by_core[core]
+            if count >= value:
+                continue  # consume under the lock on the next iteration
+            if last_count is not None and count != last_count:
+                if deadline is not None:
+                    deadline = time.monotonic() + timeout_s  # progress
+            last_count = count
+            if deadline is not None and time.monotonic() >= deadline:
+                waited = time.monotonic() - t_start
+                record_timeout(sem=getattr(sem, "id", "?"), rank=core,
+                               expected=value, observed=count,
+                               waited_s=waited)
+                raise CommTimeoutError(
+                    sem=getattr(sem, "id", "?"), rank=core, expected=value,
+                    observed=count, waited_s=waited, timeout_s=timeout_s)
+            sem.cv.wait(timeout=nap_s)

@@ -1,0 +1,478 @@
+"""Seeded, deterministic fault-injection plane over the patch-point registry.
+
+A :class:`FaultPlan` describes ONE fault occurrence (or a persistent
+fault) of one :class:`FaultClass` and installs itself as an *overlay* on
+the same ``language/instrument.py`` patch points the comm-lint tracer
+shims — so any op in the registry runs under any fault with zero kernel
+changes, and the same plan drives both the replay lane (the chaos sweep,
+``resilience/chaos.py``) and real execution (the Engine demotion tests).
+
+Fault classes and their injection sites:
+
+=================  ========================================================
+``drop_signal``    the k-th signal-carrying action (notify / signal_op /
+                   semaphore_signal / a put's delivery) on the target rank
+                   is swallowed — a dropped notify or lost DMA delivery.
+``dup_signal``     the same action is issued twice — a duplicated signal
+                   or double delivery.
+``delay_delivery`` the k-th put's issue is deferred to the rank's next
+                   wait-family call (the maximal *legal* delay: a started
+                   DMA always completes, so deferral never crosses the
+                   issuing program's own blocking wait).
+``reorder_delivery``  two adjacent puts issue in swapped order (DMA
+                   completion order is unspecified; protocols must not
+                   depend on issue order either).
+``corrupt_payload``  deterministic garbage is written over the delivery's
+                   landing region before the put — a corrupted tile
+                   arriving at the consumer.
+``straggle``       the target rank spins ``cycles`` at its k-th ``rank()``
+                   query — the generalized straggler (works on every op,
+                   unlike the per-op ``straggler=`` hooks).
+``crash``          the k-th ``pallas_call`` raises a structured
+                   :class:`FaultInjectionError` — a dying kernel launch
+                   (what the Engine demotion ladder retries around).
+=================  ========================================================
+
+Determinism: the occurrence index ``k`` derives from ``seed`` (or is
+given explicitly), the target rank is fixed, and no wall clock or global
+RNG is consulted — the same plan over the same op replays identically.
+Every fired fault is recorded as a :class:`FaultEvent` (the *named
+diagnostic* the chaos sweep asserts on).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import enum
+import hashlib
+from typing import Any, Callable
+
+import numpy as np
+
+from triton_distributed_tpu.language import instrument
+
+
+class FaultClass(enum.Enum):
+    DROP_SIGNAL = "drop_signal"
+    DUP_SIGNAL = "dup_signal"
+    DELAY_DELIVERY = "delay_delivery"
+    REORDER_DELIVERY = "reorder_delivery"
+    CORRUPT_PAYLOAD = "corrupt_payload"
+    STRAGGLE = "straggle"
+    CRASH = "crash"
+
+
+class FaultInjectionError(RuntimeError):
+    """An injected crash fault — structured and named so callers (the
+    Engine retry ladder, the chaos sweep) can tell it from a real bug."""
+
+    def __init__(self, message: str, *, point: str = "", rank=None):
+        self.point = point
+        self.rank = rank
+        super().__init__(message)
+
+
+@dataclasses.dataclass
+class FaultEvent:
+    """One fired fault — the named diagnostic record."""
+
+    cls: str            # FaultClass value
+    point: str          # patch-point name the fault fired at
+    rank: int | None    # replay rank (None outside a replay session)
+    detail: str         # semaphore/peer/bytes description
+
+    def to_json(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+_PUT_POINTS = ("putmem_nbi_block", "putmem_block", "putmem_signal_nbi_block")
+_SIGNAL_POINTS = ("notify", "pkg_notify", "signal_op", "semaphore_signal")
+# Wait-family points that flush deferred puts before executing (a deferral
+# must never cross the issuing program's own blocking wait).
+_FLUSH_POINTS = ("wait", "pkg_wait", "semaphore_wait", "signal_wait_until",
+                 "wait_deliveries", "quiet", "barrier_all", "sync_all",
+                 "barrier_grid")
+# make_async_copy handles are a wait site too (the unstarted equal-shape
+# wait idiom fences DMA completions); wrapped separately since the wait
+# lives on the returned handle, not the call.
+_MAC_POINT = "make_async_copy"
+
+
+class _NullHandle:
+    """Stand-in for a dropped put: the DMA never happened, so every fence
+    on it is a no-op (and its semaphores are never credited)."""
+
+    def start(self):
+        return self
+
+    def wait_send(self):
+        pass
+
+    def wait_recv(self):
+        pass
+
+    def wait(self):
+        pass
+
+
+class _DeferredHandle:
+    """Proxy for a put whose issue is deferred (delay/reorder): resolving
+    — any wait on it, or a plan flush — issues the real call."""
+
+    def __init__(self, plan: "FaultPlan", thunk: Callable[[], Any]):
+        self._plan = plan
+        self._thunk = thunk
+        self._h = None
+
+    def _issue(self):
+        if self._h is None:
+            self._h = self._thunk()
+        return self._h
+
+    def _resolve(self):
+        if self._h is None:
+            self._plan.flush()
+        return self._h
+
+    def start(self):
+        return self
+
+    def wait_send(self):
+        self._resolve().wait_send()
+
+    def wait_recv(self):
+        self._resolve().wait_recv()
+
+    def wait(self):
+        self._resolve().wait()
+
+
+class _FlushingHandle:
+    """Wraps a local-copy handle so its wait methods flush deferred puts
+    first — the copy's wait is a blocking point of the issuing program."""
+
+    def __init__(self, plan: "FaultPlan", h):
+        self._plan = plan
+        self._h = h
+
+    def start(self):
+        self._h.start()
+        return self
+
+    def wait(self):
+        self._plan.flush()
+        self._h.wait()
+
+    def wait_send(self):
+        self._plan.flush()
+        self._h.wait_send()
+
+    def wait_recv(self):
+        self._plan.flush()
+        self._h.wait_recv()
+
+    @property
+    def nbytes(self):
+        return self._h.nbytes
+
+
+class FaultPlan:
+    """One seeded fault (see module docstring).
+
+    ``fault=None`` is the *clean* plan: no injection, but the parity
+    oracle (output hashing) still runs — the chaos sweep uses it for the
+    clean baseline so clean and faulted runs share one code path.
+    """
+
+    def __init__(self, fault: FaultClass | None, *, seed: int = 0,
+                 target_rank: int | None = 0, occurrence: int | None = None,
+                 cycles: int = 256, persistent: bool = False,
+                 hash_outputs: bool = False, match: str | None = None):
+        self.fault = fault
+        # ``match``: restrict crash faults to pallas_calls whose kernel
+        # name contains this substring — "a persistent fault on the fused
+        # path" is ``match="_ag_gemm"``; unmatched launches (the golden
+        # xla path's flash kernels) run untouched.
+        self.match = match
+        self.seed = int(seed)
+        self.target_rank = target_rank
+        # The occurrence index is the seed's only consumer: small on
+        # purpose (protocol call counts per rank are small) and
+        # deterministic for a given seed.
+        self.occurrence = (int(occurrence) if occurrence is not None
+                           else int(np.random.default_rng(seed).integers(0, 3)))
+        self.cycles = int(cycles)
+        self.persistent = bool(persistent)
+        self.hash_outputs = bool(hash_outputs)
+        self.fired: list[FaultEvent] = []
+        self.output_hashes: list[str] = []
+        self._rank: int | None = None
+        self._count = 0
+        self._pending: list[_DeferredHandle] = []
+
+    # -- bookkeeping --------------------------------------------------------
+    def begin_rank(self, rank: int | None) -> None:
+        """Reset the per-rank occurrence counter (the chaos sweep calls
+        this as the tracer moves to the next replayed rank)."""
+        self.flush()
+        self._rank = rank
+        self._count = 0
+
+    def _on_target(self) -> bool:
+        return (self.target_rank is None or self._rank is None
+                or self._rank == self.target_rank)
+
+    def _should_fire(self) -> bool:
+        """Count one eligible call; True when this is the occurrence (or
+        any occurrence, for persistent plans) on the target rank."""
+        if self.fault is None or not self._on_target():
+            return False
+        i = self._count
+        self._count += 1
+        return self.persistent or i == self.occurrence
+
+    def _record(self, point: str, detail: str) -> FaultEvent:
+        e = FaultEvent(cls=self.fault.value, point=point, rank=self._rank,
+                       detail=detail)
+        self.fired.append(e)
+        return e
+
+    def flush(self) -> None:
+        """Issue every deferred put (in deferral order)."""
+        pending, self._pending = self._pending, []
+        for h in pending:
+            h._issue()
+
+    def _hash(self, out) -> None:
+        leaves = out if isinstance(out, (tuple, list)) else (out,)
+        h = hashlib.sha1()
+        for leaf in leaves:
+            arr = np.asarray(leaf)
+            h.update(str(arr.shape).encode())
+            h.update(str(arr.dtype).encode())
+            h.update(arr.tobytes())
+        self.output_hashes.append(h.hexdigest())
+
+    # -- shims --------------------------------------------------------------
+    def _sem_name(self, sem) -> str:
+        return getattr(sem, "label", None) or str(sem)
+
+    def _wrap_put(self, point: str, under: Callable) -> Callable:
+        plan = self
+
+        def put(src_ref, dst_ref, send_sem, recv_sem, peer, axis=None):
+            f = plan.fault
+            if f is FaultClass.CORRUPT_PAYLOAD and plan._should_fire():
+                detail = plan._corrupt(dst_ref, recv_sem, peer)
+                plan._record(point, detail)
+                return under(src_ref, dst_ref, send_sem, recv_sem, peer,
+                             axis)
+            if f is FaultClass.DROP_SIGNAL and plan._should_fire():
+                plan._record(
+                    point,
+                    f"dropped delivery of {getattr(src_ref, 'nbytes', '?')}"
+                    f" bytes on {plan._sem_name(recv_sem)} to peer {peer}")
+                return _NullHandle()
+            if f is FaultClass.DUP_SIGNAL and plan._should_fire():
+                h = under(src_ref, dst_ref, send_sem, recv_sem, peer, axis)
+                under(src_ref, dst_ref, send_sem, recv_sem, peer, axis)
+                plan._record(
+                    point,
+                    f"duplicated delivery of "
+                    f"{getattr(src_ref, 'nbytes', '?')} bytes on "
+                    f"{plan._sem_name(recv_sem)} to peer {peer}")
+                return h
+            if f in (FaultClass.DELAY_DELIVERY, FaultClass.REORDER_DELIVERY):
+                thunk = lambda: under(src_ref, dst_ref, send_sem, recv_sem,  # noqa: E731
+                                      peer, axis)
+                if plan._pending and f is FaultClass.REORDER_DELIVERY:
+                    # Adjacent swap: issue this put now, then the deferred
+                    # one — delivery issue order inverted.
+                    h = thunk()
+                    plan.flush()
+                    return h
+                if plan._should_fire():
+                    verb = ("deferred" if f is FaultClass.DELAY_DELIVERY
+                            else "reordered")
+                    plan._record(
+                        point,
+                        f"{verb} delivery on {plan._sem_name(recv_sem)} "
+                        f"to peer {peer}")
+                    proxy = _DeferredHandle(plan, thunk)
+                    plan._pending.append(proxy)
+                    return proxy
+                return thunk()
+            return under(src_ref, dst_ref, send_sem, recv_sem, peer, axis)
+
+        return put
+
+    def _corrupt(self, dst_ref, recv_sem, peer) -> str:
+        """Deterministic garbage over the landing region. In the replay
+        lane ``dst_ref`` is the SPMD-local view of the delivery target, so
+        the corruption lands exactly where the consumer reads."""
+        arr = getattr(dst_ref, "_arr", None)
+        if arr is None or arr.size == 0:
+            return f"corrupt fault on non-replay ref to peer {peer}"
+        if np.issubdtype(arr.dtype, np.floating):
+            arr[...] = -(np.abs(np.asarray(arr)) + arr.dtype.type(97.0))
+        else:
+            arr[...] = np.bitwise_xor(
+                np.asarray(arr).astype(np.int64), 0x5A).astype(arr.dtype)
+        return (f"corrupted {arr.nbytes} landing bytes on "
+                f"{self._sem_name(recv_sem)} bound for peer {peer}")
+
+    def _wrap_signal(self, point: str, under: Callable) -> Callable:
+        plan = self
+
+        def signal(sem, peer, *args, **kwargs):
+            f = plan.fault
+            if f is FaultClass.DROP_SIGNAL and plan._should_fire():
+                plan._record(point, f"dropped signal on "
+                                    f"{plan._sem_name(sem)} to peer {peer}")
+                return None
+            if f is FaultClass.DUP_SIGNAL and plan._should_fire():
+                under(sem, peer, *args, **kwargs)
+                plan._record(point, f"duplicated signal on "
+                                    f"{plan._sem_name(sem)} to peer {peer}")
+            return under(sem, peer, *args, **kwargs)
+
+        return signal
+
+    def _wrap_sem_signal(self, point: str, under: Callable) -> Callable:
+        """pltpu.semaphore_signal: peer rides the device_id kwarg."""
+        plan = self
+
+        def signal(sem, inc: int = 1, **kwargs):
+            f = plan.fault
+            peer = kwargs.get("device_id")
+            if f is FaultClass.DROP_SIGNAL and plan._should_fire():
+                plan._record(point, f"dropped signal on "
+                                    f"{plan._sem_name(sem)} to peer {peer}")
+                return None
+            if f is FaultClass.DUP_SIGNAL and plan._should_fire():
+                under(sem, inc, **kwargs)
+                plan._record(point, f"duplicated signal on "
+                                    f"{plan._sem_name(sem)} to peer {peer}")
+            return under(sem, inc, **kwargs)
+
+        return signal
+
+    def _wrap_flush(self, point: str, under: Callable) -> Callable:
+        plan = self
+
+        def flushing(*args, **kwargs):
+            plan.flush()
+            return under(*args, **kwargs)
+
+        return flushing
+
+    def _wrap_mac(self, point: str, under: Callable) -> Callable:
+        plan = self
+
+        def make_async_copy(src_ref, dst_ref, sem):
+            return _FlushingHandle(plan, under(src_ref, dst_ref, sem))
+
+        return make_async_copy
+
+    def _wrap_rank(self, point: str, under: Callable) -> Callable:
+        plan = self
+
+        def rank(axis: str = "tp"):
+            me = under(axis)
+            if plan.fault is FaultClass.STRAGGLE and plan._should_fire():
+                plan._record(point, f"straggle {plan.cycles} cycles on "
+                                    f"axis {axis!r}")
+                if not isinstance(me, (int, np.integer)):
+                    # Real (traced) execution: actually spin. Replayed
+                    # ranks are concrete ints — the recorded event is the
+                    # observable there.
+                    from jax.experimental import pallas as pl
+
+                    pl.delay(plan.cycles)
+            return me
+
+        return rank
+
+    def _wrap_pallas_call(self, point: str, under: Callable) -> Callable:
+        plan = self
+
+        def pallas_call(*args, **kwargs):
+            kernel = args[0] if args else kwargs.get("kernel")
+            kname = getattr(getattr(kernel, "func", kernel),
+                            "__name__", "kernel")
+            eligible = plan.match is None or plan.match in kname
+            if (plan.fault is FaultClass.CRASH and eligible
+                    and plan._should_fire()):
+                plan._record(point, f"injected crash in pallas_call "
+                                    f"({kname})")
+                raise FaultInjectionError(
+                    f"fault injection: pallas_call({kname}) crashed by "
+                    f"plan (class=crash, seed={plan.seed})",
+                    point=point, rank=plan._rank)
+            inner = under(*args, **kwargs)
+            if not callable(inner):
+                return inner
+
+            def call(*a, **kw):
+                out = inner(*a, **kw)
+                plan.flush()
+                if plan.hash_outputs:
+                    plan._hash(out)
+                return out
+
+            return call
+
+        return pallas_call
+
+    def build_shims(self) -> dict[str, Callable]:
+        """Wrappers over the *current* surface (the tracer's shims inside
+        a replay session, the real device API outside one), keyed by
+        patch-point name — the minimal overlay for this plan's class."""
+        f = self.fault
+        names: list[str] = ["pallas_call"]
+        if f in (FaultClass.DROP_SIGNAL, FaultClass.DUP_SIGNAL):
+            names += list(_PUT_POINTS) + list(_SIGNAL_POINTS)
+        elif f in (FaultClass.DELAY_DELIVERY, FaultClass.REORDER_DELIVERY):
+            names += list(_PUT_POINTS) + list(_FLUSH_POINTS) + [_MAC_POINT]
+        elif f is FaultClass.CORRUPT_PAYLOAD:
+            names += list(_PUT_POINTS)
+        elif f is FaultClass.STRAGGLE:
+            names += ["rank", "pkg_rank"]
+        under = instrument.originals(names)
+        shims: dict[str, Callable] = {}
+        for name in names:
+            fn = under[name]
+            if fn is instrument.MISSING:
+                continue
+            if name == "pallas_call":
+                shims[name] = self._wrap_pallas_call(name, fn)
+            elif name in _PUT_POINTS:
+                shims[name] = self._wrap_put(name, fn)
+            elif name == "semaphore_signal":
+                shims[name] = self._wrap_sem_signal(name, fn)
+            elif name in _SIGNAL_POINTS:
+                shims[name] = self._wrap_signal(name, fn)
+            elif name in _FLUSH_POINTS:
+                shims[name] = self._wrap_flush(name, fn)
+            elif name == _MAC_POINT:
+                shims[name] = self._wrap_mac(name, fn)
+            elif name in ("rank", "pkg_rank"):
+                shims[name] = self._wrap_rank(name, fn)
+        return shims
+
+    @contextlib.contextmanager
+    def active(self):
+        """Install this plan as an instrumentation layer (an overlay when
+        a tracer session is live, the base layer otherwise)."""
+        instrument.install(self.build_shims(),
+                           overlay=instrument.active_layers() > 0)
+        try:
+            yield self
+        finally:
+            # A failing flush (e.g. a deferred put whose thunk cannot run
+            # at host level) must never leak the installed layer.
+            try:
+                self.flush()
+            finally:
+                instrument.uninstall()

@@ -44,10 +44,19 @@ def use_interpret() -> bool:
 
 @dataclasses.dataclass(frozen=True)
 class DistContext:
-    """World description. Analog of the reference's (torch pg, nvshmem team) pair."""
+    """World description. Analog of the reference's (torch pg, nvshmem team) pair.
+
+    ``wait_timeout_ms``: per-context deadline budget for semaphore waits
+    (``resilience/deadline.py``): interpret-mode waits that see no
+    progress for this long raise a structured ``CommTimeoutError``
+    instead of spinning forever. ``None`` defers to the
+    ``TDTPU_WAIT_TIMEOUT_MS`` env var / fail-loud default; ``0`` disables
+    the deadline. The env var, when set, wins over this field.
+    """
 
     mesh: Mesh
     tp_axis: str = "tp"
+    wait_timeout_ms: float | None = None
 
     @property
     def world_size(self) -> int:
@@ -95,6 +104,7 @@ def initialize_distributed(
     devices: Sequence[jax.Device] | None = None,
     seed: int = 42,
     physical_ring: bool = True,
+    wait_timeout_ms: float | None = None,
 ) -> DistContext:
     """Build the global mesh context (reference: utils.py:182 ``initialize_distributed``).
 
@@ -125,7 +135,8 @@ def initialize_distributed(
     if len(mesh_shape) != len(axis_names):
         raise ValueError("mesh_shape and axis_names must have equal length")
     mesh = Mesh(np.array(devs).reshape(mesh_shape), tuple(axis_names))
-    ctx = DistContext(mesh=mesh, tp_axis=axis_names[0])
+    ctx = DistContext(mesh=mesh, tp_axis=axis_names[0],
+                      wait_timeout_ms=wait_timeout_ms)
     set_context(ctx)
     # Unlike the reference (which reseeds every library's global RNG,
     # utils.py:182), no global RNG state is touched: callers seed their own

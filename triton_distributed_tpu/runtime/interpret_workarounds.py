@@ -10,9 +10,13 @@ with an 8-device virtual mesh:
    insufficient and no executable task is queued — the common case in "eager"
    DMA mode when genuinely waiting for another device. Eight spinning device
    threads under one GIL starve the worker thread; collectives take minutes.
-   Replaced with a blocking condition-variable wait (``signal`` always
-   ``notify_all``s, so this is sound; a small timeout covers increments done
-   by popped tasks).
+   Replaced with a DEADLINE-BOUNDED blocking condition-variable wait
+   (``resilience/deadline.py``): the nap interval and total budget are env
+   configurable (``TDTPU_WAIT_NAP_MS`` / ``TDTPU_WAIT_TIMEOUT_MS``, default
+   5 ms / 300 s) and a wait that sees no progress for the whole budget
+   raises a structured ``CommTimeoutError`` naming the semaphore, core,
+   expected delta and observed count — an interpret-mode deadlock surfaces
+   as an error in minutes, not as the tier-1 870 s kill.
 
 2. ``io_callback_impl`` (jax/_src/callback.py:437) device_puts every callback
    arg onto cpu:0 *asynchronously*; ``np.array(val)`` inside the interpret
@@ -60,25 +64,16 @@ def _try(patch) -> None:
 def _patch_semaphore_wait() -> None:
     from jax._src.pallas.mosaic.interpret import shared_memory as sm
 
+    from triton_distributed_tpu.resilience.deadline import (
+        semaphore_wait_with_deadline,
+    )
+
     def wait(self, value, global_core_id, *, has_tasks=False):
-        global_core_id = int(global_core_id)
-        while True:
-            with self.cv:
-                if self.count_by_core[global_core_id] >= value:
-                    self.count_by_core[global_core_id] -= value
-                    return
-            task = None
-            if has_tasks:
-                with self.shared_memory.lock:
-                    queue = self.shared_memory.tasks_by_sem[(self.id, global_core_id)]
-                    if len(queue) > 0:
-                        task = queue.pop()
-            if task is not None:
-                task()
-            else:
-                with self.cv:
-                    if self.count_by_core[global_core_id] < value:
-                        self.cv.wait(timeout=0.005)
+        # The loop body lives in resilience/deadline.py (duck-typed over
+        # this Semaphore object) so the deadline semantics are
+        # unit-testable on jax versions without this interpret module.
+        return semaphore_wait_with_deadline(self, value, global_core_id,
+                                            has_tasks=has_tasks)
 
     sm.Semaphore.wait = wait
 
