@@ -342,6 +342,10 @@ def _measure_and_report():
         except Exception as e:
             result["megakernel_ar_decode_error"] = (
                 f"{type(e).__name__}: {str(e)[:120]}")
+        try:
+            result.update(_serving_metric())
+        except Exception as e:
+            result["serving_error"] = f"{type(e).__name__}: {str(e)[:120]}"
         _gate_and_record(result)
     print(json.dumps(result))
 
@@ -888,6 +892,21 @@ def _megakernel_ar_decode_metric(gen=(16, 40, 64)):
         "tasks_per_step": int(comp.num_exec),
     }
     return out
+
+
+def _serving_metric():
+    """Continuous-batching serving rung (round 7, ISSUE 7): the
+    Qwen3-8B TP=8 shard model served end-to-end through the
+    ServingEngine — 8 concurrent open-loop streams (128-token prompts,
+    16 generated tokens each) over the paged pool, chunked prefill
+    interleaved with the in-flight decode batch. Unlike the pure
+    decode-chain rungs, every host-side cost of serving (scheduler,
+    per-iteration dispatch, page-table rebuilds) is IN the number —
+    that is the tier being measured. One warmup replay compiles all
+    traces; the measured replay is steady-state."""
+    from triton_distributed_tpu.serving.loadgen import serving_bench_rung
+
+    return serving_bench_rung(n_streams=8, prompt_len=128, max_new=16)
 
 
 def _fp8_decode_step_metric(gen=(16, 40, 64)):

@@ -1,0 +1,393 @@
+"""Continuous-batching serving tier (ISSUE 7, docs/serving.md).
+
+The load-bearing contract: iteration-level scheduling over the shared
+paged pool must be TOKEN-IDENTICAL per request to sequential
+``Engine.serve`` calls (greedy) — including a request preempted under
+page pressure and resumed by recompute — while admission backpressure
+and the SLO-driven admission width behave deterministically.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.models.config import tiny_config
+from triton_distributed_tpu.models.dense import init_dense_llm
+from triton_distributed_tpu.models.engine import Engine
+from triton_distributed_tpu.models.kv_cache import (
+    PageAllocator, PageBudgetError, PagePoolConfigError,
+    init_paged_model_cache,
+)
+from triton_distributed_tpu.obs import metrics as obs_metrics
+from triton_distributed_tpu.runtime import initialize_distributed
+from triton_distributed_tpu.serving import (
+    AdmitResult, Request, RequestState, RequestTooLargeError,
+    ServingConfigError, ServingEngine,
+)
+from triton_distributed_tpu.serving.scheduler import Scheduler
+
+
+@pytest.fixture(scope="module")
+def ctx1():
+    return initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                  devices=jax.devices()[:1])
+
+
+@pytest.fixture(scope="module")
+def served(ctx1):
+    """(engine, params) — one tiny paged engine shared by the loop
+    tests (jit caches warm across them)."""
+    cfg = tiny_config()
+    params = init_dense_llm(jax.random.PRNGKey(7), cfg)
+    eng = Engine(cfg, params, ctx1, backend="xla", max_seq=64, page_size=4)
+    return eng
+
+
+def _prompts(seed, n, lengths=(6, 9), vocab=256):
+    """Random prompts drawn from a SMALL set of lengths: every distinct
+    length costs the golden sequential serve one prefill compile."""
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, int(rng.choice(lengths))).tolist()
+            for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# Page allocator (satellite: extracted, tested, named errors).
+# ---------------------------------------------------------------------------
+
+def test_page_allocator_alloc_free_budget():
+    al = PageAllocator(6, 3)
+    a = al.alloc_pages("a", 2)
+    assert a == [0, 1] and al.free_count == 4
+    b = al.alloc_pages("b", 3)
+    assert b == [2, 3, 4] and al.pages("b") == [2, 3, 4]
+    # Per-sequence budget: a named error, not pool exhaustion.
+    with pytest.raises(PageBudgetError, match="max_pages budget of 3"):
+        al.alloc_pages("a", 2)
+    # Pool exhaustion: None (the scheduler preempts), never an exception.
+    assert al.alloc_pages("a", 1) == [5]
+    assert al.alloc_pages("b", 0) == []
+    assert al.free_count == 0
+    al2 = PageAllocator(4, 4)
+    al2.alloc_pages("x", 4)
+    assert al2.alloc_pages("y", 1) is None
+    # Freeing returns pages lowest-first again (deterministic replay).
+    assert al.free_pages("a") == 3
+    assert al.alloc_pages("c", 1) == [0]
+    assert al.free_pages("nobody") == 0    # double-free is a no-op
+
+
+def test_page_allocator_reserved_and_for_cache():
+    cfg = tiny_config()
+    cache = init_paged_model_cache(cfg, 2, page_size=4, max_pages=4,
+                                   num_pages=9)
+    al = PageAllocator.for_cache(cache, reserved=(8,))
+    assert al.free_count == 8
+    got = [al.alloc_pages(f"r{i}", 1)[0] for i in range(8)]
+    assert 8 not in got                    # the scratch page stays out
+
+
+def test_paged_pool_config_validation():
+    cfg = tiny_config()
+    with pytest.raises(PagePoolConfigError, match="field page_size"):
+        init_paged_model_cache(cfg, 1, page_size=0, max_pages=4)
+    with pytest.raises(PagePoolConfigError, match="field max_pages"):
+        init_paged_model_cache(cfg, 1, page_size=4, max_pages=0)
+    with pytest.raises(PagePoolConfigError, match="field num_pages"):
+        init_paged_model_cache(cfg, 1, page_size=4, max_pages=4,
+                               num_pages=-1)
+
+
+# ---------------------------------------------------------------------------
+# Request lifecycle.
+# ---------------------------------------------------------------------------
+
+def test_request_lifecycle_and_accounting():
+    r = Request(prompt=[1, 2, 3, 4, 5], max_new_tokens=4)
+    assert r.state is RequestState.WAITING
+    r.advance(RequestState.PREFILLING)
+    r.advance(RequestState.RUNNING)
+    r.advance(RequestState.PREEMPTED)
+    with pytest.raises(ValueError, match="illegal request transition"):
+        r.advance(RequestState.RUNNING)    # must re-prefill first
+    r.advance(RequestState.PREFILLING)
+    r.advance(RequestState.FINISHED)
+    # Accounting view: final KV excludes the last generated token.
+    assert r.final_kv_len == 5 + 4 - 1
+    assert r.page_budget(page_size=4) == 2
+    r.kv_len = 7
+    assert r.pages_needed(4, extra=1) == 2
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        Request(prompt=[1], max_new_tokens=0)
+
+
+# ---------------------------------------------------------------------------
+# Scheduler admission / backpressure / preemption (pure host logic).
+# ---------------------------------------------------------------------------
+
+def _sched(num_slots=2, num_pages=8, max_pages=4, page=4, max_waiting=2):
+    return Scheduler(num_slots=num_slots,
+                     allocator=PageAllocator(num_pages, max_pages),
+                     page_size=page, capacity_tokens=max_pages * page,
+                     max_waiting=max_waiting)
+
+
+def test_admit_backpressure_queue_and_pool():
+    s = _sched(max_waiting=2)
+    assert s.admit(Request(prompt=[1] * 4, max_new_tokens=2),
+                   0.0) is AdmitResult.ADMITTED
+    assert s.admit(Request(prompt=[1] * 4, max_new_tokens=2),
+                   0.0) is AdmitResult.ADMITTED
+    assert s.admit(Request(prompt=[1] * 4, max_new_tokens=2),
+                   0.0) is AdmitResult.QUEUE_FULL     # queue bound
+    s2 = _sched(max_waiting=8)
+    s2.allocator.alloc_pages("hog", 4)
+    s2.allocator.alloc_pages("hog2", 4)
+    assert s2.allocator.free_count == 0
+    assert s2.admit(Request(prompt=[1] * 4, max_new_tokens=2),
+                    0.0) is AdmitResult.QUEUE_FULL    # pool exhausted
+
+
+def test_admit_rejects_unservable_request():
+    s = _sched()
+    with pytest.raises(RequestTooLargeError, match="per-sequence"):
+        s.admit(Request(prompt=[1] * 20, max_new_tokens=8), 0.0)
+    s3 = Scheduler(num_slots=1, allocator=PageAllocator(2, 4),
+                   page_size=4, capacity_tokens=16, max_waiting=4)
+    with pytest.raises(RequestTooLargeError, match="whole pool"):
+        s3.admit(Request(prompt=[1] * 10, max_new_tokens=4), 0.0)
+
+
+def test_scheduler_preempts_lowest_priority_youngest():
+    s = _sched(num_slots=3, num_pages=6, max_pages=4, max_waiting=8)
+    reqs = [Request(prompt=[1] * 8, max_new_tokens=8, priority=p)
+            for p in (1, 0, 0)]
+    for r in reqs:
+        assert s.admit(r, 0.0) is AdmitResult.ADMITTED
+    admitted = s.schedule_admissions()
+    assert len(admitted) == 3 and s.allocator.free_count == 0
+    for r in reqs:                         # pretend prefill completed
+        r.advance(RequestState.RUNNING)
+        r.kv_len = 8
+    ready, preempted = s.ensure_decode_pages()
+    # Every running sequence needs page 3 of its budget; the pool is
+    # dry, so the LOWEST-priority YOUNGEST (reqs[2]) goes first.
+    assert preempted and preempted[0] is reqs[2]
+    assert reqs[2].state is RequestState.PREEMPTED
+    assert reqs[2].preemptions == 1 and reqs[2] in s.waiting
+    assert reqs[0] in ready                # priority 1 survives
+    assert all(r is not reqs[2] for r in ready)
+
+
+def test_admission_width_shrink_grow():
+    s = _sched(num_slots=4)
+    assert s.admit_cap == 4
+    assert s.shrink_admission() == 3
+    assert s.shrink_admission() == 2
+    for _ in range(5):
+        s.shrink_admission()
+    assert s.admit_cap == 1                # floor: never fully closed
+    assert s.grow_admission() == 2
+    for _ in range(8):
+        s.grow_admission()
+    assert s.admit_cap == 4                # ceiling: num_slots
+
+
+# ---------------------------------------------------------------------------
+# The serving loop — parity, preemption, SLO coupling, metrics.
+# ---------------------------------------------------------------------------
+
+def _serve_all(se, prompts, gens, priorities=None):
+    reqs = []
+    for i, (p, g) in enumerate(zip(prompts, gens)):
+        pr = priorities[i] if priorities else 0
+        req, res = se.submit(p, g, priority=pr)
+        assert res is AdmitResult.ADMITTED
+        reqs.append(req)
+    se.run(max_iters=2000)
+    return reqs
+
+
+def _golden(engine, prompts, gens):
+    return [np.asarray(engine.serve(jnp.asarray([p], jnp.int32),
+                                    gen_len=g))[0].tolist()
+            for p, g in zip(prompts, gens)]
+
+
+def test_serving_parity_vs_sequential(served):
+    """4 heterogeneous requests through 2 slots (so admission queues and
+    slices interleave with decode) — token-identical to sequential
+    serves."""
+    se = ServingEngine(served, max_batch=2, prefill_chunk=4)
+    prompts = _prompts(0, 4)
+    gens = [5, 3, 7, 4]
+    reqs = _serve_all(se, prompts, gens)
+    assert all(r.state is RequestState.FINISHED for r in reqs)
+    assert all(r.t_first_token is not None and r.t_finish is not None
+               for r in reqs)
+    for r, exp in zip(reqs, _golden(served, prompts, gens)):
+        assert r.tokens == exp, f"{r.req_id} diverged"
+
+
+def test_serving_preempt_resume_parity(served):
+    """A pool far smaller than the aggregate demand forces eviction
+    mid-decode; the preempted request recomputes on resume and must
+    still match its sequential tokens."""
+    se = ServingEngine(served, max_batch=3, num_pages=7, prefill_chunk=4)
+    prompts = _prompts(3, 5, lengths=(8, 12))
+    gens = [8, 6, 8, 6, 7]
+    reqs = _serve_all(se, prompts, gens)
+    assert all(r.state is RequestState.FINISHED for r in reqs)
+    assert sum(r.preemptions for r in reqs) >= 1, \
+        "pool sizing no longer forces a preemption"
+    for r, exp in zip(reqs, _golden(served, prompts, gens)):
+        assert r.tokens == exp, \
+            f"{r.req_id} diverged (preemptions={r.preemptions})"
+
+
+def test_serving_priority_shields_victim(served):
+    """Under pressure the high-priority request is never the victim."""
+    se = ServingEngine(served, max_batch=2, num_pages=5, prefill_chunk=4)
+    prompts = [[1, 2, 3, 4, 5, 6], [7, 8, 9, 10, 11, 12]]
+    reqs = _serve_all(se, prompts, [8, 8], priorities=[1, 0])
+    assert reqs[0].preemptions == 0
+    assert reqs[1].preemptions >= 1
+    for r, exp in zip(reqs, _golden(served, prompts, [8, 8])):
+        assert r.tokens == exp
+
+
+def test_serving_config_errors(served, ctx1):
+    cfg = tiny_config()
+    params = init_dense_llm(jax.random.PRNGKey(7), cfg)
+    unpaged = Engine(cfg, params, ctx1, backend="xla", max_seq=32)
+    with pytest.raises(ServingConfigError, match="page_size"):
+        ServingEngine(unpaged)
+    with pytest.raises(ServingConfigError, match="prefill_chunk"):
+        ServingEngine(served, prefill_chunk=6)   # not a page multiple
+    with pytest.raises(ServingConfigError, match="max_batch"):
+        ServingEngine(served, max_batch=0)
+    with pytest.raises(RequestTooLargeError):
+        se = ServingEngine(served, max_batch=1)
+        se.submit(list(range(60)), 30)           # > capacity
+
+
+def test_slo_streak_shrinks_then_regrows(served, monkeypatch):
+    """An impossible tokens/s floor shrinks the admitted width within
+    the shrink budget; clearing it regrows the width on clean streaks
+    (acceptance criterion c)."""
+    from triton_distributed_tpu.obs.slo import SLOConfig
+
+    monkeypatch.setenv("TDTPU_ADMIT_SHRINK_AFTER", "2")
+    monkeypatch.setenv("TDTPU_ADMIT_GROW_AFTER", "3")
+    se = ServingEngine(served, max_batch=3, prefill_chunk=4,
+                       slo_cfg=SLOConfig(tokens_per_s_min=1e12))
+    _serve_all(se, _prompts(5, 3), [6, 6, 6])
+    assert se.sched.admit_cap < 3
+    shrunk = se.sched.admit_cap
+    se.slo_cfg = SLOConfig()               # thresholds cleared: clean
+    _serve_all(se, _prompts(6, 2), [6, 6])
+    assert se.sched.admit_cap > shrunk
+
+
+def test_serving_metrics_and_report_lane(served, tmp_path):
+    """Under an obs run the loop publishes the serving series (TTFT /
+    TPOT histograms, queue/pages gauges, preemption counter, ROLLING
+    tokens/s gauge) and obs.report renders + gates the lane."""
+    from triton_distributed_tpu import obs
+    from triton_distributed_tpu.obs import report as obs_report
+
+    run_dir = str(tmp_path / "run")
+    obs.start_run(run_dir)
+    try:
+        se = ServingEngine(served, max_batch=2, num_pages=5,
+                           prefill_chunk=4)
+        prompts = [[1, 2, 3, 4, 5, 6], [7, 8, 9, 10, 11, 12]]
+        _serve_all(se, prompts, [8, 8])
+        reg = obs_metrics.registry()
+        assert reg.get(obs_metrics.SERVE_TTFT_MS).count == 2
+        assert reg.get(obs_metrics.SERVE_TPOT_MS).count == 2
+        assert reg.get(obs_metrics.SERVE_FINISHED).value == 2
+        assert reg.get(obs_metrics.SERVE_PREEMPTIONS).value >= 1
+        assert reg.get(obs_metrics.SERVE_TOKENS_PER_S).value > 0
+        assert reg.get(obs_metrics.SERVE_ADMIT_CAP).value == 2
+    finally:
+        obs.finish_run()
+    # Report renders the serving lane; preemptions under a clean SLO
+    # section fail --check unless explicitly allowed (the satellite's
+    # contract: eviction with no pressure signal = mis-sized pool).
+    rc = obs_report.main([run_dir, "--check", "--require-series",
+                          obs_metrics.SERVE_TTFT_MS])
+    assert rc == 1
+    rc = obs_report.main([run_dir, "--check", "--allow-preemptions",
+                          "--require-series", obs_metrics.SERVE_TTFT_MS])
+    assert rc == 0
+
+
+def test_backend_demotion_invalidates_serving_jits(ctx1):
+    """When the ladder demotes the engine backend, this tier's
+    slice/logits jits (built under the OLD backend's mode) must drop —
+    a demoted engine must not keep prefilling through the collective
+    stack the demotion routed around. Output stays token-identical
+    (the ladder's contract)."""
+    cfg = tiny_config()
+    params = init_dense_llm(jax.random.PRNGKey(7), cfg)
+    eng = Engine(cfg, params, ctx1, backend="auto", max_seq=64,
+                 page_size=4)
+    assert eng._ladder == ["auto", "xla"]
+    se = ServingEngine(eng, max_batch=1, prefill_chunk=4)
+    prompt = [5, 4, 3, 2, 1, 6]
+    req1, _ = se.submit(prompt, 4)
+    se.run()
+    assert "pf_slice" in se._jits and se._jits_backend == "auto"
+    eng._set_rung(1, "test demotion")          # auto -> xla
+    req2, _ = se.submit(prompt, 4)
+    se.run()
+    assert se._jits_backend == "xla"           # caches were rebuilt
+    assert req2.tokens == req1.tokens          # ladder parity holds
+
+
+def test_rolling_rate_window(served):
+    """The tokens/s gauge is a trailing-window rate, not a per-call
+    number (ISSUE 7 satellite): events outside the window fall out."""
+    t = [0.0]
+    se = ServingEngine(served, max_batch=1, clock=lambda: t[0])
+    se._t0 = 0.0
+    se._rate_window_s = 5.0
+    se._rate_events.extend([(0.0, 10), (1.0, 10)])
+    t[0] = 2.0
+    assert se._rolling_rate() == pytest.approx(10.0)   # 20 tok / 2 s
+    t[0] = 5.5
+    assert se._rolling_rate() == pytest.approx(2.0)    # 10 tok / 5 s
+    t[0] = 60.0
+    assert se._rolling_rate() == 0.0
+
+
+def test_loadgen_trace_determinism():
+    """Seeded traces are bit-reproducible — the serving runs they drive
+    replay identically."""
+    from triton_distributed_tpu.serving.loadgen import LoadSpec, build_trace
+
+    t1 = build_trace(LoadSpec(seed=3))
+    t2 = build_trace(LoadSpec(seed=3))
+    assert t1 == t2
+    assert t1 != build_trace(LoadSpec(seed=4))
+
+
+@pytest.mark.slow
+def test_loadgen_dryrun(tmp_path):
+    """The full dryrun (parity incl. preempt/resume, backpressure, SLO
+    shrink) — slow tier: CI runs the same proof as its own serving
+    smoke step (`loadgen --dryrun`), so tier-1 need not pay it twice."""
+    import json
+
+    from triton_distributed_tpu.serving.loadgen import dryrun
+
+    out = str(tmp_path / "serving-report.json")
+    assert dryrun(out) == 0
+    rep = json.load(open(out))
+    assert rep["all_finished"] and rep["parity_ok"]
+    assert rep["preempted_with_parity"]
+    assert rep["backpressure_fired"]
+    assert rep["slo_admission"]["shrunk"]

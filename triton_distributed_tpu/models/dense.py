@@ -196,19 +196,11 @@ def dense_prefill_chunked(params: dict, cfg: ModelConfig,
     (ar modes — the bounded-memory use-case). Returns (last-token logits,
     cache filled for [0, S)).
     """
-    from triton_distributed_tpu.layers.tp_attn import tp_attn_prefill_chunk
-
     n = num_ranks
     batch, seq = input_ids.shape
     if seq % chunk:
         raise ValueError(f"prompt length {seq} not a multiple of "
                          f"chunk {chunk} (pad the prompt)")
-    if mode not in ("ar", "xla_rep"):
-        raise ValueError(
-            f"chunked prefill runs replicated activations: mode must be "
-            f"'ar' or 'xla_rep', got {mode!r} (silently substituting a "
-            "different collective stack would break the backend contract)")
-    attn_mode = mode
 
     # fori_loop over chunks: ONE compiled chunk body regardless of prompt
     # length (the flash kernel takes the chunk start as a TRACED offset;
@@ -218,20 +210,10 @@ def dense_prefill_chunked(params: dict, cfg: ModelConfig,
         cache, _ = carry
         start = c * chunk
         ids = jax.lax.dynamic_slice_in_dim(input_ids, start, chunk, axis=1)
-        x = params["embed"][ids.reshape(-1)]          # (B·chunk, h)
-        for i, layer in enumerate(params["layers"]):
-            h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
-            attn_out, kv = tp_attn_prefill_chunk(
-                layer["attn"], cfg, h, cache.layer(i), start, chunk,
-                axis=axis, num_ranks=n, mode=attn_mode,
-                inter_axis=inter_axis, n_inter=n_inter,
-                flash_tiles=flash_tiles)
-            cache = cache.with_layer(i, kv)
-            x = x + attn_out
-            h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
-            x = x + _mlp_or_moe(layer, cfg, h, axis=axis, n=n,
-                                mode=attn_mode, inter_axis=inter_axis,
-                                n_inter=n_inter)
+        x, cache = dense_prefill_slice(
+            params, cfg, ids, cache, start, axis=axis, num_ranks=n,
+            mode=mode, inter_axis=inter_axis, n_inter=n_inter,
+            flash_tiles=flash_tiles)
         return cache, x
 
     x0 = jnp.zeros((batch * chunk, cfg.hidden_size),
@@ -241,6 +223,62 @@ def dense_prefill_chunked(params: dict, cfg: ModelConfig,
     logits = _logits(params, cfg, last, axis=axis, n=n,
                      inter_axis=inter_axis, n_inter=n_inter)
     return logits, cache._replace(offset=jnp.int32(seq))
+
+
+def dense_prefill_slice(params: dict, cfg: ModelConfig,
+                        input_ids: jax.Array, cache: KVCache,
+                        start: jax.Array, *, axis: str = "tp",
+                        num_ranks: int = 1, mode: str = "ar",
+                        inter_axis: str = "dcn", n_inter: int = 1,
+                        flash_tiles: tuple[int, int] | None = None):
+    """ONE chunk of causal prefill at traced offset ``start`` — the body
+    both :func:`dense_prefill_chunked` (fori over a whole prompt) and the
+    serving tier's iteration-level scheduler (serving/loop.py: one slice
+    per scheduler iteration, interleaved with the in-flight decode batch)
+    share.
+
+    input_ids: (B, C) replicated; queries attend the cached prefix
+    through the flash kernel's positional causality. Returns
+    (x (B·C, h) final-layer activations — feed the last REAL row to
+    :func:`dense_last_logits` —, cache with K/V appended at
+    [start, start+C)). Activations run replicated (ar modes only)."""
+    from triton_distributed_tpu.layers.tp_attn import tp_attn_prefill_chunk
+
+    if mode not in ("ar", "xla_rep"):
+        raise ValueError(
+            f"chunked prefill runs replicated activations: mode must be "
+            f"'ar' or 'xla_rep', got {mode!r} (silently substituting a "
+            "different collective stack would break the backend contract)")
+    n = num_ranks
+    batch, chunk = input_ids.shape
+    x = params["embed"][input_ids.reshape(-1)]          # (B·chunk, h)
+    for i, layer in enumerate(params["layers"]):
+        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+        attn_out, kv = tp_attn_prefill_chunk(
+            layer["attn"], cfg, h, cache.layer(i), start, chunk,
+            axis=axis, num_ranks=n, mode=mode,
+            inter_axis=inter_axis, n_inter=n_inter,
+            flash_tiles=flash_tiles)
+        cache = cache.with_layer(i, kv)
+        x = x + attn_out
+        h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp_or_moe(layer, cfg, h, axis=axis, n=n,
+                            mode=mode, inter_axis=inter_axis,
+                            n_inter=n_inter)
+    return x, cache
+
+
+def dense_last_logits(params: dict, cfg: ModelConfig, x_last: jax.Array,
+                      *, axis: str = "tp", num_ranks: int = 1,
+                      inter_axis: str = "dcn", n_inter: int = 1
+                      ) -> jax.Array:
+    """Final-norm + lm-head logits for already-computed last-token
+    activations ``x_last`` (B, h) — the epilogue a sliced prefill runs
+    once, on the last REAL row, after its final
+    :func:`dense_prefill_slice` (the slice itself returns raw
+    activations so padded tail rows never pay the vocab matmul)."""
+    return _logits(params, cfg, x_last, axis=axis, n=num_ranks,
+                   inter_axis=inter_axis, n_inter=n_inter)
 
 
 def make_ar_stream_fn(ar_state, *, axis: str, n: int,

@@ -626,6 +626,15 @@ class Engine:
             self._set_rung(self._rung - 1, "slo_clean_streak")
             self._slo_clean_streak = 0
 
+    def serving(self, **kwargs):
+        """The request-level tier above this engine: a
+        :class:`~triton_distributed_tpu.serving.loop.ServingEngine`
+        (continuous batching over the paged pool — docs/serving.md).
+        Requires ``page_size`` to have been set on this engine."""
+        from triton_distributed_tpu.serving.loop import ServingEngine
+
+        return ServingEngine(self, **kwargs)
+
     def serve(self, input_ids: jax.Array, gen_len: int,
               profile_dir: str | None = None) -> jax.Array:
         """Greedy generation (reference Engine.serve, engine.py:113) with
@@ -706,10 +715,15 @@ class Engine:
         # steady state the gauge is meant to describe.
         compile_s = (compile_h.sum - compile_ms0) / 1e3
         serving_s = max(wall_s - compile_s, 1e-9)
+        # Per-call value; the continuous-batching tier (serving/loop.py)
+        # publishes the SAME gauge as a rolling-window rate instead —
+        # under many small interleaved steps a per-call number is
+        # meaningless and the SLO watchdog's floor would misfire.
         reg.gauge(
             "tdtpu_serve_tokens_per_s",
-            "generated tokens/s over the last serve() call, excluding "
-            "first-call jit compilation"
+            "generated tokens/s — per-call from Engine.serve (excluding "
+            "first-call jit compilation), rolling-window from "
+            "ServingEngine"
         ).set(batch * gen_len / serving_s)
         # Live SLO watchdog (obs/slo.py): evaluate the registry this serve
         # just fed — tokens/s floor, step-p99 ceiling, megakernel stall

@@ -96,18 +96,128 @@ class PagedModelCache(NamedTuple):
         return self.kv_lens >= self.capacity
 
 
+class PagePoolConfigError(ValueError):
+    """A paged-pool sizing parameter is invalid — raised up front at
+    cache-construction time, naming the offending field (the
+    ``_check_decode_step_config`` style), not later as an opaque index
+    error inside a decode step."""
+
+
+class PageBudgetError(ValueError):
+    """A sequence asked for more pages than its ``max_pages`` table row
+    can hold — the per-sequence budget, distinct from pool exhaustion
+    (which :meth:`PageAllocator.alloc_pages` reports by returning None
+    so the serving scheduler can preempt instead of dying)."""
+
+
+def _check_paged_pool_config(*, page_size: int, max_pages: int,
+                             num_pages: int, batch: int) -> None:
+    """Named up-front validation of the pool-sizing fields every paged
+    cache / allocator shares."""
+    if page_size < 1:
+        raise PagePoolConfigError(
+            f"page_size = {page_size} invalid: a page must hold at least "
+            "one position — field page_size")
+    if max_pages < 1:
+        raise PagePoolConfigError(
+            f"max_pages = {max_pages} invalid: each sequence's page-table "
+            "row needs at least one slot — field max_pages")
+    if num_pages < 1:
+        raise PagePoolConfigError(
+            f"num_pages = {num_pages} invalid: the shared pool needs at "
+            "least one page — field num_pages")
+    if batch < 1:
+        raise PagePoolConfigError(
+            f"batch = {batch} invalid: the page table needs at least one "
+            "sequence row — field batch")
+
+
+def identity_page_table(batch: int, max_pages: int,
+                        num_pages: int) -> jax.Array:
+    """The ad-hoc identity layout (sequence b owns pages
+    ``[b*max_pages, (b+1)*max_pages) % num_pages``) the non-serving
+    paths use — a serving scheduler rewrites tables from a
+    :class:`PageAllocator` instead."""
+    return (jnp.arange(batch * max_pages, dtype=jnp.int32)
+            .reshape(batch, max_pages) % num_pages)
+
+
+class PageAllocator:
+    """Host-side free-list allocator over a :class:`PagedModelCache`
+    pool — the serving tier's page-budget bookkeeping (docs/serving.md).
+
+    Pages are plain ints in ``[0, num_pages)``; ownership is tracked per
+    ``owner`` key (a request id). ``alloc_pages`` raises
+    :class:`PageBudgetError` when an owner would exceed ``max_pages``
+    (its page-table row capacity) and returns ``None`` when the POOL is
+    out of free pages — the scheduler's cue to preempt, not an error.
+    Allocation order is deterministic (lowest free id first) so serving
+    runs replay bit-identically.
+    """
+
+    def __init__(self, num_pages: int, max_pages: int, *,
+                 reserved: tuple[int, ...] = ()):
+        _check_paged_pool_config(page_size=1, max_pages=max_pages,
+                                 num_pages=num_pages, batch=1)
+        self.num_pages = num_pages
+        self.max_pages = max_pages
+        self._free = sorted(set(range(num_pages)) - set(reserved),
+                            reverse=True)   # pop() yields lowest id
+        self._owned: dict = {}
+
+    @classmethod
+    def for_cache(cls, cache: PagedModelCache, *,
+                  reserved: tuple[int, ...] = ()) -> "PageAllocator":
+        return cls(cache.k_pools.shape[1], cache.page_table.shape[1],
+                   reserved=reserved)
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def pages(self, owner) -> list[int]:
+        """Pages owned, in allocation order — page i holds positions
+        ``[i*page_size, (i+1)*page_size)`` of the owner's sequence."""
+        return list(self._owned.get(owner, ()))
+
+    def alloc_pages(self, owner, n: int = 1) -> list[int] | None:
+        held = self._owned.setdefault(owner, [])
+        if len(held) + n > self.max_pages:
+            raise PageBudgetError(
+                f"sequence {owner!r} would hold {len(held) + n} pages, "
+                f"over its max_pages budget of {self.max_pages} — the "
+                "admission check (prompt + max_new_tokens vs capacity) "
+                "should have rejected this request")
+        if len(self._free) < n:
+            return None          # pool exhausted: preempt or backpressure
+        got = [self._free.pop() for _ in range(n)]
+        held.extend(got)
+        return got
+
+    def free_pages(self, owner) -> int:
+        """Return every page the owner holds to the pool; returns the
+        count freed (0 for an unknown owner — freeing twice is a no-op,
+        not an error: preemption and finish may race in caller logic)."""
+        held = self._owned.pop(owner, [])
+        self._free.extend(held)
+        self._free.sort(reverse=True)
+        return len(held)
+
+
 def init_paged_model_cache(cfg, batch: int, *, page_size: int,
                            max_pages: int, num_pages: int | None = None,
                            dtype=None,
                            num_kv_heads: int | None = None) -> PagedModelCache:
     """Zeroed pools + identity page tables (the host's allocator may
-    rewrite tables between steps — they are data)."""
+    rewrite tables between steps — they are data). Pool sizing is
+    validated up front with named errors (:class:`PagePoolConfigError`)."""
     heads = num_kv_heads if num_kv_heads is not None else cfg.num_kv_heads
     num_pages = num_pages or batch * max_pages
+    _check_paged_pool_config(page_size=page_size, max_pages=max_pages,
+                             num_pages=num_pages, batch=batch)
     dt = dtype or jnp.dtype(cfg.dtype)
     shape = (cfg.num_layers, num_pages, page_size, heads, cfg.head_dim)
-    table = (jnp.arange(batch * max_pages, dtype=jnp.int32)
-             .reshape(batch, max_pages) % num_pages)
+    table = identity_page_table(batch, max_pages, num_pages)
     return PagedModelCache(jnp.zeros(shape, dt), jnp.zeros(shape, dt),
                            table, jnp.zeros((batch,), jnp.int32))
 

@@ -94,6 +94,12 @@ METRICS: tuple[MetricSpec, ...] = (
     MetricSpec("decode_step_ms_megakernel_ar",
                "decode step ms (megakernel, in-kernel AR n=1 loopback)",
                " ms", "lower", "megakernel"),
+    MetricSpec("serve_tokens_per_s_concurrent",
+               "serving tokens/s (continuous batching, 8 streams)",
+               " tok/s", "higher", "serving"),
+    MetricSpec("serve_ttft_p99_ms",
+               "serving TTFT p99 (8 streams, 128-token prompts)",
+               " ms", "lower", "serving"),
 )
 
 METRIC_BY_KEY = {m.key: m for m in METRICS}

@@ -30,6 +30,32 @@ from typing import Any, Iterable
 DEFAULT_BUCKETS_MS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
                       100.0, 250.0, 1000.0)
 
+# TTFT spans queueing + whole-prompt prefill — orders of magnitude above
+# a decode step, so it gets its own bucket ladder.
+TTFT_BUCKETS_MS = (1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+                   1000.0, 2500.0, 5000.0, 10000.0, 30000.0)
+
+# Serving-tier series (ISSUE 7): published by serving/loop.py, rendered
+# as obs.report's serving lane. Names live here so the publisher, the
+# report and the CI assertions can never drift.
+SERVE_TTFT_MS = "tdtpu_serve_ttft_ms"
+SERVE_TPOT_MS = "tdtpu_serve_tpot_ms"
+SERVE_QUEUE_DEPTH = "tdtpu_serve_queue_depth"
+SERVE_FREE_PAGES = "tdtpu_serve_free_pages"
+SERVE_ACTIVE = "tdtpu_serve_active_requests"
+SERVE_ADMIT_CAP = "tdtpu_serve_admitted_cap"
+SERVE_PREEMPTIONS = "tdtpu_serve_preemptions_total"
+SERVE_REJECTS = "tdtpu_serve_admission_rejects_total"
+SERVE_FINISHED = "tdtpu_serve_requests_finished_total"
+SERVE_TOKENS_PER_S = "tdtpu_serve_tokens_per_s"
+
+# What the report's serving lane renders (histograms first, then
+# gauges/counters, in this order).
+SERVING_SERIES = (SERVE_TTFT_MS, SERVE_TPOT_MS, SERVE_QUEUE_DEPTH,
+                  SERVE_FREE_PAGES, SERVE_ACTIVE, SERVE_ADMIT_CAP,
+                  SERVE_PREEMPTIONS, SERVE_REJECTS, SERVE_FINISHED,
+                  SERVE_TOKENS_PER_S)
+
 
 def _fmt_labels(labels: dict[str, str] | None) -> str:
     if not labels:

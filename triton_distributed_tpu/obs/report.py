@@ -330,7 +330,45 @@ def summarize(run_dir: str, lanes: dict, metrics: dict | None,
             m = (metrics or {}).get(name)
             if m is not None:
                 lines.append(f"  {name} = {m.get('value', 0):g}")
+    serving = serving_lane(metrics)
+    if serving:
+        lines.append("")
+        lines += serving
     return "\n".join(lines)
+
+
+def serving_lane(metrics: dict | None) -> list[str]:
+    """The serving-tier summary section (docs/serving.md) — rendered
+    whenever the snapshot carries any continuous-batching series beyond
+    the shared tokens/s gauge (which Engine.serve also publishes)."""
+    from triton_distributed_tpu.obs import metrics as obs_metrics
+
+    present = [n for n in obs_metrics.SERVING_SERIES
+               if n in (metrics or {})
+               and n != obs_metrics.SERVE_TOKENS_PER_S]
+    if not present:
+        return []
+    lines = ["serving tier (docs/serving.md):"]
+    fmt = lambda x: f"{x:.3f}" if x is not None else "—"  # noqa: E731
+    for name in obs_metrics.SERVING_SERIES:
+        m = (metrics or {}).get(name)
+        if m is None:
+            continue
+        if m["type"] == "histogram":
+            lines.append(
+                f"  {name}: n={m['count']} p50={fmt(m.get('p50'))} "
+                f"p99={fmt(m.get('p99'))}")
+        else:
+            lines.append(f"  {name} = {m['value']:g}")
+    return lines
+
+
+def preemption_count(metrics: dict | None) -> float:
+    """Preemptions recorded in a metrics snapshot (0 when absent)."""
+    from triton_distributed_tpu.obs import metrics as obs_metrics
+
+    m = (metrics or {}).get(obs_metrics.SERVE_PREEMPTIONS) or {}
+    return float(m.get("value") or 0.0)
 
 
 def degradation_count(metrics: dict | None) -> float:
@@ -452,6 +490,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="report backend demotions without failing "
                          "--check (by default an unexpected demotion in "
                          "the snapshot fails the degradation lane)")
+    ap.add_argument("--allow-preemptions", action="store_true",
+                    help="report serving preemptions without failing "
+                         "--check (by default preemptions recorded under "
+                         "a CLEAN SLO section fail: eviction with no "
+                         "pressure signal means the pool is mis-sized)")
     args = ap.parse_args(argv)
 
     if args.dryrun:
@@ -526,6 +569,13 @@ def main(argv: list[str] | None = None) -> int:
         failures.append(
             f"degradation: {demotions:g} unexpected backend demotion(s) "
             "in the snapshot (--allow-degradation to accept)")
+    preemptions = preemption_count(metrics)
+    if (preemptions and not args.allow_preemptions
+            and not (slo_section or {}).get("violations")):
+        failures.append(
+            f"serving: {preemptions:g} preemption(s) under a clean SLO "
+            "section — the page pool evicted work with no pressure "
+            "signal (--allow-preemptions to accept)")
     if failures:
         for msg in failures:
             print(f"CHECK FAIL: {msg}", file=sys.stderr)

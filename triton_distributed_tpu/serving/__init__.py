@@ -1,0 +1,31 @@
+"""Continuous-batching serving tier (ISSUE 7, ROADMAP open item #1).
+
+The request-level layer above ``models/engine.Engine``: a vLLM-style
+iteration-level schedule (Orca, OSDI'22; PagedAttention, SOSP'23) over
+the repo's own paged KV pool, chunked prefill and SLO watchdog —
+docs/serving.md.
+
+* :mod:`~triton_distributed_tpu.serving.request` — request lifecycle
+  (WAITING → PREFILLING → RUNNING → PREEMPTED → FINISHED) + latency /
+  page-budget accounting;
+* :mod:`~triton_distributed_tpu.serving.scheduler` — pure-host
+  admission/preemption state machine over the page allocator;
+* :mod:`~triton_distributed_tpu.serving.loop` — :class:`ServingEngine`,
+  the mixed prefill+decode iteration driver;
+* :mod:`~triton_distributed_tpu.serving.loadgen` — deterministic
+  open-loop load generator, the CPU dryrun proof and the bench rung.
+"""
+
+from triton_distributed_tpu.serving.request import (  # noqa: F401
+    Request, RequestState,
+)
+from triton_distributed_tpu.serving.scheduler import (  # noqa: F401
+    AdmitResult, RequestTooLargeError, Scheduler, SchedulerConfigError,
+)
+from triton_distributed_tpu.serving.loop import (  # noqa: F401
+    ServingConfigError, ServingEngine,
+)
+
+__all__ = ["Request", "RequestState", "AdmitResult", "Scheduler",
+           "SchedulerConfigError", "RequestTooLargeError",
+           "ServingConfigError", "ServingEngine"]

@@ -1,0 +1,538 @@
+"""ServingEngine — the continuous-batching loop over ``Engine``.
+
+``Engine.serve`` is one fixed batch and one ``gen_len``; this module is
+the request-level tier above it (ROADMAP open item #1): a
+:class:`ServingEngine` owns ONE shared
+:class:`~triton_distributed_tpu.models.kv_cache.PagedModelCache` pool
+(``max_batch`` decode slots over ``num_pages`` pages + one reserved
+scratch page), a host :class:`~.scheduler.Scheduler`, and per iteration
+runs one *mixed* step:
+
+1. **admissions** — WAITING requests take a free slot + their prompt's
+   page reservation (backpressure otherwise);
+2. **one chunked-prefill slice** for the oldest PREFILLING request
+   (``models/dense.dense_prefill_slice`` into a shared linear buffer;
+   the final slice's last real row yields the first token and the
+   buffer scatters into the slot's pages);
+3. **page growth** for the in-flight decode batch, preempting the
+   lowest-priority sequence under page pressure (free pages,
+   recompute-on-resume);
+4. **one paged decode step** over every RUNNING slot through the
+   engine's jitted ``dense_decode_step_paged`` path — heterogeneous
+   lengths via the shared page table + ``kv_lens``; idle slots point at
+   the scratch page with ``kv_lens`` 0, so their (discarded) lane is
+   harmless.
+
+SLO coupling (docs/serving.md): each iteration the live watchdog
+(obs/slo.py) is evaluated against the serving registry; a violation
+streak SHRINKS the scheduler's admission cap, a clean streak regrows it,
+and the section is forwarded to the engine's PR-6 demotion ladder
+(``Engine._slo_streak_update``) so backend demotion cooperates with
+admission control. The ``tdtpu_serve_tokens_per_s`` gauge is published
+as a ROLLING-WINDOW rate here (Engine.serve's per-call value is
+meaningless under many small interleaved steps).
+
+Greedy decoding end to end, so per-request output is token-identical to
+a sequential ``Engine.serve`` call (tests/test_serving.py pins it,
+including a preempt/resume).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_distributed_tpu.models import sampling
+from triton_distributed_tpu.models.dense import (
+    dense_last_logits, dense_prefill_slice,
+)
+from triton_distributed_tpu.models.engine import Engine
+from triton_distributed_tpu.models.kv_cache import (
+    PageAllocator, init_kv_cache, init_paged_model_cache, kv_cache_specs,
+    paged_cache_specs,
+)
+from triton_distributed_tpu.obs import metrics as obs_metrics
+from triton_distributed_tpu.obs import trace as obs_trace
+from triton_distributed_tpu.serving.request import Request, RequestState
+from triton_distributed_tpu.serving.scheduler import (
+    AdmitResult, Scheduler,
+)
+
+
+class ServingConfigError(ValueError):
+    """A serving-tier sizing/backend parameter is invalid — named, at
+    construction (the ``_check_decode_step_config`` style)."""
+
+
+def _env_int(var: str, default: int) -> int:
+    try:
+        return int(os.environ.get(var, "") or default)
+    except ValueError:
+        return default
+
+
+class ServingEngine:
+    """Continuous-batching serving tier over an ``Engine`` with
+    ``page_size`` set.
+
+    Args:
+      engine: an :class:`Engine` constructed with ``page_size`` (the
+        paged decode path is the whole point); ``backend="megakernel"``
+        is rejected (its workspace cache is not paged).
+      max_batch: decode slots (the in-flight batch width; one jit trace).
+      num_pages: shared KV pool size in pages (default: every slot can
+        hold its full ``max_pages`` allotment — no pressure; size it
+        smaller to oversubscribe). One extra scratch page is always
+        added for idle slots' discarded writes.
+      prefill_chunk: tokens per prefill slice (must be a multiple of
+        ``engine.page_size``; default one page) — the knob trading TTFT
+        against decode-batch stall per iteration.
+      max_waiting: waiting-queue bound (admission backpressure beyond).
+      slo_cfg: explicit :class:`~triton_distributed_tpu.obs.slo.SLOConfig`
+        for the admission controller (default: the ``TDTPU_SLO_*`` env,
+        evaluated only under an active obs run).
+      slo_every: evaluate the SLO watchdog every N iterations (default
+        1). The watchdog's stall rule globs the run directory per
+        evaluation — on a long-running loop with a large obs run dir,
+        raise this to keep the hot loop off the filesystem.
+    """
+
+    def __init__(self, engine: Engine, *, max_batch: int = 4,
+                 num_pages: int | None = None,
+                 prefill_chunk: int | None = None,
+                 max_waiting: int = 64, slo_cfg=None, slo_every: int = 1,
+                 clock=time.perf_counter):
+        if engine.page_size is None:
+            raise ServingConfigError(
+                "engine has no paged cache: construct Engine(page_size=...) "
+                "— the serving tier schedules against the PagedModelCache "
+                "pool (argument engine)")
+        if engine.backend == "megakernel":
+            raise ServingConfigError(
+                "backend 'megakernel' unsupported: the megakernel decoder "
+                "owns its own workspace cache, not the paged pool "
+                "(argument engine; see ROADMAP item 3b)")
+        page = engine.page_size
+        chunk = prefill_chunk if prefill_chunk is not None else page
+        if chunk < 1 or chunk % page:
+            raise ServingConfigError(
+                f"prefill_chunk = {chunk} invalid: must be a positive "
+                f"multiple of page_size ({page}) so prefill slices scatter "
+                "whole pages — argument prefill_chunk")
+        if max_batch < 1:
+            raise ServingConfigError(
+                f"max_batch = {max_batch} invalid: the decode batch needs "
+                "at least one slot — argument max_batch")
+        self.engine = engine
+        self.cfg = engine.cfg
+        self.page = page
+        self.max_pages = engine.max_pages
+        self.max_batch = max_batch
+        self.chunk = chunk
+        self.clock = clock
+        self.slo_cfg = slo_cfg
+        # Prefill buffer: whole chunks covering max_seq (chunk % page == 0
+        # keeps it page-aligned for the scatter reshape).
+        self.s_buf = -(-engine.max_seq // chunk) * chunk
+        # Per-sequence capacity also honors the engine's own max_seq
+        # contract: both page and chunk rounding can exceed it, and an
+        # admitted request longer than max_seq could never be replayed
+        # through the sequential parity oracle (Engine.serve rejects it).
+        capacity = min(self.max_pages * page, self.s_buf, engine.max_seq)
+        pool_pages = (num_pages if num_pages is not None
+                      else max_batch * self.max_pages)
+        if pool_pages < 1:
+            raise ServingConfigError(
+                f"num_pages = {pool_pages} invalid: the shared pool needs "
+                "at least one page — argument num_pages")
+        self.num_pages = pool_pages
+        self.scratch_page = pool_pages        # last pool row, never owned
+        mesh = engine.ctx.mesh
+
+        def put(tree, specs):
+            return jax.device_put(
+                tree, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                                   is_leaf=lambda x: isinstance(x, P)))
+
+        cache = init_paged_model_cache(
+            self.cfg, max_batch, page_size=page, max_pages=self.max_pages,
+            num_pages=pool_pages + 1)
+        self._cache = put(cache, paged_cache_specs(engine.shard_axes))
+        self._pf_cache = put(init_kv_cache(self.cfg, 1, self.s_buf),
+                             kv_cache_specs(engine.shard_axes))
+        self.sched = Scheduler(
+            num_slots=max_batch,
+            allocator=PageAllocator(pool_pages, self.max_pages),
+            page_size=page, capacity_tokens=capacity,
+            max_waiting=max_waiting)
+        self._jits: dict = {}
+        self._jits_backend = engine.backend
+        self.slo_every = max(1, int(slo_every))
+        self._iter = 0
+        self._t0: float | None = None
+        self.total_tokens = 0
+        self._rate_events: collections.deque = collections.deque()
+        self._rate_window_s = float(
+            os.environ.get("TDTPU_SERVE_RATE_WINDOW_S", "") or 5.0)
+        self._viol_streak = 0
+        self._clean_streak = 0
+        self._finished: list[Request] = []
+
+    # -- jitted pieces ------------------------------------------------------
+    def _first_call(self, key, fn, what: str):
+        """The engine's first-call compile routing, against THIS tier's
+        jit cache: the first invocation runs under a ``jit_compile`` span
+        and flags the wall time as compile-dominated, then the raw
+        executable replaces the wrapper in ``self._jits``."""
+        eng = self.engine
+
+        def first(*args):
+            eng._jit_compiled_last_call = True
+            with obs_trace.span("jit_compile", what=what, key=str(key)):
+                out = fn(*args)
+            self._jits[key] = fn
+            return out
+
+        return first
+
+    def _slice_jit(self):
+        key = "pf_slice"
+        if key not in self._jits:
+            eng = self.engine
+            mode = eng._decode_mode()
+            tiles = eng._flash_tiles(self.chunk, self.s_buf)
+            extra = ({"inter_axis": eng.inter_axis, "n_inter": eng.n_inter}
+                     if eng.hierarchical else {})
+
+            def step(params, ids, cache, start):
+                return dense_prefill_slice(
+                    params, self.cfg, ids, cache, start, axis=eng.axis,
+                    num_ranks=eng.n, mode=mode, flash_tiles=tiles, **extra)
+
+            fn = eng._shard(step, in_specs=(eng.param_specs, P(),
+                                            kv_cache_specs(eng.shard_axes),
+                                            P()),
+                            out_specs=(P(), kv_cache_specs(eng.shard_axes)))
+            self._jits[key] = self._first_call(
+                key, jax.jit(fn, donate_argnums=(2,)), "serving_prefill")
+        return self._jits[key]
+
+    def _logits_jit(self):
+        key = "pf_logits"
+        if key not in self._jits:
+            eng = self.engine
+            extra = ({"inter_axis": eng.inter_axis, "n_inter": eng.n_inter}
+                     if eng.hierarchical else {})
+
+            def step(params, x_last):
+                logits = dense_last_logits(params, self.cfg, x_last,
+                                           axis=eng.axis, num_ranks=eng.n,
+                                           **extra)
+                return sampling.greedy(logits)
+
+            fn = eng._shard(step, in_specs=(eng.param_specs, P()),
+                            out_specs=P())
+            self._jits[key] = self._first_call(
+                key, jax.jit(fn), "serving_logits")
+        return self._jits[key]
+
+    def _scatter_jit(self, n_pages: int):
+        key = ("scatter", n_pages)
+        if key not in self._jits:
+            eng = self.engine
+            L, page, s_buf = self.cfg.num_layers, self.page, self.s_buf
+
+            def step(cache, k_lin, v_lin, pages):
+                def to_pages(x):  # (L, 1, S_buf, hkv, d) local shard
+                    x = x[:, 0].reshape(L, s_buf // page, page,
+                                        *x.shape[3:])
+                    return x[:, :n_pages]
+
+                kp = cache.k_pools.at[:, pages].set(
+                    to_pages(k_lin).astype(cache.k_pools.dtype))
+                vp = cache.v_pools.at[:, pages].set(
+                    to_pages(v_lin).astype(cache.v_pools.dtype))
+                return cache._replace(k_pools=kp, v_pools=vp)
+
+            kv_spec = kv_cache_specs(eng.shard_axes)
+            fn = eng._shard(
+                step,
+                in_specs=(paged_cache_specs(eng.shard_axes),
+                          kv_spec.k, kv_spec.v, P()),
+                out_specs=paged_cache_specs(eng.shard_axes))
+            self._jits[key] = self._first_call(
+                key, jax.jit(fn, donate_argnums=(0,)), "serving_scatter")
+        return self._jits[key]
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int, *, priority: int = 0,
+               req_id: str | None = None
+               ) -> tuple[Request, AdmitResult]:
+        """Queue one request. Returns (request, admission verdict);
+        on :data:`AdmitResult.QUEUE_FULL` the request is NOT queued —
+        the caller sheds or retries (open-loop generators retry)."""
+        kw = {"req_id": req_id} if req_id is not None else {}
+        req = Request(prompt=[int(t) for t in np.asarray(prompt).ravel()],
+                      max_new_tokens=int(max_new_tokens),
+                      priority=priority, **kw)
+        res = self.sched.admit(req, self.clock())
+        if res is AdmitResult.QUEUE_FULL and self._observing():
+            obs_metrics.registry().counter(
+                obs_metrics.SERVE_REJECTS,
+                "requests refused at admission (queue/pool backpressure)"
+            ).inc()
+        return req, res
+
+    # -- the mixed iteration --------------------------------------------------
+    def step(self) -> dict:
+        """One scheduler iteration (admit → prefill slice → page growth /
+        preemption → decode). Returns a host-side summary dict."""
+        now = self.clock()
+        if self._t0 is None:
+            self._t0 = now
+        # The demotion ladder (driven from _slo_tick below, or by the
+        # engine's own serve) swaps the backend and clears the ENGINE's
+        # jit cache; this tier's slice/logits jits captured the OLD
+        # backend's mode at build time, so they must drop too — a
+        # demoted engine must not keep prefilling through the collective
+        # stack the demotion routed around.
+        if self.engine.backend != self._jits_backend:
+            self._jits.clear()
+            self._jits_backend = self.engine.backend
+        admitted = self.sched.schedule_admissions()
+        head = self.sched.prefill_head()
+        prefilled = None
+        if head is not None:
+            prefilled = self._prefill_slice(head)
+        ready, preempted = self.sched.ensure_decode_pages()
+        decoded = len(ready)
+        if ready:
+            self._decode(ready)
+        self._iter += 1
+        obs_on = self._observing()
+        if obs_on:
+            reg = obs_metrics.registry()
+            if preempted:
+                reg.counter(obs_metrics.SERVE_PREEMPTIONS,
+                            "sequences evicted under page pressure "
+                            "(recompute-on-resume)").inc(len(preempted))
+            self._publish_gauges(reg)
+        self._slo_tick()
+        return {"iter": self._iter, "admitted": [r.req_id for r in admitted],
+                "prefilled": prefilled,
+                "preempted": [r.req_id for r in preempted],
+                "decoded": decoded,
+                "waiting": len(self.sched.waiting),
+                "active": self.sched.active_count,
+                "free_pages": self.sched.allocator.free_count,
+                "admit_cap": self.sched.admit_cap}
+
+    def run(self, *, max_iters: int = 100_000) -> list[Request]:
+        """Drive until every queued request finishes; returns them in
+        finish order. Raises if ``max_iters`` elapses with work left
+        (a scheduling deadlock must be loud, never a silent hang)."""
+        start = len(self._finished)
+        it = 0
+        while self.sched.has_work():
+            if it >= max_iters:
+                raise RuntimeError(
+                    f"serving loop still has work after {max_iters} "
+                    f"iterations (waiting={len(self.sched.waiting)}, "
+                    f"active={self.sched.active_count}) — scheduling "
+                    "deadlock or max_iters too small")
+            self.step()
+            it += 1
+        return self._finished[start:]
+
+    # -- internals ------------------------------------------------------------
+    def _observing(self) -> bool:
+        return obs_trace.get_tracer() is not None or self.slo_cfg is not None
+
+    def _prefill_slice(self, req: Request) -> str:
+        text = req.text
+        T = len(text)
+        start = req.prefill_pos
+        ids = np.zeros((1, self.chunk), np.int32)
+        real = text[start:start + self.chunk]
+        ids[0, :len(real)] = real
+        eng = self.engine
+        eng._jit_compiled_last_call = False
+        t0 = self.clock()
+        with obs_trace.span("serving.prefill_slice", req=req.req_id,
+                            start=start, tokens=len(real)):
+            x, self._pf_cache = self._slice_jit()(
+                eng.params, jnp.asarray(ids), self._pf_cache,
+                jnp.int32(start))
+        req.prefill_pos = min(start + self.chunk, T)
+        done = req.prefill_pos >= T
+        if done:
+            row = (T - 1) - start
+            tok = self._logits_jit()(eng.params, x[row:row + 1])
+            tok = int(np.asarray(tok)[0])
+            now = self.clock()
+            req.tokens.append(tok)
+            req.kv_len = T
+            self.total_tokens += 1
+            self._rate_events.append((now, 1))
+            first = req.t_first_token is None
+            if first:
+                req.t_first_token = now
+            if self._observing():
+                reg = obs_metrics.registry()
+                reg.counter("tdtpu_tokens_generated_total",
+                            "decode tokens generated").inc()
+                if first:
+                    reg.histogram(
+                        obs_metrics.SERVE_TTFT_MS,
+                        "request time-to-first-token (arrival -> first "
+                        "token), ms",
+                        buckets=obs_metrics.TTFT_BUCKETS_MS,
+                    ).observe((now - req.t_arrival) * 1e3)
+                Engine._observe_step(
+                    reg, (now - t0) * 1e3, eng._jit_compiled_last_call,
+                    "tdtpu_prefill_latency_ms",
+                    "prefill wall latency (device-synced only in sync "
+                    "runs)")
+            n_pages = -(-T // self.page)
+            pages = self.sched.allocator.pages(req.req_id)[:n_pages]
+            self._cache = self._scatter_jit(n_pages)(
+                self._cache, self._pf_cache.k, self._pf_cache.v,
+                jnp.asarray(pages, jnp.int32))
+            req.advance(RequestState.RUNNING)
+            if req.done:
+                self._finish(req)
+        return req.req_id
+
+    def _finish(self, req: Request) -> None:
+        self.sched.finish(req, self.clock())
+        self._finished.append(req)
+        if self._observing():
+            reg = obs_metrics.registry()
+            reg.counter(obs_metrics.SERVE_FINISHED,
+                        "requests served to completion").inc()
+            tpot = req.tpot_s
+            if tpot is not None:
+                reg.histogram(
+                    obs_metrics.SERVE_TPOT_MS,
+                    "request mean time-per-output-token after the "
+                    "first, ms").observe(tpot * 1e3)
+
+    def _decode(self, ready: list[Request]) -> None:
+        eng = self.engine
+        alloc = self.sched.allocator
+        toks = np.zeros((self.max_batch,), np.int32)
+        lens = np.zeros((self.max_batch,), np.int32)
+        table = np.full((self.max_batch, self.max_pages),
+                        self.scratch_page, np.int32)
+        for req in ready:
+            toks[req.slot] = req.tokens[-1]
+            lens[req.slot] = req.kv_len
+            pages = alloc.pages(req.req_id)
+            table[req.slot, :len(pages)] = pages
+        cache = self._cache._replace(page_table=jnp.asarray(table),
+                                     kv_lens=jnp.asarray(lens))
+        eng._jit_compiled_last_call = False
+        t0 = self.clock()
+        with obs_trace.span("serving.decode_step", batch=len(ready)):
+            tok, self._cache = eng._decode_run(jnp.asarray(toks), cache)
+            tok_np = np.asarray(tok)        # host sync: the loop needs them
+        now = self.clock()
+        if self._observing():
+            reg = obs_metrics.registry()
+            reg.counter("tdtpu_tokens_generated_total",
+                        "decode tokens generated").inc(len(ready))
+            Engine._observe_step(
+                reg, (now - t0) * 1e3, eng._jit_compiled_last_call,
+                "tdtpu_decode_step_latency_ms",
+                "one decode step, wall (device-synced only in sync runs)")
+        self.total_tokens += len(ready)
+        self._rate_events.append((now, len(ready)))
+        for req in list(ready):
+            req.tokens.append(int(tok_np[req.slot]))
+            req.kv_len += 1
+            if req.done:
+                self._finish(req)
+
+    def _publish_gauges(self, reg) -> None:
+        reg.gauge(obs_metrics.SERVE_QUEUE_DEPTH,
+                  "requests waiting for admission"
+                  ).set(len(self.sched.waiting))
+        reg.gauge(obs_metrics.SERVE_FREE_PAGES,
+                  "free pages in the shared KV pool"
+                  ).set(self.sched.allocator.free_count)
+        reg.gauge(obs_metrics.SERVE_ACTIVE,
+                  "requests prefilling or decoding"
+                  ).set(self.sched.active_count)
+        reg.gauge(obs_metrics.SERVE_ADMIT_CAP,
+                  "SLO-driven admission width (slots)"
+                  ).set(self.sched.admit_cap)
+        reg.gauge(
+            obs_metrics.SERVE_TOKENS_PER_S,
+            "generated tokens/s — rolling window under ServingEngine, "
+            "per-call under Engine.serve").set(self._rolling_rate())
+
+    def _rolling_rate(self) -> float:
+        """Tokens/s over the trailing window — the throughput the SLO
+        watchdog's floor judges (a per-call gauge is meaningless across
+        many small interleaved steps — ISSUE 7 satellite)."""
+        now = self.clock()
+        w = self._rate_window_s
+        while self._rate_events and self._rate_events[0][0] < now - w:
+            self._rate_events.popleft()
+        total = sum(n for _, n in self._rate_events)
+        since_start = now - self._t0 if self._t0 is not None else 0.0
+        elapsed = min(w, max(since_start, 1e-6))
+        return total / max(elapsed, 1e-6)
+
+    def _slo_tick(self) -> None:
+        """Admission control from the live SLO watchdog: violation
+        streak shrinks the admitted width, clean streak regrows it; the
+        section also feeds the engine's demotion ladder (PR 6)."""
+        if not self._observing() or self._iter % self.slo_every:
+            return
+        if not self.sched.has_work():
+            # An idle tier violates no one: with a tokens/s floor set,
+            # the rolling rate decaying to 0 between arrivals would
+            # otherwise accrue a violation streak and shrink admission
+            # to 1 with no load present — an inverted feedback.
+            return
+        try:
+            from triton_distributed_tpu import obs
+            from triton_distributed_tpu.obs import slo as obs_slo
+
+            section = obs_slo.check_serving(
+                obs_metrics.registry(), run_dir=obs.active_run_dir(),
+                cfg=self.slo_cfg)
+        except Exception as e:   # the watchdog must never cost the serve
+            import warnings
+
+            warnings.warn(f"SLO watchdog failed: {type(e).__name__}: {e}",
+                          RuntimeWarning, stacklevel=2)
+            return
+        if section.get("violations", 0):
+            self._viol_streak += 1
+            self._clean_streak = 0
+        else:
+            self._clean_streak += 1
+            self._viol_streak = 0
+        if self._viol_streak >= _env_int("TDTPU_ADMIT_SHRINK_AFTER", 2):
+            cap = self.sched.shrink_admission()
+            self._viol_streak = 0
+            with obs_trace.span("serving.admission_shrink", cap=cap):
+                pass
+        elif self._clean_streak >= _env_int("TDTPU_ADMIT_GROW_AFTER", 4):
+            if self.sched.admit_cap < self.sched.num_slots:
+                cap = self.sched.grow_admission()
+                with obs_trace.span("serving.admission_grow", cap=cap):
+                    pass
+            self._clean_streak = 0
+        # Cooperate with the backend demotion ladder: the engine consumes
+        # the same section its own serve() would have produced.
+        self.engine._last_slo_section = section
+        self.engine._slo_streak_update()
