@@ -720,10 +720,15 @@ def _build_mega_program(*, force_ar_tasks: bool = False):
     hidden, hq, hkv, ffn, L, S, pos = 4096, 4, 1, 1536, 36, 512, 256
     vocab = 151936
     rng = np.random.default_rng(0)
+    # Round 9: mat_prefetch emits the PREFETCH_MAT warms — the o-proj
+    # (and on the AR rung, gate/up) weight chunk streams under the
+    # attention task / the ALLREDUCE_ROW barrier, the stall-slice kill
+    # the full-model attribution targets (megakernel_vs_jit_max 1.0).
     prog = build_decode_step(hidden=hidden, hq_local=hq, hkv_local=hkv,
                              ffn_local=ffn, num_layers=L, max_seq=S,
                              pos=pos, num_ranks=1, final_norm=True,
-                             force_ar_tasks=force_ar_tasks)
+                             force_ar_tasks=force_ar_tasks,
+                             mat_prefetch=True)
     comp = prog.mb.compile(dtype=jnp.bfloat16, force_ar=force_ar_tasks)
 
     d = TILE
@@ -903,10 +908,25 @@ def _serving_metric():
     decode-chain rungs, every host-side cost of serving (scheduler,
     per-iteration dispatch, page-table rebuilds) is IN the number —
     that is the tier being measured. One warmup replay compiles all
-    traces; the measured replay is steady-state."""
+    traces; the measured replay is steady-state.
+
+    Round 9: the megakernel serving lane races the xla rung in the SAME
+    window (`serve_tokens_per_s_megakernel` — decode through the paged
+    persistent kernel, page_size = TILE, one launch per mixed step);
+    its failure is additive, never blocking the xla rung's number."""
     from triton_distributed_tpu.serving.loadgen import serving_bench_rung
 
-    return serving_bench_rung(n_streams=8, prompt_len=128, max_new=16)
+    out = serving_bench_rung(n_streams=8, prompt_len=128, max_new=16)
+    try:
+        mk = serving_bench_rung(n_streams=8, prompt_len=128, max_new=16,
+                                backend="megakernel", page_size=128)
+        out["serve_tokens_per_s_megakernel"] = \
+            mk["serve_tokens_per_s_concurrent"]
+        out["serve_ttft_p99_ms_megakernel"] = mk["serve_ttft_p99_ms"]
+    except Exception as e:    # additive rung never blocks the xla rung
+        out["serving_megakernel_error"] = \
+            f"{type(e).__name__}: {str(e)[:120]}"
+    return out
 
 
 def _fp8_decode_step_metric(gen=(16, 40, 64)):

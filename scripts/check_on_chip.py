@@ -132,9 +132,11 @@ def floor_flash32k_ms() -> float:
 def floor_megakernel_vs_jit() -> float:
     """Full-model megakernel decode step vs the jitted bare-shard ladder
     (bench.py's own rungs — same fail-loud chains) must stay under
-    ON_CHIP_FLOORS['megakernel_vs_jit_max'] (tightened 2.0 -> 1.5 in
-    round 6 with the cross-layer fused assembly; r5 pre-fusion measured
-    1.58x). Slow: compiles two 36-layer programs."""
+    ON_CHIP_FLOORS['megakernel_vs_jit_max'] (2.0 -> 1.5 in round 6 with
+    the cross-layer fused assembly; -> 1.0 in round 9 with the
+    PREFETCH_MAT stall-slice kill — the megakernel must not lose to
+    bare jit, the reference's ordering). Slow: compiles two 36-layer
+    programs."""
     import bench
     from triton_distributed_tpu.obs.gate import ON_CHIP_FLOORS
 

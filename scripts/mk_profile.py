@@ -115,14 +115,18 @@ def build_case(name, emit, L, feeds_fn, dtype):
     return compiled, ws, wsm
 
 
-def _full_model_program(dtype):
+def _full_model_program(dtype, batch=1, head_dim=TILE):
     """The bench rung's full-model program (TPU: bench.py's OWN builder,
     so the attribution measures exactly the program the rung ships) or
     the CPU-smoke miniature — returns (prog, comp, ws, wsm, embed,
     shapes); ``embed`` is None off-TPU (the smoke path never times the
-    whole-model chain)."""
+    whole-model chain). ``batch``/``head_dim`` (CPU smoke only, round
+    9): exercise the row-blocked batch > TILE emission and the
+    padded-head head_dim-64 layout — CI runs the attribution on a
+    batch=2·TILE, head_dim-64 queue."""
     from triton_distributed_tpu.megakernel.models import (
-        broadcast_rows, build_decode_step, feed_layer_weights, rope_tables,
+        broadcast_rows, build_decode_step, feed_layer_weights,
+        pad_head_vec, rope_tables,
     )
 
     if ON_TPU:
@@ -131,34 +135,44 @@ def _full_model_program(dtype):
         prog, comp, ws, wsm, embed, hidden = bench._build_mega_program()
         return prog, comp, ws, wsm, embed, (hidden, 4, 1, 1536, 36, 512)
     hidden, hq, hkv, ffn, L, S, pos = 256, 2, 1, 256, 2, 256, 100
+    hd = head_dim
     d = TILE
+    bt = -(-batch // TILE)
     rng = np.random.default_rng(0)
     prog = build_decode_step(hidden=hidden, hq_local=hq, hkv_local=hkv,
                              ffn_local=ffn, num_layers=L, max_seq=S,
-                             pos=pos, num_ranks=1, final_norm=True)
-    comp = prog.mb.compile(dtype=dtype)
-    cos, sin = rope_tables(pos, d, 1e6)
+                             pos=pos, num_ranks=1, final_norm=True,
+                             batch=batch, head_dim=hd,
+                             mat_prefetch=True)
+    comp = prog.mb.compile(dtype=dtype, head_dim=hd)
+    cos, sin = rope_tables(pos, hd, 1e6)
     feeds = {prog.cos: cos, prog.sin: sin,
-             prog.x: rng.standard_normal((TILE, hidden)).astype(np.float32)
-             * 0.05,
+             prog.x: rng.standard_normal(
+                 (bt * TILE, hidden)).astype(np.float32) * 0.05,
              prog.fnorm: broadcast_rows(np.ones(hidden, np.float32))}
     for h in prog.layers:
-        for nh, width in ((h.attn_norm, hidden), (h.mlp_norm, hidden),
-                          (h.q_norm, d), (h.k_norm, d)):
+        for nh, width in ((h.attn_norm, hidden), (h.mlp_norm, hidden)):
             feeds[nh] = broadcast_rows(
                 rng.standard_normal(width).astype(np.float32) * .1 + 1)
+        for nh in (h.q_norm, h.k_norm):
+            feeds[nh] = broadcast_rows(pad_head_vec(
+                rng.standard_normal(hd).astype(np.float32) * .1 + 1, hd))
         feed_layer_weights(
-            feeds, h,
-            wq=rng.standard_normal((hidden, hq * d)).astype(np.float32) * .02,
-            wk=rng.standard_normal((hidden, hkv * d)).astype(np.float32) * .02,
-            wv=rng.standard_normal((hidden, hkv * d)).astype(np.float32) * .02,
-            wo=rng.standard_normal((hq * d, hidden)).astype(np.float32) * .02,
+            feeds, h, head_dim=hd,
+            wq=rng.standard_normal((hidden, hq * hd)).astype(np.float32) * .02,
+            wk=rng.standard_normal((hidden, hkv * hd)).astype(np.float32) * .02,
+            wv=rng.standard_normal((hidden, hkv * hd)).astype(np.float32) * .02,
+            wo=rng.standard_normal((hq * hd, hidden)).astype(np.float32) * .02,
             w_gate=rng.standard_normal((hidden, ffn)).astype(np.float32) * .02,
             w_up=rng.standard_normal((hidden, ffn)).astype(np.float32) * .02,
             w_down=rng.standard_normal((ffn, hidden)).astype(np.float32) * .02)
         for tk, tv in zip(h.kT, h.v):
-            feeds[tk] = rng.standard_normal((d, S)).astype(np.float32) * .3
-            feeds[tv] = rng.standard_normal((S, d)).astype(np.float32) * .3
+            kc = np.zeros((d, S), np.float32)
+            kc[:hd] = rng.standard_normal((hd, S)).astype(np.float32) * .3
+            vc = np.zeros((S, d), np.float32)
+            vc[:, :hd] = rng.standard_normal((S, hd)).astype(np.float32) * .3
+            feeds[tk] = kc
+            feeds[tv] = vc
     main_f, _w8, mat_f = comp.split_feeds(feeds)
     ws = comp.make_workspace(
         {k: jnp.asarray(v) for k, v in main_f.items()})
@@ -166,10 +180,11 @@ def _full_model_program(dtype):
     return prog, comp, ws, wsm, None, (hidden, hq, hkv, ffn, L, S)
 
 
-def full_model_main(json_out, measured=None):
+def full_model_main(json_out, measured=None, batch=1, head_dim=TILE):
     """Round-6 full-model attribution: per-task accounting of the whole
     num_layers decode queue — where the extra milliseconds beyond
-    layer-scale live (ISSUE 5 tentpole step 1)."""
+    layer-scale live (ISSUE 5 tentpole step 1; round 9 adds --batch /
+    --head-dim so CI attributes the generalized queues too)."""
     import collections
     import json
 
@@ -178,7 +193,8 @@ def full_model_main(json_out, measured=None):
     )
 
     dtype = jnp.bfloat16 if ON_TPU else jnp.float32
-    prog, comp, ws0, wsm0, embed, shapes = _full_model_program(dtype)
+    prog, comp, ws0, wsm0, embed, shapes = _full_model_program(
+        dtype, batch=batch, head_dim=head_dim)
     hidden, hq, hkv, ffn, L, S = shapes
     itemsize = jnp.dtype(dtype).itemsize
 
@@ -277,8 +293,21 @@ def main():
                      "[--json OUT]")
         with open(sys.argv[i + 1]) as f:
             measured = _json.load(f).get("per_type_seconds") or None
+
+    def _int_flag(name, default):
+        if name not in sys.argv:
+            return default
+        i = sys.argv.index(name)
+        if i + 1 >= len(sys.argv):
+            sys.exit(f"usage: mk_profile.py [--full-model] [{name} N]")
+        return int(sys.argv[i + 1])
+
     if "--full-model" in sys.argv:
-        return full_model_main(json_out, measured=measured)
+        # --batch / --head-dim (round 9, CPU smoke): attribute the
+        # row-blocked batch>TILE and padded-head head_dim-64 queues.
+        return full_model_main(json_out, measured=measured,
+                               batch=_int_flag("--batch", 1),
+                               head_dim=_int_flag("--head-dim", TILE))
     if ON_TPU:
         hidden, hq, hkv, ffn, S = 4096, 4, 1, 1536, 1024
         # Post-rework tasks run ~3-20 us: the differential needs tens of

@@ -262,6 +262,115 @@ def test_decode_step_tp8(ctx):
         np.testing.assert_allclose(got[r][:B], ref, rtol=5e-3, atol=5e-3)
 
 
+def test_decode_step_batch_two_tiles_matches_golden():
+    """batch = 2·TILE (round-9 row-blocked emission): every TILE-chunk
+    of the batch gets its own task row, outputs ride x_out_blocks, and
+    the whole 256-row batch matches the eager golden."""
+    hidden, hq, hkv, ffn, S, pos = 256, 2, 1, 256, 256, 100
+    B = 2 * TILE
+    rng = np.random.default_rng(7)
+    prog = build_decode_step(hidden=hidden, hq_local=hq, hkv_local=hkv,
+                             ffn_local=ffn, num_layers=1, max_seq=S,
+                             pos=pos, num_ranks=1, batch=B)
+    comp = prog.mb.compile()
+    w = _rand_layer_weights(rng, hidden, hq, hkv, ffn, pos)
+    kT_np = [rng.standard_normal((TILE, S)).astype(np.float32) * 0.3]
+    v_np = [rng.standard_normal((S, TILE)).astype(np.float32) * 0.3]
+    x = rng.standard_normal((B, hidden)).astype(np.float32) * 0.3
+    feeds = {prog.x: jnp.asarray(x), prog.cos: jnp.asarray(w["cos_full"]),
+             prog.sin: jnp.asarray(w["sin_full"])}
+    feeds.update({k: _j(v) for k, v in _feed_layer(
+        prog, prog.layers[0], w, kT_np, v_np).items()})
+    assert prog.blocks == 2 and len(prog.x_out_blocks) == 2
+    outs = comp.run(feeds, outputs=prog.x_out_blocks)
+    got = np.concatenate([np.asarray(o) for o in outs], axis=0)
+    ref = _golden_layer(x, w, pos, kT_np, v_np, hq, hkv)
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_decode_step_head_dim_64_matches_golden():
+    """head_dim 64 (round-9 padded-head layout, the Qwen3-0.6B/1.7B
+    presets): each head lives in the low 64 lanes of its tile, the
+    norm/rope sub-tile math spans head_dim, and the result matches an
+    eager d=64 golden."""
+    from triton_distributed_tpu.megakernel.models import pad_head_vec
+
+    hd = 64
+    hidden, hq, hkv, ffn, S, pos, B = 256, 2, 1, 256, 256, 100, 3
+    rng = np.random.default_rng(3)
+    prog = build_decode_step(hidden=hidden, hq_local=hq, hkv_local=hkv,
+                             ffn_local=ffn, num_layers=1, max_seq=S,
+                             pos=pos, num_ranks=1, head_dim=hd)
+    comp = prog.mb.compile(head_dim=hd)
+    h = prog.layers[0]
+    w = {k: rng.standard_normal(s).astype(np.float32) * 0.05 for k, s in [
+        ("wq", (hidden, hq * hd)), ("wk", (hidden, hkv * hd)),
+        ("wv", (hidden, hkv * hd)), ("wo", (hq * hd, hidden)),
+        ("w_gate", (hidden, ffn)), ("w_up", (hidden, ffn)),
+        ("w_down", (ffn, hidden))]}
+    anorm = rng.standard_normal(hidden).astype(np.float32) * 0.1 + 1
+    mnorm = rng.standard_normal(hidden).astype(np.float32) * 0.1 + 1
+    qn = rng.standard_normal(hd).astype(np.float32) * 0.1 + 1
+    kn = rng.standard_normal(hd).astype(np.float32) * 0.1 + 1
+    # Cache in the PADDED tile layout: real rows/cols [0:hd], pad zero.
+    kc = rng.standard_normal((hd, S)).astype(np.float32) * 0.3
+    vc = rng.standard_normal((S, hd)).astype(np.float32) * 0.3
+    kT_pad = np.zeros((TILE, S), np.float32)
+    kT_pad[:hd] = kc
+    v_pad = np.zeros((S, TILE), np.float32)
+    v_pad[:, :hd] = vc
+    cos, sin = rope_tables(pos, hd, 1e6)
+    x = np.zeros((TILE, hidden), np.float32)
+    x[:B] = rng.standard_normal((B, hidden)).astype(np.float32) * 0.3
+    feeds = {prog.x: jnp.asarray(x), prog.cos: jnp.asarray(cos),
+             prog.sin: jnp.asarray(sin),
+             h.attn_norm: jnp.asarray(broadcast_rows(anorm)),
+             h.mlp_norm: jnp.asarray(broadcast_rows(mnorm)),
+             h.q_norm: jnp.asarray(broadcast_rows(pad_head_vec(qn, hd))),
+             h.k_norm: jnp.asarray(broadcast_rows(pad_head_vec(kn, hd))),
+             h.kT[0]: jnp.asarray(kT_pad), h.v[0]: jnp.asarray(v_pad)}
+    feed_layer_weights(feeds, h, head_dim=hd,
+                       **{k: jnp.asarray(v) for k, v in w.items()})
+    feeds = {k: (tuple(jnp.asarray(e) for e in v) if isinstance(v, tuple)
+                 else jnp.asarray(v)) for k, v in feeds.items()}
+    (out,) = comp.run(feeds, outputs=[prog.x_out])
+
+    def rms(a, g, eps=1e-6):
+        return (a / np.sqrt((a ** 2).mean(-1, keepdims=True) + eps)) * g
+
+    def rope(a, ch, sh):
+        a1, a2 = a[:, :hd // 2], a[:, hd // 2:]
+        return np.concatenate([a1 * ch - a2 * sh, a2 * ch + a1 * sh], 1)
+
+    ch, sh = cos[0, :hd // 2], sin[0, :hd // 2]
+    xb = x[:B]
+    xn = rms(xb, anorm)
+    q = xn @ w["wq"]
+    k_new = xn @ w["wk"]
+    v_new = xn @ w["wv"]
+    groups = hq // hkv
+    attn = np.zeros_like(q)
+    for j in range(hq):
+        kv = j // groups
+        qj = rope(rms(q[:, j * hd:(j + 1) * hd], qn), ch, sh)
+        kj = rope(rms(k_new[:, kv * hd:(kv + 1) * hd], kn), ch, sh)
+        vj = v_new[:, kv * hd:(kv + 1) * hd]
+        s_cache = (qj @ kc[:, :pos]) * hd ** -0.5
+        s_cur = (qj * kj).sum(-1, keepdims=True) * hd ** -0.5
+        s = np.concatenate([s_cache, s_cur], axis=1)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        attn[:, j * hd:(j + 1) * hd] = (p[:, :pos] @ vc[:pos]
+                                        + p[:, pos:] * vj)
+    x1 = xb + attn @ w["wo"]
+    x1n = rms(x1, mnorm)
+    g = x1n @ w["w_gate"]
+    act = g / (1 + np.exp(-g)) * (x1n @ w["w_up"])
+    ref = x1 + act @ w["w_down"]
+    np.testing.assert_allclose(np.asarray(out)[:B], ref,
+                               rtol=3e-3, atol=3e-3)
+
+
 def test_paged_decode_step_matches_linear():
     """build_decode_step(paged=True): attention walks page-table DATA rows
     over the kT/v pools; with identity tables it equals the linear decode
@@ -670,8 +779,13 @@ def test_build_decode_step_named_errors():
     def build(**kw):
         return build_decode_step(**{**ok, **kw})
 
-    with pytest.raises(ValueError, match=r"head_dim = 64.*head_dim"):
-        build(head_dim=64)
+    # Round 9 lifted the two Qwen3-8B-only dims: head_dim 64 and
+    # batch > TILE now BUILD (parity tests cover their execution);
+    # anything else stays a named error.
+    assert build(head_dim=64).layers
+    assert build(batch=200).blocks == 2
+    with pytest.raises(ValueError, match=r"head_dim = 96.*head_dim"):
+        build(head_dim=96)
     with pytest.raises(ValueError, match=r"hidden = 200.*hidden_size"):
         build(hidden=200)
     with pytest.raises(ValueError,
@@ -679,8 +793,10 @@ def test_build_decode_step_named_errors():
         build(ffn_local=100)
     with pytest.raises(ValueError, match=r"max_seq = 100.*max_seq"):
         build(max_seq=100)
-    with pytest.raises(ValueError, match=r"batch = 200.*batch"):
-        build(batch=200)
+    with pytest.raises(ValueError, match=r"batch = 200.*fp8"):
+        build(batch=200, fp8_weights=True)
+    with pytest.raises(ValueError, match=r"batch = 200.*inkernel_append"):
+        build(batch=200, inkernel_append=True)
     with pytest.raises(ValueError, match=r"batch = 0"):
         build(batch=0)
     with pytest.raises(ValueError, match=r"num_layers = 0.*num_layers"):

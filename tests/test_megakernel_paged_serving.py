@@ -1,0 +1,330 @@
+"""Round-9 megakernel serving lane: paged workspace + shape
+generalization + ladder-integrated demotion.
+
+Covers the ISSUE-9 acceptance set on CPU interpret mode:
+
+* paged-megakernel decode token-parity vs ``dense_decode_step_paged``
+  over heterogeneous ``kv_lens`` (each slot its own page table over the
+  shared pools);
+* ``ServingEngine(backend="megakernel")`` token-identical to the xla
+  serving loop, including a preempted+resumed request ON the paged
+  workspace (the loadgen dryrun repeats this contract in CI);
+* head_dim-64 (padded-head layout) and batch = 2·TILE (row-blocked
+  emission) parity vs the chained golden;
+* ``BackendUnsupportedError`` demotes through the PR-6 ladder instead
+  of killing serve (page-shape mismatch = transient);
+* the PageAllocator accounts the megakernel scratch page under
+  ``reserved=`` (budget math can't oversubscribe the pool).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.megakernel.tasks import TILE
+from triton_distributed_tpu.models.config import ModelConfig
+from triton_distributed_tpu.models.dense import init_dense_llm
+from triton_distributed_tpu.models.engine import Engine
+from triton_distributed_tpu.runtime import initialize_distributed
+from triton_distributed_tpu.serving.loop import ServingEngine
+
+
+@pytest.fixture(scope="module")
+def ctx1():
+    return initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                  devices=jax.devices()[:1])
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = ModelConfig(hidden_size=256, intermediate_size=256, num_layers=2,
+                      num_heads=2, num_kv_heads=1, head_dim=128,
+                      vocab_size=512, qk_norm=True, dtype="float32")
+    params = init_dense_llm(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def one_layer_model():
+    cfg = ModelConfig(hidden_size=256, intermediate_size=256, num_layers=1,
+                      num_heads=2, num_kv_heads=1, head_dim=128,
+                      vocab_size=512, qk_norm=True, dtype="float32")
+    params = init_dense_llm(jax.random.PRNGKey(1), cfg)
+    return cfg, params
+
+
+def test_paged_megakernel_decode_parity_heterogeneous(tiny_model):
+    """Paged MK decode == dense_decode_step_paged token-for-token over
+    two slots at different lengths (own pages each, in-kernel appends
+    advancing the pools)."""
+    from triton_distributed_tpu.megakernel.serving import (
+        PagedMegakernelDecoder,
+    )
+    from triton_distributed_tpu.models import sampling
+    from triton_distributed_tpu.models.dense import (
+        dense_decode_step_paged, dense_prefill,
+    )
+    from triton_distributed_tpu.models.kv_cache import (
+        init_kv_cache, init_paged_model_cache,
+    )
+
+    cfg, params = tiny_model
+    prompts = [[3, 141, 59, 26, 5], [7, 9, 23]]
+    num_slots, num_pages, max_pages = 2, 4, 2
+    dec = PagedMegakernelDecoder(cfg, params, num_slots=num_slots,
+                                 num_pages=num_pages, max_pages=max_pages)
+    ws = dec.start()
+
+    pcache = init_paged_model_cache(cfg, num_slots, page_size=TILE,
+                                    max_pages=max_pages,
+                                    num_pages=num_pages + 1)
+    table = np.full((num_slots, max_pages), num_pages, np.int32)
+    page_alloc = {0: [0, 1], 1: [2, 3]}
+    kv_lens = np.zeros(num_slots, np.int32)
+    toks = np.zeros(num_slots, np.int32)
+    kp = np.array(pcache.k_pools)
+    vp = np.array(pcache.v_pools)
+    for b, prompt in enumerate(prompts):
+        lin = init_kv_cache(cfg, 1, 256)
+        logits, lin = dense_prefill(params, cfg,
+                                    jnp.asarray([prompt], jnp.int32), lin,
+                                    num_ranks=1)
+        toks[b] = int(np.asarray(sampling.greedy(logits))[0])
+        kv_lens[b] = len(prompt)
+        pages = page_alloc[b]
+        table[b, :len(pages)] = pages
+        ws = dec.load_prefill(ws, lin.k, lin.v, pages)
+        kl, vl = np.asarray(lin.k), np.asarray(lin.v)
+        for i, p in enumerate(pages):
+            kp[:, p] = kl[:, 0, i * TILE:(i + 1) * TILE]
+            vp[:, p] = vl[:, 0, i * TILE:(i + 1) * TILE]
+    pcache = pcache._replace(
+        k_pools=jnp.asarray(kp), v_pools=jnp.asarray(vp),
+        page_table=jnp.asarray(table), kv_lens=jnp.asarray(kv_lens))
+
+    mk_tok = toks.copy()
+    g_tok = jnp.asarray(toks)
+    for _ in range(3):
+        tables = [page_alloc[b] for b in range(num_slots)]
+        ws, nt = dec.step(ws, mk_tok, kv_lens, tables)
+        mk_tok = np.asarray(nt)
+        logits, pcache = dense_decode_step_paged(
+            params, cfg, g_tok, pcache, num_ranks=1, mode="xla_rep")
+        g_tok = sampling.greedy(logits)
+        np.testing.assert_array_equal(mk_tok, np.asarray(g_tok))
+        kv_lens = kv_lens + 1
+
+    # The host retarget validates page coverage: a kv_len needing more
+    # pages than the table maps must fail loudly (silently riding the
+    # scratch page would corrupt the sequence).
+    with pytest.raises(ValueError, match="mapped pages"):
+        dec._retarget([TILE + 1, 0], [[0], []])
+    with pytest.raises(ValueError, match="at capacity"):
+        dec._retarget([dec.capacity, 0], [[0, 1], []])
+    # Write-side twin: at an exact page boundary the APPEND page (index
+    # kvl // TILE) must also be mapped, or the token's KV would silently
+    # land on the scratch page.
+    with pytest.raises(ValueError, match="page growth"):
+        dec._retarget([TILE, 0], [[0], []])
+
+
+def test_serving_engine_megakernel_matches_xla(tiny_model, ctx1):
+    """ServingEngine(backend='megakernel') serves token-identical to the
+    xla serving loop — 3 requests through 2 slots (slot reuse), decode
+    on the persistent kernel the whole way (no silent demotion)."""
+    cfg, params = tiny_model
+    reqs = [([3, 141, 59, 26, 5], 4), ([7, 9, 23], 5), ([100, 4], 3)]
+
+    def run(backend):
+        eng = Engine(cfg, params, ctx1, backend=backend, max_seq=256,
+                     page_size=128)
+        se = ServingEngine(eng, max_batch=2, num_pages=4,
+                           prefill_chunk=128)
+        out = {}
+        for i, (p, mn) in enumerate(reqs):
+            req, res = se.submit(p, mn, req_id=f"r{i}")
+            assert res.name == "ADMITTED", res
+            out[req.req_id] = req
+        se.run()
+        return {k: r.tokens for k, r in out.items()}, se
+
+    mk, se_mk = run("megakernel")
+    assert se_mk._mk is not None, "megakernel lane demoted unexpectedly"
+    assert se_mk.engine.backend == "megakernel"
+    xla, _ = run("xla")
+    assert mk == xla
+
+
+def test_serving_engine_megakernel_preempt_resume(one_layer_model, ctx1):
+    """A request preempted under page pressure ON the paged megakernel
+    workspace resumes (recompute) and still matches the xla loop —
+    the PR-7 admission/preemption machinery drives the persistent
+    backend unchanged."""
+    cfg, params = one_layer_model
+    rng = np.random.default_rng(0)
+    reqs = [(rng.integers(0, 512, 126).tolist(), 6, 1),
+            (rng.integers(0, 512, 100).tolist(), 4, 0)]
+
+    def run(backend):
+        eng = Engine(cfg, params, ctx1, backend=backend, max_seq=256,
+                     page_size=128)
+        se = ServingEngine(eng, max_batch=2, num_pages=2,
+                           prefill_chunk=128)
+        out = {}
+        for i, (p, mn, prio) in enumerate(reqs):
+            req, res = se.submit(p, mn, priority=prio, req_id=f"r{i}")
+            assert res.name == "ADMITTED", res
+            out[req.req_id] = req
+        se.run()
+        return out, se
+
+    mk, se_mk = run("megakernel")
+    xla, _ = run("xla")
+    assert se_mk._mk is not None
+    assert {k: r.tokens for k, r in mk.items()} \
+        == {k: r.tokens for k, r in xla.items()}
+    assert any(r.preemptions > 0 for r in mk.values()), \
+        "pool sizing no longer exercises preemption on the MK lane"
+
+
+def test_megakernel_backend_demotes_not_dies(tiny_model, ctx1):
+    """Workspace/page-shape mismatch = TRANSIENT: (a) ServingEngine with
+    page_size != TILE demotes through the ladder at construction and
+    still serves; (b) sequential Engine.serve on a paged megakernel
+    engine demotes instead of raising the old anonymous ValueError."""
+    import warnings
+
+    from triton_distributed_tpu import resilience
+
+    cfg, params = tiny_model
+    # (a) serving tier: page 64 mismatches TILE.
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        eng = Engine(cfg, params, ctx1, backend="megakernel", max_seq=256,
+                     page_size=64)
+        se = ServingEngine(eng, max_batch=2, num_pages=8, prefill_chunk=64)
+    assert se._mk is None
+    assert eng.backend != "megakernel"
+    req, res = se.submit([7, 9, 23], 3, req_id="d0")
+    se.run()
+    assert len(req.tokens) == 3
+
+    # (b) sequential serve: BackendUnsupportedError is transient and the
+    # ladder demotes; the output matches the xla engine token-for-token.
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        eng2 = Engine(cfg, params, ctx1, backend="megakernel",
+                      max_seq=256, page_size=64)
+        out = np.asarray(eng2.serve(jnp.asarray([[3, 141, 59]], jnp.int32),
+                                    gen_len=4))
+    assert eng2.backend != "megakernel"
+    eng_x = Engine(cfg, params, ctx1, backend="xla", max_seq=256)
+    out_x = np.asarray(eng_x.serve(jnp.asarray([[3, 141, 59]], jnp.int32),
+                                   gen_len=4))
+    np.testing.assert_array_equal(out, out_x)
+    assert resilience.is_transient(
+        resilience.BackendUnsupportedError("x"))
+
+
+def test_ladder_disabled_raises_named_error(tiny_model, ctx1, monkeypatch):
+    """With TDTPU_DEMOTION_LADDER=0 the mismatch must surface as the
+    NAMED BackendUnsupportedError (an operator who pinned the backend
+    gets the diagnosis, not a silent fallback)."""
+    from triton_distributed_tpu.resilience import BackendUnsupportedError
+
+    cfg, params = tiny_model
+    monkeypatch.setenv("TDTPU_DEMOTION_LADDER", "0")
+    eng = Engine(cfg, params, ctx1, backend="megakernel", max_seq=256,
+                 page_size=64)
+    with pytest.raises(BackendUnsupportedError, match="page_size"):
+        ServingEngine(eng, max_batch=2, num_pages=8, prefill_chunk=64)
+
+
+def test_page_allocator_reserved_scratch_budget(tiny_model, ctx1):
+    """The megakernel scratch page is a REAL reserved pool row: the
+    allocator never hands it out, free_count excludes it, and the
+    admission budget checks usable (not raw) pages."""
+    import warnings
+
+    from triton_distributed_tpu.models.kv_cache import PageAllocator
+    from triton_distributed_tpu.serving.scheduler import (
+        RequestTooLargeError,
+    )
+
+    alloc = PageAllocator(5, 4, reserved=(4,))
+    assert alloc.usable_pages == 4
+    assert alloc.free_count == 4
+    got = alloc.alloc_pages("a", 4)
+    assert got == [0, 1, 2, 3]          # scratch (4) never allocated
+    assert alloc.alloc_pages("b", 1) is None   # pool exhausted, not scratch
+    alloc.free_pages("a")
+    assert alloc.free_count == 4
+
+    # Serving wiring: with the MK lane active the scheduler's allocator
+    # carries the scratch page reserved, and a request sized to the RAW
+    # pool (num_pages + scratch) is refused up front.
+    cfg, params = tiny_model
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        eng = Engine(cfg, params, ctx1, backend="megakernel", max_seq=256,
+                     page_size=128)
+        se = ServingEngine(eng, max_batch=2, num_pages=1,
+                           prefill_chunk=128)
+    assert se._mk is not None
+    a = se.sched.allocator
+    assert a.num_pages == 2 and a.usable_pages == 1
+    assert a.reserved == (se.scratch_page,)
+    with pytest.raises(RequestTooLargeError, match="usable"):
+        # 2 pages of budget vs 1 usable: must be refused at admission.
+        se.submit(list(range(100)), 100)
+
+
+def test_mat_prefetch_warm_program_structure_and_parity():
+    """PREFETCH_MAT + gemm_mat(prefetch_first=True): bit-identical to
+    the cold task, one PREFETCH_MAT row per warm in the queue, and the
+    builder rejects an unconsumed/mismatched warm."""
+    from triton_distributed_tpu.megakernel.builder import MegaKernelBuilder
+    from triton_distributed_tpu.megakernel.models import build_decode_step
+    from triton_distributed_tpu.megakernel.tasks import TaskType
+
+    rng = np.random.default_rng(11)
+    mb = MegaKernelBuilder()
+    a = mb.tensor(TILE, 256)
+    w = mb.tensor_mat(256, 256)
+    o_warm = mb.tensor(TILE, 256)
+    o_cold = mb.tensor(TILE, 256)
+    filler = mb.tensor(TILE, 256)
+    fo = mb.tensor(TILE, 256)
+    mb.prefetch_mat(w)
+    mb.add(fo, filler, filler)       # the task the warm DMA flies under
+    mb.gemm_mat(o_warm, a, w, prefetch_first=True)
+    mb.gemm_mat(o_cold, a, w)
+    comp = mb.compile()
+    assert any(sp.warm for sp in comp.mat_specs)
+    av = rng.standard_normal((TILE, 256)).astype(np.float32) * 0.1
+    wv = rng.standard_normal((256, 256)).astype(np.float32) * 0.1
+    fv = rng.standard_normal((TILE, 256)).astype(np.float32)
+    r1, r2 = comp.run({a: jnp.asarray(av), w: jnp.asarray(wv),
+                       filler: jnp.asarray(fv)},
+                      outputs=[o_warm, o_cold])
+    np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
+
+    # Builder contracts: double warm / mismatched consumer / unconsumed.
+    mb2 = MegaKernelBuilder()
+    w2 = mb2.tensor_mat(256, 256)
+    mb2.prefetch_mat(w2)
+    with pytest.raises(ValueError, match="not yet consumed"):
+        mb2.prefetch_mat(w2)
+    with pytest.raises(ValueError, match="never consumed"):
+        mb2.compile()
+
+    # The decode assembly emits one warm per layer at n=1 (the o-proj
+    # chunk streaming under attention).
+    prog = build_decode_step(hidden=256, hq_local=2, hkv_local=1,
+                             ffn_local=256, num_layers=2, max_seq=256,
+                             pos=100, num_ranks=1, mat_prefetch=True)
+    comp2 = prog.mb.compile()
+    q = np.asarray(comp2.queue)[:comp2.num_exec, 0]
+    assert (q == int(TaskType.PREFETCH_MAT)).sum() == 2

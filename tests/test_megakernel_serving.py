@@ -53,9 +53,14 @@ def test_megakernel_decoder_validates(ctx1, tiny_model):
 
     cfg, _ = tiny_model
     validate_megakernel_cfg(cfg, 128)
+    # Round 9: head_dim 64 is SERVED (padded-head layout) — only other
+    # head dims stay rejected.
+    validate_megakernel_cfg(
+        ModelConfig(head_dim=64, hidden_size=256,
+                    intermediate_size=256), 128)
     with pytest.raises(ValueError, match="head_dim"):
         validate_megakernel_cfg(
-            ModelConfig(head_dim=64, hidden_size=256,
+            ModelConfig(head_dim=96, hidden_size=256,
                         intermediate_size=256), 128)
     with pytest.raises(ValueError, match="TILE multiple"):
         validate_megakernel_cfg(cfg, 100)

@@ -45,6 +45,12 @@ class MegaKernelBuilder:
     _WM_HAZARD = 1 << 29
 
     def __init__(self):
+        # NORM_ROPE(_QKV) sub-tile span: the program ASSEMBLY sets this
+        # (build_decode_step(head_dim=)) so compile() cannot silently
+        # mismatch it — a 64-head program compiled at TILE would norm
+        # over the zero pad (scale off by sqrt(2)) and rotate the wrong
+        # half, wrong tokens with no error.
+        self.head_dim = TILE
         self._num_tiles = 0
         self._num_tiles8 = 0
         self._num_mrows = 0
@@ -60,6 +66,11 @@ class MegaKernelBuilder:
         # reserved slot, and the tile id the pending prefetch warmed.
         self._pf_res: TensorHandle | None = None
         self._pending_pf: int | None = None
+        # Matrix-chunk warm hand-off (PREFETCH_MAT, round 9): the pseudo
+        # resource serializing the reserved vbm slot, and (task id, wsm
+        # base) of the outstanding warm awaiting its consuming GEMM_MAT.
+        self._pfm_res: TensorHandle | None = None
+        self._pending_pf_mat: tuple[int, int] | None = None
 
     # -- tensors ------------------------------------------------------------
     def tensor(self, rows: int, cols: int, fp8: bool = False) -> TensorHandle:
@@ -236,11 +247,37 @@ class MegaKernelBuilder:
                 first = False
                 j += wd
 
+    def prefetch_mat(self, w: MatHandle) -> int:
+        """Start warming ``w``'s FIRST weight chunk into the reserved
+        matrix slot (round 9: the stall-slice kill). The next
+        ``gemm_mat(..., w, prefetch_first=True)`` consumes it — its
+        chunk-0 DMA has been in flight since THIS task dispatched, so it
+        streams under whatever tasks the scheduler places in between
+        (attention at n=1; the ALLREDUCE_ROW barrier at n>1). One
+        outstanding matrix warm at a time; the spec index the kernel
+        branch needs is patched in when the consuming gemm_mat is
+        emitted. Returns the task id."""
+        if self._pending_pf_mat is not None:
+            raise ValueError(
+                f"matrix prefetch of wsm base {self._pending_pf_mat[1]} "
+                "not yet consumed — one reserved slot, one outstanding "
+                "warm (emit the matching gemm_mat(prefetch_first=True))")
+        if not isinstance(w, MatHandle):
+            raise TypeError("prefetch_mat warms matrix-workspace weights "
+                            "(tensor_mat handles)")
+        if self._pfm_res is None:
+            self._pfm_res = self.tensor(TILE, TILE)   # hazard token only
+        tid = self._emit(Task(TaskType.PREFETCH_MAT, out=0, a0=w.base),
+                         [self._WM_HAZARD + w.base],
+                         [self._pfm_res.tile(0, 0)])
+        self._pending_pf_mat = (tid, w.base)
+        return tid
+
     def gemm_mat(self, out: TensorHandle, a: TensorHandle, w: MatHandle,
                  residual: TensorHandle | None = None,
                  norm_w: TensorHandle | None = None,
                  norm_out: TensorHandle | None = None,
-                 eps: float = 1e-6):
+                 eps: float = 1e-6, prefetch_first: bool = False):
         """out (TILE, N) = a (TILE, K) @ w — ONE task over the 2D matrix
         workspace, compiled as a STATIC specialized branch (see tasks.py
         GEMM_MAT). ``w.pair``: w holds interleaved gate|up halves and the
@@ -286,10 +323,17 @@ class MegaKernelBuilder:
             if norm_w.rt != 1 or norm_w.ct != out.ct:
                 raise ValueError("norm_w must be the broadcast (TILE, N) "
                                  "norm-weight tensor matching out's width")
+        if prefetch_first and (self._pending_pf_mat is None
+                               or self._pending_pf_mat[1] != w.base):
+            raise ValueError(
+                f"prefetch_first: pending matrix warm "
+                f"{self._pending_pf_mat} does not match this gemm_mat's "
+                f"weight base {w.base}")
         epi = 1 if w.pair else (3 if norm_w is not None
                                 else 2 if residual is not None else 0)
         spec = MatSpec(kt=a.ct, ns=w.n_strips, nt_out=out.ct,
-                       kch=mat_chunk_rows(w.k), epi=epi)
+                       kch=mat_chunk_rows(w.k), epi=epi,
+                       warm=1 if prefetch_first else 0)
         try:
             si = self._mat_specs.index(spec)
         except ValueError:
@@ -297,6 +341,16 @@ class MegaKernelBuilder:
             self._mat_specs.append(spec)
         reads = [a.tile(0, q) for q in range(a.ct)]
         reads.append(self._WM_HAZARD + w.base)
+        if prefetch_first:
+            # The warm task was emitted before its spec existed: patch its
+            # spec-index word now (the kernel's PREFETCH_MAT branch needs
+            # the static kch), and order this task after it through the
+            # reserved-slot pseudo resource.
+            pf_tid, _ = self._pending_pf_mat
+            self._tasks[pf_tid] = dataclasses.replace(
+                self._tasks[pf_tid], a_stride=si)
+            reads.append(self._pfm_res.tile(0, 0))
+            self._pending_pf_mat = None
         if residual is not None:
             reads += [residual.tile(0, q) for q in range(out.ct)]
         writes = [out.tile(0, j) for j in range(out.ct)]
@@ -359,7 +413,7 @@ class MegaKernelBuilder:
                 raise ValueError("k_new/v_new must be single head tiles")
         ti, col = pos // TILE, pos % TILE
         kt_tile, v_tile = kT.tile(0, ti), v.tile(ti, 0)
-        self._emit(
+        return self._emit(
             Task(TaskType.APPEND_KV, kt_tile, a0=k_new.tile(0, 0),
                  b0=v_tile, a_stride=kT.tile(0, 0), b_stride=v.tile(0, 0),
                  c0=col, d0=v_new.tile(0, 0)),
@@ -609,6 +663,7 @@ class MegaKernelBuilder:
                  c0=c0, d0=d0),
             reads, [out.tile(0, 0)])
         self._task_tables[tid] = flat
+        return tid
 
     def moe_topk(self, out_wt: TensorHandle, logits: TensorHandle,
                  topk: int, num_experts: int, batch: int):
@@ -689,12 +744,30 @@ class MegaKernelBuilder:
     # -- compile / run -------------------------------------------------------
     def compile(self, num_ranks: int = 1, axis: str = "tp",
                 dtype=jnp.float32,
-                force_ar: bool = False) -> "CompiledMegaKernel":
+                force_ar: bool = False,
+                head_dim: int | None = None) -> "CompiledMegaKernel":
+        # head_dim defaults to the BUILDER's value (set by the assembly);
+        # an explicit argument must agree — the three head_dim knobs
+        # (build, feed, compile) must never silently diverge.
+        if head_dim is None:
+            head_dim = self.head_dim
+        elif head_dim != self.head_dim:
+            raise ValueError(
+                f"compile(head_dim={head_dim}) mismatches the program's "
+                f"build-time head_dim {self.head_dim} — the norm/rope "
+                "sub-tile span is part of the assembly, not a free "
+                "compile knob")
         if self._pending_pf is not None:
             raise ValueError(
                 f"prefetch of tile {self._pending_pf[0]} never consumed — "
                 "the kernel would exit with an outstanding DMA on the "
                 "reserved slot (emit the matching gemm(prefetch_first=True))")
+        if self._pending_pf_mat is not None:
+            raise ValueError(
+                f"matrix prefetch of wsm base {self._pending_pf_mat[1]} "
+                "never consumed — the kernel would exit with an "
+                "outstanding DMA on the reserved matrix slot (emit the "
+                "matching gemm_mat(prefetch_first=True))")
         retired = {TaskType.GEMM, TaskType.ROPE}
         for t in self._tasks:
             if t.type in retired:
@@ -707,6 +780,11 @@ class MegaKernelBuilder:
                     "GEMM_WIDE, ROPE -> NORM_ROPE); the kernel would "
                     "no-op it silently")
         order = topo_schedule(len(self._tasks), self._edges)
+        # Emission-order task id -> queue row (paged-serving hosts retarget
+        # per-slot attention/append rows without re-deriving the schedule).
+        task_rows = [0] * len(order)
+        for pos, t in enumerate(order):
+            task_rows[t] = pos
         if num_ranks > 1:
             # Cross-device tasks must execute in the same relative order on
             # every rank (they match by queue position); the deterministic
@@ -750,7 +828,9 @@ class MegaKernelBuilder:
                                   mat_specs=tuple(self._mat_specs),
                                   max_ar=getattr(self, "_max_ar", 1),
                                   force_ar=force_ar,
-                                  used_types=used_types)
+                                  used_types=used_types,
+                                  head_dim=int(head_dim),
+                                  task_rows=tuple(task_rows))
 
 
 @dataclasses.dataclass
@@ -777,6 +857,10 @@ class CompiledMegaKernel:
     used_types: tuple | None = None  # task types in the queue (switch
     #                                  branches for the rest compile as
     #                                  no-ops; None = keep every branch)
+    head_dim: int = TILE          # NORM_ROPE(_QKV) sub-tile span (< TILE:
+    #                               heads zero-padded into their tiles)
+    task_rows: tuple | None = None  # emission task id -> queue row (the
+    #                                 paged-serving host retarget map)
 
     def scatter_input(self, ws: jax.Array, h: TensorHandle,
                       value: jax.Array) -> jax.Array:
@@ -939,7 +1023,8 @@ class CompiledMegaKernel:
                          max_strip=self.max_strip,
                          workspace_m=wsm, mat_specs=self.mat_specs,
                          max_ar=self.max_ar, force_ar=self.force_ar,
-                         used_types=self.used_types, profile=profile)
+                         used_types=self.used_types,
+                         head_dim=self.head_dim, profile=profile)
 
     def run(self, inputs: dict, outputs: list[TensorHandle],
             _device_local: bool = True):

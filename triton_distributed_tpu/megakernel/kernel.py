@@ -28,7 +28,9 @@ from jax.experimental.pallas import tpu as pltpu
 from triton_distributed_tpu import language as dl
 from triton_distributed_tpu.language import shmem_device as shmem
 from triton_distributed_tpu.language.core import any_spec
-from triton_distributed_tpu.megakernel.tasks import MAT_COLS, TILE, WORDS
+from triton_distributed_tpu.megakernel.tasks import (
+    MAT_COLS, TILE, WORDS, TaskType,
+)
 
 PIPE_DEPTH = 4  # outstanding tile-pair loads per task stream
 from triton_distributed_tpu.runtime.context import use_interpret
@@ -37,6 +39,7 @@ from triton_distributed_tpu.runtime.context import use_interpret
 def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
                  max_gemm_width: int, mat_specs: tuple, kch_max: int,
                  max_ar: int, force_ar: bool, used_types: tuple | None,
+                 head_dim: int,
                  queue_ref, ws_in, ws8, wm, ws_out, slots, va2, vb2, vb8,
                  vbw, vbw8, vacc, vq, vstat, vqg, vaccg, vstatg, vaccw,
                  vaccw_wdt, vrow_a, vrow_b, vrow_o, vmoe_a, vmoe_b,
@@ -381,23 +384,45 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
         pltpu.make_async_copy(ws8.at[a0], vb8.at[PIPE_DEPTH],
                               pipe_sems.at[2 * PIPE_DEPTH]).start()
 
+    def _norm_rope_rows(af, w_row, cosf, sinf, eps):
+        """Shared qk-norm + RoPE math over one (TILE, TILE) head tile.
+        ``head_dim`` is a STATIC program constant: at head_dim == TILE the
+        head fills the tile; at head_dim < TILE the head lives in the low
+        ``head_dim`` columns (zero-padded — the projection weights are
+        zero there, models.py feed padding), so the norm reduces over
+        head_dim and the rotation stays inside the sub-tile (round 9:
+        the Qwen3-0.6B/1.7B head_dim-64 presets)."""
+        hd = head_dim
+        if hd == TILE:
+            scale_r = jax.lax.rsqrt(
+                jnp.mean(af * af, axis=1, keepdims=True) + eps)
+            xn = af * scale_r * w_row
+            half = TILE // 2
+            rot = jnp.concatenate([-xn[:, half:], xn[:, :half]], axis=1)
+        else:
+            # Padding is zero, so the all-column sum IS the head_dim sum.
+            scale_r = jax.lax.rsqrt(
+                jnp.sum(af * af, axis=1, keepdims=True) / hd + eps)
+            xn = af * scale_r * w_row
+            half = hd // 2
+            rot = jnp.concatenate(
+                [-xn[:, half:hd], xn[:, :half], xn[:, hd:]], axis=1)
+        return xn * cosf + rot * sinf
+
     def t_norm_rope():
         # Fused per-head qk-norm + RoPE: one load of the head tile instead
         # of the rms_norm task's two streamed passes plus a separate rope
-        # task (head_dim == TILE — the norm reduces over this tile alone).
+        # task (the norm reduces over this tile's head_dim columns).
         load(a0, va)           # head tile (B, d)
         load(b0, vb)           # norm weight (broadcast rows)
         af = va[...].astype(jnp.float32)
         eps = arg.astype(jnp.float32) * 1e-9
-        scale_r = jax.lax.rsqrt(
-            jnp.mean(af * af, axis=1, keepdims=True) + eps)
-        xn = af * scale_r * vb[...].astype(jnp.float32)
+        w_row = vb[...].astype(jnp.float32)
         load(c0, vb)           # cos
         load(d0, vq)           # sin
-        half = TILE // 2
-        rot = jnp.concatenate([-xn[:, half:], xn[:, :half]], axis=1)
-        va[...] = (xn * vb[...].astype(jnp.float32)
-                   + rot * vq[...].astype(jnp.float32)).astype(wdt)
+        va[...] = _norm_rope_rows(af, w_row, vb[...].astype(jnp.float32),
+                                  vq[...].astype(jnp.float32), eps
+                                  ).astype(wdt)
         store(va, out)
 
     def t_append_kv():
@@ -583,17 +608,12 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
         sinf = vb2[1].astype(jnp.float32)
         qwf = va2[1].astype(jnp.float32)
         kwf = va2[2].astype(jnp.float32)
-        half = TILE // 2
 
         def hbody(h, _):
             load(a0 + h, vq)
             af = vq[...].astype(jnp.float32)
             w_n = jnp.where(h < hq, qwf, kwf)
-            scale_r = jax.lax.rsqrt(
-                jnp.mean(af * af, axis=1, keepdims=True) + eps)
-            xn = af * scale_r * w_n
-            rot = jnp.concatenate([-xn[:, half:], xn[:, :half]], axis=1)
-            va[...] = (xn * cosf + rot * sinf).astype(wdt)
+            va[...] = _norm_rope_rows(af, w_n, cosf, sinf, eps).astype(wdt)
             store(va, a0 + h)
             return 0
 
@@ -974,12 +994,27 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
                     voutm.at[:, pl.ds(w_ * TILE, TILE)],
                     ws_out.at[out + s * spt + w_], copy_sem)
 
+            def wdesc():
+                # The warm descriptor a PREFETCH_MAT task started earlier
+                # (same words: its a0 == this task's b0): chunk 0 into
+                # the reserved matrix slot on the warm semaphore.
+                dst = (vbm.at[2] if sp.kch == kch_max
+                       else vbm.at[2].at[pl.ds(0, sp.kch)])
+                row = (b0 // 8) * 8
+                return pltpu.make_async_copy(
+                    wm.at[pl.ds(row, sp.kch)], dst,
+                    pipe_sems.at[2 * PIPE_DEPTH + 1])
+
             # Layer-seam prefetch (round 6): the first weight chunks start
             # streaming BEFORE the A row loads — the A row of a seam task
             # is the previous task's freshly stored output, but the weight
             # chunks are static inputs, so their DMA hides under the A-row
-            # landing instead of serializing after it.
-            cdesc(0, 0).start()
+            # landing instead of serializing after it. A warm spec (round
+            # 9) goes further: chunk 0 has been streaming into the
+            # reserved slot since the PREFETCH_MAT task fired — under
+            # whatever tasks the scheduler placed in between.
+            if not sp.warm:
+                cdesc(0, 0).start()
             if total > 1:
                 cdesc(1, 1).start()
             _row_load(a0, vrow_a, sp.kt)
@@ -987,9 +1022,12 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
                 vacc[...] = jnp.zeros_like(vacc)
             for t in range(total):
                 s, j = divmod(t, n_ch)
-                slot = t % 2
+                slot = 2 if (sp.warm and t == 0) else t % 2
                 rw = min(spt, sp.nt_out - s * spt)
-                cdesc(t, slot).wait()
+                if sp.warm and t == 0:
+                    wdesc().wait()
+                else:
+                    cdesc(t, slot).wait()
                 if sp.epi in (2, 3) and j == 0:
                     # residual strip tiles arrive under the dots
                     for w_ in range(rw):
@@ -1011,7 +1049,7 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
                     else:
                         vaccm[...] = vaccm[...] + d_
                 if t + 2 < total:
-                    cdesc(t + 2, slot).start()
+                    cdesc(t + 2, (t + 2) % 2).start()
                 if j == n_ch - 1:
                     if sp.epi == 1:
                         half = MAT_COLS // 2
@@ -1076,13 +1114,39 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
         else:
             jax.lax.switch(a_stride, bodies)
 
+    def t_prefetch_mat():
+        # Fire-and-forget warm of a GEMM_MAT weight's FIRST chunk into the
+        # reserved matrix slot (round 9 stall-slice kill): the DMA flies
+        # under whatever tasks the scheduler placed between this and the
+        # consuming warm-spec GEMM_MAT — attention at n=1, the
+        # ALLREDUCE_ROW barrier at n>1. Words: a0 = wsm row base,
+        # a_stride = the consuming task's spec index (static kch).
+        if not mat_specs:
+            return
+
+        def warm_start(sp):
+            def body():
+                dst = (vbm.at[2] if sp.kch == kch_max
+                       else vbm.at[2].at[pl.ds(0, sp.kch)])
+                row = (a0 // 8) * 8
+                pltpu.make_async_copy(
+                    wm.at[pl.ds(row, sp.kch)], dst,
+                    pipe_sems.at[2 * PIPE_DEPTH + 1]).start()
+            return body
+
+        bodies = [warm_start(sp) for sp in mat_specs]
+        if len(bodies) == 1:
+            bodies[0]()
+        else:
+            jax.lax.switch(a_stride, bodies)
+
     bodies = [t_copy, t_add, t_silu_mul, t_retired, t_allreduce,
               t_scale, t_rms_norm, t_retired, t_attn_decode,
               t_attn_decode_paged, t_prefetch,
               t_attn_decode_gqa, t_gemm_wide, t_norm_rope,
               t_append_kv, t_gemm_wide_w8, t_prefetch_w8,
               t_moe_topk, t_moe_ffn, t_gemm_mat, t_add_norm,
-              t_norm_rope_qkv, t_allreduce_row]
+              t_norm_rope_qkv, t_allreduce_row, t_prefetch_mat]
     if used_types is not None:
         # Branch pruning (round 6): a compiled program's task-type set is
         # static — every absent type's handler compiles as the no-op, so
@@ -1122,6 +1186,7 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
               workspace_m=None, mat_specs: tuple = (),
               max_ar: int = 1, force_ar: bool = False,
               used_types: tuple | None = None,
+              head_dim: int = TILE,
               profile: bool = False):
     """Execute the packed task queue over the workspace in ONE pallas_call.
 
@@ -1149,6 +1214,11 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
     to the handlers a program actually uses. ``None`` (raw callers)
     keeps the full handler library. Rows naming a pruned type silently
     no-op, like the retired slots — pass the set your queue uses.
+    ``head_dim``: static per-head width of the NORM_ROPE / NORM_ROPE_QKV
+    tasks (the norm reduction span and RoPE rotation half). head_dim <
+    TILE heads live zero-padded in the low columns of their tile
+    (models.py pads the projection weights), so attention needs no
+    change — only the norm/rope sub-tile math does (round 9).
     ``profile``: add an int32 (n_tasks, 128) profile OUTPUT — each grid
     step stamps [exec_index, *queue_row] into its row (the observability
     per-task dispatch record, obs/kernel_profile.py); the return becomes
@@ -1188,6 +1258,14 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
     m_kch = kch_max if not mat_absent else 8
     m_rows = TILE if not mat_absent else 8
     m_cols = MAT_COLS if not mat_absent else 128
+    # The reserved warm slot (vbm[2]) is referenced only by warm-spec
+    # GEMM_MAT branches and a dispatchable PREFETCH_MAT handler; programs
+    # with neither keep the two-slot footprint.
+    warm_possible = (not mat_absent
+                     and (any(sp.warm for sp in mat_specs)
+                          or used_types is None
+                          or int(TaskType.PREFETCH_MAT) in used_types))
+    m_slots = 3 if warm_possible else 2
     if workspace_m is None:
         workspace_m = jnp.zeros((1, MAT_COLS), wdt)
     w8_absent = workspace8 is None
@@ -1238,11 +1316,18 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
             pltpu.VMEM((MF, TILE, TILE), jnp.float32),  # vmoe_a (gate/act)
             pltpu.VMEM((MF, TILE, TILE), jnp.float32),  # vmoe_b (up)
             pltpu.VMEM((MH, TILE, TILE), jnp.float32),  # vmoe_o (out acc)
-            pltpu.VMEM((2, m_kch, m_cols), wdt),        # vbm (mat chunks)
+            # vbm: two pipelined chunk slots, plus the reserved WARM slot
+            # PREFETCH_MAT streams into (round 9 cross-task overlap) —
+            # only when the program can dispatch a warm (no-warm programs
+            # keep the 2-slot footprint; a full chunk slot is up to
+            # kch_max * MAT_COLS elements of VMEM).
+            pltpu.VMEM((m_slots, m_kch, m_cols), wdt),  # vbm (mat chunks)
             pltpu.VMEM((m_rows, m_cols), jnp.float32),  # vaccm (mat accum)
             pltpu.VMEM((m_rows, m_cols), wdt),          # voutm (mat stores)
             pltpu.SemaphoreType.DMA(()),               # copy_sem
-            pltpu.SemaphoreType.DMA((2 * PIPE_DEPTH + 1,)),  # pipe (+pf sem)
+            # pipe sems: 2 per pipeline slot, +1 tile-prefetch sem, +1
+            # matrix-warm sem (PREFETCH_MAT / warm GEMM_MAT specs).
+            pltpu.SemaphoreType.DMA((2 * PIPE_DEPTH + 2,)),  # pipe (+pf sems)
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
             pltpu.SemaphoreType.DMA(()),
         ],
@@ -1251,7 +1336,8 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
                                tuple(mat_specs), kch_max, AR,
                                bool(force_ar),
                                None if used_types is None
-                               else tuple(sorted(set(used_types))))
+                               else tuple(sorted(set(used_types))),
+                               int(head_dim))
     if profile:
         base_kernel = kernel
 

@@ -25,6 +25,7 @@ reductions — token-identical to the jitted ar backend at TP=1 and TP=8
 
 from __future__ import annotations
 
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -34,16 +35,17 @@ from triton_distributed_tpu.layers.common import rms_norm
 from triton_distributed_tpu.obs import trace as obs_trace
 from triton_distributed_tpu.megakernel.models import (
     DecodeStepProgram, advance_queue_pos, broadcast_rows, build_decode_step,
-    feed_layer_weights, rope_tables,
+    feed_layer_weights, pad_head_vec, rope_tables,
 )
-from triton_distributed_tpu.megakernel.tasks import MAT_COLS, TILE
+from triton_distributed_tpu.megakernel.tasks import MAT_COLS, TILE, WORDS
 from triton_distributed_tpu.models.config import ModelConfig
 
 
 def validate_megakernel_cfg(cfg: ModelConfig, max_seq: int) -> None:
-    if cfg.head_dim != TILE:
-        raise ValueError(f"megakernel needs head_dim == {TILE} "
-                         f"(got {cfg.head_dim})")
+    if cfg.head_dim not in (TILE // 2, TILE):
+        raise ValueError(
+            f"megakernel needs head_dim {TILE // 2} (padded-head layout) "
+            f"or {TILE} (got {cfg.head_dim})")
     if cfg.hidden_size % TILE or cfg.intermediate_size % TILE:
         raise ValueError("hidden/intermediate sizes must be TILE multiples")
     if max_seq % TILE:
@@ -81,8 +83,8 @@ def weight_feeds(prog: DecodeStepProgram, cfg: ModelConfig,
               else np.ones(cfg.head_dim, np.float32))
         kn = (np.asarray(attn["k_norm"], np.float32) if cfg.qk_norm
               else np.ones(cfg.head_dim, np.float32))
-        feeds[h.q_norm] = broadcast_rows(qn)
-        feeds[h.k_norm] = broadcast_rows(kn)
+        feeds[h.q_norm] = broadcast_rows(pad_head_vec(qn, d))
+        feeds[h.k_norm] = broadcast_rows(pad_head_vec(kn, d))
         mlp = layer["mlp"]
         feed_layer_weights(
             feeds, h,
@@ -92,22 +94,30 @@ def weight_feeds(prog: DecodeStepProgram, cfg: ModelConfig,
             wo=rows(attn["wo"], hq_l * d),
             w_gate=cols(mlp["w_gate"], ffn_l),
             w_up=cols(mlp["w_up"], ffn_l),
-            w_down=rows(mlp["w_down"], ffn_l))
+            w_down=rows(mlp["w_down"], ffn_l),
+            head_dim=d)
     return feeds
 
 
 def cache_feeds(prog: DecodeStepProgram, cache, *, rank: int = 0,
                 num_ranks: int = 1) -> dict:
     """KV cache (models/kv_cache.KVCache, batch 1) → ``rank``'s per-head
-    kT/v feeds (kv heads are TP-sharded)."""
+    kT/v feeds (kv heads are TP-sharded; head_dim < TILE pads into the
+    tile rows/cols — the padded-head layout)."""
     feeds: dict = {}
-    k, v = cache.k, cache.v    # (L, 1, S, hkv_global, d)
+    k, v = cache.k, cache.v    # (L, 1, S, hkv_global, hd)
+    hd = k.shape[-1]
     hkv_l = k.shape[3] // num_ranks
     for li, h in enumerate(prog.layers):
         for kv in range(len(h.kT)):
             g_kv = rank * hkv_l + kv
-            feeds[h.kT[kv]] = k[li, 0, :, g_kv, :].T      # (d, S)
-            feeds[h.v[kv]] = v[li, 0, :, g_kv, :]         # (S, d)
+            kT = k[li, 0, :, g_kv, :].T                   # (hd, S)
+            vv = v[li, 0, :, g_kv, :]                     # (S, hd)
+            if hd < TILE:
+                kT = jnp.pad(kT, ((0, TILE - hd), (0, 0)))
+                vv = jnp.pad(vv, ((0, 0), (0, TILE - hd)))
+            feeds[h.kT[kv]] = kT
+            feeds[h.v[kv]] = vv
     return feeds
 
 
@@ -182,9 +192,11 @@ class MegakernelDecoder:
             num_layers=cfg.num_layers, max_seq=max_seq,
             pos=max_seq - 1, num_ranks=n, eps=cfg.rms_norm_eps,
             inkernel_append=True, fp8_weights=fp8_weights,
-            final_norm=final_norm)
+            final_norm=final_norm, head_dim=cfg.head_dim,
+            mat_prefetch=not fp8_weights)
         self.comp = self.prog.mb.compile(num_ranks=n, axis=axis,
-                                         dtype=dtype)
+                                         dtype=dtype,
+                                         head_dim=cfg.head_dim)
         # Weight feeds computed ONCE (per rank) — start() merges only the
         # cache feeds, so repeated serve() calls never re-slice the model.
         self._weight_feeds = [
@@ -358,7 +370,7 @@ class MegakernelDecoder:
                 "the adjacent workspace region")
         queue = advance_queue_pos(self.comp.queue, pos,
                                   num_exec=self.comp.num_exec)
-        cos, sin = rope_tables(pos, TILE, self.cfg.rope_theta)
+        cos, sin = rope_tables(pos, self.cfg.head_dim, self.cfg.rope_theta)
         ws8 = getattr(self, "_ws8", None)
         wsm = getattr(self, "_wsm", None)
         self.last_step_cold = not self.warm
@@ -372,4 +384,253 @@ class MegakernelDecoder:
         if self.profile:
             ws, tok, self.last_profile = out
             return ws, tok
+        return out
+
+
+class PagedMegakernelDecoder:
+    """Paged-workspace megakernel decode for the SERVING tier (round 9).
+
+    Every serving slot is one ROW BLOCK of the decode program (row 0 =
+    the slot's real token, the same padding discipline as the batch-1
+    decoder), with its OWN page table over shared per-(layer, kv-head)
+    KV pools. Pool pages line up ONE-TO-ONE with a
+    ``models/kv_cache.PagedModelCache`` pool of ``page_size == TILE``:
+    pool page ``p`` of the serving cache IS pool tile ``p`` of every
+    megakernel pool, so the PR-7 ``PageAllocator``'s page ids drive the
+    kernel's tables directly — admission, preemption and resume reuse
+    the serving scheduler unchanged. The LAST pool tile is the reserved
+    scratch page idle slots ride at ``kv_lens`` 0 (account it under the
+    allocator's ``reserved=`` — serving/loop.py does).
+
+    Per step the host rewrites QUEUE WORDS only — per-slot valid
+    lengths, visited-tile counts, APPEND_KV targets, and the page-table
+    DATA rows — then replays the ONE compiled kernel (the
+    tables-are-data contract of the reference's PagedKVCache megakernel
+    assembly; no recompile ever). KV appends run IN-KERNEL into the
+    pools, so the workspace is the decode-time source of truth;
+    ``load_prefill`` scatters a finished chunked prefill's pages in
+    (recompute-on-resume re-prefills, so preemption needs no copy-out).
+    """
+
+    def __init__(self, cfg: ModelConfig, params: dict, *, num_slots: int,
+                 num_pages: int, max_pages: int, dtype=jnp.float32,
+                 mat_prefetch: bool = True):
+        capacity = max_pages * TILE
+        validate_megakernel_cfg(cfg, capacity)
+        if num_slots < 1:
+            raise ValueError(f"num_slots = {num_slots} must be >= 1")
+        if num_pages < 1:
+            raise ValueError(f"num_pages = {num_pages} must be >= 1")
+        if max_pages < 1:
+            # A table longer than the pool is fine (unmapped entries ride
+            # the scratch page; the admission budget checks usable pages)
+            # — only an empty table is meaningless.
+            raise ValueError(f"max_pages = {max_pages} must be >= 1")
+        self.cfg = cfg
+        self.num_slots = num_slots
+        self.num_pages = num_pages          # usable pages (excl. scratch)
+        self.max_pages = max_pages
+        self.scratch = num_pages            # LAST pool tile, never owned
+        self.capacity = capacity
+        self.prog = build_decode_step(
+            hidden=cfg.hidden_size, hq_local=cfg.num_heads,
+            hkv_local=cfg.num_kv_heads, ffn_local=cfg.intermediate_size,
+            num_layers=cfg.num_layers, max_seq=capacity,
+            pos=capacity - 1, num_ranks=1, eps=cfg.rms_norm_eps,
+            paged=True, inkernel_append=True,
+            batch=num_slots * TILE, head_dim=cfg.head_dim,
+            mat_prefetch=mat_prefetch,
+            kv_pool_pages=num_pages + 1, table_pages=max_pages)
+        self.comp = self.prog.mb.compile(dtype=dtype,
+                                         head_dim=cfg.head_dim)
+        self._weight_feeds = weight_feeds(self.prog, cfg, params)
+        self.embed = jnp.asarray(params["embed"])
+        self.final_norm = jnp.asarray(params["final_norm"])
+        self.lm_head = (jnp.asarray(params["lm_head"])
+                        if params.get("lm_head") is not None else None)
+        # Host retarget map: emission task id -> compiled queue row, per
+        # slot — attention rows carry their table DATA start in word 3.
+        q0 = np.asarray(self.comp.queue)
+        rows = self.comp.task_rows
+        self._attn_rows: list[list[tuple[int, int, int, int]]] = []
+        self._append_rows: list[list[tuple[int, int, int]]] = []
+        for blk in self.prog.paged_meta["blocks"]:
+            self._attn_rows.append(
+                [(rows[tid], kt0, v0, int(q0[rows[tid], 3]))
+                 for tid, kt0, v0 in blk.get("attn", ())])
+            self._append_rows.append(
+                [(rows[tid], kt0, v0)
+                 for tid, kt0, v0 in blk.get("append", ())])
+        self._base_queue = q0
+        self._table_rows = -(-2 * max_pages // WORDS)
+        self._step_jit = jax.jit(self._step, donate_argnums=(0,))
+        self._load_jits: dict = {}      # page count -> jitted loader
+        # Rope tables depend only on the integer position: cache the
+        # COMPACT (TILE,) row per position (every row of the broadcast
+        # table is identical) — ~1 KB per visited position instead of
+        # 128 KB, so a long-lived server's cache stays bounded by
+        # capacity * 1 KB; broadcast views expand at concat time.
+        self._rope_cache: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+        self.warm = False
+        self.last_step_cold = True
+
+    # -- workspace ----------------------------------------------------------
+    def start(self) -> jax.Array:
+        """Weights loaded, pools zeroed. Returns the carried workspace
+        (donate it back through every step)."""
+        main, _w8, wm = self.comp.split_feeds(dict(self._weight_feeds))
+        self._wsm = (self.comp.make_workspace_mat(wm)
+                     if self.comp.num_mrows else None)
+        return self.comp.make_workspace(main)
+
+    def load_prefill(self, ws: jax.Array, k_lin, v_lin,
+                     pages: list[int]) -> jax.Array:
+        """Scatter a finished prefill's KV into the slot's pool pages.
+        ``k_lin``/``v_lin``: the linear prefill buffer (L, 1, S_buf,
+        hkv, head_dim); page ``pages[i]`` receives positions
+        [i*TILE, (i+1)*TILE). ONE jitted donated update per page count —
+        un-jitted per-tile scatters would each copy the whole (multi-GB
+        at the bench shapes) workspace."""
+        for p in pages:
+            if not 0 <= int(p) < self.num_pages:
+                raise ValueError(
+                    f"page id {p} outside the usable pool "
+                    f"[0, {self.num_pages}) — the scratch page is "
+                    "reserved")
+        fn = self._load_jits.get(len(pages))
+        if fn is None:
+            fn = jax.jit(functools.partial(self._load_pages, len(pages)),
+                         donate_argnums=(0,))
+            self._load_jits[len(pages)] = fn
+        return fn(ws, k_lin, v_lin, jnp.asarray(pages, jnp.int32))
+
+    def _load_pages(self, n_pages, ws, k_lin, v_lin, pages):
+        hd = self.cfg.head_dim
+        wdt = self.comp.dtype
+        for li, h in enumerate(self.prog.layers):
+            for kv in range(self.cfg.num_kv_heads):
+                kT0 = h.kT[kv].tile(0, 0)
+                v0 = h.v[kv].tile(0, 0)
+                for i in range(n_pages):
+                    p = pages[i]
+                    ksl = k_lin[li, 0, i * TILE:(i + 1) * TILE, kv, :]
+                    vsl = v_lin[li, 0, i * TILE:(i + 1) * TILE, kv, :]
+                    kT = ksl.astype(jnp.float32).T          # (hd, TILE)
+                    vv = vsl.astype(jnp.float32)            # (TILE, hd)
+                    if hd < TILE:
+                        kT = jnp.pad(kT, ((0, TILE - hd), (0, 0)))
+                        vv = jnp.pad(vv, ((0, 0), (0, TILE - hd)))
+                    ws = jax.lax.dynamic_update_slice(
+                        ws, kT.astype(wdt)[None], (kT0 + p, 0, 0))
+                    ws = jax.lax.dynamic_update_slice(
+                        ws, vv.astype(wdt)[None], (v0 + p, 0, 0))
+        return ws
+
+    # -- per-step host retarget ---------------------------------------------
+    def _retarget(self, kv_lens, tables) -> jax.Array:
+        """Rewrite the compiled queue for this step's slot states:
+        kv_lens (B,) ints; tables (B, <=max_pages) pool page ids per
+        slot (missing/negative entries ride the scratch page)."""
+        q = self._base_queue.copy()
+        for b in range(self.num_slots):
+            kvl = int(kv_lens[b])
+            if kvl >= self.capacity:
+                raise ValueError(
+                    f"slot {b} kv_len {kvl} at capacity {self.capacity}: "
+                    "the step appends this position — evict or stop the "
+                    "sequence (serving scheduler contract)")
+            pages = [int(p) for p in tables[b] if int(p) >= 0]
+            ktiles = -(-kvl // TILE)
+            if ktiles > len(pages):
+                raise ValueError(
+                    f"slot {b} kv_len {kvl} needs {ktiles} mapped pages "
+                    f"but the table holds {len(pages)} — the scheduler's "
+                    "page growth must run before decode")
+            flat: list[int] = []
+            for j in range(self.max_pages):
+                p = pages[j] if j < len(pages) else self.scratch
+                flat.append(p)
+            for row, kt0, v0, trow in self._attn_rows[b]:
+                q[row, 4] = ktiles
+                q[row, 6] = kvl
+                ent: list[int] = []
+                for p in flat:
+                    ent += [kt0 + p, v0 + p]
+                ent += [0] * (-len(ent) % WORDS)
+                q[trow:trow + self._table_rows] = np.asarray(
+                    ent, np.int32).reshape(-1, WORDS)
+            # Append target: the page holding position kv_len. An ACTIVE
+            # slot whose append page is unmapped must fail loudly — the
+            # write would silently land on the shared scratch page and
+            # the token's KV would be lost (the write-side twin of the
+            # read-coverage check above; idle slots park on scratch by
+            # design).
+            ti, col = kvl // TILE, kvl % TILE
+            if (kvl > 0 or pages) and ti >= len(pages):
+                raise ValueError(
+                    f"slot {b} appends at position {kvl} (page index "
+                    f"{ti}) but the table maps {len(pages)} page(s) — "
+                    "the scheduler's page growth must run before decode")
+            ap = flat[ti] if ti < len(flat) else self.scratch
+            for row, kt0, v0 in self._append_rows[b]:
+                q[row, 1] = kt0 + ap
+                q[row, 3] = v0 + ap
+                q[row, 8] = col
+        return jnp.asarray(q)
+
+    def _rope(self, pos: int) -> tuple[np.ndarray, np.ndarray]:
+        t = self._rope_cache.get(pos)
+        if t is None:
+            cos_t, sin_t = rope_tables(pos, self.cfg.head_dim,
+                                       self.cfg.rope_theta)
+            t = (cos_t[0].copy(), sin_t[0].copy())    # compact rows
+            self._rope_cache[pos] = t
+        return t
+
+    # -- one step over every slot --------------------------------------------
+    def _step(self, ws, embed, final_norm, lm_head, queue, cos, sin,
+              tokens):
+        # embed / final_norm / lm_head arrive as ARGUMENTS (the bench.py
+        # closed-over-constant hazard). Row b*TILE of block b carries the
+        # slot's real token; the other 127 rows are padding lanes whose
+        # outputs are discarded.
+        hidden = self.cfg.hidden_size
+        B = self.num_slots
+        rows = embed[tokens].astype(jnp.float32)            # (B, hidden)
+        x = jnp.zeros((B * TILE, hidden), jnp.float32
+                      ).at[jnp.arange(B) * TILE].set(rows)
+        ws = self.comp.scatter_input(ws, self.prog.x, x)
+        ws = self.comp.scatter_input(ws, self.prog.cos, cos)
+        ws = self.comp.scatter_input(ws, self.prog.sin, sin)
+        ws = self.comp.step(ws, queue, wsm=self._wsm)
+        outs = [self.comp.gather_output(ws, h)[0:1]
+                for h in self.prog.x_out_blocks]
+        x_out = jnp.concatenate(outs, axis=0)               # (B, hidden)
+        xn = rms_norm(x_out.astype(jnp.float32),
+                      final_norm.astype(jnp.float32),
+                      self.cfg.rms_norm_eps)
+        head = lm_head if lm_head is not None else embed.T
+        logits = xn @ head.astype(jnp.float32)
+        return ws, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def step(self, ws: jax.Array, tokens, kv_lens, tables):
+        """One decode step over every slot. tokens: (B,) int32 (idle
+        slots: any id — their lane is discarded); kv_lens: (B,) host
+        ints (0 = idle); tables: (B, <=max_pages) pool page ids.
+        Returns (workspace', next_tokens (B,))."""
+        queue = self._retarget(kv_lens, tables)
+        tabs = [self._rope(int(kv_lens[b]))
+                for b in range(self.num_slots)]
+        cos = np.concatenate(
+            [np.broadcast_to(t[0], (TILE, TILE)) for t in tabs], axis=0)
+        sin = np.concatenate(
+            [np.broadcast_to(t[1], (TILE, TILE)) for t in tabs], axis=0)
+        self.last_step_cold = not self.warm
+        with obs_trace.span("mk_paged_step", slots=self.num_slots):
+            out = self._step_jit(ws, self.embed, self.final_norm,
+                                 self.lm_head, queue, jnp.asarray(cos),
+                                 jnp.asarray(sin),
+                                 jnp.asarray(np.asarray(tokens),
+                                             jnp.int32))
+        self.warm = True
         return out

@@ -164,6 +164,18 @@ class TaskType(enum.IntEnum):
     #                 the round-6 cross-device queue compaction). Words:
     #                 out = row base tile, k_tiles = row tiles (<= the
     #                 program's max_ar slab width).
+    PREFETCH_MAT = 23  # Fire-and-forget warm of a GEMM_MAT weight's FIRST
+    #                 chunk into the reserved matrix slot (vbm[2]): the
+    #                 round-9 stall-slice kill — the consuming GEMM_MAT
+    #                 (a spec with warm=1) reads chunk 0 from the slot
+    #                 instead of serializing its first wsm DMA after the
+    #                 preceding task, so the chunk streams UNDER whatever
+    #                 long task the scheduler placed in between (attention
+    #                 at n=1; the ALLREDUCE_ROW barrier at n>1). Words:
+    #                 a0 = wsm row base of the matrix, a_stride = the
+    #                 consuming task's SPEC INDEX (static kch per branch).
+    #                 Reference: the weight-prefetch task of
+    #                 mega_triton_kernel (SURVEY.md §2.7).
     MOE_FFN = 18    # One task = one layer's ENTIRE expert MLP: loops the E
     #                 experts; an expert whose (E, B) weight column is all
     #                 zero is SKIPPED before any weight DMA issues — the
@@ -271,13 +283,17 @@ class MatSpec:
     cross-layer fusion: the o-proj/down-proj task also produces the NEXT
     norm's output — queue word b_stride = norm weight row base, d0 = xn
     output row base, arg = 3 | (eps_1e9 << 8)).
-    ``nt_out``: output width in TILE columns (for pair epi: of the act)."""
+    ``nt_out``: output width in TILE columns (for pair epi: of the act).
+    ``warm``: 1 = chunk 0 was warmed by a preceding PREFETCH_MAT into the
+    reserved matrix slot — the branch waits the warm semaphore instead of
+    issuing its own first chunk DMA (the round-9 cross-task overlap)."""
 
     kt: int          # A-row tiles (K / TILE)
     ns: int          # strips
     nt_out: int      # output tiles
     kch: int         # chunk rows
     epi: int         # epilogue kind
+    warm: int = 0    # 1 = consume a PREFETCH_MAT warm for chunk 0
 
     @property
     def n_ch(self) -> int:

@@ -785,8 +785,21 @@ class Engine:
         from triton_distributed_tpu.runtime.utils import group_profile
 
         if self.page_size is not None:
-            raise ValueError("megakernel backend uses its own workspace "
-                             "cache, not the paged cache")
+            # Sequential serve keeps the linear-workspace decoder; the
+            # PAGED megakernel lane lives in the serving tier
+            # (serving/loop.py + megakernel/serving.PagedMegakernelDecoder).
+            # Named + transient (round 9): the resilient serve wrapper
+            # demotes this engine down the ladder instead of dying.
+            from triton_distributed_tpu.resilience import (
+                BackendUnsupportedError,
+            )
+
+            raise BackendUnsupportedError(
+                "megakernel sequential serve uses its own linear "
+                "workspace cache, not the paged pool (page_size="
+                f"{self.page_size}) — demoting to the next backend rung; "
+                "use ServingEngine(backend='megakernel') for the paged "
+                "persistent-kernel lane")
         t_obs = obs_trace.get_tracer()
         # Under an active obs run on one rank, the decoder runs in profile
         # mode: every step dumps the kernel's per-task dispatch record and

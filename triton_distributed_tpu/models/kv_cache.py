@@ -161,9 +161,23 @@ class PageAllocator:
                                  num_pages=num_pages, batch=1)
         self.num_pages = num_pages
         self.max_pages = max_pages
+        self._reserved = tuple(sorted(set(reserved)))
         self._free = sorted(set(range(num_pages)) - set(reserved),
                             reverse=True)   # pop() yields lowest id
         self._owned: dict = {}
+
+    @property
+    def reserved(self) -> tuple[int, ...]:
+        return self._reserved
+
+    @property
+    def usable_pages(self) -> int:
+        """Pages a sequence can ever own: the pool minus the reserved
+        set (e.g. the megakernel workspace's scratch page, round 9) —
+        the number admission/budget math must check against, or a
+        request sized to ``num_pages`` could only ever cycle through
+        self-preemption."""
+        return self.num_pages - len(self._reserved)
 
     @classmethod
     def for_cache(cls, cache: PagedModelCache, *,

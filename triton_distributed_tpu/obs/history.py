@@ -100,6 +100,10 @@ METRICS: tuple[MetricSpec, ...] = (
     MetricSpec("serve_ttft_p99_ms",
                "serving TTFT p99 (8 streams, 128-token prompts)",
                " ms", "lower", "serving"),
+    MetricSpec("serve_tokens_per_s_megakernel",
+               "serving tokens/s (megakernel paged lane, same window as "
+               "the xla rung)",
+               " tok/s", "higher", "serving"),
 )
 
 METRIC_BY_KEY = {m.key: m for m in METRICS}

@@ -72,6 +72,8 @@ TASK_CLASS: dict[TaskType, str] = {
     TaskType.ADD_NORM: "norm",
     TaskType.NORM_ROPE_QKV: "norm",
     TaskType.ALLREDUCE_ROW: "allreduce",
+    # Round-9 stall-slice kill: cross-task GEMM_MAT chunk warm.
+    TaskType.PREFETCH_MAT: "prefetch",
 }
 
 # Fixed per-task dispatch/DMA-issue overhead the round-5 profile measured
@@ -206,7 +208,10 @@ def estimate_task_seconds(rec: TaskRecord, itemsize: int = 2,
         ft = max(w["arg"] >> 16, 1)
         nbytes = (kt * tile_b
                   + e_active * (2 * kt * ft + ft * kt) * tile_b)
-    elif t in (TaskType.PREFETCH, TaskType.PREFETCH_W8):
+    elif t in (TaskType.PREFETCH, TaskType.PREFETCH_W8,
+               TaskType.PREFETCH_MAT):
+        # Fire-and-forget DMA issue: the transfer itself rides under the
+        # tasks scheduled before the consumer (that's the point).
         return FIXED_TASK_OVERHEAD_S / 2
     elif t is TaskType.APPEND_KV:
         nbytes = 8 * tile_b

@@ -37,19 +37,33 @@ from triton_distributed_tpu.resilience.faults import (  # noqa: F401
 )
 
 __all__ = [
-    "CommTimeoutError", "FaultClass", "FaultInjectionError", "FaultPlan",
+    "BackendUnsupportedError", "CommTimeoutError", "FaultClass",
+    "FaultInjectionError", "FaultPlan",
     "drain_timeout_events", "is_transient", "wait_nap_s", "wait_timeout_s",
 ]
 
 
+class BackendUnsupportedError(RuntimeError):
+    """A requested backend cannot serve the current configuration — a
+    workspace/page-shape mismatch (megakernel paged lane needs page_size
+    == TILE), an unsupported model geometry, or a mesh the backend has
+    no layout for. NAMED and TRANSIENT by design (round 9): the PR-6
+    demotion ladder treats it as a demote-don't-die signal, so a
+    misconfigured pool falls through megakernel → overlap → xla with
+    token parity instead of killing ``serve()`` (the old anonymous
+    ``ValueError`` hard-reject bypassed the retry path entirely)."""
+
+
 def is_transient(exc: BaseException) -> bool:
     """True when ``exc`` is a failure class the Engine demotion ladder may
-    retry/degrade around: injected faults, comm deadline expiries, and
+    retry/degrade around: injected faults, comm deadline expiries,
+    backend-capability mismatches (:class:`BackendUnsupportedError`), and
     runtime/backend errors (a Mosaic compile failure, an interpreter DMA
     limit, an OOM). Programming errors (``ValueError``/``TypeError``/
     ``KeyError``) propagate — demoting around a bad argument would only
     mask the bug."""
-    if isinstance(exc, (FaultInjectionError, CommTimeoutError)):
+    if isinstance(exc, (FaultInjectionError, CommTimeoutError,
+                        BackendUnsupportedError)):
         return True
     # Errors from inside the traced/compiled step carry jax's trace-time
     # or runtime wrapper in their chain (XlaRuntimeError from jaxlib,

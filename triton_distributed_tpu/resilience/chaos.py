@@ -264,11 +264,128 @@ def deadline_selftest() -> list[CaseResult]:
 
 
 # ---------------------------------------------------------------------------
+# Megakernel serving-lane rows (round 9): fault -> demotion with parity.
+# ---------------------------------------------------------------------------
+
+def megakernel_serve_selftest() -> list[CaseResult]:
+    """Two rows per --all sweep: the megakernel serving lane must DEMOTE
+    (never die, never silently corrupt) under (a) a workspace/page-shape
+    mismatch at construction and (b) a transient fault injected into the
+    persistent decode step mid-serve — in both cases finishing every
+    request token-identical to a sequential xla serve (greedy parity is
+    the corruption oracle)."""
+    import jax
+    import numpy as np
+
+    from triton_distributed_tpu.models import Engine, init_dense_llm
+    from triton_distributed_tpu.models.config import ModelConfig
+    from triton_distributed_tpu.runtime import initialize_distributed
+    from triton_distributed_tpu.serving.loop import ServingEngine
+
+    cfg = ModelConfig(hidden_size=256, intermediate_size=256,
+                      num_layers=1, num_heads=2, num_kv_heads=1,
+                      head_dim=128, vocab_size=512, qk_norm=True,
+                      dtype="float32")
+    params = init_dense_llm(jax.random.PRNGKey(3), cfg)
+    ctx1 = initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                  devices=jax.devices()[:1])
+    prompts = [[5, 77, 131], [200, 9]]
+    oracle = Engine(cfg, params, ctx1, backend="xla", max_seq=256)
+    golden = {}
+    for i, p in enumerate(prompts):
+        import jax.numpy as jnp
+
+        golden[i] = np.asarray(
+            oracle.serve(jnp.asarray([p], jnp.int32), gen_len=3)
+        )[0].tolist()
+
+    def serve_all(se):
+        reqs = []
+        for i, p in enumerate(prompts):
+            req, res = se.submit(p, 3, req_id=f"chaos-mk-{i}")
+            assert res.name == "ADMITTED", res
+            reqs.append(req)
+        se.run()
+        return reqs
+
+    cases = []
+
+    # Row 1: page-shape mismatch (page_size != TILE) — construction must
+    # demote through the ladder, and the demoted tier still serves with
+    # parity.
+    t0 = time.time()
+    diags: list[str] = []
+    try:
+        eng = Engine(cfg, params, ctx1, backend="megakernel",
+                     max_seq=256, page_size=64)
+        se = ServingEngine(eng, max_batch=2, num_pages=8,
+                           prefill_chunk=64)
+        demoted = eng.backend != "megakernel" and se._mk is None
+        reqs = serve_all(se)
+        parity = all(r.tokens == golden[i] for i, r in enumerate(reqs))
+        diags += [f"backend after construction: {eng.backend}",
+                  f"parity vs sequential xla serve: {parity}"]
+        verdict = "detected" if demoted and parity else "error"
+    except Exception as exc:                        # died = the failure
+        verdict = "error"
+        diags.append(f"{type(exc).__name__}: {exc}")
+    cases.append(CaseResult(
+        op="megakernel_serve", mesh="1", fault="page_shape_mismatch",
+        verdict=verdict, detected_by="demotion",
+        expected=("detected",), ok=verdict == "detected", n_fired=1,
+        n_violations=0, diagnostics=diags,
+        elapsed_s=round(time.time() - t0, 3)))
+
+    # Row 2: transient fault inside the persistent decode step — the
+    # serving loop must demote mid-run, recompute the in-flight batch on
+    # the dense path, and still finish with parity.
+    t0 = time.time()
+    diags = []
+    try:
+        eng = Engine(cfg, params, ctx1, backend="megakernel",
+                     max_seq=256, page_size=128)
+        se = ServingEngine(eng, max_batch=2, num_pages=4,
+                           prefill_chunk=128)
+        assert se._mk is not None, "lane not active before injection"
+        real_step = se._mk.step
+        fired = {"n": 0}
+
+        def faulty_step(*a, **kw):
+            if fired["n"] == 0:
+                fired["n"] += 1
+                raise FaultInjectionError(
+                    "chaos: injected megakernel step fault "
+                    "(kernel=mk_paged_step occurrence=0)")
+            return real_step(*a, **kw)
+
+        se._mk.step = faulty_step
+        reqs = serve_all(se)
+        parity = all(r.tokens == golden[i] for i, r in enumerate(reqs))
+        demoted = eng.backend != "megakernel" and se._mk is None
+        diags += [f"fault fired: {fired['n']}",
+                  f"backend after serve: {eng.backend}",
+                  f"parity vs sequential xla serve: {parity}"]
+        verdict = ("detected" if fired["n"] and demoted and parity
+                   else "error")
+    except Exception as exc:
+        verdict = "error"
+        diags.append(f"{type(exc).__name__}: {exc}")
+    cases.append(CaseResult(
+        op="megakernel_serve", mesh="1", fault="step_transient_fault",
+        verdict=verdict, detected_by="demotion",
+        expected=("detected",), ok=verdict == "detected", n_fired=1,
+        n_violations=0, diagnostics=diags,
+        elapsed_s=round(time.time() - t0, 3)))
+    return cases
+
+
+# ---------------------------------------------------------------------------
 # Sweep + CLI.
 # ---------------------------------------------------------------------------
 
 def sweep(ops, faults, ranks, *, seed: int = 0,
-          verbose: bool = False) -> tuple[list[CaseResult], int]:
+          verbose: bool = False,
+          serve_rows: bool = False) -> tuple[list[CaseResult], int]:
     from triton_distributed_tpu.analysis.registry import build_registry
 
     registry = build_registry(ranks)
@@ -303,6 +420,14 @@ def sweep(ops, faults, ranks, *, seed: int = 0,
         cases.append(case)
         failed += not case.ok
         _print_case(case, verbose)
+    if serve_rows:
+        # Megakernel serving-lane rows (round 9): fault -> demotion with
+        # parity through the PR-6 ladder. --all sweeps only (two real
+        # serving runs each — too heavy for single-op invocations).
+        for case in megakernel_serve_selftest():
+            cases.append(case)
+            failed += not case.ok
+            _print_case(case, verbose)
     return cases, failed
 
 
@@ -379,7 +504,7 @@ def main(argv: list[str] | None = None) -> int:
     ranks = tuple(int(r) for r in args.ranks.split(",") if r)
 
     cases, failed = sweep(ops, faults, ranks, seed=args.seed,
-                          verbose=args.verbose)
+                          verbose=args.verbose, serve_rows=args.all)
 
     if args.json_path:
         with open(args.json_path, "w") as f:

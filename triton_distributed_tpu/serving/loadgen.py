@@ -260,6 +260,70 @@ def dryrun(json_path: str | None) -> int:
                                "final_cap": se3.sched.admit_cap,
                                "shrunk": slo_shrunk}
 
+    # Phase 4 (round 9) — megakernel serving lane: the same parity
+    # contract on the PAGED persistent kernel (page_size == TILE): every
+    # request token-identical to sequential Engine.serve, including one
+    # preempted under page pressure and resumed (recompute) ON the paged
+    # workspace, with the lane still active at the end (no silent
+    # demotion).
+    import numpy as _np
+
+    from triton_distributed_tpu.models import Engine, init_dense_llm
+    from triton_distributed_tpu.models.config import ModelConfig
+    from triton_distributed_tpu.runtime import initialize_distributed
+
+    mk_cfg = ModelConfig(hidden_size=256, intermediate_size=256,
+                         num_layers=2, num_heads=2, num_kv_heads=1,
+                         head_dim=128, vocab_size=512, qk_norm=True,
+                         dtype="float32")
+    mk_params = init_dense_llm(jax.random.PRNGKey(1), mk_cfg)
+    ctx1 = initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                  devices=jax.devices()[:1])
+    rng = _np.random.default_rng(9)
+    # r0 crosses the 128-position page boundary mid-decode; with a
+    # 2-page pool + both slots occupied, r1 (lower priority) is evicted
+    # and recomputes on resume.
+    mk_trace = [
+        {"req_id": "mk-0", "arrival_iter": 0,
+         "prompt": rng.integers(0, 512, 126).tolist(),
+         "max_new_tokens": 6, "priority": 1},
+        {"req_id": "mk-1", "arrival_iter": 0,
+         "prompt": rng.integers(0, 512, 100).tolist(),
+         "max_new_tokens": 4, "priority": 0},
+    ]
+    mk_engine = Engine(mk_cfg, mk_params, ctx1, backend="megakernel",
+                       max_seq=256, page_size=128)
+    from triton_distributed_tpu.serving.loop import ServingEngine
+
+    se4 = ServingEngine(mk_engine, max_batch=2, num_pages=2,
+                        prefill_chunk=128)
+    mk_report = run_trace(se4, mk_trace)
+    mk_reqs = mk_report.pop("requests")
+    oracle = Engine(mk_cfg, mk_params, ctx1, backend="xla", max_seq=256)
+    mk_golden = sequential_reference(oracle, mk_trace)
+    mk_mismatch = [r.req_id for r in mk_reqs
+                   if r.tokens != mk_golden[r.req_id]]
+    mk_preempted = [r.req_id for r in mk_reqs
+                    if r.preemptions > 0
+                    and r.tokens == mk_golden[r.req_id]]
+    if se4._mk is None or mk_engine.backend != "megakernel":
+        failures.append(
+            f"megakernel serving lane silently demoted (backend now "
+            f"{mk_engine.backend!r}) — the parity it reported is not "
+            "the persistent kernel's")
+    if mk_mismatch:
+        failures.append("megakernel serving token parity broken vs "
+                        f"sequential serve: {mk_mismatch}")
+    if not mk_preempted:
+        failures.append("no request was preempted+resumed with parity on "
+                        "the paged megakernel workspace")
+    report["megakernel_lane"] = {
+        "parity_ok": not mk_mismatch,
+        "preempted_with_parity": mk_preempted,
+        "iterations": mk_report["iterations"],
+        "all_finished": mk_report["all_finished"],
+    }
+
     report["failures"] = failures
     if json_path:
         with open(json_path, "w") as f:
@@ -279,13 +343,19 @@ def dryrun(json_path: str | None) -> int:
 # ---------------------------------------------------------------------------
 
 def serving_bench_rung(n_streams: int = 8, prompt_len: int = 128,
-                       max_new: int = 16) -> dict:
+                       max_new: int = 16, *, backend: str = "xla",
+                       page_size: int = 64) -> dict:
     """Tokens/s + p99 TTFT/TPOT at ``n_streams`` concurrent streams on
     the Qwen3-8B TP=8 PER-DEVICE shard shapes (the same single-chip
     pricing discipline as the decode rungs: n=1, no ICI in the number;
     host scheduler dispatch IS included — that is what a serving tier
     costs). One warmup replay compiles every trace, the second replay is
-    the measurement."""
+    the measurement.
+
+    ``backend="megakernel"`` (round 9) serves decode through the paged
+    persistent kernel (page_size must be TILE = 128 there — the lane's
+    pool pages are workspace KV tiles); bench.py races it against the
+    xla rung in the same window (`serve_tokens_per_s_megakernel`)."""
     import jax
     import jax.random as jrandom
 
@@ -301,9 +371,15 @@ def serving_bench_rung(n_streams: int = 8, prompt_len: int = 128,
     params = init_dense_llm(jrandom.PRNGKey(0), cfg)
     ctx1 = initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
                                   devices=jax.devices()[:1])
-    engine = Engine(cfg, params, ctx1, backend="xla", max_seq=512,
-                    page_size=64)
+    engine = Engine(cfg, params, ctx1, backend=backend, max_seq=512,
+                    page_size=page_size)
     se = ServingEngine(engine, max_batch=n_streams, prefill_chunk=128)
+    if backend == "megakernel" and se._mk is None:
+        # The rung exists to price the persistent lane; silently racing
+        # a demoted dense loop would mislabel the ledger row.
+        raise RuntimeError(
+            f"megakernel serving lane demoted at construction (engine "
+            f"backend now {engine.backend!r}) — rung not measurable")
     spec = LoadSpec(n_requests=n_streams, seed=0,
                     prompt_len=(prompt_len, prompt_len),
                     max_new=(max_new, max_new),
@@ -317,7 +393,7 @@ def serving_bench_rung(n_streams: int = 8, prompt_len: int = 128,
         "serve_ttft_p99_ms": report["ttft_p99_ms"],
         "serve_tpot_p99_ms": report["tpot_p99_ms"],
         "serve_concurrent_streams": n_streams,
-        "serve_comm": "none (n=1 shard; xla decode path); host "
+        "serve_comm": f"none (n=1 shard; {backend} decode path); host "
                       "scheduler + per-iteration dispatch included — "
                       "the serving tier's real cost, unlike the pure "
                       "decode-chain rungs",

@@ -83,8 +83,18 @@ class ServingEngine:
 
     Args:
       engine: an :class:`Engine` constructed with ``page_size`` (the
-        paged decode path is the whole point); ``backend="megakernel"``
-        is rejected (its workspace cache is not paged).
+        paged decode path is the whole point). ``backend="megakernel"``
+        (round 9) decodes through the PAGED persistent kernel
+        (:class:`~triton_distributed_tpu.megakernel.serving.
+        PagedMegakernelDecoder`): one row block per slot over shared
+        per-(layer, kv-head) pools whose pages are the allocator's page
+        ids one-to-one; requires ``page_size == TILE`` (128) and a
+        single-rank dense model — an incompatible configuration raises
+        :class:`~triton_distributed_tpu.resilience.
+        BackendUnsupportedError` through the PR-6 demotion ladder
+        (demote, don't die) rather than hard-rejecting. Mixed chunked
+        prefill stays on the dense path either way; only decode goes
+        persistent.
       max_batch: decode slots (the in-flight batch width; one jit trace).
       num_pages: shared KV pool size in pages (default: every slot can
         hold its full ``max_pages`` allotment — no pressure; size it
@@ -113,11 +123,6 @@ class ServingEngine:
                 "engine has no paged cache: construct Engine(page_size=...) "
                 "— the serving tier schedules against the PagedModelCache "
                 "pool (argument engine)")
-        if engine.backend == "megakernel":
-            raise ServingConfigError(
-                "backend 'megakernel' unsupported: the megakernel decoder "
-                "owns its own workspace cache, not the paged pool "
-                "(argument engine; see ROADMAP item 3b)")
         page = engine.page_size
         chunk = prefill_chunk if prefill_chunk is not None else page
         if chunk < 1 or chunk % page:
@@ -153,6 +158,22 @@ class ServingEngine:
                 "at least one page — argument num_pages")
         self.num_pages = pool_pages
         self.scratch_page = pool_pages        # last pool row, never owned
+        # Megakernel serving lane (round 9): decode through the PAGED
+        # persistent kernel when the configuration supports it; a
+        # workspace/page-shape mismatch raises the TRANSIENT
+        # BackendUnsupportedError and DEMOTES through the engine's PR-6
+        # ladder instead of killing construction.
+        self._mk = None
+        self._mk_ws = None
+        if engine.backend == "megakernel":
+            from triton_distributed_tpu.resilience import (
+                BackendUnsupportedError,
+            )
+
+            try:
+                self._mk = self._build_megakernel_lane(pool_pages)
+            except BackendUnsupportedError as exc:
+                self._demote_backend(str(exc))
         mesh = engine.ctx.mesh
 
         def put(tree, specs):
@@ -166,9 +187,18 @@ class ServingEngine:
         self._cache = put(cache, paged_cache_specs(engine.shard_axes))
         self._pf_cache = put(init_kv_cache(self.cfg, 1, self.s_buf),
                              kv_cache_specs(engine.shard_axes))
+        # With the persistent backend active the pool carries the
+        # megakernel workspace's reserved scratch page as a REAL,
+        # reserved pool row — the admission/budget math sees it (and can
+        # never hand it out or oversubscribe against it).
+        if self._mk is not None:
+            allocator = PageAllocator(pool_pages + 1, self.max_pages,
+                                      reserved=(self.scratch_page,))
+        else:
+            allocator = PageAllocator(pool_pages, self.max_pages)
         self.sched = Scheduler(
             num_slots=max_batch,
-            allocator=PageAllocator(pool_pages, self.max_pages),
+            allocator=allocator,
             page_size=page, capacity_tokens=capacity,
             max_waiting=max_waiting)
         self._jits: dict = {}
@@ -183,6 +213,55 @@ class ServingEngine:
         self._viol_streak = 0
         self._clean_streak = 0
         self._finished: list[Request] = []
+
+    # -- megakernel serving lane (round 9) ----------------------------------
+    def _build_megakernel_lane(self, pool_pages: int):
+        """The paged persistent-kernel decoder, or a named
+        BackendUnsupportedError describing exactly which dimension the
+        lane cannot serve (page shape, TP degree, model geometry)."""
+        from triton_distributed_tpu.megakernel.serving import (
+            PagedMegakernelDecoder, validate_megakernel_cfg,
+        )
+        from triton_distributed_tpu.megakernel.tasks import TILE
+        from triton_distributed_tpu.resilience import (
+            BackendUnsupportedError,
+        )
+
+        eng = self.engine
+        if eng.n_total > 1:
+            raise BackendUnsupportedError(
+                f"megakernel serving lane is single-rank for now (TP "
+                f"mesh of {eng.n_total}) — demoting to the jitted paths")
+        if self.page != TILE:
+            raise BackendUnsupportedError(
+                f"megakernel paged workspace needs page_size == TILE "
+                f"({TILE}); engine has page_size={self.page} — pool "
+                "pages must line up one-to-one with workspace KV tiles")
+        try:
+            validate_megakernel_cfg(self.cfg, self.max_pages * TILE)
+        except ValueError as exc:
+            raise BackendUnsupportedError(
+                f"megakernel cannot serve this model: {exc}") from exc
+        wdt = (jnp.float32 if jnp.dtype(self.cfg.dtype) == jnp.float32
+               else jnp.bfloat16)
+        return PagedMegakernelDecoder(
+            self.cfg, eng.params, num_slots=self.max_batch,
+            num_pages=pool_pages, max_pages=self.max_pages, dtype=wdt)
+
+    def _demote_backend(self, reason: str) -> None:
+        """Fall one rung down the engine's PR-6 ladder (megakernel →
+        overlap → xla); with the ladder disabled or exhausted the named
+        error propagates — demotion must never silently mask a config
+        the operator pinned."""
+        from triton_distributed_tpu.resilience import (
+            BackendUnsupportedError,
+        )
+
+        eng = self.engine
+        if eng._rung + 1 < len(eng._ladder):
+            eng._set_rung(eng._rung + 1, reason)
+        else:
+            raise BackendUnsupportedError(reason)
 
     # -- jitted pieces ------------------------------------------------------
     def _first_call(self, key, fn, what: str):
@@ -305,6 +384,28 @@ class ServingEngine:
         if self.engine.backend != self._jits_backend:
             self._jits.clear()
             self._jits_backend = self.engine.backend
+            if self._mk is not None and self.engine.backend != "megakernel":
+                # The ladder (SLO streaks, transient failures) moved off
+                # the persistent backend: in-flight decode state lives in
+                # the megakernel pools, so running sequences recompute
+                # through the dense path (preempt-resume).
+                self._mk = None
+                self._mk_ws = None
+                for req in list(self.sched.running()):
+                    self.sched._preempt(req)
+            elif self._mk is None and self.engine.backend == "megakernel":
+                # Re-promotion probe back onto the persistent backend.
+                from triton_distributed_tpu.resilience import (
+                    BackendUnsupportedError,
+                )
+
+                try:
+                    self._mk = self._build_megakernel_lane(self.num_pages)
+                except BackendUnsupportedError as exc:
+                    self._demote_backend(str(exc))
+                else:
+                    for req in list(self.sched.running()):
+                        self.sched._preempt(req)
         admitted = self.sched.schedule_admissions()
         head = self.sched.prefill_head()
         prefilled = None
@@ -401,6 +502,15 @@ class ServingEngine:
                     "runs)")
             n_pages = -(-T // self.page)
             pages = self.sched.allocator.pages(req.req_id)[:n_pages]
+            if self._mk is not None:
+                # The megakernel workspace is the decode-time source of
+                # truth: a finished prefill's pages scatter in here too
+                # (the paged _cache keeps the dense fallback viable).
+                if self._mk_ws is None:
+                    self._mk_ws = self._mk.start()
+                self._mk_ws = self._mk.load_prefill(
+                    self._mk_ws, self._pf_cache.k, self._pf_cache.v,
+                    pages)
             self._cache = self._scatter_jit(n_pages)(
                 self._cache, self._pf_cache.k, self._pf_cache.v,
                 jnp.asarray(pages, jnp.int32))
@@ -428,13 +538,47 @@ class ServingEngine:
         alloc = self.sched.allocator
         toks = np.zeros((self.max_batch,), np.int32)
         lens = np.zeros((self.max_batch,), np.int32)
-        table = np.full((self.max_batch, self.max_pages),
-                        self.scratch_page, np.int32)
+        # Unmapped entries are -1 so the megakernel decoder's
+        # page-coverage guard can SEE them (it treats negatives as
+        # scratch and validates kv_len against the mapped count); the
+        # dense path substitutes the scratch page below.
+        table = np.full((self.max_batch, self.max_pages), -1, np.int32)
         for req in ready:
             toks[req.slot] = req.tokens[-1]
             lens[req.slot] = req.kv_len
             pages = alloc.pages(req.req_id)
             table[req.slot, :len(pages)] = pages
+        if self._mk is not None:
+            try:
+                self._decode_megakernel(ready, toks, lens, table)
+                return
+            except Exception as exc:
+                from triton_distributed_tpu import resilience
+
+                if not resilience.is_transient(exc):
+                    raise
+                # Workspace/page-shape mismatch or a backend failure mid
+                # serve: demote (don't die) and recompute the in-flight
+                # batch through the dense path — their decode-time KV
+                # lived in the megakernel pools, so recompute-on-resume
+                # is the only state-correct hand-off.
+                self._demote_backend(
+                    f"megakernel decode failed: {type(exc).__name__}: "
+                    f"{str(exc)[:120]}")
+                self._mk = None
+                self._mk_ws = None
+                for req in list(ready):
+                    self.sched._preempt(req)
+                if self._observing():
+                    # NOT the page-pressure counter: an operator alert
+                    # keyed on pool sizing must not fire for a backend
+                    # fault.
+                    obs_metrics.registry().counter(
+                        "tdtpu_serve_backend_demote_preemptions_total",
+                        "in-flight sequences recomputed because the "
+                        "decode backend demoted mid-serve").inc(len(ready))
+                return
+        table[table < 0] = self.scratch_page
         cache = self._cache._replace(page_table=jnp.asarray(table),
                                      kv_lens=jnp.asarray(lens))
         eng._jit_compiled_last_call = False
@@ -442,13 +586,37 @@ class ServingEngine:
         with obs_trace.span("serving.decode_step", batch=len(ready)):
             tok, self._cache = eng._decode_run(jnp.asarray(toks), cache)
             tok_np = np.asarray(tok)        # host sync: the loop needs them
+        self._decode_tail(ready, tok_np, t0, eng._jit_compiled_last_call)
+
+    def _decode_megakernel(self, ready: list[Request], toks, lens,
+                           table) -> None:
+        """One paged persistent-kernel decode step over every slot (the
+        round-9 megakernel serving lane): the host rewrites queue words
+        from the allocator's page ids, ONE pallas launch decodes the
+        whole heterogeneous batch, and the in-kernel APPEND_KV tasks
+        advance each slot's pool pages."""
+        if self._mk_ws is None:
+            self._mk_ws = self._mk.start()
+        t0 = self.clock()
+        with obs_trace.span("serving.decode_step_megakernel",
+                            batch=len(ready)):
+            self._mk_ws, tok = self._mk.step(self._mk_ws, toks, lens,
+                                             table)
+            tok_np = np.asarray(tok)    # host sync: the loop needs them
+        self._decode_tail(ready, tok_np, t0, self._mk.last_step_cold)
+
+    def _decode_tail(self, ready: list[Request], tok_np, t0: float,
+                     cold: bool) -> None:
+        """The per-step bookkeeping BOTH decode backends share (metrics,
+        rolling rate, token append/finish) — one copy, so a dense-path
+        change can never silently skip the persistent lane."""
         now = self.clock()
         if self._observing():
             reg = obs_metrics.registry()
             reg.counter("tdtpu_tokens_generated_total",
                         "decode tokens generated").inc(len(ready))
             Engine._observe_step(
-                reg, (now - t0) * 1e3, eng._jit_compiled_last_call,
+                reg, (now - t0) * 1e3, cold,
                 "tdtpu_decode_step_latency_ms",
                 "one decode step, wall (device-synced only in sync runs)")
         self.total_tokens += len(ready)

@@ -104,13 +104,14 @@ class Scheduler:
                 f"capacity {self.capacity_tokens} (max_pages * page_size, "
                 "bounded by the prefill buffer) — reject up front rather "
                 "than dying mid-generation")
-        if req.page_budget(self.page_size) > self.allocator.num_pages:
+        if req.page_budget(self.page_size) > self.allocator.usable_pages:
             raise RequestTooLargeError(
                 f"request {req.req_id} needs "
                 f"{req.page_budget(self.page_size)} pages at completion "
-                f"but the whole pool holds {self.allocator.num_pages} "
-                "(argument num_pages) — it could only ever cycle through "
-                "self-preemption")
+                f"but the whole pool holds {self.allocator.usable_pages} "
+                f"usable (num_pages {self.allocator.num_pages} minus "
+                f"{len(self.allocator.reserved)} reserved) — it could "
+                "only ever cycle through self-preemption")
         if len(self.waiting) >= self.max_waiting:
             return AdmitResult.QUEUE_FULL
         if self.allocator.free_count == 0:
