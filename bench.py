@@ -926,6 +926,20 @@ def _serving_metric():
     except Exception as e:    # additive rung never blocks the xla rung
         out["serving_megakernel_error"] = \
             f"{type(e).__name__}: {str(e)[:120]}"
+    # Round 10: the disaggregated tier races the monolithic rung in the
+    # same window (`serve_tokens_per_s_disagg` — prefill role on chip 0,
+    # decode role on chip 1, checksummed KV-migration streams included
+    # in the number; docs/disagg.md). Additive, never blocking.
+    try:
+        from triton_distributed_tpu.serving.loadgen import (
+            disagg_serving_bench_rung,
+        )
+
+        out.update(disagg_serving_bench_rung(n_streams=8, prompt_len=128,
+                                             max_new=16))
+    except Exception as e:
+        out["serving_disagg_error"] = \
+            f"{type(e).__name__}: {str(e)[:120]}"
     return out
 
 

@@ -1,0 +1,439 @@
+"""Disaggregated prefill/decode serving across the DCN tier (ISSUE 10).
+
+The load-bearing contract (docs/disagg.md): the role-split tier —
+chunked prefill on one slice, paged decode on another, KV pages
+streaming between them — must be TOKEN-IDENTICAL per request to the
+monolithic ``ServingEngine`` on the virtual (2,4) mesh, including a
+preemption that crosses a migration and decode-side page ids permuted
+vs the prefill side's; migration faults demote to monolithic serving
+(never die, never silently corrupt); and the transfer protocol is
+commlint-clean with a seeded violation proving the coverage is real.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.disagg import (
+    DisaggConfigError, DisaggServingEngine, MigrationError,
+    MigrationIntegrityError, MigrationStream, MigrationTimeoutError,
+    kv_migrate_local, role_contexts, split_roles,
+)
+from triton_distributed_tpu.models.config import ModelConfig, tiny_config
+from triton_distributed_tpu.models.dense import init_dense_llm
+from triton_distributed_tpu.models.engine import Engine
+from triton_distributed_tpu.obs import metrics as obs_metrics
+from triton_distributed_tpu.runtime import initialize_distributed
+from triton_distributed_tpu.serving import (
+    AdmitResult, Request, RequestState, ServingEngine,
+)
+
+
+@pytest.fixture(scope="module")
+def ctx24():
+    """The virtual (2,4) DCN x ICI mesh over the 8 CPU devices."""
+    return initialize_distributed(mesh_shape=(2, 4),
+                                  axis_names=("dcn", "tp"))
+
+
+@pytest.fixture(scope="module")
+def model24():
+    """(cfg, params) for the (2,4) parity tests — kv heads divide the
+    4-way TP degree of each role slice."""
+    cfg = ModelConfig(hidden_size=64, intermediate_size=96, num_layers=2,
+                      num_heads=4, num_kv_heads=4, head_dim=16,
+                      vocab_size=256, dtype="float32")
+    return cfg, init_dense_llm(jax.random.PRNGKey(3), cfg)
+
+
+@pytest.fixture(scope="module")
+def mono24(ctx24, model24):
+    """The monolithic parity oracle on the SAME (2,4) mesh (xla
+    backend: the dcn axis replicated — the golden path)."""
+    cfg, params = model24
+    return Engine(cfg, params, ctx24, backend="xla", max_seq=64,
+                  page_size=4)
+
+
+def _disagg(ctx24, model24, **kw):
+    cfg, params = model24
+    return DisaggServingEngine.from_mesh(cfg, params, ctx24, max_seq=64,
+                                         page_size=4, **kw)
+
+
+def _serve_all(se, prompts, gens, priorities=None, max_iters=3000):
+    reqs = []
+    for i, (p, g) in enumerate(zip(prompts, gens)):
+        pr = priorities[i] if priorities else 0
+        req, res = se.submit(p, g, priority=pr)
+        assert res is AdmitResult.ADMITTED
+        reqs.append(req)
+    se.run(max_iters=max_iters)
+    return reqs
+
+
+def _prompts(seed, n, lengths=(6, 9)):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 256, int(rng.choice(lengths))).tolist()
+            for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# Request lifecycle: the MIGRATING edges.
+# ---------------------------------------------------------------------------
+
+def test_request_migrating_edges():
+    r = Request(prompt=[1, 2, 3], max_new_tokens=3)
+    r.advance(RequestState.PREFILLING)
+    r.advance(RequestState.MIGRATING)
+    r.advance(RequestState.PREEMPTED)          # preempt mid-migration
+    r.advance(RequestState.PREFILLING)         # recompute-on-resume
+    r.advance(RequestState.MIGRATING)
+    r.advance(RequestState.RUNNING)
+    with pytest.raises(ValueError, match="illegal request transition"):
+        r.advance(RequestState.MIGRATING)      # RUNNING never re-migrates
+    r2 = Request(prompt=[1], max_new_tokens=1)
+    with pytest.raises(ValueError, match="illegal request transition"):
+        r2.advance(RequestState.MIGRATING)     # WAITING must prefill first
+
+
+# ---------------------------------------------------------------------------
+# Role split.
+# ---------------------------------------------------------------------------
+
+def test_split_roles_partitions_the_mesh(ctx24):
+    pctx, dctx = split_roles(ctx24)
+    assert pctx.mesh.axis_names == ("tp",) and pctx.num_ranks == 4
+    assert dctx.mesh.axis_names == ("tp",) and dctx.num_ranks == 4
+    p_devs = set(pctx.mesh.devices.ravel())
+    d_devs = set(dctx.mesh.devices.ravel())
+    assert not (p_devs & d_devs), "roles must own disjoint devices"
+    assert p_devs | d_devs == set(ctx24.mesh.devices.ravel())
+
+
+def test_role_contexts_degenerate_pairs():
+    """The CPU-proof helper: two devices -> disjoint 1-device roles;
+    one device -> both roles share it (the transport is device-count-
+    independent)."""
+    pctx, dctx = role_contexts(jax.devices()[:2])
+    assert pctx.mesh.devices.ravel()[0] != dctx.mesh.devices.ravel()[0]
+    pctx1, dctx1 = role_contexts(jax.devices()[:1])
+    assert pctx1.mesh.devices.ravel()[0] == dctx1.mesh.devices.ravel()[0]
+
+
+def test_split_roles_named_errors(ctx24):
+    with pytest.raises(DisaggConfigError, match="not on the mesh"):
+        split_roles(ctx24, inter_axis="nope")
+    ctx1 = initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                  devices=jax.devices()[:1])
+    with pytest.raises(DisaggConfigError, match="exactly 2 slices"):
+        split_roles(ctx1, inter_axis="tp", axis="tp")
+
+
+# ---------------------------------------------------------------------------
+# The migration op (single-program shard_map form).
+# ---------------------------------------------------------------------------
+
+PAGE_ROWS = 8
+
+
+def _pools():
+    src = jnp.arange(4 * PAGE_ROWS * 128, dtype=jnp.float32
+                     ).reshape(4 * PAGE_ROWS, 128)
+    dst = -jnp.ones((6 * PAGE_ROWS, 128), jnp.float32)
+    return src, dst
+
+
+def test_kv_migrate_local_golden(ctx24):
+    """Pages land on the decode slice at REWRITTEN ids, the source
+    slice's pool is untouched, untargeted pages keep their bytes —
+    on the virtual (2,4) mesh with real interpret-mode DMA chains."""
+    src_pages, dst_pages = (1, 3, 0), (5, 0, 2)
+    pool_src, pool_dst = _pools()
+    fn = functools.partial(kv_migrate_local, src_pages=src_pages,
+                           dst_pages=dst_pages, inter_axis="dcn",
+                           n_inter=2, page_rows=PAGE_ROWS, block_pages=1)
+    out = jax.jit(jax.shard_map(
+        fn, mesh=ctx24.mesh, in_specs=(P(), P()), out_specs=P("dcn"),
+        check_vma=False))(pool_src, pool_dst)
+    out = np.asarray(out)
+    rows = 6 * PAGE_ROWS
+    s0, s1 = out[:rows], out[rows:]
+    assert np.all(s0 == -1), "prefill slice's decode pool must not move"
+    ps = np.asarray(pool_src)
+    for sp, dp in zip(src_pages, dst_pages):
+        np.testing.assert_array_equal(
+            s1[dp * PAGE_ROWS:(dp + 1) * PAGE_ROWS],
+            ps[sp * PAGE_ROWS:(sp + 1) * PAGE_ROWS])
+    for p in set(range(6)) - set(dst_pages):
+        assert np.all(s1[p * PAGE_ROWS:(p + 1) * PAGE_ROWS] == -1)
+
+
+def test_kv_migrate_local_validation():
+    pool_src, pool_dst = _pools()
+    kw = dict(inter_axis="dcn", n_inter=2, page_rows=PAGE_ROWS)
+    with pytest.raises(ValueError, match="pair one-to-one"):
+        kv_migrate_local(pool_src, pool_dst, (0, 1), (2,), **kw)
+    with pytest.raises(ValueError, match="duplicate destination"):
+        kv_migrate_local(pool_src, pool_dst, (0, 1), (2, 2), **kw)
+    with pytest.raises(ValueError, match="outside the pool"):
+        kv_migrate_local(pool_src, pool_dst, (9,), (0,), **kw)
+    with pytest.raises(ValueError, match="page_rows required"):
+        kv_migrate_local(pool_src, pool_dst, (0,), (1,), inter_axis="dcn",
+                         n_inter=2)
+    # Empty stream is a no-op, not an error.
+    assert kv_migrate_local(pool_src, pool_dst, (), (), **kw) is pool_dst
+
+
+def test_disagg_migrate_protocol_clean():
+    """The commlint registry driver: pack chain + DCN hop + scatter
+    chain replayed over (2,2) and (2,4) — every DMA awaited, no
+    deadlock (satellite #1; the CI lint job sweeps this with --all)."""
+    from triton_distributed_tpu.analysis.registry import analyze_op
+
+    for report in analyze_op("disagg_migrate"):
+        assert report.ok, (
+            f"{report.op}: " + "; ".join(v.message
+                                         for v in report.violations))
+        assert report.n_kernels > 0
+
+
+def test_seeded_migration_violation_caught():
+    """A pack chain that skips its last DMA wait (the seeded bug) is
+    flagged — proof the sweep sees the migration protocol, not just
+    clean replays."""
+    from triton_distributed_tpu.analysis import check, trace_op
+
+    pool_src, pool_dst = _pools()
+
+    def driver(d):
+        kv_migrate_local(pool_src, pool_dst, (1, 3, 0), (5, 0, 2),
+                         inter_axis="dcn", n_inter=d["dcn"],
+                         page_rows=PAGE_ROWS, block_pages=1,
+                         _drop_pack_wait=True)
+
+    report = check(trace_op(driver, axes=("dcn", "tp"), dims=(2, 4),
+                            name="seeded-migration"))
+    kinds = {v.kind for v in report.violations}
+    assert "delta-imbalance" in kinds, report.violations
+
+
+# ---------------------------------------------------------------------------
+# MigrationStream (host transport) units.
+# ---------------------------------------------------------------------------
+
+def _kv_blocks(n, val=1.0):
+    return [(jnp.full((2, 1, 4, 1, 8), val * (i + 1), jnp.float32),
+             jnp.full((2, 1, 4, 1, 8), -val * (i + 1), jnp.float32))
+            for i in range(n)]
+
+
+def test_migration_stream_double_buffer_and_accounting():
+    """Blocks land in order, one rotation per advance, with a send
+    always a step ahead of the landing scatter (double buffer); bytes
+    and pages account the whole stream."""
+    landed = []
+    stream = MigrationStream("r", _kv_blocks(3),
+                             [[7], [2], [5]], put=lambda kv: kv,
+                             verify=True)
+    done = stream.advance(lambda i, kv, pages: landed.append((i, pages)))
+    assert not done and landed == []          # pipeline priming: send only
+    done = stream.advance(lambda i, kv, pages: landed.append((i, pages)))
+    assert not done and landed == [(0, [7])]
+    done = stream.advance(lambda i, kv, pages: landed.append((i, pages)))
+    assert not done and landed == [(0, [7]), (1, [2])]
+    done = stream.advance(lambda i, kv, pages: landed.append((i, pages)))
+    assert done and landed[-1] == (2, [5])
+    assert stream.pages_moved == 3
+    assert stream.bytes_moved == 3 * 2 * (2 * 1 * 4 * 1 * 8) * 4
+
+
+def test_migration_stream_drop_and_corrupt_named():
+    def run(hook):
+        stream = MigrationStream("r", _kv_blocks(2), [[0], [1]],
+                                 put=lambda kv: kv, verify=True,
+                                 chaos_hook=hook)
+        for _ in range(4):
+            if stream.advance(lambda i, kv, pages: None):
+                break
+
+    with pytest.raises(MigrationError, match="block 0 lost in transit"):
+        run(lambda i, kv: None if i == 0 else kv)
+    with pytest.raises(MigrationIntegrityError, match="checksum mismatch"):
+        run(lambda i, kv: (kv[0] + 1.0, kv[1]) if i == 1 else kv)
+
+
+def test_migration_stream_deadline_named():
+    t = [0.0]
+    stream = MigrationStream("r", _kv_blocks(2), [[0], [1]],
+                             put=lambda kv: kv, verify=False,
+                             timeout_s=10.0, clock=lambda: t[0])
+    stream.advance(lambda i, kv, pages: None)
+    t[0] = 11.0
+    with pytest.raises(MigrationTimeoutError, match="exceeded its "
+                                                    "deadline"):
+        stream.advance(lambda i, kv, pages: None)
+    # transient marker: the demotion path must treat all three as such
+    from triton_distributed_tpu import resilience
+
+    assert resilience.is_transient(MigrationTimeoutError("x"))
+    assert resilience.is_transient(MigrationIntegrityError("x"))
+    assert resilience.is_transient(MigrationError("x"))
+
+
+# ---------------------------------------------------------------------------
+# DisaggServingEngine: the (2,4) acceptance contract.
+# ---------------------------------------------------------------------------
+
+def test_disagg_parity_vs_monolithic_2x4(ctx24, model24, mono24):
+    """THE acceptance test: the role-split tier on the (2,4) mesh is
+    token-identical to the monolithic ServingEngine on the same mesh,
+    with at least one migration landing at decode-side page ids that
+    differ from the prefill side's 0..n-1 (the page-table rewrite)."""
+    prompts = _prompts(0, 4, lengths=(6, 9, 11))
+    gens = [6, 5, 7, 4]
+    mono = ServingEngine(mono24, max_batch=2, prefill_chunk=4)
+    mono_reqs = _serve_all(mono, prompts, gens)
+    dg = _disagg(ctx24, model24, max_batch=2, prefill_chunk=4,
+                 block_pages=1)
+    dg_reqs = _serve_all(dg, prompts, gens)
+    assert dg.disagg_active, dg.demotion_reason
+    assert all(r.state is RequestState.FINISHED for r in dg_reqs)
+    for m, d in zip(mono_reqs, dg_reqs):
+        assert d.tokens == m.tokens, f"{d.req_id} diverged"
+    assert len(dg.migrations_log) == 4        # every request migrated
+    rewrites = [m for m in dg.migrations_log
+                if m["src_pages"] != m["dst_pages"]]
+    assert rewrites, ("every migration landed at identity ids — the "
+                      "rewrite path is untested")
+
+
+def test_disagg_preempt_during_migration_resume_parity(ctx24, model24,
+                                                       mono24):
+    """Decode-pool pressure evicts a request MID-migration (its stream
+    is cancelled, pages freed); it resumes by recompute — re-prefill +
+    re-migrate — and still matches the monolithic tokens."""
+    prompts = [list(range(10, 16)), list(range(30, 42)),
+               list(range(50, 54))]
+    gens = [10, 4, 2]
+    priorities = [1, 0, 0]
+    mono = ServingEngine(mono24, max_batch=2, num_pages=5,
+                         prefill_chunk=4)
+    mono_reqs = _serve_all(mono, prompts, gens, priorities)
+    dg = _disagg(ctx24, model24, max_batch=2, num_pages=5,
+                 prefill_chunk=4, block_pages=1)
+    dg_reqs = _serve_all(dg, prompts, gens, priorities)
+    assert dg.disagg_active
+    assert dg.migration_preemptions >= 1, \
+        "pool sizing no longer evicts a request mid-migration"
+    assert any(r.preemptions >= 1 for r in dg_reqs)
+    for m, d in zip(mono_reqs, dg_reqs):
+        assert d.tokens == m.tokens, \
+            f"{d.req_id} diverged (preemptions={d.preemptions})"
+
+
+def test_disagg_fault_demotes_to_monolithic_with_parity(ctx24, model24,
+                                                        mono24):
+    """A lost migration block demotes the tier to monolithic serving on
+    the decode slice (named reason recorded, RUNNING work kept, the
+    rest recomputed) — output still token-identical."""
+    prompts = _prompts(2, 3, lengths=(6, 9))
+    gens = [5, 6, 4]
+    mono = ServingEngine(mono24, max_batch=2, prefill_chunk=4)
+    mono_reqs = _serve_all(mono, prompts, gens)
+    dg = _disagg(ctx24, model24, max_batch=2, prefill_chunk=4,
+                 block_pages=1)
+    fired = {"n": 0}
+
+    def drop_once(idx, kv):
+        if fired["n"] == 0:
+            fired["n"] += 1
+            return None
+        return kv
+
+    dg._migrate_chaos = drop_once
+    with pytest.warns(RuntimeWarning, match="demoted to monolithic"):
+        dg_reqs = _serve_all(dg, prompts, gens)
+    assert fired["n"] == 1
+    assert not dg.disagg_active
+    assert "MigrationError" in dg.demotion_reason
+    assert all(r.state is RequestState.FINISHED for r in dg_reqs)
+    for m, d in zip(mono_reqs, dg_reqs):
+        assert d.tokens == m.tokens, f"{d.req_id} diverged post-demotion"
+
+
+def test_disagg_ladder_opt_out_propagates(ctx24, model24, monkeypatch):
+    """TDTPU_DEMOTION_LADDER=0: the named migration error PROPAGATES
+    instead of demoting (demotion must never mask a pinned config)."""
+    monkeypatch.setenv("TDTPU_DEMOTION_LADDER", "0")
+    dg = _disagg(ctx24, model24, max_batch=1, prefill_chunk=4,
+                 block_pages=1)
+    dg._migrate_chaos = lambda i, kv: None
+    req, res = dg.submit([1, 2, 3, 4, 5, 6], 4)
+    assert res is AdmitResult.ADMITTED
+    with pytest.raises(MigrationError, match="lost in transit"):
+        dg.run(max_iters=200)
+    assert dg.disagg_active                    # never silently demoted
+
+
+def test_disagg_config_errors(ctx24, model24):
+    cfg, params = model24
+    pctx, dctx = split_roles(ctx24)
+    pe = Engine(cfg, params, pctx, backend="xla", max_seq=64)
+    de = Engine(cfg, params, dctx, backend="xla", max_seq=64, page_size=4)
+    other = tiny_config()
+    pe_other = Engine(other, init_dense_llm(jax.random.PRNGKey(0), other),
+                      pctx, backend="xla", max_seq=64)
+    with pytest.raises(DisaggConfigError, match="different model"):
+        DisaggServingEngine(pe_other, de)
+    pe_short = Engine(cfg, params, pctx, backend="xla", max_seq=32)
+    with pytest.raises(DisaggConfigError, match="max_seq"):
+        DisaggServingEngine(pe_short, de)
+    with pytest.raises(DisaggConfigError, match="block_pages"):
+        DisaggServingEngine(pe, de, block_pages=0)
+
+
+def test_disagg_metrics_and_report_lane(ctx24, model24, tmp_path):
+    """Under an obs run the migration lane publishes bytes/pages/count
+    counters and the latency histogram, obs.report renders the section,
+    and a FAILED stream gates --check unless explicitly allowed."""
+    from triton_distributed_tpu import obs
+    from triton_distributed_tpu.obs import report as obs_report
+
+    run_dir = str(tmp_path / "run")
+    obs.start_run(run_dir)
+    try:
+        dg = _disagg(ctx24, model24, max_batch=2, prefill_chunk=4)
+        _serve_all(dg, _prompts(4, 2), [4, 5])
+        reg = obs_metrics.registry()
+        assert reg.get(obs_metrics.KV_MIGRATIONS).value == 2
+        assert reg.get(obs_metrics.KV_MIGRATE_BYTES).value > 0
+        assert reg.get(obs_metrics.KV_MIGRATE_PAGES).value >= 2
+        assert reg.get(obs_metrics.KV_MIGRATE_LATENCY_MS).count == 2
+        assert reg.get(obs_metrics.KV_MIGRATE_FAILURES) is None
+        # Now a failed stream -> failure counter + disagg demotion.
+        dg2 = _disagg(ctx24, model24, max_batch=1, prefill_chunk=4)
+        dg2._migrate_chaos = lambda i, kv: None
+        with pytest.warns(RuntimeWarning, match="demoted to monolithic"):
+            _serve_all(dg2, [_prompts(5, 1)[0]], [4])
+        assert reg.get(obs_metrics.KV_MIGRATE_FAILURES).value == 1
+        assert reg.get(obs_metrics.DISAGG_DEMOTIONS).value == 1
+    finally:
+        obs.finish_run()
+    out = obs_report.main([run_dir, "--allow-slo-violations"])
+    assert out == 0                            # render-only never gates
+    rc = obs_report.main([run_dir, "--check", "--allow-slo-violations",
+                          "--allow-preemptions", "--require-series",
+                          obs_metrics.KV_MIGRATE_BYTES])
+    assert rc == 1                             # the failed stream gates
+    rc = obs_report.main([run_dir, "--check", "--allow-slo-violations",
+                          "--allow-preemptions",
+                          "--allow-migration-failures",
+                          "--require-series",
+                          obs_metrics.KV_MIGRATE_BYTES])
+    assert rc == 0

@@ -246,6 +246,30 @@ def _drv_hierarchical_sp(d):
                              n_intra=n_intra, n_inter=n_inter)
 
 
+def _drv_disagg_migrate(d):
+    """KV-migration transfer protocol (disagg/migrate.kv_migrate_local,
+    docs/disagg.md): the prefill slice's double-buffered pack DMA chain,
+    one DCN ppermute hop per block, and the decode slice's copy-through
+    scatter chain landing at REWRITTEN page ids — replayed on both tier
+    aspect ratios so the checker sees the full two-tier schedule
+    (per-slice DMA pipelines interleaved with the XLA hops), like the
+    hierarchical drivers."""
+    from triton_distributed_tpu.disagg.migrate import kv_migrate_local
+
+    n_inter = d["dcn"]
+    page_rows = 8
+    pool_src = _arr(4 * page_rows, 128)
+    pool_dst = _arr(6 * page_rows, 128)
+    # Multi-block stream (block_pages=1): the double-buffer rotation —
+    # pack b+1 / hop b+1 issued while block b's scatter chain lands.
+    kv_migrate_local(pool_src, pool_dst, (1, 3, 0), (5, 0, 2),
+                     inter_axis="dcn", n_inter=n_inter,
+                     page_rows=page_rows, block_pages=1)
+    # Degenerate single-block stream (no rotation): the drain path.
+    kv_migrate_local(pool_src, pool_dst, (2,), (4,), inter_axis="dcn",
+                     n_inter=n_inter, page_rows=page_rows)
+
+
 def _drv_multi_axis(d):
     from triton_distributed_tpu.ops.multi_axis import (
         all_gather_torus_local, all_reduce_torus_local,
@@ -286,6 +310,8 @@ def build_registry(ranks: Sequence[int] = (2, 4, 8)) -> dict[str, OpDriver]:
                                  _MESHES_HIER),
         "hierarchical_sp": OpDriver("hierarchical_sp", _drv_hierarchical_sp,
                                     ((("dcn", "tp"), (2, 2)),)),
+        "disagg_migrate": OpDriver("disagg_migrate", _drv_disagg_migrate,
+                                   _MESHES_DCN),
         "multi_axis": OpDriver("multi_axis", _drv_multi_axis, _MESHES_2D),
     }
 

@@ -1,0 +1,29 @@
+"""Disaggregated prefill/decode serving across the DCN tier (ISSUE 10,
+ROADMAP open item #2 — docs/disagg.md).
+
+* :mod:`~triton_distributed_tpu.disagg.migrate` — the KV-migration
+  transport: :class:`MigrationStream` (host-driven double-buffered block
+  streaming between the role meshes, checksummed + deadline-bounded) and
+  :func:`kv_migrate_local` (the single-program shard_map/Pallas protocol
+  form the commlint registry sweeps as ``disagg_migrate``);
+* :mod:`~triton_distributed_tpu.disagg.engine` —
+  :class:`DisaggServingEngine` (the role-split continuous-batching tier
+  over the PR-7 scheduler; migration faults demote to monolithic
+  serving with token parity) and :func:`split_roles` /
+  :func:`role_contexts` mesh partitioning.
+"""
+
+from triton_distributed_tpu.disagg.engine import (  # noqa: F401
+    DisaggConfigError, DisaggServingEngine, role_contexts, split_roles,
+)
+from triton_distributed_tpu.disagg.migrate import (  # noqa: F401
+    MigrationError, MigrationIntegrityError, MigrationStream,
+    MigrationTimeoutError, kv_migrate_local, migrate_timeout_s,
+)
+
+__all__ = [
+    "DisaggConfigError", "DisaggServingEngine", "MigrationError",
+    "MigrationIntegrityError", "MigrationStream", "MigrationTimeoutError",
+    "kv_migrate_local", "migrate_timeout_s", "role_contexts",
+    "split_roles",
+]

@@ -1,0 +1,437 @@
+"""DisaggServingEngine — prefill and decode split across the DCN tier.
+
+The production pattern for heavy traffic (DistServe, OSDI'24; Mooncake —
+ROADMAP open item #2, docs/disagg.md): chunked prefill runs on one
+slice, paged decode on another, and a finished prefill's KV pages stream
+between them over DCN while the decode batch keeps stepping. This module
+composes the pieces the earlier PRs landed:
+
+* :func:`split_roles` partitions a 2-axis ``(inter, intra)`` mesh into a
+  PREFILL role (inter slice 0) and a DECODE role (inter slice 1), each a
+  plain 1-axis TP context;
+* :class:`DisaggServingEngine` extends the PR-7
+  :class:`~triton_distributed_tpu.serving.loop.ServingEngine`: the
+  scheduler, paged pool, admission backpressure (``QUEUE_FULL``),
+  SLO-driven admission width and decode batch all stay the DECODE
+  side's — admission reserves against the DECODE pool's free-page
+  budget — while the prefill lane is rerouted onto the prefill role's
+  engine and a :class:`~triton_distributed_tpu.disagg.migrate.
+  MigrationStream` hands each finished prefill across (request state
+  PREFILLING → MIGRATING → RUNNING; a migration can be preempted
+  mid-stream and recomputes on resume);
+* migration faults (lost block, checksum mismatch, deadline — the named
+  :class:`~triton_distributed_tpu.disagg.migrate.MigrationError`
+  family, all TRANSIENT) demote the tier to MONOLITHIC serving on the
+  decode slice through the PR-6 demote-don't-die discipline: in-flight
+  RUNNING requests keep their (already-migrated, valid) pool pages,
+  PREFILLING/MIGRATING requests preempt and recompute on the decode
+  engine, and every request still finishes token-identical to the
+  monolithic tier (greedy parity is the oracle —
+  tests/test_disagg.py). ``TDTPU_DEMOTION_LADDER=0`` opts out: the
+  named error propagates.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_distributed_tpu.disagg.migrate import MigrationStream, _blocks
+from triton_distributed_tpu.models.engine import Engine
+from triton_distributed_tpu.models.kv_cache import (
+    init_kv_cache, kv_cache_specs, paged_cache_specs,
+)
+from triton_distributed_tpu.obs import metrics as obs_metrics
+from triton_distributed_tpu.obs import trace as obs_trace
+from triton_distributed_tpu.runtime.context import DistContext
+from triton_distributed_tpu.serving.loop import ServingEngine
+from triton_distributed_tpu.serving.request import Request, RequestState
+
+
+class DisaggConfigError(ValueError):
+    """A disagg-tier role/mesh/sizing parameter is invalid — named, at
+    construction (the ``_check_decode_step_config`` style)."""
+
+
+def _sub_context(devices, axis: str, base: DistContext) -> DistContext:
+    devs = np.asarray(devices).reshape(-1)
+    return DistContext(mesh=Mesh(devs.reshape(len(devs)), (axis,)),
+                       tp_axis=axis,
+                       wait_timeout_ms=base.wait_timeout_ms)
+
+
+def split_roles(ctx: DistContext, *, inter_axis: str = "dcn",
+                axis: str = "tp") -> tuple[DistContext, DistContext]:
+    """Partition a 2-axis mesh into (prefill_ctx, decode_ctx): inter
+    slice 0 prefills, inter slice 1 decodes, each a 1-axis ``axis`` TP
+    context over its slice's devices. The global context is untouched
+    (no ``set_context``)."""
+    names = ctx.mesh.axis_names
+    for a in (inter_axis, axis):
+        if a not in names:
+            raise DisaggConfigError(
+                f"axis {a!r} not on the mesh (axes {tuple(names)}) — "
+                "arguments inter_axis/axis")
+    n_inter = ctx.axis_size(inter_axis)
+    if n_inter != 2:
+        raise DisaggConfigError(
+            f"role split needs exactly 2 slices on the {inter_axis!r} "
+            f"axis (one prefill, one decode); mesh has {n_inter} — "
+            "argument inter_axis")
+    devs = np.asarray(ctx.mesh.devices)
+    moved = np.moveaxis(devs, list(names).index(inter_axis), 0)
+    return (_sub_context(moved[0], axis, ctx),
+            _sub_context(moved[1], axis, ctx))
+
+
+def role_contexts(devices=None, *, axis: str = "tp"
+                  ) -> tuple[DistContext, DistContext]:
+    """Degenerate role pair for CPU proofs and single-host benches: the
+    first two devices become (prefill, decode); with one device both
+    roles share it (the migration machinery — streams, checksums,
+    page-id rewrite, preemption — is device-count-independent)."""
+    devs = list(devices if devices is not None else jax.devices())
+    if len(devs) >= 2:
+        p, d = [devs[0]], [devs[1]]
+    else:
+        p = d = [devs[0]]
+    base = DistContext(mesh=Mesh(np.asarray(d), (axis,)), tp_axis=axis)
+    return (_sub_context(p, axis, base), _sub_context(d, axis, base))
+
+
+class DisaggServingEngine(ServingEngine):
+    """Role-split continuous-batching tier: prefill on one engine/mesh,
+    paged decode on another, KV migration between them (docs/disagg.md).
+
+    Args:
+      prefill_engine: the PREFILL role's :class:`Engine` (no paged pool
+        needed — it only runs chunked-prefill slices into the shared
+        linear buffer).
+      decode_engine: the DECODE role's :class:`Engine`, constructed with
+        ``page_size`` — it owns the paged pool, the scheduler admits
+        against ITS free-page budget, and everything the monolithic
+        :class:`ServingEngine` does (decode batch, preemption, SLO
+        coupling) runs here unchanged.
+      block_pages: pages per migration block (default: half the stream,
+        rounded up — two blocks, the classic double buffer; smaller
+        blocks lengthen the stream and widen the preemption window).
+      migrate_verify / migrate_timeout_s: integrity and deadline knobs
+        forwarded to every :class:`MigrationStream` (defaults from
+        ``TDTPU_MIGRATE_VERIFY`` / ``TDTPU_MIGRATE_TIMEOUT_MS``).
+
+    Everything else (``max_batch``, ``num_pages``, ``prefill_chunk``,
+    ``max_waiting``, ``slo_cfg``, …) is the monolithic tier's and sizes
+    the DECODE side.
+    """
+
+    def __init__(self, prefill_engine: Engine, decode_engine: Engine,
+                 *, block_pages: int | None = None,
+                 migrate_verify: bool | None = None,
+                 migrate_timeout_s: float | None = None, **kw):
+        if prefill_engine.cfg != decode_engine.cfg:
+            raise DisaggConfigError(
+                "prefill and decode engines serve different model "
+                "configs — the migrated KV would be meaningless "
+                "(arguments prefill_engine/decode_engine)")
+        if prefill_engine.max_seq < decode_engine.max_seq:
+            raise DisaggConfigError(
+                f"prefill engine max_seq {prefill_engine.max_seq} < "
+                f"decode engine max_seq {decode_engine.max_seq}: every "
+                "admitted prompt must fit the prefill buffer — argument "
+                "prefill_engine")
+        if block_pages is not None and block_pages < 1:
+            raise DisaggConfigError(
+                f"block_pages = {block_pages} invalid: a migration block "
+                "moves at least one page — argument block_pages")
+        super().__init__(decode_engine, **kw)
+        if self._mk is not None:
+            raise DisaggConfigError(
+                "decode_engine backend 'megakernel' is not wired into "
+                "the disagg tier yet (migrated pages land in the paged "
+                "pool, not the persistent workspace) — use the xla/"
+                "overlap decode backends, or the monolithic "
+                "ServingEngine for the megakernel lane")
+        self.prefill_engine = prefill_engine
+        self.block_pages = block_pages
+        self._migrate_verify = migrate_verify
+        self._migrate_timeout_s = migrate_timeout_s
+        self.disagg_active = True
+        self._streams: dict[str, tuple[Request, MigrationStream]] = {}
+        self.migrations_log: list[dict] = []
+        self.migration_preemptions = 0   # streams cancelled by eviction
+        self.demotion_reason: str | None = None
+        # Fault-injection point for the chaos plane (resilience/chaos.py):
+        # hook(block_idx, (k, v)) -> (k, v) | None per landed block.
+        self._migrate_chaos = None
+        # The shared prefill buffer lives on the PREFILL mesh while the
+        # role split is active: reshard the zeros super() already built
+        # (the monolithic fallback rebuilds them on the decode mesh at
+        # demotion time).
+        self._pf_cache = self._put_prefill(self._pf_cache)
+        # DCN hop: one block (k, v) pair onto the decode mesh with the
+        # pool's sharding — jax.device_put reshards across meshes (XLA's
+        # DCN transfer on real slices).
+        kv_spec = NamedSharding(
+            decode_engine.ctx.mesh,
+            P(None, None, None, decode_engine.shard_axes, None))
+        self._put_block = lambda kv: jax.device_put(kv, kv_spec)
+
+    @classmethod
+    def from_mesh(cls, cfg, params, ctx: DistContext, *,
+                  inter_axis: str = "dcn", axis: str = "tp",
+                  backend: str = "xla", max_seq: int = 256,
+                  page_size: int, **kw) -> "DisaggServingEngine":
+        """Build both role engines from one 2-axis mesh: slice 0 of
+        ``inter_axis`` prefills, slice 1 decodes (weights replicated into
+        each role — the disagg deployment shape)."""
+        pctx, dctx = split_roles(ctx, inter_axis=inter_axis, axis=axis)
+        pe = Engine(cfg, params, pctx, axis=axis, backend=backend,
+                    max_seq=max_seq)
+        de = Engine(cfg, params, dctx, axis=axis, backend=backend,
+                    max_seq=max_seq, page_size=page_size)
+        return cls(pe, de, **kw)
+
+    # -- prefill lane on the prefill role ------------------------------------
+    def _put_prefill(self, tree):
+        mesh = self.prefill_engine.ctx.mesh
+        specs = kv_cache_specs(self.prefill_engine.shard_axes)
+        return jax.device_put(
+            tree, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                               is_leaf=lambda x: isinstance(x, P)))
+
+    def _prefill_lane(self):
+        if not self.disagg_active:
+            return super()._prefill_lane()
+        return (self.prefill_engine, self._pslice_jit(),
+                self._plogits_jit())
+
+    def _pslice_jit(self):
+        from triton_distributed_tpu.models.dense import dense_prefill_slice
+
+        key = "pf_slice_p"
+        if key not in self._jits:
+            eng = self.prefill_engine
+            mode = eng._decode_mode()
+            tiles = eng._flash_tiles(self.chunk, self.s_buf)
+            extra = ({"inter_axis": eng.inter_axis, "n_inter": eng.n_inter}
+                     if eng.hierarchical else {})
+
+            def step(params, ids, cache, start):
+                return dense_prefill_slice(
+                    params, self.cfg, ids, cache, start, axis=eng.axis,
+                    num_ranks=eng.n, mode=mode, flash_tiles=tiles, **extra)
+
+            fn = eng._shard(step, in_specs=(eng.param_specs, P(),
+                                            kv_cache_specs(eng.shard_axes),
+                                            P()),
+                            out_specs=(P(), kv_cache_specs(eng.shard_axes)))
+            self._jits[key] = self._first_call(
+                key, jax.jit(fn, donate_argnums=(2,)),
+                "disagg_prefill", eng=eng)
+        return self._jits[key]
+
+    def _plogits_jit(self):
+        from triton_distributed_tpu.models import sampling
+        from triton_distributed_tpu.models.dense import dense_last_logits
+
+        key = "pf_logits_p"
+        if key not in self._jits:
+            eng = self.prefill_engine
+            extra = ({"inter_axis": eng.inter_axis, "n_inter": eng.n_inter}
+                     if eng.hierarchical else {})
+
+            def step(params, x_last):
+                logits = dense_last_logits(params, self.cfg, x_last,
+                                           axis=eng.axis, num_ranks=eng.n,
+                                           **extra)
+                return sampling.greedy(logits)
+
+            fn = eng._shard(step, in_specs=(eng.param_specs, P()),
+                            out_specs=P())
+            self._jits[key] = self._first_call(
+                key, jax.jit(fn), "disagg_logits", eng=eng)
+        return self._jits[key]
+
+    def _pack_jit(self, n_pages: int):
+        """Paged view of the prefill buffer's first ``n_pages`` pages on
+        the PREFILL mesh — the migration stream's source snapshot."""
+        key = ("pack", n_pages)
+        if key not in self._jits:
+            L, page, s_buf = self.cfg.num_layers, self.page, self.s_buf
+
+            def pack(k, v):
+                def to_pages(x):    # (L, 1, S_buf, hkv, d)
+                    x = x[:, 0].reshape(L, s_buf // page, page,
+                                        *x.shape[3:])
+                    return x[:, :n_pages]
+
+                return to_pages(k), to_pages(v)
+
+            self._jits[key] = self._first_call(
+                key, jax.jit(pack), "disagg_pack")
+        return self._jits[key]
+
+    # -- migration ------------------------------------------------------------
+    def _complete_prefill(self, req: Request) -> None:
+        if not self.disagg_active:
+            return super()._complete_prefill(req)
+        if req.done:
+            # max_new_tokens == 1: the prefill logits produced the only
+            # token — nothing ever decodes, so nothing migrates.
+            req.advance(RequestState.RUNNING)
+            self._finish(req)
+            return
+        n_pages = -(-req.kv_len // self.page)
+        dst = self.sched.allocator.pages(req.req_id)[:n_pages]
+        kp, vp = self._pack_jit(n_pages)(self._pf_cache.k,
+                                         self._pf_cache.v)
+        bp = (self.block_pages if self.block_pages is not None
+              else -(-n_pages // 2))
+        ranges = _blocks(n_pages, bp)   # one blocking policy (migrate.py)
+        blocks = [(kp[:, s:s + c], vp[:, s:s + c]) for s, c in ranges]
+        dst_blocks = [dst[s:s + c] for s, c in ranges]
+        stream = MigrationStream(
+            req.req_id, blocks, dst_blocks, self._put_block,
+            verify=self._migrate_verify,
+            timeout_s=self._migrate_timeout_s, clock=self.clock,
+            chaos_hook=self._migrate_chaos)
+        req.advance(RequestState.MIGRATING)
+        if req.req_id in self._streams:
+            # The request was evicted mid-migration and re-admitted fast
+            # enough (single-chunk prompt) that its stale cancelled
+            # stream never reached _advance_migrations' cleanup loop —
+            # the overwrite IS that cancellation, so count it here.
+            self.migration_preemptions += 1
+        self._streams[req.req_id] = (req, stream)
+
+    def _scatter_block_jit(self, bp: int):
+        key = ("scatter_blk", bp)
+        if key not in self._jits:
+            eng = self.engine
+            kv_spec = P(None, None, None, eng.shard_axes, None)
+
+            def step(cache, kb, vb, pages):
+                kp = cache.k_pools.at[:, pages].set(
+                    kb.astype(cache.k_pools.dtype))
+                vp = cache.v_pools.at[:, pages].set(
+                    vb.astype(cache.v_pools.dtype))
+                return cache._replace(k_pools=kp, v_pools=vp)
+
+            fn = eng._shard(
+                step,
+                in_specs=(paged_cache_specs(eng.shard_axes), kv_spec,
+                          kv_spec, P()),
+                out_specs=paged_cache_specs(eng.shard_axes))
+            self._jits[key] = self._first_call(
+                key, jax.jit(fn, donate_argnums=(0,)), "disagg_scatter")
+        return self._jits[key]
+
+    def _scatter_block(self, idx: int, kv, pages) -> None:
+        k, v = kv
+        self._cache = self._scatter_block_jit(len(pages))(
+            self._cache, k, v, jnp.asarray(pages, jnp.int32))
+
+    def _advance_migrations(self) -> int:
+        if not self.disagg_active or not self._streams:
+            return 0
+        from triton_distributed_tpu import resilience
+
+        # A preempted-mid-migration request left MIGRATING (decode-pool
+        # pressure evicted it): cancel its stream — its decode pages are
+        # already freed, recompute-on-resume re-prefills + re-migrates.
+        for rid in [rid for rid, (req, _) in self._streams.items()
+                    if req.state is not RequestState.MIGRATING]:
+            del self._streams[rid]
+            self.migration_preemptions += 1
+        landed = 0
+        for rid, (req, stream) in list(self._streams.items()):
+            try:
+                done = stream.advance(self._scatter_block)
+            except Exception as exc:
+                if not resilience.is_transient(exc):
+                    raise
+                if self._observing():
+                    obs_metrics.registry().counter(
+                        obs_metrics.KV_MIGRATE_FAILURES,
+                        "migration streams failed (lost/corrupt/late "
+                        "blocks)").inc()
+                del self._streams[rid]
+                self._demote_to_monolithic(
+                    f"migration of {rid} failed: "
+                    f"{type(exc).__name__}: {str(exc)[:160]}", exc)
+                return landed
+            landed += 1
+            if done:
+                del self._streams[rid]
+                dst_flat = [p for blk in stream.dst_pages for p in blk]
+                self.migrations_log.append({
+                    "req_id": rid,
+                    # The prefill buffer's pages are always 0..n-1 in
+                    # order; the decode-side ids came from the DECODE
+                    # allocator — the page-table rewrite evidence.
+                    "src_pages": list(range(len(dst_flat))),
+                    "dst_pages": dst_flat,
+                    "pages": stream.pages_moved,
+                    "bytes": stream.bytes_moved,
+                })
+                if self._observing():
+                    stream.finish_metrics()
+                with obs_trace.span("kv.migrate.done", req=rid,
+                                    pages=stream.pages_moved,
+                                    bytes=stream.bytes_moved):
+                    pass
+                req.advance(RequestState.RUNNING)
+        return landed
+
+    # -- demote-don't-die ------------------------------------------------------
+    def _demote_to_monolithic(self, reason: str,
+                              exc: BaseException | None = None) -> None:
+        """Fall back to monolithic serving on the DECODE slice: RUNNING
+        requests keep their (valid, fully-migrated) pool pages;
+        PREFILLING/MIGRATING requests preempt — their state lives on the
+        prefill slice — and recompute through the decode engine.
+        ``TDTPU_DEMOTION_LADDER=0`` opts out: the named error
+        propagates (demotion must never mask a config the operator
+        pinned)."""
+        if os.environ.get("TDTPU_DEMOTION_LADDER", "1") == "0":
+            raise exc if exc is not None else RuntimeError(reason)
+        self.disagg_active = False
+        self.demotion_reason = reason
+        self._streams.clear()
+        recomputed = [r for r in list(self.sched.active)
+                      if r.state in (RequestState.PREFILLING,
+                                     RequestState.MIGRATING)]
+        for req in recomputed:
+            self.sched._preempt(req)
+        # The monolithic lane prefills through the decode engine: give it
+        # a fresh buffer on the DECODE mesh (the prefill-mesh one holds a
+        # preempted request's partial prompt at best).
+        mesh = self.engine.ctx.mesh
+        self._pf_cache = jax.device_put(
+            init_kv_cache(self.cfg, 1, self.s_buf),
+            jax.tree.map(lambda s: NamedSharding(mesh, s),
+                         kv_cache_specs(self.engine.shard_axes),
+                         is_leaf=lambda x: isinstance(x, P)))
+        with obs_trace.span("disagg.demotion", reason=reason,
+                            recomputed=len(recomputed)):
+            pass
+        if self._observing():
+            reg = obs_metrics.registry()
+            reg.counter(obs_metrics.DISAGG_DEMOTIONS,
+                        "disagg tier demotions to monolithic serving"
+                        ).inc()
+            if recomputed:
+                reg.counter(
+                    "tdtpu_serve_backend_demote_preemptions_total",
+                    "in-flight sequences recomputed because the "
+                    "decode backend demoted mid-serve"
+                ).inc(len(recomputed))
+        import warnings
+
+        warnings.warn(
+            f"disagg tier demoted to monolithic serving: {reason}",
+            RuntimeWarning, stacklevel=3)

@@ -1,0 +1,404 @@
+"""KV migration — paged blocks streamed prefill slice → decode slice.
+
+The transport of the disaggregated serving tier (ROADMAP open item #2,
+docs/disagg.md): a finished prefill's paged KV blocks move from the
+prefill role's pool into *free* pages of the decode role's pool over the
+DCN tier, overlapped with the decode slice's in-flight paged decode
+step. Two forms share the protocol:
+
+* :class:`MigrationStream` — the host-driven transport the
+  :class:`~triton_distributed_tpu.disagg.engine.DisaggServingEngine`
+  uses between its two role meshes: pages are packed into per-block
+  arrays on the prefill mesh, each block crosses to the decode mesh as
+  one sharded ``jax.device_put`` (XLA's DCN transfer on real slices),
+  and lands in the decode pool at the DECODE allocator's page ids —
+  the page-table rewrite: destination ids need not (and generally do
+  not) match the prefill-side ids. Double-buffered block rotation:
+  block b+1's transfer is issued before block b scatters, so with
+  async dispatch the DCN hop rides under the decode slice's step.
+  Integrity is part of the protocol: per-block checksums computed on
+  the prefill side are re-verified after landing
+  (:class:`MigrationIntegrityError` on mismatch), the block count is
+  audited at completion (:class:`MigrationError` on a lost block), and
+  a stream that sees no progress past its deadline raises
+  :class:`MigrationTimeoutError` — all three NAMED and TRANSIENT, so
+  the engine demotes to monolithic serving instead of dying.
+
+* :func:`kv_migrate_local` — the single-program shard_map form over a
+  2-axis ``(inter, intra)`` mesh, for deployments where both roles
+  share one mesh program: the prefill slice packs its pool pages into
+  a contiguous send buffer through a double-buffered Pallas DMA chain,
+  each block rides ``lax.ppermute`` over the DCN axis (the
+  ``dcn_slice_pipeline`` overlap contract: hop b+1 has no data
+  dependence on block b's scatter, so XLA runs the DCN transfer under
+  the landing DMA), and the decode slice scatters arrivals into its
+  pool at the rewritten page ids through a second aliased DMA chain.
+  This is the form the commlint registry sweeps (driver
+  ``disagg_migrate``, (2,2)/(2,4) meshes) — every DMA awaited, no
+  deadlock, delta-balanced semaphores.
+
+Env knobs: ``TDTPU_MIGRATE_TIMEOUT_MS`` (default 300 s fail-loud
+ceiling, 0 disables), ``TDTPU_MIGRATE_VERIFY`` (=0 skips checksums).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu.language.core import any_spec, kernel_call
+from triton_distributed_tpu.obs import metrics as obs_metrics
+from triton_distributed_tpu.obs import trace as obs_trace
+
+
+class MigrationError(RuntimeError):
+    """A KV-migration stream failed in a named way (lost block, integrity
+    mismatch, deadline) — TRANSIENT by design (``transient = True`` is the
+    marker ``resilience.is_transient`` honors), so the disagg engine
+    demotes to monolithic serving instead of dying mid-request."""
+
+    transient = True
+
+
+class MigrationIntegrityError(MigrationError):
+    """A migrated block's checksum on the decode side does not match the
+    checksum stamped on the prefill side — the stream delivered corrupt
+    bytes and the pages must not enter the decode batch."""
+
+
+class MigrationTimeoutError(MigrationError):
+    """The stream exceeded its migration deadline with blocks still in
+    flight — a hang converted to a structured error (the
+    resilience/deadline.py discipline, applied to the DCN transport)."""
+
+
+def migrate_timeout_s() -> float:
+    """Stream deadline budget in seconds (``TDTPU_MIGRATE_TIMEOUT_MS``,
+    default 300 s; 0 disables)."""
+    try:
+        ms = float(os.environ.get("TDTPU_MIGRATE_TIMEOUT_MS", "") or 300_000)
+    except ValueError:
+        ms = 300_000.0
+    return ms / 1e3
+
+
+def migrate_verify() -> bool:
+    return os.environ.get("TDTPU_MIGRATE_VERIFY", "1") != "0"
+
+
+def _blocks(n_pages: int, block_pages: int) -> list[tuple[int, int]]:
+    """(start, count) page ranges per block. The default caller passes
+    ``block_pages = ceil(n_pages / 2)`` — two blocks, the classic double
+    buffer: block 1 crosses DCN while block 0 scatters."""
+    return [(s, min(block_pages, n_pages - s))
+            for s in range(0, n_pages, block_pages)]
+
+
+class MigrationStream:
+    """One request's paged KV blocks in flight, prefill pool → decode
+    pool (host-driven transport between the two role meshes).
+
+    Args:
+      blocks_kv: per-block ``(k, v)`` arrays already packed on the
+        PREFILL mesh — ``(L, bp, page, hkv, d)`` each (the caller
+        snapshots them from its prefill buffer so the shared buffer can
+        take the next prompt while this stream drains).
+      dst_pages: decode-pool page ids per block (the DECODE allocator's
+        ids, in block order) — the page-table rewrite target.
+      put: ``put(tree) -> tree`` moving a (k, v) pair onto the decode
+        mesh with the pool's sharding — the DCN hop.
+      chaos_hook: fault-injection point for the chaos plane
+        (resilience/chaos.py): called per landed block as
+        ``hook(block_idx, (k, v)) -> (k, v) | None`` — ``None`` models a
+        dropped block, a mutated pair models corruption, a sleeping hook
+        models DCN delay. ``None`` (default) = no injection.
+    """
+
+    def __init__(self, req_id: str, blocks_kv: Sequence[tuple],
+                 dst_pages: Sequence[Sequence[int]], put: Callable,
+                 *, verify: bool | None = None,
+                 timeout_s: float | None = None,
+                 clock=time.perf_counter,
+                 chaos_hook: Callable | None = None):
+        if len(blocks_kv) != len(dst_pages):
+            raise ValueError(
+                f"migration stream for {req_id}: {len(blocks_kv)} blocks "
+                f"but {len(dst_pages)} destination page groups")
+        self.req_id = req_id
+        self.n_blocks = len(blocks_kv)
+        self.dst_pages = [list(p) for p in dst_pages]
+        self.verify = migrate_verify() if verify is None else verify
+        self.timeout_s = (migrate_timeout_s() if timeout_s is None
+                          else timeout_s)
+        self.clock = clock
+        self.t_start = clock()
+        self.bytes_moved = 0
+        self.pages_moved = 0
+        self._put = put
+        self._chaos = chaos_hook
+        self._pending = list(enumerate(blocks_kv))   # not yet sent
+        self._in_flight: list = []                   # sent, not landed
+        self._landed = 0
+        self._checksums: dict[int, float] = {}
+        if self.verify:
+            for i, (k, v) in enumerate(blocks_kv):
+                # f32 sum of both halves: bit-stable across the DCN hop
+                # (the transfer moves bytes, not math), so any flipped
+                # payload shows up as a sum mismatch on the decode side.
+                self._checksums[i] = float(
+                    jnp.sum(k, dtype=jnp.float32)
+                    + jnp.sum(v, dtype=jnp.float32))
+
+    @property
+    def done(self) -> bool:
+        return not self._pending and not self._in_flight
+
+    def _check_deadline(self) -> None:
+        if self.timeout_s and self.clock() - self.t_start > self.timeout_s:
+            raise MigrationTimeoutError(
+                f"migration of {self.req_id} exceeded its deadline "
+                f"({self.timeout_s:g} s) with "
+                f"{len(self._pending) + len(self._in_flight)} of "
+                f"{self.n_blocks} blocks unlanded — a wedged DCN stream "
+                "must become a named error, never a hang "
+                "(TDTPU_MIGRATE_TIMEOUT_MS)")
+
+    def advance(self, scatter: Callable) -> bool:
+        """One double-buffer rotation: issue the next block's DCN
+        transfer, then land the OLDEST in-flight block through
+        ``scatter(block_idx, (k, v), dst_pages)`` (which folds it into
+        the decode pool) — so one block is always crossing while the
+        previous scatters. Returns ``done``. Raises the named
+        :class:`MigrationError` family on loss/corruption/deadline."""
+        self._check_deadline()
+        if self._pending:
+            idx, (k, v) = self._pending.pop(0)
+            with obs_trace.span("kv.migrate", req=self.req_id, block=idx,
+                                pages=len(self.dst_pages[idx])):
+                landed = self._put((k, v))
+            self._in_flight.append((idx, landed))
+        # Land a block once the pipeline is primed (or draining): with
+        # two in flight the oldest has had a full rotation to cross.
+        if self._in_flight and (len(self._in_flight) >= 2
+                                or not self._pending):
+            idx, kv = self._in_flight.pop(0)
+            if self._chaos is not None:
+                kv = self._chaos(idx, kv)
+                self._check_deadline()     # a delaying hook can expire it
+            if kv is None:
+                raise MigrationError(
+                    f"migration of {self.req_id}: block {idx} lost in "
+                    f"transit ({self._landed} of {self.n_blocks} landed) "
+                    "— stream incomplete, pages must not join the "
+                    "decode batch")
+            k, v = kv
+            if self.verify:
+                got = float(jnp.sum(k, dtype=jnp.float32)
+                            + jnp.sum(v, dtype=jnp.float32))
+                want = self._checksums[idx]
+                if got != want:
+                    raise MigrationIntegrityError(
+                        f"migration of {self.req_id}: block {idx} "
+                        f"checksum mismatch after the DCN hop "
+                        f"(sent {want!r}, landed {got!r}) — corrupt "
+                        "payload detected before entering the decode "
+                        "pool")
+            scatter(idx, (k, v), self.dst_pages[idx])
+            self._landed += 1
+            self.pages_moved += len(self.dst_pages[idx])
+            self.bytes_moved += int(k.size * k.dtype.itemsize
+                                    + v.size * v.dtype.itemsize)
+        if self.done and self._landed != self.n_blocks:
+            raise MigrationError(
+                f"migration of {self.req_id}: only {self._landed} of "
+                f"{self.n_blocks} blocks landed — stream incomplete")
+        return self.done
+
+    def finish_metrics(self) -> None:
+        """Publish the completed stream into the migration lane
+        (docs/observability.md) — called by the engine under an active
+        obs run only."""
+        reg = obs_metrics.registry()
+        reg.counter(obs_metrics.KV_MIGRATIONS,
+                    "completed prefill->decode KV migrations").inc()
+        reg.counter(obs_metrics.KV_MIGRATE_BYTES,
+                    "KV bytes streamed prefill slice -> decode slice "
+                    "over DCN").inc(self.bytes_moved)
+        reg.counter(obs_metrics.KV_MIGRATE_PAGES,
+                    "KV pages streamed prefill slice -> decode slice"
+                    ).inc(self.pages_moved)
+        reg.histogram(
+            obs_metrics.KV_MIGRATE_LATENCY_MS,
+            "whole-stream migration latency (pack -> last block "
+            "scattered), ms",
+            buckets=obs_metrics.MIGRATE_BUCKETS_MS,
+        ).observe((self.clock() - self.t_start) * 1e3)
+
+
+# ---------------------------------------------------------------------------
+# The single-program shard_map form (the commlint-swept protocol).
+# ---------------------------------------------------------------------------
+
+def _pack_kernel(page_rows: int, pages: tuple, drop_last_wait: bool,
+                 pool_ref, out_ref, sems):
+    """Gather ``pages`` of the (flattened) pool into a contiguous send
+    buffer through a double-buffered local-DMA chain: copy i+1 starts
+    before copy i-1's wait retires, two DMA semaphores rotating — the
+    pipelined pack the real migration engine would run on TPU.
+
+    ``drop_last_wait`` exists ONLY for the seeded-violation test (an
+    un-awaited DMA the commlint sweep must catch); library callers pass
+    False."""
+    handles = {}
+    for i, p in enumerate(pages):
+        if i >= 2:
+            handles.pop(i - 2).wait()
+        cp = pltpu.make_async_copy(
+            pool_ref.at[pl.ds(p * page_rows, page_rows)],
+            out_ref.at[pl.ds(i * page_rows, page_rows)],
+            sems.at[i % 2])
+        cp.start()
+        handles[i] = cp
+    drain = sorted(handles)
+    if drop_last_wait and drain:
+        drain = drain[:-1]                 # seeded bug: one DMA unawaited
+        handles.pop(sorted(handles)[-1])
+    for i in drain:
+        handles.pop(i).wait()
+
+
+def _scatter_kernel(page_rows: int, pages: tuple, buf_ref, pool_in_ref,
+                    pool_out_ref, sems, thru_sem):
+    """Scatter the landed buffer into the pool at the REWRITTEN page ids
+    (``pages`` are the decode allocator's, not the sender's) through the
+    same double-buffered chain. The pool copies through whole (one DMA)
+    so the op stays functional — pool_in is never consumed, which keeps
+    the SPMD slice-gating select at the end of :func:`kv_migrate_local`
+    legal (a production TPU build would alias input->output and thread
+    the pool linearly instead)."""
+    thru = pltpu.make_async_copy(pool_in_ref, pool_out_ref, thru_sem)
+    thru.start()
+    thru.wait()
+    handles = {}
+    for i, p in enumerate(pages):
+        if i >= 2:
+            handles.pop(i - 2).wait()
+        cp = pltpu.make_async_copy(
+            buf_ref.at[pl.ds(i * page_rows, page_rows)],
+            pool_out_ref.at[pl.ds(p * page_rows, page_rows)],
+            sems.at[i % 2])
+        cp.start()
+        handles[i] = cp
+    for i in sorted(handles):
+        handles.pop(i).wait()
+
+
+def kv_migrate_local(pool_src: jax.Array, pool_dst: jax.Array,
+                     src_pages: Sequence[int], dst_pages: Sequence[int],
+                     *, inter_axis: str = "dcn",
+                     n_inter: int | None = None,
+                     src_slice: int = 0, dst_slice: int = 1,
+                     block_pages: int | None = None,
+                     page_rows: int | None = None,
+                     _drop_pack_wait: bool = False) -> jax.Array:
+    """Device-local KV-page migration inside a shard_map over a 2-axis
+    ``(inter, intra)`` mesh: the ``src_slice`` packs ``src_pages`` of its
+    pool, blocks ride ``lax.ppermute`` over ``inter_axis`` (the DCN hop),
+    and the ``dst_slice`` scatters each arrival into its pool at
+    ``dst_pages`` — the page-table rewrite, ids independent of the
+    sender's. Head-sharding over the intra axis is preserved: each intra
+    rank exchanges with the SAME intra rank of the peer slice, so no
+    intra-slice communication is needed (the pool's kv-head shard layout
+    matches on both roles).
+
+    pool_src/pool_dst: ``(P · page_rows, C)`` flattened page pools (the
+    caller reshapes model pools to 2-D rows; ``page_rows`` — required —
+    is the row count of one page in that flattening; the two pools may
+    hold different page counts). Returns the updated ``pool_dst``
+    (unchanged rows preserved; non-dst slices return their input pool
+    untouched).
+
+    Overlap contract (the ``dcn_slice_pipeline`` skeleton,
+    ops/hierarchical.py): block b+1's ppermute has no data dependence on
+    block b's scatter DMA, so XLA schedules the next DCN transfer under
+    the landing chain — the decode slice's in-flight compute is never
+    barriered on the whole stream.
+    """
+    if n_inter is None:
+        raise ValueError("n_inter required inside shard_map")
+    if page_rows is None:
+        raise ValueError("page_rows required (rows per page in the "
+                         "flattened 2-D pool)")
+    src_pages = tuple(int(p) for p in src_pages)
+    dst_pages = tuple(int(p) for p in dst_pages)
+    if len(src_pages) != len(dst_pages):
+        raise ValueError(
+            f"src_pages ({len(src_pages)}) and dst_pages "
+            f"({len(dst_pages)}) must pair one-to-one")
+    if not src_pages:
+        return pool_dst
+    n_pages = len(src_pages)
+    for name, ids, pool in (("src_pages", src_pages, pool_src),
+                            ("dst_pages", dst_pages, pool_dst)):
+        cap = pool.shape[0] // page_rows
+        bad = [p for p in ids if not 0 <= p < cap]
+        if bad:
+            raise ValueError(f"{name} {bad} outside the pool's "
+                             f"{cap} pages")
+    if len(set(dst_pages)) != n_pages:
+        raise ValueError(f"duplicate destination page in {dst_pages}")
+    bp = block_pages if block_pages is not None else -(-n_pages // 2)
+    if bp < 1:
+        raise ValueError(f"block_pages = {bp} invalid: a block moves at "
+                         "least one page")
+    cols = pool_src.shape[1]
+    me_inter = jax.lax.axis_index(inter_axis)
+    perm = ((src_slice, dst_slice),)
+
+    def pack(pages):
+        kernel = functools.partial(_pack_kernel, page_rows, pages,
+                                   _drop_pack_wait)
+        return kernel_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(
+                (len(pages) * page_rows, cols), pool_src.dtype),
+            in_specs=[any_spec()],
+            out_specs=any_spec(),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((2,))],
+        )(pool_src)
+
+    def scatter(pool, buf, pages):
+        kernel = functools.partial(_scatter_kernel, page_rows, pages)
+        return kernel_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(pool.shape, pool.dtype),
+            in_specs=[any_spec(), any_spec()],
+            out_specs=any_spec(),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((2,)),
+                            pltpu.SemaphoreType.DMA(())],
+        )(buf, pool)
+
+    # Double-buffered block rotation: pack block b+1 and launch its DCN
+    # hop while block b's scatter chain lands — SPMD-uniform (every rank
+    # packs/scatters; only the dst slice's pool result is kept below, the
+    # ppermute zero-fills every other slice's landing buffer).
+    blocks = _blocks(n_pages, bp)
+    out = pool_dst
+    landed_prev = None
+    for (s, c) in blocks:
+        sent = jax.lax.ppermute(pack(src_pages[s:s + c]), inter_axis, perm)
+        if landed_prev is not None:
+            (ps, pc), buf = landed_prev
+            out = scatter(out, buf, dst_pages[ps:ps + pc])
+        landed_prev = ((s, c), sent)
+    (ps, pc), buf = landed_prev
+    out = scatter(out, buf, dst_pages[ps:ps + pc])
+    keep = (me_inter == dst_slice)
+    return jnp.where(keep, out, pool_dst)

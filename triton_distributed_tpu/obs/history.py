@@ -104,6 +104,11 @@ METRICS: tuple[MetricSpec, ...] = (
                "serving tokens/s (megakernel paged lane, same window as "
                "the xla rung)",
                " tok/s", "higher", "serving"),
+    MetricSpec("serve_tokens_per_s_disagg",
+               "serving tokens/s (disaggregated prefill/decode roles, "
+               "KV migration included, same window as the monolithic "
+               "rung)",
+               " tok/s", "higher", "serving"),
 )
 
 METRIC_BY_KEY = {m.key: m for m in METRICS}

@@ -56,6 +56,24 @@ SERVING_SERIES = (SERVE_TTFT_MS, SERVE_TPOT_MS, SERVE_QUEUE_DEPTH,
                   SERVE_PREEMPTIONS, SERVE_REJECTS, SERVE_FINISHED,
                   SERVE_TOKENS_PER_S)
 
+# KV-migration lane (disaggregated prefill/decode tier, docs/disagg.md):
+# published by disagg/migrate.py + disagg/engine.py, rendered as
+# obs.report's migration section. A migration spans queueing + every
+# block hop over DCN — decode-step-scale buckets would saturate.
+KV_MIGRATE_BYTES = "tdtpu_kv_migrate_bytes_total"
+KV_MIGRATE_LATENCY_MS = "tdtpu_kv_migrate_latency_ms"
+KV_MIGRATIONS = "tdtpu_kv_migrations_total"
+KV_MIGRATE_FAILURES = "tdtpu_kv_migrate_failures_total"
+KV_MIGRATE_PAGES = "tdtpu_kv_migrate_pages_total"
+DISAGG_DEMOTIONS = "tdtpu_disagg_demotions_total"
+
+MIGRATE_BUCKETS_MS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+                      250.0, 500.0, 1000.0, 5000.0)
+
+MIGRATION_SERIES = (KV_MIGRATE_LATENCY_MS, KV_MIGRATE_BYTES,
+                    KV_MIGRATE_PAGES, KV_MIGRATIONS, KV_MIGRATE_FAILURES,
+                    DISAGG_DEMOTIONS)
+
 
 def _fmt_labels(labels: dict[str, str] | None) -> str:
     if not labels:

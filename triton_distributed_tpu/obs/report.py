@@ -334,6 +334,10 @@ def summarize(run_dir: str, lanes: dict, metrics: dict | None,
     if serving:
         lines.append("")
         lines += serving
+    migration = migration_lane(metrics)
+    if migration:
+        lines.append("")
+        lines += migration
     return "\n".join(lines)
 
 
@@ -361,6 +365,38 @@ def serving_lane(metrics: dict | None) -> list[str]:
         else:
             lines.append(f"  {name} = {m['value']:g}")
     return lines
+
+
+def migration_lane(metrics: dict | None) -> list[str]:
+    """The KV-migration summary section (docs/disagg.md) — rendered
+    whenever the snapshot carries any disagg-tier series."""
+    from triton_distributed_tpu.obs import metrics as obs_metrics
+
+    present = [n for n in obs_metrics.MIGRATION_SERIES
+               if n in (metrics or {})]
+    if not present:
+        return []
+    lines = ["kv migration (disagg tier, docs/disagg.md):"]
+    fmt = lambda x: f"{x:.3f}" if x is not None else "—"  # noqa: E731
+    for name in obs_metrics.MIGRATION_SERIES:
+        m = (metrics or {}).get(name)
+        if m is None:
+            continue
+        if m["type"] == "histogram":
+            lines.append(
+                f"  {name}: n={m['count']} p50={fmt(m.get('p50'))} "
+                f"p99={fmt(m.get('p99'))}")
+        else:
+            lines.append(f"  {name} = {m['value']:g}")
+    return lines
+
+
+def migration_failure_count(metrics: dict | None) -> float:
+    """Failed migration streams recorded in a snapshot (0 when absent)."""
+    from triton_distributed_tpu.obs import metrics as obs_metrics
+
+    m = (metrics or {}).get(obs_metrics.KV_MIGRATE_FAILURES) or {}
+    return float(m.get("value") or 0.0)
 
 
 def preemption_count(metrics: dict | None) -> float:
@@ -495,6 +531,11 @@ def main(argv: list[str] | None = None) -> int:
                          "--check (by default preemptions recorded under "
                          "a CLEAN SLO section fail: eviction with no "
                          "pressure signal means the pool is mis-sized)")
+    ap.add_argument("--allow-migration-failures", action="store_true",
+                    help="report failed KV-migration streams without "
+                         "failing --check (by default a failed stream "
+                         "in the snapshot fails the migration lane — "
+                         "each one demoted the disagg tier)")
     args = ap.parse_args(argv)
 
     if args.dryrun:
@@ -576,6 +617,13 @@ def main(argv: list[str] | None = None) -> int:
             f"serving: {preemptions:g} preemption(s) under a clean SLO "
             "section — the page pool evicted work with no pressure "
             "signal (--allow-preemptions to accept)")
+    migrate_failures = migration_failure_count(metrics)
+    if migrate_failures and not args.allow_migration_failures:
+        failures.append(
+            f"migration: {migrate_failures:g} failed KV-migration "
+            "stream(s) in the snapshot — each demoted the disagg tier "
+            "to monolithic serving (--allow-migration-failures to "
+            "accept)")
     if failures:
         for msg in failures:
             print(f"CHECK FAIL: {msg}", file=sys.stderr)

@@ -65,6 +65,13 @@ def is_transient(exc: BaseException) -> bool:
     if isinstance(exc, (FaultInjectionError, CommTimeoutError,
                         BackendUnsupportedError)):
         return True
+    # Duck-typed marker for error classes defined in packages layered
+    # ABOVE this one (importing them here would cycle): the disagg tier's
+    # MigrationError family (disagg/migrate.py) stamps ``transient =
+    # True`` so a lost/corrupted/late KV-migration stream demotes to the
+    # monolithic serving path instead of dying (docs/disagg.md).
+    if getattr(type(exc), "transient", False):
+        return True
     # Errors from inside the traced/compiled step carry jax's trace-time
     # or runtime wrapper in their chain (XlaRuntimeError from jaxlib,
     # JaxStackTraceBeforeTransformation on any error raised mid-trace,

@@ -380,6 +380,132 @@ def megakernel_serve_selftest() -> list[CaseResult]:
 
 
 # ---------------------------------------------------------------------------
+# Disagg serving-lane rows (round 10): migration fault -> demotion to
+# monolithic serving with token parity (docs/disagg.md).
+# ---------------------------------------------------------------------------
+
+def disagg_serve_selftest() -> list[CaseResult]:
+    """Three rows per --all sweep: drop / delay / corrupt injected into
+    the KV-migration stream of a :class:`DisaggServingEngine`. Each
+    fault must surface as the NAMED transient MigrationError family
+    (lost block / deadline / checksum mismatch), demote the tier to
+    monolithic serving through the PR-6 demote-don't-die discipline, and
+    still finish every request token-identical to a sequential xla serve
+    (greedy parity is the corruption oracle)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from triton_distributed_tpu.disagg import (
+        DisaggServingEngine, MigrationError, MigrationIntegrityError,
+        MigrationTimeoutError, role_contexts,
+    )
+    from triton_distributed_tpu.models import Engine, init_dense_llm
+    from triton_distributed_tpu.models.config import tiny_config
+    from triton_distributed_tpu.runtime import initialize_distributed
+
+    cfg = tiny_config()
+    params = init_dense_llm(jax.random.PRNGKey(5), cfg)
+    ctx1 = initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                  devices=jax.devices()[:1])
+    prompts = [[5, 77, 131, 9, 40, 2], [200, 9, 31, 7]]
+    gens = [4, 3]
+    oracle = Engine(cfg, params, ctx1, backend="xla", max_seq=64)
+    golden = [np.asarray(oracle.serve(jnp.asarray([p], jnp.int32),
+                                      gen_len=g))[0].tolist()
+              for p, g in zip(prompts, gens)]
+
+    def build(timeout_s=None):
+        pctx, dctx = role_contexts(jax.devices()[:2])
+        pe = Engine(cfg, params, pctx, backend="xla", max_seq=64)
+        de = Engine(cfg, params, dctx, backend="xla", max_seq=64,
+                    page_size=4)
+        return DisaggServingEngine(pe, de, max_batch=2, prefill_chunk=4,
+                                   block_pages=1,
+                                   migrate_timeout_s=timeout_s)
+
+    def serve_all(se):
+        reqs = []
+        for i, (p, g) in enumerate(zip(prompts, gens)):
+            req, res = se.submit(p, g, req_id=f"chaos-dg-{i}")
+            assert res.name == "ADMITTED", res
+            reqs.append(req)
+        se.run(max_iters=2000)
+        return reqs
+
+    def hook_drop(se):
+        def hook(idx, kv):
+            return None if idx == 0 else kv
+
+        return hook
+
+    def hook_corrupt(se):
+        def hook(idx, kv):
+            if idx != 0:
+                return kv
+            k, v = kv
+            return k.at[(0,) * k.ndim].add(1024.0), v
+
+        return hook
+
+    def hook_delay(se):
+        # Deterministic delay model: age every in-flight stream past its
+        # deadline budget (a block "took longer than the budget"), so
+        # the post-hook deadline check converts the delay to the named
+        # timeout — no wall-clock race with CI jit-compile noise.
+        def hook(idx, kv):
+            for _req, stream in list(se._streams.values()):
+                stream.t_start -= stream.timeout_s + 1.0
+            return kv
+
+        return hook
+
+    rows = [
+        ("migrate_drop_block", hook_drop, MigrationError),
+        ("migrate_corrupt_payload", hook_corrupt, MigrationIntegrityError),
+        ("migrate_delay_deadline", hook_delay, MigrationTimeoutError),
+    ]
+
+    cases = []
+    for fault_name, make_hook, want_exc in rows:
+        t0 = time.time()
+        diags: list[str] = []
+        fired = {"n": 0}
+
+        try:
+            se = build()
+            hook = make_hook(se)
+
+            def counting(idx, kv, _h=hook):
+                fired["n"] += 1
+                return _h(idx, kv)
+
+            se._migrate_chaos = counting
+            reqs = serve_all(se)
+            demoted = not se.disagg_active
+            named = (se.demotion_reason is not None
+                     and want_exc.__name__ in se.demotion_reason)
+            parity = all(r.tokens == golden[i]
+                         for i, r in enumerate(reqs))
+            finished = all(r.state.name == "FINISHED" for r in reqs)
+            diags += [f"hook fired: {fired['n']}",
+                      f"demotion reason: {se.demotion_reason}",
+                      f"parity vs sequential xla serve: {parity}"]
+            verdict = ("detected" if fired["n"] and demoted and named
+                       and parity and finished else "error")
+        except Exception as exc:                    # died = the failure
+            verdict = "error"
+            diags.append(f"{type(exc).__name__}: {exc}")
+        cases.append(CaseResult(
+            op="disagg_serve", mesh="1+1", fault=fault_name,
+            verdict=verdict, detected_by="demotion",
+            expected=("detected",), ok=verdict == "detected", n_fired=1,
+            n_violations=0, diagnostics=diags,
+            elapsed_s=round(time.time() - t0, 3)))
+    return cases
+
+
+# ---------------------------------------------------------------------------
 # Sweep + CLI.
 # ---------------------------------------------------------------------------
 
@@ -425,6 +551,13 @@ def sweep(ops, faults, ranks, *, seed: int = 0,
         # parity through the PR-6 ladder. --all sweeps only (two real
         # serving runs each — too heavy for single-op invocations).
         for case in megakernel_serve_selftest():
+            cases.append(case)
+            failed += not case.ok
+            _print_case(case, verbose)
+        # Disagg serving-lane rows (round 10): drop/delay/corrupt on the
+        # KV-migration stream -> named transient MigrationError ->
+        # demotion to monolithic serving with token parity.
+        for case in disagg_serve_selftest():
             cases.append(case)
             failed += not case.ok
             _print_case(case, verbose)

@@ -324,6 +324,73 @@ def dryrun(json_path: str | None) -> int:
         "all_finished": mk_report["all_finished"],
     }
 
+    # Phase 5 (round 10) — disaggregated tier (docs/disagg.md): the same
+    # per-request parity contract with prefill and decode on SEPARATE
+    # role meshes and every finished prefill crossing a KV-migration
+    # stream (checksummed, double-buffered, decode-side page ids from
+    # the DECODE allocator), including one request preempted DURING its
+    # migration and resumed by recompute.
+    from triton_distributed_tpu.disagg import (
+        DisaggServingEngine, role_contexts,
+    )
+    from triton_distributed_tpu.models import Engine as _Engine
+
+    pctx, dctx = role_contexts(jax.devices()[:2])
+    dg_cfg = engine.cfg
+    dg_params = engine.params
+    dg_pe = _Engine(dg_cfg, dg_params, pctx, backend="xla", max_seq=64)
+    dg_de = _Engine(dg_cfg, dg_params, dctx, backend="xla", max_seq=64,
+                    page_size=4)
+    se5 = DisaggServingEngine(dg_pe, dg_de, max_batch=2, num_pages=5,
+                              prefill_chunk=4, block_pages=1)
+    dg_trace = [
+        # High-priority long decode: its page growth drains the pool.
+        {"req_id": "dg-0", "arrival_iter": 0,
+         "prompt": list(range(10, 16)), "max_new_tokens": 10,
+         "priority": 1},
+        # Low-priority 3-page prompt: 3 migration blocks at block_pages=1
+        # — the eviction window the preempt-during-migration proof needs.
+        {"req_id": "dg-1", "arrival_iter": 1,
+         "prompt": list(range(30, 42)), "max_new_tokens": 4,
+         "priority": 0},
+        # Late 1-page arrival: admits behind dg-1's resumed allocation,
+        # so its migration lands at a non-zero decode page id — the
+        # page-table-rewrite evidence (src pages are always 0..n-1).
+        {"req_id": "dg-2", "arrival_iter": 2,
+         "prompt": list(range(50, 54)), "max_new_tokens": 2,
+         "priority": 0},
+    ]
+    dg_report = run_trace(se5, dg_trace)
+    dg_reqs = dg_report.pop("requests")
+    dg_golden = sequential_reference(engine, dg_trace)
+    dg_mismatch = [r.req_id for r in dg_reqs
+                   if r.tokens != dg_golden[r.req_id]]
+    if not se5.disagg_active:
+        failures.append(
+            f"disagg tier silently demoted ({se5.demotion_reason!r}) — "
+            "the parity it reported is the monolithic path's")
+    if dg_mismatch:
+        failures.append("disagg token parity broken vs sequential "
+                        f"serve: {dg_mismatch}")
+    if se5.migration_preemptions < 1:
+        failures.append(
+            "no request was preempted DURING its KV migration — the "
+            "pool sizing no longer exercises the mid-stream eviction "
+            "round-trip")
+    rewrites = [m for m in se5.migrations_log
+                if m["src_pages"] != m["dst_pages"]]
+    if not rewrites:
+        failures.append(
+            "every migration landed at identity page ids — the "
+            "page-table rewrite is no longer exercised")
+    report["disagg"] = {
+        "parity_ok": not dg_mismatch,
+        "migrations": len(se5.migrations_log),
+        "migration_preemptions": se5.migration_preemptions,
+        "page_id_rewrites": len(rewrites),
+        "all_finished": dg_report["all_finished"],
+    }
+
     report["failures"] = failures
     if json_path:
         with open(json_path, "w") as f:
@@ -341,6 +408,16 @@ def dryrun(json_path: str | None) -> int:
 # ---------------------------------------------------------------------------
 # The TPU bench rung (bench.py).
 # ---------------------------------------------------------------------------
+
+def _bench_shard_config():
+    """The Qwen3-8B TP=8 PER-DEVICE shard shape every serving rung
+    measures — ONE definition, so the monolithic, megakernel and disagg
+    rows always race identical models (they are gate-compared)."""
+    from triton_distributed_tpu.models.config import ModelConfig
+
+    return ModelConfig(hidden_size=4096, intermediate_size=1536,
+                       num_layers=36, num_heads=4, num_kv_heads=1,
+                       head_dim=128, vocab_size=151936, qk_norm=True)
 
 def serving_bench_rung(n_streams: int = 8, prompt_len: int = 128,
                        max_new: int = 16, *, backend: str = "xla",
@@ -360,14 +437,11 @@ def serving_bench_rung(n_streams: int = 8, prompt_len: int = 128,
     import jax.random as jrandom
 
     from triton_distributed_tpu.models import Engine
-    from triton_distributed_tpu.models.config import ModelConfig
     from triton_distributed_tpu.models.dense import init_dense_llm
     from triton_distributed_tpu.runtime import initialize_distributed
     from triton_distributed_tpu.serving.loop import ServingEngine
 
-    cfg = ModelConfig(hidden_size=4096, intermediate_size=1536,
-                      num_layers=36, num_heads=4, num_kv_heads=1,
-                      head_dim=128, vocab_size=151936, qk_norm=True)
+    cfg = _bench_shard_config()
     params = init_dense_llm(jrandom.PRNGKey(0), cfg)
     ctx1 = initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
                                   devices=jax.devices()[:1])
@@ -397,6 +471,66 @@ def serving_bench_rung(n_streams: int = 8, prompt_len: int = 128,
                       "scheduler + per-iteration dispatch included — "
                       "the serving tier's real cost, unlike the pure "
                       "decode-chain rungs",
+    }
+
+
+def disagg_serving_bench_rung(n_streams: int = 8, prompt_len: int = 128,
+                              max_new: int = 16, *,
+                              page_size: int = 64) -> dict:
+    """The disaggregated tier's rung (round 10, docs/disagg.md): the
+    same open-loop workload as :func:`serving_bench_rung`, served
+    through a :class:`~triton_distributed_tpu.disagg.engine.
+    DisaggServingEngine` — prefill role on the first device, decode role
+    on the second (falling back to one shared device on single-chip
+    hosts), every finished prefill crossing a checksummed KV-migration
+    stream. bench.py races it against the monolithic rung in the SAME
+    window (`serve_tokens_per_s_disagg`); the number includes the full
+    migration cost — that is what disaggregation buys or pays."""
+    import jax
+    import jax.random as jrandom
+
+    from triton_distributed_tpu.disagg import (
+        DisaggServingEngine, role_contexts,
+    )
+    from triton_distributed_tpu.models import Engine
+    from triton_distributed_tpu.models.dense import init_dense_llm
+
+    cfg = _bench_shard_config()
+    params = init_dense_llm(jrandom.PRNGKey(0), cfg)
+    pctx, dctx = role_contexts(jax.devices()[:2])
+    pe = Engine(cfg, params, pctx, backend="xla", max_seq=512)
+    de = Engine(cfg, params, dctx, backend="xla", max_seq=512,
+                page_size=page_size)
+    se = DisaggServingEngine(pe, de, max_batch=n_streams,
+                             prefill_chunk=128)
+    spec = LoadSpec(n_requests=n_streams, seed=0,
+                    prompt_len=(prompt_len, prompt_len),
+                    max_new=(max_new, max_new),
+                    mean_interarrival_iters=0.0, vocab=cfg.vocab_size)
+    run_trace(se, build_trace(spec))                       # warmup/compile
+    if not se.disagg_active:
+        # The rung prices the role-split path; a demoted run would
+        # mislabel the ledger row as disagg throughput.
+        raise RuntimeError(
+            f"disagg tier demoted during warmup "
+            f"({se.demotion_reason!r}) — rung not measurable")
+    spec2 = dataclasses.replace(spec, seed=1)
+    report = run_trace(se, build_trace(spec2))
+    report.pop("requests")
+    if not se.disagg_active:
+        raise RuntimeError(
+            f"disagg tier demoted mid-measurement "
+            f"({se.demotion_reason!r}) — rung not measurable")
+    two_dev = pe.ctx.mesh.devices.ravel()[0] != de.ctx.mesh.devices.ravel()[0]
+    return {
+        "serve_tokens_per_s_disagg": report["tokens_per_s"],
+        "serve_ttft_p99_ms_disagg": report["ttft_p99_ms"],
+        "serve_disagg_migrations": len(se.migrations_log),
+        "serve_disagg_comm": (
+            f"prefill/decode roles on "
+            f"{'two chips (KV blocks cross device_put/DCN)' if two_dev else 'one shared chip (degenerate roles)'}"
+            "; checksummed double-buffered migration included in the "
+            "number"),
     }
 
 

@@ -264,12 +264,14 @@ class ServingEngine:
             raise BackendUnsupportedError(reason)
 
     # -- jitted pieces ------------------------------------------------------
-    def _first_call(self, key, fn, what: str):
+    def _first_call(self, key, fn, what: str, eng=None):
         """The engine's first-call compile routing, against THIS tier's
         jit cache: the first invocation runs under a ``jit_compile`` span
         and flags the wall time as compile-dominated, then the raw
-        executable replaces the wrapper in ``self._jits``."""
-        eng = self.engine
+        executable replaces the wrapper in ``self._jits``. ``eng``: the
+        engine whose compile flag the call stamps (the disagg tier's
+        prefill-lane jits pass their prefill engine)."""
+        eng = eng if eng is not None else self.engine
 
         def first(*args):
             eng._jit_compiled_last_call = True
@@ -411,6 +413,12 @@ class ServingEngine:
         prefilled = None
         if head is not None:
             prefilled = self._prefill_slice(head)
+        # Disagg hook (docs/disagg.md): between the prefill slice and the
+        # decode batch, the disaggregated tier advances its in-flight
+        # KV-migration streams (one double-buffer rotation each) so the
+        # DCN transfers ride under this iteration's decode step. The
+        # monolithic tier has nothing to move.
+        self._advance_migrations()
         ready, preempted = self.sched.ensure_decode_pages()
         decoded = len(ready)
         if ready:
@@ -455,6 +463,19 @@ class ServingEngine:
     def _observing(self) -> bool:
         return obs_trace.get_tracer() is not None or self.slo_cfg is not None
 
+    def _prefill_lane(self):
+        """(engine, slice_fn, logits_fn) the prefill stage runs through.
+        The disaggregated tier (disagg/engine.py) overrides this to the
+        PREFILL role's engine and jits while it is active; here prefill
+        and decode share one engine."""
+        return self.engine, self._slice_jit(), self._logits_jit()
+
+    def _advance_migrations(self) -> int:
+        """Disagg hook: advance in-flight KV-migration streams by one
+        double-buffer rotation each (disagg/engine.py). The monolithic
+        tier migrates nothing."""
+        return 0
+
     def _prefill_slice(self, req: Request) -> str:
         text = req.text
         T = len(text)
@@ -462,19 +483,19 @@ class ServingEngine:
         ids = np.zeros((1, self.chunk), np.int32)
         real = text[start:start + self.chunk]
         ids[0, :len(real)] = real
-        eng = self.engine
+        eng, slice_fn, logits_fn = self._prefill_lane()
         eng._jit_compiled_last_call = False
         t0 = self.clock()
         with obs_trace.span("serving.prefill_slice", req=req.req_id,
                             start=start, tokens=len(real)):
-            x, self._pf_cache = self._slice_jit()(
+            x, self._pf_cache = slice_fn(
                 eng.params, jnp.asarray(ids), self._pf_cache,
                 jnp.int32(start))
         req.prefill_pos = min(start + self.chunk, T)
         done = req.prefill_pos >= T
         if done:
             row = (T - 1) - start
-            tok = self._logits_jit()(eng.params, x[row:row + 1])
+            tok = logits_fn(eng.params, x[row:row + 1])
             tok = int(np.asarray(tok)[0])
             now = self.clock()
             req.tokens.append(tok)
@@ -500,24 +521,32 @@ class ServingEngine:
                     "tdtpu_prefill_latency_ms",
                     "prefill wall latency (device-synced only in sync "
                     "runs)")
-            n_pages = -(-T // self.page)
-            pages = self.sched.allocator.pages(req.req_id)[:n_pages]
-            if self._mk is not None:
-                # The megakernel workspace is the decode-time source of
-                # truth: a finished prefill's pages scatter in here too
-                # (the paged _cache keeps the dense fallback viable).
-                if self._mk_ws is None:
-                    self._mk_ws = self._mk.start()
-                self._mk_ws = self._mk.load_prefill(
-                    self._mk_ws, self._pf_cache.k, self._pf_cache.v,
-                    pages)
-            self._cache = self._scatter_jit(n_pages)(
-                self._cache, self._pf_cache.k, self._pf_cache.v,
-                jnp.asarray(pages, jnp.int32))
-            req.advance(RequestState.RUNNING)
-            if req.done:
-                self._finish(req)
+            self._complete_prefill(req)
         return req.req_id
+
+    def _complete_prefill(self, req: Request) -> None:
+        """Prefill finished (first token already recorded, ``req.kv_len``
+        = prompt length): hand the buffered KV to the decode stage. Here
+        the buffer scatters page-aligned into the shared pool and the
+        request joins the decode batch; the disaggregated tier instead
+        starts a migration stream to the decode slice's pool."""
+        n_pages = -(-req.kv_len // self.page)
+        pages = self.sched.allocator.pages(req.req_id)[:n_pages]
+        if self._mk is not None:
+            # The megakernel workspace is the decode-time source of
+            # truth: a finished prefill's pages scatter in here too
+            # (the paged _cache keeps the dense fallback viable).
+            if self._mk_ws is None:
+                self._mk_ws = self._mk.start()
+            self._mk_ws = self._mk.load_prefill(
+                self._mk_ws, self._pf_cache.k, self._pf_cache.v,
+                pages)
+        self._cache = self._scatter_jit(n_pages)(
+            self._cache, self._pf_cache.k, self._pf_cache.v,
+            jnp.asarray(pages, jnp.int32))
+        req.advance(RequestState.RUNNING)
+        if req.done:
+            self._finish(req)
 
     def _finish(self, req: Request) -> None:
         self.sched.finish(req, self.clock())

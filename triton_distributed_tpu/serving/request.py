@@ -7,6 +7,17 @@ iteration-level schedule (docs/serving.md)::
                     ▲              │
                     └─ PREEMPTED ◀─┘   (pages freed; recompute-on-resume)
 
+The disaggregated tier (docs/disagg.md) inserts MIGRATING between
+PREFILLING and RUNNING: a finished prefill's paged KV blocks stream from
+the prefill slice's pool to the decode slice's over DCN, and only the
+completed migration joins the decode batch. A migration can be preempted
+mid-stream (decode-pool pressure or a migration fault) — the stream is
+cancelled, decode pages freed, recompute-on-resume like any preemption::
+
+    PREFILLING ──▶ MIGRATING ──▶ RUNNING
+                       │
+                       └──▶ PREEMPTED
+
 State transitions are validated (:meth:`Request.advance` raises on an
 illegal edge), timestamps are stamped by the serving loop through the
 clock it owns (arrival, first token, finish — the TTFT/TPOT source), and
@@ -32,6 +43,7 @@ import itertools
 class RequestState(enum.Enum):
     WAITING = "waiting"
     PREFILLING = "prefilling"
+    MIGRATING = "migrating"          # disagg tier only (docs/disagg.md)
     RUNNING = "running"
     PREEMPTED = "preempted"
     FINISHED = "finished"
@@ -39,8 +51,10 @@ class RequestState(enum.Enum):
 
 _EDGES: dict[RequestState, tuple[RequestState, ...]] = {
     RequestState.WAITING: (RequestState.PREFILLING,),
-    RequestState.PREFILLING: (RequestState.RUNNING, RequestState.PREEMPTED,
+    RequestState.PREFILLING: (RequestState.MIGRATING, RequestState.RUNNING,
+                              RequestState.PREEMPTED,
                               RequestState.FINISHED),
+    RequestState.MIGRATING: (RequestState.RUNNING, RequestState.PREEMPTED),
     RequestState.RUNNING: (RequestState.PREEMPTED, RequestState.FINISHED),
     RequestState.PREEMPTED: (RequestState.PREFILLING,),
     RequestState.FINISHED: (),
