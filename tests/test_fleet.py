@@ -1,0 +1,454 @@
+"""Elastic fleet (ISSUE 11, docs/resilience.md "Fleet degradation").
+
+The load-bearing contracts: a confirmed-dead rank EVACUATES the serving
+tier to the survivor sub-mesh with per-request token parity and intact
+first-submission accounting; a slow-but-alive rank (straggler) only
+narrows admission (flap damping — never evicted); the rejoin probe
+re-expands to the full mesh once the loss clears; and
+``TDTPU_DEMOTION_LADDER=0`` propagates the named ``RankLossError``
+instead of changing geometry.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.models.config import tiny_config
+from triton_distributed_tpu.models.dense import init_dense_llm
+from triton_distributed_tpu.models.engine import Engine
+from triton_distributed_tpu.obs import metrics as obs_metrics
+from triton_distributed_tpu.resilience import (
+    CommTimeoutError, FaultClass, FaultInjectionError, RankLossError,
+    chaos, clear_rank_loss, fleet, lost_ranks, mark_rank_lost,
+)
+from triton_distributed_tpu.resilience.faults import FaultPlan
+from triton_distributed_tpu.runtime import initialize_distributed
+from triton_distributed_tpu.serving.loop import ServingEngine
+
+
+@pytest.fixture(autouse=True)
+def _clean_rank_registry():
+    clear_rank_loss()
+    yield
+    clear_rank_loss()
+
+
+@pytest.fixture()
+def fresh_registry():
+    return obs_metrics.set_registry(obs_metrics.Registry())
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = tiny_config()
+    return cfg, init_dense_llm(jax.random.PRNGKey(7), cfg)
+
+
+def _ctx2():
+    return initialize_distributed(mesh_shape=(2,), axis_names=("tp",),
+                                  devices=jax.devices()[:2])
+
+
+def _golden(cfg, params, ctx, prompts, gens):
+    oracle = Engine(cfg, params, ctx, backend="xla", max_seq=64)
+    return [np.asarray(oracle.serve(jnp.asarray([p], jnp.int32),
+                                    gen_len=g))[0].tolist()
+            for p, g in zip(prompts, gens)]
+
+
+# ---------------------------------------------------------------------------
+# The rank_loss fault class (faults.py).
+# ---------------------------------------------------------------------------
+
+def test_rank_loss_matrix_case_detected():
+    """The replay lane: a rank_loss plan fails every pallas_call on the
+    target rank with the NAMED RankLossError (persistent, unlike the
+    one-shot crash) — the chaos matrix expects detection."""
+    from triton_distributed_tpu.analysis.registry import build_registry
+
+    driver = build_registry((2,))["allreduce"]
+    baseline = chaos._clean_baseline(driver, ("tp",), (2,), "allreduce@2")
+    case = chaos.run_case("allreduce", ("tp",), (2,),
+                          FaultClass.RANK_LOSS, seed=0,
+                          baseline_hashes=baseline, driver=driver)
+    assert case.ok and case.verdict == "detected"
+    text = "\n".join(case.diagnostics)
+    assert "RankLossError" in text and "rank 0" in text
+
+
+def test_rank_loss_plan_is_persistent_and_scopes_registry():
+    plan = FaultPlan(FaultClass.RANK_LOSS, target_rank=3)
+    assert plan.persistent            # forced: a dead chip stays dead
+    assert 3 not in lost_ranks()
+    with plan.active():
+        assert 3 in lost_ranks()      # host-visible while active
+    assert 3 not in lost_ranks()      # scope exit clears the mark
+    # Explicit marks are sticky until cleared (the chaos kill switch).
+    mark_rank_lost(5)
+    assert 5 in lost_ranks()
+    clear_rank_loss(5)
+    assert 5 not in lost_ranks()
+
+
+def test_crash_diagnostics_name_the_rank():
+    """ISSUE 11 satellite: crash events/errors carry the logical rank —
+    attribution without parsing kernel names."""
+    from triton_distributed_tpu.analysis.registry import build_registry
+
+    driver = build_registry((2,))["allreduce"]
+    baseline = chaos._clean_baseline(driver, ("tp",), (2,), "allreduce@2")
+    case = chaos.run_case("allreduce", ("tp",), (2,), FaultClass.CRASH,
+                          seed=0, baseline_hashes=baseline, driver=driver)
+    assert case.ok
+    text = "\n".join(case.diagnostics)
+    assert "on rank 0" in text        # the fired-fault detail
+    assert "rank=0" in text           # the structured error message
+
+
+def test_attribute_rank_walks_the_chain():
+    assert fleet.attribute_rank(RankLossError("x", rank=2)) == 2
+    assert fleet.attribute_rank(
+        CommTimeoutError(sem="s", rank=1, expected=1, observed=0,
+                         waited_s=1.0, timeout_s=1.0)) == 1
+    outer = RuntimeError("wrapped")
+    outer.__cause__ = FaultInjectionError("inner", rank=4)
+    assert fleet.attribute_rank(outer) == 4
+    assert fleet.attribute_rank(ValueError("no rank")) is None
+
+
+# ---------------------------------------------------------------------------
+# Per-rank comm-timeout metrics (satellite).
+# ---------------------------------------------------------------------------
+
+def test_comm_timeouts_counted_per_rank(tmp_path):
+    from triton_distributed_tpu import obs
+    from triton_distributed_tpu.resilience import deadline
+
+    obs.start_run(str(tmp_path / "run"))
+    try:
+        reg = obs_metrics.registry()
+        deadline.record_timeout(sem="t/sem", rank=3, expected=2,
+                                observed=0, waited_s=0.1)
+        deadline.record_timeout(sem="t/sem", rank=3, expected=2,
+                                observed=0, waited_s=0.1)
+        deadline.record_timeout(sem="t/sem2", rank=0, expected=1,
+                                observed=0, waited_s=0.1)
+        c3 = reg.get('tdtpu_comm_timeouts_total{rank="3"}')
+        c0 = reg.get('tdtpu_comm_timeouts_total{rank="0"}')
+        assert c3.value == 2 and c0.value == 1
+        assert 'rank="3"' in c3.to_prometheus()
+        snap = reg.snapshot()
+        assert snap['tdtpu_comm_timeouts_total{rank="3"}']["labels"] == \
+            {"rank": "3"}
+    finally:
+        obs.finish_run()
+    deadline.drain_timeout_events()
+
+
+# ---------------------------------------------------------------------------
+# Health ledger: scoring, flap damping, verdicts.
+# ---------------------------------------------------------------------------
+
+def test_ledger_timeouts_strike_the_waiters_peer():
+    """A CommTimeoutError names the WAITING rank — which proved its own
+    liveness by raising. The strike lands on the unique peer (the
+    producer that never signalled); with >1 peer the guilt is ambiguous
+    and only soft suspicion spreads (never a dead verdict)."""
+    led = fleet.HealthLedger([0, 1], dead_after=2)
+    assert led.observe_timeout(0, sem="s0") == 1
+    assert led.verdict(1) is fleet.HealthVerdict.SUSPECT
+    assert led.verdict(0) is fleet.HealthVerdict.HEALTHY  # not the waiter
+    assert led.observe_timeout(0, sem="s1") == 1
+    assert led.verdict(1) is fleet.HealthVerdict.DEAD
+    assert led.dead() == [1] and led.alive() == [0]
+    led.absolve(1)
+    assert led.verdict(1) is fleet.HealthVerdict.HEALTHY
+    # Ambiguous complement (4 ranks): soft suspicion only — repeated
+    # expiries can never evacuate a rank they cannot pinpoint.
+    led4 = fleet.HealthLedger([0, 1, 2, 3], dead_after=2)
+    for _ in range(10):
+        assert led4.observe_timeout(0) is None
+    assert led4.dead() == []
+    assert set(led4.suspects()) == {1, 2, 3}
+
+
+def test_ledger_straggles_never_kill_and_decay():
+    """Flap damping: soft evidence saturates at SUSPECT — a straggler
+    degrades admission width, never membership — and decays on clean
+    iterations so a recovered rank re-earns its width."""
+    led = fleet.HealthLedger([0, 1], dead_after=2, decay=0.25)
+    for _ in range(50):
+        led.observe_straggle(1)
+    assert led.verdict(1) is fleet.HealthVerdict.SUSPECT
+    assert led.dead() == []           # soft evidence can never evacuate
+    for _ in range(200):
+        led.observe_clean()
+    assert led.verdict(1) is fleet.HealthVerdict.HEALTHY
+    # rank_loss is the hard signal: immediately dead.
+    led.sync_lost({1})
+    assert led.verdict(1) is fleet.HealthVerdict.DEAD
+
+
+def test_ledger_error_attribution_routes_evidence():
+    led = fleet.HealthLedger([0, 1], dead_after=2)
+    assert led.observe_error(RankLossError("gone", rank=1)) == 1
+    assert led.verdict(1) is fleet.HealthVerdict.DEAD
+    assert led.observe_error(ValueError("not ours")) is None
+    assert led.observe_error(RankLossError("other mesh", rank=9)) is None
+    # A CommTimeoutError blames the waiter's PEER, not the waiter.
+    led2 = fleet.HealthLedger([0, 1], dead_after=2)
+    blamed = led2.observe_error(
+        CommTimeoutError(sem="s", rank=0, expected=1, observed=0,
+                         waited_s=1.0, timeout_s=1.0))
+    assert blamed == 1
+    assert led2.verdict(0) is fleet.HealthVerdict.HEALTHY
+    # ...and the dispatch follows the chain element that CARRIED the
+    # rank: a timeout wrapped by the jit runtime must not be classified
+    # as a crash against the provably-alive waiter.
+    led3 = fleet.HealthLedger([0, 1], dead_after=2)
+    wrapped = RuntimeError("jit wrapper")
+    wrapped.__cause__ = CommTimeoutError(sem="s", rank=0, expected=1,
+                                         observed=0, waited_s=1.0,
+                                         timeout_s=1.0)
+    assert led3.observe_error(wrapped) == 1     # the peer, not rank 0
+    assert led3.verdict(0) is fleet.HealthVerdict.HEALTHY
+    assert led3.health(0).crashes == 0
+
+
+def test_survivor_context_largest_valid_tp(ctx):
+    """TP=8 loses one rank -> the largest kv-head-divisible survivor is
+    TP=4 (never TP=7), reusing the sub-context mechanics."""
+    sub = fleet.survivor_context(ctx, [1], num_kv_heads=8)
+    assert sub.axis_size("tp") == 4
+    ids = [int(d.id) for d in np.asarray(sub.mesh.devices).ravel()]
+    assert 1 not in ids
+    assert fleet.survivor_context(ctx, list(range(8)),
+                                  num_kv_heads=8) is None
+
+
+# ---------------------------------------------------------------------------
+# Serving-tier evacuation / rejoin (the tentpole round-trip).
+# ---------------------------------------------------------------------------
+
+def test_evacuation_roundtrip_parity_accounting_rejoin(
+        tiny, fresh_registry, monkeypatch, ctx):
+    """The full ladder: rank loss mid-serve -> evacuation to the TP=1
+    survivor mesh (requests preempted, engine re-partitioned, params
+    host-resharded, jits rebuilt) -> token parity + first-submission
+    TTFT kept + evacuation preemptions counted APART from pool-pressure
+    preemptions -> fault clears -> rejoin probe re-expands to TP=2 with
+    post-rejoin parity."""
+    from triton_distributed_tpu.obs.slo import SLOConfig
+    from triton_distributed_tpu.runtime.context import set_context
+
+    cfg, params = tiny
+    monkeypatch.setenv("TDTPU_REJOIN_AFTER", "3")
+    try:
+        ctx2 = _ctx2()
+        prompts = [[5, 77, 131, 9, 40, 2], [200, 9, 31, 7], [8, 8, 8, 9]]
+        gens = [5, 4, 3]
+        golden = _golden(cfg, params, ctx2, prompts, gens)
+        eng = Engine(cfg, params, ctx2, backend="xla", max_seq=64,
+                     page_size=4)
+        se = ServingEngine(eng, max_batch=2, prefill_chunk=4,
+                           slo_cfg=SLOConfig())
+        reqs = [se.submit(p, g, req_id=f"fl-{i}")[0]
+                for i, (p, g) in enumerate(zip(prompts, gens))]
+        for _ in range(4):
+            se.step()
+        ttft_before = {r.req_id: r.t_first_token for r in reqs
+                       if r.t_first_token is not None}
+        assert ttft_before, "no first token before the kill — the test "\
+                            "no longer exercises mid-serve loss"
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            mark_rank_lost(1)
+            se.run()
+        assert se.evacuated and eng.n_total == 1
+        assert [r.tokens for r in reqs] == golden
+        # Accounting: first-submission TTFT survives the evacuation...
+        for r in reqs:
+            if r.req_id in ttft_before:
+                assert r.t_first_token == ttft_before[r.req_id]
+        # ...and fleet preemptions are a DISTINCT series from
+        # pool-pressure preemptions (satellite).
+        reg = fresh_registry
+        assert se.evacuation_preemptions >= 1
+        assert reg.get(obs_metrics.SERVE_EVAC_PREEMPTIONS).value == \
+            se.evacuation_preemptions
+        pool = reg.get(obs_metrics.SERVE_PREEMPTIONS)
+        assert pool is None or pool.value == 0
+        assert reg.get(obs_metrics.FLEET_EVACUATIONS).value == 1
+        assert reg.get(obs_metrics.FLEET_RANKS_ALIVE).value == 1
+        assert se.fleet_log[0]["event"] == "evacuation"
+        assert se.fleet_log[0]["from_ranks"] == 2
+        assert se.fleet_log[0]["to_ranks"] == 1
+        # The fault clears -> after TDTPU_REJOIN_AFTER clean iterations
+        # the probe re-expands to the full mesh, with parity.
+        clear_rank_loss(1)
+        post, _ = se.submit(prompts[0], gens[0], req_id="fl-post")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            se.run()
+        assert not se.evacuated and eng.n_total == 2
+        assert post.tokens == golden[0]
+        assert reg.get(obs_metrics.FLEET_REJOINS).value == 1
+        assert reg.get(obs_metrics.FLEET_RANKS_ALIVE).value == 2
+        assert [e["event"] for e in se.fleet_log] == \
+            ["evacuation", "rejoin"]
+    finally:
+        set_context(ctx)
+
+
+def test_flap_damping_straggler_shrinks_admission_never_evacuates(
+        tiny, fresh_registry, ctx):
+    """Satellite: a persistent straggler (the rotating resolve_straggler
+    form) raises suspicion and narrows admit_cap but NEVER triggers
+    evacuation; a true rank_loss then evacuates deterministically."""
+    from triton_distributed_tpu.language.distributed_ops import (
+        resolve_straggler,
+    )
+    from triton_distributed_tpu.runtime.context import set_context
+
+    cfg, params = tiny
+    try:
+        ctx2 = _ctx2()
+        eng = Engine(cfg, params, ctx2, backend="xla", max_seq=64,
+                     page_size=4)
+        se = ServingEngine(eng, max_batch=2, prefill_chunk=4)
+        se.submit(list(range(10, 16)), 8, req_id="st-0")
+        se.submit(list(range(30, 36)), 8, req_id="st-1")
+        cap0 = se.sched.admit_cap
+        for _ in range(6):
+            # The rotating-resolver form with a static call_index (the
+            # fused-op usage: rank call_index % n straggles) — one rank
+            # persistently lagging, observed every iteration.
+            rank, _ = resolve_straggler(("rotate", 64), 2, 1)
+            se.fleet.observe_straggle(int(rank))
+            se.step()
+        assert se.sched.admit_cap < cap0          # width degraded...
+        assert not se.evacuated and eng.n_total == 2   # ...not membership
+        assert se.fleet.dead() == []
+        # A true rank_loss evacuates, deterministically.
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            mark_rank_lost(1)
+            se.run()
+        assert se.evacuated and eng.n_total == 1
+    finally:
+        set_context(ctx)
+
+
+def test_ladder_disabled_propagates_named_error(tiny, ctx):
+    from triton_distributed_tpu.runtime.context import set_context
+
+    cfg, params = tiny
+    try:
+        ctx2 = _ctx2()
+        eng = Engine(cfg, params, ctx2, backend="xla", max_seq=64,
+                     page_size=4)
+        se = ServingEngine(eng, max_batch=2, prefill_chunk=4)
+        se.submit([1, 2, 3, 4], 2)
+        mark_rank_lost(1)
+        import os
+
+        old = os.environ.get("TDTPU_DEMOTION_LADDER")
+        os.environ["TDTPU_DEMOTION_LADDER"] = "0"
+        try:
+            with pytest.raises(RankLossError, match="confirmed dead"):
+                se.step()
+        finally:
+            if old is None:
+                os.environ.pop("TDTPU_DEMOTION_LADDER", None)
+            else:
+                os.environ["TDTPU_DEMOTION_LADDER"] = old
+        assert not se.evacuated and eng.n_total == 2
+    finally:
+        set_context(ctx)
+
+
+def test_disagg_prefill_rank_loss_demotes_to_monolithic(tiny, ctx):
+    """A dead PREFILL-role rank mid-migration: the disagg tier demotes
+    to monolithic serving on the decode slice (no survivor geometry to
+    keep), finishing with token parity."""
+    from triton_distributed_tpu.disagg import (
+        DisaggServingEngine, role_contexts,
+    )
+    from triton_distributed_tpu.runtime.context import set_context
+
+    cfg, params = tiny
+    try:
+        ctx1 = initialize_distributed(mesh_shape=(1,),
+                                      axis_names=("tp",),
+                                      devices=jax.devices()[:1])
+        prompts = [[5, 77, 131, 9, 40, 2], [200, 9, 31, 7]]
+        gens = [4, 3]
+        golden = _golden(cfg, params, ctx1, prompts, gens)
+        pctx, dctx = role_contexts(jax.devices()[:2])
+        p_id = int(np.asarray(pctx.mesh.devices).ravel()[0].id)
+        pe = Engine(cfg, params, pctx, backend="xla", max_seq=64)
+        de = Engine(cfg, params, dctx, backend="xla", max_seq=64,
+                    page_size=4)
+        se = DisaggServingEngine(pe, de, max_batch=2, prefill_chunk=4,
+                                 block_pages=1)
+        reqs = [se.submit(p, g, req_id=f"dgf-{i}")[0]
+                for i, (p, g) in enumerate(zip(prompts, gens))]
+        it = 0
+        while not se._streams and it < 50:
+            se.step()
+            it += 1
+        assert se._streams, "no migration in flight at the kill point"
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            mark_rank_lost(p_id)
+            se.run(max_iters=2000)
+        assert not se.disagg_active
+        assert "lost" in se.demotion_reason
+        assert [r.tokens for r in reqs] == golden
+        assert all(r.state.name == "FINISHED" for r in reqs)
+    finally:
+        set_context(ctx)
+
+
+# ---------------------------------------------------------------------------
+# obs.report fleet lane (satellite).
+# ---------------------------------------------------------------------------
+
+def test_report_fleet_lane_and_evacuation_check(tmp_path):
+    from triton_distributed_tpu import obs
+    from triton_distributed_tpu.obs import report as obs_report
+
+    obs.start_run(str(tmp_path / "run"))
+    reg = obs_metrics.registry()
+    reg.counter(obs_metrics.FLEET_EVACUATIONS, "evacs").inc()
+    reg.gauge(obs_metrics.FLEET_RANKS_ALIVE, "alive").set(1)
+    reg.counter(obs_metrics.COMM_TIMEOUTS, "timeouts",
+                labels={"rank": "1"}).inc(3)
+    run_dir = obs.finish_run()
+
+    metrics = obs_report.load_metrics(run_dir)
+    assert obs_report.evacuation_debt(metrics) == 1
+    lane = "\n".join(obs_report.fleet_lane(metrics))
+    assert "tdtpu_fleet_evacuations_total" in lane
+    assert 'tdtpu_comm_timeouts_total{rank="1"}' in lane
+    # An evacuated-and-never-rejoined run fails --check...
+    rc = obs_report.main([run_dir, "--check", "--require-series", ""])
+    assert rc == 1
+    # ...unless the operator acknowledges the degraded capacity.
+    rc = obs_report.main([run_dir, "--check", "--require-series", "",
+                          "--allow-evacuation"])
+    assert rc == 0
+    # A rejoin answers the evacuation: the debt clears.
+    obs.start_run(str(tmp_path / "run2"))
+    reg = obs_metrics.registry()
+    reg.counter(obs_metrics.FLEET_EVACUATIONS, "evacs").inc()
+    reg.counter(obs_metrics.FLEET_REJOINS, "rejoins").inc()
+    run_dir2 = obs.finish_run()
+    assert obs_report.evacuation_debt(
+        obs_report.load_metrics(run_dir2)) == 0
+    rc = obs_report.main([run_dir2, "--check", "--require-series", ""])
+    assert rc == 0

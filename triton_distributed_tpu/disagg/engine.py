@@ -387,6 +387,56 @@ class DisaggServingEngine(ServingEngine):
                 req.advance(RequestState.RUNNING)
         return landed
 
+    # -- fleet elasticity (ISSUE 11) -------------------------------------------
+    def _fleet_preflight(self):
+        """Role-aware fleet pass: a rank lost from the PREFILL role's
+        mesh demotes the tier to monolithic serving on the decode slice
+        (the prefill role has no survivor sub-geometry worth keeping —
+        the decode engine re-prefills everything); a DECODE-role loss
+        falls through to the base evacuation, which re-partitions the
+        decode mesh and rebuilds the migration plumbing."""
+        if self.disagg_active and self.fleet is not None:
+            from triton_distributed_tpu.resilience import (
+                faults as faults_mod,
+            )
+            from triton_distributed_tpu.resilience.faults import (
+                RankLossError,
+            )
+
+            lost = faults_mod.lost_ranks()
+            pids = {int(d.id) for d in
+                    np.asarray(self.prefill_engine.ctx.mesh.devices
+                               ).ravel()}
+            dead_p = sorted(pids & set(lost))
+            if dead_p:
+                self._demote_to_monolithic(
+                    f"prefill role rank(s) {dead_p} lost (rank_loss) — "
+                    "decode slice serves monolithic",
+                    RankLossError(
+                        f"prefill role rank(s) {dead_p} lost",
+                        rank=dead_p[0]))
+                return "demoted"
+        return super()._fleet_preflight()
+
+    def _rebuild_device_state(self) -> None:
+        super()._rebuild_device_state()
+        # In-flight migration streams hold blocks/specs bound to the old
+        # decode mesh: cancel them (their requests were preempted —
+        # recompute-on-resume re-prefills and re-migrates).
+        self.migration_preemptions += len(self._streams)
+        self._streams.clear()
+        if self.disagg_active:
+            # The base rebuild placed the prefill buffer on the DECODE
+            # mesh (the monolithic layout); the active role split keeps
+            # it on the prefill slice, and the DCN block hop must target
+            # the decode engine's CURRENT mesh.
+            self._pf_cache = self._put_prefill(
+                init_kv_cache(self.cfg, 1, self.s_buf))
+            kv_spec = NamedSharding(
+                self.engine.ctx.mesh,
+                P(None, None, None, self.engine.shard_axes, None))
+            self._put_block = lambda kv: jax.device_put(kv, kv_spec)
+
     # -- demote-don't-die ------------------------------------------------------
     def _demote_to_monolithic(self, reason: str,
                               exc: BaseException | None = None) -> None:

@@ -549,6 +549,54 @@ class Engine:
             return tok, cache
         return self._decode_jit(False, batch)(self.params, tokens, cache)
 
+    # -- resilience: fleet geometry (ISSUE 11) ------------------------------
+    def repartition(self, new_ctx: DistContext, *, reason: str = "") -> None:
+        """Re-partition this engine onto a different (typically survivor)
+        TP mesh: the fleet evacuation / rejoin primitive
+        (docs/resilience.md "Fleet degradation").
+
+        Host-reshards the params onto ``new_ctx``'s devices
+        (``jax.device_put`` across meshes — on real hardware this is
+        where a checkpoint re-load would slot in) and drops every
+        compiled artifact, so the next call re-enters the
+        ``_first_call_span`` compile routing on the new geometry. KV
+        caches are NOT migrated — callers (the serving tier) preempt
+        in-flight work and recompute-on-resume, the only state-correct
+        hand-off when a shard of the cache lived on a lost rank.
+
+        Hierarchical engines have no repartition contract (their joint
+        (inter, intra) sharding has no flat survivor twin) — same reason
+        they opt out of the backend ladder."""
+        if self.hierarchical:
+            raise ValueError(
+                "hierarchical engines cannot repartition: the joint "
+                "(inter, intra) weight sharding has no flat survivor "
+                "layout — serve fleet-elastic tiers on 1-axis TP meshes")
+        n_new = new_ctx.axis_size(self.axis)
+        if self.cfg.num_kv_heads % n_new:
+            raise ValueError(
+                f"num_kv_heads {self.cfg.num_kv_heads} not divisible by "
+                f"survivor TP degree {n_new} — pick the sub-mesh with "
+                "resilience.fleet.survivor_context(num_kv_heads=...)")
+        old_n = self.n_total
+        self.ctx = new_ctx
+        self.n = n_new
+        self.n_inter = 1
+        self.n_total = n_new
+        self.shard_axes = self.axis
+        self.param_specs = dense_llm_specs(self.cfg, self.shard_axes)
+        mesh = new_ctx.mesh
+        self.params = jax.device_put(
+            self.params, jax.tree.map(lambda s: NamedSharding(mesh, s),
+                                      self.param_specs,
+                                      is_leaf=lambda x: isinstance(x, P)))
+        self._jit_cache.clear()
+        self._mk = None
+        self._gemm_ar_choice = None
+        with obs_trace.span("engine.repartition", from_ranks=old_n,
+                            to_ranks=n_new, reason=reason):
+            pass
+
     # -- resilience: retry / demotion ladder --------------------------------
     @staticmethod
     def _resilience_cfg() -> dict:

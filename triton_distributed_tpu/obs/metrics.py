@@ -74,6 +74,23 @@ MIGRATION_SERIES = (KV_MIGRATE_LATENCY_MS, KV_MIGRATE_BYTES,
                     KV_MIGRATE_PAGES, KV_MIGRATIONS, KV_MIGRATE_FAILURES,
                     DISAGG_DEMOTIONS)
 
+# Fleet-health lane (ISSUE 11, docs/resilience.md "Fleet degradation"):
+# published by resilience/deadline.py (per-rank timeout attribution) and
+# serving/loop.py (evacuation / rejoin / alive gauges), rendered as
+# obs.report's fleet section. COMM_TIMEOUTS is a LABELED family — one
+# counter per rank (``tdtpu_comm_timeouts_total{rank="3"}``).
+COMM_TIMEOUTS = "tdtpu_comm_timeouts_total"
+FLEET_RANKS_ALIVE = "tdtpu_fleet_ranks_alive"
+FLEET_SUSPECTS = "tdtpu_fleet_suspect_ranks"
+FLEET_EVACUATIONS = "tdtpu_fleet_evacuations_total"
+FLEET_REJOINS = "tdtpu_fleet_rejoins_total"
+FLEET_STEP_FAULTS = "tdtpu_fleet_step_faults_total"
+SERVE_EVAC_PREEMPTIONS = "tdtpu_serve_evacuation_preemptions_total"
+
+FLEET_SERIES = (FLEET_RANKS_ALIVE, FLEET_SUSPECTS, FLEET_EVACUATIONS,
+                FLEET_REJOINS, FLEET_STEP_FAULTS, SERVE_EVAC_PREEMPTIONS,
+                COMM_TIMEOUTS)
+
 
 def _fmt_labels(labels: dict[str, str] | None) -> str:
     if not labels:
@@ -95,11 +112,20 @@ def percentile(samples: Iterable[float], q: float) -> float | None:
 
 
 class Counter:
-    """Monotone cumulative count (``_total`` convention)."""
+    """Monotone cumulative count (``_total`` convention).
 
-    def __init__(self, name: str, help: str = ""):
+    ``labels`` makes this one series of a labeled family (Prometheus
+    dimensioned metrics — ISSUE 11 added per-rank comm-timeout counters):
+    the registry keys on ``name + labels`` so each label set is its own
+    monotone series, exposition carries the label string on the sample
+    line, and the JSON snapshot records the labels structurally.
+    """
+
+    def __init__(self, name: str, help: str = "",
+                 labels: dict[str, str] | None = None):
         self.name = name
         self.help = help
+        self.labels = dict(labels) if labels else None
         self._value = 0.0
         self._lock = threading.Lock()
 
@@ -113,21 +139,31 @@ class Counter:
     def value(self) -> float:
         return self._value
 
-    def to_prometheus(self) -> str:
+    def prom_header(self) -> str:
         return (f"# HELP {self.name} {self.help}\n"
-                f"# TYPE {self.name} counter\n"
-                f"{self.name} {self._value}\n")
+                f"# TYPE {self.name} counter\n")
+
+    def prom_samples(self) -> str:
+        return f"{self.name}{_fmt_labels(self.labels)} {self._value}\n"
+
+    def to_prometheus(self) -> str:
+        return self.prom_header() + self.prom_samples()
 
     def snapshot(self) -> dict[str, Any]:
-        return {"type": "counter", "value": self._value, "help": self.help}
+        out = {"type": "counter", "value": self._value, "help": self.help}
+        if self.labels:
+            out["labels"] = dict(self.labels)
+        return out
 
 
 class Gauge:
-    """A value that goes up and down."""
+    """A value that goes up and down (``labels`` as on :class:`Counter`)."""
 
-    def __init__(self, name: str, help: str = ""):
+    def __init__(self, name: str, help: str = "",
+                 labels: dict[str, str] | None = None):
         self.name = name
         self.help = help
+        self.labels = dict(labels) if labels else None
         self._value = 0.0
         self._lock = threading.Lock()
 
@@ -146,13 +182,21 @@ class Gauge:
     def value(self) -> float:
         return self._value
 
-    def to_prometheus(self) -> str:
+    def prom_header(self) -> str:
         return (f"# HELP {self.name} {self.help}\n"
-                f"# TYPE {self.name} gauge\n"
-                f"{self.name} {self._value}\n")
+                f"# TYPE {self.name} gauge\n")
+
+    def prom_samples(self) -> str:
+        return f"{self.name}{_fmt_labels(self.labels)} {self._value}\n"
+
+    def to_prometheus(self) -> str:
+        return self.prom_header() + self.prom_samples()
 
     def snapshot(self) -> dict[str, Any]:
-        return {"type": "gauge", "value": self._value, "help": self.help}
+        out = {"type": "gauge", "value": self._value, "help": self.help}
+        if self.labels:
+            out["labels"] = dict(self.labels)
+        return out
 
 
 class Histogram:
@@ -206,9 +250,12 @@ class Histogram:
         with self._lock:
             return percentile(self._samples, q)
 
-    def to_prometheus(self) -> str:
-        lines = [f"# HELP {self.name} {self.help}",
-                 f"# TYPE {self.name} histogram"]
+    def prom_header(self) -> str:
+        return (f"# HELP {self.name} {self.help}\n"
+                f"# TYPE {self.name} histogram\n")
+
+    def prom_samples(self) -> str:
+        lines = []
         cum = 0
         with self._lock:
             for ub, c in zip(self.buckets, self._bucket_counts):
@@ -220,6 +267,9 @@ class Histogram:
             lines.append(f"{self.name}_sum {self._sum}")
             lines.append(f"{self.name}_count {self._count}")
         return "\n".join(lines) + "\n"
+
+    def to_prometheus(self) -> str:
+        return self.prom_header() + self.prom_samples()
 
     def snapshot(self) -> dict[str, Any]:
         with self._lock:
@@ -248,25 +298,38 @@ class Registry:
 
     def __init__(self):
         self._metrics: dict[str, Any] = {}
+        self._family_types: dict[str, type] = {}
         self._lock = threading.Lock()
 
-    def _get_or_make(self, name: str, cls, **kw):
+    def _get_or_make(self, name: str, cls,
+                     labels: dict[str, str] | None = None, **kw):
+        # Labeled series key on name + label string: each label set is
+        # its own series (``registry.get`` takes the full labeled key).
+        # The type guard applies to the whole FAMILY (base name): one
+        # Prometheus family has exactly one type, so a labeled counter
+        # and an unlabeled gauge sharing a name must collide loudly, not
+        # merge into a malformed exposition block.
+        key = name + _fmt_labels(labels)
         with self._lock:
-            m = self._metrics.get(name)
-            if m is None:
-                m = cls(name, **kw)
-                self._metrics[name] = m
-            elif not isinstance(m, cls):
+            fam = self._family_types.setdefault(name, cls)
+            if fam is not cls:
                 raise TypeError(
-                    f"metric {name!r} already registered as "
-                    f"{type(m).__name__}, not {cls.__name__}")
+                    f"metric family {name!r} already registered as "
+                    f"{fam.__name__}, not {cls.__name__}")
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(name, labels=labels, **kw) if labels \
+                    else cls(name, **kw)
+                self._metrics[key] = m
             return m
 
-    def counter(self, name: str, help: str = "") -> Counter:
-        return self._get_or_make(name, Counter, help=help)
+    def counter(self, name: str, help: str = "",
+                labels: dict[str, str] | None = None) -> Counter:
+        return self._get_or_make(name, Counter, labels=labels, help=help)
 
-    def gauge(self, name: str, help: str = "") -> Gauge:
-        return self._get_or_make(name, Gauge, help=help)
+    def gauge(self, name: str, help: str = "",
+              labels: dict[str, str] | None = None) -> Gauge:
+        return self._get_or_make(name, Gauge, labels=labels, help=help)
 
     def histogram(self, name: str, help: str = "",
                   buckets: tuple[float, ...] = DEFAULT_BUCKETS_MS
@@ -281,9 +344,21 @@ class Registry:
         return self._metrics.get(name)
 
     def to_prometheus(self) -> str:
+        """Prometheus 0.0.4 exposition. A labeled family (several series
+        sharing one base name) emits ONE ``# HELP``/``# TYPE`` block
+        followed by all of its samples — duplicate metadata lines are a
+        parse error for real scrapers."""
         with self._lock:
             metrics = [self._metrics[n] for n in sorted(self._metrics)]
-        return "".join(m.to_prometheus() for m in metrics)
+        families: dict[str, list] = {}
+        for m in metrics:
+            families.setdefault(m.name, []).append(m)
+        out = []
+        for name in sorted(families):
+            fam = families[name]
+            out.append(fam[0].prom_header())
+            out += [m.prom_samples() for m in fam]
+        return "".join(out)
 
     def snapshot(self) -> dict[str, Any]:
         with self._lock:

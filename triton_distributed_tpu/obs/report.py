@@ -338,6 +338,10 @@ def summarize(run_dir: str, lanes: dict, metrics: dict | None,
     if migration:
         lines.append("")
         lines += migration
+    fleet = fleet_lane(metrics)
+    if fleet:
+        lines.append("")
+        lines += fleet
     return "\n".join(lines)
 
 
@@ -389,6 +393,38 @@ def migration_lane(metrics: dict | None) -> list[str]:
         else:
             lines.append(f"  {name} = {m['value']:g}")
     return lines
+
+
+def fleet_lane(metrics: dict | None) -> list[str]:
+    """The fleet-health summary section (docs/resilience.md "Fleet
+    degradation") — rendered whenever the snapshot carries any fleet
+    series, including the per-rank comm-timeout label family."""
+    from triton_distributed_tpu.obs import metrics as obs_metrics
+
+    names = [n for n in (metrics or {})
+             if n in obs_metrics.FLEET_SERIES
+             or n.startswith(obs_metrics.COMM_TIMEOUTS + "{")]
+    if not names:
+        return []
+    lines = ["fleet health (docs/resilience.md):"]
+    order = list(obs_metrics.FLEET_SERIES)
+    for name in sorted(names, key=lambda n: (
+            order.index(n) if n in order else len(order), n)):
+        m = metrics[name]
+        lines.append(f"  {name} = {m['value']:g}")
+    return lines
+
+
+def evacuation_debt(metrics: dict | None) -> float:
+    """Evacuations not yet answered by a rejoin (0 when absent): the
+    run ended on a survivor mesh — degraded capacity an operator must
+    acknowledge (``--allow-evacuation``)."""
+    from triton_distributed_tpu.obs import metrics as obs_metrics
+
+    evac = (metrics or {}).get(obs_metrics.FLEET_EVACUATIONS) or {}
+    rejoin = (metrics or {}).get(obs_metrics.FLEET_REJOINS) or {}
+    return max(0.0, float(evac.get("value") or 0.0)
+               - float(rejoin.get("value") or 0.0))
 
 
 def migration_failure_count(metrics: dict | None) -> float:
@@ -536,6 +572,12 @@ def main(argv: list[str] | None = None) -> int:
                          "failing --check (by default a failed stream "
                          "in the snapshot fails the migration lane — "
                          "each one demoted the disagg tier)")
+    ap.add_argument("--allow-evacuation", action="store_true",
+                    help="report fleet evacuations without failing "
+                         "--check (by default a run that evacuated and "
+                         "never rejoined fails the fleet lane — it "
+                         "finished on a survivor mesh at degraded "
+                         "capacity)")
     args = ap.parse_args(argv)
 
     if args.dryrun:
@@ -617,6 +659,12 @@ def main(argv: list[str] | None = None) -> int:
             f"serving: {preemptions:g} preemption(s) under a clean SLO "
             "section — the page pool evicted work with no pressure "
             "signal (--allow-preemptions to accept)")
+    debt = evacuation_debt(metrics)
+    if debt and not args.allow_evacuation:
+        failures.append(
+            f"fleet: {debt:g} evacuation(s) never answered by a rejoin "
+            "— the run ended on a survivor mesh at degraded capacity "
+            "(--allow-evacuation to accept)")
     migrate_failures = migration_failure_count(metrics)
     if migrate_failures and not args.allow_migration_failures:
         failures.append(

@@ -19,7 +19,13 @@ survival half (ISSUE 6):
   diagnostic) — never a hang, never silent corruption;
 * Engine degradation lives in ``models/engine.py`` (the backend demotion
   ladder megakernel → overlap → xla with bounded retry), driven by
-  :func:`is_transient` and the SLO watchdog — docs/resilience.md.
+  :func:`is_transient` and the SLO watchdog — docs/resilience.md;
+* :mod:`~triton_distributed_tpu.resilience.fleet` — the GEOMETRY half of
+  degradation (ISSUE 11): a per-rank :class:`HealthLedger` scoring
+  suspicion from the evidence streams (comm timeouts, crash faults,
+  straggle observations, the persistent ``rank_loss`` class), survivor
+  sub-mesh selection, and the evacuation / rejoin machinery the serving
+  tier drives — docs/resilience.md "Fleet degradation".
 """
 
 from __future__ import annotations
@@ -34,12 +40,23 @@ from triton_distributed_tpu.resilience.faults import (  # noqa: F401
     FaultClass,
     FaultInjectionError,
     FaultPlan,
+    RankLossError,
+    clear_rank_loss,
+    lost_ranks,
+    mark_rank_lost,
+)
+from triton_distributed_tpu.resilience.fleet import (  # noqa: F401
+    HealthLedger,
+    HealthVerdict,
+    survivor_context,
 )
 
 __all__ = [
     "BackendUnsupportedError", "CommTimeoutError", "FaultClass",
-    "FaultInjectionError", "FaultPlan",
-    "drain_timeout_events", "is_transient", "wait_nap_s", "wait_timeout_s",
+    "FaultInjectionError", "FaultPlan", "HealthLedger", "HealthVerdict",
+    "RankLossError", "clear_rank_loss", "drain_timeout_events",
+    "is_transient", "lost_ranks", "mark_rank_lost", "survivor_context",
+    "wait_nap_s", "wait_timeout_s",
 ]
 
 

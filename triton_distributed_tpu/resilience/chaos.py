@@ -78,6 +78,11 @@ EXPECTED: dict[FaultClass, set[str]] = {
     FaultClass.CORRUPT_PAYLOAD: {"detected"},
     FaultClass.STRAGGLE: {"tolerated"},
     FaultClass.CRASH: {"detected"},
+    # rank_loss is the persistent crash (ISSUE 11): every pallas_call
+    # touching the target rank fails with the named RankLossError — the
+    # op-level detection half; the serving-tier evacuation half is the
+    # fleet_selftest rows below.
+    FaultClass.RANK_LOSS: {"detected"},
 }
 
 # Per-(op, fault) overrides for cases where the SPMD replay data model is
@@ -506,6 +511,211 @@ def disagg_serve_selftest() -> list[CaseResult]:
 
 
 # ---------------------------------------------------------------------------
+# Fleet rank-loss rows (ISSUE 11): kill a device mid-serve -> the tier
+# evacuates to the survivor mesh (geometry demotion) with token parity,
+# and rejoins once the fault clears (docs/resilience.md).
+# ---------------------------------------------------------------------------
+
+def fleet_selftest() -> list[CaseResult]:
+    """Three rows per --all sweep:
+
+    1. ``rank_loss_decode_mid_serve`` — a TP=2 monolithic serving tier
+       loses rank 1 mid-serve: every in-flight request preempts, the
+       tier re-partitions to the TP=1 survivor mesh, finishes with
+       per-request token parity vs sequential ``Engine.serve``, and the
+       rejoin probe re-expands to TP=2 once the fault clears (the post-
+       rejoin request must also be token-identical).
+    2. ``rank_loss_prefill_mid_migration`` — a disagg tier loses its
+       PREFILL-role rank while a KV-migration stream is in flight:
+       demote-to-monolithic on the decode slice still wins, with parity.
+    3. ``rank_loss_ladder_pinned`` — ``TDTPU_DEMOTION_LADDER=0``: the
+       named ``RankLossError`` propagates instead of evacuating.
+    """
+    import os
+    import warnings
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from triton_distributed_tpu.models import Engine, init_dense_llm
+    from triton_distributed_tpu.models.config import tiny_config
+    from triton_distributed_tpu.obs import metrics as obs_metrics
+    from triton_distributed_tpu.obs.slo import SLOConfig
+    from triton_distributed_tpu.resilience import faults as faults_mod
+    from triton_distributed_tpu.resilience.faults import RankLossError
+    from triton_distributed_tpu.runtime import initialize_distributed
+    from triton_distributed_tpu.serving.loop import ServingEngine
+
+    if len(jax.devices()) < 2:
+        return [CaseResult(
+            op="fleet_serve", mesh="2", fault="rank_loss", verdict="error",
+            detected_by="", expected=("detected",), ok=False, n_fired=0,
+            n_violations=0, diagnostics=[], elapsed_s=0.0,
+            error="fleet rows need >= 2 virtual CPU devices "
+                  "(--xla_force_host_platform_device_count)")]
+
+    cfg = tiny_config()
+    params = init_dense_llm(jax.random.PRNGKey(11), cfg)
+    ctx2 = initialize_distributed(mesh_shape=(2,), axis_names=("tp",),
+                                  devices=jax.devices()[:2])
+    prompts = [[5, 77, 131, 9, 40, 2], [200, 9, 31, 7]]
+    gens = [5, 4]
+    oracle = Engine(cfg, params, ctx2, backend="xla", max_seq=64)
+    golden = [np.asarray(oracle.serve(jnp.asarray([p], jnp.int32),
+                                      gen_len=g))[0].tolist()
+              for p, g in zip(prompts, gens)]
+    cases = []
+
+    # Row 1: decode-rank loss mid-serve -> survivor mesh -> rejoin.
+    t0 = time.time()
+    diags: list[str] = []
+    env0 = {k: os.environ.get(k) for k in ("TDTPU_REJOIN_AFTER",)}
+    os.environ["TDTPU_REJOIN_AFTER"] = "3"
+    # Fresh registry for the row's counters — restored after: a library
+    # caller of sweep() must keep its accumulated series.
+    prior_reg = obs_metrics.registry()
+    reg = obs_metrics.set_registry(obs_metrics.Registry())
+    try:
+        eng = Engine(cfg, params, ctx2, backend="xla", max_seq=64,
+                     page_size=4)
+        se = ServingEngine(eng, max_batch=2, prefill_chunk=4,
+                           slo_cfg=SLOConfig())
+        reqs = []
+        for i, (p, g) in enumerate(zip(prompts, gens)):
+            req, res = se.submit(p, g, req_id=f"chaos-fl-{i}")
+            assert res.name == "ADMITTED", res
+            reqs.append(req)
+        for _ in range(3):
+            se.step()                       # some tokens land on TP=2
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            faults_mod.mark_rank_lost(1)    # the seeded mid-serve kill
+            se.run()
+            parity = all(r.tokens == golden[i]
+                         for i, r in enumerate(reqs))
+            survivor = se.evacuated and eng.n_total == 1
+            evac_metric = reg.get(obs_metrics.FLEET_EVACUATIONS)
+            evac_count = evac_metric.value if evac_metric else 0
+            faults_mod.clear_rank_loss(1)   # the fault clears -> probe
+            post, res = se.submit(prompts[0], gens[0],
+                                  req_id="chaos-fl-post")
+            se.run()
+        rejoined = not se.evacuated and eng.n_total == 2
+        post_parity = post.tokens == golden[0]
+        diags += [f"evacuated to survivor mesh: {survivor}",
+                  f"tdtpu_fleet_evacuations_total: {evac_count:g}",
+                  f"parity vs sequential xla serve: {parity}",
+                  f"rejoined full mesh: {rejoined}",
+                  f"post-rejoin parity: {post_parity}",
+                  f"fleet log: {[e['event'] for e in se.fleet_log]}"]
+        verdict = ("detected" if survivor and parity and rejoined
+                   and post_parity and evac_count >= 1 else "error")
+    except Exception as exc:                        # died = the failure
+        verdict = "error"
+        diags.append(f"{type(exc).__name__}: {exc}")
+    finally:
+        faults_mod.clear_rank_loss()
+        obs_metrics.set_registry(prior_reg)
+        for k, v in env0.items():
+            os.environ.pop(k, None) if v is None else \
+                os.environ.__setitem__(k, v)
+    cases.append(CaseResult(
+        op="fleet_serve", mesh="2", fault="rank_loss_decode_mid_serve",
+        verdict=verdict, detected_by="evacuation",
+        expected=("detected",), ok=verdict == "detected", n_fired=1,
+        n_violations=0, diagnostics=diags,
+        elapsed_s=round(time.time() - t0, 3)))
+
+    # Row 2: prefill-role rank loss mid-migration -> demote-to-monolithic.
+    t0 = time.time()
+    diags = []
+    try:
+        from triton_distributed_tpu.disagg import (
+            DisaggServingEngine, role_contexts,
+        )
+
+        pctx, dctx = role_contexts(jax.devices()[:2])
+        p_id = int(np.asarray(pctx.mesh.devices).ravel()[0].id)
+        pe = Engine(cfg, params, pctx, backend="xla", max_seq=64)
+        de = Engine(cfg, params, dctx, backend="xla", max_seq=64,
+                    page_size=4)
+        se = DisaggServingEngine(pe, de, max_batch=2, prefill_chunk=4,
+                                 block_pages=1)
+        reqs = []
+        for i, (p, g) in enumerate(zip(prompts, gens)):
+            req, res = se.submit(p, g, req_id=f"chaos-flp-{i}")
+            assert res.name == "ADMITTED", res
+            reqs.append(req)
+        it = 0
+        while not se._streams and it < 50:
+            se.step()                       # step until a stream exists
+            it += 1
+        mid_migration = bool(se._streams)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            faults_mod.mark_rank_lost(p_id)
+            se.run(max_iters=2000)
+        parity = all(r.tokens == golden[i] for i, r in enumerate(reqs))
+        finished = all(r.state.name == "FINISHED" for r in reqs)
+        named = (se.demotion_reason is not None
+                 and "rank" in se.demotion_reason
+                 and "lost" in se.demotion_reason)
+        diags += [f"stream in flight at kill: {mid_migration}",
+                  f"demotion reason: {se.demotion_reason}",
+                  f"parity vs sequential xla serve: {parity}"]
+        verdict = ("detected" if mid_migration and not se.disagg_active
+                   and named and parity and finished else "error")
+    except Exception as exc:
+        verdict = "error"
+        diags.append(f"{type(exc).__name__}: {exc}")
+    finally:
+        faults_mod.clear_rank_loss()
+    cases.append(CaseResult(
+        op="fleet_serve", mesh="1+1",
+        fault="rank_loss_prefill_mid_migration", verdict=verdict,
+        detected_by="demotion", expected=("detected",),
+        ok=verdict == "detected", n_fired=1, n_violations=0,
+        diagnostics=diags, elapsed_s=round(time.time() - t0, 3)))
+
+    # Row 3: TDTPU_DEMOTION_LADDER=0 -> the named error propagates.
+    t0 = time.time()
+    diags = []
+    env_l = os.environ.get("TDTPU_DEMOTION_LADDER")
+    try:
+        os.environ["TDTPU_DEMOTION_LADDER"] = "0"
+        eng = Engine(cfg, params, ctx2, backend="xla", max_seq=64,
+                     page_size=4)
+        se = ServingEngine(eng, max_batch=2, prefill_chunk=4)
+        se.submit(prompts[0], 2, req_id="chaos-fl-pin")
+        faults_mod.mark_rank_lost(1)
+        try:
+            se.step()
+            verdict = "error"
+            diags.append("step() returned — the pinned geometry "
+                         "evacuated anyway")
+        except RankLossError as exc:
+            named = "rank" in str(exc) and "TDTPU_DEMOTION_LADDER" in \
+                str(exc)
+            diags.append(f"RankLossError: {str(exc)[:120]}")
+            verdict = "detected" if named and not se.evacuated else \
+                "error"
+    except Exception as exc:
+        verdict = "error"
+        diags.append(f"{type(exc).__name__}: {exc}")
+    finally:
+        faults_mod.clear_rank_loss()
+        os.environ.pop("TDTPU_DEMOTION_LADDER", None) if env_l is None \
+            else os.environ.__setitem__("TDTPU_DEMOTION_LADDER", env_l)
+    cases.append(CaseResult(
+        op="fleet_serve", mesh="2", fault="rank_loss_ladder_pinned",
+        verdict=verdict, detected_by="error", expected=("detected",),
+        ok=verdict == "detected", n_fired=1, n_violations=0,
+        diagnostics=diags, elapsed_s=round(time.time() - t0, 3)))
+    return cases
+
+
+# ---------------------------------------------------------------------------
 # Sweep + CLI.
 # ---------------------------------------------------------------------------
 
@@ -561,6 +771,14 @@ def sweep(ops, faults, ranks, *, seed: int = 0,
             cases.append(case)
             failed += not case.ok
             _print_case(case, verbose)
+        # Fleet rank-loss rows (ISSUE 11): a dead rank mid-serve ->
+        # survivor-mesh evacuation with parity + rejoin; a dead
+        # prefill-role rank mid-migration -> demote-to-monolithic;
+        # pinned geometry propagates the named error.
+        for case in fleet_selftest():
+            cases.append(case)
+            failed += not case.ok
+            _print_case(case, verbose)
     return cases, failed
 
 
@@ -582,6 +800,13 @@ def _setup_jax() -> None:
     """CLI-entry-only process setup (the replay lane runs on the host —
     never let a TPU plugin grab the process). NOT called by main(): a
     library caller (tests, a bench session) keeps its own backend."""
+    from triton_distributed_tpu.runtime.utils import (
+        ensure_virtual_cpu_devices,
+    )
+
+    # The fleet rank-loss rows serve on a 2-device virtual mesh (the
+    # flag must land before the CPU client is created).
+    ensure_virtual_cpu_devices(2)
     import jax
 
     jax.config.update("jax_platforms", "cpu")

@@ -115,7 +115,14 @@ def wait_nap_s() -> float:
 
 def record_timeout(*, sem: Any, rank: int, expected: int,
                    observed: int, waited_s: float) -> None:
-    """Append a checkable ``timeout`` event to the bounded module log."""
+    """Append a checkable ``timeout`` event to the bounded module log.
+
+    The expiry is also (a) counted per rank into the metrics registry —
+    ``tdtpu_comm_timeouts_total{rank=...}``, the obs fleet lane's
+    attribution series (ISSUE 11 satellite) — and (b) fed to any attached
+    fleet health ledgers (``resilience/fleet.py``), the suspicion
+    evidence stream evacuation verdicts build on. Both are best-effort:
+    observability must never mask the timeout it observes."""
     from triton_distributed_tpu.analysis import events as ev
 
     e = ev.Event(kind=ev.TIMEOUT, rank=int(rank), seq=0, sem=str(sem),
@@ -124,6 +131,25 @@ def record_timeout(*, sem: Any, rank: int, expected: int,
     with _LOG_LOCK:
         _TIMEOUT_EVENTS.append(e)
         del _TIMEOUT_EVENTS[:-_TIMEOUT_EVENTS_MAX]
+    try:
+        from triton_distributed_tpu.obs import metrics as obs_metrics
+        from triton_distributed_tpu.obs import trace as obs_trace
+
+        if obs_trace.is_enabled():
+            obs_metrics.registry().counter(
+                obs_metrics.COMM_TIMEOUTS,
+                "semaphore-wait deadline expiries (CommTimeoutError) "
+                "observed BY rank (the waiter — the guilty producer is "
+                "one of its peers)",
+                labels={"rank": str(int(rank))}).inc()
+    except Exception:
+        pass
+    try:
+        from triton_distributed_tpu.resilience import fleet
+
+        fleet._notify_timeout(int(rank), str(sem))
+    except Exception:
+        pass
 
 
 def drain_timeout_events() -> list:

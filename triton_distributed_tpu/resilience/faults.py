@@ -31,6 +31,15 @@ Fault classes and their injection sites:
 ``crash``          the k-th ``pallas_call`` raises a structured
                    :class:`FaultInjectionError` — a dying kernel launch
                    (what the Engine demotion ladder retries around).
+``rank_loss``      the target rank is PERMANENTLY gone (ISSUE 11): every
+                   ``pallas_call`` touching it raises
+                   :class:`RankLossError` (persistent, unlike the
+                   one-shot ``crash``), and the rank is registered in
+                   the module-level lost-rank registry
+                   (:func:`mark_rank_lost` / :func:`lost_ranks`) so
+                   host-side loops — the serving tier's fleet preflight,
+                   which runs even on the pallas-free xla path — see the
+                   loss deterministically mid-serve.
 =================  ========================================================
 
 Determinism: the occurrence index ``k`` derives from ``seed`` (or is
@@ -61,6 +70,7 @@ class FaultClass(enum.Enum):
     CORRUPT_PAYLOAD = "corrupt_payload"
     STRAGGLE = "straggle"
     CRASH = "crash"
+    RANK_LOSS = "rank_loss"
 
 
 class FaultInjectionError(RuntimeError):
@@ -71,6 +81,46 @@ class FaultInjectionError(RuntimeError):
         self.point = point
         self.rank = rank
         super().__init__(message)
+
+
+class RankLossError(FaultInjectionError):
+    """A device/rank is permanently gone (the ``rank_loss`` class): every
+    kernel touching it fails until the fault clears. TRANSIENT (a
+    FaultInjectionError subclass) so the demotion/evacuation machinery
+    owns it; ``rank`` names the lost logical rank for the health ledger
+    (``resilience/fleet.py``)."""
+
+
+# ---------------------------------------------------------------------------
+# Lost-rank registry: the persistent half of the ``rank_loss`` class.
+# A RANK_LOSS FaultPlan registers its target here for its active scope,
+# and chaos/tests can mark/clear directly — host-side consumers (the
+# serving tier's fleet preflight) poll it, so a "dead" device is visible
+# even on code paths that launch no pallas kernels (the xla backend).
+# Keys are logical ranks == jax device ids on the flat serving meshes.
+# ---------------------------------------------------------------------------
+
+_LOST_RANKS: set[int] = set()
+
+
+def mark_rank_lost(rank: int) -> None:
+    """Declare ``rank`` (a logical rank / jax device id) permanently dead
+    until :func:`clear_rank_loss` — the deterministic chaos kill switch."""
+    _LOST_RANKS.add(int(rank))
+
+
+def clear_rank_loss(rank: int | None = None) -> None:
+    """Recover ``rank`` (``None``: every lost rank) — what a repaired
+    host rejoining the fleet looks like to the rejoin probe."""
+    if rank is None:
+        _LOST_RANKS.clear()
+    else:
+        _LOST_RANKS.discard(int(rank))
+
+
+def lost_ranks() -> frozenset[int]:
+    """The currently-lost ranks (polled by the fleet preflight)."""
+    return frozenset(_LOST_RANKS)
 
 
 @dataclasses.dataclass
@@ -190,6 +240,10 @@ class FaultPlan:
                  cycles: int = 256, persistent: bool = False,
                  hash_outputs: bool = False, match: str | None = None):
         self.fault = fault
+        # rank_loss is persistent by definition — a dead chip stays dead
+        # (the one-shot form is just ``crash``).
+        if fault is FaultClass.RANK_LOSS:
+            persistent = True
         # ``match``: restrict crash faults to pallas_calls whose kernel
         # name contains this substring — "a persistent fault on the fused
         # path" is ``match="_ag_gemm"``; unmatched launches (the golden
@@ -236,6 +290,15 @@ class FaultPlan:
         e = FaultEvent(cls=self.fault.value, point=point, rank=self._rank,
                        detail=detail)
         self.fired.append(e)
+        # Evidence stream for the fleet health ledger (ISSUE 11): every
+        # fired fault is observable by attached ledgers. Best-effort —
+        # scoring must never change the injection behavior under test.
+        try:
+            from triton_distributed_tpu.resilience import fleet
+
+            fleet._notify_fault(e)
+        except Exception:
+            pass
         return e
 
     def flush(self) -> None:
@@ -402,14 +465,31 @@ class FaultPlan:
             kname = getattr(getattr(kernel, "func", kernel),
                             "__name__", "kernel")
             eligible = plan.match is None or plan.match in kname
+            # The fault's rank for diagnostics: the replayed rank inside
+            # a replay session, else the plan's fixed target — operators
+            # (and the health ledger) attribute the failure without
+            # parsing kernel names (ISSUE 11 satellite).
+            fault_rank = (plan._rank if plan._rank is not None
+                          else plan.target_rank)
+            if (plan.fault is FaultClass.RANK_LOSS and eligible
+                    and plan._should_fire()):
+                plan._record(point, f"rank {fault_rank} lost — "
+                                    f"pallas_call({kname}) unreachable")
+                raise RankLossError(
+                    f"fault injection: rank {fault_rank} is lost — "
+                    f"pallas_call({kname}) cannot touch it (class="
+                    f"rank_loss, seed={plan.seed}); the fleet ledger "
+                    "should evacuate to the survivor mesh",
+                    point=point, rank=fault_rank)
             if (plan.fault is FaultClass.CRASH and eligible
                     and plan._should_fire()):
                 plan._record(point, f"injected crash in pallas_call "
-                                    f"({kname})")
+                                    f"({kname}) on rank {fault_rank}")
                 raise FaultInjectionError(
                     f"fault injection: pallas_call({kname}) crashed by "
-                    f"plan (class=crash, seed={plan.seed})",
-                    point=point, rank=plan._rank)
+                    f"plan (class=crash, seed={plan.seed}, "
+                    f"rank={fault_rank})",
+                    point=point, rank=fault_rank)
             inner = under(*args, **kwargs)
             if not callable(inner):
                 return inner
@@ -464,7 +544,15 @@ class FaultPlan:
     @contextlib.contextmanager
     def active(self):
         """Install this plan as an instrumentation layer (an overlay when
-        a tracer session is live, the base layer otherwise)."""
+        a tracer session is live, the base layer otherwise). A RANK_LOSS
+        plan also registers its target in the lost-rank registry for the
+        scope — host-side fleet preflights see the loss even where no
+        pallas_call runs."""
+        marked = (self.fault is FaultClass.RANK_LOSS
+                  and self.target_rank is not None
+                  and int(self.target_rank) not in _LOST_RANKS)
+        if marked:
+            mark_rank_lost(self.target_rank)
         instrument.install(self.build_shims(),
                            overlay=instrument.active_layers() > 0)
         try:
@@ -476,3 +564,5 @@ class FaultPlan:
                 self.flush()
             finally:
                 instrument.uninstall()
+                if marked:
+                    clear_rank_loss(self.target_rank)

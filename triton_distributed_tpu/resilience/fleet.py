@@ -1,0 +1,391 @@
+"""Fleet health: per-rank suspicion ledger + survivor-mesh geometry.
+
+The serving stack assumed every rank stays healthy forever; at fleet
+scale a dead chip is a *when*, not an *if*, and before ISSUE 11 it
+surfaced as every collective hanging until ``CommTimeoutError`` — then
+dying, because the PR-6 demotion ladder only changes *backend*, never
+*geometry*. This module is the geometry half of the robustness spine:
+
+* :class:`HealthLedger` — scores per-rank suspicion from the evidence
+  streams the stack already produces, with **flap damping**:
+
+  - ``CommTimeoutError`` expiries (``deadline.record_timeout`` names
+    the WAITING rank/core — which proved its own liveness by raising)
+    are *hard strikes against the waiter's peer* when the complement is
+    unique (a 2-rank group), ``TDTPU_DEAD_AFTER`` of them confirming
+    the peer dead; with more peers the guilt is ambiguous and the
+    expiry only raises soft suspicion across them;
+  - injected ``crash`` faults (the ``FaultEvent`` stream, which names
+    the rank since ISSUE 11's satellite fix) are hard strikes too;
+  - repeated *straggle* observations (the rotating
+    ``resolve_straggler`` form, or STRAGGLE fault events) are **soft**
+    evidence: they raise suspicion — which the serving loop converts
+    into a narrower admission width — but can NEVER cross the dead
+    threshold. A slow-but-alive rank degrades throughput, not
+    membership; suspicion decays on clean iterations so a recovered
+    straggler re-earns its width back;
+  - a ``rank_loss`` fault (``faults.mark_rank_lost`` / a persistent
+    :class:`~.faults.RankLossError`) is the hard signal: immediately
+    DEAD, deterministically.
+
+* :func:`survivor_context` — the largest valid TP sub-mesh over the
+  surviving devices (TP=8 → TP=4 when the kv-head divisibility demands
+  it), reusing the disagg tier's sub-context mechanics. The serving loop
+  evacuates onto it: preempt everything in flight, re-partition the
+  engine (``Engine.repartition`` host-reshards the params), rebuild the
+  serving jits through the existing ``_first_call`` path, and resume
+  with recompute-on-resume — KV pages that lived on the lost shard are
+  simply re-prefilled (the PR-7 preemption contract).
+
+* a **rejoin probe** mirrors the PR-6 clean-streak re-promotion: after
+  ``TDTPU_REJOIN_AFTER`` clean iterations with the loss cleared, the
+  loop re-expands to the full mesh; if the probe fails the next failure
+  evacuates again — no request is ever lost either way.
+
+Evidence plumbing: ledgers register in a module-level weak set on
+construction; ``deadline.record_timeout`` and ``FaultPlan._record`` call
+:func:`_notify_timeout` / :func:`_notify_fault` lazily, so the evidence
+streams feed every live ledger with zero coupling in the hot paths.
+
+On this container the "dead" device is simulated (the lost-rank
+registry / fault plane); on real hardware the same ledger consumes the
+same streams, and the host-reshard step would re-load params from a
+checkpoint instead of ``jax.device_put``-resharding off the old mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+import weakref
+
+import numpy as np
+from jax.sharding import Mesh
+
+from triton_distributed_tpu.resilience.deadline import CommTimeoutError
+from triton_distributed_tpu.resilience.faults import (
+    FaultInjectionError, RankLossError,
+)
+from triton_distributed_tpu.runtime.context import DistContext
+
+DEFAULT_DEAD_AFTER = 2       # hard strikes that confirm a rank dead
+DEFAULT_SUSPECT_AT = 1.0     # suspicion score at/above which = SUSPECT
+DEFAULT_DECAY = 0.25         # suspicion shed per clean iteration
+STRAGGLE_WEIGHT = 0.5        # soft-evidence increment per observation
+
+
+def _env_num(var: str, default, cast):
+    try:
+        return cast(os.environ.get(var, "") or default)
+    except ValueError:
+        return cast(default)
+
+
+class HealthVerdict(enum.Enum):
+    HEALTHY = "healthy"
+    SUSPECT = "suspect"      # degrade admission width, keep membership
+    DEAD = "dead"            # evacuate to the survivor mesh
+
+
+@dataclasses.dataclass
+class RankHealth:
+    """One rank's evidence record. ``rank`` is the logical rank == jax
+    device id on the flat serving meshes this ledger covers."""
+
+    rank: int
+    suspicion: float = 0.0   # soft score (straggles; decays when clean)
+    hard_strikes: int = 0    # timeouts + crashes (sticky until absolved)
+    timeouts: int = 0
+    crashes: int = 0
+    straggles: int = 0
+    lost: bool = False       # the rank_loss hard signal
+
+
+# Live ledgers (weak: a dropped ServingEngine must not keep scoring).
+_LEDGERS: "weakref.WeakSet[HealthLedger]" = weakref.WeakSet()
+
+
+def _notify_timeout(rank: int, sem: str) -> None:
+    """Called (lazily) by ``deadline.record_timeout`` on every expiry.
+
+    SOFT evidence only, like :func:`_notify_fault` and for the same
+    reason: a process-wide broadcast cannot be scoped to one engine's
+    mesh, so an expiry from an unrelated replay or tier must never
+    hard-strike another ledger's 2-rank complement. Hard strikes arrive
+    through the scoped channel instead — the engine that actually caught
+    the error calls :meth:`HealthLedger.observe_error`."""
+    for ledger in list(_LEDGERS):
+        ledger.observe_timeout_soft(rank, sem=sem)
+
+
+def _notify_fault(event) -> None:
+    """Called (lazily) by ``FaultPlan._record`` on every fired fault.
+
+    Only STRAGGLE events score here, as soft evidence: a replayed-rank
+    event cannot be scoped to one engine's mesh, and soft suspicion is
+    the only verdict that is harmless when over-attributed (it narrows
+    admission, decays when clean, and can never evacuate). Hard evidence
+    reaches ledgers through scoped channels instead: error attribution
+    (:meth:`HealthLedger.observe_error` on the failure the engine itself
+    caught) and the lost-rank registry (:meth:`HealthLedger.sync_lost`).
+    """
+    if event.rank is None or event.cls != "straggle":
+        return
+    for ledger in list(_LEDGERS):
+        ledger.observe_straggle(event.rank)
+
+
+def _attribution(exc: BaseException
+                 ) -> tuple[BaseException, int] | None:
+    """(carrier, rank) for the chain element that actually names a rank
+    — transients routinely arrive wrapped (XlaRuntimeError /
+    JaxStackTraceBeforeTransformation around the real error), and the
+    CARRIER's type decides the evidence class, not the wrapper's."""
+    seen: set[int] = set()
+    while exc is not None and id(exc) not in seen:
+        seen.add(id(exc))
+        if isinstance(exc, (FaultInjectionError, CommTimeoutError)):
+            r = getattr(exc, "rank", None)
+            if r is not None:
+                return exc, int(r)
+        exc = exc.__cause__ or exc.__context__
+    return None
+
+
+def attribute_rank(exc: BaseException) -> int | None:
+    """The logical rank an exception blames, walking the cause chain:
+    :class:`RankLossError` / :class:`FaultInjectionError` carry
+    ``.rank``, :class:`CommTimeoutError` names the waiting core. None
+    when nothing in the chain points at a rank (the failure is not the
+    fleet's to judge)."""
+    hit = _attribution(exc)
+    return None if hit is None else hit[1]
+
+
+class HealthLedger:
+    """Per-rank suspicion scores over one set of devices (ISSUE 11).
+
+    Knobs (env, resolved at construction):
+
+    * ``TDTPU_DEAD_AFTER`` (default 2) — hard strikes (timeouts /
+      crashes) that confirm a rank dead;
+    * ``TDTPU_SUSPECT_AT`` (default 1.0) — suspicion score at which a
+      rank turns SUSPECT (admission narrows);
+    * ``TDTPU_SUSPICION_DECAY`` (default 0.25) — suspicion shed per
+      clean iteration (the damping that lets a recovered straggler
+      re-earn its width).
+    """
+
+    def __init__(self, ranks, *, dead_after: int | None = None,
+                 suspect_at: float | None = None,
+                 decay: float | None = None):
+        self._health = {int(r): RankHealth(rank=int(r)) for r in ranks}
+        self.dead_after = (dead_after if dead_after is not None
+                           else _env_num("TDTPU_DEAD_AFTER",
+                                         DEFAULT_DEAD_AFTER, int))
+        self.suspect_at = (suspect_at if suspect_at is not None
+                           else _env_num("TDTPU_SUSPECT_AT",
+                                         DEFAULT_SUSPECT_AT, float))
+        self.decay = (decay if decay is not None
+                      else _env_num("TDTPU_SUSPICION_DECAY",
+                                    DEFAULT_DECAY, float))
+        self._suspicion_epoch = 0    # bumped on every observation
+        self._suspicion_seen = 0     # consumed by the serving loop
+        self.log: list[dict] = []
+        _LEDGERS.add(self)
+
+    @classmethod
+    def for_context(cls, ctx: DistContext, **kw) -> "HealthLedger":
+        """A ledger over every device of ``ctx``'s mesh (logical rank =
+        jax device id — the flat serving meshes keep them equal)."""
+        ids = [int(d.id) for d in np.asarray(ctx.mesh.devices).ravel()]
+        return cls(ids, **kw)
+
+    # -- evidence ------------------------------------------------------------
+    _LOG_MAX = 256   # bounded like deadline's _TIMEOUT_EVENTS_MAX
+
+    def _log(self, rec: dict) -> None:
+        self.log.append(rec)
+        del self.log[:-self._LOG_MAX]
+
+    def _rh(self, rank) -> RankHealth | None:
+        return self._health.get(int(rank))
+
+    def observe_timeout(self, waiter, sem: str = "") -> int | None:
+        """A semaphore-wait deadline expired on ``waiter`` — evidence
+        AGAINST the waiter's peers, not the waiter: the waiting rank
+        proved its own liveness by raising, and the producer that never
+        signalled is one of the others (``deadline.py`` can only name
+        the waiting core). With exactly one other tracked rank the
+        complement is unique — a hard strike against it; with more, the
+        guilt is ambiguous, so every other rank gains soft suspicion
+        (admission narrows; nobody is evicted on evidence that cannot
+        pinpoint a rank). Returns the hard-struck rank, None when
+        ambiguous or the waiter is untracked."""
+        w = int(waiter)
+        if w not in self._health:
+            return None
+        peers = [rh for r, rh in self._health.items() if r != w]
+        self._suspicion_epoch += 1
+        if len(peers) == 1:
+            rh = peers[0]
+            rh.timeouts += 1
+            rh.hard_strikes += 1
+            rh.suspicion += 1.0
+            self._log({"rank": rh.rank, "evidence": "timeout",
+                       "sem": sem, "waiter": w})
+            return rh.rank
+        for rh in peers:
+            rh.timeouts += 1
+            rh.suspicion += STRAGGLE_WEIGHT
+        self._log({"rank": None, "evidence": "timeout", "sem": sem,
+                   "waiter": w, "suspects": [rh.rank for rh in peers]})
+        return None
+
+    def observe_timeout_soft(self, waiter, sem: str = "") -> None:
+        """The broadcast form (:func:`_notify_timeout`): suspicion only
+        across the waiter's peers, never a hard strike — unscoped
+        evidence may narrow admission but must not build a dead
+        verdict."""
+        w = int(waiter)
+        if w not in self._health:
+            return
+        self._suspicion_epoch += 1
+        for r, rh in self._health.items():
+            if r != w:
+                rh.suspicion += STRAGGLE_WEIGHT
+
+    def observe_crash(self, rank) -> None:
+        rh = self._rh(rank)
+        if rh is None:
+            return
+        rh.crashes += 1
+        rh.hard_strikes += 1
+        rh.suspicion += 1.0
+        self._suspicion_epoch += 1
+        self._log({"rank": rh.rank, "evidence": "crash"})
+
+    def observe_straggle(self, rank) -> None:
+        """Soft evidence: raises suspicion (→ SUSPECT → admission
+        narrows) but never hard strikes — a straggler is throttled, not
+        evicted (the flap-damping contract)."""
+        rh = self._rh(rank)
+        if rh is None:
+            return
+        rh.straggles += 1
+        rh.suspicion += STRAGGLE_WEIGHT
+        self._suspicion_epoch += 1
+
+    def observe_lost(self, rank) -> None:
+        rh = self._rh(rank)
+        if rh is None:
+            return
+        if not rh.lost:
+            rh.lost = True
+            self._log({"rank": rh.rank, "evidence": "rank_loss"})
+
+    def observe_error(self, exc: BaseException) -> int | None:
+        """Score a failure by attribution; returns the rank the evidence
+        actually BLAMES (so the caller can consult :meth:`verdict`).
+        For a :class:`CommTimeoutError` the named rank is the *waiter*
+        — the blamed rank is its unique peer when one exists, None when
+        the guilt is ambiguous (the failure is then not the fleet's to
+        absorb). Dispatch is on the chain element that CARRIED the rank:
+        transients routinely arrive wrapped, and classifying a wrapped
+        timeout as a crash would hard-strike the provably-alive waiter."""
+        hit = _attribution(exc)
+        if hit is None:
+            return None
+        carrier, rank = hit
+        if self._rh(rank) is None:
+            return None
+        if isinstance(carrier, RankLossError):
+            self.observe_lost(rank)
+            return rank
+        if isinstance(carrier, CommTimeoutError):
+            return self.observe_timeout(
+                rank, sem=str(getattr(carrier, "sem", "")))
+        self.observe_crash(rank)
+        return rank
+
+    def observe_clean(self) -> None:
+        """One clean iteration: suspicion decays (flap damping) — soft
+        evidence ages out; hard strikes and the lost flag stay until
+        :meth:`absolve`."""
+        for rh in self._health.values():
+            rh.suspicion = max(0.0, rh.suspicion - self.decay)
+
+    def sync_lost(self, lost: frozenset[int] | set[int]) -> list[int]:
+        """Fold the lost-rank registry (``faults.lost_ranks()``) in;
+        returns the ranks that just turned DEAD."""
+        newly = []
+        for rh in self._health.values():
+            if rh.rank in lost and not rh.lost:
+                self.observe_lost(rh.rank)
+                newly.append(rh.rank)
+        return newly
+
+    def absolve(self, rank) -> None:
+        """Reset a rank's record (the rejoin probe readmits it with a
+        clean slate — a relapse re-earns its strikes from zero)."""
+        r = int(rank)
+        if r in self._health:
+            self._health[r] = RankHealth(rank=r)
+
+    # -- verdicts ------------------------------------------------------------
+    def verdict(self, rank) -> HealthVerdict:
+        rh = self._rh(rank)
+        if rh is None:
+            return HealthVerdict.HEALTHY
+        if rh.lost or rh.hard_strikes >= self.dead_after:
+            return HealthVerdict.DEAD
+        if rh.suspicion >= self.suspect_at:
+            return HealthVerdict.SUSPECT
+        return HealthVerdict.HEALTHY
+
+    def dead(self) -> list[int]:
+        return [r for r in self._health
+                if self.verdict(r) is HealthVerdict.DEAD]
+
+    def suspects(self) -> list[int]:
+        return [r for r in self._health
+                if self.verdict(r) is HealthVerdict.SUSPECT]
+
+    def alive(self) -> list[int]:
+        return [r for r in self._health
+                if self.verdict(r) is not HealthVerdict.DEAD]
+
+    def consume_new_suspicion(self) -> bool:
+        """True once per batch of new suspicion evidence — the serving
+        loop's edge trigger for narrowing admission (level-triggering
+        would walk the cap to 1 on a single stale observation)."""
+        if self._suspicion_epoch > self._suspicion_seen:
+            self._suspicion_seen = self._suspicion_epoch
+            return True
+        return False
+
+    def health(self, rank) -> RankHealth | None:
+        return self._rh(rank)
+
+
+def survivor_context(ctx: DistContext, dead: list[int], *,
+                     axis: str = "tp",
+                     num_kv_heads: int | None = None
+                     ) -> DistContext | None:
+    """The largest valid TP context over ``ctx``'s surviving devices.
+
+    Reuses the disagg tier's sub-context mechanics (``_sub_context``):
+    the survivors flatten onto a 1-axis ``axis`` mesh. ``num_kv_heads``
+    constrains the degree (the Engine's divisibility contract) — losing
+    1 of 8 ranks yields TP=4, not TP=7. None when no valid geometry
+    remains (every rank dead, or no divisor fits)."""
+    dead_set = {int(r) for r in dead}
+    devs = [d for d in np.asarray(ctx.mesh.devices).ravel()
+            if int(d.id) not in dead_set]
+    for n in range(len(devs), 0, -1):
+        if num_kv_heads is None or num_kv_heads % n == 0:
+            chosen = np.asarray(devs[:n])
+            return DistContext(mesh=Mesh(chosen, (axis,)), tp_axis=axis,
+                               wait_timeout_ms=ctx.wait_timeout_ms)
+    return None

@@ -20,6 +20,21 @@ def cdiv(a: int, b: int) -> int:
     return -(-a // b)
 
 
+def ensure_virtual_cpu_devices(n: int = 2) -> None:
+    """Request an ``n``-device virtual CPU platform via ``XLA_FLAGS``.
+
+    Must run before the CPU client is created (the flag is read once at
+    backend initialization — in an already-initialized process this is a
+    no-op and callers guard on ``len(jax.devices())``). An existing
+    ``--xla_force_host_platform_device_count`` flag, whatever its count,
+    is respected. Shared by the CLI entry points that serve on small
+    virtual meshes (chaos, loadgen --dryrun)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}")
+
+
 def round_up(a: int, b: int) -> int:
     return cdiv(a, b) * b
 

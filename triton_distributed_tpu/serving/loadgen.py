@@ -179,6 +179,16 @@ def dryrun(json_path: str | None) -> int:
     (a) per-request token parity vs sequential serve incl. a
     preempt/resume, (b) admission backpressure on pool exhaustion,
     (c) SLO violation streak shrinks the admitted batch."""
+    import os
+
+    from triton_distributed_tpu.runtime.utils import (
+        ensure_virtual_cpu_devices,
+    )
+
+    # Phase 6 (the fleet round-trip) needs a 2-device virtual mesh; in
+    # an already-initialized process the flag is inert and the phase
+    # guards on the actual device count.
+    ensure_virtual_cpu_devices(2)
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -390,6 +400,110 @@ def dryrun(json_path: str | None) -> int:
         "page_id_rewrites": len(rewrites),
         "all_finished": dg_report["all_finished"],
     }
+
+    # Phase 6 (ISSUE 11) — elastic fleet: a TP=2 serving tier loses
+    # rank 1 mid-serve, EVACUATES to the TP=1 survivor mesh (every
+    # in-flight request preempted, engine re-partitioned, params
+    # host-resharded, recompute-on-resume), keeps per-request token
+    # parity AND first-submission TTFT accounting, then REJOINS the
+    # full mesh after the fault clears — the post-rejoin request must
+    # also be token-identical (docs/resilience.md "Fleet degradation").
+    import warnings as _warnings
+
+    from triton_distributed_tpu.resilience import faults as _faults
+
+    if len(jax.devices()) < 2:
+        failures.append(
+            "fleet phase needs >= 2 virtual CPU devices "
+            "(--xla_force_host_platform_device_count applied too late?)")
+    else:
+        fl_cfg = engine.cfg
+        fl_params = engine.params
+        ctx_fl = initialize_distributed(mesh_shape=(2,), axis_names=("tp",),
+                                        devices=jax.devices()[:2])
+        fl_oracle = _Engine(fl_cfg, fl_params, ctx_fl, backend="xla",
+                            max_seq=64)
+        fl_trace = [
+            {"req_id": "fl-0", "arrival_iter": 0,
+             "prompt": list(range(10, 16)), "max_new_tokens": 6,
+             "priority": 0},
+            {"req_id": "fl-1", "arrival_iter": 0,
+             "prompt": list(range(30, 38)), "max_new_tokens": 5,
+             "priority": 0},
+        ]
+        fl_golden = sequential_reference(fl_oracle, fl_trace)
+        fl_eng = _Engine(fl_cfg, fl_params, ctx_fl, backend="xla",
+                         max_seq=64, page_size=4)
+        from triton_distributed_tpu.serving.loop import (
+            ServingEngine as _ServingEngine,
+        )
+
+        # The rejoin streak is resolved at CONSTRUCTION (ServingEngine
+        # reads TDTPU_REJOIN_AFTER once) — set it before building the tier.
+        rejoin_env = os.environ.get("TDTPU_REJOIN_AFTER")
+        os.environ["TDTPU_REJOIN_AFTER"] = "3"
+        se6 = _ServingEngine(fl_eng, max_batch=2, prefill_chunk=4)
+        fl_reqs = {}
+        for item in fl_trace:
+            req, res = se6.submit(item["prompt"], item["max_new_tokens"],
+                                  req_id=item["req_id"])
+            assert res is AdmitResult.ADMITTED, res
+            fl_reqs[req.req_id] = req
+        for _ in range(3):
+            se6.step()                  # first tokens land on the full mesh
+        ttft_before = {rid: r.t_first_token for rid, r in fl_reqs.items()
+                       if r.t_first_token is not None}
+        try:
+            with _warnings.catch_warnings():
+                _warnings.simplefilter("ignore", RuntimeWarning)
+                _faults.mark_rank_lost(1)           # the mid-serve kill
+                se6.run()
+                fl_parity = [rid for rid, r in fl_reqs.items()
+                             if r.tokens != fl_golden[rid]]
+                evacuated = se6.evacuated and fl_eng.n_total == 1
+                _faults.clear_rank_loss(1)          # repaired -> probe
+                post_req, _ = se6.submit(fl_trace[0]["prompt"],
+                                         fl_trace[0]["max_new_tokens"],
+                                         req_id="fl-post")
+                se6.run()
+        finally:
+            _faults.clear_rank_loss()
+            if rejoin_env is None:
+                os.environ.pop("TDTPU_REJOIN_AFTER", None)
+            else:
+                os.environ["TDTPU_REJOIN_AFTER"] = rejoin_env
+        rejoined = (not se6.evacuated) and fl_eng.n_total == 2
+        ttft_kept = all(fl_reqs[rid].t_first_token == t
+                        for rid, t in ttft_before.items())
+        if not evacuated:
+            failures.append(
+                "rank loss did not evacuate the tier to the survivor mesh")
+        if fl_parity:
+            failures.append("fleet evacuation broke token parity vs "
+                            f"sequential serve: {fl_parity}")
+        if se6.evacuation_preemptions < 1:
+            failures.append(
+                "no request was preempted by the evacuation — the kill "
+                "no longer lands mid-serve")
+        if not ttft_kept:
+            failures.append(
+                "evacuation reset first-submission TTFT accounting")
+        if not rejoined:
+            failures.append(
+                "the rejoin probe did not re-expand to the full mesh after "
+                "the fault cleared")
+        if post_req.tokens != fl_golden["fl-0"]:
+            failures.append("post-rejoin token parity broken vs sequential "
+                            "serve")
+        report["fleet"] = {
+            "evacuated": evacuated,
+            "parity_ok": not fl_parity,
+            "evacuation_preemptions": se6.evacuation_preemptions,
+            "ttft_first_submission_kept": ttft_kept,
+            "rejoined": rejoined,
+            "post_rejoin_parity": post_req.tokens == fl_golden["fl-0"],
+            "events": [e["event"] for e in se6.fleet_log],
+        }
 
     report["failures"] = failures
     if json_path:

@@ -111,13 +111,23 @@ class ServingEngine:
         1). The watchdog's stall rule globs the run directory per
         evaluation — on a long-running loop with a large obs run dir,
         raise this to keep the hot loop off the filesystem.
+      fleet: a :class:`~triton_distributed_tpu.resilience.fleet.
+        HealthLedger` to score rank health against (default: one over
+        the engine's mesh). The fleet preflight runs every iteration:
+        a confirmed-dead rank EVACUATES the tier to the survivor
+        sub-mesh (preempt everything, re-partition, recompute-on-
+        resume), suspicion narrows admission, and after
+        ``TDTPU_REJOIN_AFTER`` clean iterations with the loss cleared a
+        rejoin probe re-expands to the full mesh — docs/resilience.md
+        "Fleet degradation". ``TDTPU_DEMOTION_LADDER=0`` opts out: the
+        named ``RankLossError`` propagates instead.
     """
 
     def __init__(self, engine: Engine, *, max_batch: int = 4,
                  num_pages: int | None = None,
                  prefill_chunk: int | None = None,
                  max_waiting: int = 64, slo_cfg=None, slo_every: int = 1,
-                 clock=time.perf_counter):
+                 fleet=None, clock=time.perf_counter):
         if engine.page_size is None:
             raise ServingConfigError(
                 "engine has no paged cache: construct Engine(page_size=...) "
@@ -213,6 +223,23 @@ class ServingEngine:
         self._viol_streak = 0
         self._clean_streak = 0
         self._finished: list[Request] = []
+        # Fleet-health state (ISSUE 11, docs/resilience.md): the ledger
+        # scores rank suspicion from the evidence streams; the full-mesh
+        # context is kept for the rejoin probe. The strong ref keeps the
+        # weakly-registered ledger subscribed for this tier's lifetime.
+        from triton_distributed_tpu.resilience import fleet as fleet_mod
+
+        self.fleet = (fleet if fleet is not None
+                      else fleet_mod.HealthLedger.for_context(engine.ctx))
+        self._full_ctx = engine.ctx
+        self._full_rank_ids = [
+            int(d.id) for d in
+            np.asarray(engine.ctx.mesh.devices).ravel()]
+        self.evacuated = False
+        self.evacuation_preemptions = 0   # evacuation/rejoin recomputes
+        self.fleet_log: list[dict] = []   # evacuation / rejoin records
+        self._clean_since_evac = 0
+        self._rejoin_after = _env_int("TDTPU_REJOIN_AFTER", 8)
 
     # -- megakernel serving lane (round 9) ----------------------------------
     def _build_megakernel_lane(self, pool_pages: int):
@@ -372,11 +399,37 @@ class ServingEngine:
 
     # -- the mixed iteration --------------------------------------------------
     def step(self) -> dict:
-        """One scheduler iteration (admit → prefill slice → page growth /
-        preemption → decode). Returns a host-side summary dict."""
+        """One scheduler iteration (fleet preflight → admit → prefill
+        slice → page growth / preemption → decode). Returns a host-side
+        summary dict; ``summary["fleet"]`` names a fleet action when one
+        happened this iteration: ``"evacuated"`` / ``"rejoined"``
+        (geometry transitions) or ``"retried"`` (a rank-attributable
+        failure absorbed below the evacuation threshold — geometry
+        kept, in-flight work recomputed)."""
         now = self.clock()
         if self._t0 is None:
             self._t0 = now
+        fleet_event = self._fleet_preflight()
+        self._sync_backend()
+        try:
+            summary = self._step_work(now)
+        except Exception as exc:
+            handled = self._fleet_on_failure(exc)
+            if not handled:
+                raise
+            self._iter += 1
+            fleet_event = fleet_event or handled
+            summary = {"iter": self._iter, "admitted": [],
+                       "prefilled": None, "preempted": [], "decoded": 0,
+                       "waiting": len(self.sched.waiting),
+                       "active": self.sched.active_count,
+                       "free_pages": self.sched.allocator.free_count,
+                       "admit_cap": self.sched.admit_cap}
+        if fleet_event:
+            summary["fleet"] = fleet_event
+        return summary
+
+    def _sync_backend(self) -> None:
         # The demotion ladder (driven from _slo_tick below, or by the
         # engine's own serve) swaps the backend and clears the ENGINE's
         # jit cache; this tier's slice/logits jits captured the OLD
@@ -408,6 +461,8 @@ class ServingEngine:
                 else:
                     for req in list(self.sched.running()):
                         self.sched._preempt(req)
+
+    def _step_work(self, now: float) -> dict:
         admitted = self.sched.schedule_admissions()
         head = self.sched.prefill_head()
         prefilled = None
@@ -433,6 +488,12 @@ class ServingEngine:
                             "(recompute-on-resume)").inc(len(preempted))
             self._publish_gauges(reg)
         self._slo_tick()
+        if self.fleet is not None:
+            # Clean iteration: soft suspicion decays (flap damping) and
+            # the rejoin streak advances while evacuated.
+            self.fleet.observe_clean()
+            if self.evacuated:
+                self._clean_since_evac += 1
         return {"iter": self._iter, "admitted": [r.req_id for r in admitted],
                 "prefilled": prefilled,
                 "preempted": [r.req_id for r in preempted],
@@ -475,6 +536,241 @@ class ServingEngine:
         double-buffer rotation each (disagg/engine.py). The monolithic
         tier migrates nothing."""
         return 0
+
+    # -- fleet elasticity (ISSUE 11, docs/resilience.md) ----------------------
+    def _mesh_rank_ids(self) -> list[int]:
+        """Device ids of the engine's CURRENT mesh, cached on context
+        identity — the geometry only changes at evacuate/rejoin (which
+        install a fresh DistContext), and the preflight runs every
+        iteration of the hot loop."""
+        ctx = self.engine.ctx
+        cached = getattr(self, "_mesh_ids_cache", None)
+        if cached is None or cached[0] is not ctx:
+            cached = (ctx, [int(d.id) for d in
+                            np.asarray(ctx.mesh.devices).ravel()])
+            self._mesh_ids_cache = cached
+        return cached[1]
+
+    def _count_fleet_preemptions(self, reg, n: int) -> None:
+        if n:
+            reg.counter(
+                obs_metrics.SERVE_EVAC_PREEMPTIONS,
+                "sequences recomputed because the fleet preempted them "
+                "(evacuation / rejoin / suspect-rank retry)").inc(n)
+
+    def _fleet_preflight(self) -> str | None:
+        """Per-iteration fleet health pass: fold the lost-rank registry
+        into the ledger, EVACUATE when a rank of the current mesh is
+        confirmed dead, narrow admission on fresh suspicion (flap
+        damping: a straggler costs width, never membership), and fire
+        the rejoin probe once the loss has cleared for
+        ``TDTPU_REJOIN_AFTER`` clean iterations."""
+        if self.fleet is None:
+            return None
+        from triton_distributed_tpu.resilience import faults as faults_mod
+
+        lost = faults_mod.lost_ranks()
+        self.fleet.sync_lost(lost)
+        mesh_ids = set(self._mesh_rank_ids())
+        dead_here = sorted(r for r in self.fleet.dead() if r in mesh_ids)
+        if dead_here:
+            self._evacuate(dead_here,
+                           reason=f"rank(s) {dead_here} confirmed dead")
+            return "evacuated"
+        if (self.fleet.consume_new_suspicion() and self.fleet.suspects()
+                and self.sched.admit_cap > 1):
+            cap = self.sched.shrink_admission()
+            with obs_trace.span("serving.admission_shrink", cap=cap,
+                                reason="fleet_suspicion"):
+                pass
+        if (self.evacuated and self._clean_since_evac >= self._rejoin_after
+                and not (set(self._full_rank_ids) & set(lost))):
+            self._rejoin()
+            return "rejoined"
+        return None
+
+    def _fleet_on_failure(self, exc: BaseException) -> str | None:
+        """Transient, rank-attributable step failure: score the ledger
+        and either evacuate (confirmed dead — returns ``"evacuated"``)
+        or preempt-and-recompute on the KEPT geometry (suspicion — a
+        slow-but-alive rank must not be evicted on one strike; returns
+        ``"retried"``). Returns None when the failure is not the fleet's
+        to handle (the caller re-raises)."""
+        from triton_distributed_tpu import resilience
+        from triton_distributed_tpu.resilience import fleet as fleet_mod
+
+        if self.fleet is None or not resilience.is_transient(exc):
+            return None
+        if os.environ.get("TDTPU_DEMOTION_LADDER", "1") == "0":
+            return None
+        rank = self.fleet.observe_error(exc)
+        if rank is None or rank not in self._mesh_rank_ids():
+            return None
+        if self.fleet.verdict(rank) is fleet_mod.HealthVerdict.DEAD:
+            mesh_ids = set(self._mesh_rank_ids())
+            dead_here = sorted(r for r in self.fleet.dead()
+                               if r in mesh_ids)
+            self._evacuate(
+                dead_here or [rank],
+                reason=f"{type(exc).__name__}: {str(exc)[:120]}", exc=exc)
+            return "evacuated"
+        # Suspicion, not a verdict: the in-flight step's device state is
+        # unknown (a failed donated jit may have consumed the cache), so
+        # preempt everything and rebuild — recompute-on-resume is always
+        # state-correct, and the geometry survives the flap.
+        n = self._preempt_all()
+        self._rebuild_device_state()
+        if self._observing():
+            reg = obs_metrics.registry()
+            reg.counter(obs_metrics.FLEET_STEP_FAULTS,
+                        "rank-attributable step failures absorbed below "
+                        "the evacuation threshold").inc()
+            self._count_fleet_preemptions(reg, n)
+        return "retried"
+
+    def _preempt_all(self) -> int:
+        """Preempt every in-flight request (recompute-on-resume). First-
+        submission accounting is untouched: ``t_arrival`` and any stamped
+        ``t_first_token`` survive, so an evacuated request keeps its real
+        TTFT evidence."""
+        evicted = list(self.sched.active)
+        for req in evicted:
+            self.sched._preempt(req)
+        self.evacuation_preemptions += len(evicted)
+        return len(evicted)
+
+    def _rebuild_device_state(self) -> None:
+        """Fresh KV pools + prefill buffer on the engine's CURRENT mesh
+        and a cleared jit cache — the serving-side half of a
+        repartition (jits rebuild lazily through ``_first_call``)."""
+        eng = self.engine
+        mesh = eng.ctx.mesh
+
+        def put(tree, specs):
+            return jax.device_put(
+                tree, jax.tree.map(lambda s: NamedSharding(mesh, s),
+                                   specs,
+                                   is_leaf=lambda x: isinstance(x, P)))
+
+        cache = init_paged_model_cache(
+            self.cfg, self.max_batch, page_size=self.page,
+            max_pages=self.max_pages, num_pages=self.num_pages + 1)
+        self._cache = put(cache, paged_cache_specs(eng.shard_axes))
+        self._pf_cache = put(init_kv_cache(self.cfg, 1, self.s_buf),
+                             kv_cache_specs(eng.shard_axes))
+        self._jits.clear()
+        self._jits_backend = eng.backend
+        self._mk = None
+        self._mk_ws = None
+        if eng.backend == "megakernel":
+            from triton_distributed_tpu.resilience import (
+                BackendUnsupportedError,
+            )
+
+            try:
+                self._mk = self._build_megakernel_lane(self.num_pages)
+            except BackendUnsupportedError as exc:
+                # The mesh ladder composes with the backend ladder:
+                # geometry demoted first; backend only now, because the
+                # survivor mesh cannot host the persistent lane.
+                self._demote_backend(str(exc))
+                self._jits_backend = eng.backend
+
+    def _evacuate(self, dead: list[int], reason: str,
+                  exc: BaseException | None = None) -> None:
+        """Confirmed-dead verdict: preempt all in-flight requests,
+        re-partition onto the survivor sub-mesh (TP=8 → TP=4 style),
+        host-reshard params, rebuild pools/jits, resume with
+        recompute-on-resume. ``TDTPU_DEMOTION_LADDER=0`` opts out — the
+        named error propagates (geometry demotion must never mask a
+        config the operator pinned)."""
+        from triton_distributed_tpu.resilience import fleet as fleet_mod
+        from triton_distributed_tpu.resilience.faults import RankLossError
+
+        if os.environ.get("TDTPU_DEMOTION_LADDER", "1") == "0":
+            if exc is not None:
+                raise exc
+            raise RankLossError(
+                f"rank(s) {dead} confirmed dead and TDTPU_DEMOTION_LADDER"
+                f"=0 pins the geometry — {reason}", rank=dead[0])
+        sub = fleet_mod.survivor_context(
+            self._full_ctx, self.fleet.dead(), axis=self.engine.axis,
+            num_kv_heads=self.cfg.num_kv_heads)
+        if sub is None:
+            raise (exc if exc is not None else RankLossError(
+                f"rank(s) {dead} dead and no survivor TP geometry exists "
+                f"(num_kv_heads {self.cfg.num_kv_heads}) — {reason}",
+                rank=dead[0]))
+        n_evicted = self._preempt_all()
+        old_n = self.engine.n_total
+        self.engine.repartition(sub, reason=reason)
+        self._rebuild_device_state()
+        self.evacuated = True
+        self._clean_since_evac = 0
+        rec = {"event": "evacuation", "dead": sorted(dead),
+               "reason": reason, "from_ranks": old_n,
+               "to_ranks": self.engine.n_total, "preempted": n_evicted}
+        self.fleet_log.append(rec)
+        with obs_trace.span("fleet.evacuation", dead=str(sorted(dead)),
+                            reason=reason, from_ranks=old_n,
+                            to_ranks=self.engine.n_total,
+                            preempted=n_evicted):
+            pass
+        if self._observing():
+            reg = obs_metrics.registry()
+            reg.counter(obs_metrics.FLEET_EVACUATIONS,
+                        "survivor-mesh evacuations (rank confirmed dead)"
+                        ).inc()
+            self._count_fleet_preemptions(reg, n_evicted)
+            self._publish_fleet_gauges(reg)
+        import warnings
+
+        warnings.warn(
+            f"fleet evacuated rank(s) {sorted(dead)}: {old_n} -> "
+            f"{self.engine.n_total} ranks ({reason})", RuntimeWarning,
+            stacklevel=3)
+
+    def _rejoin(self) -> None:
+        """Rejoin probe (the clean-streak mirror of evacuation): after
+        ``TDTPU_REJOIN_AFTER`` clean iterations with the loss cleared,
+        re-expand to the full mesh. In-flight requests preempt and
+        recompute, so a probe that fails — the rank dies again — just
+        evacuates again without losing any request."""
+        n_evicted = self._preempt_all()
+        old_n = self.engine.n_total
+        self.engine.repartition(self._full_ctx, reason="fleet rejoin probe")
+        self._rebuild_device_state()
+        for r in self._full_rank_ids:
+            self.fleet.absolve(r)
+        self.evacuated = False
+        self._clean_since_evac = 0
+        rec = {"event": "rejoin", "from_ranks": old_n,
+               "to_ranks": self.engine.n_total, "preempted": n_evicted}
+        self.fleet_log.append(rec)
+        with obs_trace.span("fleet.rejoin", from_ranks=old_n,
+                            to_ranks=self.engine.n_total,
+                            preempted=n_evicted):
+            pass
+        if self._observing():
+            reg = obs_metrics.registry()
+            reg.counter(obs_metrics.FLEET_REJOINS,
+                        "full-mesh rejoins after a cleared rank loss"
+                        ).inc()
+            self._count_fleet_preemptions(reg, n_evicted)
+            self._publish_fleet_gauges(reg)
+        import warnings
+
+        warnings.warn(
+            f"fleet rejoined the full mesh: {old_n} -> "
+            f"{self.engine.n_total} ranks", RuntimeWarning, stacklevel=3)
+
+    def _publish_fleet_gauges(self, reg) -> None:
+        reg.gauge(obs_metrics.FLEET_RANKS_ALIVE,
+                  "ranks of the full serving mesh not confirmed dead"
+                  ).set(len(self.fleet.alive()))
+        reg.gauge(obs_metrics.FLEET_SUSPECTS,
+                  "ranks under suspicion (admission narrowed, not "
+                  "evicted)").set(len(self.fleet.suspects()))
 
     def _prefill_slice(self, req: Request) -> str:
         text = req.text
@@ -673,6 +969,8 @@ class ServingEngine:
             obs_metrics.SERVE_TOKENS_PER_S,
             "generated tokens/s — rolling window under ServingEngine, "
             "per-call under Engine.serve").set(self._rolling_rate())
+        if self.fleet is not None:
+            self._publish_fleet_gauges(reg)
 
     def _rolling_rate(self) -> float:
         """Tokens/s over the trailing window — the throughput the SLO
