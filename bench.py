@@ -333,6 +333,11 @@ def _measure_and_report():
         except Exception as e:
             result["fp8_decode_error"] = f"{type(e).__name__}: {str(e)[:120]}"
         try:
+            result.update(_fp8kv_decode_step_metric())
+        except Exception as e:
+            result["fp8kv_decode_error"] = \
+                f"{type(e).__name__}: {str(e)[:120]}"
+        try:
             result.update(_megakernel_decode_metric())
         except Exception as e:
             result["megakernel_decode_error"] = (
@@ -926,6 +931,16 @@ def _serving_metric():
     except Exception as e:    # additive rung never blocks the xla rung
         out["serving_megakernel_error"] = \
             f"{type(e).__name__}: {str(e)[:120]}"
+    # Round 12: the fp8-KV rung (e4m3 paged pools — half the decode DMA
+    # bytes) races the full-width rung in the same window. Additive.
+    try:
+        f8 = serving_bench_rung(n_streams=8, prompt_len=128, max_new=16,
+                                kv_dtype=jnp.float8_e4m3fn)
+        out["serve_tokens_per_s_fp8kv"] = \
+            f8["serve_tokens_per_s_concurrent"]
+        out["serve_ttft_p99_ms_fp8kv"] = f8["serve_ttft_p99_ms"]
+    except Exception as e:
+        out["serving_fp8kv_error"] = f"{type(e).__name__}: {str(e)[:120]}"
     # Round 10: the disaggregated tier races the monolithic rung in the
     # same window (`serve_tokens_per_s_disagg` — prefill role on chip 0,
     # decode role on chip 1, checksummed KV-migration streams included
@@ -1039,6 +1054,126 @@ def _fp8_decode_step_metric(gen=(16, 40, 64)):
                                      "(inconsistent differentials)")
         return out
     out["decode_step_ms_fp8"] = round(ms, 3)
+    return out
+
+
+def _fp8kv_decode_step_metric(gen=(16, 40, 64)):
+    """fp8 KV-cache decode rung (round 12, ROADMAP 1a): the PAGED decode
+    step — dense_decode_step_paged over a PagedModelCache pool — with
+    the pools stored as e4m3 (half the attention DMA bytes per step;
+    quantize-then-attend, parity pinned by tests/test_fp8_kv.py) RACED
+    against the full-width paged pools in the SAME window. The fp8 lane
+    ships as `decode_step_ms_fp8kv` (gate-banded from r12); the
+    full-width lane rides along as the in-window comparator
+    (`fp8kv_vs_fullwidth_paged`). n=1, bare shard math — no
+    communication in the number, like the decode ladder it extends."""
+    import jax.random as jrandom
+
+    from jax.sharding import PartitionSpec as P
+
+    from triton_distributed_tpu.models.config import ModelConfig
+    from triton_distributed_tpu.models.dense import (
+        dense_decode_step_paged, init_dense_llm,
+    )
+    from triton_distributed_tpu.models.kv_cache import (
+        init_paged_model_cache,
+    )
+    from triton_distributed_tpu.runtime import initialize_distributed
+    from triton_distributed_tpu.runtime.context import shard_map_on
+
+    cfg = ModelConfig(hidden_size=4096, intermediate_size=1536,
+                      num_layers=36, num_heads=4, num_kv_heads=1,
+                      head_dim=128, vocab_size=151936, qk_norm=True)
+    params = init_dense_llm(jrandom.PRNGKey(0), cfg)
+    page, max_pages = 64, 8               # 512-position per-seq capacity
+    kv_len = 256                          # mid-sequence decode shape
+    caches = {
+        "fp8kv": init_paged_model_cache(
+            cfg, 1, page_size=page, max_pages=max_pages,
+            kv_dtype=jnp.float8_e4m3fn),
+        "fullkv": init_paged_model_cache(
+            cfg, 1, page_size=page, max_pages=max_pages),
+    }
+    caches = {k: c._replace(kv_lens=jnp.full((1,), kv_len, jnp.int32))
+              for k, c in caches.items()}
+    tok0 = jnp.zeros((1,), jnp.int32)
+    ctx1 = initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                  devices=jax.devices()[:1])
+
+    def chain(params, tok, cache, n):
+        def body(i, carry):
+            tok, cache = carry
+            logits, cache = dense_decode_step_paged(
+                params, cfg, tok, cache, num_ranks=1, mode="ar")
+            # Pin the decode position so every chained step prices the
+            # same kv_len (the differential isolates per-step cost).
+            return (jnp.argmax(logits, -1).astype(jnp.int32),
+                    cache._replace(kv_lens=jnp.full((1,), kv_len,
+                                                    jnp.int32)))
+
+        tok, _ = jax.lax.fori_loop(0, n, body, (tok, cache))
+        return tok
+
+    _jfns: dict = {}
+
+    def jfn(n):
+        if n not in _jfns:
+            body = functools.partial(chain, n=n)
+            body = shard_map_on(ctx1, body, (P(), P(), P()), P())
+            _jfns[n] = jax.jit(body)
+        return _jfns[n]
+
+    def timed(lane, n):
+        t0 = time.perf_counter()
+        _ = np.asarray(jfn(n)(params, tok0, caches[lane]))
+        return time.perf_counter() - t0
+
+    lanes = ("fp8kv", "fullkv")
+    for lane in lanes:                     # warmup/compile both lanes
+        for n in gen:
+            timed(lane, n)
+    best = {lane: {n: float("inf") for n in gen} for lane in lanes}
+    # Interleave lanes inside each burst: both race the same weather.
+    for burst in range(2):
+        for _ in range(3):
+            for n in gen:
+                for lane in lanes:
+                    best[lane][n] = min(best[lane][n], timed(lane, n))
+        if burst == 0:
+            time.sleep(3)
+
+    out = {"decode_step_fp8kv_comm": "none (n=1): paged decode over e4m3 "
+                                     "KV pools (half the attention DMA "
+                                     "bytes; models/kv_cache kv_dtype)"}
+    per_lane = {}
+    for lane in lanes:
+        t1, t2, t3 = (best[lane][n] for n in gen)
+        n1, n2, n3 = gen
+        if not (t3 > t2 > t1):
+            per_lane[lane] = None
+            continue
+        ms = (t3 - t1) / (n3 - n1) * 1e3
+        d21 = (t2 - t1) / (n2 - n1)
+        d32 = (t3 - t2) / (n3 - n2)
+        if ms < 0.5:
+            per_lane[lane] = "elided"
+        elif not (0.33 < d21 / max(d32, 1e-12) < 3.0):
+            per_lane[lane] = None
+        else:
+            per_lane[lane] = ms
+    fp8 = per_lane["fp8kv"]
+    if fp8 is None:
+        out["decode_step_ms_fp8kv"] = \
+            "unreliable this window (non-monotone or inconsistent)"
+    elif fp8 == "elided":
+        out["decode_step_ms_fp8kv"] = ("unreliable this window "
+                                       "(implausibly fast — suspected "
+                                       "elision)")
+    else:
+        out["decode_step_ms_fp8kv"] = round(fp8, 3)
+        full = per_lane["fullkv"]
+        if isinstance(full, float):
+            out["fp8kv_vs_fullwidth_paged"] = round(full / fp8, 4)
     return out
 
 

@@ -180,6 +180,65 @@ def _full_model_program(dtype, batch=1, head_dim=TILE):
     return prog, comp, ws, wsm, None, (hidden, hq, hkv, ffn, L, S)
 
 
+def full_model_fp8kv_main(json_out):
+    """Round-12 fp8-KV attribution smoke: build the PAGED serving-form
+    program with ``kv_fp8=True`` (the fp8 pool workspace), classify the
+    queue — the F8 task variants must attribute cleanly (no
+    unclassified lanes) — and on CPU run one profiled interpret-mode
+    step checking the stamped dump against the queue-derived plan."""
+    import collections
+    import json
+
+    import jax.random as jrandom
+
+    from triton_distributed_tpu.megakernel.serving import (
+        PagedMegakernelDecoder,
+    )
+    from triton_distributed_tpu.models.config import ModelConfig
+    from triton_distributed_tpu.models.dense import init_dense_llm
+    from triton_distributed_tpu.obs.kernel_profile import (
+        KernelProfile, attach_durations, decode_records, records_from_queue,
+    )
+
+    cfg = ModelConfig(hidden_size=256, intermediate_size=256, num_layers=2,
+                      num_heads=2, num_kv_heads=1, head_dim=128,
+                      vocab_size=512, qk_norm=True, dtype="float32")
+    params = init_dense_llm(jrandom.PRNGKey(0), cfg)
+    dec = PagedMegakernelDecoder(cfg, params, num_slots=2, num_pages=3,
+                                 max_pages=2, dtype=jnp.float32,
+                                 kv_dtype=jnp.float8_e4m3fn)
+    comp = dec.comp
+    recs = records_from_queue(comp.queue, comp.num_exec)
+    composition = dict(collections.Counter(r.type_name for r in recs))
+    for needed in ("ATTN_DECODE_PAGED_F8", "APPEND_KV_F8"):
+        assert composition.get(needed, 0) > 0, \
+            f"fp8-KV serving queue emitted no {needed} tasks"
+    if not ON_TPU:
+        ws, wk8 = dec.start()
+        queue = dec._retarget(np.zeros(dec.num_slots, np.int64),
+                              np.full((dec.num_slots, dec.max_pages), -1))
+        ws, wk8, prof = comp.step(ws, queue, wsm=dec._wsm, wkv8=wk8,
+                                  profile=True)
+        jax.block_until_ready(ws)
+        stamped = decode_records(np.asarray(prof))
+        assert len(stamped) == len(recs), \
+            f"stamped {len(stamped)} records vs queue {len(recs)}"
+    attach_durations(recs, itemsize=1)
+    kp = KernelProfile(records=recs, label="full_model_fp8kv")
+    acct = kp.accounting()
+    acct["composition"] = composition
+    print(f"# fp8-KV paged serving attribution ({acct['n_tasks']} tasks)")
+    for cls, d_ in sorted(acct["classes"].items()):
+        print(f"{cls:16} {d_['tasks']:5d} tasks  "
+              f"{d_['seconds'] * 1e3:9.3f} ms  [{d_['duration_kind']}]")
+    assert acct["unclassified"] == 0, \
+        "fp8-KV serving queue contains unclassified task types"
+    if json_out is not None:
+        with open(json_out, "w") as f:
+            json.dump({"full_model_fp8kv": acct}, f, indent=2, default=str)
+        print(f"wrote {json_out}")
+
+
 def full_model_main(json_out, measured=None, batch=1, head_dim=TILE):
     """Round-6 full-model attribution: per-task accounting of the whole
     num_layers decode queue — where the extra milliseconds beyond
@@ -303,6 +362,11 @@ def main():
         return int(sys.argv[i + 1])
 
     if "--full-model" in sys.argv:
+        # --fp8-kv (round 12): attribute the PAGED serving-form queue
+        # with fp8 KV pools (ATTN_DECODE_PAGED_F8 / APPEND_KV_F8
+        # classified, stamped dump checked against the plan on CPU).
+        if "--fp8-kv" in sys.argv:
+            return full_model_fp8kv_main(json_out)
         # --batch / --head-dim (round 9, CPU smoke): attribute the
         # row-blocked batch>TILE and padded-head head_dim-64 queues.
         return full_model_main(json_out, measured=measured,

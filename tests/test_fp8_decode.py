@@ -117,20 +117,71 @@ def test_fp8_dot_saturates_instead_of_nan():
         np.testing.assert_allclose(out[0, :2], [448.0, -448.0])
 
 
-def test_quantize_skips_moe_experts():
-    """MoE expert weights share leaf names (w_gate/w_up/w_down) with the
-    dense MLP but their GEMMs (ragged_dot) never receive dot_fn —
-    quantizing them would silently run the losing mixed bf16xfp8
-    configuration. The quantizer's scope excludes the 'moe' subtree."""
-    cfg = ModelConfig(hidden_size=256, intermediate_size=256,
-                      num_layers=1, num_heads=2, num_kv_heads=1,
-                      head_dim=128, vocab_size=512, qk_norm=True,
-                      num_experts=4, num_experts_per_tok=2,
-                      moe_intermediate_size=128)
-    params = init_dense_llm(jrandom.PRNGKey(0), cfg)
+def _moe_cfg():
+    return ModelConfig(hidden_size=256, intermediate_size=256,
+                       num_layers=1, num_heads=2, num_kv_heads=1,
+                       head_dim=128, vocab_size=512, qk_norm=True,
+                       num_experts=4, num_experts_per_tok=2,
+                       moe_intermediate_size=128)
+
+
+def test_quantize_covers_moe_experts():
+    """ROADMAP 1a tail (round 12): the fp8 exclusion on MoE expert
+    weights is LIFTED — the expert stacks (w_gate/w_up/w_down inside
+    the 'moe' subtree) quantize to e4m3 and their grouped GEMMs route
+    through the dtype-aware ragged_dot (PURE e4m3×e4m3 with fp32
+    accumulation — never the losing mixed bf16×fp8 form). The router
+    stays full-width: routing decisions keep wide logits and its bytes
+    are noise next to the expert stacks."""
+    params = init_dense_llm(jrandom.PRNGKey(0), _moe_cfg())
     p8 = quantize_dense_weights(params)
     moe = p8["layers"][0]["moe"]
     for k in ("w_gate", "w_up", "w_down"):
-        assert moe[k].dtype != E4M3, k
-    # Dense attention projections in the same layer DO quantize.
+        assert moe[k].dtype == E4M3, k
+    assert moe["router"].dtype != E4M3
+    # Dense attention projections in the same layer quantize too.
     assert p8["layers"][0]["attn"]["wo"].dtype == E4M3
+
+
+def test_fp8_moe_forward_matches_emulation():
+    """The quantized expert path's parity golden: ragged_dot over e4m3
+    experts with a saturate-quantized activation must agree with the
+    same quantized math run in fp32 (e4m3 products are exactly
+    representable in fp32)."""
+    import jax
+
+    from triton_distributed_tpu.models.fp8 import _to_e4m3
+    from triton_distributed_tpu.ops.moe import (
+        ragged_dot_dtype_aware, sort_by_expert,
+    )
+
+    rng = np.random.default_rng(1)
+    E, h, f, T = 4, 64, 32, 12
+    x = jnp.asarray(rng.standard_normal((T, h)) * 0.4, jnp.float32)
+    w = _to_e4m3(jnp.asarray(rng.standard_normal((E, h, f)) * 0.1,
+                             jnp.float32))
+    ids = jnp.asarray(rng.integers(0, E, T), jnp.int32)
+    sidx, gsz = sort_by_expert(ids, E)
+    xs = x[sidx]
+    got = ragged_dot_dtype_aware(xs, w, gsz)
+    ref = jax.lax.ragged_dot(_to_e4m3(xs).astype(jnp.float32),
+                             w.astype(jnp.float32), gsz)
+    assert got.dtype == x.dtype
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fp8_moe_decode_runs_end_to_end():
+    """A quantized MoE model decodes through dense_decode_step (the
+    expert GEMMs hit the dtype-aware path inside moe_tp_fwd_local) with
+    finite logits — the wiring proof the scope test alone can't give."""
+    cfg = _moe_cfg()
+    params = quantize_dense_weights(init_dense_llm(jrandom.PRNGKey(0),
+                                                   cfg))
+    cache = init_kv_cache(cfg, 1, 16)
+    logits, cache = dense_decode_step(
+        params, cfg, jnp.zeros((1,), jnp.int32), cache, num_ranks=1,
+        mode="ar", dot_fn=fp8_dot)
+    out = np.asarray(logits, np.float32)
+    assert out.shape == (1, cfg.vocab_size)
+    assert np.isfinite(out).all()

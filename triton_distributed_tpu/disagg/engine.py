@@ -258,16 +258,28 @@ class DisaggServingEngine(ServingEngine):
 
     def _pack_jit(self, n_pages: int):
         """Paged view of the prefill buffer's first ``n_pages`` pages on
-        the PREFILL mesh — the migration stream's source snapshot."""
+        the PREFILL mesh — the migration stream's source snapshot.
+
+        With a narrow decode pool (fp8 KV, round 12) the QUANTIZATION
+        happens HERE, prefill-side: the blocks cross DCN at half the
+        bytes (the migration is KV traffic too), and the stream's f32
+        checksums stamp the e4m3 payload that actually lands — so
+        integrity verification survives the narrower dtype instead of
+        comparing a wide checksum against a narrowed block."""
         key = ("pack", n_pages)
         if key not in self._jits:
+            from triton_distributed_tpu.models.fp8 import saturate_cast
+
             L, page, s_buf = self.cfg.num_layers, self.page, self.s_buf
+            kv_dt = self.kv_dtype
 
             def pack(k, v):
                 def to_pages(x):    # (L, 1, S_buf, hkv, d)
                     x = x[:, 0].reshape(L, s_buf // page, page,
                                         *x.shape[3:])
-                    return x[:, :n_pages]
+                    x = x[:, :n_pages]
+                    return (saturate_cast(x, kv_dt) if kv_dt is not None
+                            else x)
 
                 return to_pages(k), to_pages(v)
 
@@ -315,10 +327,15 @@ class DisaggServingEngine(ServingEngine):
             kv_spec = P(None, None, None, eng.shard_axes, None)
 
             def step(cache, kb, vb, pages):
+                # Blocks already quantized prefill-side (_pack_jit), so
+                # for fp8 pools this cast is the identity; saturate_cast
+                # keeps the hand-off safe if a wide block ever lands.
+                from triton_distributed_tpu.models.fp8 import saturate_cast
+
                 kp = cache.k_pools.at[:, pages].set(
-                    kb.astype(cache.k_pools.dtype))
+                    saturate_cast(kb, cache.k_pools.dtype))
                 vp = cache.v_pools.at[:, pages].set(
-                    vb.astype(cache.v_pools.dtype))
+                    saturate_cast(vb, cache.v_pools.dtype))
                 return cache._replace(k_pools=kp, v_pools=vp)
 
             fn = eng._shard(

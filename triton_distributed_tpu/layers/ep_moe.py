@@ -158,12 +158,18 @@ def ep_moe_fwd(params: dict, x: jax.Array, topk: int, *, axis: str = "tp",
 
 
 def _expert_mlp(x_sorted, group_sizes, params, pad_group: bool = False):
+    # Dtype-aware grouped GEMMs (round 12): e4m3 expert stacks
+    # (models/fp8.quantize_dense_weights) run the pure-fp8 path — the
+    # EP lane shares the TP lane's quantization contract.
+    from triton_distributed_tpu.ops.moe import ragged_dot_dtype_aware
+
     wg, wu, wd = params["w_gate"], params["w_up"], params["w_down"]
     if pad_group:
         wg = jnp.concatenate([wg, jnp.zeros_like(wg[:1])])
         wu = jnp.concatenate([wu, jnp.zeros_like(wu[:1])])
         wd = jnp.concatenate([wd, jnp.zeros_like(wd[:1])])
-    gate = jax.lax.ragged_dot(x_sorted, wg, group_sizes)
-    up = jax.lax.ragged_dot(x_sorted, wu, group_sizes)
+    gate = ragged_dot_dtype_aware(x_sorted, wg, group_sizes)
+    up = ragged_dot_dtype_aware(x_sorted, wu, group_sizes)
     act = (jax.nn.silu(gate) * up).astype(x_sorted.dtype)
-    return jax.lax.ragged_dot(act, wd, group_sizes).astype(x_sorted.dtype)
+    return ragged_dot_dtype_aware(act, wd, group_sizes
+                                  ).astype(x_sorted.dtype)

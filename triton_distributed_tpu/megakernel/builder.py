@@ -43,6 +43,10 @@ class MegaKernelBuilder:
     _W8_HAZARD = 1 << 30
     # Same for 2D matrix-workspace rows (GEMM_MAT B operands).
     _WM_HAZARD = 1 << 29
+    # And for fp8 KV-POOL tiles (ATTN_DECODE_PAGED_F8 / APPEND_KV_F8 —
+    # the read-write fp8 pool space): their WAR/WAW edges must order
+    # appends after attention reads exactly like main-workspace pools.
+    _K8_HAZARD = 1 << 28
 
     def __init__(self):
         # NORM_ROPE(_QKV) sub-tile span: the program ASSEMBLY sets this
@@ -53,6 +57,7 @@ class MegaKernelBuilder:
         self.head_dim = TILE
         self._num_tiles = 0
         self._num_tiles8 = 0
+        self._num_tiles_kv8 = 0
         self._num_mrows = 0
         self._mat_specs: list[MatSpec] = []
         self._tasks: list[Task] = []
@@ -73,16 +78,27 @@ class MegaKernelBuilder:
         self._pending_pf_mat: tuple[int, int] | None = None
 
     # -- tensors ------------------------------------------------------------
-    def tensor(self, rows: int, cols: int, fp8: bool = False) -> TensorHandle:
+    def tensor(self, rows: int, cols: int, fp8: bool = False,
+               kv8: bool = False) -> TensorHandle:
         """``fp8=True``: allocate in the float8_e4m3fn WEIGHT workspace (a
         separate read-only input — GEMM B operands only; half the
-        weight-streaming bytes of bf16)."""
+        weight-streaming bytes of bf16). ``kv8=True``: allocate in the
+        float8_e4m3fn KV-POOL workspace (read-WRITE, aliased through the
+        step) — paged KV pools at half the bytes; ATTN_DECODE_PAGED_F8
+        reads it, APPEND_KV_F8 writes it."""
         if rows % TILE or cols % TILE:
             raise ValueError(f"dims must be multiples of {TILE}, got "
                              f"({rows}, {cols})")
+        if fp8 and kv8:
+            raise ValueError("fp8 (weight) and kv8 (KV pool) are distinct "
+                             "workspaces — pick one")
         if fp8:
             h = TensorHandle(self._num_tiles8, rows, cols, fp8=True)
             self._num_tiles8 += h.rt * h.ct
+            return h
+        if kv8:
+            h = TensorHandle(self._num_tiles_kv8, rows, cols, kv8=True)
+            self._num_tiles_kv8 += h.rt * h.ct
             return h
         h = TensorHandle(self._num_tiles, rows, cols)
         self._num_tiles += h.rt * h.ct
@@ -102,15 +118,22 @@ class MegaKernelBuilder:
 
     @staticmethod
     def _no_fp8(*handles):
-        """fp8-space handles are GEMM B operands only: their tile ids live
-        in a separate space starting at 0, so any other op encoding them
-        would silently alias main-workspace tiles (data AND hazards)."""
+        """fp8-space handles are GEMM B operands only (and kv8 pool
+        handles are paged-attention/append operands only): their tile ids
+        live in separate spaces starting at 0, so any other op encoding
+        them would silently alias main-workspace tiles (data AND
+        hazards)."""
         for h in handles:
             if h is not None and getattr(h, "fp8", False):
                 raise ValueError(
                     "fp8 weight-workspace tensors can only be GEMM B "
                     "operands (GEMM_WIDE_W8) — other tasks address the "
                     "main workspace")
+            if h is not None and getattr(h, "kv8", False):
+                raise ValueError(
+                    "kv8 pool-workspace tensors can only be paged KV "
+                    "pools (ATTN_DECODE_PAGED_F8 / APPEND_KV_F8) — other "
+                    "tasks address the main workspace")
 
     # -- dependency bookkeeping --------------------------------------------
     def _emit(self, task: Task, reads: list[int], writes: list[int]) -> int:
@@ -402,8 +425,19 @@ class MegaKernelBuilder:
         of the v cache (reference appends in-kernel inside its qkv/attn
         tasks, model_builder.py). The task row is self-describing
         (a_stride/b_stride carry the cache base tiles) so
-        advance_queue_pos retargets it per step without recompiling."""
-        self._no_fp8(kT, v, k_new, v_new)
+        advance_queue_pos retargets it per step without recompiling.
+
+        ``kv8`` pool handles (both kT AND v, never mixed) emit the
+        APPEND_KV_F8 variant: the new rows clamp to ±448 and cast to
+        e4m3 on append (the saturating models/fp8._to_e4m3 contract)."""
+        self._no_fp8(k_new, v_new)
+        if kT.kv8 != v.kv8:
+            raise ValueError(
+                "append_kv pools must live in ONE space: kT and v are "
+                f"kv8={kT.kv8}/{v.kv8} — a mixed-dtype page pool would "
+                "read one space and write the other")
+        if not kT.kv8:
+            self._no_fp8(kT, v)
         if not 0 <= pos < kT.ct * TILE:
             raise ValueError(f"append pos {pos} outside cache capacity")
         if kT.rt != 1 or v.ct != 1:
@@ -413,12 +447,15 @@ class MegaKernelBuilder:
                 raise ValueError("k_new/v_new must be single head tiles")
         ti, col = pos // TILE, pos % TILE
         kt_tile, v_tile = kT.tile(0, ti), v.tile(ti, 0)
+        hz = self._K8_HAZARD if kT.kv8 else 0
+        tt = TaskType.APPEND_KV_F8 if kT.kv8 else TaskType.APPEND_KV
         return self._emit(
-            Task(TaskType.APPEND_KV, kt_tile, a0=k_new.tile(0, 0),
+            Task(tt, kt_tile, a0=k_new.tile(0, 0),
                  b0=v_tile, a_stride=kT.tile(0, 0), b_stride=v.tile(0, 0),
                  c0=col, d0=v_new.tile(0, 0)),
-            [k_new.tile(0, 0), v_new.tile(0, 0), kt_tile, v_tile],
-            [kt_tile, v_tile])
+            [k_new.tile(0, 0), v_new.tile(0, 0), kt_tile + hz,
+             v_tile + hz],
+            [kt_tile + hz, v_tile + hz])
 
     def add_norm(self, out_x2: TensorHandle, a: TensorHandle,
                  b: TensorHandle, w: TensorHandle,
@@ -620,7 +657,8 @@ class MegaKernelBuilder:
     def attn_decode_paged(self, out: TensorHandle, q: TensorHandle,
                           pages: list[tuple[int, int]], valid_len: int,
                           scale: float, k_new: TensorHandle | None = None,
-                          v_new: TensorHandle | None = None):
+                          v_new: TensorHandle | None = None,
+                          kv8: bool = False):
         """Page-table flash-attention decode for ONE head: the j-th cache
         tile pair (kT tile id, V tile id) comes from ``pages`` — arbitrary
         workspace tiles, so sequences share pools without per-sequence
@@ -632,6 +670,9 @@ class MegaKernelBuilder:
         ``pages[j]``: (kT_tile, v_tile) covering logical positions
         [j·TILE, (j+1)·TILE); kT tiles are (d, TILE) key columns, v tiles
         (TILE, d) value rows — the same layout the linear task uses.
+        ``kv8=True``: the page tile ids address the fp8 KV-POOL workspace
+        and the ATTN_DECODE_PAGED_F8 variant streams them at half the
+        bytes, widening to fp32 before the softmax dots.
         """
         self._no_fp8(out, q, k_new, v_new)
         if q.rt != 1 or q.ct != 1 or out.rt != 1 or out.ct != 1:
@@ -646,17 +687,20 @@ class MegaKernelBuilder:
                 f"{len(pages) * TILE}")
         # valid_len == 0 (empty cache, current token only): visit no pages.
         k_tiles = min(len(pages), -(-valid_len // TILE))
+        hz = self._K8_HAZARD if kv8 else 0
         reads = [q.tile(0, 0)]
         flat: list[int] = []
         for kt_t, v_t in pages:
             flat += [int(kt_t), int(v_t)]
-        reads += [t for pair in pages[:k_tiles] for t in pair]
+        reads += [t + hz for pair in pages[:k_tiles] for t in pair]
         c0 = d0 = -1
         if k_new is not None:
             c0, d0 = k_new.tile(0, 0), v_new.tile(0, 0)
             reads += [c0, d0]
+        tt = (TaskType.ATTN_DECODE_PAGED_F8 if kv8
+              else TaskType.ATTN_DECODE_PAGED)
         tid = self._emit(
-            Task(TaskType.ATTN_DECODE_PAGED, out.tile(0, 0),
+            Task(tt, out.tile(0, 0),
                  a0=q.tile(0, 0), b0=-1,   # b0 patched to table row at compile
                  k_tiles=k_tiles, a_stride=0,
                  b_stride=int(valid_len), arg=int(round(scale * 1e6)),
@@ -815,6 +859,7 @@ class MegaKernelBuilder:
                                   num_tiles=self._num_tiles,
                                   num_ranks=num_ranks, axis=axis,
                                   dtype=jnp.dtype(dtype),
+                                  num_tiles_kv8=self._num_tiles_kv8,
                                   num_exec=n_exec,
                                   max_gqa=getattr(self, "_max_gqa", 1),
                                   max_gemm_width=getattr(
@@ -846,6 +891,8 @@ class CompiledMegaKernel:
     max_gqa: int = 1              # largest GQA group (sizes VMEM scratch)
     max_gemm_width: int = 1       # widest GEMM strip (sizes acc scratch)
     num_tiles8: int = 0           # fp8 weight-workspace tiles (0 = unused)
+    num_tiles_kv8: int = 0        # fp8 KV-POOL workspace tiles (0 = none;
+    #                               the read-write half-byte paged pools)
     max_moe_h: int = 0            # MoE hidden tiles (0 = no MoE tasks)
     max_moe_f: int = 0            # MoE ffn_local tiles
     max_row: int = 1              # widest resident row (tiles)
@@ -864,9 +911,19 @@ class CompiledMegaKernel:
 
     def scatter_input(self, ws: jax.Array, h: TensorHandle,
                       value: jax.Array) -> jax.Array:
-        """Write (rows, cols) ``value`` into the tiled workspace (main or
-        fp8 — ``ws`` must be the matching array for ``h.fp8``)."""
-        dt = jnp.float8_e4m3fn if h.fp8 else self.dtype
+        """Write (rows, cols) ``value`` into the tiled workspace (main,
+        fp8, or kv8 — ``ws`` must be the matching array for the handle's
+        space). Narrow (e4m3) targets quantize through the SATURATING
+        cast — the same ±448 clamp the in-kernel append applies, so a
+        host-scattered prefill page and an in-kernel appended one store
+        identical values."""
+        if h.fp8 or h.kv8:
+            from triton_distributed_tpu.models.fp8 import _to_e4m3
+
+            value = _to_e4m3(jnp.asarray(value))
+            dt = jnp.float8_e4m3fn
+        else:
+            dt = self.dtype
         tiles = value.astype(dt).reshape(
             h.rt, TILE, h.ct, TILE).transpose(0, 2, 1, 3).reshape(
             h.rt * h.ct, TILE, TILE)
@@ -879,10 +936,24 @@ class CompiledMegaKernel:
             raise ValueError("fp8 weight-workspace tensors are read-only "
                              "inputs; gather_output reads the main "
                              "workspace")
+        if h.kv8:
+            raise ValueError("kv8 pool tensors live in the fp8 KV "
+                             "workspace; gather them with gather_kv8 "
+                             "from the carried kv8 array")
         tiles = jax.lax.dynamic_slice(
             ws, (h.base, 0, 0), (h.rt * h.ct, TILE, TILE))
         return tiles.reshape(h.rt, h.ct, TILE, TILE).transpose(
             0, 2, 1, 3).reshape(h.rows, h.cols)
+
+    def gather_kv8(self, wkv8: jax.Array, h: TensorHandle) -> jax.Array:
+        """Read a kv8 pool tensor from the carried fp8 KV workspace,
+        WIDENED to fp32 (the dequantized view parity oracles compare)."""
+        if not h.kv8:
+            raise ValueError("gather_kv8 reads kv8 pool handles only")
+        tiles = jax.lax.dynamic_slice(
+            wkv8, (h.base, 0, 0), (h.rt * h.ct, TILE, TILE))
+        return tiles.reshape(h.rt, h.ct, TILE, TILE).transpose(
+            0, 2, 1, 3).reshape(h.rows, h.cols).astype(jnp.float32)
 
     @property
     def _strip_pad(self) -> int:
@@ -909,13 +980,25 @@ class CompiledMegaKernel:
             if h.fp8:
                 raise ValueError("fp8 handle in main workspace feeds — "
                                  "pass it to make_workspace8")
+            if h.kv8:
+                raise ValueError("kv8 pool handle in main workspace feeds "
+                                 "— pass it to make_workspace_kv8")
             ws = self.scatter_input(ws, h, v)
         return ws
 
     @staticmethod
     def split_feeds(feeds: dict) -> tuple[dict, dict, dict]:
         """Split a mixed feeds dict into (main, fp8, matrix) workspace
-        feeds — the one-liner every caller of make_workspace* wants."""
+        feeds — the one-liner every caller of make_workspace* wants.
+        kv8 POOL handles are rejected: pools start zeroed
+        (:meth:`make_workspace_kv8`) and fill via ``scatter_input`` into
+        the carried kv8 array — silently dropping (or mis-routing) a
+        pool feed here would corrupt the cache with no error."""
+        for h in feeds:
+            if not isinstance(h, MatHandle) and getattr(h, "kv8", False):
+                raise ValueError(
+                    "kv8 pool handle in feeds — scatter_input it into "
+                    "the kv8 workspace (make_workspace_kv8) instead")
         main = {h: v for h, v in feeds.items()
                 if not isinstance(h, MatHandle) and not h.fp8}
         w8 = {h: v for h, v in feeds.items()
@@ -975,19 +1058,48 @@ class CompiledMegaKernel:
             ws8 = self.scatter_input(ws8, h, v)
         return ws8
 
+    def make_workspace_kv8(self, inputs: dict | None = None) -> jax.Array:
+        """Build the float8_e4m3fn KV-POOL workspace — the READ-WRITE
+        half-byte paged pools ATTN_DECODE_PAGED_F8 streams and
+        APPEND_KV_F8 appends into (carry it through every step like the
+        main workspace; step() aliases it in place). Pools start zeroed;
+        ``inputs`` (kv8 handles → (rows, cols) values) pre-load pages —
+        values quantize through the saturating cast."""
+        wkv8 = jnp.zeros((max(self.num_tiles_kv8, 1), TILE, TILE),
+                         jnp.float8_e4m3fn)
+        for h, v in (inputs or {}).items():
+            if not getattr(h, "kv8", False):
+                raise ValueError("non-kv8 handle in kv8 workspace feeds")
+            wkv8 = self.scatter_input(wkv8, h, v)
+        return wkv8
+
     def step(self, ws: jax.Array, queue: jax.Array | None = None,
              ws8: jax.Array | None = None,
              wsm: jax.Array | None = None,
+             wkv8: jax.Array | None = None,
              profile: bool = False) -> jax.Array:
         """One queue execution over a prebuilt workspace (jittable; pass an
         advance_queue_pos-updated ``queue`` to retarget without recompile).
         Device-local: wrap in shard_map when num_ranks > 1. ``ws8``: the
         fp8 weight workspace when the program uses one; ``wsm``: the 2D
-        matrix weight workspace when the program has GEMM_MAT tasks.
+        matrix weight workspace when the program has GEMM_MAT tasks;
+        ``wkv8``: the READ-WRITE fp8 KV-pool workspace when the program
+        has kv8 pools — the return then becomes ``(ws, wkv8)`` (both
+        carried, both aliased in place).
         ``profile=True``: the observability mode (ISSUE 3) — the kernel
         additionally stamps each task's execution record into an int32
-        (num_exec, 128) dump and the return becomes ``(ws, prof)``;
-        decode it with ``obs.kernel_profile.KernelProfile.from_dump``."""
+        (num_exec, 128) dump and the return grows ``prof`` as its last
+        element; decode it with
+        ``obs.kernel_profile.KernelProfile.from_dump``."""
+        if self.num_tiles_kv8 and wkv8 is None:
+            raise ValueError(
+                f"program uses {self.num_tiles_kv8} fp8 KV-pool tiles "
+                "but no wkv8 was passed — build it with "
+                "make_workspace_kv8 and carry it through every step")
+        if wkv8 is not None and not self.num_tiles_kv8:
+            raise ValueError(
+                "wkv8 passed but this program has no kv8 pool tiles — "
+                "was it compiled without the fp8 KV form?")
         if self.num_tiles8 and ws8 is None:
             # The placeholder run_queue substitutes is ONE tile — a W8
             # program would DMA weight tiles from out-of-bounds indices
@@ -1024,7 +1136,8 @@ class CompiledMegaKernel:
                          workspace_m=wsm, mat_specs=self.mat_specs,
                          max_ar=self.max_ar, force_ar=self.force_ar,
                          used_types=self.used_types,
-                         head_dim=self.head_dim, profile=profile)
+                         head_dim=self.head_dim,
+                         workspace_kv8=wkv8, profile=profile)
 
     def run(self, inputs: dict, outputs: list[TensorHandle],
             _device_local: bool = True):

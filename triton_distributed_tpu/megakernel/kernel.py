@@ -40,10 +40,11 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
                  max_gemm_width: int, mat_specs: tuple, kch_max: int,
                  max_ar: int, force_ar: bool, used_types: tuple | None,
                  head_dim: int,
-                 queue_ref, ws_in, ws8, wm, ws_out, slots, va2, vb2, vb8,
+                 queue_ref, ws_in, ws8, wm, wk8_in, ws_out, slots,
+                 wk8_out, va2, vb2, vb8,
                  vbw, vbw8, vacc, vq, vstat, vqg, vaccg, vstatg, vaccw,
                  vaccw_wdt, vrow_a, vrow_b, vrow_o, vmoe_a, vmoe_b,
-                 vmoe_o, vbm, vaccm, voutm,
+                 vmoe_o, vbm, vaccm, voutm, vkv8,
                  copy_sem, pipe_sems, send_sems, recv_sem):
     wdt = ws_out.dtype   # workspace dtype (fp32 or bf16); compute is fp32
     step = pl.program_id(0)
@@ -78,26 +79,39 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
     # Pipelined pair loads: tile streams (a_of(j), b_of(j)) double-buffered
     # so iteration j's MXU work overlaps iteration j+1's DMA — the intra-
     # task analog of ops/tiling.py's emit_pipeline.
-    def pipelined_pairs(a_of, b_of, n_iters, body_fn, init):
+    def pipelined_pairs(a_of, b_of, n_iters, body_fn, init, kv8=False):
         # DEPTH tile-pairs in flight: a single-buffer lookahead cannot hide
         # ~2us DMA latency under a 128x128 dot; 3 outstanding pairs can.
         # b_of=None streams only `a` (the body's b_ref is then invalid) —
         # copy/scale/rms-pass1 would otherwise double their HBM reads.
         # (Prefetch-warm consumption lives in t_gemm_wide, the only task
         # the builder pairs with PREFETCH.)
-        def desc(idx, vref2, slot, sem_i):
-            return pltpu.make_async_copy(ws_out.at[idx], vref2.at[slot],
+        # kv8=True (the fp8 KV pool stream, ATTN_DECODE_PAGED_F8): pairs
+        # stream from the fp8 pool workspace into the vkv8 scratch — the
+        # SAME pipeline at HALF the DMA bytes per tile; the body's refs
+        # are then e4m3 slot views (widen before the dots).
+        if kv8:
+            src = wk8_out
+            a_buf = lambda s: vkv8.at[s]                       # noqa: E731
+            b_buf = lambda s: vkv8.at[PIPE_DEPTH + s]          # noqa: E731
+        else:
+            src = ws_out
+            a_buf = lambda s: va2.at[s]                        # noqa: E731
+            b_buf = lambda s: vb2.at[s]                        # noqa: E731
+
+        def desc(idx, buf_of, slot, sem_i):
+            return pltpu.make_async_copy(src.at[idx], buf_of(slot),
                                          pipe_sems.at[sem_i])
 
         def start(j, slot):
-            desc(a_of(j), va2, slot, slot * 2).start()
+            desc(a_of(j), a_buf, slot, slot * 2).start()
             if b_of is not None:
-                desc(b_of(j), vb2, slot, slot * 2 + 1).start()
+                desc(b_of(j), b_buf, slot, slot * 2 + 1).start()
 
         def wait(j, slot):
-            desc(a_of(j), va2, slot, slot * 2).wait()
+            desc(a_of(j), a_buf, slot, slot * 2).wait()
             if b_of is not None:
-                desc(b_of(j), vb2, slot, slot * 2 + 1).wait()
+                desc(b_of(j), b_buf, slot, slot * 2 + 1).wait()
 
         for jj in range(PIPE_DEPTH - 1):
             @pl.when(jj < n_iters)
@@ -113,7 +127,7 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
                       jax.lax.rem(j + PIPE_DEPTH - 1, PIPE_DEPTH))
 
             wait(j, slot)
-            return body_fn(j, va2.at[slot], vb2.at[slot], carry)
+            return body_fn(j, a_buf(slot), b_buf(slot), carry)
 
         return jax.lax.fori_loop(0, n_iters, body, init)
 
@@ -447,6 +461,38 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
                             va[...].astype(jnp.float32)).astype(wdt)
         store(va, b0)
 
+    def t_append_kv_f8():
+        # APPEND_KV into the fp8 KV-pool workspace (round 12): the new
+        # k/v rows come from the MAIN workspace (projection outputs), the
+        # cache tiles read-modify-write in the fp8 pool. The cast on
+        # append SATURATES to e4m3's ±448 finite range — the
+        # models/fp8._to_e4m3 contract; a plain cast would NaN one hot
+        # KV element and poison every later softmax over the page.
+        lim = float(jnp.finfo(jnp.float8_e4m3fn).max)
+
+        def rmw(cache_tile, sel_iota_dim, new_row):
+            cp = pltpu.make_async_copy(wk8_out.at[cache_tile],
+                                       vkv8.at[0], copy_sem)
+            cp.start()
+            cp.wait()
+            sel = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE),
+                                           sel_iota_dim)
+            merged = jnp.where(sel == c0, new_row,
+                               vkv8[0].astype(jnp.float32))
+            vkv8[1, :, :] = jnp.clip(merged, -lim, lim).astype(
+                jnp.float8_e4m3fn)
+            cp2 = pltpu.make_async_copy(vkv8.at[1],
+                                        wk8_out.at[cache_tile], copy_sem)
+            cp2.start()
+            cp2.wait()
+
+        load(a0, vq)           # k_new (B, d) — main workspace
+        kcolT = vq[...].astype(jnp.float32).T    # (d, B); col 0 = row 0
+        rmw(out, 1, jnp.broadcast_to(kcolT[:, 0:1], (TILE, TILE)))
+        load(d0, vq)           # v_new (B, d)
+        rmw(b0, 0, jnp.broadcast_to(vq[0:1, :].astype(jnp.float32),
+                                    (TILE, TILE)))
+
     def t_allreduce():
         # One-shot AR of tile ``out`` (reference tasks/allreduce.py, minus
         # multimem): push to every peer's slot ``me``, reduce all slots,
@@ -619,9 +665,14 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
 
         jax.lax.fori_loop(0, hq + b_stride, hbody, 0)
 
-    def _attn_softmax(kt_of, v_of):
+    def _attn_softmax(kt_of, v_of, kv8=False):
         """Shared online-softmax body: streams (kT_j, V_j) tile pairs by the
-        given index functions, then folds in the current token (c0/d0)."""
+        given index functions, then folds in the current token (c0/d0).
+        ``kv8``: pairs stream from the fp8 KV-pool workspace at half the
+        bytes and WIDEN to fp32 in VMEM before the dots (the
+        quantize-then-attend dequant point — accumulation stays fp32
+        either way, so parity with the dense fp8-KV paged path is
+        exact)."""
         load(a0, vq)
         scale = arg.astype(jnp.float32) * 1e-6
         valid = b_stride
@@ -632,7 +683,13 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
 
         def body(j, kt_ref, v_ref, carry):
             m, l = carry
-            s = jnp.dot(vq[...], kt_ref[...],     # KT_j: (d, TILE)
+            if kv8:
+                kt = kt_ref[...].astype(jnp.float32)
+                vv = v_ref[...].astype(jnp.float32)
+                qv = vq[...].astype(jnp.float32)
+            else:
+                kt, vv, qv = kt_ref[...], v_ref[...], vq[...]
+            s = jnp.dot(qv, kt,                   # KT_j: (d, TILE)
                         preferred_element_type=jnp.float32) * scale
             col = j * TILE + jax.lax.broadcasted_iota(
                 jnp.int32, (TILE, TILE), 1)
@@ -640,25 +697,40 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
             m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
             p = jnp.exp(s - m_new)
             corr = jnp.exp(m - m_new)
-            pv = jnp.dot(p.astype(v_ref.dtype), v_ref[...],  # V_j: (TILE, d)
+            pv = jnp.dot(p.astype(vv.dtype), vv,  # V_j: (TILE, d)
                          preferred_element_type=jnp.float32)
             vacc[...] = vacc[...] * corr + pv
             return (m_new, l * corr + jnp.sum(p, axis=1, keepdims=True))
 
-        m, l = pipelined_pairs(kt_of, v_of, k_tiles, body, (m0, l0))
+        m, l = pipelined_pairs(kt_of, v_of, k_tiles, body, (m0, l0),
+                               kv8=kv8)
+
+        def cur_kv():
+            # Current token's k/v arrive full-width from the MAIN
+            # workspace. Under kv8 they must QUANTIZE (saturating e4m3
+            # round-trip) before joining the softmax: the dense path
+            # appends-then-attends, so the current token's contribution
+            # there is the STORED e4m3 value — folding the wide value
+            # here would break cross-backend token parity on exactly
+            # the step each token is current.
+            x = vb[...].astype(jnp.float32)
+            if kv8:
+                lim = float(jnp.finfo(jnp.float8_e4m3fn).max)
+                x = jnp.clip(x, -lim, lim).astype(jnp.float8_e4m3fn
+                                                  ).astype(jnp.float32)
+            return x
 
         @pl.when(c0 >= 0)
         def _():
             # Current token: per-row dot with each row's own k/v.
             load(c0, vb)                           # k_new: (B, d)
-            s_cur = jnp.sum(vq[...].astype(jnp.float32)
-                            * vb[...].astype(jnp.float32),
+            s_cur = jnp.sum(vq[...].astype(jnp.float32) * cur_kv(),
                             axis=1, keepdims=True) * scale
             m_new = jnp.maximum(m, s_cur)
             p_cur = jnp.exp(s_cur - m_new)
             corr = jnp.exp(m - m_new)
             load(d0, vb)                           # v_new: (B, d)
-            vacc[...] = vacc[...] * corr + p_cur * vb[...].astype(jnp.float32)
+            vacc[...] = vacc[...] * corr + p_cur * cur_kv()
             vstat[:, :1] = l * corr + p_cur
 
         @pl.when(c0 < 0)
@@ -668,20 +740,26 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
         va[...] = (vacc[...] / jnp.maximum(vstat[:, :1], 1e-30)).astype(wdt)
         store(va, out)
 
-    def t_attn_decode_paged():
+    def _paged_table(j_kind):
         # Page-table walk: the j-th (kT, V) tile pair comes from queue DATA
         # rows starting at row b0 — entry pair j at flat offsets (2j, 2j+1).
         # The table rides scalar prefetch (SMEM), so the DMA addresses are
         # data-dependent exactly like ops/paged_attention.py's table walk.
-        def kt_of(j):
-            f = 2 * j
+        def of(j):
+            f = 2 * j + j_kind
             return queue_ref[b0 + f // WORDS, jax.lax.rem(f, WORDS)]
 
-        def v_of(j):
-            f = 2 * j + 1
-            return queue_ref[b0 + f // WORDS, jax.lax.rem(f, WORDS)]
+        return of
 
-        _attn_softmax(kt_of, v_of)
+    def t_attn_decode_paged():
+        _attn_softmax(_paged_table(0), _paged_table(1))
+
+    def t_attn_decode_paged_f8():
+        # The fp8-pool variant (round 12): identical table walk and
+        # softmax, but every page tile DMA moves HALF the bytes from the
+        # fp8 KV workspace and widens to fp32 in VMEM — the static dtype
+        # branch (warm-spec pattern applied to storage dtype).
+        _attn_softmax(_paged_table(0), _paged_table(1), kv8=True)
 
     def t_attn_decode():
         # Single-token GQA decode for one q head: online-softmax flash
@@ -1146,7 +1224,8 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
               t_attn_decode_gqa, t_gemm_wide, t_norm_rope,
               t_append_kv, t_gemm_wide_w8, t_prefetch_w8,
               t_moe_topk, t_moe_ffn, t_gemm_mat, t_add_norm,
-              t_norm_rope_qkv, t_allreduce_row, t_prefetch_mat]
+              t_norm_rope_qkv, t_allreduce_row, t_prefetch_mat,
+              t_attn_decode_paged_f8, t_append_kv_f8]
     if used_types is not None:
         # Branch pruning (round 6): a compiled program's task-type set is
         # static — every absent type's handler compiles as the no-op, so
@@ -1187,6 +1266,7 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
               max_ar: int = 1, force_ar: bool = False,
               used_types: tuple | None = None,
               head_dim: int = TILE,
+              workspace_kv8=None,
               profile: bool = False):
     """Execute the packed task queue over the workspace in ONE pallas_call.
 
@@ -1219,11 +1299,16 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
     TILE heads live zero-padded in the low columns of their tile
     (models.py pads the projection weights), so attention needs no
     change — only the norm/rope sub-tile math does (round 9).
+    ``workspace_kv8``: optional (Tk8, TILE, TILE) float8_e4m3fn
+    READ-WRITE KV-pool workspace (ATTN_DECODE_PAGED_F8 streams it at
+    half the bytes; APPEND_KV_F8 saturate-casts appends into it) —
+    aliased in place like the main workspace, and the return becomes
+    ``(workspace, workspace_kv8)``.
     ``profile``: add an int32 (n_tasks, 128) profile OUTPUT — each grid
     step stamps [exec_index, *queue_row] into its row (the observability
-    per-task dispatch record, obs/kernel_profile.py); the return becomes
-    ``(workspace, profile_dump)``.
-    Returns the post-execution workspace.
+    per-task dispatch record, obs/kernel_profile.py); the return grows
+    ``profile_dump`` as its last element.
+    Returns the post-execution workspace(s).
     """
     n_tasks = num_tasks if num_tasks is not None else queue.shape[0]
     assert queue.shape[1] == WORDS
@@ -1271,6 +1356,18 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
     w8_absent = workspace8 is None
     if workspace8 is None:
         workspace8 = jnp.zeros((1, TILE, TILE), jnp.float8_e4m3fn)
+    kv8_present = workspace_kv8 is not None
+    if workspace_kv8 is None:
+        workspace_kv8 = jnp.zeros((1, TILE, TILE), jnp.float8_e4m3fn)
+    # The fp8 KV scratch (kT + V double-buffer slots, 2*PIPE_DEPTH tiles)
+    # exists full-size only when an fp8-pool handler can dispatch —
+    # passed pools, the full handler library (raw callers), or a queue
+    # naming the F8 types; everyone else keeps a 2-tile placeholder
+    # (same footprint discipline as the warm vbm slot / vbw8 shrink).
+    kv8_possible = (kv8_present or used_types is None
+                    or int(TaskType.ATTN_DECODE_PAGED_F8) in used_types
+                    or int(TaskType.APPEND_KV_F8) in used_types)
+    kv8_slots = 2 * PIPE_DEPTH if kv8_possible else 2
     if workspace8.shape[0] < SW + 1:
         # The compiled GEMM_WIDE_W8 branch statically slices strips (and
         # exists in the switch even for programs that never dispatch it)
@@ -1281,15 +1378,19 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
 
     # AR slots ride as a second output: Mosaic has no HBM scratch (see
     # language/core.py kernel_call ``workspaces``).
-    # profile adds a third: the (n_tasks, 128) int32 stamp buffer, blocked
-    # one row per grid step so each task writes only its own record.
-    out_specs = [any_spec(), any_spec()]
+    # The fp8 KV-pool workspace is a third, ALIASED like the main one
+    # (appends mutate it in place; a placeholder tile rides along when
+    # the program has no fp8 pools, same as the ws8 input).
+    # profile adds a fourth: the (n_tasks, 128) int32 stamp buffer,
+    # blocked one row per grid step so each task writes only its own
+    # record.
+    out_specs = [any_spec(), any_spec(), any_spec()]
     if profile:
         out_specs.append(pl.BlockSpec((1, 128), lambda t, *_pf: (t, 0)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_tasks,),
-        in_specs=[any_spec(), any_spec(), any_spec()],
+        in_specs=[any_spec(), any_spec(), any_spec(), any_spec()],
         out_specs=tuple(out_specs),
         scratch_shapes=[
             pltpu.VMEM((PIPE_DEPTH, TILE, TILE), wdt),      # va2
@@ -1324,6 +1425,10 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
             pltpu.VMEM((m_slots, m_kch, m_cols), wdt),  # vbm (mat chunks)
             pltpu.VMEM((m_rows, m_cols), jnp.float32),  # vaccm (mat accum)
             pltpu.VMEM((m_rows, m_cols), wdt),          # voutm (mat stores)
+            # vkv8: the fp8 KV stream's kT/V double-buffer slots (kT in
+            # [0, PIPE_DEPTH), V in [PIPE_DEPTH, 2*PIPE_DEPTH)); shrinks
+            # to 2 tiles when no fp8-pool handler can dispatch.
+            pltpu.VMEM((kv8_slots, TILE, TILE), jnp.float8_e4m3fn),
             pltpu.SemaphoreType.DMA(()),               # copy_sem
             # pipe sems: 2 per pipeline slot, +1 tile-prefetch sem, +1
             # matrix-warm sem (PREFETCH_MAT / warm GEMM_MAT specs).
@@ -1341,11 +1446,11 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
     if profile:
         base_kernel = kernel
 
-        def kernel(queue_ref, ws_in, ws8_ref, wm_ref, ws_o, slots_o,
-                   prof_ref, *scratch):
+        def kernel(queue_ref, ws_in, ws8_ref, wm_ref, wk8_in_ref, ws_o,
+                   slots_o, wk8_o, prof_ref, *scratch):
             _stamp_profile(queue_ref, prof_ref)
-            base_kernel(queue_ref, ws_in, ws8_ref, wm_ref, ws_o, slots_o,
-                        *scratch)
+            base_kernel(queue_ref, ws_in, ws8_ref, wm_ref, wk8_in_ref,
+                        ws_o, slots_o, wk8_o, *scratch)
     interpret = use_interpret()
     if interpret:
         from triton_distributed_tpu.runtime.interpret_workarounds import (
@@ -1370,6 +1475,8 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
         # AR slots: one max_ar-tile slab per rank (ALLREDUCE_ROW pushes a
         # whole activation row per peer; the single-tile task uses slab 0).
         jax.ShapeDtypeStruct((max(n, 1), AR, TILE, TILE), wdt),
+        jax.ShapeDtypeStruct(tuple(workspace_kv8.shape),
+                             jnp.float8_e4m3fn),
     ]
     if profile:
         out_shape.append(jax.ShapeDtypeStruct((n_tasks, 128), jnp.int32))
@@ -1385,9 +1492,12 @@ def run_queue(queue, workspace, *, num_ranks: int = 1, axis: str = "tp",
         # the gap between the per-task profile sum and the measured
         # step). Callers in a loop donate the carried workspace and XLA
         # runs the step fully in place; undonated callers get one
-        # XLA-level defensive copy instead of an in-kernel one.
-        input_output_aliases={1: 0},
-    )(queue, workspace, workspace8, workspace_m)
+        # XLA-level defensive copy instead of an in-kernel one. The fp8
+        # KV pool workspace (input 4 → output 2) aliases the same way.
+        input_output_aliases={1: 0, 4: 2},
+    )(queue, workspace, workspace8, workspace_m, workspace_kv8)
+    res = (outs[0], outs[2]) if kv8_present else outs[0]
     if profile:
-        return outs[0], outs[2]
-    return outs[0]
+        prof = outs[3]
+        return res + (prof,) if kv8_present else (res, prof)
+    return res

@@ -242,12 +242,14 @@ def advance_queue_pos(base_queue, pos: int, num_exec: int | None = None):
     q = np.asarray(base_queue).copy()
     attn = ((q[:, 0] == int(TaskType.ATTN_DECODE))
             | (q[:, 0] == int(TaskType.ATTN_DECODE_PAGED))
+            | (q[:, 0] == int(TaskType.ATTN_DECODE_PAGED_F8))
             | (q[:, 0] == int(TaskType.ATTN_DECODE_GQA)))
     if num_exec is not None:
         # Rows beyond the executable prefix are page-table DATA — their
         # words must never be interpreted as task fields.
         attn[num_exec:] = False
-    elif np.any(q[:, 0] == int(TaskType.ATTN_DECODE_PAGED)):
+    elif np.any((q[:, 0] == int(TaskType.ATTN_DECODE_PAGED))
+                | (q[:, 0] == int(TaskType.ATTN_DECODE_PAGED_F8))):
         # Paged programs append raw tile-id DATA rows after the tasks; a
         # row starting with 8/9 would match the mask and get corrupted.
         raise ValueError(
@@ -268,7 +270,8 @@ def advance_queue_pos(base_queue, pos: int, num_exec: int | None = None):
     q[attn, 4] = np.minimum(q[attn, 4], need)
     # APPEND_KV rows are self-describing (a_stride/b_stride = cache base
     # tiles): retarget the destination tile + intra-tile column to ``pos``.
-    app = q[:, 0] == int(TaskType.APPEND_KV)
+    app = ((q[:, 0] == int(TaskType.APPEND_KV))
+           | (q[:, 0] == int(TaskType.APPEND_KV_F8)))
     if num_exec is not None:
         app[num_exec:] = False
     ti, col = pos // TILE, pos % TILE
@@ -393,7 +396,8 @@ def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
             tid = mb.attn_decode_paged(_col(attn, j), _col(q, j), pages,
                                        valid_len=pos, scale=scale,
                                        k_new=_col(h.k_new, kv),
-                                       v_new=_col(h.v_new, kv))
+                                       v_new=_col(h.v_new, kv),
+                                       kv8=h.kT[kv].kv8)
             if meta_out is not None:
                 meta_out.setdefault("attn", []).append(
                     (tid, h.kT[kv].tile(0, 0), h.v[kv].tile(0, 0)))
@@ -501,7 +505,8 @@ def _check_decode_step_config(*, hidden, hq_local, hkv_local, ffn_local,
                               num_layers, max_seq, pos, batch, head_dim,
                               moe_experts, moe_topk,
                               fp8_weights=False,
-                              inkernel_append=False, paged=False) -> None:
+                              inkernel_append=False, paged=False,
+                              kv_fp8=False, seq_blocks=False) -> None:
     """Named build-time validation: every TILE/geometry constraint raises
     HERE, at build_decode_step time, naming the offending dimension AND
     the ModelConfig field it derives from — not later as an opaque tile
@@ -554,6 +559,30 @@ def _check_decode_step_config(*, hidden, hq_local, hkv_local, ffn_local,
                 "linear cache: the append writes row 0 only (batch-1 "
                 "serving); the paged serving lane appends per slot — "
                 "batch serving argument")
+    if kv_fp8:
+        # The fp8-pool form (round 12): named surface instead of a silent
+        # exclusion — every unsupported combination says exactly which
+        # knob conflicts and why.
+        if not (paged and seq_blocks):
+            raise ValueError(
+                "kv_fp8=True requires the paged SERVING pool form "
+                "(paged=True with kv_pool_pages): fp8 KV pools live in "
+                "the separate read-write fp8 workspace the "
+                "ATTN_DECODE_PAGED_F8 / APPEND_KV_F8 tasks address — "
+                "the linear cache stays in the workspace dtype "
+                "(kv_dtype serving argument)")
+        if fp8_weights:
+            raise ValueError(
+                "kv_fp8=True with fp8_weights=True: the serving pool "
+                "form runs the matrix weight layout, which the tiled "
+                "fp8-weight programs forgo — pick fp8 KV pools (the "
+                "decode-bandwidth lever) or tiled fp8 weights, not both "
+                "— kv_dtype / fp8_weights serving arguments")
+        if moe_experts:
+            raise ValueError(
+                "kv_fp8=True with MoE: the megakernel serving lane "
+                "covers the dense stack (validate_megakernel_cfg) — "
+                "config field num_experts")
     if num_layers < 1:
         raise ValueError(f"num_layers = {num_layers} must be >= 1 — "
                          "config field num_layers")
@@ -590,7 +619,8 @@ def build_decode_step(*, hidden: int, hq_local: int, hkv_local: int,
                       force_ar_tasks: bool = False,
                       mat_prefetch: bool = False,
                       kv_pool_pages: int | None = None,
-                      table_pages: int | None = None) -> DecodeStepProgram:
+                      table_pages: int | None = None,
+                      kv_fp8: bool = False) -> DecodeStepProgram:
     """Assemble a full num_layers decode step (per-device TP view).
 
     ``hq_local``/``hkv_local``/``ffn_local`` are this device's shards.
@@ -635,14 +665,21 @@ def build_decode_step(*, hidden: int, hq_local: int, hkv_local: int,
       lengths/append targets per step via ``prog.paged_meta``), per-slot
       rope tables (``cos``/``sin`` get one row block per slot), and
       in-kernel appends parked on the scratch page at build time.
+    * ``kv_fp8`` (round 12): the serving pool form's kT/v pools live in
+      the float8_e4m3fn KV workspace — ATTN_DECODE_PAGED_F8 streams each
+      page at HALF the bytes (widen to fp32 before the softmax dots) and
+      APPEND_KV_F8 saturate-casts appends (±448 clamp, the
+      models/fp8._to_e4m3 contract). Carry the kv8 workspace through
+      every step alongside the main one.
     """
+    seq_blocks = kv_pool_pages is not None
     _check_decode_step_config(
         hidden=hidden, hq_local=hq_local, hkv_local=hkv_local,
         ffn_local=ffn_local, num_layers=num_layers, max_seq=max_seq,
         pos=pos, batch=batch, head_dim=head_dim, moe_experts=moe_experts,
         moe_topk=moe_topk, fp8_weights=fp8_weights,
-        inkernel_append=inkernel_append, paged=paged)
-    seq_blocks = kv_pool_pages is not None
+        inkernel_append=inkernel_append, paged=paged,
+        kv_fp8=kv_fp8, seq_blocks=seq_blocks)
     if seq_blocks and not paged:
         raise ValueError("kv_pool_pages (the serving pool form) requires "
                          "paged=True")
@@ -711,9 +748,9 @@ def build_decode_step(*, hidden: int, hq_local: int, hkv_local: int,
             k_new = mb.tensor(TILE, hkv_local * d)
             v_new = mb.tensor(TILE, hkv_local * d)
         if seq_blocks:
-            kT = [mb.tensor(d, kv_pool_pages * TILE)
+            kT = [mb.tensor(d, kv_pool_pages * TILE, kv8=kv_fp8)
                   for _ in range(hkv_local)]
-            v = [mb.tensor(kv_pool_pages * TILE, d)
+            v = [mb.tensor(kv_pool_pages * TILE, d, kv8=kv_fp8)
                  for _ in range(hkv_local)]
         else:
             kT = [mb.tensor(d, max_seq) for _ in range(hkv_local)]
@@ -789,7 +826,7 @@ def build_decode_step(*, hidden: int, hq_local: int, hkv_local: int,
     meta = None
     if paged:
         meta = {"blocks": block_meta, "table_pages": tp,
-                "pool_pages": kv_pool_pages}
+                "pool_pages": kv_pool_pages, "kv_fp8": kv_fp8}
     return DecodeStepProgram(mb=mb, x=x, layers=layers, cos=cos, sin=sin,
                              x_out=outs[0], fnorm=fnorm,
                              x_out_blocks=outs, blocks=bt,

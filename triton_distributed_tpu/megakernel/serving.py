@@ -414,7 +414,7 @@ class PagedMegakernelDecoder:
 
     def __init__(self, cfg: ModelConfig, params: dict, *, num_slots: int,
                  num_pages: int, max_pages: int, dtype=jnp.float32,
-                 mat_prefetch: bool = True):
+                 kv_dtype=None, mat_prefetch: bool = True):
         capacity = max_pages * TILE
         validate_megakernel_cfg(cfg, capacity)
         if num_slots < 1:
@@ -426,6 +426,23 @@ class PagedMegakernelDecoder:
             # the scratch page; the admission budget checks usable pages)
             # — only an empty table is meaningless.
             raise ValueError(f"max_pages = {max_pages} must be >= 1")
+        # kv_dtype (round 12): None / the workspace dtype keeps the pools
+        # as main-workspace tiles; float8_e4m3fn moves them into the fp8
+        # KV workspace — ATTN_DECODE_PAGED_F8 streams pages at HALF the
+        # bytes and APPEND_KV_F8 saturate-casts appends, the megakernel
+        # half of the fp8 KV serving lane. Anything else is a named
+        # error (the serving tier wraps it in BackendUnsupportedError
+        # and demotes rather than dying).
+        wdt = jnp.dtype(dtype)
+        self.kv_fp8 = (kv_dtype is not None
+                       and jnp.dtype(kv_dtype) == jnp.float8_e4m3fn)
+        if (kv_dtype is not None and not self.kv_fp8
+                and jnp.dtype(kv_dtype) != wdt):
+            raise ValueError(
+                f"megakernel paged lane serves kv_dtype float8_e4m3fn "
+                f"(the fp8 pool workspace) or the workspace dtype "
+                f"({wdt}); got {jnp.dtype(kv_dtype)} — kv_dtype engine "
+                "argument")
         self.cfg = cfg
         self.num_slots = num_slots
         self.num_pages = num_pages          # usable pages (excl. scratch)
@@ -440,7 +457,8 @@ class PagedMegakernelDecoder:
             paged=True, inkernel_append=True,
             batch=num_slots * TILE, head_dim=cfg.head_dim,
             mat_prefetch=mat_prefetch,
-            kv_pool_pages=num_pages + 1, table_pages=max_pages)
+            kv_pool_pages=num_pages + 1, table_pages=max_pages,
+            kv_fp8=self.kv_fp8)
         self.comp = self.prog.mb.compile(dtype=dtype,
                                          head_dim=cfg.head_dim)
         self._weight_feeds = weight_feeds(self.prog, cfg, params)
@@ -463,7 +481,7 @@ class PagedMegakernelDecoder:
                  for tid, kt0, v0 in blk.get("append", ())])
         self._base_queue = q0
         self._table_rows = -(-2 * max_pages // WORDS)
-        self._step_jit = jax.jit(self._step, donate_argnums=(0,))
+        self._step_jit = jax.jit(self._step, donate_argnums=(0, 1))
         self._load_jits: dict = {}      # page count -> jitted loader
         # Rope tables depend only on the integer position: cache the
         # COMPACT (TILE,) row per position (every row of the broadcast
@@ -475,22 +493,28 @@ class PagedMegakernelDecoder:
         self.last_step_cold = True
 
     # -- workspace ----------------------------------------------------------
-    def start(self) -> jax.Array:
+    def start(self):
         """Weights loaded, pools zeroed. Returns the carried workspace
-        (donate it back through every step)."""
+        (donate it back through every step) — with ``kv_dtype``
+        float8_e4m3fn, a ``(main, kv8)`` PAIR: the fp8 pool workspace
+        rides alongside and both alias in place through the step."""
         main, _w8, wm = self.comp.split_feeds(dict(self._weight_feeds))
         self._wsm = (self.comp.make_workspace_mat(wm)
                      if self.comp.num_mrows else None)
-        return self.comp.make_workspace(main)
+        ws = self.comp.make_workspace(main)
+        if self.kv_fp8:
+            return ws, self.comp.make_workspace_kv8()
+        return ws
 
-    def load_prefill(self, ws: jax.Array, k_lin, v_lin,
-                     pages: list[int]) -> jax.Array:
+    def load_prefill(self, ws, k_lin, v_lin, pages: list[int]):
         """Scatter a finished prefill's KV into the slot's pool pages.
         ``k_lin``/``v_lin``: the linear prefill buffer (L, 1, S_buf,
         hkv, head_dim); page ``pages[i]`` receives positions
         [i*TILE, (i+1)*TILE). ONE jitted donated update per page count —
         un-jitted per-tile scatters would each copy the whole (multi-GB
-        at the bench shapes) workspace."""
+        at the bench shapes) workspace. fp8 pools quantize here through
+        the SAME saturating cast the dense scatter uses (token parity
+        across backends depends on the two quantizing identically)."""
         for p in pages:
             if not 0 <= int(p) < self.num_pages:
                 raise ValueError(
@@ -502,11 +526,23 @@ class PagedMegakernelDecoder:
             fn = jax.jit(functools.partial(self._load_pages, len(pages)),
                          donate_argnums=(0,))
             self._load_jits[len(pages)] = fn
-        return fn(ws, k_lin, v_lin, jnp.asarray(pages, jnp.int32))
+        pg = jnp.asarray(pages, jnp.int32)
+        if self.kv_fp8:
+            ws_main, wk8 = ws
+            return ws_main, fn(wk8, k_lin, v_lin, pg)
+        return fn(ws, k_lin, v_lin, pg)
 
     def _load_pages(self, n_pages, ws, k_lin, v_lin, pages):
+        # ``ws`` is the MAIN workspace normally, the fp8 KV pool
+        # workspace under kv_fp8 (the pool tile ids index whichever
+        # space the program allocated them in).
+        from triton_distributed_tpu.models.fp8 import saturate_cast
+
         hd = self.cfg.head_dim
-        wdt = self.comp.dtype
+        dt = jnp.float8_e4m3fn if self.kv_fp8 else self.comp.dtype
+
+        def cast(x):
+            return saturate_cast(x, dt)
         for li, h in enumerate(self.prog.layers):
             for kv in range(self.cfg.num_kv_heads):
                 kT0 = h.kT[kv].tile(0, 0)
@@ -521,9 +557,9 @@ class PagedMegakernelDecoder:
                         kT = jnp.pad(kT, ((0, TILE - hd), (0, 0)))
                         vv = jnp.pad(vv, ((0, 0), (0, TILE - hd)))
                     ws = jax.lax.dynamic_update_slice(
-                        ws, kT.astype(wdt)[None], (kT0 + p, 0, 0))
+                        ws, cast(kT)[None], (kT0 + p, 0, 0))
                     ws = jax.lax.dynamic_update_slice(
-                        ws, vv.astype(wdt)[None], (v0 + p, 0, 0))
+                        ws, cast(vv)[None], (v0 + p, 0, 0))
         return ws
 
     # -- per-step host retarget ---------------------------------------------
@@ -588,12 +624,13 @@ class PagedMegakernelDecoder:
         return t
 
     # -- one step over every slot --------------------------------------------
-    def _step(self, ws, embed, final_norm, lm_head, queue, cos, sin,
+    def _step(self, ws, wk8, embed, final_norm, lm_head, queue, cos, sin,
               tokens):
         # embed / final_norm / lm_head arrive as ARGUMENTS (the bench.py
         # closed-over-constant hazard). Row b*TILE of block b carries the
         # slot's real token; the other 127 rows are padding lanes whose
-        # outputs are discarded.
+        # outputs are discarded. ``wk8``: the fp8 KV pool workspace
+        # (None unless kv_fp8 — a STATIC branch, like the program form).
         hidden = self.cfg.hidden_size
         B = self.num_slots
         rows = embed[tokens].astype(jnp.float32)            # (B, hidden)
@@ -602,7 +639,10 @@ class PagedMegakernelDecoder:
         ws = self.comp.scatter_input(ws, self.prog.x, x)
         ws = self.comp.scatter_input(ws, self.prog.cos, cos)
         ws = self.comp.scatter_input(ws, self.prog.sin, sin)
-        ws = self.comp.step(ws, queue, wsm=self._wsm)
+        if wk8 is None:
+            ws = self.comp.step(ws, queue, wsm=self._wsm)
+        else:
+            ws, wk8 = self.comp.step(ws, queue, wsm=self._wsm, wkv8=wk8)
         outs = [self.comp.gather_output(ws, h)[0:1]
                 for h in self.prog.x_out_blocks]
         x_out = jnp.concatenate(outs, axis=0)               # (B, hidden)
@@ -611,13 +651,15 @@ class PagedMegakernelDecoder:
                       self.cfg.rms_norm_eps)
         head = lm_head if lm_head is not None else embed.T
         logits = xn @ head.astype(jnp.float32)
-        return ws, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return ws, wk8, jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
-    def step(self, ws: jax.Array, tokens, kv_lens, tables):
+    def step(self, ws, tokens, kv_lens, tables):
         """One decode step over every slot. tokens: (B,) int32 (idle
         slots: any id — their lane is discarded); kv_lens: (B,) host
         ints (0 = idle); tables: (B, <=max_pages) pool page ids.
-        Returns (workspace', next_tokens (B,))."""
+        Returns (workspace', next_tokens (B,)) — the workspace is the
+        ``(main, kv8)`` pair under kv_fp8, exactly as start() returned
+        it."""
         queue = self._retarget(kv_lens, tables)
         tabs = [self._rope(int(kv_lens[b]))
                 for b in range(self.num_slots)]
@@ -626,11 +668,12 @@ class PagedMegakernelDecoder:
         sin = np.concatenate(
             [np.broadcast_to(t[1], (TILE, TILE)) for t in tabs], axis=0)
         self.last_step_cold = not self.warm
+        ws_main, wk8 = (ws if self.kv_fp8 else (ws, None))
         with obs_trace.span("mk_paged_step", slots=self.num_slots):
-            out = self._step_jit(ws, self.embed, self.final_norm,
-                                 self.lm_head, queue, jnp.asarray(cos),
-                                 jnp.asarray(sin),
-                                 jnp.asarray(np.asarray(tokens),
-                                             jnp.int32))
+            ws_main, wk8, tok = self._step_jit(
+                ws_main, wk8, self.embed, self.final_norm,
+                self.lm_head, queue, jnp.asarray(cos),
+                jnp.asarray(sin),
+                jnp.asarray(np.asarray(tokens), jnp.int32))
         self.warm = True
-        return out
+        return ((ws_main, wk8) if self.kv_fp8 else ws_main), tok

@@ -176,6 +176,23 @@ class TaskType(enum.IntEnum):
     #                 consuming task's SPEC INDEX (static kch per branch).
     #                 Reference: the weight-prefetch task of
     #                 mega_triton_kernel (SURVEY.md §2.7).
+    ATTN_DECODE_PAGED_F8 = 24  # ATTN_DECODE_PAGED whose page POOLS live
+    #                 in the float8_e4m3fn KV workspace (a separate
+    #                 READ-WRITE array with its own tile-id space): each
+    #                 table entry's kT/V tile DMA moves HALF the bytes —
+    #                 the decode-bandwidth lever (ROADMAP 1a; reference
+    #                 fp8 serving payload README.md:96-97) — and tiles
+    #                 widen to fp32 in VMEM before the softmax dots
+    #                 (quantize-then-attend: parity vs the dense fp8-KV
+    #                 paged path is exact). Same word layout as
+    #                 ATTN_DECODE_PAGED; a distinct STATIC branch, the
+    #                 warm-spec pattern (MatSpec.warm) applied to dtype.
+    APPEND_KV_F8 = 25  # APPEND_KV into the fp8 KV pool workspace: the
+    #                 new k/v rows (main-workspace activations) clamp to
+    #                 e4m3's ±448 finite range and CAST on append (the
+    #                 models/fp8._to_e4m3 saturation contract — a plain
+    #                 cast would NaN hot KV values), read-modify-write of
+    #                 the two fp8 cache tiles. Same words as APPEND_KV.
     MOE_FFN = 18    # One task = one layer's ENTIRE expert MLP: loops the E
     #                 experts; an expert whose (E, B) weight column is all
     #                 zero is SKIPPED before any weight DMA issues — the
@@ -220,12 +237,16 @@ class TensorHandle:
 
     ``fp8``: lives in the float8_e4m3fn WEIGHT workspace (a separate
     read-only input array with its own tile-id space) instead of the main
-    workspace."""
+    workspace. ``kv8``: lives in the float8_e4m3fn KV-POOL workspace — a
+    separate READ-WRITE array (aliased through the step like the main
+    workspace) holding paged KV pools at half the bytes; only
+    ATTN_DECODE_PAGED_F8 reads it and APPEND_KV_F8 writes it."""
 
     base: int
     rows: int
     cols: int
     fp8: bool = False
+    kv8: bool = False
 
     @property
     def rt(self) -> int:

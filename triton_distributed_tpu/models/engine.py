@@ -59,6 +59,7 @@ class Engine:
                  ctx: DistContext | None = None, *, axis: str = "tp",
                  backend: str = "auto", max_seq: int = 256,
                  page_size: int | None = None,
+                 kv_dtype=None,
                  inter_axis: str | None = None,
                  prefill_fn: Callable = dense_prefill,
                  decode_fn: Callable = dense_decode_step):
@@ -102,6 +103,18 @@ class Engine:
         self.page_size = page_size
         self.max_pages = (-(-max_seq // page_size)
                           if page_size is not None else None)
+        # kv_dtype: the PAGED pool storage dtype (fp8 KV serving,
+        # ROADMAP 1a — "float8_e4m3fn" halves decode DMA bytes; every
+        # pool write quantizes through the saturating cast). None keeps
+        # the model dtype. Linear caches (prefill) stay full-width; the
+        # quantization point is the linear→paged hand-off.
+        if kv_dtype is not None and page_size is None:
+            raise ValueError(
+                "kv_dtype without page_size: the KV storage dtype is a "
+                "property of the PAGED pool (decode serving); linear "
+                "caches stay in the model dtype — pass page_size too")
+        self.kv_dtype = (jnp.dtype(kv_dtype) if kv_dtype is not None
+                         else None)
         self._prefill_fn = prefill_fn
         self._decode_fn = (dense_decode_step_paged
                            if page_size is not None and
@@ -406,11 +419,18 @@ class Engine:
         """Mirror a linear cache (the fast batched-prefill target) into the
         paged layout: identity page tables, per-sequence lengths = offset.
         Jitted with the linear cache DONATED, so XLA aliases the KV buffers
-        instead of holding both layouts live."""
+        instead of holding both layouts live. With ``kv_dtype`` set the
+        conversion IS the quantization point: pools narrow through the
+        saturating cast (quantize-then-attend — the same stored values the
+        serving tier's chunked-prefill scatter produces, so sequential and
+        continuous-batching serves stay token-identical)."""
         key = ("to_paged", cache.k.shape)
         if key not in self._jit_cache:
+            from triton_distributed_tpu.models.fp8 import saturate_cast
+
             L, batch = cache.k.shape[0], cache.k.shape[1]
             P_, mp = self.page_size, self.max_pages
+            kv_dt = self.kv_dtype
             pad = mp * P_ - cache.max_seq
             mesh = self.ctx.mesh
             shardings = jax.tree.map(
@@ -422,7 +442,9 @@ class Engine:
                 def to_pools(x):  # (L, B, S, hkv, d) -> (L, B*mp, P, ...)
                     x = jnp.pad(x, ((0, 0), (0, 0), (0, pad),
                                     (0, 0), (0, 0)))
-                    return x.reshape(L, batch * mp, P_, *x.shape[3:])
+                    x = x.reshape(L, batch * mp, P_, *x.shape[3:])
+                    return saturate_cast(x, kv_dt) if kv_dt is not None \
+                        else x
 
                 return PagedModelCache(
                     k_pools=to_pools(c.k), v_pools=to_pools(c.v),

@@ -49,22 +49,24 @@ def _to_e4m3(a: jax.Array) -> jax.Array:
 
 
 def quantize_dense_weights(params: dict) -> dict:
-    """The dense param tree with every per-layer projection/MLP weight
-    cast to ``float8_e4m3fn`` (half the bf16 bytes; values round to
-    e4m3). Non-weight leaves (norms, embed, lm_head, MoE router) are
-    shared, not copied."""
+    """The param tree with every per-layer projection/MLP weight cast to
+    ``float8_e4m3fn`` (half the bf16 bytes; values round to e4m3).
+    Non-weight leaves (norms, embed, lm_head, MoE router) are shared,
+    not copied.
+
+    MoE EXPERT weights (the ``moe`` subtree's ``w_gate``/``w_up``/
+    ``w_down`` stacks) quantize too (ROADMAP 1a tail): the expert
+    ``ragged_dot`` routes through the dtype-aware
+    :func:`~triton_distributed_tpu.ops.moe.ragged_dot_dtype_aware` path,
+    which runs the PURE e4m3×e4m3 grouped matmul with fp32 accumulation
+    — never the losing mixed bf16×fp8 configuration (the activation is
+    quantized at the dot, exactly like :func:`fp8_dot`). The router
+    stays in the model dtype: its (h, E) bytes are noise next to the
+    expert stacks, and routing decisions keep full-width logits."""
     def q_layer(layer: dict) -> dict:
         out = {}
         for k, v in layer.items():
-            if k == "moe":
-                # MoE expert weights stay in the model dtype: the expert
-                # GEMMs (ragged_dot) never receive dot_fn, so quantizing
-                # them would silently run the mixed bf16×fp8 configuration
-                # this module's docstring documents as LOSING (~0.3×) —
-                # the lane's scope is the dense projections, like the
-                # megakernel's fp8 weight workspace.
-                out[k] = v
-            elif isinstance(v, dict):
+            if isinstance(v, dict):
                 out[k] = q_layer(v)
             elif k in _WEIGHT_KEYS:
                 out[k] = _to_e4m3(jnp.asarray(v))
@@ -88,6 +90,17 @@ def fp8_dot(x: jax.Array, w: jax.Array) -> jax.Array:
         x8, w8, (((x.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
     return out.astype(out_dt)
+
+
+def saturate_cast(a: jax.Array, dtype) -> jax.Array:
+    """``astype`` that routes through the saturating e4m3 cast when the
+    target is ``float8_e4m3fn`` — the one cast every fp8 KV-pool write
+    (paged append, prefill scatter, linear→paged conversion, migration
+    pack) must share, or a hot KV value would NaN one path and clamp the
+    others and token parity across tiers would silently break."""
+    if jnp.dtype(dtype) == E4M3:
+        return _to_e4m3(a)
+    return a.astype(dtype)
 
 
 def fp8_emulated_dot(x: jax.Array, w: jax.Array) -> jax.Array:

@@ -220,20 +220,59 @@ class PageAllocator:
 
 def init_paged_model_cache(cfg, batch: int, *, page_size: int,
                            max_pages: int, num_pages: int | None = None,
-                           dtype=None,
+                           dtype=None, kv_dtype=None,
                            num_kv_heads: int | None = None) -> PagedModelCache:
     """Zeroed pools + identity page tables (the host's allocator may
     rewrite tables between steps — they are data). Pool sizing is
-    validated up front with named errors (:class:`PagePoolConfigError`)."""
+    validated up front with named errors (:class:`PagePoolConfigError`).
+
+    ``kv_dtype`` overrides the POOL storage dtype (``float8_e4m3fn`` is
+    the fp8 KV serving payload — half the decode DMA bytes; see
+    :func:`kv_pool_pages_for_budget` for the doubled-pool accounting).
+    Writers must quantize through ``models/fp8.saturate_cast`` — the
+    paged append, the serving scatter and ``Engine.to_paged`` all do."""
     heads = num_kv_heads if num_kv_heads is not None else cfg.num_kv_heads
     num_pages = num_pages or batch * max_pages
     _check_paged_pool_config(page_size=page_size, max_pages=max_pages,
                              num_pages=num_pages, batch=batch)
-    dt = dtype or jnp.dtype(cfg.dtype)
+    dt = kv_dtype if kv_dtype is not None else (dtype or jnp.dtype(cfg.dtype))
     shape = (cfg.num_layers, num_pages, page_size, heads, cfg.head_dim)
     table = identity_page_table(batch, max_pages, num_pages)
     return PagedModelCache(jnp.zeros(shape, dt), jnp.zeros(shape, dt),
                            table, jnp.zeros((batch,), jnp.int32))
+
+
+def kv_page_bytes(cfg, *, page_size: int, kv_dtype=None,
+                  num_kv_heads: int | None = None) -> int:
+    """HBM bytes ONE pool page costs across all layers (k + v): the unit
+    the serving tier's fixed-budget pool sizing divides by. Narrower
+    ``kv_dtype`` → cheaper pages; at e4m3 each page costs half the f16
+    bytes and a quarter of f32."""
+    heads = num_kv_heads if num_kv_heads is not None else cfg.num_kv_heads
+    item = jnp.dtype(kv_dtype if kv_dtype is not None
+                     else cfg.dtype).itemsize
+    return 2 * cfg.num_layers * page_size * heads * cfg.head_dim * item
+
+
+def kv_pool_pages_for_budget(cfg, *, page_size: int, hbm_bytes: int,
+                             kv_dtype=None,
+                             num_kv_heads: int | None = None) -> int:
+    """Pages a FIXED HBM budget buys (``hbm_bytes // kv_page_bytes``) —
+    the fp8-KV admission-width lever: at ``kv_dtype=float8_e4m3fn`` page
+    tiles halve vs bf16 (quarter vs f32), so ``num_pages`` doubles at
+    the same budget and the scheduler's admission / preemption /
+    :class:`RequestTooLargeError` bounds pick the wider pool up with no
+    logic change (they all derive from the allocator's page counts).
+    Raises :class:`PagePoolConfigError` when the budget buys no page."""
+    per_page = kv_page_bytes(cfg, page_size=page_size, kv_dtype=kv_dtype,
+                             num_kv_heads=num_kv_heads)
+    pages = int(hbm_bytes) // per_page
+    if pages < 1:
+        raise PagePoolConfigError(
+            f"kv_hbm_budget = {hbm_bytes} bytes buys zero pages (one "
+            f"page costs {per_page} bytes across {cfg.num_layers} "
+            "layers) — field kv_hbm_budget")
+    return pages
 
 
 def paged_cache_specs(axis: str = "tp"):

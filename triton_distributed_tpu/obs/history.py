@@ -89,6 +89,9 @@ METRICS: tuple[MetricSpec, ...] = (
     MetricSpec("decode_step_ms_fp8",
                "decode step ms (fp8 weights, pure-fp8 dots)", " ms",
                "lower", "decode"),
+    MetricSpec("decode_step_ms_fp8kv",
+               "decode step ms (paged decode, e4m3 KV pools — half the "
+               "attention DMA bytes)", " ms", "lower", "decode"),
     MetricSpec("decode_step_ms_megakernel", "decode step ms (megakernel)",
                " ms", "lower", "megakernel"),
     MetricSpec("decode_step_ms_megakernel_ar",
@@ -109,6 +112,13 @@ METRICS: tuple[MetricSpec, ...] = (
                "KV migration included, same window as the monolithic "
                "rung)",
                " tok/s", "higher", "serving"),
+    MetricSpec("serve_tokens_per_s_fp8kv",
+               "serving tokens/s (fp8 e4m3 KV pools, same window as the "
+               "full-width rung)",
+               " tok/s", "higher", "serving"),
+    MetricSpec("serve_ttft_p99_ms_fp8kv",
+               "serving TTFT p99 (fp8 KV pools)", " ms", "lower",
+               "serving"),
 )
 
 METRIC_BY_KEY = {m.key: m for m in METRICS}

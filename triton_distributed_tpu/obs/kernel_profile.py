@@ -74,6 +74,9 @@ TASK_CLASS: dict[TaskType, str] = {
     TaskType.ALLREDUCE_ROW: "allreduce",
     # Round-9 stall-slice kill: cross-task GEMM_MAT chunk warm.
     TaskType.PREFETCH_MAT: "prefetch",
+    # Round-12 fp8 KV pool variants (half-byte paged cache stream).
+    TaskType.ATTN_DECODE_PAGED_F8: "attention",
+    TaskType.APPEND_KV_F8: "kv_append",
 }
 
 # Fixed per-task dispatch/DMA-issue overhead the round-5 profile measured
@@ -172,6 +175,10 @@ def estimate_task_seconds(rec: TaskRecord, itemsize: int = 2,
         nbytes = 3 * kt * tile_b
     elif t in (TaskType.ATTN_DECODE, TaskType.ATTN_DECODE_PAGED):
         nbytes = (2 * kt + 3) * tile_b
+    elif t is TaskType.ATTN_DECODE_PAGED_F8:
+        # fp8 pool pages: the 2*kt cache tiles move ONE byte per element
+        # regardless of the workspace itemsize — the halved-DMA lever.
+        nbytes = 2 * kt * TILE * TILE + 3 * tile_b
     elif t is TaskType.ATTN_DECODE_GQA:
         g = max(w["arg"] >> 24, 1)
         nbytes = (2 * kt + 2 * g + 3) * tile_b
@@ -215,6 +222,9 @@ def estimate_task_seconds(rec: TaskRecord, itemsize: int = 2,
         return FIXED_TASK_OVERHEAD_S / 2
     elif t is TaskType.APPEND_KV:
         nbytes = 8 * tile_b
+    elif t is TaskType.APPEND_KV_F8:
+        # Two fp8 cache tiles round-trip (1 B/elem) + two wdt new-rows.
+        nbytes = 4 * TILE * TILE + 2 * tile_b
     else:
         nbytes = 2 * kt * tile_b
     return FIXED_TASK_OVERHEAD_S + nbytes / bw

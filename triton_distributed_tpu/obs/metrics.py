@@ -48,13 +48,17 @@ SERVE_PREEMPTIONS = "tdtpu_serve_preemptions_total"
 SERVE_REJECTS = "tdtpu_serve_admission_rejects_total"
 SERVE_FINISHED = "tdtpu_serve_requests_finished_total"
 SERVE_TOKENS_PER_S = "tdtpu_serve_tokens_per_s"
+# Pool pages resident at the configured kv_dtype (round 12, fp8 KV): at a
+# fixed HBM budget this gauge is the doubled-pool evidence — e4m3 page
+# tiles cost half the bf16 bytes, so the same budget holds 2× the pages.
+KV_PAGES_RESIDENT = "tdtpu_kv_pages_resident"
 
 # What the report's serving lane renders (histograms first, then
 # gauges/counters, in this order).
 SERVING_SERIES = (SERVE_TTFT_MS, SERVE_TPOT_MS, SERVE_QUEUE_DEPTH,
                   SERVE_FREE_PAGES, SERVE_ACTIVE, SERVE_ADMIT_CAP,
                   SERVE_PREEMPTIONS, SERVE_REJECTS, SERVE_FINISHED,
-                  SERVE_TOKENS_PER_S)
+                  KV_PAGES_RESIDENT, SERVE_TOKENS_PER_S)
 
 # KV-migration lane (disaggregated prefill/decode tier, docs/disagg.md):
 # published by disagg/migrate.py + disagg/engine.py, rendered as

@@ -647,6 +647,18 @@ def main(argv: list[str] | None = None) -> int:
                 failures.append(
                     f"SLO violation: {r['rule']} observed "
                     f"{r['observed']:g} vs threshold {r['threshold']:g}")
+    # The serving lane must carry its pool gauge (round 12): any
+    # continuous-batching snapshot without tdtpu_kv_pages_resident lost
+    # the fixed-HBM pool evidence the fp8-KV admission math is judged by.
+    from triton_distributed_tpu.obs import metrics as _om
+
+    serving_present = any(
+        n in (metrics or {}) for n in _om.SERVING_SERIES
+        if n not in (_om.SERVE_TOKENS_PER_S, _om.KV_PAGES_RESIDENT))
+    if serving_present and _om.KV_PAGES_RESIDENT not in (metrics or {}):
+        failures.append(
+            f"serving lane present but {_om.KV_PAGES_RESIDENT} missing — "
+            "the KV pool gauge is part of the serving lane contract")
     demotions = degradation_count(metrics)
     if demotions and not args.allow_degradation:
         failures.append(

@@ -53,15 +53,39 @@ def sort_by_expert(expert_ids: jax.Array, num_experts: int):
     return sort_idx, group_sizes.astype(jnp.int32)
 
 
+def ragged_dot_dtype_aware(x: jax.Array, w: jax.Array,
+                           group_sizes: jax.Array) -> jax.Array:
+    """The grouped matmul every expert GEMM routes through (ROADMAP 1a
+    tail: the fp8 lane covers MoE experts too). Full-width weights run
+    the plain ``ragged_dot``; ``float8_e4m3fn`` expert stacks
+    (models/fp8.quantize_dense_weights) run the PURE fp8 configuration —
+    the activation quantizes to e4m3 at the dot (saturating cast) and
+    the e4m3×e4m3 products accumulate in fp32, exactly the
+    :func:`~triton_distributed_tpu.models.fp8.fp8_dot` contract. The
+    mixed bf16×fp8 form (upcast weights, wide activations) is NEVER run:
+    it measured ~0.3× bf16 on this chip generation (docs/gemm_core.md).
+    Output returns in the activation's dtype."""
+    if w.dtype == jnp.float8_e4m3fn:
+        from triton_distributed_tpu.models.fp8 import _to_e4m3
+
+        out = jax.lax.ragged_dot(_to_e4m3(x), w, group_sizes,
+                                 preferred_element_type=jnp.float32)
+        out_dt = (x.dtype if x.dtype != jnp.float8_e4m3fn
+                  else jnp.float32)
+        return out.astype(out_dt)
+    return jax.lax.ragged_dot(x, w, group_sizes)
+
+
 def grouped_mlp(x_sorted: jax.Array, group_sizes: jax.Array,
                 w_gate: jax.Array, w_up: jax.Array, w_down: jax.Array):
     """SwiGLU expert MLP over expert-sorted tokens via ragged_dot.
 
     x_sorted: (T, h); w_*: (E, h, ffn) / (E, ffn, h). Returns (T, h)."""
-    gate = jax.lax.ragged_dot(x_sorted, w_gate, group_sizes)
-    up = jax.lax.ragged_dot(x_sorted, w_up, group_sizes)
+    gate = ragged_dot_dtype_aware(x_sorted, w_gate, group_sizes)
+    up = ragged_dot_dtype_aware(x_sorted, w_up, group_sizes)
     act = jax.nn.silu(gate) * up
-    return jax.lax.ragged_dot(act.astype(x_sorted.dtype), w_down, group_sizes)
+    return ragged_dot_dtype_aware(act.astype(x_sorted.dtype), w_down,
+                                  group_sizes)
 
 
 def ag_group_gemm_local(x_local: jax.Array, expert_ids: jax.Array,
@@ -90,7 +114,7 @@ def ag_group_gemm_local(x_local: jax.Array, expert_ids: jax.Array,
     sort_idx, group_sizes = sort_by_expert(expert_ids, E)
     token_of_flat = sort_idx // topk
     x_sorted = x_full[token_of_flat]
-    y_sorted = jax.lax.ragged_dot(x_sorted, w_experts, group_sizes)
+    y_sorted = ragged_dot_dtype_aware(x_sorted, w_experts, group_sizes)
     if topk_weights is not None:
         y_sorted = y_sorted * topk_weights.reshape(-1)[sort_idx][:, None]
     return y_sorted.astype(x_local.dtype), sort_idx, group_sizes
@@ -131,7 +155,7 @@ def ag_group_gemm_ring_local(x_local: jax.Array, expert_ids: jax.Array,
         f0 = src * mc * topk
         e_c = jax.lax.dynamic_slice_in_dim(expert_ids, f0, mc * topk)
         sidx_c, gsz_c = sort_by_expert(e_c, E)
-        y_c = jax.lax.ragged_dot(xc[sidx_c // topk], w_experts, gsz_c)
+        y_c = ragged_dot_dtype_aware(xc[sidx_c // topk], w_experts, gsz_c)
         if w_flat is not None:
             wf = jax.lax.dynamic_slice_in_dim(w_flat, f0, mc * topk)
             y_c = y_c * wf[sidx_c][:, None]
@@ -182,7 +206,7 @@ def moe_reduce_rs_local(y_sorted: jax.Array, sort_idx: jax.Array,
     n = num_ranks
     M = num_tokens
     topk = sort_idx.shape[0] // M
-    partial_sorted = jax.lax.ragged_dot(y_sorted, w_down, group_sizes)
+    partial_sorted = ragged_dot_dtype_aware(y_sorted, w_down, group_sizes)
     w_flat = topk_weights.reshape(-1)[sort_idx]
     partial_sorted = partial_sorted * w_flat[:, None]
     token_of_flat = sort_idx // topk
@@ -259,7 +283,7 @@ def moe_reduce_rs_overlap_local(act_sorted: jax.Array, sort_idx: jax.Array,
         e_c = jnp.searchsorted(csum, pos, side="right").astype(jnp.int32)
         sidx_c, gsz_c = sort_by_expert(e_c, E)
         rows = act_sorted[pos[sidx_c]]
-        part = jax.lax.ragged_dot(rows, w_down, gsz_c)
+        part = ragged_dot_dtype_aware(rows, w_down, gsz_c)
         part = part * w_flat[fr][sidx_c][:, None]
         tloc = (fr // topk - c * mc)[sidx_c]
         return jax.ops.segment_sum(part, tloc, num_segments=mc
@@ -301,7 +325,7 @@ def _chunk_moe(xc: jax.Array, gate_w: jax.Array, w_gate: jax.Array,
     x_sorted, sort_idx, group_sizes, token_of_flat, topk_weights = \
         route_and_sort(xc, gate_w, topk)
     act = grouped_mlp_gate_up(x_sorted, group_sizes, w_gate, w_up)
-    part = jax.lax.ragged_dot(act, w_down, group_sizes)
+    part = ragged_dot_dtype_aware(act, w_down, group_sizes)
     part = part * topk_weights.reshape(-1)[sort_idx][:, None]
     return jax.ops.segment_sum(part, token_of_flat,
                                num_segments=mc).astype(xc.dtype)
@@ -399,8 +423,8 @@ def moe_tp_fwd_local(x_local: jax.Array, gate_w: jax.Array,
 
 
 def grouped_mlp_gate_up(x_sorted, group_sizes, w_gate, w_up):
-    gate = jax.lax.ragged_dot(x_sorted, w_gate, group_sizes)
-    up = jax.lax.ragged_dot(x_sorted, w_up, group_sizes)
+    gate = ragged_dot_dtype_aware(x_sorted, w_gate, group_sizes)
+    up = ragged_dot_dtype_aware(x_sorted, w_up, group_sizes)
     return (jax.nn.silu(gate) * up).astype(x_sorted.dtype)
 
 

@@ -49,14 +49,33 @@ class PagedKVCache(NamedTuple):
 
 def init_paged_kv_cache(batch: int, *, num_pages: int, page_size: int,
                         num_kv_heads: int, head_dim: int, max_pages: int,
-                        dtype=jnp.float32) -> PagedKVCache:
+                        dtype=jnp.float32, kv_dtype=None) -> PagedKVCache:
     """Pool + identity page tables (page allocation policy is the host's;
-    tables are data, so any allocator can rewrite them between steps)."""
+    tables are data, so any allocator can rewrite them between steps).
+
+    ``kv_dtype`` overrides the POOL storage dtype (tables/lengths stay
+    int32) — ``float8_e4m3fn`` is the serving payload (ROADMAP 1a): half
+    the attention DMA bytes per decode step, and at a fixed HBM budget
+    the pool holds twice the pages. Appends quantize through the
+    saturating ``models/fp8._to_e4m3`` cast; the kernel dequantizes to
+    fp32 inside its flash accumulation (quantize-then-attend)."""
+    dt = kv_dtype if kv_dtype is not None else dtype
     shape = (num_pages, page_size, num_kv_heads, head_dim)
     table = (jnp.arange(batch * max_pages, dtype=jnp.int32)
              .reshape(batch, max_pages) % num_pages)
-    return PagedKVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+    return PagedKVCache(jnp.zeros(shape, dt), jnp.zeros(shape, dt),
                         table, jnp.zeros((batch,), jnp.int32))
+
+
+def _to_pool_dtype(a: jax.Array, pool_dtype) -> jax.Array:
+    """Cast a k/v value to the pool's storage dtype — the shared
+    ``models/fp8.saturate_cast``: for e4m3 pools the cast MUST saturate
+    (jnp's plain float→float8_e4m3fn conversion NaNs past ±448, and one
+    hot KV element would silently poison every later softmax over that
+    page). Lazy import: ops must stay importable without models."""
+    from triton_distributed_tpu.models.fp8 import saturate_cast
+
+    return saturate_cast(a, pool_dtype)
 
 
 def paged_append(cache: PagedKVCache, k_new: jax.Array,
@@ -80,7 +99,8 @@ def paged_append(cache: PagedKVCache, k_new: jax.Array,
 
     def scatter(pool, new):
         cur = pool[page_idx, row]
-        val = jnp.where(ok[:, None, None], new.astype(pool.dtype), cur)
+        val = jnp.where(ok[:, None, None], _to_pool_dtype(new, pool.dtype),
+                        cur)
         return pool.at[page_idx, row].set(val)
 
     return cache._replace(k_pool=scatter(cache.k_pool, k_new),
@@ -171,6 +191,13 @@ def paged_decode_attention(q: jax.Array, cache: PagedKVCache, *,
     path walks each sequence's page table from SMEM and DMAs exactly the
     pages that hold valid tokens.
 
+    fp8 KV pools (``init_paged_kv_cache(kv_dtype=float8_e4m3fn)``): the
+    page DMAs move HALF the bytes — the decode-bandwidth lever — and the
+    kernel dequantizes each landed page to fp32 inside the flash
+    accumulation. Parity vs :func:`paged_decode_attention_golden` stays
+    EXACT (not approximate): both paths read the same stored e4m3 values
+    (quantize-then-attend — quantization happened once, at append).
+
     ``normalize=False`` returns the split-KV partial instead:
     (acc (B,hq,d) fp32 unnormalized, m (B,hq), l (B,hq)) — the combine
     contract of ops/flash_decode.py (reference flash_decode.py:129-481
@@ -223,7 +250,9 @@ def paged_decode_attention(q: jax.Array, cache: PagedKVCache, *,
 
 def paged_decode_attention_golden(q: jax.Array,
                                   cache: PagedKVCache) -> np.ndarray:
-    """Pure-numpy reference."""
+    """Pure-numpy reference. Reads the pools AS STORED (ml_dtypes widens
+    e4m3 → float64 exactly), so an fp8 cache is compared under the same
+    quantize-then-attend semantics the kernel runs — parity is exact."""
     qn = np.asarray(q, np.float64)
     kp = np.asarray(cache.k_pool, np.float64)
     vp = np.asarray(cache.v_pool, np.float64)

@@ -384,6 +384,120 @@ def megakernel_serve_selftest() -> list[CaseResult]:
     return cases
 
 
+def fp8kv_serve_selftest() -> list[CaseResult]:
+    """Two rows per --all sweep for the fp8 KV cache (round 12,
+    docs/serving.md "fp8 KV"): (a) continuous-batching serving on e4m3
+    pools under PAGE PRESSURE — a request is preempted, its pages reused
+    by another request, and it recomputes on resume; token parity vs the
+    sequential QUANTIZED serve is the corruption oracle, and the pool
+    must stay uniformly e4m3 (COW-style page reuse can never mix
+    dtypes: the pool is one array, and reused pages carry only
+    freshly-quantized values); (b) a disaggregated migration on an fp8
+    decode pool — blocks quantize prefill-side, so the stream's f32
+    checksums stamp and verify the NARROW payload that actually crosses
+    DCN (the tier must stay disagg-active: a checksum model that broke
+    under e4m3 would demote it)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from triton_distributed_tpu.models import Engine, init_dense_llm
+    from triton_distributed_tpu.models.config import tiny_config
+    from triton_distributed_tpu.runtime import initialize_distributed
+    from triton_distributed_tpu.serving.loop import ServingEngine
+
+    E8 = jnp.float8_e4m3fn
+    cfg = tiny_config()
+    params = init_dense_llm(jax.random.PRNGKey(7), cfg)
+    ctx1 = initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                  devices=jax.devices()[:1])
+    rng = np.random.default_rng(12)
+    prompts = [rng.integers(0, cfg.vocab_size, n).tolist()
+               for n in (8, 10, 6, 7)]
+    gens = [6, 5, 4, 4]
+    # The quantized golden: sequential serve over the SAME e4m3 pools.
+    oracle = Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                    page_size=4, kv_dtype=E8)
+    golden = [np.asarray(oracle.serve(jnp.asarray([p], jnp.int32), g)
+                         )[0].tolist() for p, g in zip(prompts, gens)]
+
+    cases: list[CaseResult] = []
+
+    # Row 1: preemption + recompute-on-resume + page reuse on the pool.
+    t0 = time.time()
+    diags: list[str] = []
+    try:
+        eng = Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                     page_size=4, kv_dtype=E8)
+        se = ServingEngine(eng, max_batch=2, num_pages=6, prefill_chunk=4)
+        reqs = []
+        for i, (p, g) in enumerate(zip(prompts, gens)):
+            req, res = se.submit(p, g, req_id=f"chaos-f8kv-{i}")
+            assert res.name == "ADMITTED", res
+            reqs.append(req)
+        se.run()
+        parity = all(r.tokens == golden[i] for i, r in enumerate(reqs))
+        n_preempt = sum(r.preemptions for r in reqs)
+        dtype_ok = se._cache.k_pools.dtype == E8 \
+            and se._cache.v_pools.dtype == E8
+        diags += [f"parity vs sequential quantized serve: {parity}",
+                  f"preemptions (page reuse exercised): {n_preempt}",
+                  f"pool dtype uniform e4m3: {dtype_ok}"]
+        verdict = ("tolerated" if parity and n_preempt > 0 and dtype_ok
+                   else "error")
+    except Exception as exc:
+        verdict = "error"
+        diags.append(f"{type(exc).__name__}: {exc}")
+    cases.append(CaseResult(
+        op="fp8kv_serve", mesh="1", fault="preempt_page_reuse",
+        verdict=verdict, detected_by="parity",
+        expected=("tolerated",), ok=verdict == "tolerated", n_fired=1,
+        n_violations=0, diagnostics=diags,
+        elapsed_s=round(time.time() - t0, 3)))
+
+    # Row 2: disagg migration with an fp8 decode pool — checksums stamp
+    # the quantized payload and must verify (tier stays disagg-active).
+    t0 = time.time()
+    diags = []
+    try:
+        from triton_distributed_tpu.disagg import (
+            DisaggServingEngine, role_contexts,
+        )
+
+        pctx, dctx = role_contexts(jax.devices()[:2])
+        pe = Engine(cfg, params, pctx, backend="xla", max_seq=64)
+        de = Engine(cfg, params, dctx, backend="xla", max_seq=64,
+                    page_size=4, kv_dtype=E8)
+        se2 = DisaggServingEngine(pe, de, max_batch=2, num_pages=8,
+                                  prefill_chunk=4, block_pages=1)
+        reqs2 = []
+        for i, (p, g) in enumerate(zip(prompts[:2], gens[:2])):
+            req, res = se2.submit(p, g, req_id=f"chaos-f8mig-{i}")
+            assert res.name == "ADMITTED", res
+            reqs2.append(req)
+        se2.run()
+        parity = all(r.tokens == golden[i]
+                     for i, r in enumerate(reqs2))
+        active = se2.disagg_active
+        n_mig = len(se2.migrations_log)
+        diags += [f"parity vs sequential quantized serve: {parity}",
+                  f"migrations (checksummed e4m3 payload): {n_mig}",
+                  f"disagg still active (checksums verified): {active}",
+                  f"demotion_reason: {se2.demotion_reason!r}"]
+        verdict = ("tolerated" if parity and active and n_mig >= 2
+                   else "error")
+    except Exception as exc:
+        verdict = "error"
+        diags.append(f"{type(exc).__name__}: {exc}")
+    cases.append(CaseResult(
+        op="fp8kv_serve", mesh="1+1", fault="disagg_migration_checksum",
+        verdict=verdict, detected_by="parity",
+        expected=("tolerated",), ok=verdict == "tolerated", n_fired=1,
+        n_violations=0, diagnostics=diags,
+        elapsed_s=round(time.time() - t0, 3)))
+    return cases
+
+
 # ---------------------------------------------------------------------------
 # Disagg serving-lane rows (round 10): migration fault -> demotion to
 # monolithic serving with token parity (docs/disagg.md).
@@ -768,6 +882,13 @@ def sweep(ops, faults, ranks, *, seed: int = 0,
         # KV-migration stream -> named transient MigrationError ->
         # demotion to monolithic serving with token parity.
         for case in disagg_serve_selftest():
+            cases.append(case)
+            failed += not case.ok
+            _print_case(case, verbose)
+        # fp8-KV rows (round 12): preemption + page reuse on e4m3 pools
+        # with quantized-golden parity; disagg migration checksums on
+        # the narrowed payload.
+        for case in fp8kv_serve_selftest():
             cases.append(case)
             failed += not case.ok
             _print_case(case, verbose)

@@ -505,6 +505,94 @@ def dryrun(json_path: str | None) -> int:
             "events": [e["event"] for e in se6.fleet_log],
         }
 
+    # Phase 7 (round 12) — fp8 KV cache: (a) at a FIXED HBM budget the
+    # e4m3 pool holds exactly 2× the bf16 pages (4× the f32 pages),
+    # verified through the tdtpu_kv_pages_resident gauge the serving
+    # loop publishes; (b) per-request token parity vs the sequential
+    # QUANTIZED serve (Engine.serve with the same kv_dtype — the
+    # quantize-then-attend golden) including a preempt/resume
+    # round-trip on the fp8 pool (COW-style page reuse across requests
+    # never mixes dtypes: the pool is one e4m3 array).
+    import tempfile
+
+    import jax.numpy as _jnp
+
+    from triton_distributed_tpu import obs as _obs
+    from triton_distributed_tpu.models import Engine as _E
+    from triton_distributed_tpu.models.kv_cache import (
+        kv_pool_pages_for_budget,
+    )
+    from triton_distributed_tpu.obs import metrics as _om
+
+    f8 = _jnp.float8_e4m3fn
+    f8_cfg = engine.cfg
+    # One page's bf16 cost × 4 pages = the budget both pools share: the
+    # e4m3 pool then holds 8 — the SAME pressure shape as phase 1's
+    # 8-page pool, so the trace still forces a mid-decode eviction (the
+    # preempt/resume proof runs ON the doubled fp8 pool).
+    from triton_distributed_tpu.models.kv_cache import kv_page_bytes
+
+    budget = 4 * kv_page_bytes(f8_cfg, page_size=4,
+                               kv_dtype=_jnp.bfloat16)
+    pages_bf16 = kv_pool_pages_for_budget(
+        f8_cfg, page_size=4, hbm_bytes=budget, kv_dtype=_jnp.bfloat16)
+    pages_f8 = kv_pool_pages_for_budget(
+        f8_cfg, page_size=4, hbm_bytes=budget, kv_dtype=f8)
+    doubled = pages_f8 == 2 * pages_bf16
+    if not doubled:
+        failures.append(
+            f"fp8 pool did not double at fixed HBM: {pages_bf16} bf16 "
+            f"pages vs {pages_f8} e4m3 pages at the same budget")
+    f8_eng = _E(f8_cfg, engine.params, engine.ctx, backend="xla",
+                max_seq=64, page_size=4, kv_dtype=f8)
+    f8_trace = build_trace(LoadSpec(n_requests=8, seed=0,
+                                    mean_interarrival_iters=1.0))
+    from triton_distributed_tpu.serving.loop import (
+        ServingEngine as _ServingEngineKV,
+    )
+
+    with tempfile.TemporaryDirectory() as run_dir:
+        _obs.start_run(run_dir)
+        try:
+            se7 = _ServingEngineKV(f8_eng, max_batch=4,
+                                   kv_hbm_budget=budget, prefill_chunk=4,
+                                   max_waiting=8)
+            gauge_pages = se7.num_pages
+            f8_report = run_trace(se7, f8_trace)
+            snap = _om.registry().snapshot()
+        finally:
+            _obs.finish_run()
+    gauge = (snap.get(_om.KV_PAGES_RESIDENT) or {}).get("value")
+    if gauge != gauge_pages or gauge != pages_f8:
+        failures.append(
+            f"tdtpu_kv_pages_resident gauge ({gauge}) does not report "
+            f"the resident e4m3 pool ({pages_f8} pages at the fixed "
+            "budget)")
+    f8_reqs = f8_report.pop("requests")
+    f8_golden = sequential_reference(f8_eng, f8_trace)
+    f8_mismatch = [r.req_id for r in f8_reqs
+                   if r.tokens != f8_golden[r.req_id]]
+    f8_preempted = [r.req_id for r in f8_reqs
+                    if r.preemptions > 0
+                    and r.tokens == f8_golden[r.req_id]]
+    if f8_mismatch:
+        failures.append("fp8-KV token parity broken vs sequential "
+                        f"quantized serve: {f8_mismatch}")
+    if not f8_preempted:
+        failures.append(
+            "no fp8-KV request was preempted+resumed with parity — the "
+            "fixed budget no longer exercises eviction on the e4m3 pool")
+    report["fp8_kv"] = {
+        "budget_bytes": budget,
+        "pages_bf16": pages_bf16,
+        "pages_fp8": pages_f8,
+        "pool_doubled": doubled,
+        "gauge_pages_resident": gauge,
+        "parity_ok": not f8_mismatch,
+        "preempted_with_parity": f8_preempted,
+        "all_finished": f8_report["all_finished"],
+    }
+
     report["failures"] = failures
     if json_path:
         with open(json_path, "w") as f:
@@ -535,7 +623,7 @@ def _bench_shard_config():
 
 def serving_bench_rung(n_streams: int = 8, prompt_len: int = 128,
                        max_new: int = 16, *, backend: str = "xla",
-                       page_size: int = 64) -> dict:
+                       page_size: int = 64, kv_dtype=None) -> dict:
     """Tokens/s + p99 TTFT/TPOT at ``n_streams`` concurrent streams on
     the Qwen3-8B TP=8 PER-DEVICE shard shapes (the same single-chip
     pricing discipline as the decode rungs: n=1, no ICI in the number;
@@ -546,7 +634,12 @@ def serving_bench_rung(n_streams: int = 8, prompt_len: int = 128,
     ``backend="megakernel"`` (round 9) serves decode through the paged
     persistent kernel (page_size must be TILE = 128 there — the lane's
     pool pages are workspace KV tiles); bench.py races it against the
-    xla rung in the same window (`serve_tokens_per_s_megakernel`)."""
+    xla rung in the same window (`serve_tokens_per_s_megakernel`).
+
+    ``kv_dtype`` (round 12): the paged pool's storage dtype —
+    ``float8_e4m3fn`` is the fp8-KV rung (half the decode DMA bytes;
+    bench.py races it against the full-width rung in the same window,
+    `serve_tokens_per_s_fp8kv`)."""
     import jax
     import jax.random as jrandom
 
@@ -560,7 +653,7 @@ def serving_bench_rung(n_streams: int = 8, prompt_len: int = 128,
     ctx1 = initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
                                   devices=jax.devices()[:1])
     engine = Engine(cfg, params, ctx1, backend=backend, max_seq=512,
-                    page_size=page_size)
+                    page_size=page_size, kv_dtype=kv_dtype)
     se = ServingEngine(engine, max_batch=n_streams, prefill_chunk=128)
     if backend == "megakernel" and se._mk is None:
         # The rung exists to price the persistent lane; silently racing
