@@ -1,0 +1,301 @@
+"""Request-scoped tracing + flight recorder (ISSUE 13,
+docs/observability.md "Request tracing & postmortems").
+
+The load-bearing contracts: the disabled hooks cost nothing on the
+serving hot loop (< 20 µs/event), the TTFT decomposition PARTITIONS the
+arrival → first-decode window (queue + prefill + migrate + decode ==
+total, for a preempted-then-resumed AND a migrated request), flight
+dumps are byte-deterministic under an injected clock, and
+``obs.postmortem`` / ``obs.report --check`` gate the evidence.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from triton_distributed_tpu import obs
+from triton_distributed_tpu.models.config import tiny_config
+from triton_distributed_tpu.models.dense import init_dense_llm
+from triton_distributed_tpu.models.engine import Engine
+from triton_distributed_tpu.obs import flight as obs_flight
+from triton_distributed_tpu.obs import metrics as obs_metrics
+from triton_distributed_tpu.obs import postmortem as obs_postmortem
+from triton_distributed_tpu.obs import reqtrace as obs_reqtrace
+from triton_distributed_tpu.obs import report as obs_report
+from triton_distributed_tpu.obs import trace as obs_trace
+from triton_distributed_tpu.obs.reqtrace import ReqTracer
+from triton_distributed_tpu.obs.slo import SLOConfig
+from triton_distributed_tpu.runtime import initialize_distributed
+from triton_distributed_tpu.serving.loadgen import (
+    LoadSpec, build_trace, run_trace,
+)
+from triton_distributed_tpu.serving.loop import ServingEngine
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_run():
+    """Every test starts and ends with tracer + reqtracer disabled."""
+    obs_trace.disable()
+    obs_reqtrace.disable()
+    yield
+    obs_trace.disable()
+    obs_reqtrace.disable()
+
+
+@pytest.fixture(scope="module")
+def ctx1():
+    return initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                  devices=jax.devices()[:1])
+
+
+@pytest.fixture(scope="module")
+def served(ctx1):
+    cfg = tiny_config()
+    params = init_dense_llm(jax.random.PRNGKey(7), cfg)
+    return Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                  page_size=4)
+
+
+class CounterClock:
+    """Deterministic injectable clock: monotone, no wall time."""
+
+    def __init__(self, step: float = 0.001):
+        self.t = 0.0
+        self.step = step
+
+    def __call__(self) -> float:
+        self.t += self.step
+        return round(self.t, 6)
+
+
+# ---------------------------------------------------------------------------
+# Disabled-path overhead (the acceptance criterion's testable form).
+# ---------------------------------------------------------------------------
+
+def test_disabled_reqtrace_overhead_is_negligible():
+    """The instrumented hook pattern — one global load, one None check —
+    with no request tracer installed, for both event families the
+    serving loop emits per iteration."""
+    assert not obs_reqtrace.is_enabled()
+    n = 50_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        rt = obs_reqtrace.get_tracer()
+        if rt is not None:
+            rt.mark("r", "RUNNING", 0.0)
+        rt = obs_reqtrace.get_tracer()
+        if rt is not None:
+            rt.span("r", "decode_step", 0.0, 1.0)
+    per_event = (time.perf_counter() - t0) / (2 * n)
+    assert per_event < 20e-6, \
+        f"disabled reqtrace hook costs {per_event * 1e6:.2f} us"
+
+
+# ---------------------------------------------------------------------------
+# TTFT decomposition.
+# ---------------------------------------------------------------------------
+
+def test_decomposition_preempted_then_resumed_unit():
+    """Hand-built lifecycle: preempted mid-prefill, re-admitted, first
+    decode at t=9. Components must partition [arrival, window end]."""
+    rt = ReqTracer()
+    rt.arrival("r", 0.0)                    # WAITING
+    rt.mark("r", "PREFILLING", 1.0)
+    rt.mark("r", "PREEMPTED", 3.0)          # evicted mid-prefill
+    rt.mark("r", "PREFILLING", 6.0)         # resumed (recompute)
+    rt.mark("r", "RUNNING", 7.0)
+    bd = rt.close_window("r", 9.0)
+    assert bd["queue_ms"] == pytest.approx(4000.0)    # 0-1 and 3-6
+    assert bd["prefill_ms"] == pytest.approx(3000.0)  # 1-3 and 6-7
+    assert bd["migrate_ms"] == 0.0
+    assert bd["decode_ms"] == pytest.approx(2000.0)   # 7-9
+    assert bd["total_ms"] == pytest.approx(9000.0)
+    # Idempotent: a second close returns the stored breakdown.
+    assert rt.close_window("r", 99.0) is bd
+
+
+def test_serving_decomposition_partitions_window(served, tmp_path):
+    """A real traced serving run (page pressure forces a preemption):
+    every request's components sum to its window, the preempted-then-
+    resumed request included, and the four histogram series land in the
+    registry with one observation per request."""
+    obs.start_run(str(tmp_path))
+    try:
+        se = ServingEngine(served, max_batch=4, num_pages=8,
+                           prefill_chunk=4, max_waiting=8,
+                           clock=CounterClock())
+        report = run_trace(se, build_trace(LoadSpec(
+            n_requests=8, seed=0, mean_interarrival_iters=1.0)))
+        reqs = report.pop("requests")
+        recs = report["request_records"]
+        snap = obs_metrics.registry().snapshot()
+    finally:
+        obs.finish_run()
+    assert report["all_finished"]
+    assert any(r.preemptions > 0 for r in reqs), \
+        "pool sizing no longer exercises eviction"
+    assert len(recs) == 8
+    for rec in recs:
+        bd = rec["ttft_breakdown_ms"]
+        assert bd is not None, rec["req_id"]
+        parts = sum(bd[k] for k in ("queue_ms", "prefill_ms",
+                                    "migrate_ms", "decode_ms"))
+        assert parts == pytest.approx(bd["total_ms"], abs=0.01), rec
+    # A preempted request's queue component carries its re-admission
+    # wait: it must exceed every never-preempted single-wait request's.
+    preempted = [r for r in recs if r["preempted"]]
+    assert preempted and all(r["migrated"] is False for r in recs)
+    for series in obs_metrics.TTFT_COMPONENT_SERIES.values():
+        assert snap[series]["count"] == 8, series
+
+
+def test_migrated_request_decomposition(served):
+    """Disagg tier: a migrated request spends real time MIGRATING — its
+    migrate component is positive, the flags say so, and the partition
+    invariant holds across the extra lifecycle stage."""
+    from triton_distributed_tpu.disagg import (
+        DisaggServingEngine, role_contexts,
+    )
+
+    pctx, dctx = role_contexts(jax.devices()[:2])
+    pe = Engine(served.cfg, served.params, pctx, backend="xla",
+                max_seq=64)
+    de = Engine(served.cfg, served.params, dctx, backend="xla",
+                max_seq=64, page_size=4)
+    obs_reqtrace.enable()
+    se = DisaggServingEngine(pe, de, max_batch=2, num_pages=8,
+                             prefill_chunk=4, block_pages=1,
+                             clock=CounterClock())
+    trace = [{"req_id": "mig-0", "arrival_iter": 0,
+              "prompt": list(range(30, 42)), "max_new_tokens": 4,
+              "priority": 0}]
+    report = run_trace(se, trace)
+    report.pop("requests")
+    rec = report["request_records"][0]
+    assert se.disagg_active and rec["migrated"] and rec["state"] == \
+        "FINISHED"
+    bd = rec["ttft_breakdown_ms"]
+    assert bd["migrate_ms"] > 0.0, \
+        "a 3-block migration must spend time MIGRATING"
+    parts = sum(bd[k] for k in ("queue_ms", "prefill_ms", "migrate_ms",
+                                "decode_ms"))
+    assert parts == pytest.approx(bd["total_ms"], abs=0.01)
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder.
+# ---------------------------------------------------------------------------
+
+def _seeded_slo_run(flight_dir: str, eng) -> str:
+    """One seeded serving run under an impossible SLO floor with a
+    fully injected clock; returns the dump path it produced."""
+    prior = obs_metrics.registry()
+    obs_metrics.set_registry(obs_metrics.Registry())
+    obs_reqtrace.enable()
+    os.environ["TDTPU_FLIGHT_DIR"] = flight_dir
+    try:
+        se = ServingEngine(eng, max_batch=2, num_pages=8,
+                           prefill_chunk=4,
+                           slo_cfg=SLOConfig(tokens_per_s_min=1e12),
+                           clock=CounterClock())
+        rng = np.random.default_rng(5)
+        for i in range(2):
+            se.submit(rng.integers(0, 256, 7).tolist(), 3,
+                      req_id=f"det-{i}")
+        se.run()
+        dumps = obs_flight.find_dumps(flight_dir)
+        assert dumps, "impossible SLO floor produced no dump"
+        return dumps[0]
+    finally:
+        os.environ.pop("TDTPU_FLIGHT_DIR", None)
+        obs_reqtrace.disable()
+        obs_metrics.set_registry(prior)
+
+
+def test_flight_dump_deterministic_under_fixed_seed(served, tmp_path):
+    p1 = _seeded_slo_run(str(tmp_path / "a"), served)
+    p2 = _seeded_slo_run(str(tmp_path / "b"), served)
+    with open(p1) as f1, open(p2) as f2:
+        d1, d2 = json.load(f1), json.load(f2)
+    assert os.path.basename(p1) == os.path.basename(p2)
+    assert d1 == d2, "flight dump content is not deterministic"
+    assert d1["trigger"]["kind"] == "slo_violation"
+    assert d1["iterations"] and d1["requests"]
+
+
+def test_postmortem_check_valid_and_malformed(served, tmp_path):
+    dump = _seeded_slo_run(str(tmp_path), served)
+    assert obs_postmortem.main([dump, "--check", "--quiet"]) == 0
+    assert obs_postmortem.main([str(tmp_path), "--check",
+                                "--quiet"]) == 0
+    bad = tmp_path / "flight-9999-evacuation.json"
+    bad.write_text(json.dumps({"schema": "nope"}))
+    assert obs_postmortem.main([str(tmp_path), "--check",
+                                "--quiet"]) == 1
+    # obs.report --check gates the same malformed dump in a run dir.
+    assert obs_report.main([str(tmp_path), "--check",
+                            "--require-series", ""]) == 1
+    bad.unlink()
+    empty = tmp_path / "nodumps"
+    empty.mkdir()
+    assert obs_postmortem.main([str(empty), "--check", "--quiet"]) == 1
+
+
+def test_flight_ring_is_bounded(tmp_path):
+    rec = obs_flight.FlightRecorder(capacity=4, run_dir=str(tmp_path))
+    for i in range(10):
+        rec.record({"iter": i})
+    path = rec.dump("slo_violation", "test", 10)
+    data = obs_flight.load_dump(path)
+    assert [r["iter"] for r in data["iterations"]] == [6, 7, 8, 9]
+    assert not obs_flight.validate_dump(data)
+
+
+# ---------------------------------------------------------------------------
+# Report gating + utilization gauges.
+# ---------------------------------------------------------------------------
+
+def test_report_check_fails_on_missing_request_lane(tmp_path):
+    """A serving-tier snapshot WITHOUT per-request timelines must fail
+    --check (the postmortem evidence is gone); adding the lane — or the
+    explicit opt-out — passes it."""
+    reg = obs_metrics.Registry()
+    reg.counter(obs_metrics.SERVE_FINISHED, "x").inc(3)
+    reg.gauge(obs_metrics.KV_PAGES_RESIDENT, "x").set(8)
+    reg.save(str(tmp_path))
+    args = [str(tmp_path), "--check", "--require-series", ""]
+    assert obs_report.main(args) == 1
+    assert obs_report.main(args + ["--allow-missing-request-lane"]) == 0
+    rt = ReqTracer()
+    rt.arrival("req-lane", 0.0)
+    rt.save(str(tmp_path / "requests.spans.json"))
+    assert obs_report.main(args) == 0
+
+
+def test_utilization_gauges_published(served, tmp_path):
+    obs.start_run(str(tmp_path))
+    try:
+        se = ServingEngine(served, max_batch=2, num_pages=8,
+                           prefill_chunk=4)
+        se.submit(list(range(1, 8)), 2, req_id="gauge-0")
+        while se.sched.has_work():
+            se.step()
+        snap = obs_metrics.registry().snapshot()
+    finally:
+        obs.finish_run()
+    assert obs_metrics.SERVE_RUNNING_SLOTS in snap
+    occ = snap[obs_metrics.KV_POOL_OCCUPANCY]["value"]
+    assert 0.0 <= occ <= 1.0
+    # The request lane landed in the run dir with one track per request.
+    lane = json.load(open(tmp_path / "requests.spans.json"))
+    names = [e["args"]["name"] for e in lane["traceEvents"]
+             if e.get("name") == "thread_name"]
+    assert names == ["gauge-0"]
+    # And the merged report validates with the request lane present.
+    assert obs_report.main([str(tmp_path), "--check",
+                            "--require-series", ""]) == 0

@@ -46,6 +46,7 @@ from triton_distributed_tpu.models.kv_cache import (
     init_kv_cache, kv_cache_specs, paged_cache_specs,
 )
 from triton_distributed_tpu.obs import metrics as obs_metrics
+from triton_distributed_tpu.obs import reqtrace as obs_reqtrace
 from triton_distributed_tpu.obs import trace as obs_trace
 from triton_distributed_tpu.runtime.context import DistContext
 from triton_distributed_tpu.serving.loop import ServingEngine
@@ -312,6 +313,9 @@ class DisaggServingEngine(ServingEngine):
             timeout_s=self._migrate_timeout_s, clock=self.clock,
             chaos_hook=self._migrate_chaos)
         req.advance(RequestState.MIGRATING)
+        rt = obs_reqtrace.get_tracer()
+        if rt is not None:
+            rt.mark(req.req_id, "MIGRATING", self.clock())
         if req.req_id in self._streams:
             # The request was evicted mid-migration and re-admitted fast
             # enough (single-chunk prompt) that its stale cancelled
@@ -365,7 +369,9 @@ class DisaggServingEngine(ServingEngine):
             del self._streams[rid]
             self.migration_preemptions += 1
         landed = 0
+        rt = obs_reqtrace.get_tracer()
         for rid, (req, stream) in list(self._streams.items()):
+            t0 = self.clock() if rt is not None else 0.0
             try:
                 done = stream.advance(self._scatter_block)
             except Exception as exc:
@@ -377,10 +383,20 @@ class DisaggServingEngine(ServingEngine):
                         "migration streams failed (lost/corrupt/late "
                         "blocks)").inc()
                 del self._streams[rid]
+                # The failure chains INTO the demotion's flight dump:
+                # postmortem renders migration_failure -> disagg_demotion
+                # as one causal trigger chain.
+                self.flight.note(
+                    "migration_failure",
+                    f"stream {rid}: {type(exc).__name__}: "
+                    f"{str(exc)[:120]}", self._iter, req=rid)
                 self._demote_to_monolithic(
                     f"migration of {rid} failed: "
                     f"{type(exc).__name__}: {str(exc)[:160]}", exc)
                 return landed
+            if rt is not None:
+                rt.span(rid, "migrate_block", t0, self.clock(),
+                        pages_moved=stream.pages_moved)
             landed += 1
             if done:
                 del self._streams[rid]
@@ -402,6 +418,9 @@ class DisaggServingEngine(ServingEngine):
                                     bytes=stream.bytes_moved):
                     pass
                 req.advance(RequestState.RUNNING)
+                req.migrations += 1
+                if rt is not None:
+                    rt.mark(rid, "RUNNING", self.clock())
         return landed
 
     # -- fleet elasticity (ISSUE 11) -------------------------------------------
@@ -486,6 +505,7 @@ class DisaggServingEngine(ServingEngine):
         with obs_trace.span("disagg.demotion", reason=reason,
                             recomputed=len(recomputed)):
             pass
+        self._flight_dump("disagg_demotion", reason)
         if self._observing():
             reg = obs_metrics.registry()
             reg.counter(obs_metrics.DISAGG_DEMOTIONS,

@@ -491,6 +491,8 @@ class PagedMegakernelDecoder:
         self._rope_cache: dict[int, tuple[np.ndarray, np.ndarray]] = {}
         self.warm = False
         self.last_step_cold = True
+        self.last_step_active = 0       # RUNNING slots in the last launch
+        self.last_step_pages = 0        # mapped pool pages in the last launch
 
     # -- workspace ----------------------------------------------------------
     def start(self):
@@ -668,8 +670,19 @@ class PagedMegakernelDecoder:
         sin = np.concatenate(
             [np.broadcast_to(t[1], (TILE, TILE)) for t in tabs], axis=0)
         self.last_step_cold = not self.warm
+        # Step-hook accounting for the request tracer / flight recorder
+        # (ISSUE 13): active slots + mapped pages this launch — the
+        # serving loop attributes the step to its requests, this span
+        # tells the merged timeline what the ONE launch actually carried.
+        active = int(sum(1 for b in range(self.num_slots)
+                         if int(kv_lens[b]) > 0))
+        pages_mapped = int(sum(1 for row in tables for p in row
+                               if int(p) >= 0))
+        self.last_step_active = active
+        self.last_step_pages = pages_mapped
         ws_main, wk8 = (ws if self.kv_fp8 else (ws, None))
-        with obs_trace.span("mk_paged_step", slots=self.num_slots):
+        with obs_trace.span("mk_paged_step", slots=self.num_slots,
+                            active=active, pages_mapped=pages_mapped):
             ws_main, wk8, tok = self._step_jit(
                 ws_main, wk8, self.embed, self.final_norm,
                 self.lm_head, queue, jnp.asarray(cos),

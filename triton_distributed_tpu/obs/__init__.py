@@ -24,20 +24,21 @@ from __future__ import annotations
 
 import os
 
-from triton_distributed_tpu.obs import metrics, trace  # noqa: F401
+from triton_distributed_tpu.obs import metrics, reqtrace, trace  # noqa: F401
 from triton_distributed_tpu.obs.metrics import Registry
 from triton_distributed_tpu.obs.trace import Tracer
 
-__all__ = ["trace", "metrics", "start_run", "finish_run", "active_run_dir",
-           "run_from_env"]
+__all__ = ["trace", "metrics", "reqtrace", "start_run", "finish_run",
+           "active_run_dir", "run_from_env"]
 
 # Enforcement tier (ISSUE 4) — imported lazily by name to keep package
 # import light: obs.history (bench ledger), obs.gate (cross-round
-# regression gate), obs.slo (live SLO watchdog).
+# regression gate), obs.slo (live SLO watchdog), obs.flight (serving
+# flight recorder, ISSUE 13) + obs.postmortem (its render/check CLI).
 
 
 def __getattr__(name: str):
-    if name in ("history", "gate", "slo"):
+    if name in ("history", "gate", "slo", "flight", "postmortem"):
         import importlib
 
         return importlib.import_module(f"triton_distributed_tpu.obs.{name}")
@@ -55,6 +56,7 @@ def start_run(run_dir: str, *, sync: bool = False) -> Tracer:
     os.makedirs(run_dir, exist_ok=True)
     _RUN_DIR = run_dir
     metrics.set_registry(Registry())
+    reqtrace.enable(run_dir)
     return trace.enable(run_dir, sync=sync)
 
 
@@ -64,10 +66,26 @@ def finish_run() -> str | None:
     no run was active)."""
     global _RUN_DIR
     t = trace.disable()
+    rt = reqtrace.disable()
     run_dir = _RUN_DIR
     _RUN_DIR = None
     if t is None or run_dir is None:
         return None
+    if rt is not None and rt.has_events():
+        # Request-timeline lane (ISSUE 13): written only when the run
+        # actually served requests, so non-serving runs don't grow an
+        # empty lane file (and the report's request-lane gate only
+        # applies when serving series are present). Best-effort like
+        # the SLO section below — a failed lane write must never cost
+        # the span trace and metrics artifacts.
+        try:
+            rt.save(os.path.join(run_dir, "requests.spans.json"))
+        except Exception as e:
+            import warnings
+
+            warnings.warn(
+                f"request-timeline lane skipped: {type(e).__name__}: "
+                f"{e}", RuntimeWarning, stacklevel=2)
     reg = metrics.registry()
     # Best-effort SLO section: a watchdog bug must never cost the run's
     # artifacts (same contract as the serve-path guard in Engine.serve).

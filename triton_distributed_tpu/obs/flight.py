@@ -1,0 +1,217 @@
+"""Serving flight recorder — the last N iterations, dumped on failure.
+
+Aggregates (obs/metrics.py) say a rung slipped; the flight recorder
+says WHICH iterations and WHICH requests paid. A
+:class:`FlightRecorder` keeps a bounded ring buffer of per-iteration
+serving records (admissions, preemptions, per-slot ``kv_lens``, pool
+occupancy, backend rung, fleet/ledger state, SLO streaks — whatever the
+serving loop hands :meth:`record`) plus a bounded **trigger chain** of
+notable events, and on a dump-worthy trigger writes one self-contained
+JSON file into the run directory:
+
+* **backend_demotion** — the PR-6 ladder moved the engine off a rung;
+* **disagg_demotion** — the disagg tier fell back to monolithic
+  serving (a migration failure lands in the trigger chain first);
+* **evacuation** — the fleet preempted everything onto a survivor mesh;
+* **slo_violation** — a violation streak shrank the admission width.
+
+Dump files are ``flight-NNNN-<kind>.json`` — sequence-numbered, never
+timestamped, so a run driven by an injected fake clock produces
+byte-identical dumps (the determinism the chaos rows gate on).
+``python -m triton_distributed_tpu.obs.postmortem`` renders and
+validates them; ``obs.report`` folds them into its summary and
+``--check`` fails on a structurally invalid dump.
+
+The recorder itself is passive and cheap: the serving loop only feeds
+it under an active observation (the same ``_observing()`` gate the
+metrics publish behind), and a dump with no resolvable directory
+(no active obs run, no ``TDTPU_FLIGHT_DIR``) is a counted no-op, never
+an error — the recorder must not cost a serve that nobody is watching.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+from typing import Any
+
+SCHEMA = "tdtpu-flight-v1"
+
+TRIGGER_KINDS = ("backend_demotion", "disagg_demotion", "evacuation",
+                 "migration_failure", "slo_violation", "rejoin")
+
+
+class FlightRecorder:
+    """Bounded ring of serving-iteration records + dump-on-trigger."""
+
+    def __init__(self, capacity: int = 128, *, run_dir: str | None = None,
+                 max_triggers: int = 64):
+        if capacity < 1:
+            raise ValueError(
+                f"capacity = {capacity} invalid: the flight ring needs at "
+                "least one iteration record — argument capacity "
+                "(TDTPU_FLIGHT_CAPACITY)")
+        self.capacity = capacity
+        self.run_dir = run_dir
+        self._ring: collections.deque[dict] = collections.deque(
+            maxlen=capacity)
+        self._triggers: collections.deque[dict] = collections.deque(
+            maxlen=max_triggers)
+        self.dumps: list[str] = []        # paths written this session
+        self.dumps_skipped = 0            # triggers with no dump dir
+
+    # -- feeding ------------------------------------------------------------
+    def record(self, rec: dict) -> None:
+        """Append one iteration record (the serving loop's summary +
+        utilization snapshot)."""
+        self._ring.append(rec)
+
+    def note(self, kind: str, reason: str, iteration: int,
+             **extra: Any) -> dict:
+        """Append a trigger-chain entry WITHOUT dumping (e.g. a
+        migration failure that is about to demote — the demotion dump
+        carries the chain, so the causal order is preserved)."""
+        ev = {"kind": kind, "reason": reason, "iter": iteration, **extra}
+        self._triggers.append(ev)
+        return ev
+
+    def iterations(self) -> list[dict]:
+        return list(self._ring)
+
+    def triggers(self) -> list[dict]:
+        return list(self._triggers)
+
+    # -- dumping ------------------------------------------------------------
+    def _resolve_dir(self) -> str | None:
+        if self.run_dir is not None:
+            return self.run_dir
+        from triton_distributed_tpu import obs
+
+        d = obs.active_run_dir()
+        if d is not None:
+            return d
+        return os.environ.get("TDTPU_FLIGHT_DIR") or None
+
+    def dump(self, kind: str, reason: str, iteration: int, *,
+             config: dict | None = None,
+             requests: list[dict] | None = None,
+             counters: dict[str, float] | None = None) -> str | None:
+        """Write one postmortem dump; returns the path (None when no
+        dump directory resolves — the trigger is still chained, so a
+        later dump in the same run carries the evidence)."""
+        trigger = self.note(kind, reason, iteration)
+        out_dir = self._resolve_dir()
+        if out_dir is None:
+            self.dumps_skipped += 1
+            return None
+        os.makedirs(out_dir, exist_ok=True)
+        # Sequence numbers advance past any file already in the dir:
+        # two recorders sharing one run directory (two tiers under one
+        # obs run, or a fixed TDTPU_FLIGHT_DIR across sessions) must
+        # never overwrite each other's evidence. Still deterministic —
+        # the probe depends only on the directory's (deterministic)
+        # contents, never on time.
+        seq = len(self.dumps)
+        path = os.path.join(out_dir, f"flight-{seq:04d}-{kind}.json")
+        while os.path.exists(path):
+            seq += 1
+            path = os.path.join(out_dir, f"flight-{seq:04d}-{kind}.json")
+        data = {
+            "schema": SCHEMA,
+            "capacity": self.capacity,
+            "trigger": trigger,
+            "trigger_chain": self.triggers(),
+            "config": config or {},
+            "iterations": self.iterations(),
+            "requests": requests or [],
+            "counters": counters or {},
+        }
+        with open(path, "w") as f:
+            json.dump(data, f, indent=2)
+        self.dumps.append(path)
+        return path
+
+
+# ---------------------------------------------------------------------------
+# Dump validation (shared by obs.postmortem --check and obs.report).
+# ---------------------------------------------------------------------------
+
+def validate_dump(data: Any, *, path: str = "<dump>") -> list[str]:
+    """Structural problems with one loaded flight dump (empty list =
+    valid). The contract every producer must hold and every consumer
+    may rely on: schema tag, a trigger with kind/reason/iter, a
+    non-empty trigger chain containing the trigger, iteration records
+    with strictly increasing ``iter`` bounded by the ring capacity, and
+    request records that each name a request."""
+    problems: list[str] = []
+    if not isinstance(data, dict):
+        return [f"{path}: dump is not a JSON object"]
+    if data.get("schema") != SCHEMA:
+        problems.append(f"{path}: schema {data.get('schema')!r} != "
+                        f"{SCHEMA!r}")
+    cap = data.get("capacity")
+    if not isinstance(cap, int) or cap < 1:
+        problems.append(f"{path}: capacity {cap!r} is not a positive int")
+    trig = data.get("trigger")
+    if not isinstance(trig, dict):
+        problems.append(f"{path}: trigger missing")
+    else:
+        for field in ("kind", "reason", "iter"):
+            if field not in trig:
+                problems.append(f"{path}: trigger missing {field!r}")
+        if trig.get("kind") not in TRIGGER_KINDS:
+            problems.append(f"{path}: unknown trigger kind "
+                            f"{trig.get('kind')!r}")
+    chain = data.get("trigger_chain")
+    if not isinstance(chain, list) or not chain:
+        problems.append(f"{path}: trigger_chain missing or empty")
+    elif isinstance(trig, dict) and trig not in chain:
+        problems.append(f"{path}: trigger not in trigger_chain — the "
+                        "chain must end in the dump's own trigger")
+    iters = data.get("iterations")
+    if not isinstance(iters, list):
+        problems.append(f"{path}: iterations is not a list")
+    else:
+        if isinstance(cap, int) and cap >= 1 and len(iters) > cap:
+            problems.append(f"{path}: {len(iters)} iteration records "
+                            f"exceed the ring capacity {cap}")
+        prev = None
+        for i, rec in enumerate(iters):
+            if not isinstance(rec, dict) or not isinstance(
+                    rec.get("iter"), int):
+                problems.append(f"{path}: iteration record {i} has no "
+                                "integer 'iter'")
+                break
+            if prev is not None and rec["iter"] <= prev:
+                problems.append(f"{path}: iteration numbers not strictly "
+                                f"increasing at record {i} "
+                                f"({prev} -> {rec['iter']})")
+                break
+            prev = rec["iter"]
+    reqs = data.get("requests")
+    if not isinstance(reqs, list):
+        problems.append(f"{path}: requests is not a list")
+    else:
+        for i, r in enumerate(reqs):
+            if not isinstance(r, dict) or not r.get("req_id"):
+                problems.append(f"{path}: request record {i} has no "
+                                "req_id")
+                break
+    if not isinstance(data.get("config"), dict):
+        problems.append(f"{path}: config is not an object")
+    return problems
+
+
+def load_dump(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def find_dumps(run_dir: str) -> list[str]:
+    """Flight dumps under a run directory, in write order (the sequence
+    number sorts lexically)."""
+    import glob
+
+    return sorted(glob.glob(os.path.join(run_dir, "**", "flight-*.json"),
+                            recursive=True))

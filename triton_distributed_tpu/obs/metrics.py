@@ -53,10 +53,36 @@ SERVE_TOKENS_PER_S = "tdtpu_serve_tokens_per_s"
 # tiles cost half the bf16 bytes, so the same budget holds 2× the pages.
 KV_PAGES_RESIDENT = "tdtpu_kv_pages_resident"
 
+# Per-iteration utilization gauges (ISSUE 13): the admission/preemption
+# picture BETWEEN iterations — slots actually decoding and the fraction
+# of usable pool pages allocated (SERVE_FREE_PAGES is the absolute twin).
+SERVE_RUNNING_SLOTS = "tdtpu_serve_running_slots"
+KV_POOL_OCCUPANCY = "tdtpu_kv_pool_occupancy_frac"
+
+# TTFT decomposition (ISSUE 13, obs/reqtrace.py): the interval
+# arrival -> end of the request's first decode step, partitioned by
+# lifecycle-state residency. The four components SUM to the window per
+# request, so the histograms attribute p99 TTFT to queueing vs prefill
+# vs migration vs decode-readiness instead of one opaque number.
+SERVE_TTFT_QUEUE_MS = "tdtpu_serve_ttft_queue_ms"
+SERVE_TTFT_PREFILL_MS = "tdtpu_serve_ttft_prefill_ms"
+SERVE_TTFT_MIGRATE_MS = "tdtpu_serve_ttft_migrate_ms"
+SERVE_TTFT_DECODE_MS = "tdtpu_serve_ttft_first_decode_ms"
+
+TTFT_COMPONENT_SERIES = {
+    "queue_ms": SERVE_TTFT_QUEUE_MS,
+    "prefill_ms": SERVE_TTFT_PREFILL_MS,
+    "migrate_ms": SERVE_TTFT_MIGRATE_MS,
+    "decode_ms": SERVE_TTFT_DECODE_MS,
+}
+
 # What the report's serving lane renders (histograms first, then
 # gauges/counters, in this order).
-SERVING_SERIES = (SERVE_TTFT_MS, SERVE_TPOT_MS, SERVE_QUEUE_DEPTH,
-                  SERVE_FREE_PAGES, SERVE_ACTIVE, SERVE_ADMIT_CAP,
+SERVING_SERIES = (SERVE_TTFT_MS, SERVE_TPOT_MS, SERVE_TTFT_QUEUE_MS,
+                  SERVE_TTFT_PREFILL_MS, SERVE_TTFT_MIGRATE_MS,
+                  SERVE_TTFT_DECODE_MS, SERVE_QUEUE_DEPTH,
+                  SERVE_FREE_PAGES, SERVE_ACTIVE, SERVE_RUNNING_SLOTS,
+                  KV_POOL_OCCUPANCY, SERVE_ADMIT_CAP,
                   SERVE_PREEMPTIONS, SERVE_REJECTS, SERVE_FINISHED,
                   KV_PAGES_RESIDENT, SERVE_TOKENS_PER_S)
 

@@ -1,0 +1,182 @@
+"""obs.postmortem — render and validate serving flight-recorder dumps.
+
+``python -m triton_distributed_tpu.obs.postmortem PATH`` takes one
+flight dump (obs/flight.py) or a directory of them and prints the
+incident the recorder captured:
+
+* the **trigger** (what fired the dump) and the **trigger chain**
+  leading up to it — e.g. a migration failure chained into the disagg
+  demotion that dumped;
+* the **iteration table** — the last N serving iterations (queue depth,
+  active/running, free pages, occupancy, admission cap, backend rung),
+  the utilization picture the aggregates can't give per incident;
+* **per-request timelines** — each traced request's lifecycle marks and
+  TTFT decomposition (obs/reqtrace.py), so "which requests paid and
+  where the time went" is answerable after the fact.
+
+``--check`` validates every dump structurally (flight.validate_dump —
+the contract chaos rows and CI gate on) and exits nonzero on any
+problem; ``--json`` writes the machine-readable verdict. ``obs.report``
+folds the same validation into its run-directory summary, so a run dir
+with a malformed dump fails ``obs.report --check`` too
+(docs/observability.md "Request tracing & postmortems").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from triton_distributed_tpu.obs import flight
+
+
+def _s(v) -> str:
+    """Render-safe field: a malformed dump must still print (validation
+    is --check's job, not the renderer's)."""
+    return "?" if v is None else str(v)
+
+
+def render(data: dict, path: str) -> str:
+    lines = [f"# flight dump — {os.path.basename(path)}", ""]
+    trig = data.get("trigger") or {}
+    lines.append(f"trigger: {_s(trig.get('kind'))} @ iter "
+                 f"{_s(trig.get('iter'))} — {_s(trig.get('reason'))}")
+    chain = data.get("trigger_chain") or []
+    if len(chain) > 1:
+        lines.append("trigger chain:")
+        for ev in chain:
+            if not isinstance(ev, dict):
+                continue
+            lines.append(f"  iter {_s(ev.get('iter')):>6}: "
+                         f"{_s(ev.get('kind'))}"
+                         f" — {_s(ev.get('reason'))[:100]}")
+    cfg = data.get("config") or {}
+    if cfg:
+        lines.append("config: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(cfg.items())))
+    iters = data.get("iterations") or []
+    lines.append("")
+    lines.append(f"iterations ({len(iters)} in ring, "
+                 f"capacity {data.get('capacity')}):")
+    shown = iters[-20:]
+    if len(iters) > len(shown):
+        lines.append(f"  ... {len(iters) - len(shown)} earlier "
+                     "iteration(s)")
+    lines.append(f"  {'iter':>6} {'wait':>5} {'activ':>5} {'run':>4} "
+                 f"{'dec':>4} {'free':>5} {'occ%':>5} {'cap':>4} backend")
+    for rec in shown:
+        if not isinstance(rec, dict):
+            continue
+        occ = rec.get("pool_occupancy_frac")
+        occ_s = f"{occ * 100:5.1f}" if isinstance(occ, (int, float)) \
+            else f"{'—':>5}"
+        lines.append(
+            f"  {_s(rec.get('iter')):>6} {_s(rec.get('waiting')):>5} "
+            f"{_s(rec.get('active')):>5} {_s(rec.get('running')):>4} "
+            f"{_s(rec.get('decoded')):>4} "
+            f"{_s(rec.get('free_pages')):>5} {occ_s} "
+            f"{_s(rec.get('admit_cap')):>4} "
+            f"{_s(rec.get('backend'))}"
+            + (" [evacuated]" if rec.get("evacuated") else ""))
+    reqs = data.get("requests") or []
+    if reqs:
+        lines.append("")
+        lines.append(f"request timelines ({len(reqs)}):")
+        for r in reqs:
+            if not isinstance(r, dict):
+                continue
+            marks = r.get("marks") or []
+            path_s = " → ".join(_s(m.get("state")) for m in marks
+                                if isinstance(m, dict))
+            lines.append(f"  {_s(r.get('req_id'))}: {path_s}")
+            bd = r.get("ttft_breakdown_ms")
+            if isinstance(bd, dict):
+                lines.append(
+                    "    ttft: " + "  ".join(
+                        f"{k.replace('_ms', '')}={bd[k]:.3f}ms"
+                        if isinstance(bd.get(k), (int, float))
+                        else f"{k.replace('_ms', '')}={_s(bd.get(k))}"
+                        for k in ("queue_ms", "prefill_ms", "migrate_ms",
+                                  "decode_ms", "total_ms") if k in bd))
+    counters = data.get("counters") or {}
+    if isinstance(counters, dict) and counters:
+        lines.append("")
+        lines.append("counters at dump:")
+        for k in sorted(counters):
+            v = counters[k]
+            lines.append(f"  {k} = {v:g}"
+                         if isinstance(v, (int, float)) else
+                         f"  {k} = {_s(v)}")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m triton_distributed_tpu.obs.postmortem",
+        description="Render + validate serving flight-recorder dumps "
+                    "(docs/observability.md \"Request tracing & "
+                    "postmortems\").")
+    ap.add_argument("path", help="one flight-*.json dump, or a directory "
+                                 "to search recursively")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on a structurally invalid dump (or a "
+                         "directory containing none)")
+    ap.add_argument("--json", dest="json_path", default=None,
+                    help="write the machine-readable verdict here")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress the rendered timelines (verdict only)")
+    args = ap.parse_args(argv)
+
+    if os.path.isdir(args.path):
+        paths = flight.find_dumps(args.path)
+    elif os.path.exists(args.path):
+        paths = [args.path]
+    else:
+        print(f"error: {args.path} does not exist", file=sys.stderr)
+        return 2
+
+    problems: list[str] = []
+    dumps: list[dict] = []
+    if not paths:
+        problems.append(f"{args.path}: no flight-*.json dumps found")
+    for p in paths:
+        try:
+            data = flight.load_dump(p)
+        except (OSError, json.JSONDecodeError) as exc:
+            problems.append(f"{p}: unreadable ({type(exc).__name__}: "
+                            f"{exc})")
+            continue
+        dump_problems = flight.validate_dump(data, path=p)
+        problems += dump_problems
+        dumps.append({"path": p,
+                      "trigger": (data.get("trigger") or {}).get("kind"),
+                      "iterations": len(data.get("iterations") or []),
+                      "requests": len(data.get("requests") or []),
+                      "valid": not dump_problems})
+        if not args.quiet:
+            try:
+                print(render(data, p))
+            except Exception as exc:   # render-safe: validation still runs
+                print(f"(render failed for {p}: "
+                      f"{type(exc).__name__}: {exc})", file=sys.stderr)
+            print()
+
+    print(f"postmortem: {len(paths)} dump(s), "
+          f"{sum(d['valid'] for d in dumps)} valid")
+    if args.json_path:
+        with open(args.json_path, "w") as f:
+            json.dump({"ok": not problems, "dumps": dumps,
+                       "problems": problems}, f, indent=2)
+    if args.check and problems:
+        for msg in problems:
+            print(f"CHECK FAIL: {msg}", file=sys.stderr)
+        return 1
+    if args.check:
+        print("checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -228,8 +228,16 @@ def merge_run(run_dir: str) -> tuple[dict, dict]:
     trace = {MERGED_MARKER: 1,
              "traceEvents": span_ev + cl_ev + kp_ev + dev_ev,
              "displayTimeUnit": "ms"}
+    # Request timelines (ISSUE 13, obs/reqtrace.py) are a *.spans.json
+    # source kind — already merged above — but gate as their OWN lane:
+    # a serving run without per-request tracks lost the evidence the
+    # postmortem tooling stands on.
+    req_files = glob.glob(os.path.join(run_dir, "**",
+                                       "requests.spans.json"),
+                          recursive=True)
     lanes = {"host": bool(span_ev), "commlint": bool(cl_ev),
              "kernel": bool(kp_ev), "device": bool(dev_ev),
+             "request": bool(req_files),
              "kernel_summaries": kp_summaries}
     return trace, lanes
 
@@ -270,7 +278,8 @@ def load_metrics(run_dir: str) -> dict[str, Any] | None:
 
 def summarize(run_dir: str, lanes: dict, metrics: dict | None,
               cl_metrics: dict[str, float],
-              slo: dict | None = None) -> str:
+              slo: dict | None = None,
+              flight_dumps: list[tuple] | None = None) -> str:
     lines = [f"# obs report — {run_dir}", ""]
     lines.append("lanes: " + ", ".join(
         f"{k}={'yes' if v else 'no'}" for k, v in lanes.items()
@@ -334,6 +343,12 @@ def summarize(run_dir: str, lanes: dict, metrics: dict | None,
     if serving:
         lines.append("")
         lines += serving
+    flight_sec = flight_section(
+        load_flight_dumps(run_dir) if flight_dumps is None
+        else flight_dumps)
+    if flight_sec:
+        lines.append("")
+        lines += flight_sec
     migration = migration_lane(metrics)
     if migration:
         lines.append("")
@@ -369,6 +384,58 @@ def serving_lane(metrics: dict | None) -> list[str]:
         else:
             lines.append(f"  {name} = {m['value']:g}")
     return lines
+
+
+def load_flight_dumps(run_dir: str) -> list[tuple]:
+    """``[(path, data | None, error | None)]`` — every flight dump in
+    the run dir parsed ONCE; the summary section and the --check gate
+    both consume this (dumps embed up to a full iteration ring each, so
+    double-parsing them per report invocation is real I/O)."""
+    from triton_distributed_tpu.obs import flight as flight_mod
+
+    out: list[tuple] = []
+    for p in flight_mod.find_dumps(run_dir):
+        try:
+            out.append((p, flight_mod.load_dump(p), None))
+        except (OSError, json.JSONDecodeError) as exc:
+            out.append((p, None, f"{type(exc).__name__}: {exc}"))
+    return out
+
+
+def flight_section(flight_dumps: list[tuple]) -> list[str]:
+    """Flight-recorder dumps (docs/observability.md "Request tracing &
+    postmortems") — each one is a captured incident; ``obs.postmortem``
+    renders them in full."""
+    if not flight_dumps:
+        return []
+    lines = ["flight-recorder dumps (obs.postmortem renders them):"]
+    for p, data, err in flight_dumps:
+        if data is None:
+            lines.append(f"  {os.path.basename(p)}: UNREADABLE ({err})")
+            continue
+        trig = data.get("trigger") or {}
+        lines.append(
+            f"  {os.path.basename(p)}: {trig.get('kind')} @ iter "
+            f"{trig.get('iter')} — {str(trig.get('reason'))[:80]} "
+            f"({len(data.get('iterations') or [])} iterations, "
+            f"{len(data.get('requests') or [])} requests)")
+    return lines
+
+
+def flight_problems(flight_dumps: list[tuple]) -> list[str]:
+    """Structural problems across the loaded flight dumps — what
+    ``--check`` gates (a malformed dump is lost postmortem evidence,
+    fail loud)."""
+    from triton_distributed_tpu.obs import flight as flight_mod
+
+    problems: list[str] = []
+    for p, data, err in flight_dumps:
+        if data is None:
+            problems.append(f"{p}: unreadable ({err})")
+            continue
+        problems += flight_mod.validate_dump(
+            data, path=os.path.basename(p))
+    return problems
 
 
 def migration_lane(metrics: dict | None) -> list[str]:
@@ -572,6 +639,12 @@ def main(argv: list[str] | None = None) -> int:
                          "failing --check (by default a failed stream "
                          "in the snapshot fails the migration lane — "
                          "each one demoted the disagg tier)")
+    ap.add_argument("--allow-missing-request-lane", action="store_true",
+                    help="accept a serving-tier snapshot without the "
+                         "per-request timeline lane "
+                         "(requests.spans.json) — by default a serving "
+                         "run that lost its request traces fails "
+                         "--check (pre-ISSUE-13 run dirs)")
     ap.add_argument("--allow-evacuation", action="store_true",
                     help="report fleet evacuations without failing "
                          "--check (by default a run that evacuated and "
@@ -616,7 +689,9 @@ def main(argv: list[str] | None = None) -> int:
                 slo_mod.stall_fraction_for_run_dir(args.run_dir))
             slo_section = slo_mod.evaluate(observed,
                                            slo_mod.SLOConfig.from_env())
-    print(summarize(args.run_dir, lanes, metrics, cl_metrics, slo_section))
+    flight_dumps = load_flight_dumps(args.run_dir)
+    print(summarize(args.run_dir, lanes, metrics, cl_metrics, slo_section,
+                    flight_dumps=flight_dumps))
     print(f"\nmerged trace: {out_path} "
           f"({len(trace['traceEvents'])} events) — load at "
           "https://ui.perfetto.dev")
@@ -659,6 +734,17 @@ def main(argv: list[str] | None = None) -> int:
         failures.append(
             f"serving lane present but {_om.KV_PAGES_RESIDENT} missing — "
             "the KV pool gauge is part of the serving lane contract")
+    # Request-timeline lane (ISSUE 13): any serving snapshot must carry
+    # its per-request tracks — without them an SLO slip or demotion in
+    # this run dir is unattributable after the fact.
+    if (serving_present and not lanes.get("request")
+            and not args.allow_missing_request_lane):
+        failures.append(
+            "serving series present but the request-timeline lane "
+            "(requests.spans.json) is missing — per-request evidence "
+            "lost (--allow-missing-request-lane to accept)")
+    failures += [f"flight dump: {p}" for p in
+                 flight_problems(flight_dumps)]
     demotions = degradation_count(metrics)
     if demotions and not args.allow_degradation:
         failures.append(

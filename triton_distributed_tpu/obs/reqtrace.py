@@ -1,0 +1,262 @@
+"""Request-scoped tracing for the serving tier (ISSUE 13).
+
+The host tracer (obs/trace.py) answers "what was the ENGINE doing";
+this module answers "where did THIS REQUEST's time go". A
+:class:`ReqTracer` keeps one lane per request id, fed by the serving
+loop's lifecycle hooks (serving/loop.py, disagg/engine.py):
+
+* **lifecycle marks** — every validated :class:`~triton_distributed_tpu.
+  serving.request.RequestState` transition, timestamped with the serving
+  loop's own clock (so injected fake clocks make the whole record
+  deterministic — the flight-recorder contract, obs/flight.py);
+* **stage spans** — per prefill slice, per decode step, per landed
+  KV-migration block, rendered as one Perfetto track PER REQUEST
+  (``requests.spans.json`` is a ``*.spans.json`` file, so
+  ``runtime.utils.merge_profiles`` and ``obs.report`` pick it up as a
+  source kind with no new plumbing);
+* **TTFT decomposition** — the interval *arrival → end of the request's
+  first decode step* partitioned by state residency into
+  ``queue`` (WAITING + PREEMPTED), ``prefill`` (PREFILLING),
+  ``migrate`` (MIGRATING, the disagg tier) and ``decode`` (RUNNING up
+  to the first decoded token). The components PARTITION the window —
+  ``sum(components) == window`` is the testable invariant
+  (tests/test_reqtrace.py pins it for a preempted-then-resumed and a
+  migrated request) — and the serving loop publishes them as the
+  ``tdtpu_serve_ttft_{queue,prefill,migrate,first_decode}_ms``
+  histogram series (obs/metrics.py).
+
+Like the host tracer, everything here is FREE when disabled: each hook
+is one module-global load and one ``None`` check (< 20 µs/event,
+asserted by test — the serving hot loop must cost nothing when nobody
+is watching). ``obs.start_run`` enables a request tracer alongside the
+span tracer; ``obs.finish_run`` writes ``requests.spans.json`` when any
+request was traced.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any
+
+# Chrome-trace pid for the request-timeline lanes. Distinct from the
+# host tracer's HOST_PID (90_001) and below the commlint/kernel bases,
+# so every lane family stays visually separate in the merged view.
+REQ_PID = 91_001
+
+# State -> TTFT-decomposition bucket. RUNNING time before the first
+# decoded token is the "first decode" component (scheduler gaps land in
+# the stage the request was in — states cover all wall time, so the
+# buckets partition the window exactly).
+_BUCKET = {
+    "WAITING": "queue_ms",
+    "PREEMPTED": "queue_ms",
+    "PREFILLING": "prefill_ms",
+    "MIGRATING": "migrate_ms",
+    "RUNNING": "decode_ms",
+}
+
+COMPONENTS = ("queue_ms", "prefill_ms", "migrate_ms", "decode_ms")
+
+_TRACER: "ReqTracer | None" = None
+
+
+def get_tracer() -> "ReqTracer | None":
+    return _TRACER
+
+
+def is_enabled() -> bool:
+    return _TRACER is not None
+
+
+def enable(run_dir: str | None = None) -> "ReqTracer":
+    """Install a fresh global request tracer; returns it."""
+    global _TRACER
+    _TRACER = ReqTracer(run_dir=run_dir)
+    return _TRACER
+
+
+def disable() -> "ReqTracer | None":
+    """Uninstall the global request tracer and return it (lanes retained
+    so the caller can still ``save()``)."""
+    global _TRACER
+    t = _TRACER
+    _TRACER = None
+    return t
+
+
+class _Lane:
+    """One request's record: lifecycle marks + stage spans."""
+
+    __slots__ = ("req_id", "t_arrival", "marks", "spans", "window_end",
+                 "breakdown")
+
+    def __init__(self, req_id: str):
+        self.req_id = req_id
+        self.t_arrival: float | None = None
+        self.marks: list[tuple[float, str]] = []
+        self.spans: list[dict] = []
+        self.window_end: float | None = None       # first decode step end
+        self.breakdown: dict[str, float] | None = None
+
+
+class ReqTracer:
+    """Per-request span lanes keyed by request id.
+
+    All timestamps are SECONDS on the caller's clock (the serving loop
+    passes its own ``self.clock()`` readings through, so a fake clock
+    makes the whole record — and any flight dump embedding it —
+    deterministic). Chrome export rebases to the wall anchor captured at
+    construction, matching the host tracer's clock-domain convention.
+    """
+
+    def __init__(self, run_dir: str | None = None):
+        self.run_dir = run_dir
+        self._lanes: dict[str, _Lane] = {}
+        self._epoch_s = time.perf_counter()
+        self._wall_epoch_us = time.time_ns() / 1e3
+
+    def _lane(self, req_id: str) -> _Lane:
+        lane = self._lanes.get(req_id)
+        if lane is None:
+            lane = self._lanes[req_id] = _Lane(req_id)
+        return lane
+
+    # -- hooks (the serving loop calls these; each is cheap) ---------------
+    def arrival(self, req_id: str, t: float) -> None:
+        lane = self._lane(req_id)
+        if lane.t_arrival is None:
+            lane.t_arrival = t
+            lane.marks.append((t, "WAITING"))
+
+    def mark(self, req_id: str, state: str, t: float) -> None:
+        self._lane(req_id).marks.append((t, state))
+
+    def rebase_arrival(self, req_id: str, t: float) -> None:
+        """Move a lane's arrival (and its opening WAITING mark) to an
+        EARLIER first-submission time — open-loop generators measure
+        TTFT from the first attempt, so a shed-and-retried request's
+        backpressure wait must land in the queue component, not vanish
+        (serving/loadgen.py rebases right after it restamps
+        ``req.t_arrival``)."""
+        lane = self._lanes.get(req_id)
+        if lane is None or lane.t_arrival is None or t >= lane.t_arrival:
+            return
+        if lane.marks and lane.marks[0] == (lane.t_arrival, "WAITING"):
+            lane.marks[0] = (t, "WAITING")
+        else:
+            lane.marks.insert(0, (t, "WAITING"))
+        lane.t_arrival = t
+
+    def span(self, req_id: str, name: str, t0: float, t1: float,
+             **args: Any) -> None:
+        self._lane(req_id).spans.append(
+            {"name": name, "t0": t0, "t1": t1, "args": args})
+
+    # -- TTFT decomposition -------------------------------------------------
+    def close_window(self, req_id: str, t: float) -> dict | None:
+        """Close the decomposition window at ``t`` (the end of the
+        request's first decode step — or its finish, for requests that
+        never decode) and return the components. Idempotent: only the
+        FIRST close computes; later calls return the stored breakdown."""
+        lane = self._lanes.get(req_id)
+        if lane is None or lane.t_arrival is None:
+            return None
+        if lane.breakdown is not None:
+            return lane.breakdown
+        lane.window_end = t
+        lane.breakdown = self._decompose(lane, t)
+        return lane.breakdown
+
+    def breakdown(self, req_id: str) -> dict | None:
+        lane = self._lanes.get(req_id)
+        return lane.breakdown if lane is not None else None
+
+    @staticmethod
+    def _decompose(lane: _Lane, end: float) -> dict[str, float]:
+        comp = {k: 0.0 for k in COMPONENTS}
+        marks = sorted(lane.marks, key=lambda m: m[0])
+        for i, (t0, state) in enumerate(marks):
+            if t0 >= end:
+                break
+            t1 = min(marks[i + 1][0] if i + 1 < len(marks) else end, end)
+            bucket = _BUCKET.get(state)
+            if bucket is not None and t1 > t0:
+                comp[bucket] += (t1 - t0) * 1e3
+        comp["total_ms"] = (end - lane.t_arrival) * 1e3
+        return comp
+
+    # -- export -------------------------------------------------------------
+    def has_events(self) -> bool:
+        return bool(self._lanes)
+
+    def record_for(self, req_id: str) -> dict | None:
+        lane = self._lanes.get(req_id)
+        if lane is None:
+            return None
+        return {
+            "req_id": lane.req_id,
+            "arrival_s": lane.t_arrival,
+            "marks": [{"t": t, "state": s} for t, s in lane.marks],
+            "spans": len(lane.spans),
+            "ttft_breakdown_ms": lane.breakdown,
+        }
+
+    def records(self) -> list[dict]:
+        """Per-request summaries (the flight-recorder ``requests``
+        section, obs/flight.py), in first-arrival order."""
+        lanes = sorted(self._lanes.values(),
+                       key=lambda ln: (ln.t_arrival is None,
+                                       ln.t_arrival or 0.0, ln.req_id))
+        return [self.record_for(ln.req_id) for ln in lanes]
+
+    def _ts_us(self, t: float) -> float:
+        return self._wall_epoch_us + (t - self._epoch_s) * 1e6
+
+    def chrome_trace(self) -> dict:
+        """One Perfetto track per request under the ``request
+        timelines`` process: stage spans as complete events, lifecycle
+        marks as instants."""
+        meta = [{"name": "process_name", "ph": "M", "pid": REQ_PID,
+                 "args": {"name": "request timelines (obs/reqtrace.py)"}}]
+        events: list[dict] = []
+        for tid, lane in enumerate(sorted(
+                self._lanes.values(),
+                key=lambda ln: (ln.t_arrival is None, ln.t_arrival or 0.0,
+                                ln.req_id)), start=1):
+            meta.append({"name": "thread_name", "ph": "M", "pid": REQ_PID,
+                         "tid": tid, "args": {"name": lane.req_id}})
+            for t, state in lane.marks:
+                events.append({"name": state, "ph": "i", "s": "t",
+                               "pid": REQ_PID, "tid": tid,
+                               "ts": self._ts_us(t)})
+            for sp in lane.spans:
+                ev = {"name": sp["name"], "ph": "X", "pid": REQ_PID,
+                      "tid": tid, "ts": self._ts_us(sp["t0"]),
+                      "dur": max((sp["t1"] - sp["t0"]) * 1e6, 0.001)}
+                if sp["args"]:
+                    ev["args"] = dict(sp["args"])
+                events.append(ev)
+            if lane.breakdown is not None:
+                events.append({
+                    "name": "ttft_breakdown", "ph": "i", "s": "t",
+                    "pid": REQ_PID, "tid": tid,
+                    "ts": self._ts_us(lane.window_end),
+                    "args": {k: round(v, 3)
+                             for k, v in lane.breakdown.items()}})
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+    def save(self, path: str | None = None) -> str:
+        """Write ``<run_dir>/requests.spans.json`` (or ``path``). The
+        ``.spans.json`` suffix keeps it a ``merge_profiles`` /
+        ``obs.report`` source kind; the FIXED ``requests`` stem is what
+        the report's request-lane gate looks for."""
+        if path is None:
+            if self.run_dir is None:
+                raise ValueError("no run_dir configured and no path given")
+            path = os.path.join(self.run_dir, "requests.spans.json")
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        return path

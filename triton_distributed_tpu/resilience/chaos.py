@@ -830,6 +830,154 @@ def fleet_selftest() -> list[CaseResult]:
 
 
 # ---------------------------------------------------------------------------
+# Flight-recorder rows (ISSUE 13): a seeded failure must leave a
+# postmortem dump the tooling can stand on — deterministic evidence,
+# validated by ``obs.postmortem --check`` rc 0, not just a demotion
+# verdict (docs/observability.md "Request tracing & postmortems").
+# ---------------------------------------------------------------------------
+
+def flight_recorder_selftest() -> list[CaseResult]:
+    """Two rows per --all sweep: (1) a seeded transient fault in the
+    megakernel decode step demotes the backend mid-serve and the flight
+    recorder dumps a ``backend_demotion`` postmortem; (2) a seeded
+    ``rank_loss`` evacuates a TP=2 tier to the survivor mesh and dumps
+    an ``evacuation`` postmortem. Both runs are under an obs run (so
+    per-request timelines ride in the dumps) and both dumps must pass
+    ``obs.postmortem --check`` (rc 0) naming their trigger."""
+    import tempfile
+    import warnings
+
+    import jax
+
+    from triton_distributed_tpu import obs as obs_pkg
+    from triton_distributed_tpu.models import Engine, init_dense_llm
+    from triton_distributed_tpu.models.config import (
+        ModelConfig, tiny_config,
+    )
+    from triton_distributed_tpu.obs import flight as flight_mod
+    from triton_distributed_tpu.obs import metrics as obs_metrics
+    from triton_distributed_tpu.obs import postmortem as postmortem_mod
+    from triton_distributed_tpu.resilience import faults as faults_mod
+    from triton_distributed_tpu.runtime import initialize_distributed
+    from triton_distributed_tpu.serving.loop import ServingEngine
+
+    cases = []
+
+    # Row 1: seeded megakernel step fault -> backend_demotion dump.
+    t0 = time.time()
+    diags: list[str] = []
+    prior_reg = obs_metrics.registry()
+    run_dir = tempfile.mkdtemp(prefix="tdtpu-chaos-flight-")
+    try:
+        mk_cfg = ModelConfig(hidden_size=256, intermediate_size=256,
+                             num_layers=1, num_heads=2, num_kv_heads=1,
+                             head_dim=128, vocab_size=512, qk_norm=True,
+                             dtype="float32")
+        mk_params = init_dense_llm(jax.random.PRNGKey(3), mk_cfg)
+        ctx1 = initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                      devices=jax.devices()[:1])
+        fired = {"n": 0}
+        obs_pkg.start_run(run_dir)
+        try:
+            eng = Engine(mk_cfg, mk_params, ctx1, backend="megakernel",
+                         max_seq=256, page_size=128)
+            se = ServingEngine(eng, max_batch=2, num_pages=4,
+                               prefill_chunk=128)
+            assert se._mk is not None, "lane not active before injection"
+            real_step = se._mk.step
+
+            def faulty_step(*a, **kw):
+                if fired["n"] == 0:
+                    fired["n"] += 1
+                    raise FaultInjectionError(
+                        "chaos: injected megakernel step fault "
+                        "(kernel=mk_paged_step occurrence=0)")
+                return real_step(*a, **kw)
+
+            se._mk.step = faulty_step
+            se.submit([5, 77, 131], 3, req_id="chaos-fr-0")
+            se.run()
+        finally:
+            obs_pkg.finish_run()
+        dumps = flight_mod.find_dumps(run_dir)
+        kinds = [(flight_mod.load_dump(p).get("trigger") or {}).get("kind")
+                 for p in dumps]
+        rc = (postmortem_mod.main([run_dir, "--check", "--quiet"])
+              if dumps else 1)
+        diags += [f"fault fired: {fired['n']}", f"dumps: {kinds}",
+                  f"postmortem --check rc: {rc}"]
+        verdict = ("detected" if fired["n"]
+                   and "backend_demotion" in kinds and rc == 0
+                   else "error")
+    except Exception as exc:                        # died = the failure
+        verdict = "error"
+        diags.append(f"{type(exc).__name__}: {exc}")
+    finally:
+        obs_metrics.set_registry(prior_reg)
+    cases.append(CaseResult(
+        op="flight_recorder", mesh="1", fault="seeded_backend_demotion",
+        verdict=verdict, detected_by="postmortem",
+        expected=("detected",), ok=verdict == "detected", n_fired=1,
+        n_violations=0, diagnostics=diags,
+        elapsed_s=round(time.time() - t0, 3)))
+
+    # Row 2: seeded rank loss -> evacuation dump.
+    t0 = time.time()
+    diags = []
+    prior_reg = obs_metrics.registry()
+    run_dir = tempfile.mkdtemp(prefix="tdtpu-chaos-flight-")
+    try:
+        if len(jax.devices()) < 2:
+            raise RuntimeError(
+                "flight evacuation row needs >= 2 virtual CPU devices "
+                "(--xla_force_host_platform_device_count)")
+        cfg = tiny_config()
+        params = init_dense_llm(jax.random.PRNGKey(11), cfg)
+        ctx2 = initialize_distributed(mesh_shape=(2,), axis_names=("tp",),
+                                      devices=jax.devices()[:2])
+        obs_pkg.start_run(run_dir)
+        try:
+            eng = Engine(cfg, params, ctx2, backend="xla", max_seq=64,
+                         page_size=4)
+            se = ServingEngine(eng, max_batch=2, prefill_chunk=4)
+            se.submit([5, 77, 131, 9, 40, 2], 4, req_id="chaos-fr-1")
+            for _ in range(2):
+                se.step()
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                faults_mod.mark_rank_lost(1)
+                se.run()
+        finally:
+            faults_mod.clear_rank_loss()
+            obs_pkg.finish_run()
+        dumps = flight_mod.find_dumps(run_dir)
+        kinds = [(flight_mod.load_dump(p).get("trigger") or {}).get("kind")
+                 for p in dumps]
+        rc = (postmortem_mod.main([run_dir, "--check", "--quiet"])
+              if dumps else 1)
+        named = any("dead" in str((flight_mod.load_dump(p)["trigger"]
+                                   or {}).get("reason", ""))
+                    for p in dumps if "evacuation" in p)
+        diags += [f"dumps: {kinds}", f"postmortem --check rc: {rc}",
+                  f"evacuated: {se.evacuated}"]
+        verdict = ("detected" if se.evacuated and "evacuation" in kinds
+                   and named and rc == 0 else "error")
+    except Exception as exc:
+        verdict = "error"
+        diags.append(f"{type(exc).__name__}: {exc}")
+    finally:
+        faults_mod.clear_rank_loss()
+        obs_metrics.set_registry(prior_reg)
+    cases.append(CaseResult(
+        op="flight_recorder", mesh="2",
+        fault="seeded_rank_loss_evacuation", verdict=verdict,
+        detected_by="postmortem", expected=("detected",),
+        ok=verdict == "detected", n_fired=1, n_violations=0,
+        diagnostics=diags, elapsed_s=round(time.time() - t0, 3)))
+    return cases
+
+
+# ---------------------------------------------------------------------------
 # Sweep + CLI.
 # ---------------------------------------------------------------------------
 
@@ -897,6 +1045,13 @@ def sweep(ops, faults, ranks, *, seed: int = 0,
         # prefill-role rank mid-migration -> demote-to-monolithic;
         # pinned geometry propagates the named error.
         for case in fleet_selftest():
+            cases.append(case)
+            failed += not case.ok
+            _print_case(case, verbose)
+        # Flight-recorder rows (ISSUE 13): a seeded backend demotion and
+        # a seeded rank-loss evacuation must each leave a flight dump
+        # that obs.postmortem --check validates rc=0.
+        for case in flight_recorder_selftest():
             cases.append(case)
             failed += not case.ok
             _print_case(case, verbose)

@@ -34,6 +34,7 @@ import time
 
 import numpy as np
 
+from triton_distributed_tpu.obs import reqtrace as obs_reqtrace
 from triton_distributed_tpu.serving.scheduler import AdmitResult
 
 
@@ -74,9 +75,43 @@ def build_trace(spec: LoadSpec) -> list[dict]:
     return trace
 
 
+def request_records(reqs) -> list[dict]:
+    """The per-request record array (ISSUE 13): one row per request —
+    id, arrival, TTFT/TPOT, preempted/migrated/evacuated flags, final
+    backend — plus the TTFT decomposition when a request tracer was
+    active. ``obs.postmortem`` and the serving-report artifact consume
+    it; the dryrun asserts it reconciles with the aggregate counters."""
+    rt = obs_reqtrace.get_tracer()
+    out = []
+    for r in sorted(reqs, key=lambda r: (r.arrival_seq, r.req_id)):
+        rec = {
+            "req_id": r.req_id,
+            "arrival_s": r.t_arrival,
+            "ttft_ms": (round(r.ttft_s * 1e3, 3)
+                        if r.ttft_s is not None else None),
+            "tpot_ms": (round(r.tpot_s * 1e3, 3)
+                        if r.tpot_s is not None else None),
+            "tokens": len(r.tokens),
+            "preemptions": r.preemptions,
+            "preempted": r.preemptions > 0,
+            "migrated": r.migrations > 0,
+            "evacuated": r.evacuations > 0,
+            "final_backend": r.final_backend,
+            "state": r.state.name,
+        }
+        if rt is not None:
+            bd = rt.breakdown(r.req_id)
+            if bd is not None:
+                rec["ttft_breakdown_ms"] = {k: round(v, 3)
+                                            for k, v in bd.items()}
+        out.append(rec)
+    return out
+
+
 def run_trace(se, trace: list[dict], *, max_iters: int = 100_000) -> dict:
     """Replay an arrival trace open-loop. Returns the run report:
-    per-request latency stats, reject/preemption counts, throughput."""
+    per-request latency stats, reject/preemption counts, throughput,
+    and the ``request_records`` array (one row per request)."""
     pending = sorted(trace, key=lambda t: t["arrival_iter"])
     requests = {}
     rejects = 0
@@ -107,6 +142,12 @@ def run_trace(se, trace: list[dict], *, max_iters: int = 100_000) -> dict:
                 still.append(item)
             else:
                 req.t_arrival = item["_t_first_try"]
+                # Keep the request tracer's window on the same clock
+                # origin: the shed-and-retry wait belongs in the TTFT
+                # queue component (obs/reqtrace.py).
+                rt = obs_reqtrace.get_tracer()
+                if rt is not None:
+                    rt.rebase_arrival(req.req_id, req.t_arrival)
                 requests[req.req_id] = req
         pending = still
         se.step()
@@ -132,6 +173,7 @@ def run_trace(se, trace: list[dict], *, max_iters: int = 100_000) -> dict:
         "admission_rejects": rejects,
         "preemptions": sum(r.preemptions for r in reqs),
         "all_finished": all(r.state.name == "FINISHED" for r in reqs),
+        "request_records": request_records(reqs),
         "requests": reqs,
     }
 
@@ -174,11 +216,14 @@ def _tiny_serving(engine=None, **serving_kw):
     return engine, ServingEngine(engine, **serving_kw)
 
 
-def dryrun(json_path: str | None) -> int:
+def dryrun(json_path: str | None, flight_dir: str | None = None) -> int:
     """The seeded 8-request CPU proof (acceptance criteria of ISSUE 7):
     (a) per-request token parity vs sequential serve incl. a
     preempt/resume, (b) admission backpressure on pool exhaustion,
-    (c) SLO violation streak shrinks the admitted batch."""
+    (c) SLO violation streak shrinks the admitted batch. Phase 8
+    (ISSUE 13) adds the request-tracing + flight-recorder round-trip:
+    ``flight_dir`` keeps its obs run directory (dumps + request
+    timelines) for CI's postmortem step."""
     import os
 
     from triton_distributed_tpu.runtime.utils import (
@@ -222,6 +267,21 @@ def dryrun(json_path: str | None) -> int:
     if not preempted_ok:
         failures.append("no request was preempted+resumed with parity — "
                         "the pool sizing no longer exercises eviction")
+    # The per-request record array must reconcile with the aggregate
+    # counters it rides beside (ISSUE 13): same request set, same
+    # preemption total, same token total, everyone FINISHED.
+    recs = report["request_records"]
+    reconciled = (
+        len(recs) == report["n_requests"]
+        and sum(r["preemptions"] for r in recs) == report["preemptions"]
+        and sum(r["tokens"] for r in recs) == report["tokens"]
+        and all(r["state"] == "FINISHED" for r in recs)
+        and all(r["ttft_ms"] is not None for r in recs))
+    if not reconciled:
+        failures.append(
+            "per-request records do not reconcile with the aggregate "
+            "counters (n/preemptions/tokens/finished/ttft)")
+    report["records_reconciled"] = reconciled
     report["parity_ok"] = not mismatches
     report["preempted_with_parity"] = preempted_ok
     report["per_request"] = [
@@ -593,6 +653,66 @@ def dryrun(json_path: str | None) -> int:
         "all_finished": f8_report["all_finished"],
     }
 
+    # Phase 8 (ISSUE 13) — request tracing + flight recorder: a traced
+    # serving run under an impossible tokens/s floor must (a) leave
+    # per-request timelines (requests.spans.json) whose TTFT components
+    # PARTITION each request's window, (b) dump the flight ring when the
+    # SLO violation streak shrinks admission, (c) validate under
+    # ``obs.postmortem --check`` (rc 0), and (d) reconcile the
+    # per-request record array against the run's own metric counters.
+    from triton_distributed_tpu.obs import postmortem as _pm
+
+    run_dir = flight_dir or tempfile.mkdtemp(prefix="tdtpu-flight-")
+    _obs.start_run(run_dir)
+    try:
+        _, se8 = _tiny_serving(engine, max_batch=4, num_pages=8,
+                               prefill_chunk=4, max_waiting=8,
+                               slo_cfg=SLOConfig(tokens_per_s_min=1e12))
+        rep8 = run_trace(se8, build_trace(spec))    # phase 1's shape
+        rep8.pop("requests")
+        recs8 = rep8["request_records"]
+        snap8 = _om.registry().snapshot()
+    finally:
+        _obs.finish_run()
+    # THIS run's recorder, not a directory glob: a stale dump from a
+    # previous session in a reused --flight-dir must neither satisfy
+    # the produced-a-dump assertion nor be misreported as this run's.
+    dumps = list(se8.flight.dumps)
+    if not dumps:
+        failures.append(
+            "phase 8: the SLO-driven admission shrink produced no "
+            "flight-recorder dump")
+    elif any(_pm.main([p, "--check", "--quiet"]) != 0 for p in dumps):
+        failures.append(
+            "phase 8: obs.postmortem --check rejected a flight dump")
+    if not os.path.exists(os.path.join(run_dir, "requests.spans.json")):
+        failures.append(
+            "phase 8: the traced serving run left no request-timeline "
+            "lane (requests.spans.json)")
+    bad_bd = [r["req_id"] for r in recs8
+              if not r.get("ttft_breakdown_ms")
+              or abs(sum(r["ttft_breakdown_ms"][k] for k in
+                         ("queue_ms", "prefill_ms", "migrate_ms",
+                          "decode_ms"))
+                     - r["ttft_breakdown_ms"]["total_ms"]) > 0.01]
+    if bad_bd:
+        failures.append(
+            f"phase 8: TTFT components do not partition the window for "
+            f"{bad_bd}")
+    finished8 = (snap8.get(_om.SERVE_FINISHED) or {}).get("value")
+    if (finished8 != len(recs8)
+            or not all(r["state"] == "FINISHED" for r in recs8)):
+        failures.append(
+            f"phase 8: per-request records ({len(recs8)} finished rows) "
+            f"do not reconcile with {_om.SERVE_FINISHED} = {finished8}")
+    report["reqtrace"] = {
+        "run_dir": run_dir,
+        "flight_dumps": [os.path.basename(p) for p in dumps],
+        "n_records": len(recs8),
+        "breakdown_partition_ok": not bad_bd,
+        "preemptions": rep8["preemptions"],
+    }
+
     report["failures"] = failures
     if json_path:
         with open(json_path, "w") as f:
@@ -752,9 +872,14 @@ def main(argv: list[str] | None = None) -> int:
                          "backpressure, SLO admission shrink")
     ap.add_argument("--json", default=None,
                     help="write the run report to this path")
+    ap.add_argument("--flight-dir", default=None,
+                    help="keep phase 8's obs run directory (flight "
+                         "dumps + request timelines) here for "
+                         "obs.postmortem / the CI artifact (default: a "
+                         "temp dir)")
     args = ap.parse_args(argv)
     if args.dryrun:
-        return dryrun(args.json)
+        return dryrun(args.json, flight_dir=args.flight_dir)
     ap.error("only --dryrun is wired as a CLI entry today; the bench "
              "rung runs through bench.py (serving_bench_rung)")
     return 2

@@ -58,6 +58,7 @@ from triton_distributed_tpu.models.kv_cache import (
     paged_cache_specs,
 )
 from triton_distributed_tpu.obs import metrics as obs_metrics
+from triton_distributed_tpu.obs import reqtrace as obs_reqtrace
 from triton_distributed_tpu.obs import trace as obs_trace
 from triton_distributed_tpu.serving.request import Request, RequestState
 from triton_distributed_tpu.serving.scheduler import (
@@ -194,6 +195,15 @@ class ServingEngine:
                 "at least one page — argument num_pages")
         self.num_pages = pool_pages
         self.scratch_page = pool_pages        # last pool row, never owned
+        # Flight recorder (ISSUE 13, obs/flight.py): the last N
+        # iterations + trigger chain, dumped on demotion / evacuation /
+        # SLO shrink. Created BEFORE the megakernel lane so a
+        # construction-time demotion is already dump-able.
+        from triton_distributed_tpu.obs import flight as obs_flight
+
+        self.flight = obs_flight.FlightRecorder(
+            _env_int("TDTPU_FLIGHT_CAPACITY", 128))
+        self._flight_rung = engine._rung
         # Megakernel serving lane (round 9): decode through the PAGED
         # persistent kernel when the configuration supports it; a
         # workspace/page-shape mismatch raises the TRANSIENT
@@ -236,7 +246,7 @@ class ServingEngine:
             num_slots=max_batch,
             allocator=allocator,
             page_size=page, capacity_tokens=capacity,
-            max_waiting=max_waiting)
+            max_waiting=max_waiting, on_event=self._req_event)
         self._jits: dict = {}
         self._jits_backend = engine.backend
         self.slo_every = max(1, int(slo_every))
@@ -323,6 +333,7 @@ class ServingEngine:
         eng = self.engine
         if eng._rung + 1 < len(eng._ladder):
             eng._set_rung(eng._rung + 1, reason)
+            self._flight_dump("backend_demotion", reason)
         else:
             raise BackendUnsupportedError(reason)
 
@@ -431,6 +442,12 @@ class ServingEngine:
                       max_new_tokens=int(max_new_tokens),
                       priority=priority, **kw)
         res = self.sched.admit(req, self.clock())
+        if res is AdmitResult.ADMITTED:
+            rt = obs_reqtrace.get_tracer()
+            if rt is not None:
+                rt.arrival(req.req_id,
+                           req.t_arrival if req.t_arrival is not None
+                           else self.clock())
         if res is AdmitResult.QUEUE_FULL and self._observing():
             obs_metrics.registry().counter(
                 obs_metrics.SERVE_REJECTS,
@@ -477,6 +494,18 @@ class ServingEngine:
         # backend's mode at build time, so they must drop too — a
         # demoted engine must not keep prefilling through the collective
         # stack the demotion routed around.
+        if self.engine._rung > self._flight_rung:
+            # The engine's OWN ladder (SLO streaks inside
+            # _slo_streak_update, serve-path retries) demoted since we
+            # last looked — the serving-side _demote_backend path dumps
+            # at the demotion site, so only engine-internal moves land
+            # here.
+            self._flight_dump(
+                "backend_demotion",
+                f"engine ladder moved to rung {self.engine._rung} "
+                f"({self.engine.backend})")
+        else:
+            self._flight_rung = self.engine._rung
         if self.engine.backend != self._jits_backend:
             self._jits.clear()
             self._jits_backend = self.engine.backend
@@ -528,6 +557,8 @@ class ServingEngine:
                             "sequences evicted under page pressure "
                             "(recompute-on-resume)").inc(len(preempted))
             self._publish_gauges(reg)
+            self._flight_record_iteration(now, admitted, prefilled,
+                                          preempted, decoded)
         self._slo_tick()
         if self.fleet is not None:
             # Clean iteration: soft suspicion decays (flap damping) and
@@ -564,6 +595,118 @@ class ServingEngine:
     # -- internals ------------------------------------------------------------
     def _observing(self) -> bool:
         return obs_trace.get_tracer() is not None or self.slo_cfg is not None
+
+    # -- request-scoped tracing + flight recorder (ISSUE 13) ------------------
+    def _req_event(self, req: Request, kind: str) -> None:
+        """Scheduler lifecycle observer → request-tracer mark (one
+        global load + None check when tracing is off)."""
+        rt = obs_reqtrace.get_tracer()
+        if rt is None:
+            return
+        state = {"prefilling": "PREFILLING", "preempted": "PREEMPTED",
+                 "finished": "FINISHED"}.get(kind)
+        if state is not None:
+            rt.mark(req.req_id, state, self.clock())
+
+    def _publish_ttft_breakdown(self, bd: dict) -> None:
+        reg = obs_metrics.registry()
+        helps = {
+            "queue_ms": "TTFT component: time WAITING/PREEMPTED "
+                        "(admission + re-admission waits), ms",
+            "prefill_ms": "TTFT component: time PREFILLING (chunked "
+                          "slices + their scheduling gaps), ms",
+            "migrate_ms": "TTFT component: time MIGRATING (disagg KV "
+                          "stream), ms",
+            "decode_ms": "TTFT component: RUNNING until the first "
+                         "decode step lands, ms",
+        }
+        for comp, series in obs_metrics.TTFT_COMPONENT_SERIES.items():
+            reg.histogram(series, helps[comp],
+                          buckets=obs_metrics.TTFT_BUCKETS_MS
+                          ).observe(bd[comp])
+
+    def _flight_counters(self) -> dict[str, float]:
+        """Count-valued series only — deterministic under seeded runs
+        with an injected clock (histogram latencies are not)."""
+        reg = obs_metrics.registry()
+        out: dict[str, float] = {}
+        for name in (obs_metrics.SERVE_FINISHED,
+                     obs_metrics.SERVE_PREEMPTIONS,
+                     obs_metrics.SERVE_REJECTS,
+                     obs_metrics.SERVE_EVAC_PREEMPTIONS,
+                     obs_metrics.KV_MIGRATE_FAILURES,
+                     obs_metrics.DISAGG_DEMOTIONS,
+                     "tdtpu_engine_demotions_total",
+                     "tdtpu_tokens_generated_total"):
+            m = reg.get(name)
+            if m is not None:
+                out[name] = m.value
+        return out
+
+    def _flight_requests(self) -> list[dict]:
+        rt = obs_reqtrace.get_tracer()
+        if rt is not None and rt.has_events():
+            return rt.records()
+        # No request tracer (e.g. slo_cfg-only observation): fall back
+        # to the scheduler's live view so the dump still names who paid.
+        # (A construction-time demotion fires before the scheduler
+        # exists — nothing was in flight, so an empty list is exact.)
+        sched = getattr(self, "sched", None)
+        if sched is None:
+            return []
+        return [{"req_id": r.req_id, "state": r.state.name,
+                 "kv_len": r.kv_len, "preemptions": r.preemptions}
+                for r in list(sched.active) + list(sched.waiting)]
+
+    def _flight_dump(self, kind: str, reason: str) -> None:
+        """Write a postmortem dump (best-effort: the recorder must never
+        cost the serve it is documenting)."""
+        eng = self.engine
+        self._flight_rung = eng._rung
+        try:
+            cfg = {"max_batch": self.max_batch,
+                   "num_pages": self.num_pages, "page_size": self.page,
+                   "prefill_chunk": self.chunk, "backend": eng.backend,
+                   "rung": eng._rung,
+                   "kv_dtype": (str(jnp.dtype(self.kv_dtype))
+                                if self.kv_dtype is not None else None)}
+            self.flight.dump(kind, reason, getattr(self, "_iter", 0),
+                             config=cfg,
+                             requests=self._flight_requests(),
+                             counters=self._flight_counters())
+        except Exception as exc:
+            import warnings
+
+            warnings.warn(
+                f"flight-recorder dump failed: {type(exc).__name__}: "
+                f"{exc}", RuntimeWarning, stacklevel=2)
+
+    def _flight_record_iteration(self, now: float, admitted, prefilled,
+                                 preempted, decoded: int) -> None:
+        alloc = self.sched.allocator
+        usable = max(alloc.usable_pages, 1)
+        running = self.sched.running()
+        self.flight.record({
+            "iter": self._iter, "t": round(now, 6),
+            "admitted": [r.req_id for r in admitted],
+            "prefilled": prefilled,
+            "preempted": [r.req_id for r in preempted],
+            "decoded": decoded,
+            "waiting": len(self.sched.waiting),
+            "active": self.sched.active_count,
+            "running": len(running),
+            "free_pages": alloc.free_count,
+            "pool_occupancy_frac": round(
+                1.0 - alloc.free_count / usable, 4),
+            "admit_cap": self.sched.admit_cap,
+            "kv_lens": {r.req_id: r.kv_len for r in running},
+            "backend": self.engine.backend,
+            "rung": self.engine._rung,
+            "evacuated": self.evacuated,
+            "slo_violation_streak": self._viol_streak,
+            "fleet_suspects": (len(self.fleet.suspects())
+                               if self.fleet is not None else 0),
+        })
 
     def _prefill_lane(self):
         """(engine, slice_fn, logits_fn) the prefill stage runs through.
@@ -624,6 +767,10 @@ class ServingEngine:
             with obs_trace.span("serving.admission_shrink", cap=cap,
                                 reason="fleet_suspicion"):
                 pass
+            self.flight.note(
+                "fleet_suspicion",
+                f"suspect rank(s) {sorted(self.fleet.suspects())} "
+                f"narrowed admission to {cap}", self._iter)
         if (self.evacuated and self._clean_since_evac >= self._rejoin_after
                 and not (set(self._full_rank_ids) & set(lost))):
             self._rejoin()
@@ -661,6 +808,10 @@ class ServingEngine:
         # state-correct, and the geometry survives the flap.
         n = self._preempt_all()
         self._rebuild_device_state()
+        self.flight.note(
+            "fleet_step_fault",
+            f"{type(exc).__name__} attributed to rank {rank}: "
+            f"{str(exc)[:120]}", self._iter, rank=rank)
         if self._observing():
             reg = obs_metrics.registry()
             reg.counter(obs_metrics.FLEET_STEP_FAULTS,
@@ -669,14 +820,18 @@ class ServingEngine:
             self._count_fleet_preemptions(reg, n)
         return "retried"
 
-    def _preempt_all(self) -> int:
+    def _preempt_all(self, *, evacuation: bool = False) -> int:
         """Preempt every in-flight request (recompute-on-resume). First-
         submission accounting is untouched: ``t_arrival`` and any stamped
         ``t_first_token`` survive, so an evacuated request keeps its real
-        TTFT evidence."""
+        TTFT evidence. ``evacuation=True`` (the survivor-mesh path only)
+        stamps ``req.evacuations`` — the record flag must not fire for a
+        rejoin probe or a sub-threshold transient-fault rebuild."""
         evicted = list(self.sched.active)
         for req in evicted:
             self.sched._preempt(req)
+            if evacuation:
+                req.evacuations += 1
         self.evacuation_preemptions += len(evicted)
         return len(evicted)
 
@@ -743,7 +898,7 @@ class ServingEngine:
                 f"rank(s) {dead} dead and no survivor TP geometry exists "
                 f"(num_kv_heads {self.cfg.num_kv_heads}) — {reason}",
                 rank=dead[0]))
-        n_evicted = self._preempt_all()
+        n_evicted = self._preempt_all(evacuation=True)
         old_n = self.engine.n_total
         self.engine.repartition(sub, reason=reason)
         self._rebuild_device_state()
@@ -753,6 +908,10 @@ class ServingEngine:
                "reason": reason, "from_ranks": old_n,
                "to_ranks": self.engine.n_total, "preempted": n_evicted}
         self.fleet_log.append(rec)
+        self._flight_dump(
+            "evacuation", f"rank(s) {sorted(dead)} dead: {reason} "
+            f"({old_n} -> {self.engine.n_total} ranks, "
+            f"{n_evicted} preempted)")
         with obs_trace.span("fleet.evacuation", dead=str(sorted(dead)),
                             reason=reason, from_ranks=old_n,
                             to_ranks=self.engine.n_total,
@@ -789,6 +948,8 @@ class ServingEngine:
         rec = {"event": "rejoin", "from_ranks": old_n,
                "to_ranks": self.engine.n_total, "preempted": n_evicted}
         self.fleet_log.append(rec)
+        self.flight.note("rejoin", f"rejoined full mesh ({old_n} -> "
+                         f"{self.engine.n_total} ranks)", self._iter)
         with obs_trace.span("fleet.rejoin", from_ranks=old_n,
                             to_ranks=self.engine.n_total,
                             preempted=n_evicted):
@@ -829,6 +990,10 @@ class ServingEngine:
             x, self._pf_cache = slice_fn(
                 eng.params, jnp.asarray(ids), self._pf_cache,
                 jnp.int32(start))
+        rt = obs_reqtrace.get_tracer()
+        if rt is not None:
+            rt.span(req.req_id, "prefill_slice", t0, self.clock(),
+                    start=start, tokens=len(real))
         req.prefill_pos = min(start + self.chunk, T)
         done = req.prefill_pos >= T
         if done:
@@ -883,10 +1048,24 @@ class ServingEngine:
             self._cache, self._pf_cache.k, self._pf_cache.v,
             jnp.asarray(pages, jnp.int32))
         req.advance(RequestState.RUNNING)
+        rt = obs_reqtrace.get_tracer()
+        if rt is not None:
+            rt.mark(req.req_id, "RUNNING", self.clock())
         if req.done:
             self._finish(req)
 
     def _finish(self, req: Request) -> None:
+        req.final_backend = self.engine.backend
+        rt = obs_reqtrace.get_tracer()
+        if rt is not None and rt.breakdown(req.req_id) is None:
+            # Requests that never decode (max_new_tokens == 1, or a
+            # mid-flight finish): their decomposition window closes at
+            # the first token — decode component 0 by construction.
+            end = (req.t_first_token if req.t_first_token is not None
+                   else self.clock())
+            bd = rt.close_window(req.req_id, end)
+            if bd is not None and self._observing():
+                self._publish_ttft_breakdown(bd)
         self.sched.finish(req, self.clock())
         self._finished.append(req)
         if self._observing():
@@ -978,6 +1157,18 @@ class ServingEngine:
         rolling rate, token append/finish) — one copy, so a dense-path
         change can never silently skip the persistent lane."""
         now = self.clock()
+        rt = obs_reqtrace.get_tracer()
+        if rt is not None:
+            backend = self.engine.backend
+            for req in ready:
+                rt.span(req.req_id, "decode_step", t0, now,
+                        backend=backend)
+                if rt.breakdown(req.req_id) is None:
+                    # This request's FIRST decode step: close its TTFT
+                    # decomposition window and publish the components.
+                    bd = rt.close_window(req.req_id, now)
+                    if bd is not None and self._observing():
+                        self._publish_ttft_breakdown(bd)
         if self._observing():
             reg = obs_metrics.registry()
             reg.counter("tdtpu_tokens_generated_total",
@@ -1004,6 +1195,13 @@ class ServingEngine:
         reg.gauge(obs_metrics.SERVE_ACTIVE,
                   "requests prefilling or decoding"
                   ).set(self.sched.active_count)
+        reg.gauge(obs_metrics.SERVE_RUNNING_SLOTS,
+                  "decode slots occupied by RUNNING sequences this "
+                  "iteration").set(len(self.sched.running()))
+        usable = max(self.sched.allocator.usable_pages, 1)
+        reg.gauge(obs_metrics.KV_POOL_OCCUPANCY,
+                  "fraction of usable KV pool pages currently allocated"
+                  ).set(1.0 - self.sched.allocator.free_count / usable)
         reg.gauge(obs_metrics.SERVE_ADMIT_CAP,
                   "SLO-driven admission width (slots)"
                   ).set(self.sched.admit_cap)
@@ -1064,10 +1262,22 @@ class ServingEngine:
             self._clean_streak += 1
             self._viol_streak = 0
         if self._viol_streak >= _env_int("TDTPU_ADMIT_SHRINK_AFTER", 2):
+            old_cap = self.sched.admit_cap
             cap = self.sched.shrink_admission()
             self._viol_streak = 0
             with obs_trace.span("serving.admission_shrink", cap=cap):
                 pass
+            violated = [r["rule"] for r in section.get("rules", ())
+                        if r.get("status") == "violation"]
+            reason = (f"violation streak shrank admission to {cap} "
+                      f"(rules: {', '.join(violated) or 'unknown'})")
+            if cap < old_cap:
+                self._flight_dump("slo_violation", reason)
+            else:
+                # Cap already at the floor: one dump per actual
+                # narrowing, not one per streak — the chain still
+                # records that the violations kept coming.
+                self.flight.note("slo_violation", reason, self._iter)
         elif self._clean_streak >= _env_int("TDTPU_ADMIT_GROW_AFTER", 4):
             if self.sched.admit_cap < self.sched.num_slots:
                 cap = self.sched.grow_admission()

@@ -83,6 +83,9 @@ class Request:
     kv_len: int = 0                  # positions currently in the paged pool
     prefill_pos: int = 0             # tokens of ``text`` prefilled (attempt)
     preemptions: int = 0
+    migrations: int = 0              # completed KV migrations (disagg tier)
+    evacuations: int = 0             # fleet preempt-alls this request rode
+    final_backend: str | None = None  # engine backend at finish time
     arrival_seq: int = -1            # admission order stamp (scheduler)
 
     t_arrival: float | None = None

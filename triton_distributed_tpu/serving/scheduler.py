@@ -53,7 +53,7 @@ class Scheduler:
 
     def __init__(self, *, num_slots: int, allocator: PageAllocator,
                  page_size: int, capacity_tokens: int,
-                 max_waiting: int = 64):
+                 max_waiting: int = 64, on_event=None):
         if num_slots < 1:
             raise SchedulerConfigError(
                 f"num_slots = {num_slots} invalid: the decode batch needs "
@@ -73,11 +73,30 @@ class Scheduler:
         self.page_size = page_size
         self.capacity_tokens = capacity_tokens
         self.max_waiting = max_waiting
+        # Lifecycle observer (ISSUE 13): called as on_event(req, kind)
+        # for kind in {"prefilling", "preempted", "finished"} right
+        # after the transition lands. The serving loop timestamps these
+        # into the request tracer (obs/reqtrace.py); a failing observer
+        # must never break scheduling, so calls are exception-guarded.
+        self.on_event = on_event
         self.admit_cap = num_slots       # SLO-driven admission width
         self.waiting: list[Request] = []
         self.active: list[Request] = []  # PREFILLING + RUNNING, admit order
         self._free_slots = set(range(num_slots))
         self._seq = 0
+
+    def _notify(self, req: Request, kind: str) -> None:
+        if self.on_event is None:
+            return
+        try:
+            self.on_event(req, kind)
+        except Exception as exc:
+            import warnings
+
+            warnings.warn(
+                f"scheduler on_event observer failed for {req.req_id} "
+                f"({kind}): {type(exc).__name__}: {exc}", RuntimeWarning,
+                stacklevel=3)
 
     # -- views --------------------------------------------------------------
     @property
@@ -150,6 +169,7 @@ class Scheduler:
             req.advance(RequestState.PREFILLING)
             self.active.append(req)
             admitted.append(req)
+            self._notify(req, "prefilling")
         return admitted
 
     def prefill_head(self) -> Request | None:
@@ -173,6 +193,7 @@ class Scheduler:
         req.advance(RequestState.PREEMPTED)
         self.active.remove(req)
         self.waiting.append(req)
+        self._notify(req, "preempted")
 
     def _victim(self) -> Request | None:
         """Lowest priority, then youngest (latest admission) — the
@@ -221,6 +242,7 @@ class Scheduler:
         req.advance(RequestState.FINISHED)
         if req in self.active:
             self.active.remove(req)
+        self._notify(req, "finished")
 
     # -- SLO-driven admission width ------------------------------------------
     def shrink_admission(self) -> int:
