@@ -941,6 +941,22 @@ def _serving_metric():
         out["serve_ttft_p99_ms_fp8kv"] = f8["serve_ttft_p99_ms"]
     except Exception as e:
         out["serving_fp8kv_error"] = f"{type(e).__name__}: {str(e)[:120]}"
+    # Round 14: the speculative-decode rung (spec_k=4 prompt-lookup
+    # drafts over fp8-KV pools — the draft-and-verify launch spends the
+    # freed decode bandwidth on accepted tokens) races the one-token
+    # rung in the same window; the ledger counts ACCEPTED tokens only
+    # and the measured accept rate rides alongside. Additive.
+    try:
+        sp = serving_bench_rung(n_streams=8, prompt_len=128, max_new=16,
+                                kv_dtype=jnp.float8_e4m3fn, spec_k=4)
+        out["serve_tokens_per_s_spec"] = \
+            sp["serve_tokens_per_s_concurrent"]
+        out["serve_ttft_p99_ms_spec"] = sp["serve_ttft_p99_ms"]
+        out["spec_accept_rate"] = sp["spec_accept_rate"]
+        out["spec_drafted_tokens"] = sp["spec_drafted_tokens"]
+        out["spec_accepted_tokens"] = sp["spec_accepted_tokens"]
+    except Exception as e:
+        out["serving_spec_error"] = f"{type(e).__name__}: {str(e)[:120]}"
     # Round 10: the disaggregated tier races the monolithic rung in the
     # same window (`serve_tokens_per_s_disagg` — prefill role on chip 0,
     # decode role on chip 1, checksummed KV-migration streams included

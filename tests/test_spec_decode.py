@@ -1,0 +1,470 @@
+"""Speculative multi-token decode lane (ISSUE 14, docs/serving.md
+"Speculative decode").
+
+The load-bearing contract: greedy draft-and-verify serving
+(``spec_k > 0``) must be TOKEN-IDENTICAL to one-token decode on both
+the xla and megakernel backends — including preempt/resume — while
+rejected drafts never leave KV bytes resident (pool occupancy returns
+to the one-token baseline after every iteration's rollback) and a
+transient fault inside a verify step falls the lane back to one-token
+decode instead of dying.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.models.config import ModelConfig, tiny_config
+from triton_distributed_tpu.models.dense import (
+    dense_decode_step_paged, dense_verify_step_paged, init_dense_llm,
+)
+from triton_distributed_tpu.models.engine import Engine
+from triton_distributed_tpu.models.kv_cache import (
+    PageAllocator, init_paged_model_cache,
+)
+from triton_distributed_tpu.models.sampling import accept_longest_prefix
+from triton_distributed_tpu.obs import metrics as obs_metrics
+from triton_distributed_tpu.runtime import initialize_distributed
+from triton_distributed_tpu.serving.loop import ServingEngine
+from triton_distributed_tpu.serving.spec import NGramProposer, SpecConfigError
+
+
+@pytest.fixture(scope="module")
+def ctx1():
+    return initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                  devices=jax.devices()[:1])
+
+
+@pytest.fixture(scope="module")
+def tiny(ctx1):
+    cfg = tiny_config()
+    params = init_dense_llm(jax.random.key(0), cfg)
+    return cfg, params
+
+
+# ---------------------------------------------------------------------------
+# accept_longest_prefix — the one rule both backends share.
+# ---------------------------------------------------------------------------
+
+def test_accept_empty_draft_takes_base_token():
+    assert accept_longest_prefix([], [7]).tolist() == [7]
+
+
+def test_accept_full_window():
+    assert accept_longest_prefix([3, 4], [3, 4, 9]).tolist() == [3, 4, 9]
+
+
+def test_accept_first_token_reject():
+    assert accept_longest_prefix([5, 4], [3, 4, 9]).tolist() == [3]
+
+
+def test_accept_partial_prefix():
+    assert accept_longest_prefix([3, 6, 1], [3, 4, 9, 2]).tolist() == [3, 4]
+
+
+def test_accept_dtype_and_size_contract():
+    out = accept_longest_prefix(np.array([3], np.int64),
+                                np.array([3, 9], np.int64))
+    assert out.dtype == np.int32
+    with pytest.raises(ValueError, match="k\\+1 positions"):
+        accept_longest_prefix([1, 2], [1, 2])
+
+
+# ---------------------------------------------------------------------------
+# NGramProposer — deterministic self-drafting.
+# ---------------------------------------------------------------------------
+
+def test_proposer_copies_most_recent_continuation():
+    p = NGramProposer(3, ngram=2)
+    # ... 5 6 A B C ... 5 6 -> proposes A B C (the continuation of the
+    # most recent EARLIER occurrence of the trailing bigram).
+    hist = [1, 5, 6, 7, 8, 9, 2, 5, 6]
+    assert p.propose(hist) == [7, 8, 9]
+
+
+def test_proposer_recency_wins():
+    p = NGramProposer(1, ngram=1)
+    hist = [4, 10, 3, 4, 20, 4]
+    assert p.propose(hist) == [20]       # the later occurrence's successor
+
+
+def test_proposer_no_match_is_empty_and_deterministic():
+    p = NGramProposer(3, ngram=3, min_ngram=3)
+    assert p.propose([1, 2, 3, 4]) == []
+    hist = [1, 5, 6, 7, 2, 5, 6]
+    assert p.propose(hist) == p.propose(hist)
+
+
+def test_proposer_cap_and_validation():
+    p = NGramProposer(4, ngram=1)
+    assert p.propose([9, 1, 2, 3, 4, 9], max_tokens=2) == [1, 2]
+    assert p.propose([9, 1, 2, 3, 4, 9], max_tokens=0) == []
+    with pytest.raises(SpecConfigError, match="spec_k=0 disables"):
+        NGramProposer(0)
+    with pytest.raises(SpecConfigError, match="min_ngram"):
+        NGramProposer(2, ngram=1, min_ngram=3)
+
+
+# ---------------------------------------------------------------------------
+# PageAllocator.free_tail — the rollback primitive.
+# ---------------------------------------------------------------------------
+
+def test_free_tail_releases_allocation_order_tail():
+    a = PageAllocator(8, 6)
+    a.alloc_pages("r", 5)
+    held = a.pages("r")
+    assert a.free_tail("r", 3) == 2
+    assert a.pages("r") == held[:3]
+    assert a.free_count == 5
+    assert a.free_tail("r", 3) == 0          # idempotent
+    assert a.free_tail("ghost", 0) == 0      # unknown owner is a no-op
+    with pytest.raises(ValueError, match="non-negative"):
+        a.free_tail("r", -1)
+
+
+def test_paged_append_window_drops_past_capacity_without_aliasing():
+    """Padding rows past capacity must DROP, not clamp onto the last
+    in-capacity position: a clamped duplicate index could overwrite the
+    final real candidate's just-appended k/v with the stale pre-step
+    value (scatter order over duplicate indices is undefined)."""
+    from triton_distributed_tpu.ops.paged_attention import (
+        init_paged_kv_cache, paged_append, paged_append_window,
+    )
+
+    cache = init_paged_kv_cache(1, num_pages=2, page_size=4,
+                                num_kv_heads=1, head_dim=8, max_pages=2)
+    cache = cache._replace(kv_lens=jnp.asarray([6], jnp.int32))
+    k = jax.random.normal(jax.random.key(3), (1, 3, 1, 8))
+    v = jax.random.normal(jax.random.key(4), (1, 3, 1, 8))
+    # Window of 3 at base 6 over capacity 8: positions 6, 7 real, 8 OOB.
+    out = paged_append_window(cache, k, v)
+    assert int(out.kv_lens[0]) == 8
+    # Sequential golden: two in-capacity appends, third dropped.
+    seq = cache
+    for i in range(3):
+        seq = paged_append(seq, k[:, i], v[:, i])
+    np.testing.assert_array_equal(np.asarray(out.k_pool),
+                                  np.asarray(seq.k_pool))
+    np.testing.assert_array_equal(np.asarray(out.v_pool),
+                                  np.asarray(seq.v_pool))
+
+
+# ---------------------------------------------------------------------------
+# The dense verify step — bit-parity with sequential one-token decode.
+# ---------------------------------------------------------------------------
+
+def test_verify_step_matches_sequential_paged_decode(tiny):
+    cfg, params = tiny
+    B, W, page, mp = 2, 3, 4, 8
+    cache = init_paged_model_cache(cfg, B, page_size=page, max_pages=mp)
+    k1, k2 = jax.random.split(jax.random.key(1))
+    cache = cache._replace(
+        k_pools=jax.random.normal(k1, cache.k_pools.shape,
+                                  cache.k_pools.dtype),
+        v_pools=jax.random.normal(k2, cache.v_pools.shape,
+                                  cache.v_pools.dtype),
+        kv_lens=jnp.asarray([5, 9], jnp.int32))   # heterogeneous lengths
+    toks = np.array([[3, 11, 7], [20, 5, 5]], np.int32)
+
+    c_seq = cache
+    seq_logits = []
+    for i in range(W):
+        lg, c_seq = dense_decode_step_paged(
+            params, cfg, jnp.asarray(toks[:, i]), c_seq, num_ranks=1,
+            mode="ar")
+        seq_logits.append(np.asarray(lg))
+    ver, c_ver = dense_verify_step_paged(params, cfg, jnp.asarray(toks),
+                                         cache, num_ranks=1, mode="ar")
+    ver = np.asarray(ver)
+    for i in range(W):
+        np.testing.assert_allclose(ver[:, i], seq_logits[i],
+                                   rtol=2e-6, atol=2e-6)
+        assert (ver[:, i].argmax(-1) == seq_logits[i].argmax(-1)).all()
+    # The appended pool state is byte-identical: the serving rollback's
+    # append-then-truncate depends on the stored values matching W
+    # sequential appends exactly.
+    np.testing.assert_array_equal(np.asarray(c_ver.k_pools),
+                                  np.asarray(c_seq.k_pools))
+    np.testing.assert_array_equal(np.asarray(c_ver.v_pools),
+                                  np.asarray(c_seq.v_pools))
+    np.testing.assert_array_equal(np.asarray(c_ver.kv_lens),
+                                  np.asarray(c_seq.kv_lens))
+
+
+# ---------------------------------------------------------------------------
+# The serving lane — parity, rollback, fallback, records.
+# ---------------------------------------------------------------------------
+
+def _golden(engine, trace):
+    out = {}
+    for item in trace:
+        toks = engine.serve(jnp.asarray([item["prompt"]], jnp.int32),
+                            gen_len=item["max_new_tokens"])
+        out[item["req_id"]] = np.asarray(toks)[0].tolist()
+    return out
+
+
+def _serve_with_occupancy_check(se, trace):
+    reqs = {}
+    pending = sorted(trace, key=lambda t: t["arrival_iter"])
+    it = 0
+    stale = 0
+    while pending or se.sched.has_work():
+        still = []
+        for item in pending:
+            if item["arrival_iter"] > it:
+                still.append(item)
+                continue
+            req, res = se.submit(item["prompt"], item["max_new_tokens"],
+                                 priority=item.get("priority", 0),
+                                 req_id=item["req_id"])
+            assert res.name == "ADMITTED", res
+            reqs[req.req_id] = req
+        pending = still
+        se.step()
+        for r in se.sched.running():
+            held = len(se.sched.allocator.pages(r.req_id))
+            if held != -(-r.kv_len // se.page):
+                stale += 1
+        it += 1
+        assert it < 10_000
+    return reqs, stale
+
+
+def test_spec_serving_token_parity_xla_with_preemption(ctx1, tiny):
+    cfg, params = tiny
+    engine = Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                    page_size=4)
+    # Repetitive prompts (lookup drafting's traffic shape) + a pool
+    # sized to force eviction while candidate windows are in flight.
+    trace = [
+        {"req_id": "sp-0", "arrival_iter": 0, "prompt": [3, 9] * 4,
+         "max_new_tokens": 12, "priority": 1},
+        {"req_id": "sp-1", "arrival_iter": 0, "prompt": [7] * 5,
+         "max_new_tokens": 8},
+        {"req_id": "sp-2", "arrival_iter": 1, "prompt": [11, 4] * 3,
+         "max_new_tokens": 8},
+    ]
+    golden = _golden(engine, trace)
+    se = ServingEngine(engine, max_batch=3, num_pages=7, prefill_chunk=4,
+                       spec_k=2)
+    reqs, stale = _serve_with_occupancy_check(se, trace)
+    assert all(r.tokens == golden[rid] for rid, r in reqs.items()), \
+        {rid: (r.tokens, golden[rid]) for rid, r in reqs.items()}
+    assert any(r.preemptions > 0 for r in reqs.values()), \
+        "pool sizing no longer forces a preemption mid-spec"
+    assert stale == 0, "rollback left pages beyond the accepted prefix"
+    assert se.sched.allocator.free_count == se.sched.allocator.usable_pages
+    assert sum(r.drafted_tokens for r in reqs.values()) > 0
+    assert sum(r.accepted_draft_tokens for r in reqs.values()) > 0, \
+        "nothing accepted — the lane degenerated to one-token decode"
+    assert not se._spec_fallback
+
+
+def test_spec_serving_accepts_multiple_tokens_per_step(ctx1, tiny):
+    cfg, params = tiny
+    engine = Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                    page_size=4)
+    trace = [{"req_id": "cyc-0", "arrival_iter": 0,
+              "prompt": [3, 9, 3, 9, 3, 9], "max_new_tokens": 24}]
+    golden = _golden(engine, trace)
+    se = ServingEngine(engine, max_batch=2, num_pages=16, prefill_chunk=4,
+                       spec_k=3)
+    reqs, _ = _serve_with_occupancy_check(se, trace)
+    r = reqs["cyc-0"]
+    assert r.tokens == golden["cyc-0"]
+    # 24 tokens in strictly fewer decode iterations than one-token needs
+    # — i.e. at least one step accepted more than one token.
+    assert r.accepted_draft_tokens > 0
+
+
+def test_spec_k0_keeps_the_one_token_path(ctx1, tiny):
+    cfg, params = tiny
+    engine = Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                    page_size=4)
+    se = ServingEngine(engine, max_batch=2, prefill_chunk=4, spec_k=0)
+    assert se._proposer is None and not se._spec_enabled()
+    req, _ = se.submit([3, 9, 3, 9], 4)
+    se.run()
+    assert req.drafted_tokens == 0 and req.accepted_draft_tokens == 0
+    assert ("verify", 1) not in se._jits
+
+
+def test_spec_fallback_on_transient_verify_fault(ctx1, tiny):
+    from triton_distributed_tpu.resilience import FaultInjectionError
+
+    cfg, params = tiny
+    engine = Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                    page_size=4)
+    trace = [
+        {"req_id": "fb-0", "arrival_iter": 0, "prompt": [3, 9] * 4,
+         "max_new_tokens": 8},
+        {"req_id": "fb-1", "arrival_iter": 0, "prompt": [7] * 5,
+         "max_new_tokens": 6},
+    ]
+    golden = _golden(engine, trace)
+    se = ServingEngine(engine, max_batch=2, num_pages=16, prefill_chunk=4,
+                       spec_k=2)
+    real = se._verify_jit
+    fired = {"n": 0}
+
+    def faulty():
+        fn = real()
+
+        def wrapper(*a, **kw):
+            if fired["n"] == 0:
+                fired["n"] += 1
+                raise FaultInjectionError("test: verify fault")
+            return fn(*a, **kw)
+
+        return wrapper
+
+    se._verify_jit = faulty
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        reqs, _ = _serve_with_occupancy_check(se, trace)
+    assert fired["n"] == 1
+    assert se._spec_fallback, "verify fault did not fall back"
+    assert all(r.tokens == golden[rid] for rid, r in reqs.items())
+
+
+def test_spec_nontransient_verify_error_propagates(ctx1, tiny):
+    cfg, params = tiny
+    engine = Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                    page_size=4)
+    se = ServingEngine(engine, max_batch=2, prefill_chunk=4, spec_k=2)
+
+    def boom():
+        def wrapper(*a, **kw):
+            raise ValueError("not transient")
+
+        return wrapper
+
+    se._verify_jit = boom
+    se.submit([3, 9, 3, 9], 6)
+    with pytest.raises(ValueError, match="not transient"):
+        se.run()
+
+
+def test_spec_config_validation(ctx1, tiny):
+    from triton_distributed_tpu.serving.loop import ServingConfigError
+
+    cfg, params = tiny
+    engine = Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                    page_size=4)
+    with pytest.raises(ServingConfigError, match="spec_k"):
+        ServingEngine(engine, max_batch=2, spec_k=-1)
+
+
+def test_spec_metrics_and_report_gate(ctx1, tiny, tmp_path):
+    from triton_distributed_tpu import obs
+    from triton_distributed_tpu.obs import report as obs_report
+
+    cfg, params = tiny
+    engine = Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                    page_size=4)
+    run_dir = str(tmp_path / "spec-run")
+    obs.start_run(run_dir)
+    try:
+        se = ServingEngine(engine, max_batch=2, num_pages=16,
+                           prefill_chunk=4, spec_k=2)
+        se.submit([3, 9] * 4, 10, req_id="m-0")
+        se.run()
+        snap = obs_metrics.registry().snapshot()
+    finally:
+        obs.finish_run()
+    assert obs_metrics.SPEC_DRAFT_TOKENS in snap
+    assert obs_metrics.SPEC_ACCEPTED_TOKENS in snap
+    assert obs_metrics.SPEC_ACCEPT_RATE in snap
+    assert snap[obs_metrics.SPEC_DRAFT_TOKENS]["value"] > 0
+    # The report renders the spec lane and --check passes the snapshot.
+    rc = obs_report.main([run_dir, "--check"])
+    assert rc == 0
+
+
+def test_spec_serving_token_parity_disagg(tiny):
+    """Spec decode composes with the disaggregated tier: drafting starts
+    only after a request is RUNNING on the decode role, so the KV
+    migration stream never sees draft state — parity must hold across
+    the full prefill → migrate → spec-decode round-trip."""
+    from triton_distributed_tpu.disagg import (
+        DisaggServingEngine, role_contexts,
+    )
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 virtual CPU devices")
+    cfg, params = tiny
+    pctx, dctx = role_contexts(jax.devices()[:2])
+    pe = Engine(cfg, params, pctx, backend="xla", max_seq=64)
+    de = Engine(cfg, params, dctx, backend="xla", max_seq=64, page_size=4)
+    oracle = Engine(cfg, params, pctx, backend="xla", max_seq=64,
+                    page_size=4)
+    trace = [
+        {"req_id": "dsp-0", "arrival_iter": 0, "prompt": [3, 9] * 4,
+         "max_new_tokens": 10, "priority": 1},
+        {"req_id": "dsp-1", "arrival_iter": 1, "prompt": [7] * 6,
+         "max_new_tokens": 6},
+    ]
+    golden = _golden(oracle, trace)
+    se = DisaggServingEngine(pe, de, max_batch=2, num_pages=8,
+                             prefill_chunk=4, block_pages=1, spec_k=2)
+    reqs, stale = _serve_with_occupancy_check(se, trace)
+    assert se.disagg_active, se.demotion_reason
+    assert all(r.tokens == golden[rid] for rid, r in reqs.items()), \
+        {rid: (r.tokens, golden[rid]) for rid, r in reqs.items()}
+    assert len(se.migrations_log) >= 2
+    assert stale == 0
+    assert sum(r.drafted_tokens for r in reqs.values()) > 0
+
+
+# ---------------------------------------------------------------------------
+# The megakernel draft-and-verify lane.
+# ---------------------------------------------------------------------------
+
+MK_CFG = ModelConfig(hidden_size=256, intermediate_size=256, num_layers=2,
+                     num_heads=2, num_kv_heads=1, head_dim=128,
+                     vocab_size=512, qk_norm=True, dtype="float32")
+
+
+def test_spec_window_program_validation():
+    from triton_distributed_tpu.megakernel.models import build_decode_step
+
+    kw = dict(hidden=256, hq_local=2, hkv_local=1, ffn_local=256,
+              num_layers=1, max_seq=256, pos=255)
+    with pytest.raises(ValueError, match="pool form"):
+        build_decode_step(spec_window=2, **kw)
+    with pytest.raises(ValueError, match="out of range"):
+        build_decode_step(spec_window=200, paged=True,
+                          inkernel_append=True, batch=128,
+                          kv_pool_pages=3, table_pages=2, **kw)
+
+
+def test_spec_serving_token_parity_megakernel(ctx1):
+    params = init_dense_llm(jax.random.PRNGKey(1), MK_CFG)
+    rng = np.random.default_rng(9)
+    pat = rng.integers(0, 512, 7).tolist()
+    trace = [
+        {"req_id": "mksp-0", "arrival_iter": 0,
+         "prompt": (pat * 19)[:126], "max_new_tokens": 8, "priority": 1},
+        {"req_id": "mksp-1", "arrival_iter": 0,
+         "prompt": (pat * 16)[:100], "max_new_tokens": 6},
+    ]
+    oracle = Engine(MK_CFG, params, ctx1, backend="xla", max_seq=256)
+    golden = _golden(oracle, trace)
+    eng = Engine(MK_CFG, params, ctx1, backend="megakernel", max_seq=256,
+                 page_size=128)
+    se = ServingEngine(eng, max_batch=2, num_pages=2, prefill_chunk=128,
+                       spec_k=2)
+    assert se._mk is not None and se._mk.spec_w == 3
+    reqs, stale = _serve_with_occupancy_check(se, trace)
+    assert se._mk is not None and eng.backend == "megakernel", \
+        "megakernel spec lane silently demoted"
+    assert all(r.tokens == golden[rid] for rid, r in reqs.items()), \
+        {rid: (r.tokens, golden[rid]) for rid, r in reqs.items()}
+    assert any(r.preemptions > 0 for r in reqs.values())
+    assert stale == 0
+    assert sum(r.drafted_tokens for r in reqs.values()) > 0

@@ -336,6 +336,59 @@ def tp_attn_decode_paged(params: dict, cfg: ModelConfig, x: jax.Array,
                      ar_fn=ar_fn), cache
 
 
+def tp_attn_verify_paged(params: dict, cfg: ModelConfig, x: jax.Array,
+                         cache, window: int, *, axis: str = "tp",
+                         num_ranks: int = 1, mode: str = "ar",
+                         inter_axis: str = "dcn", n_inter: int = 1,
+                         ar_fn=None):
+    """Speculative VERIFY attention over a paged KV cache: ``window``
+    consecutive candidate positions per sequence score in one call
+    (docs/serving.md "Speculative decode"). x: (B·window, h) — row
+    ``b·window + i`` is sequence b's candidate i (the last accepted
+    token at i = 0, draft tokens after). All window k/v append at
+    ``[kv_lens, kv_lens + window)`` first (append-then-attend, the same
+    order as the one-token step), then each candidate row attends as its
+    OWN virtual sequence — the page table tiled ``window`` times with
+    per-row valid lengths ``kv_lens + i + 1`` — so row i's math is
+    bit-identical to the one-token decode step at that position (causal
+    within the candidate window by construction). The host truncates
+    ``kv_lens`` back to the accepted prefix after scoring.
+
+    ``window`` = 1 is exactly :func:`tp_attn_decode_paged`. Returns
+    (out (B·window, h), cache advanced by ``window``)."""
+    from triton_distributed_tpu.ops.paged_attention import (
+        PagedKVCache, paged_append_window, paged_decode_attention,
+    )
+
+    n = num_ranks
+    rows = x.shape[0]
+    batch = rows // window
+    base = cache.kv_lens                                   # (B,)
+    pos = (base[:, None]
+           + jnp.arange(window, dtype=jnp.int32)[None, :]).reshape(-1)
+    q, k, v = _project_qkv(params, cfg, x, rows, 1,
+                           axis=axis, n=n, mode="ar")
+    cos, sin = rope_cos_sin(pos, cfg.head_dim, cfg.rope_theta)
+    q = apply_rope(q, cos[:, None], sin[:, None])
+    k = apply_rope(k, cos[:, None], sin[:, None])
+
+    hkv, d = k.shape[2], k.shape[3]
+    cache = paged_append_window(
+        cache, k[:, 0].reshape(batch, window, hkv, d),
+        v[:, 0].reshape(batch, window, hkv, d))
+    capacity = cache.page_table.shape[1] * cache.page_size
+    virtual = PagedKVCache(
+        cache.k_pool, cache.v_pool,
+        jnp.repeat(cache.page_table, window, axis=0),
+        jnp.minimum(pos + 1, capacity))
+    attn = paged_decode_attention(q[:, 0], virtual)        # (B·W, hq, d)
+    attn = attn.reshape(rows, -1).astype(x.dtype)
+
+    return _out_proj(attn, params, axis=axis, n=n, mode=mode,
+                     inter_axis=inter_axis, n_inter=n_inter,
+                     ar_fn=ar_fn), cache
+
+
 def tp_attn_decode(params: dict, cfg: ModelConfig, x: jax.Array,
                    kv_slice: KVSlice, pos: jax.Array, *,
                    axis: str = "tp", num_ranks: int = 1, mode: str = "ar",

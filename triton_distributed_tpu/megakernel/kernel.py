@@ -444,22 +444,64 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
         # k_new row 0 -> column c0 of kT cache tile ``out``; v_new row 0 ->
         # row c0 of v cache tile ``b0``. Read-modify-write of the two cache
         # tiles; the scheduler's WAR edges order it after every attention
-        # task that read them this step.
-        load(a0, vq)           # k_new (B, d)
-        load(out, va)          # kT cache tile (d, TILE)
-        kcolT = vq[...].astype(jnp.float32).T    # (d, B); col 0 = row 0
-        cols = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 1)
-        va[...] = jnp.where(cols == c0,
-                            jnp.broadcast_to(kcolT[:, 0:1], (TILE, TILE)),
-                            va[...].astype(jnp.float32)).astype(wdt)
-        store(va, out)
-        load(d0, vq)           # v_new (B, d)
-        load(b0, va)           # v cache tile (TILE, d)
-        rows = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 0)
-        va[...] = jnp.where(rows == c0,
-                            jnp.broadcast_to(vq[0:1, :], (TILE, TILE)),
-                            va[...].astype(jnp.float32)).astype(wdt)
-        store(va, b0)
+        # task that read them this step. Speculative window form (queue
+        # word 4 = count n >= 1, word 7 = source row offset s): k_new rows
+        # s..s+n-1 land at columns c0..c0+n-1 (v rows likewise) — a
+        # page-spanning window splits into two rows, the spill row skipped
+        # via c0 < 0 when the window stays inside one page tile.
+        @pl.when(c0 >= 0)
+        def _():
+            cnt, src = k_tiles, arg
+            rowio = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 0)
+            colio = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 1)
+            load(a0, vq)           # k_new (B, d)
+            load(out, va)          # kT cache tile (d, TILE)
+            kT_new = vq[...].astype(jnp.float32).T   # (d, B); col j = row j
+
+            @pl.when(cnt == 0)
+            def _():               # legacy single-row append (row 0)
+                va[...] = jnp.where(
+                    colio == c0,
+                    jnp.broadcast_to(kT_new[:, 0:1], (TILE, TILE)),
+                    va[...].astype(jnp.float32)).astype(wdt)
+
+            @pl.when(cnt > 0)
+            def _():
+                # Permutation matmul: destination col c takes source row
+                # (c - c0 + src); exact — one 1.0 term per column.
+                sel = ((rowio == colio - c0 + src) & (colio >= c0)
+                       & (colio < c0 + cnt)).astype(jnp.float32)
+                new_cols = jnp.dot(kT_new, sel,
+                                   preferred_element_type=jnp.float32)
+                va[...] = jnp.where((colio >= c0) & (colio < c0 + cnt),
+                                    new_cols,
+                                    va[...].astype(jnp.float32)
+                                    ).astype(wdt)
+
+            store(va, out)
+            load(d0, vq)           # v_new (B, d)
+            load(b0, va)           # v cache tile (TILE, d)
+            vf = vq[...].astype(jnp.float32)
+
+            @pl.when(cnt == 0)
+            def _():
+                va[...] = jnp.where(
+                    rowio == c0,
+                    jnp.broadcast_to(vf[0:1, :], (TILE, TILE)),
+                    va[...].astype(jnp.float32)).astype(wdt)
+
+            @pl.when(cnt > 0)
+            def _():
+                sel = ((colio == rowio - c0 + src) & (rowio >= c0)
+                       & (rowio < c0 + cnt)).astype(jnp.float32)
+                new_rows = jnp.dot(sel, vf,
+                                   preferred_element_type=jnp.float32)
+                va[...] = jnp.where((rowio >= c0) & (rowio < c0 + cnt),
+                                    new_rows,
+                                    va[...].astype(jnp.float32)
+                                    ).astype(wdt)
+
+            store(va, b0)
 
     def t_append_kv_f8():
         # APPEND_KV into the fp8 KV-pool workspace (round 12): the new
@@ -468,30 +510,61 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
         # append SATURATES to e4m3's ±448 finite range — the
         # models/fp8._to_e4m3 contract; a plain cast would NaN one hot
         # KV element and poison every later softmax over the page.
+        # Speculative window form: same word contract as t_append_kv
+        # (word 4 = count, word 7 = source offset, c0 < 0 skips the row).
         lim = float(jnp.finfo(jnp.float8_e4m3fn).max)
 
-        def rmw(cache_tile, sel_iota_dim, new_row):
-            cp = pltpu.make_async_copy(wk8_out.at[cache_tile],
-                                       vkv8.at[0], copy_sem)
-            cp.start()
-            cp.wait()
-            sel = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE),
-                                           sel_iota_dim)
-            merged = jnp.where(sel == c0, new_row,
-                               vkv8[0].astype(jnp.float32))
-            vkv8[1, :, :] = jnp.clip(merged, -lim, lim).astype(
-                jnp.float8_e4m3fn)
-            cp2 = pltpu.make_async_copy(vkv8.at[1],
-                                        wk8_out.at[cache_tile], copy_sem)
-            cp2.start()
-            cp2.wait()
+        @pl.when(c0 >= 0)
+        def _():
+            cnt, src = k_tiles, arg
+            rowio = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 0)
+            colio = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 1)
 
-        load(a0, vq)           # k_new (B, d) — main workspace
-        kcolT = vq[...].astype(jnp.float32).T    # (d, B); col 0 = row 0
-        rmw(out, 1, jnp.broadcast_to(kcolT[:, 0:1], (TILE, TILE)))
-        load(d0, vq)           # v_new (B, d)
-        rmw(b0, 0, jnp.broadcast_to(vq[0:1, :].astype(jnp.float32),
-                                    (TILE, TILE)))
+            def rmw(cache_tile, write_mask, new_vals):
+                cp = pltpu.make_async_copy(wk8_out.at[cache_tile],
+                                           vkv8.at[0], copy_sem)
+                cp.start()
+                cp.wait()
+                merged = jnp.where(write_mask, new_vals,
+                                   vkv8[0].astype(jnp.float32))
+                vkv8[1, :, :] = jnp.clip(merged, -lim, lim).astype(
+                    jnp.float8_e4m3fn)
+                cp2 = pltpu.make_async_copy(vkv8.at[1],
+                                            wk8_out.at[cache_tile],
+                                            copy_sem)
+                cp2.start()
+                cp2.wait()
+
+            load(a0, vq)       # k_new (B, d) — main workspace
+            kT_new = vq[...].astype(jnp.float32).T
+
+            @pl.when(cnt == 0)
+            def _():           # legacy single-row append (row 0)
+                rmw(out, colio == c0,
+                    jnp.broadcast_to(kT_new[:, 0:1], (TILE, TILE)))
+
+            @pl.when(cnt > 0)
+            def _():
+                sel = ((rowio == colio - c0 + src) & (colio >= c0)
+                       & (colio < c0 + cnt)).astype(jnp.float32)
+                rmw(out, (colio >= c0) & (colio < c0 + cnt),
+                    jnp.dot(kT_new, sel,
+                            preferred_element_type=jnp.float32))
+
+            load(d0, vq)       # v_new (B, d)
+            vf = vq[...].astype(jnp.float32)
+
+            @pl.when(cnt == 0)
+            def _():
+                rmw(b0, rowio == c0,
+                    jnp.broadcast_to(vf[0:1, :], (TILE, TILE)))
+
+            @pl.when(cnt > 0)
+            def _():
+                sel = ((colio == rowio - c0 + src) & (rowio >= c0)
+                       & (rowio < c0 + cnt)).astype(jnp.float32)
+                rmw(b0, (rowio >= c0) & (rowio < c0 + cnt),
+                    jnp.dot(sel, vf, preferred_element_type=jnp.float32))
 
     def t_allreduce():
         # One-shot AR of tile ``out`` (reference tasks/allreduce.py, minus
@@ -665,14 +738,19 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
 
         jax.lax.fori_loop(0, hq + b_stride, hbody, 0)
 
-    def _attn_softmax(kt_of, v_of, kv8=False):
+    def _attn_softmax(kt_of, v_of, kv8=False, spec_words=False):
         """Shared online-softmax body: streams (kT_j, V_j) tile pairs by the
         given index functions, then folds in the current token (c0/d0).
         ``kv8``: pairs stream from the fp8 KV-pool workspace at half the
         bytes and WIDEN to fp32 in VMEM before the dots (the
         quantize-then-attend dequant point — accumulation stays fp32
         either way, so parity with the dense fp8-KV paged path is
-        exact)."""
+        exact). ``spec_words`` (the PAGED serving variants only): queue
+        word 5 carries the speculative-decode candidate WINDOW — 0 keeps
+        the legacy per-row diagonal fold (each batch row its own current
+        token), win >= 1 folds the block's fresh k/v CAUSALLY (row i
+        attends fresh rows j <= i, j < win — draft-and-verify, row 0
+        degenerating to the diagonal fold's row-0 math exactly)."""
         load(a0, vq)
         scale = arg.astype(jnp.float32) * 1e-6
         valid = b_stride
@@ -720,8 +798,7 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
                                                   ).astype(jnp.float32)
             return x
 
-        @pl.when(c0 >= 0)
-        def _():
+        def diag_fold():
             # Current token: per-row dot with each row's own k/v.
             load(c0, vb)                           # k_new: (B, d)
             s_cur = jnp.sum(vq[...].astype(jnp.float32) * cur_kv(),
@@ -732,6 +809,42 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
             load(d0, vb)                           # v_new: (B, d)
             vacc[...] = vacc[...] * corr + p_cur * cur_kv()
             vstat[:, :1] = l * corr + p_cur
+
+        def window_fold(win):
+            # Speculative verify: the block's fresh k/v (rows 0..win-1 of
+            # c0/d0 — the last accepted token plus the drafts) join the
+            # softmax CAUSALLY: candidate row i attends fresh rows j <= i.
+            # Masked entries underflow to exp(-1e30 - m) == 0.0 exactly,
+            # so win == 1 reproduces the diagonal fold's row-0 result
+            # bit-for-bit (one matched term plus exact zeros).
+            load(c0, vb)                           # k_new: (win.., d)
+            s_w = jnp.dot(vq[...].astype(jnp.float32), cur_kv().T,
+                          preferred_element_type=jnp.float32) * scale
+            rowio = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 0)
+            colio = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 1)
+            s_w = jnp.where((colio <= rowio) & (colio < win), s_w, neg)
+            m_new = jnp.maximum(m, jnp.max(s_w, axis=1, keepdims=True))
+            p_w = jnp.exp(s_w - m_new)
+            corr = jnp.exp(m - m_new)
+            load(d0, vb)                           # v_new: (win.., d)
+            vacc[...] = vacc[...] * corr + jnp.dot(
+                p_w, cur_kv(), preferred_element_type=jnp.float32)
+            vstat[:, :1] = l * corr + jnp.sum(p_w, axis=1, keepdims=True)
+
+        @pl.when(c0 >= 0)
+        def _():
+            if spec_words:
+                win = a_stride                     # w(5): 0 = legacy
+
+                @pl.when(win == 0)
+                def _():
+                    diag_fold()
+
+                @pl.when(win > 0)
+                def _():
+                    window_fold(win)
+            else:
+                diag_fold()
 
         @pl.when(c0 < 0)
         def _():
@@ -752,14 +865,15 @@ def _mega_kernel(n: int, axis: str, n_tasks: int, max_gqa: int,
         return of
 
     def t_attn_decode_paged():
-        _attn_softmax(_paged_table(0), _paged_table(1))
+        _attn_softmax(_paged_table(0), _paged_table(1), spec_words=True)
 
     def t_attn_decode_paged_f8():
         # The fp8-pool variant (round 12): identical table walk and
         # softmax, but every page tile DMA moves HALF the bytes from the
         # fp8 KV workspace and widens to fp32 in VMEM — the static dtype
         # branch (warm-spec pattern applied to storage dtype).
-        _attn_softmax(_paged_table(0), _paged_table(1), kv8=True)
+        _attn_softmax(_paged_table(0), _paged_table(1), kv8=True,
+                      spec_words=True)
 
     def t_attn_decode():
         # Single-token GQA decode for one q head: online-softmax flash

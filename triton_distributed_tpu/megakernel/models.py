@@ -296,7 +296,8 @@ def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
                        mat_prefetch: bool = False,
                        paged_tables: list[list[tuple[int, int]]] | None = None,
                        append_pos: int | None = None,
-                       meta_out: dict | None = None):
+                       meta_out: dict | None = None,
+                       spec_append: bool = False):
     """Emit one transformer layer's decode tasks (for ONE row block —
     build_decode_step loops blocks for batch > TILE).
 
@@ -423,6 +424,19 @@ def build_decode_layer(mb: MegaKernelBuilder, x: TensorHandle,
             if meta_out is not None:
                 meta_out.setdefault("append", []).append(
                     (tid, h.kT[kv].tile(0, 0), h.v[kv].tile(0, 0)))
+            if spec_append:
+                # Speculative draft-and-verify (docs/serving.md): a
+                # candidate window can SPAN two page tiles, so each kv
+                # head gets a second append row for the spill — the host
+                # retargets both per step (or parks the spill via
+                # c0 = -1); parked on scratch at build time like the
+                # primary, so the WAR edges vs this layer's attention
+                # reads are identical.
+                tid2 = mb.append_kv(h.kT[kv], h.v[kv], apos,
+                                    _col(h.k_new, kv), _col(h.v_new, kv))
+                if meta_out is not None:
+                    meta_out.setdefault("append", []).append(
+                        (tid2, h.kT[kv].tile(0, 0), h.v[kv].tile(0, 0)))
 
     nw, nout = out_norm if out_norm is not None else (None, None)
     x1 = mb.tensor(TILE, hidden)
@@ -506,7 +520,8 @@ def _check_decode_step_config(*, hidden, hq_local, hkv_local, ffn_local,
                               moe_experts, moe_topk,
                               fp8_weights=False,
                               inkernel_append=False, paged=False,
-                              kv_fp8=False, seq_blocks=False) -> None:
+                              kv_fp8=False, seq_blocks=False,
+                              spec_window=1) -> None:
     """Named build-time validation: every TILE/geometry constraint raises
     HERE, at build_decode_step time, naming the offending dimension AND
     the ModelConfig field it derives from — not later as an opaque tile
@@ -583,6 +598,27 @@ def _check_decode_step_config(*, hidden, hq_local, hkv_local, ffn_local,
                 "kv_fp8=True with MoE: the megakernel serving lane "
                 "covers the dense stack (validate_megakernel_cfg) — "
                 "config field num_experts")
+    if spec_window != 1:
+        # Speculative draft-and-verify (ISSUE 14): named surface for the
+        # unsupported combinations — the serving tier wraps these in
+        # BackendUnsupportedError and demotes rather than dying.
+        if not 1 <= spec_window <= TILE:
+            raise ValueError(
+                f"spec_window = {spec_window} out of range [1, {TILE}]: "
+                "the candidate window rides the rows of one slot's TILE "
+                "block — spec_k serving argument")
+        if not (paged and seq_blocks and inkernel_append):
+            raise ValueError(
+                f"spec_window = {spec_window} > 1 requires the paged "
+                "SERVING pool form (paged=True with kv_pool_pages and "
+                "in-kernel appends): the candidate window folds the "
+                "slot's fresh k/v causally and appends it through the "
+                "windowed APPEND_KV rows — spec_k serving argument")
+        if moe_experts:
+            raise ValueError(
+                f"spec_window = {spec_window} > 1 with MoE: the "
+                "megakernel serving lane covers the dense stack — "
+                "config field num_experts")
     if num_layers < 1:
         raise ValueError(f"num_layers = {num_layers} must be >= 1 — "
                          "config field num_layers")
@@ -620,7 +656,8 @@ def build_decode_step(*, hidden: int, hq_local: int, hkv_local: int,
                       mat_prefetch: bool = False,
                       kv_pool_pages: int | None = None,
                       table_pages: int | None = None,
-                      kv_fp8: bool = False) -> DecodeStepProgram:
+                      kv_fp8: bool = False,
+                      spec_window: int = 1) -> DecodeStepProgram:
     """Assemble a full num_layers decode step (per-device TP view).
 
     ``hq_local``/``hkv_local``/``ffn_local`` are this device's shards.
@@ -671,6 +708,14 @@ def build_decode_step(*, hidden: int, hq_local: int, hkv_local: int,
       APPEND_KV_F8 saturate-casts appends (±448 clamp, the
       models/fp8._to_e4m3 contract). Carry the kv8 workspace through
       every step alongside the main one.
+    * ``spec_window`` (round 14, docs/serving.md "Speculative decode"):
+      W > 1 compiles the serving pool form's draft-and-verify shape —
+      candidate rows 0..W-1 of each slot's TILE block score in one
+      launch (causal fresh-k/v window fold in the paged attention rows;
+      a second APPEND_KV row per kv head for page-boundary spills; the
+      live per-slot window rides queue words, so W = spec_k+1 is the
+      only compile-time commitment). W = 1 builds the exact pre-spec
+      program.
     """
     seq_blocks = kv_pool_pages is not None
     _check_decode_step_config(
@@ -679,7 +724,7 @@ def build_decode_step(*, hidden: int, hq_local: int, hkv_local: int,
         pos=pos, batch=batch, head_dim=head_dim, moe_experts=moe_experts,
         moe_topk=moe_topk, fp8_weights=fp8_weights,
         inkernel_append=inkernel_append, paged=paged,
-        kv_fp8=kv_fp8, seq_blocks=seq_blocks)
+        kv_fp8=kv_fp8, seq_blocks=seq_blocks, spec_window=spec_window)
     if seq_blocks and not paged:
         raise ValueError("kv_pool_pages (the serving pool form) requires "
                          "paged=True")
@@ -821,7 +866,8 @@ def build_decode_step(*, hidden: int, hq_local: int, hkv_local: int,
                 head_dim=head_dim, mat_prefetch=mat_prefetch,
                 paged_tables=tables,
                 append_pos=(scratch * TILE) if seq_blocks else None,
-                meta_out=block_meta[b] if block_meta is not None else None)
+                meta_out=block_meta[b] if block_meta is not None else None,
+                spec_append=spec_window > 1)
     outs = [curn[b] if final_norm else cur[b] for b in range(bt)]
     meta = None
     if paged:

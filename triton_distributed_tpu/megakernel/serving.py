@@ -414,11 +414,20 @@ class PagedMegakernelDecoder:
 
     def __init__(self, cfg: ModelConfig, params: dict, *, num_slots: int,
                  num_pages: int, max_pages: int, dtype=jnp.float32,
-                 kv_dtype=None, mat_prefetch: bool = True):
+                 kv_dtype=None, mat_prefetch: bool = True,
+                 spec_window: int = 1):
         capacity = max_pages * TILE
         validate_megakernel_cfg(cfg, capacity)
         if num_slots < 1:
             raise ValueError(f"num_slots = {num_slots} must be >= 1")
+        # spec_window (ISSUE 14, docs/serving.md "Speculative decode"):
+        # W = spec_k + 1 candidate rows per slot ride rows 0..W-1 of the
+        # slot's TILE block — ONE launch scores the last accepted token
+        # plus k drafts (causal window fold in ATTN_DECODE_PAGED{,_F8},
+        # windowed APPEND_KV{,_F8} rows). W = 1 builds the exact
+        # pre-spec program. Range/combos validated by
+        # _check_decode_step_config with named errors.
+        self.spec_w = int(spec_window)
         if num_pages < 1:
             raise ValueError(f"num_pages = {num_pages} must be >= 1")
         if max_pages < 1:
@@ -458,7 +467,7 @@ class PagedMegakernelDecoder:
             batch=num_slots * TILE, head_dim=cfg.head_dim,
             mat_prefetch=mat_prefetch,
             kv_pool_pages=num_pages + 1, table_pages=max_pages,
-            kv_fp8=self.kv_fp8)
+            kv_fp8=self.kv_fp8, spec_window=self.spec_w)
         self.comp = self.prog.mb.compile(dtype=dtype,
                                          head_dim=cfg.head_dim)
         self._weight_feeds = weight_feeds(self.prog, cfg, params)
@@ -565,18 +574,31 @@ class PagedMegakernelDecoder:
         return ws
 
     # -- per-step host retarget ---------------------------------------------
-    def _retarget(self, kv_lens, tables) -> jax.Array:
+    def _retarget(self, kv_lens, tables, wins=None) -> jax.Array:
         """Rewrite the compiled queue for this step's slot states:
         kv_lens (B,) ints; tables (B, <=max_pages) pool page ids per
-        slot (missing/negative entries ride the scratch page)."""
+        slot (missing/negative entries ride the scratch page); ``wins``
+        (spec programs only): per-slot candidate-window sizes in
+        [1, spec_window] — the step appends ``win`` positions and the
+        attention rows fold the fresh window causally."""
+        spec = self.spec_w > 1
+        if wins is None:
+            wins = [1] * self.num_slots
         q = self._base_queue.copy()
         for b in range(self.num_slots):
             kvl = int(kv_lens[b])
-            if kvl >= self.capacity:
+            win = int(wins[b])
+            if not 1 <= win <= self.spec_w:
                 raise ValueError(
-                    f"slot {b} kv_len {kvl} at capacity {self.capacity}: "
-                    "the step appends this position — evict or stop the "
-                    "sequence (serving scheduler contract)")
+                    f"slot {b} window {win} outside [1, {self.spec_w}] — "
+                    "the program was compiled for spec_window = "
+                    f"{self.spec_w}")
+            if kvl + win > self.capacity:
+                raise ValueError(
+                    f"slot {b} kv_len {kvl} (+ window {win}) at capacity "
+                    f"{self.capacity}: the step appends these positions "
+                    "— evict or stop the sequence (serving scheduler "
+                    "contract)")
             pages = [int(p) for p in tables[b] if int(p) >= 0]
             ktiles = -(-kvl // TILE)
             if ktiles > len(pages):
@@ -591,29 +613,63 @@ class PagedMegakernelDecoder:
             for row, kt0, v0, trow in self._attn_rows[b]:
                 q[row, 4] = ktiles
                 q[row, 6] = kvl
+                if spec:
+                    q[row, 5] = win      # causal window fold (kernel.py)
                 ent: list[int] = []
                 for p in flat:
                     ent += [kt0 + p, v0 + p]
                 ent += [0] * (-len(ent) % WORDS)
                 q[trow:trow + self._table_rows] = np.asarray(
                     ent, np.int32).reshape(-1, WORDS)
-            # Append target: the page holding position kv_len. An ACTIVE
-            # slot whose append page is unmapped must fail loudly — the
-            # write would silently land on the shared scratch page and
-            # the token's KV would be lost (the write-side twin of the
-            # read-coverage check above; idle slots park on scratch by
-            # design).
+            # Append target: the page(s) holding positions
+            # [kv_len, kv_len + win). An ACTIVE slot whose append page is
+            # unmapped must fail loudly — the write would silently land
+            # on the shared scratch page and the token's KV would be lost
+            # (the write-side twin of the read-coverage check above; idle
+            # slots park on scratch by design).
             ti, col = kvl // TILE, kvl % TILE
-            if (kvl > 0 or pages) and ti >= len(pages):
+            last_ti = (kvl + win - 1) // TILE
+            if (kvl > 0 or pages) and last_ti >= len(pages):
                 raise ValueError(
-                    f"slot {b} appends at position {kvl} (page index "
-                    f"{ti}) but the table maps {len(pages)} page(s) — "
-                    "the scheduler's page growth must run before decode")
+                    f"slot {b} appends at positions [{kvl}, {kvl + win}) "
+                    f"(page index {last_ti}) but the table maps "
+                    f"{len(pages)} page(s) — the scheduler's page growth "
+                    "must run before decode")
             ap = flat[ti] if ti < len(flat) else self.scratch
-            for row, kt0, v0 in self._append_rows[b]:
-                q[row, 1] = kt0 + ap
-                q[row, 3] = v0 + ap
-                q[row, 8] = col
+            if not spec:
+                for row, kt0, v0 in self._append_rows[b]:
+                    q[row, 1] = kt0 + ap
+                    q[row, 3] = v0 + ap
+                    q[row, 8] = col
+            else:
+                # Spec programs emit append rows in (primary, spill)
+                # PAIRS per (layer, kv head): the primary takes the first
+                # n1 window rows at columns col.., the spill takes the
+                # remainder at columns 0.. of the NEXT page tile (parked
+                # via c0 = -1 when the window stays inside one tile).
+                n1 = min(win, TILE - col)
+                rest = win - n1
+                ap2 = (flat[ti + 1] if ti + 1 < len(flat)
+                       else self.scratch)
+                rows_b = self._append_rows[b]
+                for i in range(0, len(rows_b), 2):
+                    row, kt0, v0 = rows_b[i]
+                    q[row, 1] = kt0 + ap
+                    q[row, 3] = v0 + ap
+                    q[row, 8] = col
+                    q[row, 4] = n1       # window count (kernel.py)
+                    q[row, 7] = 0        # source row offset
+                    row2, kt0b, v0b = rows_b[i + 1]
+                    if rest > 0:
+                        q[row2, 1] = kt0b + ap2
+                        q[row2, 3] = v0b + ap2
+                        q[row2, 8] = 0
+                        q[row2, 4] = rest
+                        q[row2, 7] = n1
+                    else:
+                        q[row2, 8] = -1  # skip (c0 < 0)
+                        q[row2, 4] = 0
+                        q[row2, 7] = 0
         return jnp.asarray(q)
 
     def _rope(self, pos: int) -> tuple[np.ndarray, np.ndarray]:
@@ -630,14 +686,24 @@ class PagedMegakernelDecoder:
               tokens):
         # embed / final_norm / lm_head arrive as ARGUMENTS (the bench.py
         # closed-over-constant hazard). Row b*TILE of block b carries the
-        # slot's real token; the other 127 rows are padding lanes whose
-        # outputs are discarded. ``wk8``: the fp8 KV pool workspace
-        # (None unless kv_fp8 — a STATIC branch, like the program form).
+        # slot's real token; under spec_window = W > 1 rows b*TILE..
+        # b*TILE+W-1 carry the slot's candidate window (last accepted
+        # token + drafts); the other rows are padding lanes whose outputs
+        # are discarded. ``wk8``: the fp8 KV pool workspace (None unless
+        # kv_fp8 — a STATIC branch, like the program form).
         hidden = self.cfg.hidden_size
         B = self.num_slots
-        rows = embed[tokens].astype(jnp.float32)            # (B, hidden)
-        x = jnp.zeros((B * TILE, hidden), jnp.float32
-                      ).at[jnp.arange(B) * TILE].set(rows)
+        W = self.spec_w
+        if W == 1:
+            rows = embed[tokens].astype(jnp.float32)        # (B, hidden)
+            x = jnp.zeros((B * TILE, hidden), jnp.float32
+                          ).at[jnp.arange(B) * TILE].set(rows)
+        else:
+            rows = embed[tokens.reshape(-1)].astype(jnp.float32)
+            idx = (jnp.arange(B)[:, None] * TILE
+                   + jnp.arange(W)[None, :]).reshape(-1)
+            x = jnp.zeros((B * TILE, hidden), jnp.float32
+                          ).at[idx].set(rows)
         ws = self.comp.scatter_input(ws, self.prog.x, x)
         ws = self.comp.scatter_input(ws, self.prog.cos, cos)
         ws = self.comp.scatter_input(ws, self.prog.sin, sin)
@@ -645,30 +711,62 @@ class PagedMegakernelDecoder:
             ws = self.comp.step(ws, queue, wsm=self._wsm)
         else:
             ws, wk8 = self.comp.step(ws, queue, wsm=self._wsm, wkv8=wk8)
-        outs = [self.comp.gather_output(ws, h)[0:1]
+        outs = [self.comp.gather_output(ws, h)[0:W]
                 for h in self.prog.x_out_blocks]
-        x_out = jnp.concatenate(outs, axis=0)               # (B, hidden)
+        x_out = jnp.concatenate(outs, axis=0)           # (B·W, hidden)
         xn = rms_norm(x_out.astype(jnp.float32),
                       final_norm.astype(jnp.float32),
                       self.cfg.rms_norm_eps)
         head = lm_head if lm_head is not None else embed.T
         logits = xn @ head.astype(jnp.float32)
-        return ws, wk8, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if W > 1:
+            tok = tok.reshape(B, W)
+        return ws, wk8, tok
 
-    def step(self, ws, tokens, kv_lens, tables):
+    def step(self, ws, tokens, kv_lens, tables, wins=None):
         """One decode step over every slot. tokens: (B,) int32 (idle
         slots: any id — their lane is discarded); kv_lens: (B,) host
         ints (0 = idle); tables: (B, <=max_pages) pool page ids.
         Returns (workspace', next_tokens (B,)) — the workspace is the
         ``(main, kv8)`` pair under kv_fp8, exactly as start() returned
-        it."""
-        queue = self._retarget(kv_lens, tables)
-        tabs = [self._rope(int(kv_lens[b]))
-                for b in range(self.num_slots)]
-        cos = np.concatenate(
-            [np.broadcast_to(t[0], (TILE, TILE)) for t in tabs], axis=0)
-        sin = np.concatenate(
-            [np.broadcast_to(t[1], (TILE, TILE)) for t in tabs], axis=0)
+        it.
+
+        Spec programs (``spec_window`` = W > 1): tokens is (B, W) — the
+        last accepted token + drafts per slot, ``wins`` (B,) the live
+        window per slot (rows past it are padding; 1 = plain one-token
+        decode for that slot) — and the return is (B, W) verifier
+        tokens, column j the greedy next-token after consuming the
+        window prefix 0..j (feed models/sampling.accept_longest_prefix).
+        """
+        queue = self._retarget(kv_lens, tables, wins)
+        if self.spec_w == 1:
+            tabs = [self._rope(int(kv_lens[b]))
+                    for b in range(self.num_slots)]
+            cos = np.concatenate(
+                [np.broadcast_to(t[0], (TILE, TILE)) for t in tabs],
+                axis=0)
+            sin = np.concatenate(
+                [np.broadcast_to(t[1], (TILE, TILE)) for t in tabs],
+                axis=0)
+        else:
+            # Per-ROW positions: row i of slot b rotates at kv_len + i
+            # for i < win; rows past the window broadcast the last real
+            # position (their k/v are never appended or folded) — O(win)
+            # cache lookups per slot, not O(TILE).
+            cos_rows, sin_rows = [], []
+            for b in range(self.num_slots):
+                kvl = int(kv_lens[b])
+                win = int(wins[b]) if wins is not None else 1
+                per = [self._rope(kvl + i) for i in range(win)]
+                pad = np.broadcast_to(per[-1][0], (TILE - win, TILE))
+                cos_rows.append(np.stack([t[0] for t in per]))
+                cos_rows.append(pad)
+                sin_rows.append(np.stack([t[1] for t in per]))
+                sin_rows.append(np.broadcast_to(per[-1][1],
+                                                (TILE - win, TILE)))
+            cos = np.concatenate(cos_rows, axis=0)
+            sin = np.concatenate(sin_rows, axis=0)
         self.last_step_cold = not self.warm
         # Step-hook accounting for the request tracer / flight recorder
         # (ISSUE 13): active slots + mapped pages this launch — the

@@ -64,9 +64,13 @@ class TaskType(enum.IntEnum):
     #                 — data-dependent addressing, the same mechanism as
     #                 ops/paged_attention.py). b0 = table start ROW in the
     #                 queue; entry pair j at flat offsets (2j, 2j+1) within
-    #                 rows b0+. Other words as ATTN_DECODE (a_stride unused).
-    #                 Reference: the paged FA decode task of
-    #                 mega_triton_kernel tasks/flash_attn.py.
+    #                 rows b0+. Other words as ATTN_DECODE; a_stride is the
+    #                 SPECULATIVE candidate window (0 = legacy diagonal
+    #                 current-token fold; win >= 1 folds the block's fresh
+    #                 k/v causally — row i attends fresh rows j <= i < win,
+    #                 the draft-and-verify form, docs/serving.md
+    #                 "Speculative decode"). Reference: the paged FA decode
+    #                 task of mega_triton_kernel tasks/flash_attn.py.
     GEMM_WIDE = 12  # GEMM over ``arg`` contiguous output column tiles
     #                 (out..out+arg-1) in ONE task: the A row streams once
     #                 for the whole strip (vs once per output tile) and
@@ -90,6 +94,12 @@ class TaskType(enum.IntEnum):
     #                 ``b0`` (TILE, d). a_stride/b_stride carry the kT/v
     #                 tensor BASE tile ids so advance_queue_pos can retarget
     #                 out/b0/c0 per position without recompiling.
+    #                 SPECULATIVE window form (docs/serving.md): k_tiles =
+    #                 count n >= 1 appends k_new rows arg..arg+n-1 at
+    #                 columns c0..c0+n-1 (v rows likewise; k_tiles == 0
+    #                 keeps the legacy single-row form); c0 < 0 skips the
+    #                 task — the host parks the page-spill row there when a
+    #                 candidate window stays inside one page tile.
     GEMM_WIDE_W8 = 15  # GEMM_WIDE whose B (weight) tiles live in the
     #                 float8_e4m3fn weight workspace (separate read-only
     #                 input; tile ids index it, upcast to the compute dtype

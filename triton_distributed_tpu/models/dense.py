@@ -447,3 +447,55 @@ def dense_decode_step_paged(params: dict, cfg: ModelConfig,
     if ar_state is not None:
         return logits, cache, (final() if final is not None else ar_state)
     return logits, cache
+
+
+def dense_verify_step_paged(params: dict, cfg: ModelConfig,
+                            tokens: jax.Array, cache, *, axis: str = "tp",
+                            num_ranks: int = 1, mode: str = "ar",
+                            inter_axis: str = "dcn", n_inter: int = 1):
+    """Speculative VERIFY decode over a :class:`PagedModelCache`: score
+    W = k+1 candidate positions per sequence in ONE launch
+    (docs/serving.md "Speculative decode"). tokens: (B, W) replicated —
+    column 0 each sequence's last accepted token, columns 1..k its
+    drafted candidates. Every projection/MLP GEMM batches over all B·W
+    rows (the fp8-KV bandwidth spend: weights stream once for the whole
+    window), attention runs each candidate as its own virtual sequence
+    over the shared pools (causal within the window, heterogeneous
+    ``kv_lens``), and per-row math is bit-identical to W sequential
+    :func:`dense_decode_step_paged` calls fed the same tokens — which is
+    what makes greedy acceptance (models/sampling.accept_longest_prefix)
+    lossless.
+
+    Returns (logits (B, W, vocab), cache with all W positions appended
+    and ``kv_lens`` advanced by W, clamped at capacity). The CALLER owns
+    the acceptance truncation: rewrite ``kv_lens`` to the accepted
+    prefix (append-then-truncate — rejected positions are dead data the
+    next append overwrites before any read). W = 1 degenerates to the
+    one-token step."""
+    from triton_distributed_tpu.layers.tp_attn import tp_attn_verify_paged
+
+    n = num_ranks
+    batch, window = tokens.shape
+    start_lens = cache.kv_lens
+
+    def attend(i, attn_params, h):
+        nonlocal cache
+        # Every layer appends at the same positions: reset kv_lens to the
+        # step's start for each layer, advance once at the end.
+        layer_cache = cache.layer(i)._replace(kv_lens=start_lens)
+        out, layer_cache = tp_attn_verify_paged(
+            attn_params, cfg, h, layer_cache, window,
+            axis=axis, num_ranks=n, mode=mode, inter_axis=inter_axis,
+            n_inter=n_inter)
+        cache = cache.with_layer_pools(i, layer_cache)
+        return out
+
+    # The SHARED transformer walk (_decode_body) over B·W rows — the
+    # verify path must never fork from the one-token step it is judged
+    # bit-identical to; only the attention closure differs.
+    logits = _decode_body(params, cfg, tokens.reshape(-1), attend,
+                          axis=axis, n=n, mode=mode, inter_axis=inter_axis,
+                          n_inter=n_inter)
+    new_lens = jnp.minimum(start_lens + window, cache.capacity)
+    return (logits.reshape(batch, window, -1),
+            cache._replace(kv_lens=new_lens))

@@ -217,6 +217,25 @@ class PageAllocator:
         self._free.sort(reverse=True)
         return len(held)
 
+    def free_tail(self, owner, keep: int) -> int:
+        """Return the owner's pages BEYOND the first ``keep`` (allocation
+        order) to the pool — the speculative-decode draft rollback
+        (docs/serving.md "Speculative decode"): pages grown for a k-token
+        candidate window shrink back to exactly what the accepted prefix
+        occupies, so rejected drafts never leave KV bytes resident.
+        Returns the count freed (0 when nothing extends past ``keep``)."""
+        if keep < 0:
+            raise ValueError(f"keep = {keep} invalid: a rollback keeps a "
+                             "non-negative page count — argument keep")
+        held = self._owned.get(owner)
+        if not held or len(held) <= keep:
+            return 0
+        tail = held[keep:]
+        del held[keep:]
+        self._free.extend(tail)
+        self._free.sort(reverse=True)
+        return len(tail)
+
 
 def init_paged_model_cache(cfg, batch: int, *, page_size: int,
                            max_pages: int, num_pages: int | None = None,

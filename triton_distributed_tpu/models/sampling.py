@@ -1,18 +1,51 @@
-"""Token sampling.
+"""Token sampling + speculative-decode acceptance.
 
 Reference: ``python/triton_dist/models/utils.py:45,86`` (greedy + temperature
-sampling helpers used by Engine.serve).
+sampling helpers used by Engine.serve). :func:`accept_longest_prefix` is the
+greedy draft-verification rule of Leviathan et al. 2023 ("Fast Inference
+from Transformers via Speculative Decoding") — under greedy decoding the
+accepted output is bit-identical to one-token decode, which is what makes
+the serving tier's spec lane (docs/serving.md "Speculative decode")
+verifiable against the sequential parity oracle.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def greedy(logits: jax.Array) -> jax.Array:
     """(B, vocab) → (B,) int32 argmax."""
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def accept_longest_prefix(draft, verified) -> np.ndarray:
+    """Greedy speculative acceptance — the ONE rule both decode backends
+    (xla paged verify + megakernel draft-and-verify) share.
+
+    ``draft``: the k proposed tokens (k >= 0). ``verified``: the
+    verifier's greedy next-token at each of the k+1 candidate positions —
+    ``verified[j]`` is the model's output after consuming the last
+    accepted token plus ``draft[:j]``. Let m be the longest prefix with
+    ``draft[j] == verified[j]``; the accepted NEW tokens are
+    ``verified[:m+1]`` (the m confirmed drafts — equal to the verifier's
+    own outputs — plus the bonus token the verify step computed for
+    free). Always accepts at least one token, so k = 0 degenerates to
+    plain one-token decode. Host-side, int32 in/out (the queue-word /
+    token-buffer contract)."""
+    d = np.asarray(draft, dtype=np.int32).ravel()
+    v = np.asarray(verified, dtype=np.int32).ravel()
+    if v.size != d.size + 1:
+        raise ValueError(
+            f"verified has {v.size} entries for {d.size} draft tokens — "
+            "the verify step scores k+1 positions (last accepted token "
+            "plus each draft)")
+    m = 0
+    while m < d.size and d[m] == v[m]:
+        m += 1
+    return v[:m + 1].astype(np.int32, copy=False)
 
 
 def sample(logits: jax.Array, key: jax.Array, temperature: float = 1.0,

@@ -119,6 +119,17 @@ METRICS: tuple[MetricSpec, ...] = (
     MetricSpec("serve_ttft_p99_ms_fp8kv",
                "serving TTFT p99 (fp8 KV pools)", " ms", "lower",
                "serving"),
+    MetricSpec("serve_tokens_per_s_spec",
+               "serving ACCEPTED tokens/s (speculative draft-and-verify, "
+               "spec_k=4 prompt-lookup drafts, same window as the "
+               "one-token rung)",
+               " tok/s", "higher", "serving"),
+    MetricSpec("spec_accept_rate",
+               "speculative accept rate (accepted drafts / drafted, "
+               "same window)", "", "higher", "serving"),
+    MetricSpec("serve_ttft_p99_ms_spec",
+               "serving TTFT p99 (speculative lane)", " ms", "lower",
+               "serving"),
 )
 
 METRIC_BY_KEY = {m.key: m for m in METRICS}

@@ -76,6 +76,16 @@ TTFT_COMPONENT_SERIES = {
     "decode_ms": SERVE_TTFT_DECODE_MS,
 }
 
+# Speculative-decode lane (ISSUE 14, docs/serving.md "Speculative
+# decode"): drafted vs accepted candidate tokens, plus the per-iteration
+# accept rate the serving loop publishes (accepted drafts / drafted —
+# the number that says whether the k knob is paying for its verify
+# window). A spec-enabled run must carry the rate gauge whenever the
+# draft counter is present (obs.report --check pins it).
+SPEC_ACCEPTED_TOKENS = "tdtpu_spec_accepted_tokens_total"
+SPEC_DRAFT_TOKENS = "tdtpu_spec_draft_tokens_total"
+SPEC_ACCEPT_RATE = "tdtpu_spec_accept_rate"
+
 # What the report's serving lane renders (histograms first, then
 # gauges/counters, in this order).
 SERVING_SERIES = (SERVE_TTFT_MS, SERVE_TPOT_MS, SERVE_TTFT_QUEUE_MS,
@@ -84,7 +94,9 @@ SERVING_SERIES = (SERVE_TTFT_MS, SERVE_TPOT_MS, SERVE_TTFT_QUEUE_MS,
                   SERVE_FREE_PAGES, SERVE_ACTIVE, SERVE_RUNNING_SLOTS,
                   KV_POOL_OCCUPANCY, SERVE_ADMIT_CAP,
                   SERVE_PREEMPTIONS, SERVE_REJECTS, SERVE_FINISHED,
-                  KV_PAGES_RESIDENT, SERVE_TOKENS_PER_S)
+                  KV_PAGES_RESIDENT, SPEC_DRAFT_TOKENS,
+                  SPEC_ACCEPTED_TOKENS, SPEC_ACCEPT_RATE,
+                  SERVE_TOKENS_PER_S)
 
 # KV-migration lane (disaggregated prefill/decode tier, docs/disagg.md):
 # published by disagg/migrate.py + disagg/engine.py, rendered as

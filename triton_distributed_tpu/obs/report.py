@@ -734,6 +734,15 @@ def main(argv: list[str] | None = None) -> int:
         failures.append(
             f"serving lane present but {_om.KV_PAGES_RESIDENT} missing — "
             "the KV pool gauge is part of the serving lane contract")
+    # Speculative-decode lane (ISSUE 14): a spec-enabled run (draft
+    # counter present) must carry the accept-rate gauge — without it the
+    # drafted/accepted evidence cannot be judged per-iteration.
+    if (_om.SPEC_DRAFT_TOKENS in (metrics or {})
+            and _om.SPEC_ACCEPT_RATE not in (metrics or {})):
+        failures.append(
+            f"spec lane present ({_om.SPEC_DRAFT_TOKENS}) but "
+            f"{_om.SPEC_ACCEPT_RATE} missing — the accept-rate gauge is "
+            "part of the spec lane contract")
     # Request-timeline lane (ISSUE 13): any serving snapshot must carry
     # its per-request tracks — without them an SLO slip or demotion in
     # this run dir is unattributable after the fact.

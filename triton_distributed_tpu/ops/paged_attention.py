@@ -108,6 +108,51 @@ def paged_append(cache: PagedKVCache, k_new: jax.Array,
                           kv_lens=cache.kv_lens + ok.astype(jnp.int32))
 
 
+def paged_append_window(cache: PagedKVCache, k_new: jax.Array,
+                        v_new: jax.Array) -> PagedKVCache:
+    """Append a WINDOW of W tokens' k/v per sequence at positions
+    ``[kv_lens, kv_lens + W)`` (k_new/v_new: (B, W, hkv, d)) — the
+    speculative-decode verify step's append (docs/serving.md
+    "Speculative decode"): the last accepted token plus k draft
+    candidates land in one scatter, then the verifier attends each
+    candidate position causally and the host truncates ``kv_lens`` back
+    to the accepted prefix (append-then-truncate; positions past the
+    truncation are dead data the next append overwrites before they can
+    ever be read).
+
+    Per-(b, i) writes past capacity are dropped exactly like
+    :func:`paged_append`'s saturation clamp; stored values are
+    bit-identical to W sequential ``paged_append`` calls (same
+    ``_to_pool_dtype`` quantization point). W = 1 IS ``paged_append``.
+    """
+    P = cache.page_size
+    b, w = k_new.shape[0], k_new.shape[1]
+    capacity = cache.page_table.shape[1] * P
+    pos = cache.kv_lens[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]
+    ok = pos < capacity                                   # (B, W)
+    safe_pos = jnp.minimum(pos, capacity - 1)
+    # Out-of-capacity rows must DROP, not clamp: a clamped index would
+    # alias the last in-capacity position in the SAME scatter and could
+    # overwrite a real candidate's just-appended k/v with the stale
+    # pre-step value (duplicate-index scatter order is undefined).
+    # Redirecting the page index past the pool and scattering with
+    # mode="drop" discards them exactly like paged_append's saturation.
+    page_idx = jnp.where(
+        ok, cache.page_table[jnp.arange(b)[:, None], safe_pos // P],
+        cache.k_pool.shape[0])
+    row = safe_pos % P
+
+    def scatter(pool, new):
+        return pool.at[page_idx.reshape(-1), row.reshape(-1)].set(
+            _to_pool_dtype(new.reshape(b * w, *new.shape[2:]),
+                           pool.dtype), mode="drop")
+
+    return cache._replace(
+        k_pool=scatter(cache.k_pool, k_new),
+        v_pool=scatter(cache.v_pool, v_new),
+        kv_lens=cache.kv_lens + jnp.sum(ok.astype(jnp.int32), axis=1))
+
+
 # ---------------------------------------------------------------------------
 # Kernel.
 # ---------------------------------------------------------------------------

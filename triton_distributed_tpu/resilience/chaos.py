@@ -630,6 +630,152 @@ def disagg_serve_selftest() -> list[CaseResult]:
 # and rejoins once the fault clears (docs/resilience.md).
 # ---------------------------------------------------------------------------
 
+def spec_serve_selftest() -> list[CaseResult]:
+    """Two rows per --all sweep for the speculative decode lane
+    (ISSUE 14, docs/serving.md "Speculative decode"): (a) a seeded
+    transient fault inside a VERIFY step must fall the lane back to
+    one-token decode — never die — and still finish every request
+    token-identical to a sequential one-token serve; (b) preemption
+    mid-draft (page pressure strikes a request whose candidate window
+    was already reserved) must recompute on resume with parity and
+    leave NO stale draft KV pages in the pool — every running request
+    holds exactly ceil(kv_len / page) pages after each iteration and
+    the pool drains completely at the end."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from triton_distributed_tpu.models import (
+        Engine, init_dense_llm, tiny_config,
+    )
+    from triton_distributed_tpu.runtime import initialize_distributed
+    from triton_distributed_tpu.serving.loop import ServingEngine
+
+    cfg = tiny_config()
+    params = init_dense_llm(jax.random.key(0), cfg)
+    ctx1 = initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                  devices=jax.devices()[:1])
+    oracle = Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                    page_size=4)
+    # Repetitive prompts so the lookup proposer actually drafts — the
+    # fault/preemption must land on a live candidate window, not on a
+    # degenerate one-token step.
+    prompts = [[3, 9] * 4, [7, 7, 7, 7, 7], [11, 4, 11, 4, 11, 4]]
+    gens = [10, 8, 8]
+    golden = {}
+    for i, (p, g) in enumerate(zip(prompts, gens)):
+        golden[i] = np.asarray(
+            oracle.serve(jnp.asarray([p], jnp.int32), gen_len=g)
+        )[0].tolist()
+
+    def serve_all(se, check_occupancy=None):
+        reqs = []
+        for i, (p, g) in enumerate(zip(prompts, gens)):
+            req, res = se.submit(p, g, req_id=f"chaos-sp-{i}",
+                                 priority=1 if i == 0 else 0)
+            assert res.name == "ADMITTED", res
+            reqs.append(req)
+        it = 0
+        while se.sched.has_work():
+            se.step()
+            if check_occupancy is not None:
+                check_occupancy(se)
+            it += 1
+            assert it < 10_000, "spec chaos serve did not drain"
+        return reqs
+
+    cases = []
+
+    # Row (a): seeded fault mid-verify -> fall back to one-token decode
+    # with token parity (the lane must absorb its own failure).
+    t0 = time.time()
+    diags: list[str] = []
+    try:
+        eng = Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                     page_size=4)
+        se = ServingEngine(eng, max_batch=3, num_pages=24,
+                           prefill_chunk=4, spec_k=2)
+        real_verify = se._verify_jit
+        fired = {"n": 0}
+
+        def faulty_verify():
+            fn = real_verify()
+
+            def wrapper(*a, **kw):
+                if fired["n"] == 0:
+                    fired["n"] += 1
+                    raise FaultInjectionError(
+                        "chaos: injected verify-step fault "
+                        "(kernel=serving_verify occurrence=0)")
+                return fn(*a, **kw)
+
+            return wrapper
+
+        se._verify_jit = faulty_verify
+        import warnings as _w
+
+        with _w.catch_warnings():
+            _w.simplefilter("ignore", RuntimeWarning)
+            reqs = serve_all(se)
+        parity = all(r.tokens == golden[i] for i, r in enumerate(reqs))
+        diags += [f"fault fired: {fired['n']}",
+                  f"spec fallback: {se._spec_fallback}",
+                  f"parity vs sequential one-token serve: {parity}"]
+        verdict = ("detected" if fired["n"] and se._spec_fallback
+                   and parity else "error")
+    except Exception as exc:                        # died = the failure
+        verdict = "error"
+        diags.append(f"{type(exc).__name__}: {exc}")
+    cases.append(CaseResult(
+        op="spec_serve", mesh="1", fault="verify_step_fault",
+        verdict=verdict, detected_by="spec_fallback",
+        expected=("detected",), ok=verdict == "detected", n_fired=1,
+        n_violations=0, diagnostics=diags,
+        elapsed_s=round(time.time() - t0, 3)))
+
+    # Row (b): preemption mid-draft under page pressure — recompute on
+    # resume with parity, and NO stale draft pages survive in the pool.
+    t0 = time.time()
+    diags = []
+    try:
+        eng = Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                     page_size=4)
+        # 7 pages against 3 requests wanting up to 5 pages each forces
+        # eviction while candidate windows are in flight.
+        se = ServingEngine(eng, max_batch=3, num_pages=7,
+                           prefill_chunk=4, spec_k=2)
+        stale = {"n": 0}
+
+        def check_occupancy(se_):
+            for r in se_.sched.running():
+                held = len(se_.sched.allocator.pages(r.req_id))
+                if held != -(-r.kv_len // se_.page):
+                    stale["n"] += 1
+
+        reqs = serve_all(se, check_occupancy)
+        parity = all(r.tokens == golden[i] for i, r in enumerate(reqs))
+        preempted = [r.req_id for r in reqs if r.preemptions > 0]
+        drained = (se.sched.allocator.free_count
+                   == se.sched.allocator.usable_pages)
+        drafted = sum(r.drafted_tokens for r in reqs)
+        diags += [f"preempted: {preempted}", f"drafted: {drafted}",
+                  f"stale-page iterations: {stale['n']}",
+                  f"pool drained: {drained}",
+                  f"parity vs sequential one-token serve: {parity}"]
+        verdict = ("detected" if preempted and parity and drained
+                   and drafted and not stale["n"] else "error")
+    except Exception as exc:
+        verdict = "error"
+        diags.append(f"{type(exc).__name__}: {exc}")
+    cases.append(CaseResult(
+        op="spec_serve", mesh="1", fault="preempt_mid_draft",
+        verdict=verdict, detected_by="rollback",
+        expected=("detected",), ok=verdict == "detected", n_fired=1,
+        n_violations=0, diagnostics=diags,
+        elapsed_s=round(time.time() - t0, 3)))
+    return cases
+
+
 def fleet_selftest() -> list[CaseResult]:
     """Three rows per --all sweep:
 
@@ -1037,6 +1183,14 @@ def sweep(ops, faults, ranks, *, seed: int = 0,
         # with quantized-golden parity; disagg migration checksums on
         # the narrowed payload.
         for case in fp8kv_serve_selftest():
+            cases.append(case)
+            failed += not case.ok
+            _print_case(case, verbose)
+        # Speculative-decode rows (ISSUE 14): a seeded fault mid-verify
+        # falls the lane back to one-token decode with parity;
+        # preemption mid-draft recomputes on resume with no stale draft
+        # KV pages surviving in the pool.
+        for case in spec_serve_selftest():
             cases.append(case)
             failed += not case.ok
             _print_case(case, verbose)

@@ -13,7 +13,10 @@ docs/serving.md.
 * :mod:`~triton_distributed_tpu.serving.loop` — :class:`ServingEngine`,
   the mixed prefill+decode iteration driver;
 * :mod:`~triton_distributed_tpu.serving.loadgen` — deterministic
-  open-loop load generator, the CPU dryrun proof and the bench rung.
+  open-loop load generator, the CPU dryrun proof and the bench rung;
+* :mod:`~triton_distributed_tpu.serving.spec` — self-drafting
+  speculative-decode proposer (prompt lookup; ``ServingEngine(spec_k=)``
+  is the lane's switch — docs/serving.md "Speculative decode").
 """
 
 from triton_distributed_tpu.serving.request import (  # noqa: F401
@@ -25,7 +28,11 @@ from triton_distributed_tpu.serving.scheduler import (  # noqa: F401
 from triton_distributed_tpu.serving.loop import (  # noqa: F401
     ServingConfigError, ServingEngine,
 )
+from triton_distributed_tpu.serving.spec import (  # noqa: F401
+    NGramProposer, SpecConfigError,
+)
 
 __all__ = ["Request", "RequestState", "AdmitResult", "Scheduler",
            "SchedulerConfigError", "RequestTooLargeError",
-           "ServingConfigError", "ServingEngine"]
+           "ServingConfigError", "ServingEngine", "NGramProposer",
+           "SpecConfigError"]

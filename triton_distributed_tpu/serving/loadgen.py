@@ -96,6 +96,8 @@ def request_records(reqs) -> list[dict]:
             "preempted": r.preemptions > 0,
             "migrated": r.migrations > 0,
             "evacuated": r.evacuations > 0,
+            "drafted": r.drafted_tokens,
+            "accepted": r.accepted_draft_tokens,
             "final_backend": r.final_backend,
             "state": r.state.name,
         }
@@ -223,7 +225,10 @@ def dryrun(json_path: str | None, flight_dir: str | None = None) -> int:
     (c) SLO violation streak shrinks the admitted batch. Phase 8
     (ISSUE 13) adds the request-tracing + flight-recorder round-trip:
     ``flight_dir`` keeps its obs run directory (dumps + request
-    timelines) for CI's postmortem step."""
+    timelines) for CI's postmortem step. Phase 9 (ISSUE 14) proves
+    greedy speculative decode token-identical to sequential one-token
+    serve on BOTH backends (xla + megakernel, incl. preempt/resume)
+    with the rejected-draft page rollback asserted every iteration."""
     import os
 
     from triton_distributed_tpu.runtime.utils import (
@@ -713,6 +718,112 @@ def dryrun(json_path: str | None, flight_dir: str | None = None) -> int:
         "preemptions": rep8["preemptions"],
     }
 
+    # Phase 9 (ISSUE 14) — speculative decode: greedy draft-and-verify
+    # (spec_k > 0) must be TOKEN-IDENTICAL to sequential one-token
+    # Engine.serve on BOTH backends — xla (dense_verify_step_paged, incl.
+    # a preempt/resume round-trip under page pressure) and megakernel
+    # (the windowed draft-and-verify queue rows). Rejected drafts must
+    # never leave KV bytes resident: every running request's page count
+    # returns to exactly ceil(kv_len / page) after each iteration, and
+    # the pool drains completely at the end.
+    from triton_distributed_tpu.serving.loop import (
+        ServingEngine as _SpecServing,
+    )
+
+    sp_trace = build_trace(spec)                 # phase 1's seeded shape
+    se9 = _SpecServing(engine, max_batch=4, num_pages=8, prefill_chunk=4,
+                       max_waiting=8, spec_k=2)
+    sp_occupancy_ok = [True]
+    sp_orig_step = se9.step
+
+    def _sp_checked_step():
+        out = sp_orig_step()
+        for r in se9.sched.running():
+            held = len(se9.sched.allocator.pages(r.req_id))
+            if held != -(-r.kv_len // se9.page):
+                sp_occupancy_ok[0] = False
+        return out
+
+    se9.step = _sp_checked_step
+    sp_report = run_trace(se9, sp_trace)
+    sp_reqs = sp_report.pop("requests")
+    sp_mismatch = [r.req_id for r in sp_reqs
+                   if r.tokens != golden[r.req_id]]
+    sp_preempted = [r.req_id for r in sp_reqs
+                    if r.preemptions > 0 and r.tokens == golden[r.req_id]]
+    sp_drafted = sum(r.drafted_tokens for r in sp_reqs)
+    sp_recs = sp_report["request_records"]
+    if sp_mismatch:
+        failures.append("spec-decode token parity broken vs sequential "
+                        f"one-token serve (xla): {sp_mismatch}")
+    if not sp_preempted:
+        failures.append(
+            "no spec-decode request was preempted+resumed with parity — "
+            "the pool sizing no longer exercises eviction under the "
+            "candidate-window reservations")
+    if not sp_occupancy_ok[0]:
+        failures.append(
+            "spec-decode rollback left pages resident beyond the "
+            "accepted prefix (occupancy did not return to the one-token "
+            "baseline)")
+    if sp_drafted < 1:
+        failures.append(
+            "the spec proposer drafted nothing over the whole trace — "
+            "the lane ran as plain one-token decode and proved nothing")
+    if se9._spec_fallback:
+        failures.append("spec lane silently fell back to one-token "
+                        "decode during the parity run")
+    if any("drafted" not in r or "accepted" not in r for r in sp_recs):
+        failures.append("request_records rows lost their per-request "
+                        "accepted/drafted spec fields")
+    # Megakernel half: the SAME contract on the persistent kernel's
+    # windowed draft-and-verify rows (repetitive prompts so the drafts
+    # actually fire), including a preempt/resume on the paged workspace.
+    mk_sp_eng = Engine(mk_cfg, mk_params, ctx1, backend="megakernel",
+                       max_seq=256, page_size=128)
+    sp_pat = rng.integers(0, 512, 7).tolist()
+    mk_sp_trace = [
+        {"req_id": "mksp-0", "arrival_iter": 0,
+         "prompt": (sp_pat * 19)[:126], "max_new_tokens": 8,
+         "priority": 1},
+        {"req_id": "mksp-1", "arrival_iter": 0,
+         "prompt": (sp_pat * 16)[:100], "max_new_tokens": 6,
+         "priority": 0},
+    ]
+    mk_sp_golden = sequential_reference(oracle, mk_sp_trace)
+    se9mk = _SpecServing(mk_sp_eng, max_batch=2, num_pages=2,
+                         prefill_chunk=128, spec_k=2)
+    mk_sp_report = run_trace(se9mk, mk_sp_trace)
+    mk_sp_reqs = mk_sp_report.pop("requests")
+    mk_sp_mismatch = [r.req_id for r in mk_sp_reqs
+                      if r.tokens != mk_sp_golden[r.req_id]]
+    mk_sp_preempted = [r.req_id for r in mk_sp_reqs
+                       if r.preemptions > 0
+                       and r.tokens == mk_sp_golden[r.req_id]]
+    if se9mk._mk is None or mk_sp_eng.backend != "megakernel":
+        failures.append(
+            f"megakernel spec lane silently demoted (backend now "
+            f"{mk_sp_eng.backend!r}) — the parity it reported is not "
+            "the windowed persistent kernel's")
+    if mk_sp_mismatch:
+        failures.append("megakernel spec-decode token parity broken vs "
+                        f"sequential serve: {mk_sp_mismatch}")
+    if not mk_sp_preempted:
+        failures.append("no megakernel spec request was preempted+"
+                        "resumed with parity on the paged workspace")
+    report["spec_decode"] = {
+        "parity_ok": not sp_mismatch,
+        "preempted_with_parity": sp_preempted,
+        "drafted": sp_drafted,
+        "accepted_drafts": sum(r.accepted_draft_tokens for r in sp_reqs),
+        "occupancy_baseline_ok": sp_occupancy_ok[0],
+        "megakernel_parity_ok": not mk_sp_mismatch,
+        "megakernel_preempted_with_parity": mk_sp_preempted,
+        "megakernel_drafted": sum(r.drafted_tokens for r in mk_sp_reqs),
+        "megakernel_accepted_drafts": sum(
+            r.accepted_draft_tokens for r in mk_sp_reqs),
+    }
+
     report["failures"] = failures
     if json_path:
         with open(json_path, "w") as f:
@@ -743,7 +854,8 @@ def _bench_shard_config():
 
 def serving_bench_rung(n_streams: int = 8, prompt_len: int = 128,
                        max_new: int = 16, *, backend: str = "xla",
-                       page_size: int = 64, kv_dtype=None) -> dict:
+                       page_size: int = 64, kv_dtype=None,
+                       spec_k: int = 0) -> dict:
     """Tokens/s + p99 TTFT/TPOT at ``n_streams`` concurrent streams on
     the Qwen3-8B TP=8 PER-DEVICE shard shapes (the same single-chip
     pricing discipline as the decode rungs: n=1, no ICI in the number;
@@ -759,7 +871,15 @@ def serving_bench_rung(n_streams: int = 8, prompt_len: int = 128,
     ``kv_dtype`` (round 12): the paged pool's storage dtype —
     ``float8_e4m3fn`` is the fp8-KV rung (half the decode DMA bytes;
     bench.py races it against the full-width rung in the same window,
-    `serve_tokens_per_s_fp8kv`)."""
+    `serve_tokens_per_s_fp8kv`).
+
+    ``spec_k`` (round 14): the speculative draft depth — the
+    accepted-tokens/s ledger rung (`serve_tokens_per_s_spec`) races the
+    one-token rung in the same window and reports the measured accept
+    rate (`spec_accept_rate` — accepted drafts / drafted, from the
+    per-request ledger, so no obs run is required). The workload gains
+    a repeated-phrase prompt shape when spec is on: lookup drafting
+    exists for exactly that traffic."""
     import jax
     import jax.random as jrandom
 
@@ -774,22 +894,35 @@ def serving_bench_rung(n_streams: int = 8, prompt_len: int = 128,
                                   devices=jax.devices()[:1])
     engine = Engine(cfg, params, ctx1, backend=backend, max_seq=512,
                     page_size=page_size, kv_dtype=kv_dtype)
-    se = ServingEngine(engine, max_batch=n_streams, prefill_chunk=128)
+    se = ServingEngine(engine, max_batch=n_streams, prefill_chunk=128,
+                       spec_k=spec_k)
     if backend == "megakernel" and se._mk is None:
         # The rung exists to price the persistent lane; silently racing
         # a demoted dense loop would mislabel the ledger row.
         raise RuntimeError(
             f"megakernel serving lane demoted at construction (engine "
             f"backend now {engine.backend!r}) — rung not measurable")
-    spec = LoadSpec(n_requests=n_streams, seed=0,
-                    prompt_len=(prompt_len, prompt_len),
-                    max_new=(max_new, max_new),
-                    mean_interarrival_iters=0.0, vocab=cfg.vocab_size)
-    run_trace(se, build_trace(spec))                       # warmup/compile
-    spec2 = dataclasses.replace(spec, seed=1)
-    report = run_trace(se, build_trace(spec2))
-    report.pop("requests")
-    return {
+
+    def make_trace(seed: int) -> list[dict]:
+        spec = LoadSpec(n_requests=n_streams, seed=seed,
+                        prompt_len=(prompt_len, prompt_len),
+                        max_new=(max_new, max_new),
+                        mean_interarrival_iters=0.0, vocab=cfg.vocab_size)
+        trace = build_trace(spec)
+        if spec_k > 0:
+            # Repeated-phrase prompts (seeded): the shared-preamble /
+            # template traffic shape prompt-lookup drafting pays off on.
+            rng = np.random.default_rng(seed + 1000)
+            for item in trace:
+                phrase = rng.integers(0, cfg.vocab_size, 8).tolist()
+                reps = -(-len(item["prompt"]) // len(phrase))
+                item["prompt"] = (phrase * reps)[:len(item["prompt"])]
+        return trace
+
+    run_trace(se, make_trace(0))                           # warmup/compile
+    report = run_trace(se, make_trace(1))
+    reqs = report.pop("requests")
+    out = {
         "serve_tokens_per_s_concurrent": report["tokens_per_s"],
         "serve_ttft_p99_ms": report["ttft_p99_ms"],
         "serve_tpot_p99_ms": report["tpot_p99_ms"],
@@ -799,6 +932,19 @@ def serving_bench_rung(n_streams: int = 8, prompt_len: int = 128,
                       "the serving tier's real cost, unlike the pure "
                       "decode-chain rungs",
     }
+    if spec_k > 0:
+        drafted = sum(r.drafted_tokens for r in reqs)
+        accepted = sum(r.accepted_draft_tokens for r in reqs)
+        if se._spec_fallback:
+            raise RuntimeError(
+                "speculative lane fell back to one-token decode during "
+                "the measurement — rung not measurable as spec")
+        out["spec_drafted_tokens"] = drafted
+        out["spec_accepted_tokens"] = accepted
+        out["spec_accept_rate"] = (round(accepted / drafted, 4)
+                                   if drafted else None)
+        out["spec_k"] = spec_k
+    return out
 
 
 def disagg_serving_bench_rung(n_streams: int = 8, prompt_len: int = 128,

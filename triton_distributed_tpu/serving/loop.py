@@ -132,6 +132,23 @@ class ServingEngine:
         rejoin probe re-expands to the full mesh — docs/resilience.md
         "Fleet degradation". ``TDTPU_DEMOTION_LADDER=0`` opts out: the
         named ``RankLossError`` propagates instead.
+      spec_k: speculative-decode draft depth (ISSUE 14, docs/serving.md
+        "Speculative decode"). 0 (default) keeps today's one-token
+        decode path byte-identical; k > 0 self-drafts up to k candidate
+        tokens per RUNNING slot from the request's own prompt+generated
+        history (serving/spec.NGramProposer — deterministic, no second
+        model) and scores the whole k+1 window in ONE decode launch
+        (``models/dense.dense_verify_step_paged`` on the jitted paths;
+        the megakernel's windowed draft-and-verify rows on the
+        persistent lane). Greedy longest-accepted-prefix verification
+        (``models/sampling.accept_longest_prefix``) makes the output
+        token-identical to one-token decode; the tokens/s ledger counts
+        ACCEPTED tokens only, and rejected drafts roll back both the
+        device positions (kv_len truncation) and their page
+        reservations (``PageAllocator.free_tail``) — pool occupancy
+        returns to the one-token baseline every iteration. A transient
+        failure inside a verify step falls the lane back to one-token
+        decode with recompute-on-resume parity (never dies).
     """
 
     def __init__(self, engine: Engine, *, max_batch: int = 4,
@@ -139,7 +156,7 @@ class ServingEngine:
                  kv_hbm_budget: int | None = None,
                  prefill_chunk: int | None = None,
                  max_waiting: int = 64, slo_cfg=None, slo_every: int = 1,
-                 fleet=None, clock=time.perf_counter):
+                 fleet=None, clock=time.perf_counter, spec_k: int = 0):
         if engine.page_size is None:
             raise ServingConfigError(
                 "engine has no paged cache: construct Engine(page_size=...) "
@@ -195,6 +212,24 @@ class ServingEngine:
                 "at least one page — argument num_pages")
         self.num_pages = pool_pages
         self.scratch_page = pool_pages        # last pool row, never owned
+        # Speculative decode lane (ISSUE 14): resolved BEFORE the
+        # megakernel lane builds — the persistent program's candidate
+        # window is a compile-time shape.
+        if spec_k < 0 or int(spec_k) != spec_k:
+            raise ServingConfigError(
+                f"spec_k = {spec_k} invalid: the draft depth is a "
+                "non-negative integer (0 disables speculative decode) — "
+                "argument spec_k")
+        self.spec_k = int(spec_k)
+        self._spec_fallback = False     # one-token fallback after a fault
+        self._drafts: dict[str, list[int]] = {}
+        self._last_spec = (0, 0)        # (drafted, accepted drafts)/iter
+        if self.spec_k:
+            from triton_distributed_tpu.serving.spec import NGramProposer
+
+            self._proposer = NGramProposer(self.spec_k)
+        else:
+            self._proposer = None
         # Flight recorder (ISSUE 13, obs/flight.py): the last N
         # iterations + trigger chain, dumped on demotion / evacuation /
         # SLO shrink. Created BEFORE the megakernel lane so a
@@ -311,7 +346,16 @@ class ServingEngine:
             return PagedMegakernelDecoder(
                 self.cfg, eng.params, num_slots=self.max_batch,
                 num_pages=pool_pages, max_pages=self.max_pages, dtype=wdt,
-                kv_dtype=self.kv_dtype)
+                kv_dtype=self.kv_dtype,
+                # The candidate window is a compile-time program shape,
+                # resolved from the spec state at BUILD time (ctor, a
+                # backend re-promotion probe, or a post-fault rebuild —
+                # every path goes through here). _decode's dispatch
+                # consults the LANE's compiled window, not the spec
+                # flag, so a lane built windowless can never be handed
+                # a wins>1 step.
+                spec_window=(self.spec_k + 1 if self._spec_enabled()
+                             else 1))
         except ValueError as exc:
             # e.g. an unservable kv_dtype: named + transient, so the
             # tier demotes to the dense paged path (which serves any
@@ -430,6 +474,94 @@ class ServingEngine:
                 key, jax.jit(fn, donate_argnums=(0,)), "serving_scatter")
         return self._jits[key]
 
+    # -- speculative decode lane (ISSUE 14) ----------------------------------
+    def _spec_enabled(self) -> bool:
+        return self.spec_k > 0 and not self._spec_fallback
+
+    def _plan_drafts(self) -> dict[str, int]:
+        """Draft up to ``spec_k`` candidates per RUNNING slot from its
+        own history (host-side, deterministic) and return the per-request
+        token reservation (1 + draft length) the scheduler's page growth
+        covers this iteration. Drafts are clamped so the window can
+        never exceed the request's remaining budget (k+1 accepted tokens
+        max) — which also bounds the transient page reservation by the
+        request's admitted ``page_budget``."""
+        extra: dict[str, int] = {}
+        self._drafts.clear()
+        w = self._proposer.window_tokens
+        for req in self.sched.running():
+            remaining = req.max_new_tokens - len(req.tokens)
+            k_max = min(self.spec_k, remaining - 1)
+            if k_max > 0:
+                # Only the proposer's trailing window — req.text would
+                # copy the whole prompt+generated per slot per iteration.
+                tail = req.tokens[-w:]
+                if len(tail) < w:
+                    tail = req.prompt[-(w - len(tail)):] + tail
+                draft = self._proposer.propose(tail, k_max)
+            else:
+                draft = []
+            self._drafts[req.req_id] = draft
+            extra[req.req_id] = 1 + len(draft)
+        return extra
+
+    def _verify_jit(self):
+        """The jitted k+1-position verify step (the xla/dense lane's
+        draft-and-verify launch): one trace per serving tier — the
+        window is a fixed shape, slots with shorter (or no) drafts ride
+        padding columns whose appends land past the truncation point."""
+        key = ("verify", self.spec_k + 1)
+        if key not in self._jits:
+            from triton_distributed_tpu.models.dense import (
+                dense_verify_step_paged,
+            )
+
+            eng = self.engine
+            mode = eng._decode_mode()
+
+            def step(params, tokens, cache):
+                logits, cache = dense_verify_step_paged(
+                    params, self.cfg, tokens, cache, axis=eng.axis,
+                    num_ranks=eng.n, mode=mode)
+                b, w, v = logits.shape
+                ver = sampling.greedy(logits.reshape(b * w, v))
+                return ver.reshape(b, w), cache
+
+            fn = eng._shard(
+                step,
+                in_specs=(eng.param_specs, P(),
+                          paged_cache_specs(eng.shard_axes)),
+                out_specs=(P(), paged_cache_specs(eng.shard_axes)))
+            self._jits[key] = self._first_call(
+                key, jax.jit(fn, donate_argnums=(2,)), "serving_verify")
+        return self._jits[key]
+
+    def _spec_disable(self, reason: str) -> None:
+        """Transient failure INSIDE a verify step: fall the lane back to
+        one-token decode (chaos contract: fall back, never die). The
+        paged cache was donated into the failed jit and the rebuild
+        wipes the prefill buffer too, so EVERY in-flight request
+        preempts (the ``_evacuate`` discipline — preempting only the
+        decode batch would leave a mid-chunked-prefill request's
+        ``prefill_pos`` pointing into a zeroed buffer) and recomputes on
+        resume — token parity holds because the one-token path replays
+        the same greedy stream."""
+        import warnings
+
+        self._spec_fallback = True
+        self._drafts.clear()
+        self._preempt_all()
+        self._rebuild_device_state()
+        self.flight.note("spec_fallback", reason, self._iter)
+        if self._observing():
+            obs_metrics.registry().counter(
+                "tdtpu_spec_fallbacks_total",
+                "speculative lane disabled after a transient verify "
+                "failure (one-token decode from here)").inc()
+        warnings.warn(
+            f"speculative decode fell back to one-token decode: {reason}",
+            RuntimeWarning, stacklevel=3)
+
     # -- submission ----------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int, *, priority: int = 0,
                req_id: str | None = None
@@ -533,6 +665,10 @@ class ServingEngine:
                         self.sched._preempt(req)
 
     def _step_work(self, now: float) -> dict:
+        # Per-iteration spec evidence: reset so iterations that run no
+        # verify step (prefill-only, post-fallback, empty batch) record
+        # zeros in the flight ring instead of the last launch's counts.
+        self._last_spec = (0, 0)
         admitted = self.sched.schedule_admissions()
         head = self.sched.prefill_head()
         prefilled = None
@@ -544,7 +680,11 @@ class ServingEngine:
         # DCN transfers ride under this iteration's decode step. The
         # monolithic tier has nothing to move.
         self._advance_migrations()
-        ready, preempted = self.sched.ensure_decode_pages()
+        # Speculative drafting happens BEFORE page growth so the whole
+        # candidate window's reservation rides the same growth pass
+        # (preempted victims drop their drafts with their pages).
+        extra = self._plan_drafts() if self._spec_enabled() else None
+        ready, preempted = self.sched.ensure_decode_pages(extra=extra)
         decoded = len(ready)
         if ready:
             self._decode(ready)
@@ -686,7 +826,13 @@ class ServingEngine:
         alloc = self.sched.allocator
         usable = max(alloc.usable_pages, 1)
         running = self.sched.running()
+        rec_extra = {}
+        if self.spec_k:
+            rec_extra["spec"] = {"drafted": self._last_spec[0],
+                                 "accepted_drafts": self._last_spec[1],
+                                 "fallback": self._spec_fallback}
         self.flight.record({
+            **rec_extra,
             "iter": self._iter, "t": round(now, 6),
             "admitted": [r.req_id for r in admitted],
             "prefilled": prefilled,
@@ -1082,6 +1228,18 @@ class ServingEngine:
     def _decode(self, ready: list[Request]) -> None:
         eng = self.engine
         alloc = self.sched.allocator
+        if self._spec_enabled() and (
+                (self._mk.spec_w > 1) if self._mk is not None
+                else any(self._drafts.get(r.req_id) for r in ready)):
+            # Dense lane with EVERY draft empty falls through to the
+            # one-token step below — the verify window of 1 computes the
+            # same token at (spec_k+1)× the GEMM rows and attention
+            # walks, so paying it buys nothing. The megakernel lane
+            # routes by its COMPILED window (extra candidate rows ride
+            # the block padding for free there; and a lane built
+            # windowless must never receive a wins>1 step).
+            self._decode_spec(ready)
+            return
         toks = np.zeros((self.max_batch,), np.int32)
         lens = np.zeros((self.max_batch,), np.int32)
         # Unmapped entries are -1 so the megakernel decoder's
@@ -1103,26 +1261,7 @@ class ServingEngine:
 
                 if not resilience.is_transient(exc):
                     raise
-                # Workspace/page-shape mismatch or a backend failure mid
-                # serve: demote (don't die) and recompute the in-flight
-                # batch through the dense path — their decode-time KV
-                # lived in the megakernel pools, so recompute-on-resume
-                # is the only state-correct hand-off.
-                self._demote_backend(
-                    f"megakernel decode failed: {type(exc).__name__}: "
-                    f"{str(exc)[:120]}")
-                self._mk = None
-                self._mk_ws = None
-                for req in list(ready):
-                    self.sched._preempt(req)
-                if self._observing():
-                    # NOT the page-pressure counter: an operator alert
-                    # keyed on pool sizing must not fire for a backend
-                    # fault.
-                    obs_metrics.registry().counter(
-                        "tdtpu_serve_backend_demote_preemptions_total",
-                        "in-flight sequences recomputed because the "
-                        "decode backend demoted mid-serve").inc(len(ready))
+                self._mk_decode_failed(ready, exc)
                 return
         table[table < 0] = self.scratch_page
         cache = self._cache._replace(page_table=jnp.asarray(table),
@@ -1132,7 +1271,30 @@ class ServingEngine:
         with obs_trace.span("serving.decode_step", batch=len(ready)):
             tok, self._cache = eng._decode_run(jnp.asarray(toks), cache)
             tok_np = np.asarray(tok)        # host sync: the loop needs them
-        self._decode_tail(ready, tok_np, t0, eng._jit_compiled_last_call)
+        self._decode_tail(ready,
+                          {r.req_id: [int(tok_np[r.slot])] for r in ready},
+                          t0, eng._jit_compiled_last_call)
+
+    def _mk_decode_failed(self, ready: list[Request], exc) -> None:
+        """Transient megakernel failure mid-serve: demote (don't die) and
+        recompute the in-flight batch through the dense path — their
+        decode-time KV lived in the megakernel pools, so
+        recompute-on-resume is the only state-correct hand-off."""
+        self._demote_backend(
+            f"megakernel decode failed: {type(exc).__name__}: "
+            f"{str(exc)[:120]}")
+        self._mk = None
+        self._mk_ws = None
+        for req in list(ready):
+            self.sched._preempt(req)
+        if self._observing():
+            # NOT the page-pressure counter: an operator alert
+            # keyed on pool sizing must not fire for a backend
+            # fault.
+            obs_metrics.registry().counter(
+                "tdtpu_serve_backend_demote_preemptions_total",
+                "in-flight sequences recomputed because the "
+                "decode backend demoted mid-serve").inc(len(ready))
 
     def _decode_megakernel(self, ready: list[Request], toks, lens,
                            table) -> None:
@@ -1149,20 +1311,147 @@ class ServingEngine:
             self._mk_ws, tok = self._mk.step(self._mk_ws, toks, lens,
                                              table)
             tok_np = np.asarray(tok)    # host sync: the loop needs them
-        self._decode_tail(ready, tok_np, t0, self._mk.last_step_cold)
+        self._decode_tail(ready,
+                          {r.req_id: [int(tok_np[r.slot])] for r in ready},
+                          t0, self._mk.last_step_cold)
 
-    def _decode_tail(self, ready: list[Request], tok_np, t0: float,
-                     cold: bool) -> None:
-        """The per-step bookkeeping BOTH decode backends share (metrics,
+    def _decode_spec(self, ready: list[Request]) -> None:
+        """Speculative draft-and-verify decode (ISSUE 14): the candidate
+        window [last accepted token, draft_1..draft_k] of every RUNNING
+        slot scores in ONE launch; the host keeps the longest accepted
+        prefix per slot and rolls rejected positions back (kv_len
+        truncation + page-tail release) — the ledger counts accepted
+        tokens only."""
+        eng = self.engine
+        alloc = self.sched.allocator
+        W = self.spec_k + 1
+        toks = np.zeros((self.max_batch, W), np.int32)
+        lens = np.zeros((self.max_batch,), np.int32)
+        wins = np.ones((self.max_batch,), np.int32)
+        table = np.full((self.max_batch, self.max_pages), -1, np.int32)
+        drafts: dict[str, list[int]] = {}
+        for req in ready:
+            d = self._drafts.get(req.req_id, [])
+            drafts[req.req_id] = d
+            toks[req.slot, 0] = req.tokens[-1]
+            if d:
+                toks[req.slot, 1:1 + len(d)] = d
+            wins[req.slot] = 1 + len(d)
+            lens[req.slot] = req.kv_len
+            pages = alloc.pages(req.req_id)
+            table[req.slot, :len(pages)] = pages
+        if self._mk is not None:
+            # The lane was compiled with spec_window == W (it rebuilds
+            # through _build_megakernel_lane on every spec-state change).
+            try:
+                if self._mk_ws is None:
+                    self._mk_ws = self._mk.start()
+                t0 = self.clock()
+                with obs_trace.span("serving.verify_step_megakernel",
+                                    batch=len(ready), window=W):
+                    self._mk_ws, ver = self._mk.step(
+                        self._mk_ws, toks, lens, table, wins)
+                    ver_np = np.asarray(ver)
+            except Exception as exc:
+                from triton_distributed_tpu import resilience
+
+                if not resilience.is_transient(exc):
+                    raise
+                self._mk_decode_failed(ready, exc)
+                return
+            self._spec_tail(ready, drafts, ver_np, t0,
+                            self._mk.last_step_cold)
+            return
+        table[table < 0] = self.scratch_page
+        cache = self._cache._replace(page_table=jnp.asarray(table),
+                                     kv_lens=jnp.asarray(lens))
+        eng._jit_compiled_last_call = False
+        t0 = self.clock()
+        try:
+            with obs_trace.span("serving.verify_step", batch=len(ready),
+                                window=W):
+                ver, self._cache = self._verify_jit()(
+                    eng.params, jnp.asarray(toks), cache)
+                ver_np = np.asarray(ver)
+        except Exception as exc:
+            from triton_distributed_tpu import resilience
+            from triton_distributed_tpu.resilience import fleet as fleet_mod
+
+            if not resilience.is_transient(exc):
+                raise
+            if fleet_mod.attribute_rank(exc) is not None:
+                # A rank-attributable failure is the FLEET's to judge
+                # (evacuate / retry on kept geometry) — disabling the
+                # spec lane would mask the real fault and forfeit the
+                # lane for a problem it did not cause.
+                raise
+            self._spec_disable(
+                f"verify step failed: {type(exc).__name__}: "
+                f"{str(exc)[:120]}")
+            return
+        self._spec_tail(ready, drafts, ver_np, t0,
+                        eng._jit_compiled_last_call)
+
+    def _spec_tail(self, ready: list[Request], drafts: dict,
+                   ver_np, t0: float, cold: bool) -> None:
+        """Acceptance + rollback: keep each slot's longest accepted
+        prefix (models/sampling.accept_longest_prefix — the shared
+        rule), publish the accept-rate evidence, then release every
+        page the accepted prefix does not occupy (append-then-truncate:
+        rejected-draft KV bytes never stay resident)."""
+        alloc = self.sched.allocator
+        accepted: dict[str, list[int]] = {}
+        drafted_total = 0
+        accepted_drafts = 0
+        for req in ready:
+            d = drafts.get(req.req_id, [])
+            acc = sampling.accept_longest_prefix(
+                d, ver_np[req.slot][:len(d) + 1])
+            accepted[req.req_id] = [int(t) for t in acc]
+            drafted_total += len(d)
+            accepted_drafts += len(acc) - 1
+            req.drafted_tokens += len(d)
+            req.accepted_draft_tokens += len(acc) - 1
+        self._last_spec = (drafted_total, accepted_drafts)
+        if self._observing():
+            reg = obs_metrics.registry()
+            reg.counter(obs_metrics.SPEC_DRAFT_TOKENS,
+                        "draft candidate tokens proposed to verify "
+                        "steps").inc(drafted_total)
+            reg.counter(obs_metrics.SPEC_ACCEPTED_TOKENS,
+                        "draft tokens the greedy verifier accepted"
+                        ).inc(accepted_drafts)
+            reg.gauge(obs_metrics.SPEC_ACCEPT_RATE,
+                      "per-iteration accepted/drafted draft-token ratio "
+                      "(1.0 when nothing was drafted — vacuously "
+                      "accepted)").set(
+                accepted_drafts / drafted_total if drafted_total else 1.0)
+        self._decode_tail(ready, accepted, t0, cold)
+        for req in ready:
+            # FINISHED requests already freed everything (free_tail is a
+            # no-op for unknown owners); RUNNING ones shrink to exactly
+            # ceil(kv_len / page) — the one-token post-step baseline the
+            # occupancy gauge is asserted against.
+            alloc.free_tail(req.req_id, -(-req.kv_len // self.page))
+
+    def _decode_tail(self, ready: list[Request], new_tokens: dict,
+                     t0: float, cold: bool) -> None:
+        """The per-step bookkeeping EVERY decode backend shares (metrics,
         rolling rate, token append/finish) — one copy, so a dense-path
-        change can never silently skip the persistent lane."""
+        change can never silently skip the persistent lane.
+        ``new_tokens``: req_id → tokens this step produced (singleton
+        lists on the one-token paths; 1..k+1 accepted tokens from the
+        spec lane — the ledger and the rolling tokens/s gauge count
+        exactly what was accepted)."""
         now = self.clock()
+        total = sum(len(v) for v in new_tokens.values())
         rt = obs_reqtrace.get_tracer()
         if rt is not None:
             backend = self.engine.backend
             for req in ready:
                 rt.span(req.req_id, "decode_step", t0, now,
-                        backend=backend)
+                        backend=backend,
+                        tokens=len(new_tokens[req.req_id]))
                 if rt.breakdown(req.req_id) is None:
                     # This request's FIRST decode step: close its TTFT
                     # decomposition window and publish the components.
@@ -1172,16 +1461,17 @@ class ServingEngine:
         if self._observing():
             reg = obs_metrics.registry()
             reg.counter("tdtpu_tokens_generated_total",
-                        "decode tokens generated").inc(len(ready))
+                        "decode tokens generated").inc(total)
             Engine._observe_step(
                 reg, (now - t0) * 1e3, cold,
                 "tdtpu_decode_step_latency_ms",
                 "one decode step, wall (device-synced only in sync runs)")
-        self.total_tokens += len(ready)
-        self._rate_events.append((now, len(ready)))
+        self.total_tokens += total
+        self._rate_events.append((now, total))
         for req in list(ready):
-            req.tokens.append(int(tok_np[req.slot]))
-            req.kv_len += 1
+            ts = new_tokens[req.req_id]
+            req.tokens.extend(ts)
+            req.kv_len += len(ts)
             if req.done:
                 self._finish(req)
 

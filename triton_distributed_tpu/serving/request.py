@@ -85,6 +85,8 @@ class Request:
     preemptions: int = 0
     migrations: int = 0              # completed KV migrations (disagg tier)
     evacuations: int = 0             # fleet preempt-alls this request rode
+    drafted_tokens: int = 0          # spec lane: draft candidates proposed
+    accepted_draft_tokens: int = 0   # spec lane: drafts the verifier kept
     final_backend: str | None = None  # engine backend at finish time
     arrival_seq: int = -1            # admission order stamp (scheduler)
 

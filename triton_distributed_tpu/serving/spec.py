@@ -1,0 +1,100 @@
+"""Self-drafting speculative-decode proposer for the serving tier.
+
+Prompt-lookup / n-gram drafting (Saxena 2023, "Prompt Lookup Decoding";
+the self-drafting arm of Leviathan et al. 2023): candidate tokens are
+proposed from the request's OWN history — find the most recent earlier
+occurrence of the trailing n-gram of ``prompt + generated`` and propose
+the tokens that followed it. No second model, no device work, and fully
+deterministic, so a seeded serving run with drafting on replays
+bit-identically (the repo's token-parity oracle culture extends to the
+draft stream).
+
+The verify side lives in ``models/dense.dense_verify_step_paged`` (xla)
+and the megakernel draft-and-verify queue rows
+(``megakernel/serving.PagedMegakernelDecoder(spec_window=...)``);
+acceptance is ``models/sampling.accept_longest_prefix`` — greedy
+verification makes the whole lane lossless (docs/serving.md
+"Speculative decode").
+"""
+
+from __future__ import annotations
+
+import os
+
+
+class SpecConfigError(ValueError):
+    """A speculative-decode parameter is invalid — named, up front (the
+    ``_check_decode_step_config`` style)."""
+
+
+def _env_int(var: str, default: int) -> int:
+    try:
+        return int(os.environ.get(var, "") or default)
+    except ValueError:
+        return default
+
+
+class NGramProposer:
+    """Per-slot deterministic n-gram draft of up to ``k`` tokens.
+
+    ``ngram`` is the LONGEST suffix matched (falling back to shorter
+    suffixes down to ``min_ngram`` — a longer match is stronger evidence
+    the continuation repeats); ``lookback`` bounds how far back the scan
+    walks (host cost stays O(lookback) per step on long generations).
+    Defaults come from ``TDTPU_SPEC_NGRAM`` (3), ``TDTPU_SPEC_MIN_NGRAM``
+    (1) and ``TDTPU_SPEC_LOOKBACK`` (512). ``propose`` returns 0..k
+    tokens — an empty draft just means this step verifies one position,
+    i.e. plain one-token decode for that slot.
+    """
+
+    def __init__(self, k: int, *, ngram: int | None = None,
+                 min_ngram: int | None = None,
+                 lookback: int | None = None):
+        if k < 1:
+            raise SpecConfigError(
+                f"k = {k} invalid: a proposer drafts at least one "
+                "candidate token (spec_k=0 disables the lane instead) — "
+                "argument k")
+        self.k = int(k)
+        self.ngram = (int(ngram) if ngram is not None
+                      else max(1, _env_int("TDTPU_SPEC_NGRAM", 3)))
+        self.min_ngram = (int(min_ngram) if min_ngram is not None
+                          else max(1, _env_int("TDTPU_SPEC_MIN_NGRAM", 1)))
+        if self.min_ngram > self.ngram:
+            raise SpecConfigError(
+                f"min_ngram = {self.min_ngram} > ngram = {self.ngram}: "
+                "the fallback ladder must descend — arguments "
+                "ngram/min_ngram (TDTPU_SPEC_NGRAM/TDTPU_SPEC_MIN_NGRAM)")
+        self.lookback = (int(lookback) if lookback is not None
+                         else max(1, _env_int("TDTPU_SPEC_LOOKBACK", 512)))
+
+    @property
+    def window_tokens(self) -> int:
+        """Trailing history tokens the proposer ever examines — hot-path
+        callers slice to this instead of materializing whole
+        prompt+generated lists per slot per iteration."""
+        return self.lookback + self.ngram
+
+    def propose(self, history, max_tokens: int | None = None) -> list[int]:
+        """Draft up to ``min(k, max_tokens)`` tokens continuing
+        ``history`` (the request's ``prompt + tokens``). Most recent
+        match wins (recency beats frequency for repetitive serving
+        traffic); longest n-gram wins over shorter fallbacks. Only the
+        trailing ``window_tokens`` are examined, so host cost per call
+        is bounded by the lookback, not the sequence length."""
+        cap = self.k if max_tokens is None else min(self.k, max_tokens)
+        if cap < 1:
+            return []
+        hist = [int(t) for t in history[-self.window_tokens:]]
+        n = len(hist)
+        for g in range(min(self.ngram, n - 1), self.min_ngram - 1, -1):
+            key = hist[n - g:]
+            # Scan backwards for the most recent earlier occurrence whose
+            # continuation is non-empty (an occurrence ending at the very
+            # tail IS the query itself).
+            for s in range(n - g - 1, -1, -1):
+                if hist[s:s + g] == key:
+                    cont = hist[s + g:s + g + cap]
+                    if cont:
+                        return cont
+        return []
