@@ -957,6 +957,21 @@ def _serving_metric():
         out["spec_accepted_tokens"] = sp["spec_accepted_tokens"]
     except Exception as e:
         out["serving_spec_error"] = f"{type(e).__name__}: {str(e)[:120]}"
+    # Round 15: the prefix-cache rung (shared-preamble families served
+    # through a warm radix index — only divergent tails prefill) races
+    # the cold rung in the same window; the TTFT delta is what prefix
+    # reuse buys a multi-tenant fleet (docs/serving.md "Prefix cache").
+    # Additive.
+    try:
+        from triton_distributed_tpu.serving.loadgen import (
+            warm_serving_bench_rung,
+        )
+
+        wm = warm_serving_bench_rung(n_streams=8, prompt_len=128,
+                                     max_new=16)
+        out.update(wm)
+    except Exception as e:
+        out["serving_warm_error"] = f"{type(e).__name__}: {str(e)[:120]}"
     # Round 10: the disaggregated tier races the monolithic rung in the
     # same window (`serve_tokens_per_s_disagg` — prefill role on chip 0,
     # decode role on chip 1, checksummed KV-migration streams included

@@ -1,0 +1,455 @@
+"""Prefix-reuse subsystem (ISSUE 15, docs/serving.md "Prefix cache").
+
+The load-bearing contract: warm serve (a request whose prompt prefix is
+resident in the radix-indexed page pool) must be TOKEN-IDENTICAL to
+cold serve on both the xla and megakernel backends — including a
+preempt/resume of a sharing request and a copy-on-write whose divergent
+suffix crosses a page boundary — while refcounted pages are counted
+once in the pool accounting, preempting a sharer never frees or
+corrupts a page another reader holds, and cold cached chains evict in
+refcount×recency order under pool pressure.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.models.config import ModelConfig, tiny_config
+from triton_distributed_tpu.models.dense import init_dense_llm
+from triton_distributed_tpu.models.engine import Engine
+from triton_distributed_tpu.models.kv_cache import (
+    PageAllocator, PageRefError,
+)
+from triton_distributed_tpu.obs import metrics as obs_metrics
+from triton_distributed_tpu.runtime import initialize_distributed
+from triton_distributed_tpu.serving.loop import ServingEngine
+from triton_distributed_tpu.serving.prefix import (
+    PrefixCache, PrefixConfigError,
+)
+
+
+@pytest.fixture(scope="module")
+def ctx1():
+    return initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                  devices=jax.devices()[:1])
+
+
+@pytest.fixture(scope="module")
+def tiny(ctx1):
+    cfg = tiny_config()
+    params = init_dense_llm(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def _golden(engine, prompt, gen):
+    return np.asarray(
+        engine.serve(jnp.asarray([prompt], jnp.int32), gen_len=gen)
+    )[0].tolist()
+
+
+# ---------------------------------------------------------------------------
+# PageAllocator refcounts — share = +ref, free = −ref, physical at zero.
+# ---------------------------------------------------------------------------
+
+def test_share_and_free_refcounted():
+    al = PageAllocator(8, 8)
+    got = al.alloc_pages("a", 2)
+    assert got == [0, 1] and al.ref_count(0) == 1
+    al.share_pages("b", got)
+    assert al.ref_count(0) == 2 and al.pages("b") == [0, 1]
+    assert al.free_count == 6
+    # Freeing one sharer releases references, not bytes.
+    al.free_pages("a")
+    assert al.ref_count(0) == 1 and al.free_count == 6
+    al.free_pages("b")
+    assert al.ref_count(0) == 0 and al.free_count == 8
+
+
+def test_named_ref_errors():
+    al = PageAllocator(4, 4)
+    with pytest.raises(PageRefError, match="share of page"):
+        al.share_pages("x", [2])        # free page: nothing to share
+    with pytest.raises(PageRefError, match="incref of page"):
+        al.incref(1)
+    al.alloc_pages("a", 1)
+    al.free_pages("a")
+    with pytest.raises(PageRefError, match="reference count is already"):
+        al.decref(0)
+    with pytest.raises(PageRefError, match="COW of page"):
+        al.cow_page("a", 0)             # owner holds nothing
+
+
+def test_cow_page_replaces_in_place():
+    al = PageAllocator(8, 8)
+    pages = al.alloc_pages("a", 3)
+    al.share_pages("b", [pages[1]])
+    new = al.cow_page("b", pages[1])
+    assert new is not None and new != pages[1]
+    assert al.pages("b") == [new]              # same position, private
+    assert al.ref_count(pages[1]) == 1         # a's reference survives
+    assert al.ref_count(new) == 1
+
+
+def test_free_tail_respects_sharers():
+    al = PageAllocator(8, 8)
+    pages = al.alloc_pages("a", 3)
+    al.share_pages("b", [pages[2]])
+    assert al.free_tail("a", 1) == 2           # released 2 references
+    # Page 2 had a second reader: it must NOT have rejoined the pool.
+    assert al.ref_count(pages[2]) == 1
+    assert al.free_count == 8 - 2              # pages 1 freed, 0+2 held
+
+
+# ---------------------------------------------------------------------------
+# PrefixCache — radix index, partial-tail match, eviction order.
+# ---------------------------------------------------------------------------
+
+def test_prefix_config_error():
+    with pytest.raises(PrefixConfigError, match="page_size"):
+        PrefixCache(PageAllocator(4, 4), 0)
+
+
+def test_match_full_and_partial_with_cap():
+    al = PageAllocator(16, 16)
+    cache = PrefixCache(al, 4)
+    toks = list(range(30, 46))                  # 16 tokens = 4 pages
+    pages = al.alloc_pages("a", 4)
+    assert cache.insert(toks, pages) == 4
+    # Identical prompt: cap at len-1 → 3 full pages + 3-token partial.
+    hit, full, partial = cache.match(toks)
+    assert hit == 15 and full == pages[:3] and partial == pages[3]
+    # Divergence INSIDE page 2: LCP partial match.
+    q = toks[:9] + [99, 98, 97, 96]
+    hit, full, partial = cache.match(q)
+    assert hit == 9 and full == pages[:2] and partial == pages[2]
+    # No overlap at all.
+    hit, full, partial = cache.match([1, 2, 3, 4, 5])
+    assert (hit, full, partial) == (0, [], None)
+    # match is a READ-ONLY probe: stats move only on commit_match (the
+    # committed admission), so a pool-short retry can't inflate them.
+    assert cache.hits == 0 and cache.lookups == 0
+    cache.commit_match(toks, 15)
+    cache.commit_match([1, 2, 3, 4, 5], 0)
+    assert cache.hits == 1 and cache.lookups == 2
+    assert cache.tokens_saved == 15
+
+
+def test_eviction_refcount_times_recency():
+    al = PageAllocator(8, 8)
+    cache = PrefixCache(al, 2)
+    a = al.alloc_pages("a", 2)
+    b = al.alloc_pages("b", 2)
+    cache.insert([1, 2, 3, 4], a)              # chain A (older)
+    cache.insert([5, 6, 7, 8], b)              # chain B (newer)
+    al.free_pages("b")                          # B pages now cache-only
+    hit_b = cache.match([5, 6, 7, 8, 9])[0]
+    cache.commit_match([5, 6, 7, 8, 9], hit_b)  # ...but recently used
+    al.free_pages("a")                          # A cache-only, colder
+    # Chain A's pages still carry a live sharer? No — both are
+    # cache-only; A is colder, so A's LEAF evicts first.
+    freed = cache.reclaim(1)
+    assert freed == 1
+    assert a[1] not in cache._pages and a[0] in cache._pages
+    # A page with a live reader is never evictable, however cold.
+    al.share_pages("c", [a[0]])
+    assert cache.reclaim(10) == 2               # b's two leaves... a[0] kept
+    assert a[0] in cache._pages and not any(
+        p in cache._pages for p in (a[1], b[0], b[1]))
+
+
+def test_reclaim_via_alloc_pages_hook():
+    al = PageAllocator(4, 4)
+    cache = PrefixCache(al, 2)
+    pages = al.alloc_pages("a", 4)
+    cache.insert([1, 2, 3, 4, 5, 6, 7, 8], pages)
+    al.free_pages("a")                          # all 4 pages cache-held
+    assert al.free_count == 0 and al.reclaimable() == 4
+    # A fresh allocation evicts cold chains instead of failing.
+    got = al.alloc_pages("b", 2)
+    assert got is not None and len(got) == 2
+    assert cache.evictions >= 2
+
+
+def test_invalidate_releases_everything():
+    al = PageAllocator(4, 4)
+    cache = PrefixCache(al, 2)
+    pages = al.alloc_pages("a", 2)
+    cache.insert([1, 2, 3, 4], pages)
+    al.free_pages("a")
+    assert cache.invalidate() == 2
+    assert al.free_count == 4 and cache.pages_held == 0
+    assert cache.match([1, 2, 3, 4, 5]) == (0, [], None)
+
+
+# ---------------------------------------------------------------------------
+# Warm serve — token parity vs cold, xla backend.
+# ---------------------------------------------------------------------------
+
+def test_warm_serve_parity_and_cow_across_page_boundary(ctx1, tiny):
+    """The acceptance shape: request D indexes a 4-full-page chain;
+    request F shares 3 full pages + a partial page (divergence INSIDE
+    page 3) so its suffix write COWs the boundary page AND continues
+    into the next page — token-identical to cold serve throughout."""
+    cfg, params = tiny
+    engine = Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                    page_size=4)
+    se = ServingEngine(engine, max_batch=2, num_pages=16,
+                       prefill_chunk=4, prefix_cache=True)
+    pre = list(range(10, 22))
+    pD = pre + [3, 5, 8, 9]                     # 16 tokens: 4 full pages
+    pF = pre + [3, 5, 8, 30, 31, 32]            # diverges inside page 3
+    gD = _golden(engine, pD, 5)
+    gF = _golden(engine, pF, 6)
+    rD, _ = se.submit(pD, 5, req_id="D")
+    se.run()
+    assert rD.tokens == gD and rD.prefix_hit_tokens_total == 0
+    rF, _ = se.submit(pF, 6, req_id="F")
+    se.run()
+    assert rF.tokens == gF
+    assert rF.prefix_hit_tokens_total == 15     # 12 full + 3 partial
+    # Identical full prompt warm: cap at len-1.
+    rD2, _ = se.submit(pD, 5, req_id="D2")
+    se.run()
+    assert rD2.tokens == gD and rD2.prefix_hit_tokens_total == 15
+    # Pool accounting exact: refcounted pages counted once.
+    al = se.sched.allocator
+    assert al.free_count + se.prefix.pages_held == al.usable_pages
+    assert se.prefix.pages_shared_peak > 0
+
+
+def test_preempt_resume_of_sharer_with_parity(ctx1, tiny):
+    """Preempting a sharer mid-decode releases only ITS references;
+    the survivor keeps decoding off the shared pages and the preempted
+    request resumes (warm, off the surviving chain) with parity."""
+    cfg, params = tiny
+    engine = Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                    page_size=4)
+    se = ServingEngine(engine, max_batch=2, num_pages=12,
+                       prefill_chunk=4, prefix_cache=True)
+    pre = list(range(40, 52))
+    pA = pre + [3, 5]
+    pB = pre + [7, 9]
+    gA = _golden(engine, pA, 8)
+    gB = _golden(engine, pB, 8)
+    r0, _ = se.submit(pA, 8, req_id="s-0", priority=1)
+    se.run()
+    rA, _ = se.submit(pB, 8, req_id="s-A", priority=1)
+    rB, _ = se.submit(pA, 8, req_id="s-B", priority=0)
+    for _ in range(5):
+        se.step()
+    assert rA.prefix_hit_tokens_total > 0
+    assert rB.prefix_hit_tokens_total > 0
+    shared_before = {p: np.asarray(se._cache.k_pools)[:, p].copy()
+                     for p in sorted(se.prefix._pages)}
+    se.sched._preempt(rB)                      # evict the sharer
+    pools = np.asarray(se._cache.k_pools)
+    for p, before in shared_before.items():
+        assert np.array_equal(pools[:, p], before)
+    se.run()
+    assert r0.tokens == gA and rA.tokens == gB and rB.tokens == gA
+
+
+def test_decode_time_cow_copies_the_page(ctx1, tiny):
+    """The general COW guard: an append target still carrying another
+    reader is replaced by a private byte-copy before the launch."""
+    cfg, params = tiny
+    engine = Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                    page_size=4)
+    se = ServingEngine(engine, max_batch=1, num_pages=8,
+                       prefill_chunk=4, prefix_cache=True)
+    r, _ = se.submit(list(range(60, 66)), 8, req_id="cow-0")
+    for _ in range(4):
+        se.step()
+    assert r.state.name == "RUNNING"
+    al = se.sched.allocator
+    pages = al.pages(r.req_id)
+    target = pages[r.kv_len // se.page]
+    se.prefix.pin(target)                      # simulate a second reader
+    assert al.ref_count(target) == 2
+    golden = _golden(engine, list(range(60, 66)), 8)
+    se.run()
+    # The request never wrote the pinned page: it was COW'd away.
+    assert al.pages(r.req_id) == []            # finished, refs released
+    assert al.ref_count(target) == 1           # the pin's ref survives
+    assert r.tokens == golden
+    se.prefix.unpin(target)
+
+
+def test_partial_pin_precedes_suffix_alloc():
+    """The partially-matched page must be pinned BEFORE the suffix
+    allocation: ``alloc_pages``' reclaim hook may otherwise evict (and
+    physically free) a cold, cache-only partial page between the match
+    and the pin — pinning a freed page is a PageRefError that would
+    kill the serving loop on a routine warm admission."""
+    from triton_distributed_tpu.serving.request import Request
+    from triton_distributed_tpu.serving.scheduler import Scheduler
+
+    al = PageAllocator(6, 6)
+    cache = PrefixCache(al, 4)
+    sched = Scheduler(num_slots=2, allocator=al, page_size=4,
+                      capacity_tokens=24, max_waiting=4, prefix=cache)
+    toks = list(range(10, 18))            # 8 tokens: 2 chunks
+    pages = al.alloc_pages("seed", 2)
+    cache.insert(toks, pages)
+    al.free_pages("seed")                 # chain is cache-only (evictable)
+    partial_page = pages[1]
+    seen = {}
+    real_alloc = al.alloc_pages
+
+    def spy(owner, n=1):
+        seen["ref_at_alloc"] = al.ref_count(partial_page)
+        return real_alloc(owner, n)
+
+    al.alloc_pages = spy
+    req = Request(prompt=toks[:6] + [99, 98, 97], max_new_tokens=2)
+    sched.admit(req, 0.0)
+    admitted = sched.schedule_admissions()
+    assert [r.req_id for r in admitted] == [req.req_id]
+    # Cache ref + the admission's read-pin, already held when the
+    # suffix allocation (and so any reclaim it triggers) ran.
+    assert seen["ref_at_alloc"] == 2
+    assert req._prefix_partial == partial_page
+    assert al.ref_count(partial_page) == 2
+
+
+def test_admission_undo_when_pool_short(ctx1, tiny):
+    """A warm admission whose fresh-suffix reservation fails must undo
+    its shares and stay queued whole — no leaked references."""
+    cfg, params = tiny
+    engine = Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                    page_size=4)
+    se = ServingEngine(engine, max_batch=2, num_pages=6,
+                       prefill_chunk=4, prefix_cache=True)
+    pre = list(range(70, 82))
+    r0, _ = se.submit(pre + [1, 2], 10, req_id="u-0")
+    se.run()
+    # Occupy the pool with a long-running request so the warm
+    # follow-up's suffix cannot reserve.
+    r1, _ = se.submit(list(range(1, 13)), 10, req_id="u-1", priority=1)
+    for _ in range(6):
+        se.step()
+    refs_before = {p: se.sched.allocator.ref_count(p)
+                   for p in sorted(se.prefix._pages)}
+    r2, _ = se.submit(pre + [9, 8], 4, req_id="u-2", priority=0)
+    se.step()
+    if r2.state.name == "WAITING":
+        # No reference may have been ADDED by the failed admission
+        # (reclaim may legitimately have evicted cold cache-only pages
+        # between the snapshots — fewer refs is fine, more is a leak).
+        refs_after = {p: se.sched.allocator.ref_count(p)
+                      for p in sorted(se.prefix._pages)}
+        assert all(refs_after[p] <= refs_before.get(p, 1)
+                   for p in refs_after)
+        assert se.sched.allocator.pages("u-2") == []
+    se.run()
+
+
+# ---------------------------------------------------------------------------
+# Megakernel backend — warm parity + COW on the paged workspace.
+# ---------------------------------------------------------------------------
+
+def test_megakernel_warm_serve_parity_with_cow():
+    """Warm serve on the persistent paged workspace: the second
+    request's prefix (incl. an in-page divergence COW whose suffix
+    crosses into the next TILE page) reads resident pool tiles and
+    stays token-identical to cold xla serve."""
+    cfg = ModelConfig(hidden_size=256, intermediate_size=256,
+                      num_layers=2, num_heads=2, num_kv_heads=1,
+                      head_dim=128, vocab_size=512, qk_norm=True,
+                      dtype="float32")
+    params = init_dense_llm(jax.random.PRNGKey(1), cfg)
+    ctx = initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                 devices=jax.devices()[:1])
+    rng = np.random.default_rng(9)
+    base = rng.integers(0, 512, 264).tolist()   # 2 full pages + partial
+    pB = base[:250] + rng.integers(0, 512, 12).tolist()
+    oracle = Engine(cfg, params, ctx, backend="xla", max_seq=384)
+    gA = _golden(oracle, base, 4)
+    gB = _golden(oracle, pB, 4)
+    eng = Engine(cfg, params, ctx, backend="megakernel", max_seq=384,
+                 page_size=128)
+    se = ServingEngine(eng, max_batch=2, num_pages=8, prefill_chunk=128,
+                       prefix_cache=True)
+    rA, _ = se.submit(base, 4, req_id="mk-A")
+    se.run()
+    assert se._mk is not None, "lane demoted"
+    assert rA.tokens == gA
+    rB, _ = se.submit(pB, 4, req_id="mk-B")
+    se.run()
+    assert se._mk is not None and eng.backend == "megakernel"
+    assert rB.tokens == gB
+    assert rB.prefix_hit_tokens_total == 250    # 128 full + 122 partial
+
+
+# ---------------------------------------------------------------------------
+# Observability — series published, report contract.
+# ---------------------------------------------------------------------------
+
+def test_prefix_metrics_and_report_gate(ctx1, tiny, tmp_path):
+    from triton_distributed_tpu import obs
+    from triton_distributed_tpu.obs import report as obs_report
+
+    cfg, params = tiny
+    engine = Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                    page_size=4)
+    run_dir = str(tmp_path / "prefix-run")
+    obs.start_run(run_dir)
+    try:
+        se = ServingEngine(engine, max_batch=2, num_pages=16,
+                           prefill_chunk=4, prefix_cache=True)
+        pre = list(range(20, 32))
+        se.submit(pre + [1, 2], 4, req_id="g-0")
+        se.run()
+        se.submit(pre + [5, 6], 4, req_id="g-1")
+        se.run()
+        snap = obs_metrics.registry().snapshot()
+    finally:
+        obs.finish_run()
+    assert obs_metrics.PREFIX_HIT_RATE in snap
+    assert obs_metrics.PREFIX_PAGES_SHARED in snap
+    assert snap[obs_metrics.PREFIX_TOKENS_SAVED]["value"] > 0
+    assert snap[obs_metrics.PREFIX_HIT_RATE]["value"] > 0
+    rc = obs_report.main([run_dir, "--check"])
+    assert rc == 0
+
+
+def test_request_records_carry_prefix_hits(ctx1, tiny):
+    from triton_distributed_tpu.serving.loadgen import request_records
+
+    cfg, params = tiny
+    engine = Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                    page_size=4)
+    se = ServingEngine(engine, max_batch=2, num_pages=16,
+                       prefill_chunk=4, prefix_cache=True)
+    pre = list(range(33, 45))
+    r0, _ = se.submit(pre + [1], 3, req_id="rr-0")
+    se.run()
+    r1, _ = se.submit(pre + [2], 3, req_id="rr-1")
+    se.run()
+    recs = {r["req_id"]: r for r in request_records([r0, r1])}
+    assert recs["rr-0"]["prefix_hit_tokens"] == 0
+    assert recs["rr-1"]["prefix_hit_tokens"] > 0
+
+
+def test_shared_prefix_loadspec_deterministic():
+    from triton_distributed_tpu.serving.loadgen import (
+        LoadSpec, build_trace,
+    )
+
+    spec = LoadSpec(n_requests=6, seed=0, prefix_families=2,
+                    prefix_len=12)
+    t1 = build_trace(spec)
+    t2 = build_trace(spec)
+    assert t1 == t2
+    fams = {}
+    for item in t1:
+        fams.setdefault(item["family"], set()).add(
+            tuple(item["prompt"][:12]))
+    # One preamble per family, shared across its requests.
+    assert all(len(v) == 1 for v in fams.values()) and len(fams) == 2
+    # A different trace seed keeps the SAME preambles (warm-rung shape).
+    t3 = build_trace(LoadSpec(n_requests=6, seed=7, prefix_families=2,
+                              prefix_len=12))
+    assert t3[0]["prompt"][:12] == t1[0]["prompt"][:12]

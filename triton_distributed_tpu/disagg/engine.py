@@ -165,6 +165,15 @@ class DisaggServingEngine(ServingEngine):
         self.migrations_log: list[dict] = []
         self.migration_preemptions = 0   # streams cancelled by eviction
         self.demotion_reason: str | None = None
+        # Prefix-reuse interplay (docs/serving.md "Prefix cache"): a
+        # warm admission's hit was scored against the DECODE pool's
+        # index, so its short divergent suffix prefills on the decode
+        # engine directly — skipping both the prefill role AND the
+        # migration stream entirely. The counter is the loadgen
+        # dryrun's skip evidence; the warm requests' decode-mesh
+        # prefill buffer is built lazily.
+        self.prefix_disagg_skips = 0
+        self._warm_pf = None
         # Fault-injection point for the chaos plane (resilience/chaos.py):
         # hook(block_idx, (k, v)) -> (k, v) | None per landed block.
         self._migrate_chaos = None
@@ -198,17 +207,48 @@ class DisaggServingEngine(ServingEngine):
 
     # -- prefill lane on the prefill role ------------------------------------
     def _put_prefill(self, tree):
-        mesh = self.prefill_engine.ctx.mesh
-        specs = kv_cache_specs(self.prefill_engine.shard_axes)
-        return jax.device_put(
-            tree, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
-                               is_leaf=lambda x: isinstance(x, P)))
+        return self._put_sharded(
+            tree, kv_cache_specs(self.prefill_engine.shard_axes),
+            mesh=self.prefill_engine.ctx.mesh)
 
-    def _prefill_lane(self):
-        if not self.disagg_active:
-            return super()._prefill_lane()
+    def _is_warm(self, req: Request) -> bool:
+        """Warm = admitted off the DECODE pool's prefix index: its
+        suffix stays on the decode slice (no prefill role, no
+        migration)."""
+        return req.prefix_hit_tokens > 0
+
+    def _prefill_lane(self, req: Request):
+        if not self.disagg_active or self._is_warm(req):
+            return super()._prefill_lane(req)
         return (self.prefill_engine, self._pslice_jit(),
                 self._plogits_jit())
+
+    def _pf_get(self, req: Request):
+        if not self.disagg_active or not self._is_warm(req):
+            return self._pf_cache
+        if self._warm_pf is None:
+            # Decode-mesh buffer for warm suffixes: the prefix gather
+            # reads the decode pool, the suffix slices run on the
+            # decode engine, and the scatter lands locally.
+            self._warm_pf = self._put_sharded(
+                init_kv_cache(self.cfg, 1, self.s_buf),
+                kv_cache_specs(self.engine.shard_axes))
+        return self._warm_pf
+
+    def _pf_set(self, req: Request, cache) -> None:
+        if self.disagg_active and self._is_warm(req):
+            self._warm_pf = cache
+        else:
+            self._pf_cache = cache
+
+    def _reset_pf_buffer(self, req: Request) -> None:
+        if not self.disagg_active:
+            return super()._reset_pf_buffer(req)
+        if self._is_warm(req):
+            self._warm_pf = None       # rebuilt lazily on next warm head
+        else:
+            self._pf_cache = self._put_prefill(
+                init_kv_cache(self.cfg, 1, self.s_buf))
 
     def _pslice_jit(self):
         from triton_distributed_tpu.models.dense import dense_prefill_slice
@@ -291,6 +331,15 @@ class DisaggServingEngine(ServingEngine):
     # -- migration ------------------------------------------------------------
     def _complete_prefill(self, req: Request) -> None:
         if not self.disagg_active:
+            return super()._complete_prefill(req)
+        if self._is_warm(req):
+            # The decode-pool prefix hit: suffix KV is already on the
+            # decode mesh (warm buffer) — scatter locally, never touch
+            # the prefill role or the migration stream.
+            self.prefix_disagg_skips += 1
+            with obs_trace.span("disagg.prefix_skip", req=req.req_id,
+                                hit_tokens=req.prefix_hit_tokens):
+                pass
             return super()._complete_prefill(req)
         if req.done:
             # max_new_tokens == 1: the prefill logits produced the only
@@ -419,6 +468,15 @@ class DisaggServingEngine(ServingEngine):
                     pass
                 req.advance(RequestState.RUNNING)
                 req.migrations += 1
+                if self.prefix is not None:
+                    # The migrated chain is now resident in the DECODE
+                    # pool — index it there (the cold half of the
+                    # prefix-hit-skips-migration interplay: the NEXT
+                    # admission sharing this prefix never migrates).
+                    n_pg = -(-req.kv_len // self.page)
+                    self.prefix.insert(
+                        req.text[:req.kv_len],
+                        self.sched.allocator.pages(rid)[:n_pg])
                 if rt is not None:
                     rt.mark(rid, "RUNNING", self.clock())
         return landed
@@ -461,6 +519,7 @@ class DisaggServingEngine(ServingEngine):
         # recompute-on-resume re-prefills and re-migrates).
         self.migration_preemptions += len(self._streams)
         self._streams.clear()
+        self._warm_pf = None      # decode mesh may have changed
         if self.disagg_active:
             # The base rebuild placed the prefill buffer on the DECODE
             # mesh (the monolithic layout); the active role split keeps
@@ -496,12 +555,9 @@ class DisaggServingEngine(ServingEngine):
         # The monolithic lane prefills through the decode engine: give it
         # a fresh buffer on the DECODE mesh (the prefill-mesh one holds a
         # preempted request's partial prompt at best).
-        mesh = self.engine.ctx.mesh
-        self._pf_cache = jax.device_put(
+        self._pf_cache = self._put_sharded(
             init_kv_cache(self.cfg, 1, self.s_buf),
-            jax.tree.map(lambda s: NamedSharding(mesh, s),
-                         kv_cache_specs(self.engine.shard_axes),
-                         is_leaf=lambda x: isinstance(x, P)))
+            kv_cache_specs(self.engine.shard_axes))
         with obs_trace.span("disagg.demotion", reason=reason,
                             recomputed=len(recomputed)):
             pass

@@ -491,7 +491,8 @@ class PagedMegakernelDecoder:
         self._base_queue = q0
         self._table_rows = -(-2 * max_pages // WORDS)
         self._step_jit = jax.jit(self._step, donate_argnums=(0, 1))
-        self._load_jits: dict = {}      # page count -> jitted loader
+        self._load_jits: dict = {}  # (page count, offset) -> jitted loader
+        self._copy_jit = None       # COW page-tile copy (copy_page)
         # Rope tables depend only on the integer position: cache the
         # COMPACT (TILE,) row per position (every row of the broadcast
         # table is identical) — ~1 KB per visited position instead of
@@ -517,33 +518,78 @@ class PagedMegakernelDecoder:
             return ws, self.comp.make_workspace_kv8()
         return ws
 
-    def load_prefill(self, ws, k_lin, v_lin, pages: list[int]):
+    def load_prefill(self, ws, k_lin, v_lin, pages: list[int], *,
+                     first_page: int = 0):
         """Scatter a finished prefill's KV into the slot's pool pages.
         ``k_lin``/``v_lin``: the linear prefill buffer (L, 1, S_buf,
         hkv, head_dim); page ``pages[i]`` receives positions
-        [i*TILE, (i+1)*TILE). ONE jitted donated update per page count —
-        un-jitted per-tile scatters would each copy the whole (multi-GB
-        at the bench shapes) workspace. fp8 pools quantize here through
-        the SAME saturating cast the dense scatter uses (token parity
-        across backends depends on the two quantizing identically)."""
+        [(first_page+i)*TILE, (first_page+i+1)*TILE) — ``first_page``
+        skips a warm admission's shared prefix pages (already resident
+        in the workspace and never to be rewritten; docs/serving.md
+        "Prefix cache"). ONE jitted donated update per (page count,
+        offset) — un-jitted per-tile scatters would each copy the whole
+        (multi-GB at the bench shapes) workspace. fp8 pools quantize
+        here through the SAME saturating cast the dense scatter uses
+        (token parity across backends depends on the two quantizing
+        identically)."""
         for p in pages:
             if not 0 <= int(p) < self.num_pages:
                 raise ValueError(
                     f"page id {p} outside the usable pool "
                     f"[0, {self.num_pages}) — the scratch page is "
                     "reserved")
-        fn = self._load_jits.get(len(pages))
+        if first_page < 0:
+            raise ValueError(
+                f"first_page = {first_page} invalid: the buffer offset "
+                "counts skipped prefix pages — argument first_page")
+        key = (len(pages), first_page)
+        fn = self._load_jits.get(key)
         if fn is None:
-            fn = jax.jit(functools.partial(self._load_pages, len(pages)),
+            fn = jax.jit(functools.partial(self._load_pages, len(pages),
+                                           first_page),
                          donate_argnums=(0,))
-            self._load_jits[len(pages)] = fn
+            self._load_jits[key] = fn
         pg = jnp.asarray(pages, jnp.int32)
         if self.kv_fp8:
             ws_main, wk8 = ws
             return ws_main, fn(wk8, k_lin, v_lin, pg)
         return fn(ws, k_lin, v_lin, pg)
 
-    def _load_pages(self, n_pages, ws, k_lin, v_lin, pages):
+    def copy_page(self, ws, src: int, dst: int):
+        """One pool-page copy — the megakernel half of copy-on-write
+        (docs/serving.md "Prefix cache"): page tables are DATA here, so
+        COW is this host-side tile copy plus the allocator's row
+        rewrite. Copies the (kT, v) tiles of every (layer, kv-head)
+        pool from ``src`` to ``dst`` in the workspace that owns the KV
+        pools (the fp8 KV workspace under ``kv_fp8``)."""
+        for name, p in (("src", src), ("dst", dst)):
+            if not 0 <= int(p) < self.num_pages:
+                raise ValueError(
+                    f"copy_page {name} page id {p} outside the usable "
+                    f"pool [0, {self.num_pages}) — the scratch page is "
+                    "reserved")
+        fn = self._copy_jit
+        if fn is None:
+            fn = jax.jit(self._copy_page_impl, donate_argnums=(0,))
+            self._copy_jit = fn
+        s, d = jnp.int32(int(src)), jnp.int32(int(dst))
+        if self.kv_fp8:
+            ws_main, wk8 = ws
+            return ws_main, fn(wk8, s, d)
+        return fn(ws, s, d)
+
+    def _copy_page_impl(self, ws, src, dst):
+        for h in self.prog.layers:
+            for kv in range(self.cfg.num_kv_heads):
+                for handle in (h.kT[kv], h.v[kv]):
+                    t0 = handle.tile(0, 0)
+                    tile = jax.lax.dynamic_slice(
+                        ws, (t0 + src, 0, 0), (1, TILE, TILE))
+                    ws = jax.lax.dynamic_update_slice(
+                        ws, tile, (t0 + dst, 0, 0))
+        return ws
+
+    def _load_pages(self, n_pages, first_page, ws, k_lin, v_lin, pages):
         # ``ws`` is the MAIN workspace normally, the fp8 KV pool
         # workspace under kv_fp8 (the pool tile ids index whichever
         # space the program allocated them in).
@@ -560,8 +606,9 @@ class PagedMegakernelDecoder:
                 v0 = h.v[kv].tile(0, 0)
                 for i in range(n_pages):
                     p = pages[i]
-                    ksl = k_lin[li, 0, i * TILE:(i + 1) * TILE, kv, :]
-                    vsl = v_lin[li, 0, i * TILE:(i + 1) * TILE, kv, :]
+                    b = first_page + i      # buffer page (pool page p)
+                    ksl = k_lin[li, 0, b * TILE:(b + 1) * TILE, kv, :]
+                    vsl = v_lin[li, 0, b * TILE:(b + 1) * TILE, kv, :]
                     kT = ksl.astype(jnp.float32).T          # (hd, TILE)
                     vv = vsl.astype(jnp.float32)            # (TILE, hd)
                     if hd < TILE:

@@ -9,6 +9,7 @@ when the cache is donated), sharded over the TP axis on the KV-head dim.
 
 from __future__ import annotations
 
+import bisect
 from typing import NamedTuple
 
 import jax
@@ -110,6 +111,16 @@ class PageBudgetError(ValueError):
     so the serving scheduler can preempt instead of dying)."""
 
 
+class PageRefError(ValueError):
+    """A refcount invariant of the shared page pool was violated —
+    sharing a page nobody holds a reference to, releasing a page whose
+    count is already zero, or COW-replacing a page the owner does not
+    hold. These were silent assumptions before the prefix-reuse
+    subsystem (docs/serving.md "Prefix cache") made pages shareable;
+    now they are checkable invariants raised with the page id and the
+    offending operation named."""
+
+
 def _check_paged_pool_config(*, page_size: int, max_pages: int,
                              num_pages: int, batch: int) -> None:
     """Named up-front validation of the pool-sizing fields every paged
@@ -153,6 +164,22 @@ class PageAllocator:
     out of free pages — the scheduler's cue to preempt, not an error.
     Allocation order is deterministic (lowest free id first) so serving
     runs replay bit-identically.
+
+    Pages are REFCOUNTED (prefix-reuse subsystem, docs/serving.md
+    "Prefix cache"): a freshly allocated page carries one reference;
+    :meth:`share_pages` / :meth:`incref` add holders (another request
+    reading the same prefix KV, or the prefix cache pinning a resident
+    chain), :meth:`free_pages` / :meth:`free_tail` / :meth:`decref`
+    drop them, and the page physically returns to the free list only
+    when its count reaches zero — so preempting or finishing one sharer
+    can never free bytes another request still reads. Refcount misuse
+    raises the named :class:`PageRefError`.
+
+    ``reclaim`` / ``reclaimable`` hooks let a cache of evictable pages
+    (the prefix cache's cold chains) participate in the pool budget:
+    ``alloc_pages`` asks ``reclaim(n)`` to release references before
+    reporting exhaustion, and admission checks count ``reclaimable()``
+    pages as available.
     """
 
     def __init__(self, num_pages: int, max_pages: int, *,
@@ -165,6 +192,18 @@ class PageAllocator:
         self._free = sorted(set(range(num_pages)) - set(reserved),
                             reverse=True)   # pop() yields lowest id
         self._owned: dict = {}
+        self._refs: dict[int, int] = {}     # page id -> live references
+        # Monotone refcount-mutation epoch: bumped by every operation
+        # that changes any page's reference count, so derived views
+        # (PrefixCache.pages_shared) can memoize instead of rescanning
+        # the pool on the per-iteration serving path.
+        self._ref_epoch = 0
+        # Prefix-cache integration points (serving/prefix.py): reclaim(n)
+        # releases up to n evictable cached pages back to the free list;
+        # reclaimable() counts pages such a call could free. Both are
+        # optional — a tier without a prefix cache never sets them.
+        self.reclaim = None
+        self.reclaimable = lambda: 0
 
     @property
     def reserved(self) -> tuple[int, ...]:
@@ -194,6 +233,107 @@ class PageAllocator:
         ``[i*page_size, (i+1)*page_size)`` of the owner's sequence."""
         return list(self._owned.get(owner, ()))
 
+    # -- refcount primitives (prefix-reuse subsystem) -----------------------
+    @property
+    def ref_epoch(self) -> int:
+        """Changes whenever any page's reference count changes — a cheap
+        staleness key for memoized refcount-derived views."""
+        return self._ref_epoch
+
+    def ref_count(self, page: int) -> int:
+        """Live references on ``page`` (0 = free or never allocated)."""
+        return self._refs.get(int(page), 0)
+
+    def incref(self, page: int) -> None:
+        """Add one reference to an ALLOCATED page (the prefix cache's
+        pin, or a sharer added outside the owner lists). Raises
+        :class:`PageRefError` for a free page — a reference to bytes the
+        allocator may hand out again is a use-after-free waiting to
+        happen."""
+        p = int(page)
+        if self._refs.get(p, 0) < 1:
+            raise PageRefError(
+                f"incref of page {p} which holds no live reference — "
+                "only allocated pages can gain sharers (operation "
+                "incref)")
+        self._refs[p] += 1
+        self._ref_epoch += 1
+
+    def decref(self, page: int) -> bool:
+        """Drop one reference; returns True when the page physically
+        freed (count reached zero and it rejoined the free list).
+        Raises :class:`PageRefError` when the count is already zero —
+        the caller released a reference it never held."""
+        p = int(page)
+        refs = self._refs.get(p, 0)
+        if refs < 1:
+            raise PageRefError(
+                f"decref of page {p} whose reference count is already "
+                "zero — the caller freed a page it holds no reference "
+                "to (operation decref)")
+        self._ref_epoch += 1
+        if refs > 1:
+            self._refs[p] = refs - 1
+            return False
+        del self._refs[p]
+        # Keep the descending order without re-sorting per freed page
+        # (free_pages/free_tail release k pages on the serving hot
+        # path — k insertions beat k full sorts).
+        bisect.insort(self._free, p, key=lambda x: -x)
+        return True
+
+    def share_pages(self, owner, pages) -> None:
+        """Add ``owner`` as a holder of already-allocated ``pages`` (the
+        prefix-hit admission path: a warm request reads another chain's
+        resident KV instead of re-prefilling it). Checks the owner's
+        ``max_pages`` budget like :meth:`alloc_pages`; raises
+        :class:`PageRefError` if any page is free (nobody's KV to
+        share). Pages append to the owner's list in the given order, so
+        share-then-alloc keeps the position-covering invariant."""
+        held = self._owned.setdefault(owner, [])
+        pages = [int(p) for p in pages]
+        if len(held) + len(pages) > self.max_pages:
+            raise PageBudgetError(
+                f"sequence {owner!r} would hold {len(held) + len(pages)} "
+                f"pages, over its max_pages budget of {self.max_pages} — "
+                "the admission check should have rejected this request")
+        for p in pages:
+            if self._refs.get(p, 0) < 1:
+                raise PageRefError(
+                    f"share of page {p} which holds no live reference — "
+                    f"a free page has no KV for {owner!r} to share "
+                    "(operation share_pages)")
+        for p in pages:
+            self._refs[p] += 1
+        self._ref_epoch += 1
+        held.extend(pages)
+
+    def cow_page(self, owner, old: int) -> int | None:
+        """Copy-on-write bookkeeping: swap the owner's reference on
+        shared page ``old`` for a fresh PRIVATE page at the SAME
+        position in its allocation-order list (the caller copies the
+        bytes and rewrites its table row). Returns the new page id, or
+        None when the pool is dry (after asking the reclaim hook).
+        Raises :class:`PageRefError` if the owner does not hold
+        ``old``."""
+        held = self._owned.get(owner)
+        old = int(old)
+        if not held or old not in held:
+            raise PageRefError(
+                f"COW of page {old} which {owner!r} does not hold — "
+                "only a holder may replace its reference (operation "
+                "cow_page)")
+        if not self._free and self.reclaim is not None:
+            self.reclaim(1)
+        if not self._free:
+            return None
+        new = self._free.pop()
+        self._refs[new] = 1
+        self._ref_epoch += 1
+        held[held.index(old)] = new
+        self.decref(old)
+        return new
+
     def alloc_pages(self, owner, n: int = 1) -> list[int] | None:
         held = self._owned.setdefault(owner, [])
         if len(held) + n > self.max_pages:
@@ -202,28 +342,42 @@ class PageAllocator:
                 f"over its max_pages budget of {self.max_pages} — the "
                 "admission check (prompt + max_new_tokens vs capacity) "
                 "should have rejected this request")
+        if len(self._free) < n and self.reclaim is not None:
+            # Cold cached prefix chains are evictable capacity: ask the
+            # cache to release before reporting exhaustion (the
+            # refcount×recency eviction order lives in the hook).
+            self.reclaim(n - len(self._free))
         if len(self._free) < n:
             return None          # pool exhausted: preempt or backpressure
         got = [self._free.pop() for _ in range(n)]
+        for p in got:
+            self._refs[p] = 1
+        self._ref_epoch += 1
         held.extend(got)
         return got
 
     def free_pages(self, owner) -> int:
-        """Return every page the owner holds to the pool; returns the
-        count freed (0 for an unknown owner — freeing twice is a no-op,
-        not an error: preemption and finish may race in caller logic)."""
+        """Release the owner's REFERENCE on every page it holds;
+        returns the count of references released (0 for an unknown
+        owner — releasing twice is a no-op, not an error: preemption
+        and finish may race in caller logic). A page physically rejoins
+        the free list only when its LAST reference drops — a preempted
+        or finished sharer can never free bytes another request (or the
+        prefix cache) still reads."""
         held = self._owned.pop(owner, [])
-        self._free.extend(held)
-        self._free.sort(reverse=True)
+        for p in held:
+            self.decref(p)
         return len(held)
 
     def free_tail(self, owner, keep: int) -> int:
-        """Return the owner's pages BEYOND the first ``keep`` (allocation
-        order) to the pool — the speculative-decode draft rollback
-        (docs/serving.md "Speculative decode"): pages grown for a k-token
-        candidate window shrink back to exactly what the accepted prefix
-        occupies, so rejected drafts never leave KV bytes resident.
-        Returns the count freed (0 when nothing extends past ``keep``)."""
+        """Release the owner's references BEYOND the first ``keep``
+        pages (allocation order) — the speculative-decode draft rollback
+        (docs/serving.md "Speculative decode"): pages grown for a
+        k-token candidate window shrink back to exactly what the
+        accepted prefix occupies, so rejected drafts never leave KV
+        bytes resident. Returns the count of references released (0
+        when nothing extends past ``keep``); as everywhere, a released
+        page only physically frees at refcount zero."""
         if keep < 0:
             raise ValueError(f"keep = {keep} invalid: a rollback keeps a "
                              "non-negative page count — argument keep")
@@ -232,8 +386,8 @@ class PageAllocator:
             return 0
         tail = held[keep:]
         del held[keep:]
-        self._free.extend(tail)
-        self._free.sort(reverse=True)
+        for p in tail:
+            self.decref(p)
         return len(tail)
 
 

@@ -130,6 +130,15 @@ METRICS: tuple[MetricSpec, ...] = (
     MetricSpec("serve_ttft_p99_ms_spec",
                "serving TTFT p99 (speculative lane)", " ms", "lower",
                "serving"),
+    MetricSpec("serve_ttft_p99_ms_warm",
+               "serving TTFT p99 (prefix-cache warm replay: shared "
+               "preambles resident, only divergent tails prefill — "
+               "same window as the cold rung)",
+               " ms", "lower", "serving"),
+    MetricSpec("serve_tokens_per_s_warm",
+               "serving tokens/s (prefix-cache warm replay, same "
+               "window as the cold rung)",
+               " tok/s", "higher", "serving"),
 )
 
 METRIC_BY_KEY = {m.key: m for m in METRICS}

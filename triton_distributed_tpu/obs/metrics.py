@@ -86,6 +86,15 @@ SPEC_ACCEPTED_TOKENS = "tdtpu_spec_accepted_tokens_total"
 SPEC_DRAFT_TOKENS = "tdtpu_spec_draft_tokens_total"
 SPEC_ACCEPT_RATE = "tdtpu_spec_accept_rate"
 
+# Prefix-reuse lane (ISSUE 15, docs/serving.md "Prefix cache"): pages
+# currently shared across readers, prefill tokens warm admissions
+# skipped, and the cumulative hit rate. A prefix-enabled run must carry
+# the hit-rate gauge whenever the tokens-saved counter is present
+# (obs.report --check pins it).
+PREFIX_PAGES_SHARED = "tdtpu_prefix_pages_shared"
+PREFIX_TOKENS_SAVED = "tdtpu_prefill_tokens_saved_total"
+PREFIX_HIT_RATE = "tdtpu_prefix_hit_rate"
+
 # What the report's serving lane renders (histograms first, then
 # gauges/counters, in this order).
 SERVING_SERIES = (SERVE_TTFT_MS, SERVE_TPOT_MS, SERVE_TTFT_QUEUE_MS,
@@ -96,7 +105,8 @@ SERVING_SERIES = (SERVE_TTFT_MS, SERVE_TPOT_MS, SERVE_TTFT_QUEUE_MS,
                   SERVE_PREEMPTIONS, SERVE_REJECTS, SERVE_FINISHED,
                   KV_PAGES_RESIDENT, SPEC_DRAFT_TOKENS,
                   SPEC_ACCEPTED_TOKENS, SPEC_ACCEPT_RATE,
-                  SERVE_TOKENS_PER_S)
+                  PREFIX_PAGES_SHARED, PREFIX_TOKENS_SAVED,
+                  PREFIX_HIT_RATE, SERVE_TOKENS_PER_S)
 
 # KV-migration lane (disaggregated prefill/decode tier, docs/disagg.md):
 # published by disagg/migrate.py + disagg/engine.py, rendered as

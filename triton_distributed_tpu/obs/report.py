@@ -743,6 +743,18 @@ def main(argv: list[str] | None = None) -> int:
             f"spec lane present ({_om.SPEC_DRAFT_TOKENS}) but "
             f"{_om.SPEC_ACCEPT_RATE} missing — the accept-rate gauge is "
             "part of the spec lane contract")
+    # Prefix-reuse lane (ISSUE 15): a prefix-enabled run (tokens-saved
+    # counter or shared-pages gauge present) must carry the hit-rate
+    # gauge — without it the warm/cold mix of the snapshot cannot be
+    # judged.
+    if ((_om.PREFIX_TOKENS_SAVED in (metrics or {})
+         or _om.PREFIX_PAGES_SHARED in (metrics or {}))
+            and _om.PREFIX_HIT_RATE not in (metrics or {})):
+        failures.append(
+            f"prefix lane present ({_om.PREFIX_TOKENS_SAVED}/"
+            f"{_om.PREFIX_PAGES_SHARED}) but {_om.PREFIX_HIT_RATE} "
+            "missing — the hit-rate gauge is part of the prefix lane "
+            "contract")
     # Request-timeline lane (ISSUE 13): any serving snapshot must carry
     # its per-request tracks — without them an SLO slip or demotion in
     # this run dir is unattributable after the fact.

@@ -776,6 +776,166 @@ def spec_serve_selftest() -> list[CaseResult]:
     return cases
 
 
+def prefix_serve_selftest() -> list[CaseResult]:
+    """Two rows per --all sweep for the prefix-reuse subsystem
+    (ISSUE 15, docs/serving.md "Prefix cache"):
+
+    (a) ``cow_under_preemption`` — two requests share a resident
+        preamble's pages; the sharer is preempted mid-decode. The
+        refcount discipline must keep the survivor's shared pages
+        BYTE-INTACT (preempting a sharer never frees or corrupts a page
+        another request still reads), and the preempted request must
+        resume — warm, off the surviving chain — with token parity vs
+        the cold sequential serve.
+
+    (b) ``warm_suffix_prefill_fault`` — a seeded transient fault lands
+        inside a WARM admission's divergent-suffix prefill slice. The
+        serving loop must retry/recompute (never die), the shared pages
+        must stay byte-intact, and the warm request must still finish
+        token-identical to the cold oracle."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from triton_distributed_tpu.models import (
+        Engine, init_dense_llm, tiny_config,
+    )
+    from triton_distributed_tpu.runtime import initialize_distributed
+    from triton_distributed_tpu.serving.loop import ServingEngine
+
+    cfg = tiny_config()
+    params = init_dense_llm(jax.random.key(0), cfg)
+    ctx1 = initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                  devices=jax.devices()[:1])
+    pre = list(range(100, 112))                 # 12-token shared preamble
+    prompts = [pre + [3, 5], pre + [7, 9, 11], pre + [13, 15]]
+    gens = [8, 8, 8]
+    oracle = Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                    page_size=4)
+    golden = {}
+    for i, (p, g) in enumerate(zip(prompts, gens)):
+        golden[i] = np.asarray(
+            oracle.serve(jnp.asarray([p], jnp.int32), gen_len=g)
+        )[0].tolist()
+
+    def shared_bytes(se):
+        """Snapshot of the pool bytes of every page the cache pins —
+        the corruption oracle for the shared chains."""
+        pools = np.asarray(se._cache.k_pools)
+        return {p: pools[:, p].copy() for p in sorted(se.prefix._pages)}
+
+    cases: list[CaseResult] = []
+
+    # Row (a): COW under preemption — preempt a sharer mid-decode.
+    t0 = time.time()
+    diags: list[str] = []
+    try:
+        eng = Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                     page_size=4)
+        se = ServingEngine(eng, max_batch=2, num_pages=12,
+                           prefill_chunk=4, prefix_cache=True)
+        # Cold admission populates the index, then drains.
+        r0, res = se.submit(prompts[0], gens[0], req_id="chaos-px-0",
+                            priority=1)
+        assert res.name == "ADMITTED", res
+        se.run()
+        # Two sharers of the resident preamble decode together; the
+        # lower-priority one is preempted mid-decode by hand (the
+        # deterministic form of page-pressure eviction) while the
+        # survivor keeps reading the shared pages.
+        r1, _ = se.submit(prompts[1], gens[1], req_id="chaos-px-1",
+                          priority=1)
+        r2, _ = se.submit(prompts[2], gens[2], req_id="chaos-px-2",
+                          priority=0)
+        for _ in range(5):
+            se.step()
+        warm_before = (r1.prefix_hit_tokens_total,
+                       r2.prefix_hit_tokens_total)
+        before = shared_bytes(se)
+        from triton_distributed_tpu.serving.request import RequestState
+
+        preempted_live = r2.state in (RequestState.RUNNING,
+                                      RequestState.PREFILLING)
+        if preempted_live:
+            se.sched._preempt(r2)
+        after = shared_bytes(se)
+        intact = (sorted(before) == sorted(after)
+                  and all(np.array_equal(before[p], after[p])
+                          for p in before))
+        se.run()
+        parity = all(r.tokens == golden[i]
+                     for i, r in enumerate((r0, r1, r2)))
+        diags += [f"sharer preempted mid-decode: {preempted_live}",
+                  f"warm hits before preemption: {warm_before}",
+                  f"survivor shared pages byte-intact: {intact}",
+                  f"resume+parity vs cold sequential serve: {parity}"]
+        verdict = ("detected" if preempted_live and intact and parity
+                   and all(warm_before) else "error")
+    except Exception as exc:                        # died = the failure
+        verdict = "error"
+        diags.append(f"{type(exc).__name__}: {exc}")
+    cases.append(CaseResult(
+        op="prefix_serve", mesh="1", fault="cow_under_preemption",
+        verdict=verdict, detected_by="refcount",
+        expected=("detected",), ok=verdict == "detected", n_fired=1,
+        n_violations=0, diagnostics=diags,
+        elapsed_s=round(time.time() - t0, 3)))
+
+    # Row (b): seeded fault during a WARM admission's suffix prefill.
+    t0 = time.time()
+    diags = []
+    try:
+        eng = Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                     page_size=4)
+        se = ServingEngine(eng, max_batch=2, num_pages=12,
+                           prefill_chunk=4, prefix_cache=True)
+        r0, _ = se.submit(prompts[0], gens[0], req_id="chaos-pxf-0")
+        se.run()
+        before = shared_bytes(se)
+        fired = {"n": 0}
+        real_slice = se._prefill_lane
+
+        def faulty_lane(req):
+            eng_, slice_fn, logits_fn = real_slice(req)
+            if req.prefix_hit_tokens > 0 and fired["n"] == 0:
+                def boom(*a, **kw):
+                    fired["n"] += 1
+                    raise FaultInjectionError(
+                        "chaos: injected warm suffix-prefill fault "
+                        "(kernel=serving_prefill occurrence=0)")
+                return eng_, boom, logits_fn
+            return eng_, slice_fn, logits_fn
+
+        se._prefill_lane = faulty_lane
+        import warnings as _w
+
+        r1, _ = se.submit(prompts[1], gens[1], req_id="chaos-pxf-1")
+        with _w.catch_warnings():
+            _w.simplefilter("ignore", RuntimeWarning)
+            se.run()
+        after = shared_bytes(se)
+        intact = all(np.array_equal(before[p], after[p])
+                     for p in before if p in after)
+        parity = (r0.tokens == golden[0] and r1.tokens == golden[1])
+        diags += [f"fault fired: {fired['n']}",
+                  f"warm request recovered with parity: "
+                  f"{r1.tokens == golden[1]}",
+                  f"shared pages never corrupted: {intact}",
+                  f"warm hit tokens: {r1.prefix_hit_tokens_total}"]
+        verdict = ("detected" if fired["n"] and parity and intact
+                   else "error")
+    except Exception as exc:
+        verdict = "error"
+        diags.append(f"{type(exc).__name__}: {exc}")
+    cases.append(CaseResult(
+        op="prefix_serve", mesh="1", fault="warm_suffix_prefill_fault",
+        verdict=verdict, detected_by="retry_parity",
+        expected=("detected",), ok=verdict == "detected", n_fired=1,
+        n_violations=0, diagnostics=diags,
+        elapsed_s=round(time.time() - t0, 3)))
+    return cases
+
+
 def fleet_selftest() -> list[CaseResult]:
     """Three rows per --all sweep:
 
@@ -1191,6 +1351,14 @@ def sweep(ops, faults, ranks, *, seed: int = 0,
         # preemption mid-draft recomputes on resume with no stale draft
         # KV pages surviving in the pool.
         for case in spec_serve_selftest():
+            cases.append(case)
+            failed += not case.ok
+            _print_case(case, verbose)
+        # Prefix-reuse rows (ISSUE 15): preempting a sharer must leave
+        # the survivor's shared pages byte-intact with resume parity;
+        # a seeded fault in a warm admission's suffix prefill must
+        # retry with parity and never corrupt shared pages.
+        for case in prefix_serve_selftest():
             cases.append(case)
             failed += not case.ok
             _print_case(case, verbose)

@@ -16,7 +16,11 @@ docs/serving.md.
   open-loop load generator, the CPU dryrun proof and the bench rung;
 * :mod:`~triton_distributed_tpu.serving.spec` — self-drafting
   speculative-decode proposer (prompt lookup; ``ServingEngine(spec_k=)``
-  is the lane's switch — docs/serving.md "Speculative decode").
+  is the lane's switch — docs/serving.md "Speculative decode");
+* :mod:`~triton_distributed_tpu.serving.prefix` — radix-indexed
+  copy-on-write prefix cache for multi-tenant reuse
+  (``ServingEngine(prefix_cache=True)`` — docs/serving.md "Prefix
+  cache").
 """
 
 from triton_distributed_tpu.serving.request import (  # noqa: F401
@@ -31,8 +35,11 @@ from triton_distributed_tpu.serving.loop import (  # noqa: F401
 from triton_distributed_tpu.serving.spec import (  # noqa: F401
     NGramProposer, SpecConfigError,
 )
+from triton_distributed_tpu.serving.prefix import (  # noqa: F401
+    PrefixCache, PrefixConfigError,
+)
 
 __all__ = ["Request", "RequestState", "AdmitResult", "Scheduler",
            "SchedulerConfigError", "RequestTooLargeError",
            "ServingConfigError", "ServingEngine", "NGramProposer",
-           "SpecConfigError"]
+           "SpecConfigError", "PrefixCache", "PrefixConfigError"]

@@ -40,7 +40,16 @@ from triton_distributed_tpu.serving.scheduler import AdmitResult
 
 @dataclasses.dataclass(frozen=True)
 class LoadSpec:
-    """Seeded open-loop workload shape."""
+    """Seeded open-loop workload shape.
+
+    Shared-prefix traffic (ISSUE 15, docs/serving.md "Prefix cache"):
+    ``prefix_families > 0`` turns the trace into PROMPT FAMILIES — each
+    family shares a common preamble of ``prefix_len`` tokens (seeded by
+    ``prefix_seed``, INDEPENDENT of the trace seed, so two traces with
+    different seeds still share the same family preambles — the
+    warm-measurement shape), and each request appends its own divergent
+    tail of ``prompt_len`` tokens. Requests round-robin over families.
+    """
 
     n_requests: int = 8
     seed: int = 0
@@ -49,28 +58,44 @@ class LoadSpec:
     mean_interarrival_iters: float = 1.0        # 0 = burst at iter 0
     priorities: tuple[int, ...] = (0,)
     vocab: int = 256
+    prefix_families: int = 0                    # 0 = no shared preambles
+    prefix_len: int = 12                        # preamble tokens / family
+    prefix_seed: int = 1234
 
 
 def build_trace(spec: LoadSpec) -> list[dict]:
     """Expand the spec into a fixed arrival trace (same seed, same
-    trace — bit-reproducible serving runs)."""
+    trace — bit-reproducible serving runs). With ``prefix_families``
+    set, ``prompt_len`` sizes each request's divergent TAIL and the
+    family preamble rides in front of it."""
     rng = np.random.default_rng(spec.seed)
+    families = []
+    if spec.prefix_families > 0:
+        frng = np.random.default_rng(spec.prefix_seed)
+        families = [frng.integers(0, spec.vocab, spec.prefix_len).tolist()
+                    for _ in range(spec.prefix_families)]
     trace = []
     it = 0
     for i in range(spec.n_requests):
         if spec.mean_interarrival_iters > 0 and i > 0:
             it += int(rng.geometric(
                 1.0 / (1.0 + spec.mean_interarrival_iters)) - 1)
+        prompt = rng.integers(
+            0, spec.vocab,
+            int(rng.integers(spec.prompt_len[0],
+                             spec.prompt_len[1] + 1))).tolist()
+        fam = None
+        if families:
+            fam = i % len(families)
+            prompt = families[fam] + prompt
         trace.append({
             "req_id": f"lg-{spec.seed}-{i}",
             "arrival_iter": it,
-            "prompt": rng.integers(
-                0, spec.vocab,
-                int(rng.integers(spec.prompt_len[0],
-                                 spec.prompt_len[1] + 1))).tolist(),
+            "prompt": prompt,
             "max_new_tokens": int(rng.integers(spec.max_new[0],
                                                spec.max_new[1] + 1)),
             "priority": int(rng.choice(spec.priorities)),
+            **({"family": fam} if fam is not None else {}),
         })
     return trace
 
@@ -98,6 +123,7 @@ def request_records(reqs) -> list[dict]:
             "evacuated": r.evacuations > 0,
             "drafted": r.drafted_tokens,
             "accepted": r.accepted_draft_tokens,
+            "prefix_hit_tokens": r.prefix_hit_tokens_total,
             "final_backend": r.final_backend,
             "state": r.state.name,
         }
@@ -228,7 +254,12 @@ def dryrun(json_path: str | None, flight_dir: str | None = None) -> int:
     timelines) for CI's postmortem step. Phase 9 (ISSUE 14) proves
     greedy speculative decode token-identical to sequential one-token
     serve on BOTH backends (xla + megakernel, incl. preempt/resume)
-    with the rejected-draft page rollback asserted every iteration."""
+    with the rejected-draft page rollback asserted every iteration.
+    Phase 10 (ISSUE 15) proves the prefix-reuse subsystem: a
+    shared-prefix trace served warm is token-identical to the cold
+    sequential oracle on both backends with a nonzero shared-page
+    count, exact refcounted pool occupancy, and a decode-pool hit on
+    the disagg tier that skips the prefill role + migration stream."""
     import os
 
     from triton_distributed_tpu.runtime.utils import (
@@ -824,6 +855,165 @@ def dryrun(json_path: str | None, flight_dir: str | None = None) -> int:
             r.accepted_draft_tokens for r in mk_sp_reqs),
     }
 
+    # Phase 10 (ISSUE 15) — prefix-reuse subsystem (docs/serving.md
+    # "Prefix cache"): a shared-prefix trace (prompt families with a
+    # common preamble + divergent tails) served WARM must be
+    # token-identical to the sequential cold oracle on BOTH backends,
+    # with a nonzero shared-page count, tdtpu_prefill_tokens_saved_total
+    # > 0, and EXACT pool occupancy (refcounted pages counted once).
+    # Disagg: a decode-pool prefix hit admits without invoking the
+    # prefill role or the migration stream.
+    from triton_distributed_tpu.serving.loop import (
+        ServingEngine as _PrefixServing,
+    )
+
+    px_spec = LoadSpec(n_requests=6, seed=3, prompt_len=(3, 6),
+                       max_new=(4, 6), mean_interarrival_iters=2.0,
+                       prefix_families=2, prefix_len=12)
+    px_trace = build_trace(px_spec)
+    px_golden = sequential_reference(engine, px_trace)
+    with tempfile.TemporaryDirectory() as px_dir:
+        _obs.start_run(px_dir)
+        try:
+            se10 = _PrefixServing(engine, max_batch=4, num_pages=24,
+                                  prefill_chunk=4, max_waiting=8,
+                                  prefix_cache=True)
+            px_report = run_trace(se10, px_trace)
+            px_snap = _om.registry().snapshot()
+        finally:
+            _obs.finish_run()
+    px_reqs = px_report.pop("requests")
+    px_mismatch = [r.req_id for r in px_reqs
+                   if r.tokens != px_golden[r.req_id]]
+    px_warm = [r.req_id for r in px_reqs if r.prefix_hit_tokens_total > 0]
+    saved = (px_snap.get(_om.PREFIX_TOKENS_SAVED) or {}).get("value", 0)
+    hit_rate = (px_snap.get(_om.PREFIX_HIT_RATE) or {}).get("value")
+    alloc10 = se10.sched.allocator
+    used10 = {p for o in list(alloc10._owned.values()) for p in o}
+    used10 |= se10.prefix._pages
+    occupancy_exact = (len(used10)
+                       == alloc10.usable_pages - alloc10.free_count)
+    if px_mismatch:
+        failures.append("warm serve token parity broken vs cold "
+                        f"sequential serve: {px_mismatch}")
+    if not px_warm:
+        failures.append("no request admitted warm — the shared-prefix "
+                        "trace no longer exercises the radix index")
+    if se10.prefix.pages_held < 1:
+        failures.append("prefix cache holds no resident pages after the "
+                        "trace — nothing was indexed")
+    if se10.prefix.pages_shared_peak < 1:
+        failures.append(
+            "no page was ever shared across readers during the trace "
+            "(pages_shared peak 0) — the families no longer overlap in "
+            "flight")
+    if not saved or saved <= 0:
+        failures.append(
+            f"tdtpu_prefill_tokens_saved_total = {saved!r}: warm "
+            "admissions saved no prefill work")
+    if hit_rate is None:
+        failures.append("prefix-enabled run missing the "
+                        f"{_om.PREFIX_HIT_RATE} gauge")
+    if not occupancy_exact:
+        failures.append(
+            "pool occupancy accounting not exact under sharing "
+            f"({len(used10)} unique held pages vs "
+            f"{alloc10.usable_pages - alloc10.free_count} non-free)")
+    # Megakernel half: the SAME warm contract on the paged persistent
+    # workspace — the second request's prefix (incl. an in-page
+    # divergence COW) reads the resident pool tiles.
+    px_rng = _np.random.default_rng(15)
+    px_base = px_rng.integers(0, 512, 140).tolist()
+    mk_px_trace = [
+        {"req_id": "px-mk-0", "arrival_iter": 0, "prompt": px_base,
+         "max_new_tokens": 4, "priority": 0},
+        {"req_id": "px-mk-1", "arrival_iter": 3,
+         "prompt": px_base[:132] + px_rng.integers(0, 512, 8).tolist(),
+         "max_new_tokens": 4, "priority": 0},
+    ]
+    mk_px_golden = sequential_reference(oracle, mk_px_trace)
+    mk_px_eng = Engine(mk_cfg, mk_params, ctx1, backend="megakernel",
+                       max_seq=256, page_size=128)
+    se10mk = _PrefixServing(mk_px_eng, max_batch=2, num_pages=4,
+                            prefill_chunk=128, prefix_cache=True)
+    mk_px_report = run_trace(se10mk, mk_px_trace)
+    mk_px_reqs = mk_px_report.pop("requests")
+    mk_px_mismatch = [r.req_id for r in mk_px_reqs
+                      if r.tokens != mk_px_golden[r.req_id]]
+    mk_px_warm = [r.req_id for r in mk_px_reqs
+                  if r.prefix_hit_tokens_total > 0]
+    if se10mk._mk is None or mk_px_eng.backend != "megakernel":
+        failures.append(
+            f"megakernel prefix lane silently demoted (backend now "
+            f"{mk_px_eng.backend!r}) — the warm parity it reported is "
+            "not the persistent kernel's")
+    if mk_px_mismatch:
+        failures.append("megakernel warm serve token parity broken vs "
+                        f"cold sequential serve: {mk_px_mismatch}")
+    if not mk_px_warm:
+        failures.append("no megakernel request admitted warm off the "
+                        "paged workspace's resident pages")
+    # Disagg half: the decode-pool hit must skip the prefill role AND
+    # the migration stream entirely.
+    dg_px_pe = _Engine(engine.cfg, engine.params, pctx, backend="xla",
+                       max_seq=64)
+    dg_px_de = _Engine(engine.cfg, engine.params, dctx, backend="xla",
+                       max_seq=64, page_size=4)
+    se10dg = DisaggServingEngine(dg_px_pe, dg_px_de, max_batch=2,
+                                 num_pages=16, prefill_chunk=4,
+                                 block_pages=1, prefix_cache=True)
+    dg_px_trace = [
+        {"req_id": "px-dg-0", "arrival_iter": 0,
+         "prompt": px_trace[0]["prompt"], "max_new_tokens": 4,
+         "priority": 0},
+        # Arrives AFTER px-dg-0's migration lands (prefill slices +
+        # one block rotation per iteration), so the admission scores a
+        # decode-pool hit instead of racing the cold prefill.
+        {"req_id": "px-dg-1", "arrival_iter": 14,
+         "prompt": px_trace[0]["prompt"][:14] + [99, 98, 97],
+         "max_new_tokens": 4, "priority": 0},
+    ]
+    dg_px_golden = sequential_reference(engine, dg_px_trace)
+    dg_px_report = run_trace(se10dg, dg_px_trace)
+    dg_px_reqs = {r.req_id: r for r in dg_px_report.pop("requests")}
+    dg_warm = dg_px_reqs["px-dg-1"]
+    dg_px_mismatch = [rid for rid, r in dg_px_reqs.items()
+                      if r.tokens != dg_px_golden[rid]]
+    if not se10dg.disagg_active:
+        failures.append(
+            f"disagg prefix tier silently demoted "
+            f"({se10dg.demotion_reason!r})")
+    if dg_px_mismatch:
+        failures.append("disagg warm serve token parity broken vs cold "
+                        f"sequential serve: {dg_px_mismatch}")
+    if dg_warm.prefix_hit_tokens_total < 1:
+        failures.append("the disagg follow-up request did not admit "
+                        "warm off the decode pool's index")
+    if se10dg.prefix_disagg_skips < 1 or dg_warm.migrations != 0:
+        failures.append(
+            "the decode-pool prefix hit did not skip the prefill role "
+            f"+ migration stream (skips={se10dg.prefix_disagg_skips}, "
+            f"warm migrations={dg_warm.migrations})")
+    if [m["req_id"] for m in se10dg.migrations_log] != ["px-dg-0"]:
+        failures.append(
+            "migration stream saw an unexpected request set "
+            f"({[m['req_id'] for m in se10dg.migrations_log]}) — only "
+            "the cold admission should migrate")
+    report["prefix"] = {
+        "parity_ok": not px_mismatch,
+        "warm_requests": px_warm,
+        "tokens_saved_total": saved,
+        "hit_rate": hit_rate,
+        "pages_shared_peak": se10.prefix.pages_shared_peak,
+        "pages_held": se10.prefix.pages_held,
+        "occupancy_exact": occupancy_exact,
+        "megakernel_parity_ok": not mk_px_mismatch,
+        "megakernel_warm_requests": mk_px_warm,
+        "disagg_parity_ok": not dg_px_mismatch,
+        "disagg_skips": se10dg.prefix_disagg_skips,
+        "disagg_warm_hit_tokens": dg_warm.prefix_hit_tokens_total,
+    }
+
     report["failures"] = failures
     if json_path:
         with open(json_path, "w") as f:
@@ -945,6 +1135,68 @@ def serving_bench_rung(n_streams: int = 8, prompt_len: int = 128,
                                    if drafted else None)
         out["spec_k"] = spec_k
     return out
+
+
+def warm_serving_bench_rung(n_streams: int = 8, prompt_len: int = 128,
+                            max_new: int = 16, *,
+                            page_size: int = 64) -> dict:
+    """The prefix-cache rung (ISSUE 15, docs/serving.md "Prefix
+    cache"): the same open-loop workload as :func:`serving_bench_rung`
+    but with SHARED-PREFIX traffic (two prompt families, 128-token
+    preambles + divergent tails) served twice through ONE
+    prefix-enabled tier — the first replay compiles AND populates the
+    radix index, the second replay is the WARM measurement (every
+    admission hits a resident preamble and prefills only its tail).
+    bench.py races it against the cold rung in the same window
+    (`serve_ttft_p99_ms_warm` / `serve_tokens_per_s_warm`): the TTFT
+    delta is what the prefix cache buys a multi-tenant fleet."""
+    import jax
+    import jax.random as jrandom
+
+    from triton_distributed_tpu.models import Engine
+    from triton_distributed_tpu.models.dense import init_dense_llm
+    from triton_distributed_tpu.runtime import initialize_distributed
+    from triton_distributed_tpu.serving.loop import ServingEngine
+
+    cfg = _bench_shard_config()
+    params = init_dense_llm(jrandom.PRNGKey(0), cfg)
+    ctx1 = initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                  devices=jax.devices()[:1])
+    engine = Engine(cfg, params, ctx1, backend="xla", max_seq=512,
+                    page_size=page_size)
+    se = ServingEngine(engine, max_batch=n_streams, prefill_chunk=128,
+                       prefix_cache=True)
+
+    def make_trace(seed: int) -> list[dict]:
+        # prefix_seed is fixed (LoadSpec default), so both replays share
+        # the SAME family preambles — the warm shape — while the tails
+        # and arrival jitter vary with the trace seed.
+        spec = LoadSpec(n_requests=n_streams, seed=seed,
+                        prompt_len=(max(1, prompt_len - 128),
+                                    max(1, prompt_len - 128)),
+                        max_new=(max_new, max_new),
+                        mean_interarrival_iters=0.0, vocab=cfg.vocab_size,
+                        prefix_families=2, prefix_len=128)
+        return build_trace(spec)
+
+    run_trace(se, make_trace(0))              # warmup: compile + index
+    report = run_trace(se, make_trace(1))     # warm measurement
+    reqs = report.pop("requests")
+    warm = [r for r in reqs if r.prefix_hit_tokens_total > 0]
+    if not warm:
+        raise RuntimeError(
+            "no measurement request admitted warm — the rung would "
+            "mislabel a cold run as prefix-cache throughput")
+    return {
+        "serve_tokens_per_s_warm": report["tokens_per_s"],
+        "serve_ttft_p99_ms_warm": report["ttft_p99_ms"],
+        "serve_warm_requests": len(warm),
+        "serve_prefill_tokens_saved": se.prefix.tokens_saved,
+        "serve_prefix_hit_rate": round(se.prefix.hit_rate(), 4),
+        "serve_warm_comm": "none (n=1 shard; prefix-cache warm replay "
+                           "— shared 128-token preambles resident, "
+                           "only divergent tails prefill)",
+    }
 
 
 def disagg_serving_bench_rung(n_streams: int = 8, prompt_len: int = 128,

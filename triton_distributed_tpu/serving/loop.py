@@ -149,6 +149,20 @@ class ServingEngine:
         returns to the one-token baseline every iteration. A transient
         failure inside a verify step falls the lane back to one-token
         decode with recompute-on-resume parity (never dies).
+      prefix_cache: enable the prefix-reuse subsystem (ISSUE 15,
+        docs/serving.md "Prefix cache"): a radix index over token-id
+        prefixes (serving/prefix.py) is consulted at admission, a warm
+        request SHARES the resident pages covering its prompt prefix
+        (refcounted — share = +ref, free = −ref, physical free only at
+        zero) and prefills only its divergent suffix; a shared page
+        that would be written is first copied to a private page
+        (copy-on-write — on both the xla paged path and the megakernel
+        paged workspace), and cold cached chains evict in
+        refcount×recency order under pool pressure, so the scheduler's
+        admission budget sees them as available capacity. Warm serve is
+        token-identical to cold serve (tests + loadgen dryrun phase 10
+        pin it). False (default) keeps every pre-prefix path
+        byte-identical.
     """
 
     def __init__(self, engine: Engine, *, max_batch: int = 4,
@@ -156,7 +170,8 @@ class ServingEngine:
                  kv_hbm_budget: int | None = None,
                  prefill_chunk: int | None = None,
                  max_waiting: int = 64, slo_cfg=None, slo_every: int = 1,
-                 fleet=None, clock=time.perf_counter, spec_k: int = 0):
+                 fleet=None, clock=time.perf_counter, spec_k: int = 0,
+                 prefix_cache: bool = False):
         if engine.page_size is None:
             raise ServingConfigError(
                 "engine has no paged cache: construct Engine(page_size=...) "
@@ -255,19 +270,14 @@ class ServingEngine:
                 self._mk = self._build_megakernel_lane(pool_pages)
             except BackendUnsupportedError as exc:
                 self._demote_backend(str(exc))
-        mesh = engine.ctx.mesh
-
-        def put(tree, specs):
-            return jax.device_put(
-                tree, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
-                                   is_leaf=lambda x: isinstance(x, P)))
-
         cache = init_paged_model_cache(
             self.cfg, max_batch, page_size=page, max_pages=self.max_pages,
             num_pages=pool_pages + 1, kv_dtype=self.kv_dtype)
-        self._cache = put(cache, paged_cache_specs(engine.shard_axes))
-        self._pf_cache = put(init_kv_cache(self.cfg, 1, self.s_buf),
-                             kv_cache_specs(engine.shard_axes))
+        self._cache = self._put_sharded(
+            cache, paged_cache_specs(engine.shard_axes))
+        self._pf_cache = self._put_sharded(
+            init_kv_cache(self.cfg, 1, self.s_buf),
+            kv_cache_specs(engine.shard_axes))
         # With the persistent backend active the pool carries the
         # megakernel workspace's reserved scratch page as a REAL,
         # reserved pool row — the admission/budget math sees it (and can
@@ -277,11 +287,21 @@ class ServingEngine:
                                       reserved=(self.scratch_page,))
         else:
             allocator = PageAllocator(pool_pages, self.max_pages)
+        # Prefix-reuse subsystem (ISSUE 15, docs/serving.md "Prefix
+        # cache"): the radix index + cache pins register themselves as
+        # the allocator's reclaim hooks, so admission and page growth
+        # treat cold cached chains as evictable capacity.
+        self.prefix = None
+        if prefix_cache:
+            from triton_distributed_tpu.serving.prefix import PrefixCache
+
+            self.prefix = PrefixCache(allocator, page)
         self.sched = Scheduler(
             num_slots=max_batch,
             allocator=allocator,
             page_size=page, capacity_tokens=capacity,
-            max_waiting=max_waiting, on_event=self._req_event)
+            max_waiting=max_waiting, on_event=self._req_event,
+            prefix=self.prefix)
         self._jits: dict = {}
         self._jits_backend = engine.backend
         self.slo_every = max(1, int(slo_every))
@@ -381,6 +401,17 @@ class ServingEngine:
         else:
             raise BackendUnsupportedError(reason)
 
+    def _put_sharded(self, tree, specs, mesh=None):
+        """``device_put`` with per-leaf :class:`NamedSharding` resolved
+        against ``mesh`` (default: the engine's CURRENT mesh) — the one
+        home for the spec tree-map, so every pool/buffer build (init,
+        repartition rebuild, prefill-buffer reset, disagg role meshes)
+        shards identically."""
+        mesh = self.engine.ctx.mesh if mesh is None else mesh
+        return jax.device_put(
+            tree, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                               is_leaf=lambda x: isinstance(x, P)))
+
     # -- jitted pieces ------------------------------------------------------
     def _first_call(self, key, fn, what: str, eng=None):
         """The engine's first-call compile routing, against THIS tier's
@@ -441,8 +472,12 @@ class ServingEngine:
                 key, jax.jit(fn), "serving_logits")
         return self._jits[key]
 
-    def _scatter_jit(self, n_pages: int):
-        key = ("scatter", n_pages)
+    def _scatter_jit(self, n_pages: int, skip: int = 0):
+        """``skip``: leading buffer pages NOT written (a warm
+        admission's shared prefix pages — writing a shared page, even
+        with identical bytes, is what the COW discipline exists to
+        forbid; the suffix scatter starts at the first private page)."""
+        key = ("scatter", n_pages, skip)
         if key not in self._jits:
             eng = self.engine
             L, page, s_buf = self.cfg.num_layers, self.page, self.s_buf
@@ -456,7 +491,7 @@ class ServingEngine:
                 def to_pages(x):  # (L, 1, S_buf, hkv, d) local shard
                     x = x[:, 0].reshape(L, s_buf // page, page,
                                         *x.shape[3:])
-                    return x[:, :n_pages]
+                    return x[:, skip:skip + n_pages]
 
                 kp = cache.k_pools.at[:, pages].set(
                     saturate_cast(to_pages(k_lin), cache.k_pools.dtype))
@@ -473,6 +508,126 @@ class ServingEngine:
             self._jits[key] = self._first_call(
                 key, jax.jit(fn, donate_argnums=(0,)), "serving_scatter")
         return self._jits[key]
+
+    # -- prefix-reuse lane (ISSUE 15, docs/serving.md "Prefix cache") --------
+    def _gather_jit(self, n_pages: int):
+        """Inverse of the scatter: pull a warm request's shared prefix
+        pages out of the pool into the linear prefill buffer, so the
+        divergent-suffix slices attend the resident KV. Narrow (fp8)
+        pools dequantize here; for same-dtype pools the bytes are
+        exactly what the original prefill scattered, so the suffix math
+        is bit-identical to a cold prefill of the same tokens."""
+        key = ("gather", n_pages)
+        if key not in self._jits:
+            eng = self.engine
+            L, page = self.cfg.num_layers, self.page
+
+            def step(pf, cache, pages):
+                def from_pages(pool, dst):   # (L, P, page, hkv, d) shard
+                    x = pool[:, pages].astype(dst.dtype)
+                    x = x.reshape(L, 1, n_pages * page, *x.shape[3:])
+                    return dst.at[:, :, :n_pages * page].set(x)
+
+                return pf._replace(k=from_pages(cache.k_pools, pf.k),
+                                   v=from_pages(cache.v_pools, pf.v))
+
+            kv_spec = kv_cache_specs(eng.shard_axes)
+            fn = eng._shard(
+                step,
+                in_specs=(kv_spec, paged_cache_specs(eng.shard_axes),
+                          P()),
+                out_specs=kv_spec)
+            self._jits[key] = self._first_call(
+                key, jax.jit(fn, donate_argnums=(0,)), "prefix_gather")
+        return self._jits[key]
+
+    def _copy_page_jit(self):
+        """One pool-page copy — the copy half of copy-on-write: the
+        new private page receives the shared page's bytes before the
+        divergent append writes it (src/dst are traced scalars, so one
+        trace serves every COW)."""
+        key = "cow_copy"
+        if key not in self._jits:
+            eng = self.engine
+
+            def step(cache, src, dst):
+                kp = cache.k_pools.at[:, dst].set(cache.k_pools[:, src])
+                vp = cache.v_pools.at[:, dst].set(cache.v_pools[:, src])
+                return cache._replace(k_pools=kp, v_pools=vp)
+
+            fn = eng._shard(
+                step,
+                in_specs=(paged_cache_specs(eng.shard_axes), P(), P()),
+                out_specs=paged_cache_specs(eng.shard_axes))
+            self._jits[key] = self._first_call(
+                key, jax.jit(fn, donate_argnums=(0,)), "prefix_cow_copy")
+        return self._jits[key]
+
+    def _cow_shared_appends(
+            self, ready: list[Request],
+    ) -> tuple[list[Request], list[Request]]:
+        """Copy-on-write guard before every decode/verify launch: any
+        append-target page still carrying OTHER readers (refcount > 1 —
+        another sharer or the prefix cache) is first replaced by a
+        private copy (allocator row rewrite + one page copy, mirrored
+        into the megakernel workspace when that lane is live). A
+        request whose COW cannot get a page (pool dry even after
+        reclaim) preempts itself — recompute-on-resume is always
+        state-correct. Returns ``(still_ready, pool_preempted)`` so the
+        iteration accounting (SERVE_PREEMPTIONS, flight record, summary)
+        sees the guard's evictions like any other page-pressure
+        preemption. A transient fault in a copy launch on the
+        megakernel lane demotes (don't die) exactly like a fault in the
+        decode step itself — those preemptions are counted by the
+        demote path, not returned here."""
+        if self.prefix is None:
+            return ready, []
+        alloc = self.sched.allocator
+        out: list[Request] = []
+        evicted: list[Request] = []
+        spec = self._spec_enabled()
+        for req in ready:
+            pages = alloc.pages(req.req_id)
+            win = (1 + len(self._drafts.get(req.req_id, []))
+                   if spec else 1)
+            ti = req.kv_len // self.page
+            last_ti = (req.kv_len + win - 1) // self.page
+            ok = True
+            for idx in range(ti, min(last_ti + 1, len(pages))):
+                old = pages[idx]
+                if alloc.ref_count(old) <= 1:
+                    continue
+                new = alloc.cow_page(req.req_id, old)
+                if new is None:
+                    self.sched._preempt(req)
+                    evicted.append(req)
+                    ok = False
+                    break
+                try:
+                    self._cache = self._copy_page_jit()(
+                        self._cache, jnp.int32(old), jnp.int32(new))
+                    if self._mk is not None and self._mk_ws is not None:
+                        self._mk_ws = self._mk.copy_page(self._mk_ws,
+                                                         old, new)
+                except Exception as exc:
+                    from triton_distributed_tpu import resilience
+
+                    if self._mk is None or not resilience.is_transient(
+                            exc):
+                        # Dense lane: the donated pool state is the
+                        # step()-level fault machinery's to judge (fleet
+                        # retry/evacuation), same as a fault in the
+                        # dense decode launch itself.
+                        raise
+                    self._mk_decode_failed(
+                        [r for r in ready if r not in evicted], exc)
+                    return [], evicted
+                with obs_trace.span("serving.prefix_cow", req=req.req_id,
+                                    src=old, dst=new):
+                    pass
+            if ok:
+                out.append(req)
+        return out, evicted
 
     # -- speculative decode lane (ISSUE 14) ----------------------------------
     def _spec_enabled(self) -> bool:
@@ -661,6 +816,11 @@ class ServingEngine:
                 except BackendUnsupportedError as exc:
                     self._demote_backend(str(exc))
                 else:
+                    if self.prefix is not None:
+                        # The re-promoted lane starts a FRESH paged
+                        # workspace: indexed chains are not resident in
+                        # it, so a warm hit would read unwritten tiles.
+                        self.prefix.invalidate()
                     for req in list(self.sched.running()):
                         self.sched._preempt(req)
 
@@ -685,9 +845,20 @@ class ServingEngine:
         # (preempted victims drop their drafts with their pages).
         extra = self._plan_drafts() if self._spec_enabled() else None
         ready, preempted = self.sched.ensure_decode_pages(extra=extra)
+        # Prefix COW guard (ISSUE 15): no append may target a page that
+        # still carries other readers — replace with a private copy (or
+        # preempt) BEFORE any launch writes the pools. Runs here, not
+        # inside _decode, so its preemptions land in this iteration's
+        # accounting (counter, summary, flight record) and ``decoded``
+        # reflects the batch that actually stepped.
+        if ready:
+            ready, cow_evicted = self._cow_shared_appends(ready)
+            preempted = list(preempted) + cow_evicted
         decoded = len(ready)
         if ready:
             self._decode(ready)
+        if self.prefix is not None:
+            self.prefix.note_peak()
         self._iter += 1
         obs_on = self._observing()
         if obs_on:
@@ -831,6 +1002,15 @@ class ServingEngine:
             rec_extra["spec"] = {"drafted": self._last_spec[0],
                                  "accepted_drafts": self._last_spec[1],
                                  "fallback": self._spec_fallback}
+        if self.prefix is not None:
+            rec_extra["prefix"] = {
+                "hits": self.prefix.hits,
+                "lookups": self.prefix.lookups,
+                "tokens_saved": self.prefix.tokens_saved,
+                "pages_held": self.prefix.pages_held,
+                "pages_shared": self.prefix.pages_shared(),
+                "evictions": self.prefix.evictions,
+            }
         self.flight.record({
             **rec_extra,
             "iter": self._iter, "t": round(now, 6),
@@ -854,12 +1034,22 @@ class ServingEngine:
                                if self.fleet is not None else 0),
         })
 
-    def _prefill_lane(self):
-        """(engine, slice_fn, logits_fn) the prefill stage runs through.
-        The disaggregated tier (disagg/engine.py) overrides this to the
-        PREFILL role's engine and jits while it is active; here prefill
-        and decode share one engine."""
+    def _prefill_lane(self, req: Request):
+        """(engine, slice_fn, logits_fn) the prefill stage runs through
+        for ``req``. The disaggregated tier (disagg/engine.py)
+        overrides this to the PREFILL role's engine and jits while it
+        is active — except for a prefix-hit admission, whose short
+        suffix prefills on the DECODE engine directly (the disagg
+        skip); here prefill and decode share one engine."""
         return self.engine, self._slice_jit(), self._logits_jit()
+
+    def _pf_get(self, req: Request):
+        """The linear prefill buffer ``req``'s slices read/write — the
+        disagg tier routes warm admissions to a decode-mesh buffer."""
+        return self._pf_cache
+
+    def _pf_set(self, req: Request, cache) -> None:
+        self._pf_cache = cache
 
     def _advance_migrations(self) -> int:
         """Disagg hook: advance in-flight KV-migration streams by one
@@ -986,21 +1176,19 @@ class ServingEngine:
         and a cleared jit cache — the serving-side half of a
         repartition (jits rebuild lazily through ``_first_call``)."""
         eng = self.engine
-        mesh = eng.ctx.mesh
-
-        def put(tree, specs):
-            return jax.device_put(
-                tree, jax.tree.map(lambda s: NamedSharding(mesh, s),
-                                   specs,
-                                   is_leaf=lambda x: isinstance(x, P)))
-
         cache = init_paged_model_cache(
             self.cfg, self.max_batch, page_size=self.page,
             max_pages=self.max_pages, num_pages=self.num_pages + 1,
             kv_dtype=self.kv_dtype)
-        self._cache = put(cache, paged_cache_specs(eng.shard_axes))
-        self._pf_cache = put(init_kv_cache(self.cfg, 1, self.s_buf),
-                             kv_cache_specs(eng.shard_axes))
+        self._cache = self._put_sharded(
+            cache, paged_cache_specs(eng.shard_axes))
+        self._pf_cache = self._put_sharded(
+            init_kv_cache(self.cfg, 1, self.s_buf),
+            kv_cache_specs(eng.shard_axes))
+        if self.prefix is not None:
+            # The pools were just zeroed: every indexed chain's bytes
+            # are gone — a stale hit would serve garbage KV.
+            self.prefix.invalidate()
         self._jits.clear()
         self._jits_backend = eng.backend
         self._mk = None
@@ -1124,18 +1312,36 @@ class ServingEngine:
     def _prefill_slice(self, req: Request) -> str:
         text = req.text
         T = len(text)
-        start = req.prefill_pos
-        ids = np.zeros((1, self.chunk), np.int32)
-        real = text[start:start + self.chunk]
-        ids[0, :len(real)] = real
-        eng, slice_fn, logits_fn = self._prefill_lane()
-        eng._jit_compiled_last_call = False
-        t0 = self.clock()
-        with obs_trace.span("serving.prefill_slice", req=req.req_id,
-                            start=start, tokens=len(real)):
-            x, self._pf_cache = slice_fn(
-                eng.params, jnp.asarray(ids), self._pf_cache,
-                jnp.int32(start))
+        try:
+            if req.prefill_pos == 0 and req.prefix_hit_tokens > 0:
+                self._prefix_gather(req)
+            start = req.prefill_pos
+            ids = np.zeros((1, self.chunk), np.int32)
+            real = text[start:start + self.chunk]
+            ids[0, :len(real)] = real
+            eng, slice_fn, logits_fn = self._prefill_lane(req)
+            eng._jit_compiled_last_call = False
+            t0 = self.clock()
+            with obs_trace.span("serving.prefill_slice", req=req.req_id,
+                                start=start, tokens=len(real)):
+                x, pf = slice_fn(
+                    eng.params, jnp.asarray(ids), self._pf_get(req),
+                    jnp.int32(start))
+                self._pf_set(req, pf)
+        except Exception as exc:
+            from triton_distributed_tpu import resilience
+            from triton_distributed_tpu.resilience import fleet as fleet_mod
+
+            if (not resilience.is_transient(exc)
+                    or fleet_mod.attribute_rank(exc) is not None
+                    or os.environ.get("TDTPU_DEMOTION_LADDER", "1")
+                    == "0"):
+                # Rank-attributable failures are the FLEET's to judge
+                # (evacuate / retry on kept geometry); non-transient
+                # errors and a pinned ladder propagate.
+                raise
+            self._prefill_fault(req, exc)
+            return req.req_id
         rt = obs_reqtrace.get_tracer()
         if rt is not None:
             rt.span(req.req_id, "prefill_slice", t0, self.clock(),
@@ -1173,14 +1379,102 @@ class ServingEngine:
             self._complete_prefill(req)
         return req.req_id
 
+    def _prefill_fault(self, req: Request, exc: BaseException) -> None:
+        """Transient, non-rank-attributable failure inside a prefill
+        slice (or a warm admission's prefix gather): retry by
+        recompute — the head request preempts (its pages release their
+        references; shared pages stay intact for their other readers)
+        and the prefill buffer is rebuilt (it was donated into the
+        failed jit, so its state is unknown). The paged pools were NOT
+        an operand, so resident chains — including every shared prefix
+        page — are untouched, and the resumed request re-admits warm
+        off the surviving index."""
+        import warnings
+
+        # Buffer reset FIRST: preemption zeroes req.prefix_hit_tokens,
+        # and the disagg override routes on warmness — resetting after
+        # would rebuild the wrong buffer and leave the donated warm
+        # buffer live for the next admission to trip over.
+        self._reset_pf_buffer(req)
+        self.sched._preempt(req)
+        self.flight.note(
+            "prefill_fault",
+            f"{type(exc).__name__} in prefill of {req.req_id}: "
+            f"{str(exc)[:120]} (preempt + recompute-on-resume)",
+            self._iter, req=req.req_id)
+        if self._observing():
+            obs_metrics.registry().counter(
+                "tdtpu_serve_prefill_faults_total",
+                "transient prefill-slice failures absorbed by "
+                "preempt + recompute-on-resume").inc()
+        warnings.warn(
+            f"prefill slice of {req.req_id} failed transiently "
+            f"({type(exc).__name__}); preempted for recompute-on-"
+            "resume", RuntimeWarning, stacklevel=3)
+
+    def _reset_pf_buffer(self, req: Request) -> None:
+        """Fresh zeroed prefill buffer after a failed (donated) slice
+        jit — the disagg tier overrides to target the right mesh."""
+        self._pf_cache = self._put_sharded(
+            init_kv_cache(self.cfg, 1, self.s_buf),
+            kv_cache_specs(self.engine.shard_axes))
+
+    def _prefix_gather(self, req: Request) -> None:
+        """Warm-admission restart (docs/serving.md "Prefix cache"): pull
+        the shared prefix pages into the prefill buffer and move
+        ``prefill_pos`` past them, so only the divergent suffix
+        prefills. The restart is CHUNK-aligned (slices are a fixed
+        grid): tokens between the aligned restart and the token-granular
+        hit recompute into the buffer — identical values by content
+        addressing, so the COW'd boundary page's merged content is
+        exact either way."""
+        hit = req.prefix_hit_tokens
+        restart = hit - hit % self.chunk
+        n_gather = restart // self.page
+        t0 = self.clock()
+        if n_gather:
+            pages = self.sched.allocator.pages(req.req_id)[:n_gather]
+            buf = self._gather_jit(n_gather)(
+                self._pf_get(req), self._cache,
+                jnp.asarray(pages, jnp.int32))
+            self._pf_set(req, buf)
+        req.prefill_pos = restart
+        with obs_trace.span("serving.prefix_hit", req=req.req_id,
+                            hit_tokens=hit, restart=restart):
+            pass
+        rt = obs_reqtrace.get_tracer()
+        if rt is not None:
+            rt.span(req.req_id, "prefix_gather", t0, self.clock(),
+                    hit_tokens=hit, restart=restart)
+        if restart and self._observing():
+            obs_metrics.registry().counter(
+                obs_metrics.PREFIX_TOKENS_SAVED,
+                "prefill tokens skipped because a shared resident "
+                "prefix covered them (warm admissions)").inc(restart)
+
     def _complete_prefill(self, req: Request) -> None:
         """Prefill finished (first token already recorded, ``req.kv_len``
         = prompt length): hand the buffered KV to the decode stage. Here
         the buffer scatters page-aligned into the shared pool and the
         request joins the decode batch; the disaggregated tier instead
-        starts a migration stream to the decode slice's pool."""
+        starts a migration stream to the decode slice's pool.
+
+        Warm admissions scatter only from the first PRIVATE page on:
+        the shared prefix pages are already resident and must never be
+        written (the partially-matched boundary page's replacement — the
+        first fresh page — receives the merged prefix+suffix content
+        from the buffer: that is the copy half of its copy-on-write)."""
         n_pages = -(-req.kv_len // self.page)
         pages = self.sched.allocator.pages(req.req_id)[:n_pages]
+        skip = 0
+        if self.prefix is not None and req.prefix_hit_tokens > 0:
+            skip = req.prefix_hit_tokens // self.page
+            if req._prefix_partial is not None:
+                # The merged content lands in the private replacement;
+                # the read hold on the shared boundary page drops.
+                self.prefix.unpin(req._prefix_partial)
+                req._prefix_partial = None
+        buf = self._pf_get(req)
         if self._mk is not None:
             # The megakernel workspace is the decode-time source of
             # truth: a finished prefill's pages scatter in here too
@@ -1188,11 +1482,15 @@ class ServingEngine:
             if self._mk_ws is None:
                 self._mk_ws = self._mk.start()
             self._mk_ws = self._mk.load_prefill(
-                self._mk_ws, self._pf_cache.k, self._pf_cache.v,
-                pages)
-        self._cache = self._scatter_jit(n_pages)(
-            self._cache, self._pf_cache.k, self._pf_cache.v,
-            jnp.asarray(pages, jnp.int32))
+                self._mk_ws, buf.k, buf.v, pages[skip:],
+                first_page=skip)
+        self._cache = self._scatter_jit(n_pages - skip, skip)(
+            self._cache, buf.k, buf.v,
+            jnp.asarray(pages[skip:], jnp.int32))
+        if self.prefix is not None:
+            # Index the chain (full pages only) for future admissions:
+            # the cache pins each newly indexed page resident.
+            self.prefix.insert(req.text[:req.kv_len], pages)
         req.advance(RequestState.RUNNING)
         rt = obs_reqtrace.get_tracer()
         if rt is not None:
@@ -1504,6 +1802,16 @@ class ServingEngine:
             obs_metrics.SERVE_TOKENS_PER_S,
             "generated tokens/s — rolling window under ServingEngine, "
             "per-call under Engine.serve").set(self._rolling_rate())
+        if self.prefix is not None:
+            reg.gauge(
+                obs_metrics.PREFIX_PAGES_SHARED,
+                "cached prefix pages with live readers beyond the "
+                "cache's own pin (refcount > 1)"
+                ).set(self.prefix.pages_shared())
+            reg.gauge(
+                obs_metrics.PREFIX_HIT_RATE,
+                "cumulative warm-admission fraction (prefix-index hits "
+                "/ lookups)").set(self.prefix.hit_rate())
         if self.fleet is not None:
             self._publish_fleet_gauges(reg)
 

@@ -1,0 +1,346 @@
+"""Prefix-reuse subsystem: radix-indexed copy-on-write page sharing.
+
+ROADMAP open item #1 — the single biggest TTFT lever a production fleet
+has: millions of users share system prompts, few-shot preambles and
+conversation history, yet a naive serving tier re-prefills every
+admission from token 0. The PR-7 page-table indirection makes sharing a
+refcount away (vLLM's PagedAttention showed block-level KV sharing;
+SGLang's RadixAttention showed a radix tree over token prefixes is the
+right index for automatic multi-tenant reuse):
+
+* a **radix/trie index** maps token-id prefixes (page-granularity
+  chunks, plus a partial-tail extension inside the next chunk) to
+  RESIDENT pool page ids. Consulted at admission, so a warm request
+  only prefills its divergent suffix — the hit pages are shared
+  (``PageAllocator.share_pages``: +1 reference each) and the prefill
+  restarts at ``hit_tokens``;
+* **refcounted pages**: share = +ref, free = −ref, physical free only
+  at zero — preempting or finishing one sharer can never free bytes
+  another request (or the cache itself) still reads;
+* **copy-on-write**: a shared page that would be WRITTEN (the divergent
+  suffix landing inside a partially-matched page, or any append whose
+  target still carries other readers) is first replaced by a private
+  copy (``PageAllocator.cow_page`` + a one-page pool copy) and the
+  request's table row rewritten — on both the xla paged path and the
+  megakernel paged workspace (tables are data there, so COW is a
+  host-side row rewrite + one page-tile copy);
+* **eviction ordered by refcount×recency**: the cache holds one
+  reference per indexed page, so hot shared chains (live sharers →
+  refcount > 1) are never evictable, and among cold cache-only pages
+  the least-recently-matched LEAVES release first. Eviction is wired
+  into the allocator's ``reclaim`` hook, so the scheduler's admission
+  budget and page growth see cached-cold pages as available capacity.
+
+This module is PURE HOST logic (no jax): the gather/scatter/page-copy
+jits live in serving/loop.py and megakernel/serving.py. Determinism:
+the index, eviction order and hit scoring depend only on token ids and
+a logical clock, so seeded serving runs replay bit-identically.
+"""
+
+from __future__ import annotations
+
+from triton_distributed_tpu.models.kv_cache import PageAllocator
+
+
+class PrefixConfigError(ValueError):
+    """A prefix-cache parameter is invalid — named, up front (the
+    ``_check_decode_step_config`` style)."""
+
+
+class _Node:
+    """One page-granularity chunk of a cached token chain."""
+
+    __slots__ = ("page", "children", "last_use")
+
+    def __init__(self, page: int, clock: int):
+        self.page = page
+        self.children: dict[tuple, _Node] = {}
+        self.last_use = clock
+
+
+class PrefixCache:
+    """Radix index + refcount pins over a serving tier's page pool.
+
+    One per :class:`~triton_distributed_tpu.serving.loop.ServingEngine`
+    (``prefix_cache=True``). The cache owns one allocator reference per
+    indexed page (``incref`` at insert), releases it at eviction or
+    invalidation, and registers itself as the allocator's
+    ``reclaim``/``reclaimable`` hooks so pool-pressure paths (admission
+    reservation, decode page growth, COW) evict cold chains instead of
+    shedding load.
+
+    Content addressing: page ``i`` of a chain holds KV for positions
+    ``[i*page_size, (i+1)*page_size)`` of some token sequence, and KV at
+    a position depends only on the tokens at and before it — so a chunk
+    chain keyed by token ids is valid for ANY request whose prompt
+    starts with those tokens. A partial tail match (the first ``r``
+    tokens of the next chunk) shares that page read-only: its first
+    ``r`` positions are valid, and the first divergent write triggers
+    COW.
+    """
+
+    def __init__(self, allocator: PageAllocator, page_size: int):
+        if page_size < 1:
+            raise PrefixConfigError(
+                f"page_size = {page_size} invalid: prefix chunks are "
+                "pages — argument page_size")
+        self.allocator = allocator
+        self.page_size = page_size
+        self._root = _Node(-1, 0)
+        self._clock = 0
+        self._pages: set[int] = set()     # pages the cache holds a ref on
+        # pages_shared memo: the scan over _pages is O(pool) and sits on
+        # the per-iteration serving path, but its inputs only change
+        # when a refcount moves (allocator.ref_epoch) — most decode
+        # iterations reuse the cached value.
+        self._shared_memo = (-1, 0)       # (ref_epoch, value)
+        # match/commit_match walk memo: the scheduler probes then
+        # commits the SAME prompt within one admission, so the second
+        # radix walk is redundant unless the tree changed in between.
+        self._tree_epoch = 0
+        self._walk_memo = None            # (tokens obj, tree_epoch, walk)
+        # Evidence (obs satellite): lookups/hits are per-admission,
+        # tokens_saved is the prefill work warm admissions skipped.
+        self.lookups = 0
+        self.hits = 0
+        self.tokens_saved = 0
+        self.evictions = 0
+        self.pages_shared_peak = 0
+        allocator.reclaim = self.reclaim
+        allocator.reclaimable = self.reclaimable
+
+    def note_peak(self) -> int:
+        """Sample the live shared-page count into the peak stat (the
+        serving loop calls this each iteration — the dryrun's
+        nonzero-shared-pages evidence)."""
+        s = self.pages_shared()
+        if s > self.pages_shared_peak:
+            self.pages_shared_peak = s
+        return s
+
+    # -- views ---------------------------------------------------------------
+    @property
+    def pages_held(self) -> int:
+        """Pages the cache currently pins resident."""
+        return len(self._pages)
+
+    def pages_shared(self) -> int:
+        """Cached pages with live readers beyond the cache's own pin
+        (refcount > 1) — the ``tdtpu_prefix_pages_shared`` gauge.
+        Memoized on the allocator's refcount epoch: the O(pages_held)
+        scan only reruns after a refcount actually moved, so pure
+        decode iterations pay one integer compare."""
+        epoch = self.allocator.ref_epoch
+        if self._shared_memo[0] != epoch:
+            self._shared_memo = (epoch, sum(
+                1 for p in self._pages
+                if self.allocator.ref_count(p) > 1))
+        return self._shared_memo[1]
+
+    def hit_rate(self) -> float:
+        """Cumulative warm-admission fraction (0.0 before any lookup)."""
+        return self.hits / self.lookups if self.lookups else 0.0
+
+    # -- index ---------------------------------------------------------------
+    def _chunks(self, tokens) -> list[tuple]:
+        ps = self.page_size
+        toks = [int(t) for t in tokens]
+        return [tuple(toks[i:i + ps])
+                for i in range(0, len(toks) - ps + 1, ps)]
+
+    def insert(self, tokens, pages) -> int:
+        """Index the FULL pages of ``tokens`` (a request whose prefill
+        just scattered them — ``pages[i]`` holds positions
+        ``[i*page, (i+1)*page)``). New nodes pin their page (+1 ref);
+        an existing node keeps its page (first chain wins — both hold
+        identical bytes by content addressing). Returns the number of
+        pages newly indexed."""
+        self._clock += 1
+        node = self._root
+        added = 0
+        for i, chunk in enumerate(self._chunks(tokens)):
+            child = node.children.get(chunk)
+            if child is None:
+                page = int(pages[i])
+                if self.allocator.ref_count(page) < 1:
+                    # Never index a page with no live holder: the chain
+                    # under insertion must still own it.
+                    break
+                self.allocator.incref(page)
+                self._pages.add(page)
+                child = _Node(page, self._clock)
+                node.children[chunk] = child
+                self._tree_epoch += 1
+                added += 1
+            child.last_use = self._clock
+            node = child
+        return added
+
+    def _walk(self, tokens):
+        """Longest resident prefix of ``tokens`` capped at
+        ``len(tokens) - 1``: (hit_tokens, full_pages, partial_page,
+        matched nodes). Pure read — no recency or stat mutation."""
+        toks = [int(t) for t in tokens]
+        cap = len(toks) - 1
+        node = self._root
+        full: list[int] = []
+        nodes: list[_Node] = []
+        ps = self.page_size
+        pos = 0
+        while pos + ps <= cap:
+            chunk = tuple(toks[pos:pos + ps])
+            child = node.children.get(chunk)
+            if child is None:
+                break
+            full.append(child.page)
+            nodes.append(child)
+            node = child
+            pos += ps
+        # Partial tail: the longest common prefix between the remaining
+        # tokens and any child chunk — that child's page holds valid KV
+        # for exactly those positions (a divergent suffix inside the
+        # page is the canonical COW trigger). Ties keep the first
+        # (insertion-ordered) child: deterministic under a fixed seed.
+        partial = None
+        rem = toks[pos:cap]
+        if rem:
+            best = 0
+            best_child = None
+            for chunk, child in node.children.items():
+                length = 0
+                for a, b in zip(rem, chunk):
+                    if a != b:
+                        break
+                    length += 1
+                if length > best:
+                    best = length
+                    best_child = child
+            if best_child is not None:
+                nodes.append(best_child)
+                partial = best_child.page
+                pos += best
+        return pos, full, partial, nodes
+
+    def match(self, tokens) -> tuple[int, list[int], int | None]:
+        """Longest resident prefix of ``tokens``, capped at
+        ``len(tokens) - 1`` (at least one token must prefill — its
+        logits produce the next token). Returns ``(hit_tokens,
+        full_pages, partial_page)``:
+
+        * ``full_pages`` — shared whole pages covering
+          ``hit_tokens // page_size`` chunks (share these);
+        * ``partial_page`` — a page whose first ``hit_tokens % page``
+          positions are valid (pin read-only; the suffix write into it
+          COWs), or None when the hit is page-aligned.
+
+        READ-ONLY: a scheduler may probe the same queued request every
+        iteration while the pool is short, so recency and the
+        hit/lookup stats move only on :meth:`commit_match` (the
+        committed admission) — otherwise a stuck request would inflate
+        the hit rate and distort the recency eviction order."""
+        walk = self._walk(tokens)
+        # Remember the walk for the commit that typically follows in
+        # the same admission (keyed by object identity — holding the
+        # prompt list keeps its id stable — and tree shape).
+        self._walk_memo = (tokens, self._tree_epoch, walk)
+        pos, full, partial, _nodes = walk
+        return pos, full, partial
+
+    def commit_match(self, tokens, hit_tokens: int) -> None:
+        """Record an ADMITTED lookup: one lookup (one hit when
+        ``hit_tokens`` > 0), ``tokens_saved`` grows by the shared
+        tokens, and recency bumps along the matched path. Note
+        ``tokens_saved`` counts tokens covered by shared pages at
+        admission; the ``tdtpu_prefill_tokens_saved_total`` counter
+        counts the chunk-aligned prefill work actually skipped — the
+        partial-page tail recomputes into the buffer, so the counter
+        can trail this stat by up to a chunk per admission."""
+        self._clock += 1
+        self.lookups += 1
+        if hit_tokens > 0:
+            self.hits += 1
+            self.tokens_saved += hit_tokens
+            memo = self._walk_memo
+            if (memo is not None and memo[0] is tokens
+                    and memo[1] == self._tree_epoch):
+                nodes = memo[2][3]
+            else:
+                nodes = self._walk(tokens)[3]
+            for node in nodes:
+                node.last_use = self._clock
+
+    # -- pins (partial-page read holds) --------------------------------------
+    def pin(self, page: int) -> None:
+        """Read-hold on a partially-matched page between admission and
+        the COW at prefill-complete (+1 ref, outside any owner list)."""
+        self.allocator.incref(page)
+
+    def unpin(self, page: int) -> None:
+        self.allocator.decref(page)
+
+    # -- eviction ------------------------------------------------------------
+    def _evictable(self) -> list[tuple[int, _Node, _Node, tuple]]:
+        """(last_use, node, parent, key) for every LEAF whose page only
+        the cache holds (refcount == 1): releasing anything else either
+        frees nothing (live sharers) or breaks a deeper chain."""
+        out = []
+
+        def walk(parent):
+            for key, node in parent.children.items():
+                if node.children:
+                    walk(node)
+                elif self.allocator.ref_count(node.page) == 1:
+                    out.append((node.last_use, node, parent, key))
+
+        walk(self._root)
+        return out
+
+    def reclaim(self, n: int) -> int:
+        """Release up to ``n`` pages back to the pool, coldest evictable
+        leaves first (refcount×recency: pages with live sharers are
+        never candidates, so hot shared prefixes outlive cold private
+        tails by construction). Evicting a leaf can expose its parent
+        as the next candidate, so the scan repeats until satisfied or
+        dry. Returns the count physically freed."""
+        freed = 0
+        while freed < n:
+            cands = self._evictable()
+            if not cands:
+                break
+            cands.sort(key=lambda c: c[0])
+            for _, node, parent, key in cands:
+                if freed >= n:
+                    break
+                del parent.children[key]
+                self._tree_epoch += 1
+                self._pages.discard(node.page)
+                if self.allocator.decref(node.page):
+                    freed += 1
+                self.evictions += 1
+        return freed
+
+    def reclaimable(self) -> int:
+        """Pages :meth:`reclaim` could free right now — admission
+        counts them as available capacity. Conservative: every cached
+        page with no live sharer frees once its subtree of cold
+        descendants goes with it, so the count is all cache-only
+        pages."""
+        return sum(1 for p in self._pages
+                   if self.allocator.ref_count(p) == 1)
+
+    def invalidate(self) -> int:
+        """Drop the whole index and every cache reference — REQUIRED
+        whenever the pool bytes stop being the indexed content (device
+        rebuild, evacuation, a fresh megakernel workspace): a stale hit
+        would serve garbage KV. Live sharers keep their own references;
+        the cache simply stops advertising the chains. Returns the
+        count of references released."""
+        released = 0
+        for p in sorted(self._pages):
+            self.allocator.decref(p)
+            released += 1
+        self._pages.clear()
+        self._root = _Node(-1, self._clock)
+        self._tree_epoch += 1
+        self._walk_memo = None
+        return released

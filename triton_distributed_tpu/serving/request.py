@@ -87,6 +87,14 @@ class Request:
     evacuations: int = 0             # fleet preempt-alls this request rode
     drafted_tokens: int = 0          # spec lane: draft candidates proposed
     accepted_draft_tokens: int = 0   # spec lane: drafts the verifier kept
+    # Prefix-reuse lane (docs/serving.md "Prefix cache"): tokens of the
+    # CURRENT admission's prompt covered by shared resident pages (the
+    # prefill work skipped), and the cumulative total across
+    # re-admissions — the per-request warm-serve evidence loadgen's
+    # request_records carries.
+    prefix_hit_tokens: int = 0       # this admission's hit (reset on preempt)
+    prefix_hit_tokens_total: int = 0
+    _prefix_partial: int | None = None   # pinned partially-matched page
     final_backend: str | None = None  # engine backend at finish time
     arrival_seq: int = -1            # admission order stamp (scheduler)
 

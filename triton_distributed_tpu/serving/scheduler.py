@@ -53,7 +53,7 @@ class Scheduler:
 
     def __init__(self, *, num_slots: int, allocator: PageAllocator,
                  page_size: int, capacity_tokens: int,
-                 max_waiting: int = 64, on_event=None):
+                 max_waiting: int = 64, on_event=None, prefix=None):
         if num_slots < 1:
             raise SchedulerConfigError(
                 f"num_slots = {num_slots} invalid: the decode batch needs "
@@ -79,6 +79,12 @@ class Scheduler:
         # into the request tracer (obs/reqtrace.py); a failing observer
         # must never break scheduling, so calls are exception-guarded.
         self.on_event = on_event
+        # Prefix cache (serving/prefix.py, docs/serving.md "Prefix
+        # cache"): consulted at admission so a warm request shares the
+        # resident pages covering its prompt prefix (+1 ref each) and
+        # reserves fresh pages only for the divergent suffix. None = the
+        # pre-prefix admission path, byte-identical.
+        self.prefix = prefix
         self.admit_cap = num_slots       # SLO-driven admission width
         self.waiting: list[Request] = []
         self.active: list[Request] = []  # PREFILLING + RUNNING, admit order
@@ -133,9 +139,12 @@ class Scheduler:
                 "only ever cycle through self-preemption")
         if len(self.waiting) >= self.max_waiting:
             return AdmitResult.QUEUE_FULL
-        if self.allocator.free_count == 0:
+        if (self.allocator.free_count == 0
+                and self.allocator.reclaimable() == 0):
             # Pool exhausted: nothing admitted from the queue can make
             # progress, so shed load at the door instead of queueing.
+            # Cold cached prefix chains count as available capacity —
+            # the allocator's reclaim hook evicts them on demand.
             return AdmitResult.QUEUE_FULL
         if req.arrival_seq < 0:
             req.arrival_seq = self._seq
@@ -153,14 +162,47 @@ class Scheduler:
     def schedule_admissions(self) -> list[Request]:
         """WAITING/PREEMPTED → PREFILLING while a slot is free, the
         admission cap has room, and the pool can reserve the full
-        prefill scatter (ceil(len(text)/page) pages)."""
+        prefill scatter (ceil(len(text)/page) pages). With a prefix
+        cache attached, the request's prompt is matched against the
+        radix index first: hit pages are SHARED (+1 ref each, no fresh
+        allocation, no re-prefill) and only the divergent suffix
+        reserves fresh pages — ``req.prefix_hit_tokens`` records where
+        the prefill restarts."""
         admitted: list[Request] = []
         while (self.waiting and self._free_slots
                and self.active_count < self.admit_cap):
             req = self._pick_waiting()
             n_pages = max(1, -(-len(req.text) // self.page_size))
-            if self.allocator.alloc_pages(req.req_id, n_pages) is None:
+            hit, full, partial = (self.prefix.match(req.text)
+                                  if self.prefix is not None
+                                  else (0, [], None))
+            if partial is not None:
+                # Pin BEFORE the suffix allocation: a cold (cache-only)
+                # partially-matched page is otherwise evictable by the
+                # reclaim hook alloc_pages may invoke, and pinning a
+                # physically-freed page is a PageRefError. The read-hold
+                # lasts until the COW at prefill-complete (or a
+                # preemption) releases it.
+                self.prefix.pin(partial)
+            if full:
+                self.allocator.share_pages(req.req_id, full)
+            if self.allocator.alloc_pages(req.req_id,
+                                          n_pages - len(full)) is None:
+                # Undo the holds: stays queued whole.
+                if partial is not None:
+                    self.prefix.unpin(partial)
+                if full:
+                    self.allocator.free_pages(req.req_id)
                 break                # pool short: stays queued
+            req.prefix_hit_tokens = hit
+            if hit:
+                req.prefix_hit_tokens_total += hit
+            if self.prefix is not None:
+                # Stats + recency move only on the COMMITTED admission
+                # (match is a read-only probe — see PrefixCache.match).
+                self.prefix.commit_match(req.text, hit)
+            if partial is not None:
+                req._prefix_partial = partial
             self.waiting.remove(req)
             req.slot = min(self._free_slots)
             self._free_slots.discard(req.slot)
@@ -184,6 +226,12 @@ class Scheduler:
     # -- preemption / page growth -------------------------------------------
     def _preempt(self, req: Request) -> None:
         self.allocator.free_pages(req.req_id)
+        if req._prefix_partial is not None:
+            # Drop the partial-page read hold; shared full pages were
+            # released by free_pages (their other readers keep theirs).
+            self.prefix.unpin(req._prefix_partial)
+            req._prefix_partial = None
+        req.prefix_hit_tokens = 0    # re-admission re-matches the index
         if req.slot is not None:
             self._free_slots.add(req.slot)
         req.slot = None
@@ -242,6 +290,9 @@ class Scheduler:
     # -- completion ----------------------------------------------------------
     def finish(self, req: Request, now: float) -> None:
         self.allocator.free_pages(req.req_id)
+        if req._prefix_partial is not None:   # defensive: COW unpins first
+            self.prefix.unpin(req._prefix_partial)
+            req._prefix_partial = None
         if req.slot is not None:
             self._free_slots.add(req.slot)
         req.slot = None
