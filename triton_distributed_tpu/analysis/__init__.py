@@ -24,3 +24,7 @@ Entry points: :func:`analysis.commlint.main` (CLI:
 from triton_distributed_tpu.analysis.checker import Report, Violation, check  # noqa: F401
 from triton_distributed_tpu.analysis.events import Event, TraceSet  # noqa: F401
 from triton_distributed_tpu.analysis.tracer import ReplaySession, trace_op  # noqa: F401
+
+# mklint / page_audit are runnable modules (python -m ...); import them
+# from their own modules to keep ``runpy`` from double-importing them
+# through this package init.

@@ -1,0 +1,364 @@
+"""page-audit — refcount/COW lifetime sanitizer for the paged serving tier.
+
+A shadow-state replay over the :class:`PageAllocator`'s event stream
+(``alloc`` / ``share`` / ``incref`` / ``decref`` / ``cow`` / ``free`` /
+``free_tail`` / ``reclaim``, emitted by the ``on_event`` hook in
+``models/kv_cache.py``). The auditor keeps its OWN refcount map and
+per-owner page lists, so any divergence between what the allocator did
+and what the serving tier believes is a named violation instead of a
+token-parity diff three subsystems later:
+
+* ``double-free`` — a decref on a page whose shadow count is already
+  zero (the caller released a reference it never held);
+* ``use-after-free`` — a share/incref of a free page, or (via
+  :meth:`note_launch`) a decode/verify launch reading a page freed
+  earlier in the same iteration;
+* ``cow-before-append`` — a launch appending into a page whose shadow
+  refcount is not exactly 1 (a sharer still reads those bytes; COW must
+  have replaced the reference first);
+* ``leak`` — at iteration end an owner holds pages although it is no
+  longer live, or a RUNNING owner's holdings exceed the
+  ``ceil(kv_len/page)`` baseline (+1 for the pre-grown append page);
+* ``audit-desync`` — the allocator handed out a page the shadow still
+  believes is live (an auditor attached mid-run, or allocator-state
+  corruption).
+
+Runs LIVE under ``TDTPU_PAGE_AUDIT=1`` inside ``ServingEngine.step()``
+(the engine attaches :meth:`record` as the allocator hook and calls
+:meth:`note_launch` / :meth:`end_iteration` around each decode), and
+OFFLINE from a flight-recorder dump whose iteration records carry the
+``page_events`` / ``page_live`` ride-alongs::
+
+    python -m triton_distributed_tpu.analysis.page_audit <dump.json|run-dir>
+
+Report shape mirrors commlint's (docs/mklint.md, "Shadow-state model").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from triton_distributed_tpu.analysis.checker import Violation
+
+# Violation kinds, most severe first (report ordering).
+PAGE_KIND_ORDER = (
+    "double-free",
+    "use-after-free",
+    "cow-before-append",
+    "leak",
+    "audit-desync",
+)
+
+
+@dataclasses.dataclass
+class AuditReport:
+    """commlint's Report shape, for one audited event stream."""
+
+    op: str
+    n_events: int
+    n_iterations: int
+    violations: list[Violation]
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "op": self.op,
+            "ok": self.ok,
+            "n_events": self.n_events,
+            "n_iterations": self.n_iterations,
+            "violations": [v.to_json() for v in self.violations],
+        }
+
+
+class PageAuditor:
+    """Shadow refcount map + per-owner holdings, fed by allocator events.
+
+    ``max_violations`` bounds the list so a systematically-broken run
+    can't grow the auditor without bound (the count past the cap is
+    still tracked in ``n_suppressed``).
+    """
+
+    def __init__(self, page_size: int = 128, *, max_violations: int = 256,
+                 warm_start: bool = False):
+        self.page_size = int(page_size)
+        # ``warm_start``: the event stream begins mid-run (a flight ring
+        # that rolled past iteration 0), so a reference to a page the
+        # window never saw allocated is a PRE-RING reference, not a
+        # violation — seed the shadow instead of flagging. In-window
+        # frees stay strict (the page enters ``_window_known``).
+        self.warm_start = bool(warm_start)
+        self._window_known: set[int] = set()
+        self.shadow: dict[int, int] = {}       # page -> live references
+        self.owned: dict[str, list[int]] = {}  # owner -> held pages
+        self.freed_this_iter: set[int] = set()
+        self.violations: list[Violation] = []
+        self.max_violations = max_violations
+        self.n_suppressed = 0
+        self.n_events = 0
+        self.iterations = 0
+        self._iter_events: list[dict] = []
+
+    def _flag(self, kind: str, message: str, site: str = "") -> None:
+        if len(self.violations) >= self.max_violations:
+            self.n_suppressed += 1
+            return
+        self.violations.append(Violation(kind=kind, message=message,
+                                         site=site))
+
+    def _warm_seed(self, p: int) -> None:
+        """Under ``warm_start``, a first-touch reference to a page the
+        window never saw allocated carries one pre-ring reference."""
+        if (self.warm_start and p not in self._window_known
+                and p not in self.shadow):
+            self.shadow[p] = 1
+        self._window_known.add(p)
+
+    # -- the allocator hook --------------------------------------------------
+    def record(self, ev: dict) -> None:
+        """``PageAllocator.on_event`` target: apply one event to the
+        shadow state (and buffer it for the flight ride-along)."""
+        self.n_events += 1
+        self._iter_events.append(ev)
+        op = ev["op"]
+        if op in ("alloc", "share"):
+            owner = ev["owner"]
+            held = self.owned.setdefault(owner, [])
+            for p in ev["pages"]:
+                if op == "share":
+                    self._warm_seed(p)
+                else:
+                    self._window_known.add(p)
+                c = self.shadow.get(p, 0)
+                if op == "alloc":
+                    if c != 0:
+                        self._flag(
+                            "audit-desync",
+                            f"allocator handed out page {p} which the "
+                            f"shadow still counts {c} live reference(s) "
+                            "on", site=f"alloc for {owner!r}")
+                    self.shadow[p] = 1
+                else:
+                    if c < 1:
+                        self._flag(
+                            "use-after-free",
+                            f"page {p} shared to {owner!r} while free — "
+                            "no KV bytes to share",
+                            site=f"share for {owner!r}")
+                    self.shadow[p] = c + 1
+                held.append(p)
+        elif op == "incref":
+            p = ev["page"]
+            self._warm_seed(p)
+            c = self.shadow.get(p, 0)
+            if c < 1:
+                self._flag("use-after-free",
+                           f"incref of free page {p} — a reference to "
+                           "bytes the allocator may hand out again",
+                           site="incref")
+            self.shadow[p] = c + 1
+        elif op == "decref":
+            p = ev["page"]
+            self._warm_seed(p)
+            c = self.shadow.get(p, 0)
+            if c < 1:
+                self._flag("double-free",
+                           f"decref of page {p} whose shadow count is "
+                           "already zero — a reference released twice",
+                           site="decref")
+            elif c == 1:
+                del self.shadow[p]
+                self.freed_this_iter.add(p)
+            else:
+                self.shadow[p] = c - 1
+        elif op == "cow":
+            owner, old, new = ev["owner"], ev["old"], ev["new"]
+            self._window_known.add(new)
+            if self.shadow.get(new, 0) != 0:
+                self._flag("audit-desync",
+                           f"COW target page {new} already counts "
+                           f"{self.shadow.get(new, 0)} reference(s)",
+                           site=f"cow for {owner!r}")
+            self.shadow[new] = 1
+            held = self.owned.get(owner)
+            if held and old in held:
+                held[held.index(old)] = new
+            # the old page's reference drops via the decref that follows
+        elif op == "free":
+            self.owned.pop(ev["owner"], None)
+        elif op == "free_tail":
+            held = self.owned.get(ev["owner"])
+            if held is not None:
+                del held[ev["keep"]:]
+        # "reclaim" carries no state change of its own (the evictions it
+        # triggers arrive as decref events).
+
+    # -- launch-time checks --------------------------------------------------
+    def note_launch(self, read_pages, append_pages, *,
+                    site: str = "decode") -> None:
+        """Audit the page set one decode/verify launch reads and the
+        append targets it writes, against the shadow state."""
+        for p in read_pages:
+            p = int(p)
+            if p in self.freed_this_iter or self.shadow.get(p, 0) < 1:
+                self._flag(
+                    "use-after-free",
+                    f"launch reads page {p} which holds no live "
+                    "reference" + (" (freed this iteration)"
+                                   if p in self.freed_this_iter else ""),
+                    site=site)
+        for p in append_pages:
+            p = int(p)
+            c = self.shadow.get(p, 0)
+            if c != 1:
+                self._flag(
+                    "cow-before-append",
+                    f"launch appends into page {p} with refcount {c} — "
+                    "a shared (or free) page must be COW-replaced "
+                    "before any write",
+                    site=site)
+
+    # -- iteration boundary --------------------------------------------------
+    def end_iteration(self, live: dict) -> list[dict]:
+        """Close one serving iteration. ``live`` maps every owner that
+        may legitimately hold pages to its ``kv_len`` (or None for
+        owners mid-prefill/migration, exempt from the count check).
+        Returns (and clears) the iteration's raw event buffer — the
+        flight-record ride-along."""
+        self.iterations += 1
+        for owner, held in self.owned.items():
+            if not held:
+                continue
+            if owner not in live:
+                self._flag(
+                    "leak",
+                    f"owner {owner!r} is no longer live but still holds "
+                    f"{len(held)} page(s) {held[:8]} — references never "
+                    "released", site=f"iteration {self.iterations}")
+            else:
+                kvl = live[owner]
+                if kvl is None:
+                    continue
+                baseline = -(-max(int(kvl), 1) // self.page_size)
+                if len(held) > baseline + 1:
+                    self._flag(
+                        "leak",
+                        f"owner {owner!r} holds {len(held)} pages but "
+                        f"kv_len {kvl} baselines at {baseline} "
+                        "(+1 append page) — growth never rolled back",
+                        site=f"iteration {self.iterations}")
+        self.freed_this_iter.clear()
+        events, self._iter_events = self._iter_events, []
+        return events
+
+    # -- reporting -----------------------------------------------------------
+    def report(self, name: str = "page-audit") -> AuditReport:
+        order = {k: i for i, k in enumerate(PAGE_KIND_ORDER)}
+        vs = sorted(self.violations,
+                    key=lambda v: order.get(v.kind, len(order)))
+        return AuditReport(op=name, n_events=self.n_events,
+                           n_iterations=self.iterations, violations=vs)
+
+    def summary(self) -> dict[str, Any]:
+        s = self.report().to_json()
+        if self.n_suppressed:
+            s["n_suppressed"] = self.n_suppressed
+        return s
+
+
+# -- offline replay -----------------------------------------------------------
+def replay_iterations(iterations, page_size: int | None = None) -> PageAuditor:
+    """Re-run the audit over flight-dump iteration records (each may
+    carry ``page_events`` + ``page_live`` from a live audited run).
+    The records embed the pool's page size (``page_size`` ride-along);
+    an explicit argument overrides it, else 128. A ring that rolled
+    past iteration 1 replays in ``warm_start`` mode — pre-ring
+    references seed the shadow instead of flagging."""
+    iterations = list(iterations)
+    if page_size is None:
+        page_size = next((rec["page_size"] for rec in iterations
+                          if "page_size" in rec), 128)
+    warm = bool(iterations) and int(iterations[0].get("iter", 1)) > 1
+    aud = PageAuditor(page_size, warm_start=warm)
+    for rec in iterations:
+        for ev in rec.get("page_events", ()):
+            aud.record(ev)
+        aud.end_iteration(rec.get("page_live", {}) or {})
+    return aud
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+    import json
+    import os
+
+    parser = argparse.ArgumentParser(
+        prog="page_audit",
+        description="Replay a flight dump's allocator event stream "
+                    "through the shadow-state auditor (docs/mklint.md).")
+    parser.add_argument("paths", nargs="+",
+                        help="flight dump .json files or run directories "
+                             "(searched for flight-*.json)")
+    parser.add_argument("--json", dest="json_path", default=None,
+                        help="write the machine-readable report here")
+    parser.add_argument("--page-size", type=int, default=None,
+                        help="override the page size embedded in the "
+                             "dump's iteration records (default: embedded "
+                             "value, else 128)")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args(argv)
+
+    from triton_distributed_tpu.obs.flight import find_dumps
+
+    dumps: list[str] = []
+    for path in args.paths:
+        if os.path.isdir(path):
+            dumps.extend(find_dumps(path))
+        else:
+            dumps.append(path)
+    if not dumps:
+        print("page_audit: no flight dumps found")
+        return 1
+
+    reports = []
+    failed = 0
+    for path in dumps:
+        with open(path) as f:
+            dump = json.load(f)
+        recs = dump.get("iterations", [])
+        n_ev = sum(len(r.get("page_events", ())) for r in recs)
+        if n_ev == 0:
+            print(f"SKIP {os.path.basename(path):40s} no page_events "
+                  "(run was not audited — TDTPU_PAGE_AUDIT=1)")
+            continue
+        aud = replay_iterations(recs, args.page_size)
+        rep = aud.report(name=os.path.basename(path))
+        reports.append(rep.to_json())
+        status = "OK " if rep.ok else "FAIL"
+        print(f"{status} {rep.op:40s} events={rep.n_events:6d} "
+              f"iterations={rep.n_iterations:4d} "
+              f"violations={len(rep.violations)}")
+        if not rep.ok:
+            failed += 1
+            shown = rep.violations if args.verbose else rep.violations[:8]
+            for v in shown:
+                where = f" @ {v.site}" if v.site else ""
+                print(f"     [{v.kind}] {v.message}{where}")
+
+    if args.json_path:
+        with open(args.json_path, "w") as f:
+            json.dump({"ok": failed == 0, "reports": reports}, f, indent=2)
+        print(f"report written to {args.json_path}")
+
+    total = len(reports)
+    print(f"page_audit: {total - failed}/{total} clean")
+    return 0 if failed == 0 else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

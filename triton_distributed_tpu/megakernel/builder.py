@@ -64,6 +64,11 @@ class MegaKernelBuilder:
         self._edges: list[tuple[int, int]] = []
         self._last_writer: dict[int, int] = {}
         self._readers_since_write: dict[int, list[int]] = {}
+        # Per-task hazard sets (tile ids in the _W8/_WM/_K8-offset spaces),
+        # emission order — exported on the compiled artifact so mklint can
+        # re-derive RAW/WAW/WAR independently of the edge list.
+        self._reads: list[tuple[int, ...]] = []
+        self._writes: list[tuple[int, ...]] = []
         # task id -> flat int list; packed as extra queue rows at compile
         # (page tables for ATTN_DECODE_PAGED — data rows, never dispatched).
         self._task_tables: dict[int, list[int]] = {}
@@ -153,6 +158,8 @@ class MegaKernelBuilder:
             self._last_writer[t] = tid
             self._readers_since_write[t] = []
         self._tasks.append(task)
+        self._reads.append(tuple(reads))
+        self._writes.append(tuple(writes))
         return tid
 
     # -- ops ----------------------------------------------------------------
@@ -823,7 +830,8 @@ class MegaKernelBuilder:
                     f"task type {t.type.name} is retired (GEMM -> "
                     "GEMM_WIDE, ROPE -> NORM_ROPE); the kernel would "
                     "no-op it silently")
-        order = topo_schedule(len(self._tasks), self._edges)
+        order = topo_schedule(len(self._tasks), self._edges,
+                              task_types=[t.type for t in self._tasks])
         # Emission-order task id -> queue row (paged-serving hosts retarget
         # per-slot attention/append rows without re-deriving the schedule).
         task_rows = [0] * len(order)
@@ -875,7 +883,10 @@ class MegaKernelBuilder:
                                   force_ar=force_ar,
                                   used_types=used_types,
                                   head_dim=int(head_dim),
-                                  task_rows=tuple(task_rows))
+                                  task_rows=tuple(task_rows),
+                                  hazard_edges=tuple(self._edges),
+                                  task_reads=tuple(self._reads),
+                                  task_writes=tuple(self._writes))
 
 
 @dataclasses.dataclass
@@ -908,6 +919,12 @@ class CompiledMegaKernel:
     #                               heads zero-padded into their tiles)
     task_rows: tuple | None = None  # emission task id -> queue row (the
     #                                 paged-serving host retarget map)
+    hazard_edges: tuple | None = None  # (src, dst) emission-id dependency
+    #                                    edges the schedule was derived from
+    task_reads: tuple | None = None   # per-task read tile-id sets, emission
+    #                                   order (_W8/_WM/_K8 hazard spaces)
+    task_writes: tuple | None = None  # per-task write tile-id sets (mklint
+    #                                   re-derives RAW/WAW/WAR from these)
 
     def scatter_input(self, ws: jax.Array, h: TensorHandle,
                       value: jax.Array) -> jax.Array:

@@ -503,6 +503,10 @@ class PagedMegakernelDecoder:
         self.last_step_cold = True
         self.last_step_active = 0       # RUNNING slots in the last launch
         self.last_step_pages = 0        # mapped pool pages in the last launch
+        # The last host-rewritten queue + the slot state it was derived
+        # from, for analysis/mklint.py's paged-step checks (references,
+        # not copies — _retarget already owns a fresh queue array).
+        self.last_retarget: dict | None = None
 
     # -- workspace ----------------------------------------------------------
     def start(self):
@@ -717,6 +721,13 @@ class PagedMegakernelDecoder:
                         q[row2, 8] = -1  # skip (c0 < 0)
                         q[row2, 4] = 0
                         q[row2, 7] = 0
+        self.last_retarget = {
+            "queue": q,
+            "kv_lens": [int(kv_lens[b]) for b in range(self.num_slots)],
+            "tables": [[int(p) for p in tables[b]]
+                       for b in range(self.num_slots)],
+            "wins": [int(w) for w in wins],
+        }
         return jnp.asarray(q)
 
     def _rope(self, pos: int) -> tuple[np.ndarray, np.ndarray]:

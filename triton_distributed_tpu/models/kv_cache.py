@@ -204,6 +204,16 @@ class PageAllocator:
         # optional — a tier without a prefix cache never sets them.
         self.reclaim = None
         self.reclaimable = lambda: 0
+        # Lifetime-event hook (analysis/page_audit.py): when set, every
+        # refcount-mutating operation emits one small dict. Kept a plain
+        # attribute (like reclaim) so the default path costs one None
+        # check per operation.
+        self.on_event = None
+
+    def _ev(self, op: str, **kw) -> None:
+        if self.on_event is not None:
+            kw["op"] = op
+            self.on_event(kw)
 
     @property
     def reserved(self) -> tuple[int, ...]:
@@ -258,6 +268,7 @@ class PageAllocator:
                 "incref)")
         self._refs[p] += 1
         self._ref_epoch += 1
+        self._ev("incref", page=p)
 
     def decref(self, page: int) -> bool:
         """Drop one reference; returns True when the page physically
@@ -274,12 +285,14 @@ class PageAllocator:
         self._ref_epoch += 1
         if refs > 1:
             self._refs[p] = refs - 1
+            self._ev("decref", page=p, freed=False)
             return False
         del self._refs[p]
         # Keep the descending order without re-sorting per freed page
         # (free_pages/free_tail release k pages on the serving hot
         # path — k insertions beat k full sorts).
         bisect.insort(self._free, p, key=lambda x: -x)
+        self._ev("decref", page=p, freed=True)
         return True
 
     def share_pages(self, owner, pages) -> None:
@@ -307,6 +320,7 @@ class PageAllocator:
             self._refs[p] += 1
         self._ref_epoch += 1
         held.extend(pages)
+        self._ev("share", owner=str(owner), pages=list(pages))
 
     def cow_page(self, owner, old: int) -> int | None:
         """Copy-on-write bookkeeping: swap the owner's reference on
@@ -324,6 +338,7 @@ class PageAllocator:
                 "only a holder may replace its reference (operation "
                 "cow_page)")
         if not self._free and self.reclaim is not None:
+            self._ev("reclaim", n=1)
             self.reclaim(1)
         if not self._free:
             return None
@@ -331,6 +346,7 @@ class PageAllocator:
         self._refs[new] = 1
         self._ref_epoch += 1
         held[held.index(old)] = new
+        self._ev("cow", owner=str(owner), old=old, new=new)
         self.decref(old)
         return new
 
@@ -346,6 +362,7 @@ class PageAllocator:
             # Cold cached prefix chains are evictable capacity: ask the
             # cache to release before reporting exhaustion (the
             # refcount×recency eviction order lives in the hook).
+            self._ev("reclaim", n=n - len(self._free))
             self.reclaim(n - len(self._free))
         if len(self._free) < n:
             return None          # pool exhausted: preempt or backpressure
@@ -354,6 +371,7 @@ class PageAllocator:
             self._refs[p] = 1
         self._ref_epoch += 1
         held.extend(got)
+        self._ev("alloc", owner=str(owner), pages=list(got))
         return got
 
     def free_pages(self, owner) -> int:
@@ -365,6 +383,8 @@ class PageAllocator:
         or finished sharer can never free bytes another request (or the
         prefix cache) still reads."""
         held = self._owned.pop(owner, [])
+        if held:
+            self._ev("free", owner=str(owner), pages=list(held))
         for p in held:
             self.decref(p)
         return len(held)
@@ -386,6 +406,8 @@ class PageAllocator:
             return 0
         tail = held[keep:]
         del held[keep:]
+        self._ev("free_tail", owner=str(owner), keep=keep,
+                 pages=list(tail))
         for p in tail:
             self.decref(p)
         return len(tail)

@@ -349,6 +349,12 @@ def summarize(run_dir: str, lanes: dict, metrics: dict | None,
     if flight_sec:
         lines.append("")
         lines += flight_sec
+    audit_sec, _ = page_audit_lane(
+        run_dir, load_flight_dumps(run_dir) if flight_dumps is None
+        else flight_dumps)
+    if audit_sec:
+        lines.append("")
+        lines += audit_sec
     migration = migration_lane(metrics)
     if migration:
         lines.append("")
@@ -436,6 +442,73 @@ def flight_problems(flight_dumps: list[tuple]) -> list[str]:
         problems += flight_mod.validate_dump(
             data, path=os.path.basename(p))
     return problems
+
+
+def page_audit_lane(run_dir: str,
+                    flight_dumps: list[tuple]) -> tuple[list[str],
+                                                        list[str]]:
+    """The page-audit lane (docs/mklint.md "Shadow-state model"):
+    loadgen's per-phase ``page-audit.json`` plus a shadow-state replay
+    of every flight dump that carries allocator events. Returns
+    ``(summary lines, --check problems)`` — a recorded refcount/COW
+    violation is lost correctness evidence, so --check fails on it."""
+    from triton_distributed_tpu.analysis.page_audit import (
+        replay_iterations,
+    )
+
+    entries: list[str] = []
+    problems: list[str] = []
+    pa_path = os.path.join(run_dir, "page-audit.json")
+    if os.path.exists(pa_path):
+        try:
+            with open(pa_path) as f:
+                pa = json.load(f)
+        except (OSError, json.JSONDecodeError) as exc:
+            pa = None
+            problems.append(
+                f"page-audit.json unreadable "
+                f"({type(exc).__name__}: {exc})")
+        if pa is not None:
+            phases = pa.get("phases") or {}
+            n_viol = sum(len(p.get("violations") or [])
+                         for p in phases.values())
+            entries.append(f"  page-audit.json: {len(phases)} audited "
+                           f"phase(s), {n_viol} violation(s)")
+            for name, p in phases.items():
+                vs = p.get("violations") or []
+                if vs or not p.get("ok", True):
+                    kinds = sorted({v.get("kind") for v in vs})
+                    problems.append(
+                        f"page-audit phase {name}: {len(vs)} "
+                        f"violation(s) {kinds}")
+    for p, data, err in flight_dumps:
+        if data is None:
+            continue
+        recs = data.get("iterations") or []
+        if not any(r.get("page_events") for r in recs):
+            continue
+        aud = replay_iterations(recs)
+        entries.append(
+            f"  {os.path.basename(p)}: replayed {aud.n_events} "
+            f"allocator event(s) over {aud.iterations} iteration(s), "
+            f"{len(aud.violations)} violation(s)")
+        for v in aud.violations[:8]:
+            problems.append(f"page-audit replay "
+                            f"{os.path.basename(p)}: [{v.kind}] "
+                            f"{v.message}")
+        # The live auditor's cumulative counter rides in each record —
+        # it saw the WHOLE run, including iterations the ring dropped.
+        live_count = max((int(r.get("page_audit_violations") or 0)
+                          for r in recs), default=0)
+        if live_count > len(aud.violations):
+            problems.append(
+                f"page-audit {os.path.basename(p)}: the live auditor "
+                f"recorded {live_count} violation(s), "
+                f"{live_count - len(aud.violations)} before the ring "
+                "window — rerun with a larger flight ring for detail")
+    lines = (["page audit (refcount/COW sanitizer, docs/mklint.md):"]
+             + entries) if entries else []
+    return lines, problems
 
 
 def migration_lane(metrics: dict | None) -> list[str]:
@@ -645,6 +718,14 @@ def main(argv: list[str] | None = None) -> int:
                          "(requests.spans.json) — by default a serving "
                          "run that lost its request traces fails "
                          "--check (pre-ISSUE-13 run dirs)")
+    ap.add_argument("--allow-page-audit-violations", action="store_true",
+                    help="report page-audit (refcount/COW sanitizer) "
+                         "violations without failing --check — by "
+                         "default a violation recorded in "
+                         "page-audit.json or replayed from an audited "
+                         "flight dump fails the page-audit lane (each "
+                         "one is a leak/double-free/use-after-free in "
+                         "the paged serving tier, docs/mklint.md)")
     ap.add_argument("--allow-evacuation", action="store_true",
                     help="report fleet evacuations without failing "
                          "--check (by default a run that evacuated and "
@@ -784,6 +865,10 @@ def main(argv: list[str] | None = None) -> int:
             f"fleet: {debt:g} evacuation(s) never answered by a rejoin "
             "— the run ended on a survivor mesh at degraded capacity "
             "(--allow-evacuation to accept)")
+    _, audit_problems = page_audit_lane(args.run_dir, flight_dumps)
+    if audit_problems and not args.allow_page_audit_violations:
+        failures += [f"{p} (--allow-page-audit-violations to accept)"
+                     for p in audit_problems]
     migrate_failures = migration_failure_count(metrics)
     if migrate_failures and not args.allow_migration_failures:
         failures.append(

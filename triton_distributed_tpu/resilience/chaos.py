@@ -936,6 +936,102 @@ def prefix_serve_selftest() -> list[CaseResult]:
     return cases
 
 
+def page_audit_selftest() -> list[CaseResult]:
+    """One row per --all sweep for the refcount/COW lifetime sanitizer
+    (docs/mklint.md): a serving run that exercises the full page
+    lifecycle — prefix sharing, COW on a shared append, preemption
+    under page pressure (the in-tier form of evacuation: every held
+    page released, recompute on resume) — with the live auditor
+    attached must close with ZERO violations, and a seeded double
+    decref on the same allocator must then be flagged as
+    ``double-free`` (the clean verdict is only evidence if the
+    sanitizer demonstrably still detects)."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from triton_distributed_tpu.models import (
+        Engine, init_dense_llm, tiny_config,
+    )
+    from triton_distributed_tpu.runtime import initialize_distributed
+    from triton_distributed_tpu.serving.loop import ServingEngine
+
+    cfg = tiny_config()
+    params = init_dense_llm(jax.random.key(0), cfg)
+    ctx1 = initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                  devices=jax.devices()[:1])
+    pre = list(range(100, 112))
+    prompts = [pre + [3, 5], pre + [7, 9, 11], pre + [13, 15]]
+    gens = [8, 8, 8]
+    oracle = Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                    page_size=4)
+    golden = {}
+    for i, (p, g) in enumerate(zip(prompts, gens)):
+        golden[i] = np.asarray(
+            oracle.serve(jnp.asarray([p], jnp.int32), gen_len=g)
+        )[0].tolist()
+
+    t0 = time.time()
+    diags: list[str] = []
+    audit_prev = os.environ.get("TDTPU_PAGE_AUDIT")
+    os.environ["TDTPU_PAGE_AUDIT"] = "1"
+    try:
+        eng = Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                     page_size=4)
+        # The pool is sized so the third admission forces an eviction
+        # while the first two share the resident preamble: preempt,
+        # COW (the sharer's append into a shared page) and full-release
+        # /recompute all land in one audited run.
+        se = ServingEngine(eng, max_batch=2, num_pages=10,
+                           prefill_chunk=4, prefix_cache=True)
+        reqs = []
+        for i, (p, g) in enumerate(zip(prompts, gens)):
+            r, res = se.submit(p, g, req_id=f"chaos-pa-{i}",
+                               priority=1 if i == 0 else 0)
+            assert res.name == "ADMITTED", res
+            reqs.append(r)
+        se.run()
+        parity = all(r.tokens == golden[i] for i, r in enumerate(reqs))
+        preemptions = sum(r.preemptions for r in reqs)
+        clean = se.page_audit is not None and not se.page_audit.violations
+        diags += [
+            f"live auditor attached: {se.page_audit is not None}",
+            f"events audited: "
+            f"{se.page_audit.n_events if se.page_audit else 0}",
+            f"preempt/COW lifecycle clean: {clean} "
+            f"(preemptions={preemptions})",
+            f"token parity vs cold sequential serve: {parity}"]
+        # Detection proof: release a reference the audited history
+        # never granted (a forged count on a free page — the shadow
+        # correctly counts it at zero, so the decref is a double-free).
+        alloc = se.sched.allocator
+        victim = next(p for p in range(alloc.num_pages)
+                      if alloc.ref_count(p) == 0
+                      and p not in alloc.reserved)
+        alloc._refs[victim] = 1
+        alloc.decref(victim)
+        seeded = [v.kind for v in se.page_audit.violations]
+        diags.append(f"seeded unbacked decref flagged: {seeded}")
+        verdict = ("detected" if clean and parity and preemptions
+                   and "double-free" in seeded else "error")
+    except Exception as exc:                        # died = the failure
+        verdict = "error"
+        diags.append(f"{type(exc).__name__}: {exc}")
+    finally:
+        if audit_prev is None:
+            os.environ.pop("TDTPU_PAGE_AUDIT", None)
+        else:
+            os.environ["TDTPU_PAGE_AUDIT"] = audit_prev
+    return [CaseResult(
+        op="page_audit", mesh="1", fault="preempt_cow_lifecycle",
+        verdict=verdict, detected_by="page_audit",
+        expected=("detected",), ok=verdict == "detected", n_fired=1,
+        n_violations=0, diagnostics=diags,
+        elapsed_s=round(time.time() - t0, 3))]
+
+
 def fleet_selftest() -> list[CaseResult]:
     """Three rows per --all sweep:
 
@@ -1359,6 +1455,13 @@ def sweep(ops, faults, ranks, *, seed: int = 0,
         # a seeded fault in a warm admission's suffix prefill must
         # retry with parity and never corrupt shared pages.
         for case in prefix_serve_selftest():
+            cases.append(case)
+            failed += not case.ok
+            _print_case(case, verbose)
+        # Page-audit row (docs/mklint.md): the preempt/COW/full-release
+        # lifecycle audited clean by the live refcount sanitizer, plus
+        # a seeded double decref proving detection still fires.
+        for case in page_audit_selftest():
             cases.append(case)
             failed += not case.ok
             _print_case(case, verbose)

@@ -281,6 +281,30 @@ def dryrun(json_path: str | None, flight_dir: str | None = None) -> int:
 
     failures: list[str] = []
 
+    # Page-audit lane (analysis/page_audit.py): every engine this dryrun
+    # builds runs with the shadow-state lifetime sanitizer LIVE, and
+    # every phase must close with zero violations — leaks, double-frees,
+    # use-after-free and COW-before-append across preemption, eviction,
+    # migration, spec rollback and prefix sharing are all in scope.
+    audit_prev = os.environ.get("TDTPU_PAGE_AUDIT")
+    os.environ["TDTPU_PAGE_AUDIT"] = "1"
+    page_audits: dict[str, dict] = {}
+
+    def _audit(phase: str, se_) -> None:
+        aud = getattr(se_, "page_audit", None)
+        if aud is None:
+            failures.append(
+                f"{phase}: engine has no live page auditor — the "
+                "TDTPU_PAGE_AUDIT wiring regressed")
+            return
+        s = aud.summary()
+        page_audits[phase] = s
+        if not s["ok"]:
+            kinds = [v["kind"] for v in s["violations"][:6]]
+            failures.append(
+                f"{phase}: page-audit violations {kinds} "
+                f"({len(s['violations'])} total) — see report")
+
     # Phase 1 — seeded trace under page pressure: parity + preemption.
     # num_pages 8 against 4 slots wanting up to ceil(19/4)=5 pages each
     # forces eviction mid-decode; the preempted request recomputes on
@@ -326,6 +350,7 @@ def dryrun(json_path: str | None, flight_dir: str | None = None) -> int:
          "preemptions": r.preemptions,
          "ttft_ms": round(r.ttft_s * 1e3, 3) if r.ttft_s else None}
         for r in reqs]
+    _audit("phase1-pressure", se)
 
     # Phase 2 — backpressure: a pool of 2 pages is fully reserved by the
     # first admission (prompt 5, max_new 3 → final KV 7 ≤ 2 pages);
@@ -344,6 +369,7 @@ def dryrun(json_path: str | None, flight_dir: str | None = None) -> int:
             f"(first={res_a}, second={res_b})")
     report["backpressure_fired"] = backpressure
     se2.run()                      # drain phase-2 work
+    _audit("phase2-backpressure", se2)
 
     # Phase 3 — SLO coupling: an impossible tokens/s floor must shrink
     # the admitted batch within the shrink-streak budget.
@@ -365,6 +391,7 @@ def dryrun(json_path: str | None, flight_dir: str | None = None) -> int:
     report["slo_admission"] = {"initial_cap": cap0,
                                "final_cap": se3.sched.admit_cap,
                                "shrunk": slo_shrunk}
+    _audit("phase3-slo", se3)
 
     # Phase 4 (round 9) — megakernel serving lane: the same parity
     # contract on the PAGED persistent kernel (page_size == TILE): every
@@ -429,6 +456,7 @@ def dryrun(json_path: str | None, flight_dir: str | None = None) -> int:
         "iterations": mk_report["iterations"],
         "all_finished": mk_report["all_finished"],
     }
+    _audit("phase4-megakernel", se4)
 
     # Phase 5 (round 10) — disaggregated tier (docs/disagg.md): the same
     # per-request parity contract with prefill and decode on SEPARATE
@@ -496,6 +524,7 @@ def dryrun(json_path: str | None, flight_dir: str | None = None) -> int:
         "page_id_rewrites": len(rewrites),
         "all_finished": dg_report["all_finished"],
     }
+    _audit("phase5-disagg", se5)
 
     # Phase 6 (ISSUE 11) — elastic fleet: a TP=2 serving tier loses
     # rank 1 mid-serve, EVACUATES to the TP=1 survivor mesh (every
@@ -600,6 +629,7 @@ def dryrun(json_path: str | None, flight_dir: str | None = None) -> int:
             "post_rejoin_parity": post_req.tokens == fl_golden["fl-0"],
             "events": [e["event"] for e in se6.fleet_log],
         }
+        _audit("phase6-fleet", se6)
 
     # Phase 7 (round 12) — fp8 KV cache: (a) at a FIXED HBM budget the
     # e4m3 pool holds exactly 2× the bf16 pages (4× the f32 pages),
@@ -688,6 +718,7 @@ def dryrun(json_path: str | None, flight_dir: str | None = None) -> int:
         "preempted_with_parity": f8_preempted,
         "all_finished": f8_report["all_finished"],
     }
+    _audit("phase7-fp8kv", se7)
 
     # Phase 8 (ISSUE 13) — request tracing + flight recorder: a traced
     # serving run under an impossible tokens/s floor must (a) leave
@@ -748,6 +779,7 @@ def dryrun(json_path: str | None, flight_dir: str | None = None) -> int:
         "breakdown_partition_ok": not bad_bd,
         "preemptions": rep8["preemptions"],
     }
+    _audit("phase8-reqtrace", se8)
 
     # Phase 9 (ISSUE 14) — speculative decode: greedy draft-and-verify
     # (spec_k > 0) must be TOKEN-IDENTICAL to sequential one-token
@@ -854,6 +886,8 @@ def dryrun(json_path: str | None, flight_dir: str | None = None) -> int:
         "megakernel_accepted_drafts": sum(
             r.accepted_draft_tokens for r in mk_sp_reqs),
     }
+    _audit("phase9-spec", se9)
+    _audit("phase9-spec-megakernel", se9mk)
 
     # Phase 10 (ISSUE 15) — prefix-reuse subsystem (docs/serving.md
     # "Prefix cache"): a shared-prefix trace (prompt families with a
@@ -1013,6 +1047,22 @@ def dryrun(json_path: str | None, flight_dir: str | None = None) -> int:
         "disagg_skips": se10dg.prefix_disagg_skips,
         "disagg_warm_hit_tokens": dg_warm.prefix_hit_tokens_total,
     }
+    _audit("phase10-prefix", se10)
+    _audit("phase10-prefix-megakernel", se10mk)
+    _audit("phase10-prefix-disagg", se10dg)
+
+    if audit_prev is None:
+        os.environ.pop("TDTPU_PAGE_AUDIT", None)
+    else:
+        os.environ["TDTPU_PAGE_AUDIT"] = audit_prev
+    audited_clean = bool(page_audits) and all(
+        a["ok"] for a in page_audits.values())
+    report["page_audit"] = {"ok": audited_clean, "phases": page_audits}
+    if flight_dir:
+        # Next to the flight dumps, so CI's obs artifact carries it and
+        # ``obs.report --check`` can gate on recorded violations.
+        with open(os.path.join(flight_dir, "page-audit.json"), "w") as f:
+            json.dump(report["page_audit"], f, indent=2)
 
     report["failures"] = failures
     if json_path:

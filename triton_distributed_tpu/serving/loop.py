@@ -287,6 +287,20 @@ class ServingEngine:
                                       reserved=(self.scratch_page,))
         else:
             allocator = PageAllocator(pool_pages, self.max_pages)
+        # Refcount/COW lifetime sanitizer (analysis/page_audit.py):
+        # TDTPU_PAGE_AUDIT=1 shadows every allocator event and audits
+        # each iteration's launches + holdings, feeding the flight
+        # recorder's page_events ride-along for offline replay.
+        self.page_audit = None
+        self._last_page_events: list[dict] = []
+        self._last_page_live: dict = {}
+        if _env_int("TDTPU_PAGE_AUDIT", 0):
+            from triton_distributed_tpu.analysis.page_audit import (
+                PageAuditor,
+            )
+
+            self.page_audit = PageAuditor(page)
+            allocator.on_event = self.page_audit.record
         # Prefix-reuse subsystem (ISSUE 15, docs/serving.md "Prefix
         # cache"): the radix index + cache pins register themselves as
         # the allocator's reclaim hooks, so admission and page growth
@@ -856,10 +870,14 @@ class ServingEngine:
             preempted = list(preempted) + cow_evicted
         decoded = len(ready)
         if ready:
+            if self.page_audit is not None:
+                self._audit_launch(ready)
             self._decode(ready)
         if self.prefix is not None:
             self.prefix.note_peak()
         self._iter += 1
+        if self.page_audit is not None:
+            self._audit_iteration()
         obs_on = self._observing()
         if obs_on:
             reg = obs_metrics.registry()
@@ -992,6 +1010,36 @@ class ServingEngine:
                 f"flight-recorder dump failed: {type(exc).__name__}: "
                 f"{exc}", RuntimeWarning, stacklevel=2)
 
+    # -- page-audit tick (analysis/page_audit.py) ----------------------------
+    def _audit_launch(self, ready: list[Request]) -> None:
+        """Audit the page set this iteration's decode/verify launch
+        reads and the append targets it writes (pre-launch state: the
+        COW guard has run, kv_lens not yet advanced)."""
+        alloc = self.sched.allocator
+        spec = self._spec_enabled()
+        for req in ready:
+            pages = alloc.pages(req.req_id)
+            win = (1 + len(self._drafts.get(req.req_id, []))
+                   if spec else 1)
+            ti = req.kv_len // self.page
+            last_ti = (req.kv_len + win - 1) // self.page
+            reads = pages[:-(-req.kv_len // self.page)]
+            appends = pages[ti:min(last_ti + 1, len(pages))]
+            self.page_audit.note_launch(
+                reads, appends,
+                site=f"decode iter {self._iter} req {req.req_id}")
+
+    def _audit_iteration(self) -> None:
+        """Close the auditor's iteration: leak checks against the live
+        request set, and stash the event buffer for the flight record."""
+        live = {}
+        for r in self.sched.active:
+            live[str(r.req_id)] = (r.kv_len
+                                   if r.state is RequestState.RUNNING
+                                   else None)
+        self._last_page_live = live
+        self._last_page_events = self.page_audit.end_iteration(live)
+
     def _flight_record_iteration(self, now: float, admitted, prefilled,
                                  preempted, decoded: int) -> None:
         alloc = self.sched.allocator
@@ -1002,6 +1050,12 @@ class ServingEngine:
             rec_extra["spec"] = {"drafted": self._last_spec[0],
                                  "accepted_drafts": self._last_spec[1],
                                  "fallback": self._spec_fallback}
+        if self.page_audit is not None:
+            rec_extra["page_events"] = self._last_page_events
+            rec_extra["page_live"] = self._last_page_live
+            rec_extra["page_size"] = self.page
+            rec_extra["page_audit_violations"] = len(
+                self.page_audit.violations)
         if self.prefix is not None:
             rec_extra["prefix"] = {
                 "hits": self.prefix.hits,
