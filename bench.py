@@ -986,6 +986,22 @@ def _serving_metric():
     except Exception as e:
         out["serving_disagg_error"] = \
             f"{type(e).__name__}: {str(e)[:120]}"
+    # Round 17: the fleet-router rung (docs/fleet.md) races a 4-replica
+    # data-parallel fleet against a 1-replica fleet measured identically
+    # in the same window; virtual replicas serialize on one host, so
+    # both report parallel-equivalent makespan (Σ per-iteration max
+    # replica step). `serve_fleet_scaling_x` is what the router's
+    # admission/drain bookkeeping must not tax away. Additive.
+    try:
+        from triton_distributed_tpu.serving.loadgen import (
+            fleet_serving_bench_rung,
+        )
+
+        out.update(fleet_serving_bench_rung(n_replicas=4, n_streams=8,
+                                            prompt_len=128, max_new=16))
+    except Exception as e:
+        out["serving_fleet_error"] = \
+            f"{type(e).__name__}: {str(e)[:120]}"
     return out
 
 

@@ -1,0 +1,425 @@
+"""Multi-replica fleet router (ISSUE 17, docs/fleet.md).
+
+The load-bearing contracts: prefix-affinity routing from the shadow
+index (never device probing), bounded spill chains ending in a NAMED
+shed, drain-onto-siblings with token parity and first-submission TTFT
+accounting, deterministic autoscale decisions, and per-replica
+namespacing everywhere evidence lands (metrics labels, flight-dump
+filenames, page-audit report names).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from triton_distributed_tpu.fleet import (
+    AffinityIndex, AutoscaleConfigError, Autoscaler, FleetConfigError,
+    FleetRouter, FleetShedError, ReplicaHandle,
+)
+from triton_distributed_tpu.models.config import tiny_config
+from triton_distributed_tpu.models.dense import init_dense_llm
+from triton_distributed_tpu.models.engine import Engine
+from triton_distributed_tpu.obs import metrics as obs_metrics
+from triton_distributed_tpu.runtime import initialize_distributed
+from triton_distributed_tpu.serving import AdmitResult
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    """(cfg, params) shared by every fleet in this module."""
+    cfg = tiny_config()
+    params = init_dense_llm(jax.random.PRNGKey(7), cfg)
+    return cfg, params
+
+
+def _replica_engine(tiny, devices):
+    cfg, params = tiny
+    ctx = initialize_distributed(mesh_shape=(len(devices),),
+                                 axis_names=("tp",), devices=devices)
+    return Engine(cfg, params, ctx, backend="xla", max_seq=64,
+                  page_size=4)
+
+
+# Building an Engine recompiles its serve/prefill/decode jits, which
+# dominates this module's wall clock. The serving tier's mutable state
+# (scheduler, pools, prefix cache, registries) lives on ServingEngine,
+# so 1-device Engines are reusable across tests — each fleet still gets
+# DISTINCT Engine objects per replica. Struck (2-device) replicas are
+# always built fresh: evacuation repartitions the Engine itself.
+_ENGINE_POOL: list = []
+
+
+def _pooled_engine(tiny, slot):
+    while len(_ENGINE_POOL) <= slot:
+        _ENGINE_POOL.append(_replica_engine(tiny, jax.devices()[:1]))
+    return _ENGINE_POOL[slot]
+
+
+def _fleet(tiny, n, *, struck=None, **kw):
+    """n replicas; only ``struck`` gets a 2-device mesh, so a rank-1
+    loss lands in exactly that replica's health ledger."""
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("num_pages", 16)
+    kw.setdefault("prefill_chunk", 4)
+    kw.setdefault("max_waiting", 8)
+    kw.setdefault("prefix_cache", True)
+    policy = kw.pop("policy", "affinity")
+    strict_shed = kw.pop("strict_shed", False)
+    autoscaler = kw.pop("autoscaler", None)
+    reps = []
+    for i in range(n):
+        if i == struck:
+            eng = _replica_engine(tiny, jax.devices()[:2])
+        else:
+            eng = _pooled_engine(tiny, i)
+        reps.append(ReplicaHandle.build(str(i), eng, **kw))
+    return FleetRouter(reps, policy=policy, strict_shed=strict_shed,
+                       autoscaler=autoscaler)
+
+
+_ORACLE = {}
+
+
+def _golden(tiny, prompt, max_new):
+    """Sequential-serve oracle; one engine per module (rebuilding one
+    per call recompiles the serve path every time)."""
+    import jax.numpy as jnp
+
+    key = id(tiny)
+    if key not in _ORACLE:
+        _ORACLE.clear()
+        _ORACLE[key] = _replica_engine(tiny, jax.devices()[:1])
+    toks = _ORACLE[key].serve(jnp.asarray([prompt], jnp.int32),
+                              gen_len=max_new)
+    return np.asarray(toks)[0].tolist()
+
+
+# ---------------------------------------------------------------------------
+# AffinityIndex: the replica-coverage shadow.
+# ---------------------------------------------------------------------------
+
+def test_affinity_index_lcp_and_events():
+    ix = AffinityIndex()
+    ix.note("0", "insert", [1, 2, 3, 4])
+    ix.note("1", "insert", [1, 2, 9])
+    assert ix.match_len("0", [1, 2, 3, 7]) == 3
+    assert ix.match_len("1", [1, 2, 3, 7]) == 2
+    assert ix.match_len("1", [8, 8]) == 0
+    # A hit refreshes coverage too (the replica proved it still holds it).
+    ix.note("1", "hit", [5, 6])
+    assert ix.match_len("1", [5, 6, 7]) == 2
+    # invalidate drops the WHOLE replica's coverage (pool rebuilt).
+    ix.note("1", "invalidate", None)
+    assert ix.match_len("1", [1, 2]) == 0
+    assert ix.match_len("0", [1, 2]) == 2
+    assert ix.coverage("0") == 1 and ix.coverage("1") == 0
+
+
+def test_affinity_index_bound_drop_and_bad_kind():
+    ix = AffinityIndex(max_chains=2)
+    for i in range(4):
+        ix.note("0", "insert", [i, i + 1])
+    assert ix.coverage("0") == 2          # recency-bounded, no growth
+    assert ix.match_len("0", [0, 1]) == 0  # oldest chain evicted
+    assert ix.match_len("0", [3, 4]) == 2
+    ix.drop("0")
+    assert ix.coverage("0") == 0
+    with pytest.raises(ValueError, match="kind"):
+        ix.note("0", "mystery", [1])
+
+
+# ---------------------------------------------------------------------------
+# Named configuration errors.
+# ---------------------------------------------------------------------------
+
+def test_fleet_config_errors():
+    with pytest.raises(FleetConfigError, match="at least one replica"):
+        FleetRouter([])
+    rep = ReplicaHandle("0", se=None)
+    with pytest.raises(FleetConfigError, match="not a ReplicaHandle"):
+        FleetRouter([object()])
+    with pytest.raises(FleetConfigError, match="duplicate replica id"):
+        FleetRouter([rep, ReplicaHandle("0", se=None)])
+    with pytest.raises(FleetConfigError, match="policy"):
+        FleetRouter([rep], policy="random")
+    with pytest.raises(FleetConfigError, match="max_spills"):
+        FleetRouter([rep], max_spills=-1, clock=lambda: 0.0)
+
+
+def test_autoscaler_config_errors():
+    with pytest.raises(AutoscaleConfigError, match="min_replicas"):
+        Autoscaler(min_replicas=0)
+    with pytest.raises(AutoscaleConfigError, match="cooldown"):
+        Autoscaler(cooldown=0)
+    with pytest.raises(AutoscaleConfigError, match="queue_high"):
+        Autoscaler(queue_high=0)
+    with pytest.raises(AutoscaleConfigError, match="shrink_margin"):
+        Autoscaler(shrink_margin=1.5)
+
+
+def test_shed_error_is_named():
+    e = FleetShedError("r-9", ["0", "1", "2"], 2)
+    assert e.req_id == "r-9" and e.tried == ["0", "1", "2"]
+    assert e.spills == 2
+    assert "shed" in str(e) and "r-9" in str(e) and "3 candidate" in str(e)
+
+
+# ---------------------------------------------------------------------------
+# Routing: spread, affinity, spill/shed, retry accounting.
+# ---------------------------------------------------------------------------
+
+def test_cold_traffic_spreads_with_parity(tiny):
+    from triton_distributed_tpu.serving.loadgen import run_trace
+
+    router = _fleet(tiny, 3)
+    trace = [
+        {"req_id": f"c-{i}", "arrival_iter": 0,
+         "prompt": [11 + 5 * i, 3, 77, 4 + i, 29, 6 + i],
+         "max_new_tokens": 4, "priority": 0}
+        for i in range(6)
+    ]
+    report = run_trace(router, [dict(t) for t in trace])
+    reqs = {r.req_id: r for r in report.pop("requests")}
+    assert report["all_finished"]
+    assert router.routed == 6 and router.sheds == 0
+    spread = [rid for rid, rep in sorted(router.replicas.items())
+              if rep.routed > 0]
+    assert len(spread) >= 2, spread
+    for t in trace:
+        assert reqs[t["req_id"]].tokens == _golden(
+            tiny, t["prompt"], t["max_new_tokens"])
+    desc = router.describe()
+    assert desc["routed"] == 6 and desc["replicas_active"] == 3
+    assert [row["replica"] for row in desc["replicas"]] == ["0", "1", "2"]
+
+
+def test_affinity_routes_warm_to_holder(tiny):
+    router = _fleet(tiny, 2)
+    fam = [9, 9, 8, 7, 6, 5, 4, 3]
+    req0, res0 = router.submit(fam, 3, req_id="warm-0")
+    assert res0 is AdmitResult.ADMITTED
+    router.run()
+    # The cold serve fed insert events through the PrefixCache hook:
+    # the shadow now advertises the family on exactly one replica.
+    holder = [rid for rid in router.replicas
+              if router.affinity.coverage(rid) > 0]
+    assert len(holder) == 1
+    req1, res1 = router.submit(fam[:6] + [99, 98], 3, req_id="warm-1")
+    assert res1 is AdmitResult.ADMITTED
+    assert router.affinity_hits == 1
+    hit_rep = [rid for rid, rep in router.replicas.items()
+               if rep.affinity_hits > 0]
+    assert hit_rep == holder
+    router.run()
+    assert req1.state.name == "FINISHED"
+
+
+def test_spill_then_named_shed_then_retry_accounting(tiny):
+    router = _fleet(tiny, 2, max_batch=1, max_waiting=1, num_pages=4,
+                    strict_shed=True)
+    admitted = []
+    shed_exc = None
+    for i in range(8):
+        try:
+            rq, rs = router.submit([21 + i, 7, 3, 5 + i], 3,
+                                   req_id=f"sp-{i}")
+        except FleetShedError as e:
+            shed_exc = e
+            break
+        assert rs is AdmitResult.ADMITTED
+        admitted.append(rq)
+    assert shed_exc is not None, "the fleet never saturated"
+    assert shed_exc.req_id == f"sp-{len(admitted)}"
+    assert sorted(shed_exc.tried) == ["0", "1"]   # full chain walked
+    assert router.sheds == 1 and router.spills >= 1
+    assert router.shed_log[-1]["req_id"] == shed_exc.req_id
+    # Open-loop retry with the SAME req_id: TTFT counts from the FIRST
+    # submission — the shed-and-retry wait must not vanish.
+    first_try = router._first_try[shed_exc.req_id]
+    router.run()
+    rq, rs = router.submit([55, 56, 57, 58], 3, req_id=shed_exc.req_id)
+    assert rs is AdmitResult.ADMITTED
+    assert router.shed_retries == 1
+    assert rq.t_arrival == first_try
+    router.run()
+    assert all(r.state.name == "FINISHED" for r in admitted)
+
+
+# ---------------------------------------------------------------------------
+# Drain / re-admit: the resilience composition.
+# ---------------------------------------------------------------------------
+
+def test_kill_one_replica_drains_onto_siblings_with_parity(tiny):
+    import warnings
+
+    from triton_distributed_tpu.resilience import faults
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 virtual CPU devices")
+    rejoin_prev = os.environ.get("TDTPU_REJOIN_AFTER")
+    os.environ["TDTPU_REJOIN_AFTER"] = "3"
+    try:
+        router = _fleet(tiny, 2, struck=1)
+    finally:
+        if rejoin_prev is None:
+            os.environ.pop("TDTPU_REJOIN_AFTER", None)
+        else:
+            os.environ["TDTPU_REJOIN_AFTER"] = rejoin_prev
+    trace = [
+        {"req_id": f"dr-{i}",
+         "prompt": [31 + 9 * i, 2, 64, 5 + i, 17, 3 + i],
+         "max_new_tokens": 4} for i in range(4)
+    ]
+    reqs = {}
+    for t in trace:
+        rq, rs = router.submit(t["prompt"], t["max_new_tokens"],
+                               req_id=t["req_id"])
+        assert rs is AdmitResult.ADMITTED
+        reqs[rq.req_id] = rq
+    assert router.replicas["1"].routed > 0   # the victim holds work
+    for _ in range(2):
+        router.step()
+    arrivals = {rid: r.t_arrival for rid, r in reqs.items()}
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            faults.mark_rank_lost(1)
+            for _ in range(4):
+                router.step()
+            assert router.replicas["1"].draining
+            assert router.drain_moves >= 1
+            faults.clear_rank_loss(1)
+            router.run()
+    finally:
+        faults.clear_rank_loss()
+    for t in trace:
+        r = reqs[t["req_id"]]
+        assert r.state.name == "FINISHED"
+        assert r.tokens == _golden(tiny, t["prompt"],
+                                   t["max_new_tokens"]), t["req_id"]
+        # First-submission accounting survives the cross-replica move.
+        assert r.t_arrival == arrivals[t["req_id"]]
+    assert router.drains == 1 and router.readmits == 1
+    assert not router.replicas["1"].draining
+    assert [e["event"] for e in router.fleet_log] == ["drain", "readmit"]
+
+
+def test_manual_drain_is_idempotent_and_parks_overflow(tiny):
+    router = _fleet(tiny, 2, max_batch=1, max_waiting=1, num_pages=4)
+    for i in range(4):
+        rq, rs = router.submit([41 + i, 6, 2, 9 + i], 3, req_id=f"mp-{i}")
+        assert rs is AdmitResult.ADMITTED, f"mp-{i}: {rs}"
+        if i == 1:
+            router.step()   # move the first pair waiting -> active
+    moved = router.drain("0", reason="manual")
+    assert moved >= 1 and router.drains == 1
+    assert router.drain("0") == 0 and router.drains == 1   # idempotent
+    # Sibling capacity is 1+1: the overflow parks on the pending queue
+    # (never dropped) and admits as slots free up.
+    router.replicas["0"].draining = False   # manual re-admit for the run
+    router.run()
+    assert not router._pending
+
+
+# ---------------------------------------------------------------------------
+# Autoscaler decisions.
+# ---------------------------------------------------------------------------
+
+def test_autoscaler_shrinks_idle_then_grows_under_pressure(tiny):
+    router = _fleet(tiny, 3, autoscaler=Autoscaler(
+        min_replicas=1, cooldown=2, queue_high=1.0))
+    router.submit([3, 1, 4, 1, 5], 2, req_id="as-0")
+    router.run()
+    auto = router.autoscaler
+    assert auto.shrinks >= 1
+    assert any(rep.scaled_out for rep in router.replicas.values())
+    for i in range(8):
+        router.submit([61 + 3 * i, 2, 8, 5 + i], 3, req_id=f"as-b{i}")
+    router.run()
+    assert auto.grows >= 1
+    actions = [d["action"] for d in auto.log]
+    assert "shrink" in actions
+    assert "grow" in actions[actions.index("shrink"):]
+    # Decisions are named and step-stamped (deterministic evidence).
+    for d in auto.log:
+        assert d["reason"] and isinstance(d["step"], int)
+
+
+# ---------------------------------------------------------------------------
+# Per-replica namespacing: metrics labels, page-audit names, flight ids.
+# ---------------------------------------------------------------------------
+
+def test_metrics_merge_publishes_replica_labels(tiny, tmp_path):
+    from triton_distributed_tpu import obs as _obs
+
+    _obs.start_run(str(tmp_path))
+    try:
+        router = _fleet(tiny, 2)
+        for i in range(3):
+            router.submit([71 + 7 * i, 4, 9, 2 + i], 3, req_id=f"mm-{i}")
+        router.run()
+        # run() publishes per step (delta-merged); one more explicit
+        # publish must not double-count anything.
+        router.publish_metrics()
+        snap = obs_metrics.registry().snapshot()
+    finally:
+        _obs.finish_run()
+    assert snap[obs_metrics.FLEET_ROUTED]["value"] == 3
+    labeled = {k for k in snap if 'replica="' in k}
+    assert any('replica="0"' in k for k in labeled)
+    assert any('replica="1"' in k for k in labeled)
+    finished = [k for k in labeled
+                if k.startswith(obs_metrics.SERVE_FINISHED)]
+    assert sum(snap[k]["value"] for k in finished) == 3
+    assert snap[obs_metrics.FLEET_REPLICAS_ACTIVE]["value"] == 2
+
+
+def test_page_audit_names_the_violating_replica(tiny, monkeypatch):
+    monkeypatch.setenv("TDTPU_PAGE_AUDIT", "1")
+    router = _fleet(tiny, 2)
+    for rid, rep in router.replicas.items():
+        assert rep.se.page_audit is not None, rid
+    router.submit([81, 3, 5, 7], 3, req_id="pa-0")
+    router.run()
+    # Seed a lifetime violation in replica 1's auditor ONLY: a decref
+    # of a page whose shadow count is already zero (a double-free).
+    router.replicas["1"].se.page_audit.record({"op": "decref", "page": 0})
+    reports = router.page_audit_reports()
+    assert sorted(reports) == ["0", "1"]
+    assert reports["0"].op == "replica0" and reports["0"].ok
+    bad = reports["1"]
+    assert bad.op == "replica1" and not bad.ok
+    assert any(v.kind == "double-free" for v in bad.violations)
+
+
+def test_flight_dumps_carry_replica_id(tmp_path):
+    from triton_distributed_tpu.obs.flight import (
+        FlightRecorder, find_dumps, load_dump, validate_dump,
+    )
+    from triton_distributed_tpu.obs.postmortem import render
+
+    fr = FlightRecorder(capacity=4, run_dir=str(tmp_path),
+                        replica_id="3")
+    fr.record({"iter": 0, "decoded": 1})
+    path = fr.dump("evacuation", "unit test", 0)
+    assert os.path.basename(path).startswith("replica3-flight-")
+    data = load_dump(path)
+    assert data["replica"] == "3"
+    assert validate_dump(data, path=path) == []
+    assert find_dumps(str(tmp_path)) == [path]
+    assert "replica: 3" in render(data, path)
+    # Un-namespaced recorders keep the legacy stem and stay findable.
+    fr2 = FlightRecorder(capacity=4, run_dir=str(tmp_path))
+    p2 = fr2.dump("evacuation", "unit test", 0)
+    assert os.path.basename(p2).startswith("flight-")
+    assert set(find_dumps(str(tmp_path))) == {path, p2}
+
+
+def test_run_raises_instead_of_hanging(tiny):
+    router = _fleet(tiny, 1)
+    router.submit([5, 4, 3], 4, req_id="h-0")
+    with pytest.raises(RuntimeError, match="never a hang"):
+        router.run(max_iters=1)

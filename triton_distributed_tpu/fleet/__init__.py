@@ -1,0 +1,29 @@
+"""Multi-replica fleet tier: prefix-aware data-parallel serving.
+
+ROADMAP item #2 (docs/fleet.md): N full serving replicas — each its
+own scheduler, page pool, prefix cache, flight recorder, health
+ledger — behind one admission door. ``FleetRouter`` routes by prefix
+affinity with spill/shed backpressure, drains evacuating replicas onto
+siblings with token parity, and re-admits them after the rejoin probe;
+``Autoscaler`` derives the routable replica count from the SLO /
+admission signals the tiers already emit.
+"""
+
+from triton_distributed_tpu.fleet.affinity import AffinityIndex
+from triton_distributed_tpu.fleet.autoscale import (
+    AutoscaleConfigError, Autoscaler,
+)
+from triton_distributed_tpu.fleet.replica import ReplicaHandle
+from triton_distributed_tpu.fleet.router import (
+    FleetConfigError, FleetRouter, FleetShedError,
+)
+
+__all__ = [
+    "AffinityIndex",
+    "AutoscaleConfigError",
+    "Autoscaler",
+    "FleetConfigError",
+    "FleetRouter",
+    "FleetShedError",
+    "ReplicaHandle",
+]

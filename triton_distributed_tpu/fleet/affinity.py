@@ -1,0 +1,93 @@
+"""Per-replica shadow of prefix-cache coverage — the router's map.
+
+The FleetRouter never probes a replica's device state (pool bytes, the
+radix tree) to decide where a warm request should land: replicas feed
+it coverage EVENTS through the PrefixCache ``on_event`` hook
+(serving/prefix.py) — "I indexed this chain", "I served a hit on this
+chain", "my pool was rebuilt, forget everything". The
+:class:`AffinityIndex` folds those into a bounded per-replica store of
+token chains, and scoring a candidate is a longest-common-prefix probe
+against that store.
+
+The shadow is deliberately allowed to go stale in ONE direction: a
+chain the replica has since evicted may still be advertised here (the
+router sends the request there, the prefill runs cold — a performance
+miss, never a correctness problem, because the replica's own radix
+index is the only thing that decides what is actually shared).
+``invalidate`` events (pool rebuilds, evacuations) clear the replica's
+whole shadow, because after those EVERY advertised chain is wrong.
+
+Pure host logic, deterministic: insertion-ordered dicts, no clocks.
+"""
+
+from __future__ import annotations
+
+import collections
+
+
+class AffinityIndex:
+    """Bounded per-replica store of indexed token chains + LCP probe."""
+
+    def __init__(self, *, max_chains: int = 512):
+        if max_chains < 1:
+            raise ValueError(
+                f"max_chains = {max_chains} invalid: the shadow needs "
+                "room for at least one chain per replica — argument "
+                "max_chains")
+        self.max_chains = max_chains
+        # replica id -> OrderedDict[chain tuple, None] (insertion order
+        # doubles as the eviction order: oldest advertised chain drops
+        # first when the bound is hit).
+        self._chains: dict[str, collections.OrderedDict] = {}
+
+    # -- event feed ----------------------------------------------------------
+    def note(self, replica_id: str, kind: str, tokens) -> None:
+        """Fold one PrefixCache event for ``replica_id`` into the
+        shadow (the ReplicaHandle subscribes this as the hook)."""
+        if kind == "invalidate":
+            self._chains.pop(replica_id, None)
+            return
+        if kind not in ("insert", "hit"):
+            raise ValueError(
+                f"kind = {kind!r} invalid: prefix coverage events are "
+                "'insert', 'hit' or 'invalidate' — argument kind")
+        if tokens is None or not len(tokens):
+            return
+        chains = self._chains.setdefault(replica_id,
+                                         collections.OrderedDict())
+        key = tuple(int(t) for t in tokens)
+        # Re-advertising bumps recency (move_to_end), so the chains a
+        # replica keeps hitting outlive one-shot insertions.
+        if key in chains:
+            chains.move_to_end(key)
+        else:
+            chains[key] = None
+            while len(chains) > self.max_chains:
+                chains.popitem(last=False)
+
+    # -- probes --------------------------------------------------------------
+    def match_len(self, replica_id: str, tokens) -> int:
+        """Longest common prefix (in tokens) between ``tokens`` and any
+        chain the replica has advertised. 0 when the replica is cold."""
+        chains = self._chains.get(replica_id)
+        if not chains:
+            return 0
+        toks = [int(t) for t in tokens]
+        best = 0
+        for chain in chains:
+            n = 0
+            for a, b in zip(toks, chain):
+                if a != b:
+                    break
+                n += 1
+            if n > best:
+                best = n
+        return best
+
+    def coverage(self, replica_id: str) -> int:
+        """Advertised chains for one replica (diagnostics)."""
+        return len(self._chains.get(replica_id, ()))
+
+    def drop(self, replica_id: str) -> None:
+        """Forget a replica entirely (drain/deactivate paths)."""
+        self._chains.pop(replica_id, None)

@@ -1,0 +1,122 @@
+"""Deterministic autoscaler: replica count from admission signals.
+
+No new telemetry: the target replica count derives from the pressure
+signals the serving tier already emits —
+
+* **SLO violation streaks** (``ServingEngine._viol_streak``, the state
+  behind ``tdtpu_slo_violation_streak``): a replica missing its SLO is
+  a fleet that needs more capacity, not a replica that needs a bigger
+  queue;
+* **admission-cap narrowing** (``Scheduler.admit_cap < num_slots``):
+  the tier's own backpressure ladder (SLO shrink, fleet suspicion)
+  already decided to do less per step — spread the load instead;
+* **queue depth**: waiting requests per routable replica above the
+  high-water mark grows, a fleet whose whole load fits comfortably in
+  one fewer replica shrinks.
+
+Decisions are pure functions of those counters plus a step-counted
+cooldown — no wall clock, so a seeded run replays the same
+grow/shrink sequence bit-for-bit. Grow activates the LOWEST-id
+deactivated replica, shrink deactivates the HIGHEST-id routable one
+(deterministic tie-breaks; the router drains its in-flight work onto
+siblings through the same preempt-and-finish path an evacuation uses).
+"""
+
+from __future__ import annotations
+
+
+class AutoscaleConfigError(ValueError):
+    """An autoscaler parameter is invalid — named, up front."""
+
+
+class Autoscaler:
+    """Step-cooled grow/shrink decisions over a FleetRouter's fleet."""
+
+    def __init__(self, *, min_replicas: int = 1, cooldown: int = 8,
+                 queue_high: float = 2.0, shrink_margin: float = 0.5):
+        if min_replicas < 1:
+            raise AutoscaleConfigError(
+                f"min_replicas = {min_replicas} invalid: the fleet needs "
+                "at least one routable replica — argument min_replicas")
+        if cooldown < 1:
+            raise AutoscaleConfigError(
+                f"cooldown = {cooldown} invalid: decisions need at least "
+                "one step between them — argument cooldown")
+        if queue_high <= 0:
+            raise AutoscaleConfigError(
+                f"queue_high = {queue_high} invalid: the grow watermark "
+                "is waiting-per-replica > 0 — argument queue_high")
+        if not 0 < shrink_margin <= 1:
+            raise AutoscaleConfigError(
+                f"shrink_margin = {shrink_margin} invalid: the shrink "
+                "test keeps this fraction of the smaller fleet's slots "
+                "as headroom, so it must be in (0, 1] — argument "
+                "shrink_margin")
+        self.min_replicas = min_replicas
+        self.cooldown = cooldown
+        self.queue_high = queue_high
+        self.shrink_margin = shrink_margin
+        self._since_last = cooldown   # first decision allowed immediately
+        self.grows = 0
+        self.shrinks = 0
+        self.log: list[dict] = []
+
+    # -- signals -------------------------------------------------------------
+    def _pressure(self, routable) -> str | None:
+        """The named grow signal, or None."""
+        for rep in routable:
+            if getattr(rep.se, "_viol_streak", 0) > 0:
+                return f"slo_violation_streak(replica {rep.replica_id})"
+        for rep in routable:
+            sched = rep.se.sched
+            if sched.admit_cap < sched.num_slots:
+                return f"admit_cap_narrowed(replica {rep.replica_id})"
+        n = max(1, len(routable))
+        depth = sum(rep.queue_depth() for rep in routable)
+        if depth > self.queue_high * n:
+            return f"queue_depth({depth} > {self.queue_high:g}/replica)"
+        return None
+
+    def _can_shrink(self, routable) -> bool:
+        """True when the whole load fits in one fewer replica with
+        ``shrink_margin`` of its slots left over — and nothing is
+        under pressure."""
+        if len(routable) <= self.min_replicas:
+            return False
+        load = sum(rep.load() for rep in routable)
+        slots = sum(min(rep.se.sched.admit_cap, rep.se.sched.num_slots)
+                    for rep in sorted(routable,
+                                      key=lambda r: r.replica_id)[:-1])
+        return load <= slots * (1.0 - self.shrink_margin)
+
+    # -- the tick ------------------------------------------------------------
+    def tick(self, router) -> dict | None:
+        """One router step: maybe one decision. Returns the decision
+        record (also appended to ``log``) or None."""
+        self._since_last += 1
+        if self._since_last < self.cooldown:
+            return None
+        routable = [rep for rep in router.replicas.values() if rep.routable]
+        parked = sorted((rep for rep in router.replicas.values()
+                         if rep.scaled_out and not rep.draining),
+                        key=lambda r: r.replica_id)
+        reason = self._pressure(routable)
+        if reason is not None and parked:
+            rep = parked[0]
+            router.activate(rep.replica_id)
+            self.grows += 1
+            self._since_last = 0
+            rec = {"action": "grow", "replica": rep.replica_id,
+                   "reason": reason, "step": router.steps}
+            self.log.append(rec)
+            return rec
+        if reason is None and self._can_shrink(routable):
+            rep = max(routable, key=lambda r: r.replica_id)
+            router.deactivate(rep.replica_id, reason="autoscale_shrink")
+            self.shrinks += 1
+            self._since_last = 0
+            rec = {"action": "shrink", "replica": rep.replica_id,
+                   "reason": "idle_capacity", "step": router.steps}
+            self.log.append(rec)
+            return rec
+        return None

@@ -1,0 +1,90 @@
+"""One fleet member: a full serving tier plus its router-side state.
+
+A :class:`ReplicaHandle` wraps one
+:class:`~triton_distributed_tpu.serving.loop.ServingEngine` (its own
+scheduler, page pool, prefix cache, flight recorder, health ledger)
+together with everything the router tracks ABOUT it: the private
+metrics registry the tier publishes into (merged back under a
+``replica=`` label by the router — never summed), the drain /
+scaled-out flags, and per-replica routing counters.
+
+Build replicas with :meth:`ReplicaHandle.build` — it threads the
+replica id into the tier's flight recorder (attributable postmortems)
+and installs the private registry so fleet runs never collapse N
+copies of ``tdtpu_kv_pages_resident`` into one meaningless sum.
+"""
+
+from __future__ import annotations
+
+from triton_distributed_tpu.obs import metrics as obs_metrics
+
+
+class ReplicaHandle:
+    """A ServingEngine plus the router's view of it."""
+
+    def __init__(self, replica_id: str | int, se, *, registry=None):
+        self.replica_id = str(replica_id)
+        self.se = se
+        self.registry = registry
+        # Router-side state. ``draining`` means the tier's OWN fleet
+        # ledger evacuated it (re-admitted after the rejoin probe);
+        # ``scaled_out`` means the autoscaler deactivated it. Both stop
+        # new routing; draining also moves the in-flight work out.
+        self.draining = False
+        self.scaled_out = False
+        # Per-replica routing evidence (the fleet lane's rows).
+        self.routed = 0
+        self.spill_ins = 0       # requests that spilled IN from a sibling
+        self.affinity_hits = 0
+        self.drain_moves = 0     # requests moved OFF this replica
+
+    @classmethod
+    def build(cls, replica_id: str | int, engine, **serving_kw):
+        """Construct the tier with per-replica namespacing installed:
+        a private Registry and the replica id on the flight recorder.
+        ``serving_kw`` passes through to ServingEngine."""
+        from triton_distributed_tpu.serving.loop import ServingEngine
+
+        reg = obs_metrics.Registry()
+        se = ServingEngine(engine, metrics_registry=reg,
+                           replica_id=str(replica_id), **serving_kw)
+        return cls(replica_id, se, registry=reg)
+
+    # -- views the router scores on ------------------------------------------
+    @property
+    def routable(self) -> bool:
+        return not self.draining and not self.scaled_out
+
+    def load(self) -> int:
+        """Queued + in-flight requests (the least-loaded fallback)."""
+        sched = self.se.sched
+        return len(sched.waiting) + len(sched.active)
+
+    def headroom(self) -> int:
+        """Admission room: free batch slots under the (possibly
+        narrowed) admission cap, floored at 0. The affinity score
+        multiplies by ``headroom + 1`` so a warm-but-saturated replica
+        still outranks a cold one — admission backpressure (QUEUE_FULL)
+        handles the truly-full case by spilling."""
+        sched = self.se.sched
+        cap = min(sched.admit_cap, sched.num_slots)
+        return max(0, cap - len(sched.active))
+
+    def queue_depth(self) -> int:
+        return len(self.se.sched.waiting)
+
+    def has_work(self) -> bool:
+        return self.se.sched.has_work()
+
+    def describe(self) -> dict:
+        """One fleet-lane row."""
+        return {"replica": self.replica_id,
+                "draining": self.draining,
+                "scaled_out": self.scaled_out,
+                "evacuated": self.se.evacuated,
+                "load": self.load(),
+                "queue_depth": self.queue_depth(),
+                "routed": self.routed,
+                "spill_ins": self.spill_ins,
+                "affinity_hits": self.affinity_hits,
+                "drain_moves": self.drain_moves}

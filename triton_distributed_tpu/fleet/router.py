@@ -1,0 +1,486 @@
+"""FleetRouter: prefix-affinity admission across N serving replicas.
+
+The scheduler-of-schedulers (ROADMAP item #2): every subsystem below
+this one serves a SINGLE tensor-parallel slice; production traffic
+needs N data-parallel replicas behind one admission door. The router
+owns that door:
+
+* **prefix-affinity routing** — candidates are scored by
+  ``matched_prefix_len × (headroom + 1)`` against the
+  :class:`~triton_distributed_tpu.fleet.affinity.AffinityIndex` (a
+  per-replica shadow of radix-index coverage fed by PrefixCache
+  events, never by probing device state), falling back to
+  least-loaded when every candidate is cold. Warm requests land where
+  their KV already lives, so the fleet's hit rate survives scale-out;
+* **spill / shed** — ``QUEUE_FULL`` (queue or pool backpressure) from
+  the chosen replica spills to the next-best candidate, bounded by
+  ``max_spills``; an exhausted chain is a fleet-level SHED: counted,
+  surfaced as ``QUEUE_FULL`` to open-loop callers (who retry), or
+  raised as the named :class:`FleetShedError` under ``strict_shed``.
+  Retry accounting keeps TTFT honest: the router remembers each
+  req_id's FIRST submission clock and rebases ``t_arrival`` (and the
+  request tracer) when a retried request finally admits;
+* **drain / re-admit** — when a replica's own elastic-fleet ledger
+  confirms a dead rank and the tier evacuates
+  (``ServingEngine.evacuated``), the router drains it: in-flight
+  requests preempt (recompute-on-resume — the same state-correct path
+  an evacuation already uses) and finish on sibling replicas with
+  token parity, keeping their first-submission ``arrival_seq`` /
+  ``t_arrival`` because ``Scheduler.admit`` only stamps fresh
+  requests. The drained replica keeps stepping (its rejoin probe
+  needs the clean-iteration streak) and re-admits once the probe
+  restores the full mesh;
+* **autoscale** — an attached
+  :class:`~triton_distributed_tpu.fleet.autoscale.Autoscaler` derives
+  the routable replica count from the admission signals the tiers
+  already emit (SLO violation streaks, admit-cap narrowing, queue
+  depth), deterministically.
+
+Duck-compatible with ``loadgen.run_trace``: the router exposes
+``clock`` / ``submit`` / ``step`` / ``sched.has_work`` with the same
+contracts as one ServingEngine, so every existing open-loop harness
+drives a fleet unchanged.
+"""
+
+from __future__ import annotations
+
+from triton_distributed_tpu.fleet.affinity import AffinityIndex
+from triton_distributed_tpu.fleet.replica import ReplicaHandle
+from triton_distributed_tpu.obs import metrics as obs_metrics
+from triton_distributed_tpu.obs import reqtrace as obs_reqtrace
+from triton_distributed_tpu.obs import trace as obs_trace
+from triton_distributed_tpu.serving.scheduler import AdmitResult
+
+POLICIES = ("affinity", "least_loaded", "round_robin")
+
+
+class FleetConfigError(ValueError):
+    """A fleet parameter is invalid — named, up front."""
+
+
+class FleetShedError(RuntimeError):
+    """Every candidate in the spill chain refused a request — the
+    fleet-level shed, named (never a hang): callers either retry
+    (open-loop QUEUE_FULL semantics) or see exactly which replicas
+    refused and why the chain ended."""
+
+    def __init__(self, req_id: str | None, tried: list[str],
+                 spills: int):
+        self.req_id = req_id
+        self.tried = list(tried)
+        self.spills = spills
+        super().__init__(
+            f"request {req_id or '<unnamed>'} shed: all "
+            f"{len(tried)} candidate replica(s) {tried} refused "
+            f"admission (queue/pool backpressure) after {spills} "
+            "spill(s) — fleet at capacity")
+
+
+class _FleetSchedView:
+    """The one scheduler attribute ``run_trace`` consults."""
+
+    def __init__(self, router: "FleetRouter"):
+        self._router = router
+
+    def has_work(self) -> bool:
+        return self._router.has_work()
+
+
+class FleetRouter:
+    """Admission + drain + autoscale across N replica serving tiers."""
+
+    def __init__(self, replicas, *, policy: str = "affinity",
+                 max_spills: int | None = None, autoscaler=None,
+                 strict_shed: bool = False, affinity=None, clock=None):
+        if not replicas:
+            raise FleetConfigError(
+                "a fleet needs at least one replica — argument replicas")
+        if policy not in POLICIES:
+            raise FleetConfigError(
+                f"policy = {policy!r} invalid: one of {POLICIES} — "
+                "argument policy")
+        self.replicas: dict[str, ReplicaHandle] = {}
+        for rep in replicas:
+            if not isinstance(rep, ReplicaHandle):
+                raise FleetConfigError(
+                    f"replica {rep!r} is not a ReplicaHandle — build "
+                    "them with ReplicaHandle.build (argument replicas)")
+            if rep.replica_id in self.replicas:
+                raise FleetConfigError(
+                    f"duplicate replica id {rep.replica_id!r} — ids "
+                    "must be unique (argument replicas)")
+            self.replicas[rep.replica_id] = rep
+        self.policy = policy
+        n = len(self.replicas)
+        self.max_spills = max_spills if max_spills is not None else n - 1
+        if self.max_spills < 0:
+            raise FleetConfigError(
+                f"max_spills = {self.max_spills} invalid: the spill "
+                "chain length is non-negative — argument max_spills")
+        self.strict_shed = strict_shed
+        self.autoscaler = autoscaler
+        self.affinity = affinity if affinity is not None else AffinityIndex()
+        first = next(iter(self.replicas.values()))
+        self.clock = clock if clock is not None else first.se.clock
+        self.sched = _FleetSchedView(self)
+        # Shadow feed: each replica's PrefixCache events land in the
+        # affinity index under that replica's id.
+        for rid, rep in self.replicas.items():
+            pc = rep.se.prefix
+            if pc is not None:
+                pc.on_event = self._prefix_hook(rid)
+        # Router totals (the fleet lane).
+        self.routed = 0
+        self.spills = 0
+        self.sheds = 0
+        self.shed_retries = 0        # admissions that had shed earlier
+        self.drains = 0
+        self.readmits = 0
+        self.drain_moves = 0
+        self.affinity_hits = 0
+        self.steps = 0
+        self.shed_log: list[dict] = []
+        self.fleet_log: list[dict] = []
+        self._rr = 0                 # round_robin cursor
+        self._first_try: dict[str, float] = {}
+        self._was_shed: set[str] = set()
+        self._pending = []           # drained requests awaiting a slot
+        self._pub_last: dict[str, float] = {}   # counter merge deltas
+
+    def _prefix_hook(self, rid: str):
+        def hook(kind, tokens):
+            self.affinity.note(rid, kind, tokens)
+        return hook
+
+    # -- views ---------------------------------------------------------------
+    def routable(self) -> list[ReplicaHandle]:
+        return [rep for rep in self.replicas.values() if rep.routable]
+
+    def has_work(self) -> bool:
+        return bool(self._pending) or any(
+            rep.has_work() for rep in self.replicas.values())
+
+    def finished_requests(self) -> list:
+        """Every finished request across the fleet (finish order within
+        a replica; replica-id order across)."""
+        out = []
+        for rid in sorted(self.replicas):
+            out.extend(self.replicas[rid].se._finished)
+        return out
+
+    # -- routing -------------------------------------------------------------
+    def _candidates(self, tokens) -> list[tuple[ReplicaHandle, int]]:
+        """Routable replicas in try-order with their matched-prefix
+        lengths. Deterministic: every tie breaks on replica id."""
+        reps = sorted(self.routable(), key=lambda r: r.replica_id)
+        if not reps:
+            return []
+        if self.policy == "round_robin":
+            k = self._rr % len(reps)
+            return [(rep, 0) for rep in reps[k:] + reps[:k]]
+        if self.policy == "least_loaded":
+            return [(rep, 0) for rep in
+                    sorted(reps, key=lambda r: (r.load(), r.replica_id))]
+        scored = []
+        for rep in reps:
+            mlen = self.affinity.match_len(rep.replica_id, tokens)
+            # headroom + 1: a warm replica with a momentarily-full
+            # batch still beats a cold one (QUEUE_FULL spill handles
+            # the truly-exhausted case); all-cold falls through to
+            # least-loaded.
+            scored.append((rep, mlen, mlen * (rep.headroom() + 1)))
+        scored.sort(key=lambda t: (-t[2], t[0].load(), t[0].replica_id))
+        return [(rep, mlen) for rep, mlen, _ in scored]
+
+    def submit(self, prompt, max_new_tokens: int, *, priority: int = 0,
+               req_id: str | None = None):
+        """Route one request: try candidates in score order, spilling
+        past ``QUEUE_FULL`` up to ``max_spills`` times. Returns
+        ``(Request, AdmitResult)`` like ``ServingEngine.submit``; a
+        shed returns ``(None, QUEUE_FULL)`` (or raises
+        :class:`FleetShedError` under ``strict_shed``). With a stable
+        ``req_id``, a retried-after-shed admission keeps TTFT counting
+        from the FIRST submission."""
+        now = self.clock()
+        if req_id is not None and req_id not in self._first_try:
+            self._first_try[req_id] = now
+        chain = self._candidates(prompt)[:self.max_spills + 1]
+        if self.policy == "round_robin":
+            self._rr += 1
+        tried: list[str] = []
+        for i, (rep, mlen) in enumerate(chain):
+            req, res = rep.se.submit(prompt, max_new_tokens,
+                                     priority=priority, req_id=req_id)
+            if res is AdmitResult.QUEUE_FULL:
+                tried.append(rep.replica_id)
+                continue
+            self.routed += 1
+            rep.routed += 1
+            if i > 0:
+                self.spills += i
+                rep.spill_ins += 1
+            if self.policy == "affinity" and mlen > 0:
+                self.affinity_hits += 1
+                rep.affinity_hits += 1
+            if req_id is not None:
+                ft = self._first_try.pop(req_id, now)
+                if req_id in self._was_shed:
+                    self._was_shed.discard(req_id)
+                    self.shed_retries += 1
+                if req.t_arrival is None or ft < req.t_arrival:
+                    req.t_arrival = ft
+                    rt = obs_reqtrace.get_tracer()
+                    if rt is not None:
+                        rt.rebase_arrival(req.req_id, ft)
+            return req, res
+        # Chain exhausted: the fleet-level shed.
+        self.sheds += 1
+        self.spills += max(0, len(tried) - 1)
+        if req_id is not None:
+            self._was_shed.add(req_id)
+        self.shed_log.append({"req_id": req_id, "tried": tried,
+                              "step": self.steps})
+        if self.strict_shed:
+            raise FleetShedError(req_id, tried, max(0, len(tried) - 1))
+        return None, AdmitResult.QUEUE_FULL
+
+    # -- drain / re-admit ----------------------------------------------------
+    def _strip_work(self, rep: ReplicaHandle) -> list:
+        """Preempt + pull every request off one replica. Preemption
+        frees its pages / unpins its prefix holds (the evacuation
+        discipline), and ``admit`` on the receiving scheduler leaves
+        ``arrival_seq`` / ``t_arrival`` alone — first-submission
+        accounting survives the move."""
+        se = rep.se
+        for req in list(se.sched.active):
+            se.sched._preempt(req)
+        moved = list(se.sched.waiting)
+        se.sched.waiting.clear()
+        return moved
+
+    def _place(self, req) -> bool:
+        """Re-admit a moved request on the best sibling; parks it on
+        the pending queue when every candidate refuses (retried every
+        step — a drained request is never dropped)."""
+        for rep, _mlen in self._candidates(req.text):
+            if rep.se.sched.admit(req, rep.se.clock()) \
+                    is AdmitResult.ADMITTED:
+                rep.spill_ins += 1
+                return True
+        self._pending.append(req)
+        return False
+
+    def drain(self, replica_id: str, *, reason: str = "") -> int:
+        """Stop routing to a replica and move its in-flight work to
+        siblings. Idempotent; returns the number of requests moved."""
+        rep = self.replicas[replica_id]
+        if rep.draining:
+            return 0
+        rep.draining = True
+        self.drains += 1
+        # The evacuation already rebuilt the pools (PrefixCache
+        # invalidate fired through the hook), but drop the shadow
+        # explicitly: a drain without an invalidate event (manual
+        # drain) must not keep advertising chains nobody can route to.
+        self.affinity.drop(replica_id)
+        moved = self._strip_work(rep)
+        rep.drain_moves += len(moved)
+        self.drain_moves += len(moved)
+        for req in moved:
+            self._place(req)
+        self.fleet_log.append({"event": "drain", "replica": replica_id,
+                               "reason": reason, "moved": len(moved),
+                               "step": self.steps})
+        with obs_trace.span("fleet.router_drain", replica=replica_id,
+                            reason=reason, moved=len(moved)):
+            pass
+        return len(moved)
+
+    def _readmit(self, replica_id: str) -> None:
+        rep = self.replicas[replica_id]
+        rep.draining = False
+        self.readmits += 1
+        self.fleet_log.append({"event": "readmit", "replica": replica_id,
+                               "step": self.steps})
+        with obs_trace.span("fleet.router_readmit", replica=replica_id):
+            pass
+
+    # -- autoscale hooks -----------------------------------------------------
+    def deactivate(self, replica_id: str, *, reason: str = "") -> int:
+        """Autoscale shrink: park a replica (its pools stay warm — the
+        affinity shadow is kept, so a later grow resumes warm) and move
+        its work to siblings."""
+        rep = self.replicas[replica_id]
+        if rep.scaled_out:
+            return 0
+        rep.scaled_out = True
+        moved = self._strip_work(rep)
+        rep.drain_moves += len(moved)
+        self.drain_moves += len(moved)
+        for req in moved:
+            self._place(req)
+        self.fleet_log.append({"event": "deactivate",
+                               "replica": replica_id, "reason": reason,
+                               "moved": len(moved), "step": self.steps})
+        return len(moved)
+
+    def activate(self, replica_id: str) -> None:
+        rep = self.replicas[replica_id]
+        if not rep.scaled_out:
+            return
+        rep.scaled_out = False
+        self.fleet_log.append({"event": "activate",
+                               "replica": replica_id, "step": self.steps})
+
+    # -- the fleet iteration -------------------------------------------------
+    def step(self) -> dict:
+        """One fleet iteration: step EVERY replica (idle drained ones
+        too — their rejoin probes ride the clean-iteration streak),
+        couple drains/re-admits to each tier's evacuation state, retry
+        parked requests, tick the autoscaler, publish the lane."""
+        self.steps += 1
+        summaries: dict[str, dict] = {}
+        for rid in sorted(self.replicas):
+            summaries[rid] = self.replicas[rid].se.step()
+        for rid in sorted(self.replicas):
+            rep = self.replicas[rid]
+            if not rep.draining and rep.se.evacuated:
+                self.drain(rid, reason="replica evacuated "
+                           "(ledger confirmed a dead rank)")
+            elif rep.draining and not rep.se.evacuated:
+                self._readmit(rid)
+        if self._pending:
+            parked, self._pending = self._pending, []
+            for req in parked:
+                self._place(req)
+        if self.autoscaler is not None:
+            self.autoscaler.tick(self)
+        if obs_trace.get_tracer() is not None:
+            self.publish_metrics()
+        return summaries
+
+    def run(self, *, max_iters: int = 100_000) -> list:
+        """Drive until the whole fleet is idle; returns every finished
+        request. Raises rather than hangs (the chaos contract)."""
+        it = 0
+        while self.has_work():
+            if it >= max_iters:
+                raise RuntimeError(
+                    f"fleet router still has work after {max_iters} "
+                    f"iterations (pending={len(self._pending)}, loads="
+                    f"{ {rid: rep.load() for rid, rep in sorted(self.replicas.items())} }) "
+                    "— deadlock must be loud, never a hang")
+            self.step()
+            it += 1
+        return self.finished_requests()
+
+    # -- evidence ------------------------------------------------------------
+    def affinity_hit_rate(self) -> float:
+        return self.affinity_hits / self.routed if self.routed else 0.0
+
+    def describe(self) -> dict:
+        """The fleet report: router totals + one row per replica."""
+        return {
+            "replicas": [self.replicas[rid].describe()
+                         for rid in sorted(self.replicas)],
+            "policy": self.policy,
+            "routed": self.routed,
+            "spilled": self.spills,
+            "shed": self.sheds,
+            "shed_retries": self.shed_retries,
+            "drained": self.drains,
+            "readmitted": self.readmits,
+            "drain_moves": self.drain_moves,
+            "affinity_hits": self.affinity_hits,
+            "affinity_hit_rate": round(self.affinity_hit_rate(), 4),
+            "replicas_active": len(self.routable()),
+            "autoscale": (list(self.autoscaler.log)
+                          if self.autoscaler is not None else []),
+            "fleet_log": list(self.fleet_log),
+            "shed_log": list(self.shed_log),
+        }
+
+    def page_audit_reports(self) -> dict:
+        """Per-replica page-audit reports, each NAMED with its replica
+        id — one replica's violations must never smear across the
+        fleet (TDTPU_PAGE_AUDIT=1)."""
+        out = {}
+        for rid in sorted(self.replicas):
+            aud = self.replicas[rid].se.page_audit
+            if aud is not None:
+                out[rid] = aud.report(name=f"replica{rid}")
+        return out
+
+    # -- metrics merge -------------------------------------------------------
+    def _merge_counter(self, reg, name: str, help: str, value: float,
+                       labels=None) -> None:
+        key = name + obs_metrics._fmt_labels(labels)
+        last = self._pub_last.get(key, 0.0)
+        if value > last:
+            reg.counter(name, help, labels=labels).inc(value - last)
+            self._pub_last[key] = value
+
+    def publish_metrics(self, reg=None) -> None:
+        """Fold the fleet into a registry (default: the process-global
+        one an obs run snapshots): unlabeled router totals, plus every
+        replica registry's counters/gauges re-published under a
+        ``replica="<id>"`` label — merged as SERIES, never summed, so
+        ``tdtpu_kv_pages_resident{replica="2"}`` means what it says.
+        Histograms stay per-replica (no label support); the latency
+        evidence lives in each replica's own snapshot."""
+        reg = reg if reg is not None else obs_metrics.registry()
+        m = obs_metrics
+        self._merge_counter(reg, m.FLEET_ROUTED,
+                            "requests admitted through the fleet router",
+                            self.routed)
+        self._merge_counter(reg, m.FLEET_SPILLS,
+                            "admissions that spilled past a QUEUE_FULL "
+                            "candidate", self.spills)
+        self._merge_counter(reg, m.FLEET_SHEDS,
+                            "requests refused by every candidate in the "
+                            "spill chain", self.sheds)
+        self._merge_counter(reg, m.FLEET_SHED_RETRIES,
+                            "admissions that had shed earlier (TTFT "
+                            "counts from first submission)",
+                            self.shed_retries)
+        self._merge_counter(reg, m.FLEET_DRAINS,
+                            "replicas drained after their tier evacuated",
+                            self.drains)
+        self._merge_counter(reg, m.FLEET_READMITS,
+                            "drained replicas re-admitted after the "
+                            "rejoin probe", self.readmits)
+        self._merge_counter(reg, m.FLEET_DRAIN_MOVES,
+                            "in-flight requests moved to a sibling by a "
+                            "drain/deactivate", self.drain_moves)
+        self._merge_counter(reg, m.FLEET_AFFINITY_HITS,
+                            "admissions routed to a replica already "
+                            "holding a prefix of the prompt",
+                            self.affinity_hits)
+        reg.gauge(m.FLEET_AFFINITY_HIT_RATE,
+                  "cumulative affinity-routed fraction of admissions"
+                  ).set(round(self.affinity_hit_rate(), 6))
+        reg.gauge(m.FLEET_REPLICAS_ACTIVE,
+                  "replicas currently routable (not draining, not "
+                  "scaled out)").set(len(self.routable()))
+        if self.autoscaler is not None:
+            self._merge_counter(reg, m.FLEET_AUTOSCALE_GROWS,
+                                "autoscaler activations",
+                                self.autoscaler.grows)
+            self._merge_counter(reg, m.FLEET_AUTOSCALE_SHRINKS,
+                                "autoscaler deactivations",
+                                self.autoscaler.shrinks)
+        for rid in sorted(self.replicas):
+            rep = self.replicas[rid]
+            if rep.registry is None:
+                continue
+            for key in rep.registry.names():
+                metric = rep.registry.get(key)
+                labels = {**(metric.labels or {}), "replica": rid} \
+                    if isinstance(metric, (m.Counter, m.Gauge)) else None
+                if isinstance(metric, m.Counter):
+                    self._merge_counter(
+                        reg, metric.name, metric.help, metric.value,
+                        labels=labels)
+                elif isinstance(metric, m.Gauge):
+                    reg.gauge(metric.name, metric.help,
+                              labels=labels).set(metric.value)

@@ -46,7 +46,7 @@ class FlightRecorder:
     """Bounded ring of serving-iteration records + dump-on-trigger."""
 
     def __init__(self, capacity: int = 128, *, run_dir: str | None = None,
-                 max_triggers: int = 64):
+                 max_triggers: int = 64, replica_id: str | None = None):
         if capacity < 1:
             raise ValueError(
                 f"capacity = {capacity} invalid: the flight ring needs at "
@@ -54,6 +54,10 @@ class FlightRecorder:
                 "(TDTPU_FLIGHT_CAPACITY)")
         self.capacity = capacity
         self.run_dir = run_dir
+        # Fleet runs: which replica's loop fed this recorder. Prefixes
+        # the dump filename (``replica0-flight-NNNN-<kind>.json``) and
+        # lands in the record, so a 4-replica postmortem is attributable.
+        self.replica_id = replica_id
         self._ring: collections.deque[dict] = collections.deque(
             maxlen=capacity)
         self._triggers: collections.deque[dict] = collections.deque(
@@ -113,10 +117,12 @@ class FlightRecorder:
         # the probe depends only on the directory's (deterministic)
         # contents, never on time.
         seq = len(self.dumps)
-        path = os.path.join(out_dir, f"flight-{seq:04d}-{kind}.json")
+        stem = (f"replica{self.replica_id}-flight"
+                if self.replica_id is not None else "flight")
+        path = os.path.join(out_dir, f"{stem}-{seq:04d}-{kind}.json")
         while os.path.exists(path):
             seq += 1
-            path = os.path.join(out_dir, f"flight-{seq:04d}-{kind}.json")
+            path = os.path.join(out_dir, f"{stem}-{seq:04d}-{kind}.json")
         data = {
             "schema": SCHEMA,
             "capacity": self.capacity,
@@ -127,6 +133,8 @@ class FlightRecorder:
             "requests": requests or [],
             "counters": counters or {},
         }
+        if self.replica_id is not None:
+            data["replica"] = self.replica_id
         with open(path, "w") as f:
             json.dump(data, f, indent=2)
         self.dumps.append(path)
@@ -200,6 +208,9 @@ def validate_dump(data: Any, *, path: str = "<dump>") -> list[str]:
                 break
     if not isinstance(data.get("config"), dict):
         problems.append(f"{path}: config is not an object")
+    if "replica" in data and not isinstance(data["replica"], str):
+        problems.append(f"{path}: replica id {data['replica']!r} is not "
+                        "a string")
     return problems
 
 
@@ -213,5 +224,7 @@ def find_dumps(run_dir: str) -> list[str]:
     number sorts lexically)."""
     import glob
 
-    return sorted(glob.glob(os.path.join(run_dir, "**", "flight-*.json"),
+    # ``*flight-*`` (leading star matches empty) covers both the bare
+    # single-engine names and the replica-prefixed fleet names.
+    return sorted(glob.glob(os.path.join(run_dir, "**", "*flight-*.json"),
                             recursive=True))

@@ -139,6 +139,16 @@ METRICS: tuple[MetricSpec, ...] = (
                "serving tokens/s (prefix-cache warm replay, same "
                "window as the cold rung)",
                " tok/s", "higher", "serving"),
+    MetricSpec("serve_tokens_per_s_fleet",
+               "serving tokens/s (fleet router, 4 data-parallel "
+               "replicas, parallel-equivalent makespan — Σ "
+               "per-iteration max replica step)",
+               " tok/s", "higher", "serving"),
+    MetricSpec("serve_fleet_scaling_x",
+               "fleet scaling (4-replica vs 1-replica fleet measured "
+               "identically, same window; near-linear is the router's "
+               "contract)",
+               "×", "higher", "serving"),
 )
 
 METRIC_BY_KEY = {m.key: m for m in METRICS}

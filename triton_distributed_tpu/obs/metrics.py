@@ -143,6 +143,30 @@ FLEET_SERIES = (FLEET_RANKS_ALIVE, FLEET_SUSPECTS, FLEET_EVACUATIONS,
                 FLEET_REJOINS, FLEET_STEP_FAULTS, SERVE_EVAC_PREEMPTIONS,
                 COMM_TIMEOUTS)
 
+# Fleet-router lane (ISSUE 17, docs/fleet.md): published by
+# fleet/router.py — router-level totals are unlabeled; per-replica
+# mirrors of each replica's private registry carry a
+# ``replica="<id>"`` label so gauges like tdtpu_kv_pages_resident never
+# silently sum across replicas.
+FLEET_ROUTED = "tdtpu_fleet_routed_total"
+FLEET_SPILLS = "tdtpu_fleet_spills_total"
+FLEET_SHEDS = "tdtpu_fleet_sheds_total"
+FLEET_SHED_RETRIES = "tdtpu_fleet_shed_retries_total"
+FLEET_DRAINS = "tdtpu_fleet_drains_total"
+FLEET_READMITS = "tdtpu_fleet_readmits_total"
+FLEET_DRAIN_MOVES = "tdtpu_fleet_drain_moved_requests_total"
+FLEET_AFFINITY_HITS = "tdtpu_fleet_affinity_hits_total"
+FLEET_AFFINITY_HIT_RATE = "tdtpu_fleet_affinity_hit_rate"
+FLEET_REPLICAS_ACTIVE = "tdtpu_fleet_replicas_active"
+FLEET_AUTOSCALE_GROWS = "tdtpu_fleet_autoscale_grows_total"
+FLEET_AUTOSCALE_SHRINKS = "tdtpu_fleet_autoscale_shrinks_total"
+
+FLEET_ROUTER_SERIES = (FLEET_REPLICAS_ACTIVE, FLEET_ROUTED, FLEET_SPILLS,
+                       FLEET_SHEDS, FLEET_SHED_RETRIES, FLEET_DRAINS,
+                       FLEET_READMITS, FLEET_DRAIN_MOVES,
+                       FLEET_AFFINITY_HITS, FLEET_AFFINITY_HIT_RATE,
+                       FLEET_AUTOSCALE_GROWS, FLEET_AUTOSCALE_SHRINKS)
+
 
 def _fmt_labels(labels: dict[str, str] | None) -> str:
     if not labels:

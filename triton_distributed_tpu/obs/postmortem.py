@@ -40,6 +40,8 @@ def _s(v) -> str:
 
 def render(data: dict, path: str) -> str:
     lines = [f"# flight dump — {os.path.basename(path)}", ""]
+    if data.get("replica") is not None:
+        lines.append(f"replica: {_s(data.get('replica'))}")
     trig = data.get("trigger") or {}
     lines.append(f"trigger: {_s(trig.get('kind'))} @ iter "
                  f"{_s(trig.get('iter'))} — {_s(trig.get('reason'))}")
@@ -152,6 +154,7 @@ def main(argv: list[str] | None = None) -> int:
         problems += dump_problems
         dumps.append({"path": p,
                       "trigger": (data.get("trigger") or {}).get("kind"),
+                      "replica": data.get("replica"),
                       "iterations": len(data.get("iterations") or []),
                       "requests": len(data.get("requests") or []),
                       "valid": not dump_problems})

@@ -363,6 +363,10 @@ def summarize(run_dir: str, lanes: dict, metrics: dict | None,
     if fleet:
         lines.append("")
         lines += fleet
+    fleet_router = fleet_router_lane(metrics)
+    if fleet_router:
+        lines.append("")
+        lines += fleet_router
     return "\n".join(lines)
 
 
@@ -420,8 +424,11 @@ def flight_section(flight_dumps: list[tuple]) -> list[str]:
             lines.append(f"  {os.path.basename(p)}: UNREADABLE ({err})")
             continue
         trig = data.get("trigger") or {}
+        rep = data.get("replica")
         lines.append(
-            f"  {os.path.basename(p)}: {trig.get('kind')} @ iter "
+            f"  {os.path.basename(p)}: "
+            + (f"[replica {rep}] " if rep is not None else "")
+            + f"{trig.get('kind')} @ iter "
             f"{trig.get('iter')} — {str(trig.get('reason'))[:80]} "
             f"({len(data.get('iterations') or [])} iterations, "
             f"{len(data.get('requests') or [])} requests)")
@@ -553,6 +560,65 @@ def fleet_lane(metrics: dict | None) -> list[str]:
         m = metrics[name]
         lines.append(f"  {name} = {m['value']:g}")
     return lines
+
+
+def fleet_router_lane(metrics: dict | None) -> list[str]:
+    """The fleet-ROUTER summary section (docs/fleet.md) — rendered
+    whenever the snapshot carries router totals. Router totals print
+    first, then one row per replica built from the ``replica=``-labeled
+    series the router merged out of each replica's private registry
+    (never summed across replicas)."""
+    import re
+
+    from triton_distributed_tpu.obs import metrics as obs_metrics
+
+    present = [n for n in obs_metrics.FLEET_ROUTER_SERIES
+               if n in (metrics or {})]
+    if not present:
+        return []
+    lines = ["fleet router (docs/fleet.md):"]
+    for name in obs_metrics.FLEET_ROUTER_SERIES:
+        m = (metrics or {}).get(name)
+        if m is not None:
+            lines.append(f"  {name} = {m['value']:g}")
+    # Per-replica rows: group every labeled series by its replica id.
+    by_replica: dict[str, dict[str, float]] = {}
+    for key, m in (metrics or {}).items():
+        if not isinstance(m, dict) or "value" not in m:
+            continue
+        labels = m.get("labels") or {}
+        rid = labels.get("replica")
+        if rid is None:
+            match = re.search(r'replica="([^"]*)"', key)
+            rid = match.group(1) if match else None
+        if rid is None:
+            continue
+        base = key.split("{", 1)[0]
+        by_replica.setdefault(rid, {})[base] = m["value"]
+    row_series = (obs_metrics.SERVE_FINISHED, obs_metrics.SERVE_REJECTS,
+                  obs_metrics.SERVE_PREEMPTIONS,
+                  obs_metrics.KV_PAGES_RESIDENT,
+                  obs_metrics.PREFIX_HIT_RATE,
+                  obs_metrics.FLEET_EVACUATIONS,
+                  obs_metrics.FLEET_REJOINS)
+    for rid in sorted(by_replica):
+        vals = by_replica[rid]
+        cells = [f"{name.replace('tdtpu_', '')}="
+                 f"{vals[name]:g}" for name in row_series
+                 if name in vals]
+        lines.append(f"  replica {rid}: " + (", ".join(cells) or
+                                             "(no labeled series)"))
+    return lines
+
+
+def shed_count(metrics: dict | None) -> float:
+    """Fleet-level sheds recorded in a snapshot (0 when absent): every
+    one is a request the WHOLE fleet refused after walking the spill
+    chain (``--allow-shed`` to accept)."""
+    from triton_distributed_tpu.obs import metrics as obs_metrics
+
+    m = (metrics or {}).get(obs_metrics.FLEET_SHEDS) or {}
+    return float(m.get("value") or 0.0)
 
 
 def evacuation_debt(metrics: dict | None) -> float:
@@ -726,6 +792,12 @@ def main(argv: list[str] | None = None) -> int:
                          "flight dump fails the page-audit lane (each "
                          "one is a leak/double-free/use-after-free in "
                          "the paged serving tier, docs/mklint.md)")
+    ap.add_argument("--allow-shed", action="store_true",
+                    help="report fleet-level sheds without failing "
+                         "--check (by default any request the whole "
+                         "fleet refused after walking the spill chain "
+                         "fails the fleet-router lane — the fleet was "
+                         "under-provisioned for the offered load)")
     ap.add_argument("--allow-evacuation", action="store_true",
                     help="report fleet evacuations without failing "
                          "--check (by default a run that evacuated and "
@@ -859,6 +931,12 @@ def main(argv: list[str] | None = None) -> int:
             f"serving: {preemptions:g} preemption(s) under a clean SLO "
             "section — the page pool evicted work with no pressure "
             "signal (--allow-preemptions to accept)")
+    sheds = shed_count(metrics)
+    if sheds and not args.allow_shed:
+        failures.append(
+            f"fleet router: {sheds:g} shed(s) in the snapshot — the "
+            "whole fleet refused a request after walking the spill "
+            "chain (--allow-shed to accept)")
     debt = evacuation_debt(metrics)
     if debt and not args.allow_evacuation:
         failures.append(

@@ -1231,6 +1231,179 @@ def fleet_selftest() -> list[CaseResult]:
     return cases
 
 
+def fleet_router_selftest() -> list[CaseResult]:
+    """Two rows per --all sweep (ISSUE 17, docs/fleet.md):
+
+    1. ``kill_one_replica_mid_serve`` — a 3-replica FleetRouter loses
+       one replica's rank mid-serve (its ledger confirms, the tier
+       evacuates): the router drains it, the drained in-flight requests
+       finish on SIBLING replicas with per-request token parity, and
+       the replica re-admits after the rejoin probe.
+    2. ``spill_chain_exhaustion`` — a seeded flood against a 2-replica
+       fleet with tiny admission budgets walks the whole spill chain:
+       under ``strict_shed`` the named :class:`FleetShedError` raises
+       (never a hang), and the already-admitted work still finishes.
+    """
+    import os
+    import warnings
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from triton_distributed_tpu.fleet import (
+        FleetRouter, FleetShedError, ReplicaHandle,
+    )
+    from triton_distributed_tpu.models import Engine, init_dense_llm
+    from triton_distributed_tpu.models.config import tiny_config
+    from triton_distributed_tpu.resilience import faults as faults_mod
+    from triton_distributed_tpu.runtime import initialize_distributed
+
+    if len(jax.devices()) < 2:
+        return [CaseResult(
+            op="fleet_router", mesh="3x", fault="rank_loss",
+            verdict="error", detected_by="", expected=("detected",),
+            ok=False, n_fired=0, n_violations=0, diagnostics=[],
+            elapsed_s=0.0,
+            error="fleet-router rows need >= 2 virtual CPU devices "
+                  "(--xla_force_host_platform_device_count)")]
+
+    cfg = tiny_config()
+    params = init_dense_llm(jax.random.PRNGKey(17), cfg)
+    ctx1 = initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                  devices=jax.devices()[:1])
+    oracle = Engine(cfg, params, ctx1, backend="xla", max_seq=64)
+    prompts = [[13 + 7 * i, 5, 91, 2 + i, 44, 8 + i] for i in range(6)]
+    gens = [4, 5, 4, 5, 4, 5]
+    golden = [np.asarray(oracle.serve(jnp.asarray([p], jnp.int32),
+                                      gen_len=g))[0].tolist()
+              for p, g in zip(prompts, gens)]
+    cases = []
+
+    def build_fleet(n, *, struck=None, **kw):
+        reps = []
+        for i in range(n):
+            if i == struck:
+                ctx = initialize_distributed(
+                    mesh_shape=(2,), axis_names=("tp",),
+                    devices=jax.devices()[:2])
+            else:
+                ctx = initialize_distributed(
+                    mesh_shape=(1,), axis_names=("tp",),
+                    devices=jax.devices()[:1])
+            eng = Engine(cfg, params, ctx, backend="xla", max_seq=64,
+                         page_size=4)
+            reps.append(ReplicaHandle.build(i, eng, prefill_chunk=4,
+                                            **kw))
+        return reps
+
+    # Row 1: one replica's rank dies mid-serve -> drain to siblings
+    # with parity -> re-admit after the rejoin probe.
+    t0 = time.time()
+    diags: list[str] = []
+    env0 = os.environ.get("TDTPU_REJOIN_AFTER")
+    os.environ["TDTPU_REJOIN_AFTER"] = "3"
+    try:
+        # Replica 1 is the only one whose mesh includes device 1, so
+        # mark_rank_lost(1) strikes exactly its ledger.
+        router = FleetRouter(build_fleet(3, struck=1, max_batch=2,
+                                         max_waiting=8))
+        reqs = []
+        for i, (p, g) in enumerate(zip(prompts, gens)):
+            req, res = router.submit(p, g, req_id=f"chaos-fr-{i}")
+            assert res.name == "ADMITTED", res
+            reqs.append(req)
+        loads = {rid: rep.load()
+                 for rid, rep in sorted(router.replicas.items())}
+        for _ in range(2):
+            router.step()               # tokens land on all replicas
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            faults_mod.mark_rank_lost(1)
+            for _ in range(4):          # confirm-dead -> drain
+                router.step()
+            drained = router.replicas["1"].draining
+            moved = router.drain_moves
+            faults_mod.clear_rank_loss(1)
+            router.run(max_iters=2000)
+        parity = all(list(r.tokens) == golden[i]
+                     for i, r in enumerate(reqs))
+        finished = all(r.state.name == "FINISHED" for r in reqs)
+        on_siblings = not any(r.req_id.startswith("chaos-fr-")
+                              for r in router.replicas["1"].se._finished
+                              if r in reqs and r.preemptions > 0)
+        readmitted = (router.readmits >= 1
+                      and not router.replicas["1"].draining)
+        diags += [f"loads at submit: {loads}",
+                  f"replica 1 drained: {drained}",
+                  f"in-flight requests moved: {moved}",
+                  f"per-request token parity: {parity}",
+                  f"all finished: {finished}",
+                  f"moved requests finished off replica 1: "
+                  f"{on_siblings}",
+                  f"re-admitted after rejoin: {readmitted}",
+                  f"router log: "
+                  f"{[e['event'] for e in router.fleet_log]}"]
+        verdict = ("detected" if drained and moved >= 1 and parity
+                   and finished and readmitted else "error")
+    except Exception as exc:
+        verdict = "error"
+        diags.append(f"{type(exc).__name__}: {exc}")
+    finally:
+        faults_mod.clear_rank_loss()
+        os.environ.pop("TDTPU_REJOIN_AFTER", None) if env0 is None \
+            else os.environ.__setitem__("TDTPU_REJOIN_AFTER", env0)
+    cases.append(CaseResult(
+        op="fleet_router", mesh="3x", fault="kill_one_replica_mid_serve",
+        verdict=verdict, detected_by="drain", expected=("detected",),
+        ok=verdict == "detected", n_fired=1, n_violations=0,
+        diagnostics=diags, elapsed_s=round(time.time() - t0, 3)))
+
+    # Row 2: seeded spill-chain exhaustion -> named FleetShedError,
+    # never a hang.
+    t0 = time.time()
+    diags = []
+    try:
+        router = FleetRouter(build_fleet(2, max_batch=1, max_waiting=1,
+                                         num_pages=4),
+                             strict_shed=True)
+        shed_exc = None
+        admitted = 0
+        for i in range(8):
+            try:
+                _req, res = router.submit(prompts[i % len(prompts)], 3,
+                                          req_id=f"chaos-shed-{i}")
+                admitted += res.name == "ADMITTED"
+            except FleetShedError as exc:
+                shed_exc = exc
+                break
+        named = (shed_exc is not None
+                 and "shed" in str(shed_exc)
+                 and shed_exc.req_id is not None
+                 and len(shed_exc.tried) == 2)
+        # The admitted work must still drain cleanly — a shed is load
+        # refused at the door, never a wedged fleet.
+        fin = router.run(max_iters=2000)
+        drained_clean = all(r.state.name == "FINISHED" for r in fin)
+        diags += [f"admitted before shed: {admitted}",
+                  f"FleetShedError: {str(shed_exc)[:120]}",
+                  f"sheds counted: {router.sheds}",
+                  f"spills counted: {router.spills}",
+                  f"admitted work drained clean: {drained_clean}"]
+        verdict = ("detected" if named and router.sheds >= 1
+                   and drained_clean else "error")
+    except Exception as exc:
+        verdict = "error"
+        diags.append(f"{type(exc).__name__}: {exc}")
+    cases.append(CaseResult(
+        op="fleet_router", mesh="2x", fault="spill_chain_exhaustion",
+        verdict=verdict, detected_by="FleetShedError",
+        expected=("detected",), ok=verdict == "detected", n_fired=1,
+        n_violations=0, diagnostics=diags,
+        elapsed_s=round(time.time() - t0, 3)))
+    return cases
+
+
 # ---------------------------------------------------------------------------
 # Flight-recorder rows (ISSUE 13): a seeded failure must leave a
 # postmortem dump the tooling can stand on — deterministic evidence,
@@ -1470,6 +1643,14 @@ def sweep(ops, faults, ranks, *, seed: int = 0,
         # prefill-role rank mid-migration -> demote-to-monolithic;
         # pinned geometry propagates the named error.
         for case in fleet_selftest():
+            cases.append(case)
+            failed += not case.ok
+            _print_case(case, verbose)
+        # Fleet-router rows (ISSUE 17): kill one replica mid-serve ->
+        # in-flight requests drain to siblings with token parity and
+        # the replica re-admits after the rejoin probe; a seeded
+        # spill-chain exhaustion raises the named FleetShedError.
+        for case in fleet_router_selftest():
             cases.append(case)
             failed += not case.ok
             _print_case(case, verbose)

@@ -259,7 +259,14 @@ def dryrun(json_path: str | None, flight_dir: str | None = None) -> int:
     shared-prefix trace served warm is token-identical to the cold
     sequential oracle on both backends with a nonzero shared-page
     count, exact refcounted pool occupancy, and a decode-pool hit on
-    the disagg tier that skips the prefill role + migration stream."""
+    the disagg tier that skips the prefill role + migration stream.
+    Phase 11 (ISSUE 17) proves the fleet router over four virtual CPU
+    replicas: parity + spread + replica-labeled metrics, prefix
+    affinity strictly beating round_robin on warm prefill tokens, a
+    mid-serve replica kill drained onto siblings (parity kept) and
+    re-admitted after the rejoin probe, and an autoscaler
+    shrink-then-grow round trip — with one named page auditor per
+    replica."""
     import os
 
     from triton_distributed_tpu.runtime.utils import (
@@ -1051,6 +1058,257 @@ def dryrun(json_path: str | None, flight_dir: str | None = None) -> int:
     _audit("phase10-prefix-megakernel", se10mk)
     _audit("phase10-prefix-disagg", se10dg)
 
+    # Phase 11 (ISSUE 17) — multi-replica fleet router (docs/fleet.md):
+    # four full serving replicas on CPU behind one admission door. All
+    # seeded: (a) per-request token parity vs the sequential oracle
+    # with the work actually SPREAD across replicas, and the merged
+    # registry carrying replica="..."-labeled series; (b) warm
+    # shared-prefix traffic routes to the prefix-holding replica
+    # (affinity hits > 0) and prefills STRICTLY fewer tokens than the
+    # same trace under round_robin; (c) a replica's rank dies
+    # mid-serve — the router drains it, its in-flight requests finish
+    # on siblings with parity, and the rejoin probe re-admits it;
+    # (d) the autoscaler shrinks an idle fleet then grows it back
+    # under queue pressure; per-replica page audits stay clean.
+    from triton_distributed_tpu.fleet import (
+        Autoscaler, FleetRouter, ReplicaHandle,
+    )
+
+    def _mk_fleet(n=4, *, struck=None, policy="affinity",
+                  autoscaler=None, **kw):
+        kw.setdefault("max_batch", 2)
+        kw.setdefault("num_pages", 16)
+        kw.setdefault("prefill_chunk", 4)
+        kw.setdefault("max_waiting", 8)
+        kw.setdefault("prefix_cache", True)
+        reps = []
+        for i in range(n):
+            # Only the to-be-struck replica gets a 2-device mesh: its
+            # ledger alone sees rank 1 die, so the kill is surgical.
+            devs = (jax.devices()[:2] if i == struck
+                    else jax.devices()[:1])
+            rctx = initialize_distributed(mesh_shape=(len(devs),),
+                                          axis_names=("tp",),
+                                          devices=devs)
+            reng = _Engine(engine.cfg, engine.params, rctx,
+                           backend="xla", max_seq=64, page_size=4)
+            reps.append(ReplicaHandle.build(str(i), reng, **kw))
+        return FleetRouter(reps, policy=policy, autoscaler=autoscaler)
+
+    # (a) parity + spread + labeled metrics, inside an obs run so the
+    # router publishes its lane into the snapshotted registry.
+    fr_spec = LoadSpec(n_requests=8, seed=7, mean_interarrival_iters=1.0)
+    fr_trace = build_trace(fr_spec)
+    fr_golden = sequential_reference(engine, fr_trace)
+    with tempfile.TemporaryDirectory() as fr_dir:
+        _obs.start_run(fr_dir)
+        try:
+            router11 = _mk_fleet(4)
+            fr_report = run_trace(router11, fr_trace)
+            fr_snap = _om.registry().snapshot()
+        finally:
+            _obs.finish_run()
+    fr_reqs = fr_report.pop("requests")
+    fr_mismatch = [r.req_id for r in fr_reqs
+                   if r.tokens != fr_golden[r.req_id]]
+    fr_spread = sorted(rid for rid, rep in router11.replicas.items()
+                       if rep.routed > 0)
+    if not fr_report["all_finished"]:
+        failures.append("fleet: not every routed request reached "
+                        "FINISHED")
+    if fr_mismatch:
+        failures.append("fleet token parity broken vs sequential "
+                        f"serve: {fr_mismatch}")
+    if len(fr_spread) < 2:
+        failures.append(
+            f"fleet routed everything to {fr_spread} — the router no "
+            "longer spreads cold traffic")
+    fr_routed_pub = (fr_snap.get(_om.FLEET_ROUTED) or {}).get("value", 0)
+    fr_labeled = sorted({k.split('replica="')[1].split('"')[0]
+                         for k in fr_snap if 'replica="' in k})
+    if fr_routed_pub != len(fr_trace):
+        failures.append(
+            f"{_om.FLEET_ROUTED} = {fr_routed_pub!r} in the obs "
+            f"snapshot (expected {len(fr_trace)})")
+    if len(fr_labeled) < 2:
+        failures.append(
+            "the merged registry carries replica=-labeled series for "
+            f"{fr_labeled} only — per-replica namespacing regressed")
+    if router11.sheds:
+        failures.append(f"fleet shed {router11.sheds} request(s) on an "
+                        "uncontended trace")
+
+    # (b) affinity vs round_robin A/B: same warm two-wave trace, two
+    # fresh fleets — affinity must route warm requests to the replica
+    # holding their family preamble and so prefill strictly less.
+    ab_spec = LoadSpec(n_requests=6, seed=8, prompt_len=(3, 5),
+                       max_new=(3, 4), mean_interarrival_iters=2.0,
+                       prefix_families=2, prefix_len=12)
+    ab_trace = build_trace(ab_spec)
+    ab_golden = sequential_reference(engine, ab_trace)
+    # Cold seed: the FIRST request of each family only, so each family
+    # preamble becomes resident on exactly one replica. (Seeding the
+    # whole trace would spread every family over every replica and
+    # round_robin would ride the warm pages for free.)
+    ab_seen, ab_cold = set(), []
+    for t in ab_trace:
+        fam_key = tuple(t["prompt"][:12])
+        if fam_key not in ab_seen:
+            ab_seen.add(fam_key)
+            ab_cold.append(t)
+    ab_prefill = {}
+    ab_routers = {}
+    for pol in ("affinity", "round_robin"):
+        r_ab = _mk_fleet(3, policy=pol)
+        run_trace(r_ab, [dict(t) for t in ab_cold])    # cold: populate
+        warm_trace = [dict(t, req_id=t["req_id"] + "-w")
+                      for t in ab_trace]
+        warm_report = run_trace(r_ab, warm_trace)
+        warm_reqs = warm_report.pop("requests")
+        ab_bad = [q.req_id for q in warm_reqs
+                  if q.tokens != ab_golden[q.req_id[:-2]]]
+        if ab_bad:
+            failures.append(f"fleet {pol} warm pass broke token parity "
+                            f"vs sequential serve: {ab_bad}")
+        ab_prefill[pol] = sum(len(q.prompt) - q.prefix_hit_tokens_total
+                              for q in warm_reqs)
+        ab_routers[pol] = r_ab
+    if ab_routers["affinity"].affinity_hits < 1:
+        failures.append("warm traffic scored no affinity-routed "
+                        "admissions — the shadow index is not fed")
+    if not ab_prefill["affinity"] < ab_prefill["round_robin"]:
+        failures.append(
+            "prefix-affinity routing did not beat round_robin on warm "
+            f"traffic (prefill tokens {ab_prefill['affinity']} vs "
+            f"{ab_prefill['round_robin']})")
+
+    # (c) kill-one-replica round trip. Distinct prompts so the cold
+    # fallback SPREADS work (warm families would all colonise one
+    # replica and the struck one would be idle at kill time).
+    report_drain = None
+    if len(jax.devices()) < 2:
+        failures.append("fleet drain segment needs >= 2 virtual CPU "
+                        "devices")
+    else:
+        rejoin_prev = os.environ.get("TDTPU_REJOIN_AFTER")
+        os.environ["TDTPU_REJOIN_AFTER"] = "3"
+        try:
+            router_dr = _mk_fleet(3, struck=1)
+        finally:
+            if rejoin_prev is None:
+                os.environ.pop("TDTPU_REJOIN_AFTER", None)
+            else:
+                os.environ["TDTPU_REJOIN_AFTER"] = rejoin_prev
+        dr_trace = [
+            {"req_id": f"fl11-{i}", "arrival_iter": 0,
+             "prompt": [13 + 7 * i, 5, 91, 2 + i, 44, 8 + i],
+             "max_new_tokens": 4 + (i % 2), "priority": 0}
+            for i in range(6)
+        ]
+        dr_golden = sequential_reference(engine, dr_trace)
+        dr_reqs = {}
+        for item in dr_trace:
+            rq, rs = router_dr.submit(item["prompt"],
+                                      item["max_new_tokens"],
+                                      req_id=item["req_id"])
+            if rs is not AdmitResult.ADMITTED:
+                failures.append(f"fleet drain segment: {item['req_id']} "
+                                f"refused admission ({rs})")
+            else:
+                dr_reqs[rq.req_id] = rq
+        for _ in range(2):
+            router_dr.step()           # first tokens land fleet-wide
+        try:
+            with _warnings.catch_warnings():
+                _warnings.simplefilter("ignore", RuntimeWarning)
+                _faults.mark_rank_lost(1)     # replica 1's rank dies
+                for _ in range(4):
+                    router_dr.step()
+                dr_drained = router_dr.replicas["1"].draining
+                dr_moved = router_dr.drain_moves
+                _faults.clear_rank_loss(1)    # repaired -> rejoin probe
+                router_dr.run()
+        finally:
+            _faults.clear_rank_loss()
+        dr_mismatch = [rid for rid, r in dr_reqs.items()
+                       if r.tokens != dr_golden[rid]]
+        dr_finished = all(r.state.name == "FINISHED"
+                          for r in dr_reqs.values())
+        if not dr_drained:
+            failures.append("the router did not drain the replica whose "
+                            "tier evacuated")
+        if dr_moved < 1:
+            failures.append("the drain moved no in-flight requests — "
+                            "the kill no longer lands mid-serve")
+        if dr_mismatch:
+            failures.append("drained requests broke token parity on "
+                            f"their sibling replicas: {dr_mismatch}")
+        if not dr_finished:
+            failures.append("not every request survived the replica "
+                            "kill to FINISHED")
+        if router_dr.readmits < 1 or router_dr.replicas["1"].draining:
+            failures.append("the drained replica was never re-admitted "
+                            "after its rejoin probe")
+        report_drain = {
+            "drained": dr_drained, "moved": dr_moved,
+            "parity_ok": not dr_mismatch,
+            "readmitted": router_dr.readmits >= 1,
+            "events": [e["event"] for e in router_dr.fleet_log],
+        }
+
+    # (d) autoscaler round trip: idle fleet shrinks, queue-pressure
+    # burst grows it back — decisions named and step-stamped.
+    as_router = _mk_fleet(3, autoscaler=Autoscaler(min_replicas=1,
+                                                   cooldown=2,
+                                                   queue_high=1.0))
+    as_router.submit([7, 8, 9], 2, req_id="as-warm")
+    as_router.run()                    # near-idle: shrink fires
+    as_shrunk = as_router.autoscaler.shrinks
+    for item in build_trace(LoadSpec(n_requests=8, seed=9,
+                                     mean_interarrival_iters=0.0)):
+        as_router.submit(item["prompt"], item["max_new_tokens"],
+                         req_id=item["req_id"] + "-as")
+    as_router.run()                    # queue pressure: grow fires
+    as_grown = as_router.autoscaler.grows
+    if as_shrunk < 1:
+        failures.append("the autoscaler never shrank the idle fleet")
+    if as_grown < 1:
+        failures.append("the autoscaler never grew the fleet back "
+                        "under queue pressure")
+    as_actions = [d["action"] for d in as_router.autoscaler.log]
+    if "shrink" not in as_actions or "grow" not in as_actions[
+            as_actions.index("shrink"):]:
+        failures.append("autoscaler log lacks the shrink-then-grow "
+                        f"sequence: {as_actions}")
+
+    # Per-replica audits (TDTPU_PAGE_AUDIT=1 is still live): one
+    # auditor per allocator, each report named with its replica id.
+    for rid in sorted(router11.replicas):
+        _audit(f"phase11-fleet-replica{rid}", router11.replicas[rid].se)
+    audit_names = {rid: rep.op
+                   for rid, rep in router11.page_audit_reports().items()}
+    if audit_names != {rid: f"replica{rid}"
+                       for rid in router11.replicas}:
+        failures.append("per-replica page-audit reports are not named "
+                        f"by replica id: {audit_names}")
+
+    report["fleet_router"] = {
+        "parity_ok": not fr_mismatch,
+        "replicas_routed": fr_spread,
+        "replica_labels": fr_labeled,
+        "affinity_hits": ab_routers["affinity"].affinity_hits,
+        "prefill_tokens": ab_prefill,
+        "drain": report_drain,
+        "autoscale": list(as_router.autoscaler.log),
+        "describe": router11.describe(),
+    }
+    if flight_dir:
+        # Next to the flight dumps: CI's obs artifact carries the
+        # fleet evidence alongside the postmortem inputs.
+        with open(os.path.join(flight_dir, "fleet-report.json"),
+                  "w") as f:
+            json.dump(report["fleet_router"], f, indent=2, default=str)
+
     if audit_prev is None:
         os.environ.pop("TDTPU_PAGE_AUDIT", None)
     else:
@@ -1306,6 +1564,103 @@ def disagg_serving_bench_rung(n_streams: int = 8, prompt_len: int = 128,
             f"{'two chips (KV blocks cross device_put/DCN)' if two_dev else 'one shared chip (degenerate roles)'}"
             "; checksummed double-buffered migration included in the "
             "number"),
+    }
+
+
+def fleet_serving_bench_rung(n_replicas: int = 4, n_streams: int = 8,
+                             prompt_len: int = 128, max_new: int = 16,
+                             *, page_size: int = 64) -> dict:
+    """The fleet router's rung (ISSUE 17, docs/fleet.md): the open-loop
+    workload of :func:`serving_bench_rung` scaled to ``n_replicas``×
+    the requests, served through a :class:`~triton_distributed_tpu.
+    fleet.FleetRouter` over ``n_replicas`` full replicas of the same
+    Qwen3-8B shard. Virtual replicas SERIALIZE on one host, so the
+    rung reports the parallel-equivalent makespan — per router
+    iteration the SLOWEST replica step is what a real data-parallel
+    fleet would wait on, so the wall is Σ max-per-iteration — and
+    bench.py races it against a 1-replica fleet measured identically
+    in the same window (`serve_tokens_per_s_fleet` +
+    `serve_fleet_scaling_x`): near-linear scaling is what the router
+    must not tax away in routing/drain bookkeeping."""
+    import jax
+    import jax.random as jrandom
+
+    from triton_distributed_tpu.fleet import FleetRouter, ReplicaHandle
+    from triton_distributed_tpu.models import Engine
+    from triton_distributed_tpu.models.dense import init_dense_llm
+    from triton_distributed_tpu.runtime import initialize_distributed
+
+    cfg = _bench_shard_config()
+    params = init_dense_llm(jrandom.PRNGKey(0), cfg)
+    ctx1 = initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                  devices=jax.devices()[:1])
+
+    def build_router(n):
+        durs: list[float] = []
+        reps = []
+        for i in range(n):
+            eng = Engine(cfg, params, ctx1, backend="xla", max_seq=512,
+                         page_size=page_size)
+            rep = ReplicaHandle.build(str(i), eng, max_batch=n_streams,
+                                      prefill_chunk=128)
+            orig = rep.se.step
+
+            def timed_step(_orig=orig):
+                t0 = time.perf_counter()
+                out = _orig()
+                durs.append(time.perf_counter() - t0)
+                return out
+
+            rep.se.step = timed_step
+            reps.append(rep)
+        router = FleetRouter(reps)
+        iter_maxes: list[float] = []
+        orig_step = router.step
+
+        def step():
+            durs.clear()
+            out = orig_step()
+            if durs:
+                iter_maxes.append(max(durs))
+            return out
+
+        router.step = step
+        router._iter_maxes = iter_maxes
+        return router
+
+    def make_trace(n_requests, seed):
+        spec = LoadSpec(n_requests=n_requests, seed=seed,
+                        prompt_len=(prompt_len, prompt_len),
+                        max_new=(max_new, max_new),
+                        mean_interarrival_iters=0.0,
+                        vocab=cfg.vocab_size)
+        return build_trace(spec)
+
+    def measure(n):
+        router = build_router(n)
+        run_trace(router, make_trace(n * n_streams, 0))  # warmup/compile
+        router._iter_maxes.clear()
+        report = run_trace(router, make_trace(n * n_streams, 1))
+        report.pop("requests")
+        if not report["all_finished"] or router.sheds:
+            raise RuntimeError(
+                f"fleet rung not measurable: finished="
+                f"{report['all_finished']}, sheds={router.sheds} — a "
+                "shed or hung request would mislabel the ledger row")
+        wall = max(sum(router._iter_maxes), 1e-9)
+        return report["tokens"] / wall
+
+    single_tps = measure(1)
+    fleet_tps = measure(n_replicas)
+    return {
+        "serve_tokens_per_s_fleet": round(fleet_tps, 3),
+        "serve_fleet_scaling_x": round(fleet_tps / max(single_tps, 1e-9),
+                                       3),
+        "serve_fleet_replicas": n_replicas,
+        "serve_fleet_comm": (
+            f"none ({n_replicas} data-parallel n=1 shards, no ICI; "
+            "parallel-equivalent makespan = per-iteration max replica "
+            "step; router admission/bookkeeping included)"),
     }
 
 

@@ -171,7 +171,8 @@ class ServingEngine:
                  prefill_chunk: int | None = None,
                  max_waiting: int = 64, slo_cfg=None, slo_every: int = 1,
                  fleet=None, clock=time.perf_counter, spec_k: int = 0,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False, metrics_registry=None,
+                 replica_id: str | int | None = None):
         if engine.page_size is None:
             raise ServingConfigError(
                 "engine has no paged cache: construct Engine(page_size=...) "
@@ -196,6 +197,13 @@ class ServingEngine:
         self.chunk = chunk
         self.clock = clock
         self.slo_cfg = slo_cfg
+        # Fleet namespacing (ISSUE 17, docs/fleet.md): a replica tier
+        # publishes into its OWN registry (the router merges them back
+        # with replica= labels) so N replicas never silently sum gauges
+        # like tdtpu_kv_pages_resident; the replica id also stamps the
+        # flight recorder's dumps.
+        self.metrics_registry = metrics_registry
+        self.replica_id = None if replica_id is None else str(replica_id)
         # Prefill buffer: whole chunks covering max_seq (chunk % page == 0
         # keeps it page-aligned for the scatter reshape).
         self.s_buf = -(-engine.max_seq // chunk) * chunk
@@ -252,7 +260,8 @@ class ServingEngine:
         from triton_distributed_tpu.obs import flight as obs_flight
 
         self.flight = obs_flight.FlightRecorder(
-            _env_int("TDTPU_FLIGHT_CAPACITY", 128))
+            _env_int("TDTPU_FLIGHT_CAPACITY", 128),
+            replica_id=self.replica_id)
         self._flight_rung = engine._rung
         # Megakernel serving lane (round 9): decode through the PAGED
         # persistent kernel when the configuration supports it; a
@@ -723,7 +732,7 @@ class ServingEngine:
         self._rebuild_device_state()
         self.flight.note("spec_fallback", reason, self._iter)
         if self._observing():
-            obs_metrics.registry().counter(
+            self._reg().counter(
                 "tdtpu_spec_fallbacks_total",
                 "speculative lane disabled after a transient verify "
                 "failure (one-token decode from here)").inc()
@@ -750,7 +759,7 @@ class ServingEngine:
                            req.t_arrival if req.t_arrival is not None
                            else self.clock())
         if res is AdmitResult.QUEUE_FULL and self._observing():
-            obs_metrics.registry().counter(
+            self._reg().counter(
                 obs_metrics.SERVE_REJECTS,
                 "requests refused at admission (queue/pool backpressure)"
             ).inc()
@@ -880,7 +889,7 @@ class ServingEngine:
             self._audit_iteration()
         obs_on = self._observing()
         if obs_on:
-            reg = obs_metrics.registry()
+            reg = self._reg()
             if preempted:
                 reg.counter(obs_metrics.SERVE_PREEMPTIONS,
                             "sequences evicted under page pressure "
@@ -925,6 +934,13 @@ class ServingEngine:
     def _observing(self) -> bool:
         return obs_trace.get_tracer() is not None or self.slo_cfg is not None
 
+    def _reg(self):
+        """The registry this tier publishes into: its private
+        per-replica registry when the fleet router namespaced it,
+        otherwise the process-global one."""
+        return (self.metrics_registry if self.metrics_registry is not None
+                else obs_metrics.registry())
+
     # -- request-scoped tracing + flight recorder (ISSUE 13) ------------------
     def _req_event(self, req: Request, kind: str) -> None:
         """Scheduler lifecycle observer → request-tracer mark (one
@@ -938,7 +954,7 @@ class ServingEngine:
             rt.mark(req.req_id, state, self.clock())
 
     def _publish_ttft_breakdown(self, bd: dict) -> None:
-        reg = obs_metrics.registry()
+        reg = self._reg()
         helps = {
             "queue_ms": "TTFT component: time WAITING/PREEMPTED "
                         "(admission + re-admission waits), ms",
@@ -957,7 +973,7 @@ class ServingEngine:
     def _flight_counters(self) -> dict[str, float]:
         """Count-valued series only — deterministic under seeded runs
         with an injected clock (histogram latencies are not)."""
-        reg = obs_metrics.registry()
+        reg = self._reg()
         out: dict[str, float] = {}
         for name in (obs_metrics.SERVE_FINISHED,
                      obs_metrics.SERVE_PREEMPTIONS,
@@ -999,6 +1015,8 @@ class ServingEngine:
                    "rung": eng._rung,
                    "kv_dtype": (str(jnp.dtype(self.kv_dtype))
                                 if self.kv_dtype is not None else None)}
+            if self.replica_id is not None:
+                cfg["replica"] = self.replica_id
             self.flight.dump(kind, reason, getattr(self, "_iter", 0),
                              config=cfg,
                              requests=self._flight_requests(),
@@ -1203,7 +1221,7 @@ class ServingEngine:
             f"{type(exc).__name__} attributed to rank {rank}: "
             f"{str(exc)[:120]}", self._iter, rank=rank)
         if self._observing():
-            reg = obs_metrics.registry()
+            reg = self._reg()
             reg.counter(obs_metrics.FLEET_STEP_FAULTS,
                         "rank-attributable step failures absorbed below "
                         "the evacuation threshold").inc()
@@ -1306,7 +1324,7 @@ class ServingEngine:
                             preempted=n_evicted):
             pass
         if self._observing():
-            reg = obs_metrics.registry()
+            reg = self._reg()
             reg.counter(obs_metrics.FLEET_EVACUATIONS,
                         "survivor-mesh evacuations (rank confirmed dead)"
                         ).inc()
@@ -1343,7 +1361,7 @@ class ServingEngine:
                             preempted=n_evicted):
             pass
         if self._observing():
-            reg = obs_metrics.registry()
+            reg = self._reg()
             reg.counter(obs_metrics.FLEET_REJOINS,
                         "full-mesh rejoins after a cleared rank loss"
                         ).inc()
@@ -1415,7 +1433,7 @@ class ServingEngine:
             if first:
                 req.t_first_token = now
             if self._observing():
-                reg = obs_metrics.registry()
+                reg = self._reg()
                 reg.counter("tdtpu_tokens_generated_total",
                             "decode tokens generated").inc()
                 if first:
@@ -1457,7 +1475,7 @@ class ServingEngine:
             f"{str(exc)[:120]} (preempt + recompute-on-resume)",
             self._iter, req=req.req_id)
         if self._observing():
-            obs_metrics.registry().counter(
+            self._reg().counter(
                 "tdtpu_serve_prefill_faults_total",
                 "transient prefill-slice failures absorbed by "
                 "preempt + recompute-on-resume").inc()
@@ -1501,7 +1519,7 @@ class ServingEngine:
             rt.span(req.req_id, "prefix_gather", t0, self.clock(),
                     hit_tokens=hit, restart=restart)
         if restart and self._observing():
-            obs_metrics.registry().counter(
+            self._reg().counter(
                 obs_metrics.PREFIX_TOKENS_SAVED,
                 "prefill tokens skipped because a shared resident "
                 "prefix covered them (warm admissions)").inc(restart)
@@ -1567,7 +1585,7 @@ class ServingEngine:
         self.sched.finish(req, self.clock())
         self._finished.append(req)
         if self._observing():
-            reg = obs_metrics.registry()
+            reg = self._reg()
             reg.counter(obs_metrics.SERVE_FINISHED,
                         "requests served to completion").inc()
             tpot = req.tpot_s
@@ -1643,7 +1661,7 @@ class ServingEngine:
             # NOT the page-pressure counter: an operator alert
             # keyed on pool sizing must not fire for a backend
             # fault.
-            obs_metrics.registry().counter(
+            self._reg().counter(
                 "tdtpu_serve_backend_demote_preemptions_total",
                 "in-flight sequences recomputed because the "
                 "decode backend demoted mid-serve").inc(len(ready))
@@ -1766,7 +1784,7 @@ class ServingEngine:
             req.accepted_draft_tokens += len(acc) - 1
         self._last_spec = (drafted_total, accepted_drafts)
         if self._observing():
-            reg = obs_metrics.registry()
+            reg = self._reg()
             reg.counter(obs_metrics.SPEC_DRAFT_TOKENS,
                         "draft candidate tokens proposed to verify "
                         "steps").inc(drafted_total)
@@ -1811,7 +1829,7 @@ class ServingEngine:
                     if bd is not None and self._observing():
                         self._publish_ttft_breakdown(bd)
         if self._observing():
-            reg = obs_metrics.registry()
+            reg = self._reg()
             reg.counter("tdtpu_tokens_generated_total",
                         "decode tokens generated").inc(total)
             Engine._observe_step(
@@ -1899,7 +1917,7 @@ class ServingEngine:
             from triton_distributed_tpu.obs import slo as obs_slo
 
             section = obs_slo.check_serving(
-                obs_metrics.registry(), run_dir=obs.active_run_dir(),
+                self._reg(), run_dir=obs.active_run_dir(),
                 cfg=self.slo_cfg)
         except Exception as e:   # the watchdog must never cost the serve
             import warnings

@@ -106,6 +106,14 @@ class PrefixCache:
         self.tokens_saved = 0
         self.evictions = 0
         self.pages_shared_peak = 0
+        # Coverage event hook (ISSUE 17): the fleet router subscribes
+        # here to shadow WHICH prefixes this tier has resident — fed by
+        # index/hit/invalidate events, never by probing device state.
+        # Called as on_event(kind, tokens) with kind in
+        # {"insert", "hit", "invalidate"} (tokens is None for
+        # invalidate). Must never cost a serve: failures propagate to
+        # the subscriber, not swallowed here.
+        self.on_event = None
         allocator.reclaim = self.reclaim
         allocator.reclaimable = self.reclaimable
 
@@ -174,6 +182,10 @@ class PrefixCache:
                 added += 1
             child.last_use = self._clock
             node = child
+        if self.on_event is not None:
+            # Full token chain, not just the newly-added tail: coverage
+            # includes the pre-existing shared spine of the chain.
+            self.on_event("insert", [int(t) for t in tokens])
         return added
 
     def _walk(self, tokens):
@@ -268,6 +280,8 @@ class PrefixCache:
                 nodes = self._walk(tokens)[3]
             for node in nodes:
                 node.last_use = self._clock
+            if self.on_event is not None:
+                self.on_event("hit", [int(t) for t in tokens][:hit_tokens])
 
     # -- pins (partial-page read holds) --------------------------------------
     def pin(self, page: int) -> None:
@@ -343,4 +357,6 @@ class PrefixCache:
         self._root = _Node(-1, self._clock)
         self._tree_epoch += 1
         self._walk_memo = None
+        if self.on_event is not None:
+            self.on_event("invalidate", None)
         return released
