@@ -39,12 +39,17 @@ from triton_distributed_tpu.serving.loop import ServingEngine
 
 @pytest.fixture(autouse=True)
 def _no_leaked_run():
-    """Every test starts and ends with tracer + reqtracer disabled."""
+    """Every test starts and ends with tracer + reqtracer + step
+    profiler disabled."""
+    from triton_distributed_tpu.obs import stepprof as obs_stepprof
+
     obs_trace.disable()
     obs_reqtrace.disable()
+    obs_stepprof.disable()
     yield
     obs_trace.disable()
     obs_reqtrace.disable()
+    obs_stepprof.disable()
 
 
 @pytest.fixture(scope="module")
@@ -263,17 +268,28 @@ def test_flight_ring_is_bounded(tmp_path):
 def test_report_check_fails_on_missing_request_lane(tmp_path):
     """A serving-tier snapshot WITHOUT per-request timelines must fail
     --check (the postmortem evidence is gone); adding the lane — or the
-    explicit opt-out — passes it."""
+    explicit opt-out — passes it. Since ISSUE 18 the step-phase lane
+    (steps.spans.json) is gated the same way."""
+    from triton_distributed_tpu.obs import stepprof as obs_stepprof
+
     reg = obs_metrics.Registry()
     reg.counter(obs_metrics.SERVE_FINISHED, "x").inc(3)
     reg.gauge(obs_metrics.KV_PAGES_RESIDENT, "x").set(8)
     reg.save(str(tmp_path))
     args = [str(tmp_path), "--check", "--require-series", ""]
     assert obs_report.main(args) == 1
-    assert obs_report.main(args + ["--allow-missing-request-lane"]) == 0
+    assert obs_report.main(args + ["--allow-missing-request-lane",
+                                   "--allow-missing-step-profile"]) == 0
     rt = ReqTracer()
     rt.arrival("req-lane", 0.0)
     rt.save(str(tmp_path / "requests.spans.json"))
+    # Request lane restored — the step-phase lane still gates alone.
+    assert obs_report.main(args) == 1
+    assert obs_report.main(args + ["--allow-missing-step-profile"]) == 0
+    sp = obs_stepprof.StepProfiler()
+    sp.begin_iteration(0, 1.0)
+    sp.finish_iteration(1.5)
+    sp.save(str(tmp_path / "steps.spans.json"))
     assert obs_report.main(args) == 0
 
 

@@ -1,0 +1,491 @@
+"""Step-phase profiler (ISSUE 18, docs/observability.md "Step
+profiling & host bubble").
+
+The load-bearing contracts: the telescoping phase stack makes the
+PARTITION INVARIANT (Σ phases == iteration wall) hold by construction
+for every iteration shape the serving stack produces — plain decode,
+chunked-prefill mixed, preemption, evacuation preflight, spec-decode
+verify, disagg migration advance, and fleet-router per-replica — all
+byte-deterministic under the loop's injected clock; phase vectors ride
+the flight ring with cumulative host/device counters; the bubble gauge
+and per-phase histograms land in the registry (the fleet router merges
+per-replica bubbles); and ``obs.report --check`` gates the lane.
+"""
+
+import json
+import os
+import warnings
+
+import pytest
+
+import jax
+
+from triton_distributed_tpu import obs
+from triton_distributed_tpu.models.config import tiny_config
+from triton_distributed_tpu.models.dense import init_dense_llm
+from triton_distributed_tpu.models.engine import Engine
+from triton_distributed_tpu.obs import flight as obs_flight
+from triton_distributed_tpu.obs import metrics as obs_metrics
+from triton_distributed_tpu.obs import postmortem as obs_postmortem
+from triton_distributed_tpu.obs import report as obs_report
+from triton_distributed_tpu.obs import stepprof
+from triton_distributed_tpu.obs import trace as obs_trace
+from triton_distributed_tpu.obs.stepprof import StepProfiler
+from triton_distributed_tpu.runtime import initialize_distributed
+from triton_distributed_tpu.serving.loadgen import (
+    LoadSpec, build_trace, run_trace,
+)
+from triton_distributed_tpu.serving.loop import ServingEngine
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_profiler():
+    stepprof.disable()
+    obs_trace.disable()
+    yield
+    stepprof.disable()
+    obs_trace.disable()
+
+
+@pytest.fixture(scope="module")
+def ctx1():
+    return initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                  devices=jax.devices()[:1])
+
+
+@pytest.fixture(scope="module")
+def served(ctx1):
+    cfg = tiny_config()
+    params = init_dense_llm(jax.random.PRNGKey(7), cfg)
+    return Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                  page_size=4)
+
+
+class CounterClock:
+    """Deterministic injectable clock: monotone, no wall time."""
+
+    def __init__(self, step: float = 0.001):
+        self.t = 0.0
+        self.step = step
+
+    def __call__(self) -> float:
+        self.t += self.step
+        return round(self.t, 6)
+
+
+def _assert_partition(recs):
+    assert recs, "no phase records produced"
+    for rec in recs:
+        problem = stepprof.check_partition(rec)
+        assert problem is None, problem
+
+
+def _profiled_run(eng, trace, **kw):
+    """One serving replay under a private profiler + CounterClock;
+    returns (records, report)."""
+    prof = StepProfiler()
+    prev = stepprof.set_profiler(prof)
+    try:
+        se = ServingEngine(eng, clock=CounterClock(), **kw)
+        report = run_trace(se, [dict(t) for t in trace])
+    finally:
+        stepprof.set_profiler(prev)
+    return prof.records(), report
+
+
+# ---------------------------------------------------------------------------
+# The telescoping stack (unit level).
+# ---------------------------------------------------------------------------
+
+def test_telescoping_stack_partitions_with_nesting():
+    """Nested phases (megakernel retarget inside decode_dispatch)
+    telescope: each segment lands in exactly one phase, the parent
+    keeps only its un-nested remainder, and Σ phases == wall."""
+    sp = StepProfiler()
+    sp.begin_iteration(0, 10.0)
+    sp.enter("admit", 10.1)          # [10.0, 10.1] -> other
+    sp.exit(10.3)                    # [10.1, 10.3] -> admit
+    sp.enter("decode_dispatch", 10.3)
+    sp.enter("retarget", 10.4)       # [10.3, 10.4] -> decode_dispatch
+    sp.exit(10.6)                    # [10.4, 10.6] -> retarget
+    sp.exit(10.7)                    # [10.6, 10.7] -> decode_dispatch
+    rec = sp.finish_iteration(11.0)  # [10.7, 11.0] -> other
+    assert rec["phases"] == {
+        "admit": 200.0, "decode_dispatch": 200.0, "retarget": 200.0,
+        "other": 400.0}
+    assert rec["wall_ms"] == 1000.0
+    assert rec["host_ms"] == 1000.0 and rec["device_ms"] == 0.0
+    assert rec["host_bubble_frac"] == 1.0
+    assert stepprof.check_partition(rec) is None
+
+
+def test_dangling_phases_and_aborted_iterations_stay_partitioned():
+    """An exception can skip exits, and a crashed iteration can skip
+    finish entirely — both must still produce partition-valid records
+    (the next begin closes the dangling window as aborted)."""
+    sp = StepProfiler()
+    sp.begin_iteration(0, 0.0)
+    sp.enter("prefill", 0.5)         # never exited
+    sp.begin_iteration(1, 2.0)       # auto-closes iter 0
+    rec1 = sp.finish_iteration(3.0)
+    recs = sp.records()
+    assert [r["it"] for r in recs] == [0, 1]
+    assert recs[0]["aborted"] is True
+    assert recs[0]["phases"] == {"prefill": 1500.0, "other": 500.0}
+    _assert_partition(recs)
+    assert rec1["device_ms"] == 0.0
+    # Device phases roll up separately from host phases.
+    assert recs[0]["host_ms"] == 500.0
+    assert recs[0]["device_ms"] == 1500.0
+
+
+def test_phase_hook_is_noop_without_active_iteration():
+    """The scoped hook must cost nothing (and record nothing) when no
+    profiler is installed or no iteration is open — instrumentation
+    sites fire unconditionally on the serving hot path."""
+    with stepprof.phase("admit"):
+        pass                         # no profiler at all
+    sp = stepprof.enable()
+    with stepprof.phase("admit"):
+        pass                         # profiler idle, no iteration
+    assert not sp.has_records()
+    sp.begin_iteration(0, 1.0)
+    assert sp.active()
+    sp.finish_iteration(2.0)
+    assert len(sp.records()) == 1
+
+
+def test_check_partition_rejects_broken_vectors():
+    good = {"it": 3, "wall_ms": 10.0,
+            "phases": {"admit": 4.0, "other": 6.0},
+            "host_bubble_frac": 1.0}
+    assert stepprof.check_partition(good) is None
+    assert "partition invariant" in stepprof.check_partition(
+        {**good, "phases": {"admit": 4.0}})
+    assert "missing 'phases'" in stepprof.check_partition(
+        {"wall_ms": 1.0})
+    assert "outside [0, 1]" in stepprof.check_partition(
+        {**good, "host_bubble_frac": 1.7})
+
+
+# ---------------------------------------------------------------------------
+# Iteration shapes (the acceptance criterion's sweep) + determinism.
+# ---------------------------------------------------------------------------
+
+def test_plain_decode_partitions_and_is_byte_deterministic(served):
+    """Two identically-seeded replays under the injected clock produce
+    BYTE-IDENTICAL phase records; every iteration satisfies the
+    partition invariant and carries the plain-decode phases."""
+    trace = build_trace(LoadSpec(n_requests=2, seed=3,
+                                 prompt_len=(4, 4), max_new=(3, 3),
+                                 mean_interarrival_iters=0.0))
+    recs1, report = _profiled_run(served, trace, max_batch=2,
+                                  num_pages=16, prefill_chunk=4)
+    recs2, _ = _profiled_run(served, trace, max_batch=2,
+                             num_pages=16, prefill_chunk=4)
+    assert report["all_finished"]
+    _assert_partition(recs1)
+    assert json.dumps(recs1) == json.dumps(recs2), \
+        "phase records are not byte-deterministic under a fake clock"
+    seen = {p for r in recs1 for p in r["phases"]}
+    assert {"admit", "decode_dispatch", "device_wait",
+            "accounting"} <= seen
+    # Cumulative counters are monotone and end at the run totals.
+    cums = [r["host_ms_cum"] for r in recs1]
+    assert cums == sorted(cums)
+    assert cums[-1] == pytest.approx(
+        round(sum(r["host_ms"] for r in recs1), 3), abs=0.001)
+
+
+def test_chunked_prefill_mixed_iterations_partition(served):
+    """Prefill slices interleaved with in-flight decode: iterations
+    carrying BOTH a prefill slice and a decode batch still partition."""
+    trace = build_trace(LoadSpec(n_requests=3, seed=1,
+                                 prompt_len=(8, 10), max_new=(3, 4),
+                                 mean_interarrival_iters=1.0))
+    recs, report = _profiled_run(served, trace, max_batch=4,
+                                 num_pages=32, prefill_chunk=4)
+    assert report["all_finished"]
+    _assert_partition(recs)
+    mixed = [r for r in recs if r["phases"].get("prefill", 0) > 0
+             and r["phases"].get("decode_dispatch", 0) > 0]
+    assert mixed, "no iteration mixed a prefill slice with decode"
+    assert all(r["device_ms"] >= r["phases"].get("prefill", 0)
+               for r in recs)
+
+
+def test_preemption_shape_partitions(served):
+    """Page pressure forces eviction mid-decode (phase-1 dryrun shape):
+    the preempting iterations partition like any other."""
+    trace = build_trace(LoadSpec(n_requests=8, seed=0,
+                                 mean_interarrival_iters=1.0))
+    recs, report = _profiled_run(served, trace, max_batch=4, num_pages=8,
+                                 prefill_chunk=4, max_waiting=8)
+    assert report["all_finished"]
+    assert report["preemptions"] > 0, \
+        "pool sizing no longer exercises eviction"
+    _assert_partition(recs)
+    assert any(r["phases"].get("pages", 0) > 0 for r in recs)
+
+
+def test_spec_decode_verify_shape_partitions(served):
+    """Draft-and-verify iterations (spec_k=2): the draft-planning phase
+    appears and the verify launch still splits dispatch/device_wait."""
+    trace = [{"req_id": "sp-0", "arrival_iter": 0,
+              "prompt": [3, 9] * 4, "max_new_tokens": 5, "priority": 0}]
+    recs, report = _profiled_run(served, trace, max_batch=2,
+                                 num_pages=16, prefill_chunk=4,
+                                 spec_k=2)
+    assert report["all_finished"]
+    _assert_partition(recs)
+    assert any(r["phases"].get("draft", 0) > 0 for r in recs)
+    assert any(r["phases"].get("device_wait", 0) > 0 for r in recs)
+
+
+def test_disagg_migration_advance_partitions(served):
+    """The disagg tier's migration-advance slice lands in ``migrate``
+    and the extra lifecycle stage keeps the partition."""
+    from triton_distributed_tpu.disagg import (
+        DisaggServingEngine, role_contexts,
+    )
+
+    pctx, dctx = role_contexts(jax.devices()[:2])
+    pe = Engine(served.cfg, served.params, pctx, backend="xla",
+                max_seq=64)
+    de = Engine(served.cfg, served.params, dctx, backend="xla",
+                max_seq=64, page_size=4)
+    prof = StepProfiler()
+    prev = stepprof.set_profiler(prof)
+    try:
+        se = DisaggServingEngine(pe, de, max_batch=2, num_pages=8,
+                                 prefill_chunk=4, block_pages=1,
+                                 clock=CounterClock())
+        report = run_trace(se, [{"req_id": "mig-0", "arrival_iter": 0,
+                                 "prompt": list(range(30, 42)),
+                                 "max_new_tokens": 4, "priority": 0}])
+    finally:
+        stepprof.set_profiler(prev)
+    assert se.disagg_active and report["all_finished"]
+    recs = prof.records()
+    _assert_partition(recs)
+    assert any(r["phases"].get("migrate", 0) > 0 for r in recs), \
+        "a 3-block migration must spend time in the migrate phase"
+
+
+def test_evacuation_preflight_shape_partitions(served):
+    """A rank loss mid-serve: the evacuation runs inside ``preflight``
+    and the geometry-transition iteration still partitions."""
+    from triton_distributed_tpu.resilience import (
+        clear_rank_loss, mark_rank_lost,
+    )
+
+    cfg, params = served.cfg, served.params
+    ctx2 = initialize_distributed(mesh_shape=(2,), axis_names=("tp",),
+                                  devices=jax.devices()[:2])
+    eng = Engine(cfg, params, ctx2, backend="xla", max_seq=64,
+                 page_size=4)
+    prof = StepProfiler()
+    prev = stepprof.set_profiler(prof)
+    clear_rank_loss()
+    try:
+        se = ServingEngine(eng, max_batch=2, prefill_chunk=4,
+                           clock=CounterClock())
+        se.submit([5, 77, 131, 9, 40, 2], 5, req_id="ev-0")
+        for _ in range(3):
+            se.step()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            mark_rank_lost(1)
+            se.run()
+        assert se.evacuated and eng.n_total == 1
+    finally:
+        clear_rank_loss()
+        stepprof.set_profiler(prev)
+    recs = prof.records()
+    _assert_partition(recs)
+    evac = [r for r in recs if r["phases"].get("preflight", 0) > 0]
+    assert evac, "the evacuation never charged the preflight phase"
+
+
+def test_fleet_router_per_replica_records_and_merged_bubble(tmp_path):
+    """Fleet replicas step through ONE profiler: records carry replica
+    labels, per-replica cumulative counters stay separate, and
+    ``publish_metrics`` merges the bubble gauge (fleet mean) plus the
+    replica-labeled variants into the fleet registry."""
+    from triton_distributed_tpu.fleet import FleetRouter, ReplicaHandle
+
+    cfg = tiny_config()
+    params = init_dense_llm(jax.random.PRNGKey(7), cfg)
+    reps = []
+    for i in range(2):
+        ctx = initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                     devices=jax.devices()[:1])
+        eng = Engine(cfg, params, ctx, backend="xla", max_seq=64,
+                     page_size=4)
+        reps.append(ReplicaHandle.build(str(i), eng, max_batch=2,
+                                        num_pages=16, prefill_chunk=4,
+                                        max_waiting=8))
+    obs.start_run(str(tmp_path))
+    try:
+        router = FleetRouter(reps, policy="round_robin")
+        run_trace(router, build_trace(LoadSpec(
+            n_requests=2, seed=5, prompt_len=(4, 6), max_new=(3, 4),
+            mean_interarrival_iters=0.0)))
+        sp = stepprof.get_profiler()
+        recs = sp.records()
+        labels = sorted({r.get("replica") for r in recs} - {None})
+        cum0 = sp.cumulative("0")
+        cum1 = sp.cumulative("1")
+        snap = obs_metrics.registry().snapshot()
+    finally:
+        obs.finish_run()
+    _assert_partition(recs)
+    assert labels == ["0", "1"], \
+        f"per-replica attribution lost (labels {labels})"
+    assert cum0[0] > 0 and cum1[0] > 0 and cum0 != cum1
+    merged = snap.get(obs_metrics.SERVE_HOST_BUBBLE_FRAC)
+    assert merged is not None and 0.0 < merged["value"] <= 1.0
+    labeled = [k for k in snap
+               if k.startswith(obs_metrics.SERVE_HOST_BUBBLE_FRAC + "{")
+               and 'replica="' in k]
+    assert len(labeled) == 2, labeled
+    # The steps lane landed in the run dir with one thread per replica.
+    lane = json.load(open(tmp_path / "steps.spans.json"))
+    threads = {e["args"]["name"] for e in lane["traceEvents"]
+               if e.get("name") == "thread_name"}
+    assert threads == {"step-phases/0", "step-phases/1"}
+
+
+# ---------------------------------------------------------------------------
+# Evidence surfaces: registry, flight ring, postmortem, report gate.
+# ---------------------------------------------------------------------------
+
+def test_metrics_published_under_obs_run(served, tmp_path):
+    """Under an obs run the loop publishes the bubble gauge, the
+    host/device step histograms, and per-phase histograms."""
+    obs.start_run(str(tmp_path))
+    try:
+        se = ServingEngine(served, max_batch=2, num_pages=16,
+                           prefill_chunk=4)
+        se.submit(list(range(1, 8)), 3, req_id="m-0")
+        se.run()
+        reg = obs_metrics.registry()
+        bubble = reg.get(obs_metrics.SERVE_HOST_BUBBLE_FRAC)
+        assert bubble is not None and 0.0 < bubble.value <= 1.0
+        assert reg.get(obs_metrics.SERVE_STEP_HOST_MS).count > 0
+        assert reg.get(obs_metrics.SERVE_STEP_DEVICE_MS).count > 0
+        for phase in ("admit", "decode_dispatch", "accounting"):
+            h = reg.get(f"{obs_metrics.SERVE_PHASE_MS_PREFIX}_{phase}")
+            assert h is not None and h.count > 0, phase
+    finally:
+        obs.finish_run()
+    # The run dir report validates with the steps lane present.
+    assert obs_report.main([str(tmp_path), "--check",
+                            "--require-series", ""]) == 0
+
+
+def test_flight_dump_carries_phases_and_postmortem_renders(served,
+                                                           tmp_path):
+    """Flight-ring iteration records carry the phase vector + the
+    cumulative host/device counters; the postmortem renders the phase
+    table; ``obs.report --check`` verifies the partition on the dump."""
+    from triton_distributed_tpu.obs.slo import SLOConfig
+
+    prior = obs_metrics.set_registry(obs_metrics.Registry())
+    prof = StepProfiler()
+    prev = stepprof.set_profiler(prof)
+    monkey_dir = str(tmp_path)
+    os.environ["TDTPU_FLIGHT_DIR"] = monkey_dir
+    try:
+        se = ServingEngine(served, max_batch=2, num_pages=8,
+                           prefill_chunk=4,
+                           slo_cfg=SLOConfig(tokens_per_s_min=1e12),
+                           clock=CounterClock())
+        se.submit(list(range(1, 8)), 3, req_id="fd-0")
+        se.run()
+        dumps = obs_flight.find_dumps(monkey_dir)
+    finally:
+        os.environ.pop("TDTPU_FLIGHT_DIR", None)
+        stepprof.set_profiler(prev)
+        obs_metrics.set_registry(prior)
+    assert dumps
+    data = obs_flight.load_dump(dumps[0])
+    phased = [r for r in data["iterations"] if "phases" in r]
+    assert phased, "flight records carry no phase vectors"
+    for rec in phased:
+        assert stepprof.check_partition(rec) is None
+        assert rec["host_ms_cum"] >= rec["host_ms"]
+        assert "device_ms_cum" in rec
+    rendered = obs_postmortem.render(data, dumps[0])
+    assert "step phases (ms; bubble = host/wall):" in rendered
+    assert "cumulative: host" in rendered
+    assert obs_report.main([str(tmp_path), "--check", "--require-series",
+                            "", "--allow-missing-step-profile"]) == 0
+
+
+def test_report_check_gates_steps_lane_and_partition(tmp_path):
+    """A serving-tier snapshot without ``steps.spans.json`` fails
+    --check (host-bubble attribution lost); the opt-out or the lane
+    passes it; a flight dump whose phase vector breaks the partition
+    invariant fails --check even with the lane present."""
+    from triton_distributed_tpu.obs.reqtrace import ReqTracer
+
+    reg = obs_metrics.Registry()
+    reg.counter(obs_metrics.SERVE_FINISHED, "x").inc(1)
+    reg.gauge(obs_metrics.KV_PAGES_RESIDENT, "x").set(4)
+    reg.save(str(tmp_path))
+    rt = ReqTracer()
+    rt.arrival("r-0", 0.0)
+    rt.save(str(tmp_path / "requests.spans.json"))
+    args = [str(tmp_path), "--check", "--require-series", ""]
+    assert obs_report.main(args) == 1
+    assert obs_report.main(args + ["--allow-missing-step-profile"]) == 0
+    sp = StepProfiler()
+    sp.begin_iteration(0, 1.0)
+    sp.finish_iteration(1.5)
+    sp.save(str(tmp_path / "steps.spans.json"))
+    assert obs_report.main(args) == 0
+    # Now a flight dump with a broken phase vector: Σ phases != wall.
+    rec = obs_flight.FlightRecorder(capacity=4, run_dir=str(tmp_path))
+    rec.record({"iter": 0, "wall_ms": 10.0,
+                "phases": {"admit": 1.0, "other": 2.0},
+                "host_bubble_frac": 0.3})
+    rec.dump("slo_violation", "synthetic partition break", 1)
+    assert obs_report.main(args) == 1
+
+
+# ---------------------------------------------------------------------------
+# Satellite 1: deterministic SLO watchdog under the injected clock.
+# ---------------------------------------------------------------------------
+
+def test_check_serving_stamps_injected_clock_not_wall_time():
+    from triton_distributed_tpu.obs import slo as obs_slo
+
+    reg = obs_metrics.Registry()
+    reg.gauge("tdtpu_serve_tokens_per_s", "x").set(5.0)
+    clock = CounterClock(step=0.25)
+    s1 = obs_slo.check_serving(reg, cfg=obs_slo.SLOConfig(), clock=clock)
+    s2 = obs_slo.check_serving(reg, cfg=obs_slo.SLOConfig(), clock=clock)
+    assert (s1["t"], s2["t"]) == (0.25, 0.5)
+    # Without a clock the section carries NO stamp (never wall time).
+    s3 = obs_slo.check_serving(reg, cfg=obs_slo.SLOConfig())
+    assert "t" not in s3
+
+
+def test_rolling_rate_deterministic_under_injected_clock(served):
+    """Two identically-seeded serving runs under CounterClock publish
+    the SAME rolling tokens/s gauge — the window math reads only the
+    injected clock."""
+    def one_run():
+        prior = obs_metrics.set_registry(obs_metrics.Registry())
+        try:
+            se = ServingEngine(served, max_batch=2, num_pages=16,
+                               prefill_chunk=4, clock=CounterClock())
+            se.submit(list(range(1, 6)), 3, req_id="rr-0")
+            se.run()
+            return se._rolling_rate()
+        finally:
+            obs_metrics.set_registry(prior)
+
+    r1, r2 = one_run(), one_run()
+    assert r1 == r2 and r1 > 0

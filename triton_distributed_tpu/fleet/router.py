@@ -469,10 +469,14 @@ class FleetRouter:
             self._merge_counter(reg, m.FLEET_AUTOSCALE_SHRINKS,
                                 "autoscaler deactivations",
                                 self.autoscaler.shrinks)
+        bubbles = []
         for rid in sorted(self.replicas):
             rep = self.replicas[rid]
             if rep.registry is None:
                 continue
+            g = rep.registry.get(m.SERVE_HOST_BUBBLE_FRAC)
+            if g is not None:
+                bubbles.append(g.value)
             for key in rep.registry.names():
                 metric = rep.registry.get(key)
                 labels = {**(metric.labels or {}), "replica": rid} \
@@ -484,3 +488,12 @@ class FleetRouter:
                 elif isinstance(metric, m.Gauge):
                     reg.gauge(metric.name, metric.help,
                               labels=labels).set(metric.value)
+        if bubbles:
+            # Fleet-level host-bubble rollup (ISSUE 18): the unlabeled
+            # family head is the mean across replicas' latest
+            # iterations; the per-replica truth rides the labeled
+            # variants the loop above just merged.
+            reg.gauge(m.SERVE_HOST_BUBBLE_FRAC,
+                      "host milliseconds not overlapped with the device "
+                      "/ iteration wall (fleet mean across replicas)"
+                      ).set(round(sum(bubbles) / len(bubbles), 6))

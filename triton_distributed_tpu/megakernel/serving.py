@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from triton_distributed_tpu.layers.common import rms_norm
+from triton_distributed_tpu.obs import stepprof as obs_stepprof
 from triton_distributed_tpu.obs import trace as obs_trace
 from triton_distributed_tpu.megakernel.models import (
     DecodeStepProgram, advance_queue_pos, broadcast_rows, build_decode_step,
@@ -797,7 +798,12 @@ class PagedMegakernelDecoder:
         tokens, column j the greedy next-token after consuming the
         window prefix 0..j (feed models/sampling.accept_longest_prefix).
         """
-        queue = self._retarget(kv_lens, tables, wins)
+        # The host queue-word / page-table rewrite gets its own
+        # step-phase slice (ISSUE 18): under the serving loop it runs
+        # nested inside the ``decode_dispatch`` phase and telescopes out
+        # — the first number the megakernel's retarget cost shows up in.
+        with obs_stepprof.phase("retarget"):
+            queue = self._retarget(kv_lens, tables, wins)
         if self.spec_w == 1:
             tabs = [self._rope(int(kv_lens[b]))
                     for b in range(self.num_slots)]

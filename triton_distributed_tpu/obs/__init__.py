@@ -24,12 +24,14 @@ from __future__ import annotations
 
 import os
 
-from triton_distributed_tpu.obs import metrics, reqtrace, trace  # noqa: F401
+from triton_distributed_tpu.obs import (  # noqa: F401
+    metrics, reqtrace, stepprof, trace,
+)
 from triton_distributed_tpu.obs.metrics import Registry
 from triton_distributed_tpu.obs.trace import Tracer
 
-__all__ = ["trace", "metrics", "reqtrace", "start_run", "finish_run",
-           "active_run_dir", "run_from_env"]
+__all__ = ["trace", "metrics", "reqtrace", "stepprof", "start_run",
+           "finish_run", "active_run_dir", "run_from_env"]
 
 # Enforcement tier (ISSUE 4) — imported lazily by name to keep package
 # import light: obs.history (bench ledger), obs.gate (cross-round
@@ -57,6 +59,7 @@ def start_run(run_dir: str, *, sync: bool = False) -> Tracer:
     _RUN_DIR = run_dir
     metrics.set_registry(Registry())
     reqtrace.enable(run_dir)
+    stepprof.enable(run_dir)
     return trace.enable(run_dir, sync=sync)
 
 
@@ -67,10 +70,24 @@ def finish_run() -> str | None:
     global _RUN_DIR
     t = trace.disable()
     rt = reqtrace.disable()
+    sp = stepprof.get_profiler()
+    stepprof.disable()
     run_dir = _RUN_DIR
     _RUN_DIR = None
     if t is None or run_dir is None:
         return None
+    if sp is not None and sp.has_records():
+        # Step-phase lane (ISSUE 18): written only when serving
+        # iterations actually ran under this run, mirroring the
+        # request lane's contract below.
+        try:
+            sp.save(os.path.join(run_dir, "steps.spans.json"))
+        except Exception as e:
+            import warnings
+
+            warnings.warn(
+                f"step-phase lane skipped: {type(e).__name__}: {e}",
+                RuntimeWarning, stacklevel=2)
     if rt is not None and rt.has_events():
         # Request-timeline lane (ISSUE 13): written only when the run
         # actually served requests, so non-serving runs don't grow an

@@ -149,6 +149,16 @@ METRICS: tuple[MetricSpec, ...] = (
                "identically, same window; near-linear is the router's "
                "contract)",
                "×", "higher", "serving"),
+    MetricSpec("serve_host_bubble_frac",
+               "host-bubble fraction of serving iteration wall (step "
+               "profiler: host-attributed phase ms / wall ms over the "
+               "measured replay — the synchronous-loop overhead the "
+               "async loop must kill)",
+               "", "lower", "serving"),
+    MetricSpec("serve_step_host_ms_p99",
+               "serving iteration host-attributed milliseconds p99 "
+               "(step profiler, same window)",
+               " ms", "lower", "serving"),
 )
 
 METRIC_BY_KEY = {m.key: m for m in METRICS}

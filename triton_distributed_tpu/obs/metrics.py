@@ -108,6 +108,23 @@ SERVING_SERIES = (SERVE_TTFT_MS, SERVE_TPOT_MS, SERVE_TTFT_QUEUE_MS,
                   PREFIX_PAGES_SHARED, PREFIX_TOKENS_SAVED,
                   PREFIX_HIT_RATE, SERVE_TOKENS_PER_S)
 
+# Step-phase profiler lane (ISSUE 18, obs/stepprof.py): per-iteration
+# host-bubble attribution. The bubble gauge is host milliseconds not
+# overlapped with the device over iteration wall — the number the async
+# double-buffered loop (ROADMAP item 3) must drive down. Per-phase
+# histograms are one family member per phase name
+# (``tdtpu_serve_phase_ms_<phase>``: the registry's histogram type has
+# no label axis, and the fleet router's per-replica merge covers gauges
+# — the bubble gauge therefore carries the ``replica=`` label for
+# free). Published by serving/loop.py after each finished iteration.
+SERVE_HOST_BUBBLE_FRAC = "tdtpu_serve_host_bubble_frac"
+SERVE_STEP_HOST_MS = "tdtpu_serve_step_host_ms"
+SERVE_STEP_DEVICE_MS = "tdtpu_serve_step_device_ms"
+SERVE_PHASE_MS_PREFIX = "tdtpu_serve_phase_ms"
+
+STEPPROF_SERIES = (SERVE_HOST_BUBBLE_FRAC, SERVE_STEP_HOST_MS,
+                   SERVE_STEP_DEVICE_MS)
+
 # KV-migration lane (disaggregated prefill/decode tier, docs/disagg.md):
 # published by disagg/migrate.py + disagg/engine.py, rendered as
 # obs.report's migration section. A migration spans queueing + every

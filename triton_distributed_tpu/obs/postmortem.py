@@ -82,6 +82,37 @@ def render(data: dict, path: str) -> str:
             f"{_s(rec.get('admit_cap')):>4} "
             f"{_s(rec.get('backend'))}"
             + (" [evacuated]" if rec.get("evacuated") else ""))
+    # Step-phase table (ISSUE 18, obs/stepprof.py): rendered whenever
+    # the ring's records carry a phase vector — per-iteration wall /
+    # host / device milliseconds, the bubble fraction, and the top
+    # phases, plus the cumulative host/device counters at the dump.
+    phased = [r for r in shown if isinstance(r, dict)
+              and isinstance(r.get("phases"), dict)]
+    if phased:
+        lines.append("")
+        lines.append("step phases (ms; bubble = host/wall):")
+        lines.append(f"  {'iter':>6} {'wall':>9} {'host':>9} "
+                     f"{'devc':>9} {'bub%':>5}  top phases")
+        for rec in phased:
+            fm = lambda v: (f"{v:9.3f}"  # noqa: E731
+                            if isinstance(v, (int, float)) else f"{'—':>9}")
+            bub = rec.get("host_bubble_frac")
+            bub_s = (f"{bub * 100:5.1f}"
+                     if isinstance(bub, (int, float)) else f"{'—':>5}")
+            top = sorted(
+                ((p, v) for p, v in rec["phases"].items()
+                 if isinstance(v, (int, float)) and v > 0),
+                key=lambda kv: -kv[1])[:3]
+            top_s = " ".join(f"{p}={v:.3f}" for p, v in top)
+            lines.append(
+                f"  {_s(rec.get('iter')):>6} {fm(rec.get('wall_ms'))} "
+                f"{fm(rec.get('host_ms'))} {fm(rec.get('device_ms'))} "
+                f"{bub_s}  {top_s}")
+        last = phased[-1]
+        if isinstance(last.get("host_ms_cum"), (int, float)):
+            lines.append(
+                f"  cumulative: host {last['host_ms_cum']:.3f} ms, "
+                f"device {last.get('device_ms_cum', 0):.3f} ms")
     reqs = data.get("requests") or []
     if reqs:
         lines.append("")

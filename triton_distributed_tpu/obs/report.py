@@ -235,9 +235,16 @@ def merge_run(run_dir: str) -> tuple[dict, dict]:
     req_files = glob.glob(os.path.join(run_dir, "**",
                                        "requests.spans.json"),
                           recursive=True)
+    # Step-phase timelines (ISSUE 18, obs/stepprof.py) likewise: merged
+    # through the glob above, gated as their own lane — a serving run
+    # without per-iteration phase attribution lost the host-bubble
+    # evidence ROADMAP item 3's async loop is judged against.
+    step_files = glob.glob(os.path.join(run_dir, "**",
+                                        "steps.spans.json"),
+                           recursive=True)
     lanes = {"host": bool(span_ev), "commlint": bool(cl_ev),
              "kernel": bool(kp_ev), "device": bool(dev_ev),
-             "request": bool(req_files),
+             "request": bool(req_files), "steps": bool(step_files),
              "kernel_summaries": kp_summaries}
     return trace, lanes
 
@@ -343,6 +350,12 @@ def summarize(run_dir: str, lanes: dict, metrics: dict | None,
     if serving:
         lines.append("")
         lines += serving
+    step_sec = step_profile_lane(
+        metrics, load_flight_dumps(run_dir) if flight_dumps is None
+        else flight_dumps)
+    if step_sec:
+        lines.append("")
+        lines += step_sec
     flight_sec = flight_section(
         load_flight_dumps(run_dir) if flight_dumps is None
         else flight_dumps)
@@ -394,6 +407,71 @@ def serving_lane(metrics: dict | None) -> list[str]:
         else:
             lines.append(f"  {name} = {m['value']:g}")
     return lines
+
+
+def step_profile_lane(metrics: dict | None,
+                      flight_dumps: list[tuple]) -> list[str]:
+    """The step-profile summary (docs/observability.md "Step profiling
+    & host bubble"): the bubble gauge + host/device step histograms
+    from the snapshot, and per-phase means aggregated across every
+    flight-dump iteration record that carries a phase vector."""
+    from triton_distributed_tpu.obs import metrics as obs_metrics
+    from triton_distributed_tpu.obs import stepprof as stepprof_mod
+
+    lines: list[str] = []
+    fmt = lambda x: f"{x:.3f}" if isinstance(x, (int, float)) else "—"  # noqa: E731
+    for name in obs_metrics.STEPPROF_SERIES:
+        m = (metrics or {}).get(name)
+        if m is None:
+            continue
+        if m["type"] == "histogram":
+            lines.append(f"  {name}: n={m['count']} "
+                         f"p50={fmt(m.get('p50'))} p99={fmt(m.get('p99'))}")
+        else:
+            lines.append(f"  {name} = {m['value']:g}")
+    totals: dict[str, float] = {}
+    counts: dict[str, int] = {}
+    n_recs = 0
+    for _, data, _err in flight_dumps:
+        for rec in (data or {}).get("iterations") or []:
+            phases = rec.get("phases") if isinstance(rec, dict) else None
+            if not isinstance(phases, dict):
+                continue
+            n_recs += 1
+            for ph, ms in phases.items():
+                if isinstance(ms, (int, float)):
+                    totals[ph] = totals.get(ph, 0.0) + ms
+                    counts[ph] = counts.get(ph, 0) + 1
+    if n_recs:
+        lines.append(f"  phase means over {n_recs} flight-ring "
+                     "iteration(s), ms:")
+        order = {p: i for i, p in enumerate(stepprof_mod.PHASES)}
+        for ph in sorted(totals, key=lambda p: order.get(p, 99)):
+            lines.append(f"    {ph:16s} {totals[ph] / counts[ph]:10.3f}")
+    if not lines:
+        return []
+    return ["step profile (obs/stepprof.py — host-bubble "
+            "attribution):"] + lines
+
+
+def step_profile_problems(flight_dumps: list[tuple]) -> list[str]:
+    """Partition-invariant violations (Σ phases == iteration wall, the
+    PR-12 decomposition discipline) across every flight-dump iteration
+    record carrying a phase vector — what --check gates."""
+    from triton_distributed_tpu.obs import stepprof as stepprof_mod
+
+    problems: list[str] = []
+    for p, data, _err in flight_dumps:
+        for rec in (data or {}).get("iterations") or []:
+            if not isinstance(rec, dict) or "phases" not in rec:
+                continue
+            msg = stepprof_mod.check_partition(rec)
+            if msg is not None:
+                problems.append(f"{os.path.basename(p)}: {msg}")
+            if len(problems) > 20:
+                problems.append("... (truncated)")
+                return problems
+    return problems
 
 
 def load_flight_dumps(run_dir: str) -> list[tuple]:
@@ -784,6 +862,12 @@ def main(argv: list[str] | None = None) -> int:
                          "(requests.spans.json) — by default a serving "
                          "run that lost its request traces fails "
                          "--check (pre-ISSUE-13 run dirs)")
+    ap.add_argument("--allow-missing-step-profile", action="store_true",
+                    help="accept a serving-tier snapshot without the "
+                         "step-phase lane (steps.spans.json) — by "
+                         "default a serving run that lost its "
+                         "per-iteration phase attribution fails --check "
+                         "(pre-ISSUE-18 run dirs)")
     ap.add_argument("--allow-page-audit-violations", action="store_true",
                     help="report page-audit (refcount/COW sanitizer) "
                          "violations without failing --check — by "
@@ -917,6 +1001,18 @@ def main(argv: list[str] | None = None) -> int:
             "serving series present but the request-timeline lane "
             "(requests.spans.json) is missing — per-request evidence "
             "lost (--allow-missing-request-lane to accept)")
+    # Step-profile lane (ISSUE 18): a serving snapshot without the
+    # per-iteration phase lane lost the host-bubble attribution; and
+    # every phase vector in the flight dumps must satisfy the partition
+    # invariant (Σ phases == iteration wall).
+    if (serving_present and not lanes.get("steps")
+            and not args.allow_missing_step_profile):
+        failures.append(
+            "serving series present but the step-phase lane "
+            "(steps.spans.json) is missing — host-bubble attribution "
+            "lost (--allow-missing-step-profile to accept)")
+    failures += [f"step profile: {p}" for p in
+                 step_profile_problems(flight_dumps)]
     failures += [f"flight dump: {p}" for p in
                  flight_problems(flight_dumps)]
     demotions = degradation_count(metrics)

@@ -189,13 +189,22 @@ def evaluate(observed: dict[str, float | None],
 
 def check_serving(reg: obs_metrics.Registry | None = None,
                   run_dir: str | None = None,
-                  cfg: SLOConfig | None = None) -> dict[str, Any]:
+                  cfg: SLOConfig | None = None,
+                  clock=None) -> dict[str, Any]:
     """The live watchdog step (Engine.serve calls this after each serve
     under an active run): evaluate, emit one ``slo.violation`` span per
-    violated rule into the host trace, and bump the violation counters."""
+    violated rule into the host trace, and bump the violation counters.
+
+    ``clock`` (ISSUE 18 satellite): the serving loop threads its
+    injectable clock through so the section's evidence stamp is
+    byte-deterministic under a fake clock — without it the section
+    carries no timestamp at all (never wall time), keeping chaos/dryrun
+    SLO rows pinnable either way."""
     reg = reg or obs_metrics.registry()
     cfg = cfg or SLOConfig.from_env()
     section = evaluate(observed_from_registry(reg, run_dir), cfg)
+    if clock is not None:
+        section["t"] = round(float(clock()), 6)
     for rule in section["rules"]:
         if rule["status"] != "violation":
             continue

@@ -1,0 +1,384 @@
+"""obs.stepprof — per-iteration step-phase profiler for the serving loop.
+
+ROADMAP item 3(ii) names the next latency lever: the serving loop is
+synchronous, so host scheduling, radix matching, COW guards, and
+megakernel table rewrites serialize with the device step. Before that
+bubble can be overlapped away it has to be *attributed* — per
+iteration, per phase, in the same deterministic ``--check``-gated shape
+as the rest of ``obs/``. This module is that measurement layer.
+
+The profiler keeps a **telescoping phase stack** per iteration:
+
+* ``begin_iteration(it, t, clock=..., replica=...)`` opens the window;
+* ``enter(phase, t)`` attributes the elapsed time since the previous
+  boundary to the phase currently on top of the stack (or ``other``
+  when the stack is empty) and pushes ``phase``;
+* ``exit(t)`` attributes to the popped phase;
+* ``finish_iteration(t)`` closes any dangling phases, attributes the
+  remainder to ``other``, and emits one record.
+
+Every segment between ``begin`` and ``finish`` lands in exactly one
+phase, so the **partition invariant** (Σ phases == iteration wall,
+same discipline as the PR-12 TTFT decomposition) holds by
+construction; ``obs.report --check`` re-verifies it on flight dumps.
+Nesting composes: the megakernel's queue-retarget rewrite runs inside
+the loop's ``decode_dispatch`` phase and telescopes out its own
+``retarget`` slice without double counting.
+
+All timestamps come from the serving loop's injectable ``clock=``
+(seconds), so records are **byte-deterministic under a fake clock** —
+the property every partition-invariant test pins. Spans export to a
+dedicated ``steps.spans.json`` (Chrome trace format, own pid lane)
+rather than through obs/trace.py's tracer, whose internal
+``perf_counter_ns`` timestamps live in a different clock domain; the
+existing ``*.spans.json`` merge in ``obs.report`` folds both into one
+Perfetto view.
+
+Phase taxonomy (docs/observability.md "Step profiling & host bubble"):
+
+===============  =====  ==================================================
+phase            kind   covers
+===============  =====  ==================================================
+preflight        host   fleet health preflight + backend resync/evacuation
+admit            host   admission scheduling + radix prefix match
+prefill          dev    chunked prefill-slice dispatch + wait
+migrate          dev    disagg migration advance (DCN hops)
+draft            host   speculative-draft planning
+pages            host   decode page ensure / preemption decisions
+cow              host   copy-on-write guard on shared appends
+decode_dispatch  host   host-side decode build + launch submit
+retarget         host   megakernel queue-word / page-table rewrite
+device_wait      dev    the ``block_until_ready`` boundary
+accounting       host   post-step counters, flight record, SLO tick
+other            host   unattributed remainder inside the iteration
+===============  =====  ==================================================
+
+A synchronous loop cannot split host-vs-device *within* a
+device-involving phase (``prefill``/``migrate``/``device_wait`` include
+the host time spent blocked); the host/device rollup is therefore a
+conservative upper bound on the device share and an exact lower bound
+on the addressable host bubble — which is the number the async
+double-buffered loop (ROADMAP item 3) will be judged against.
+
+Like the request tracer, recording costs one module-global load plus a
+``None`` check when disabled.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+from typing import Any, Callable
+
+# Chrome-trace process id for the step-phase lane. Host tracer owns
+# 90_001, request lanes own 91_001; step phases get their own process
+# so Perfetto groups them side by side, not interleaved.
+STEP_PID = 93_001
+
+# Phase names the serving stack emits, in taxonomy order (render order
+# for postmortem tables and the report lane).
+PHASES = ("preflight", "admit", "prefill", "migrate", "draft", "pages",
+          "cow", "decode_dispatch", "retarget", "device_wait",
+          "accounting", "other")
+
+# Phases whose wall time is dominated by the device (the loop is
+# blocked on completion, not doing host work). Everything else is
+# host-side planning/bookkeeping — the bubble.
+DEVICE_PHASES = frozenset({"prefill", "migrate", "device_wait"})
+
+OTHER = "other"
+
+
+def _ms(seconds: float) -> float:
+    """Milliseconds rounded for byte-stable JSON under fake clocks."""
+    return round(seconds * 1e3, 6)
+
+
+class StepProfiler:
+    """Bounded per-iteration phase records + Chrome span export.
+
+    One profiler serves every engine in the process (fleet replicas
+    included): iterations are single-threaded per engine and the
+    serving tier steps replicas sequentially, so one active-iteration
+    slot suffices; records carry ``replica`` and cumulative
+    host/device counters are kept per replica.
+    """
+
+    def __init__(self, run_dir: str | None = None, capacity: int = 4096):
+        self.run_dir = run_dir
+        self.capacity = capacity
+        self._records: deque[dict[str, Any]] = deque(maxlen=capacity)
+        # Wall-clock rebase for the Perfetto merge, same recipe as
+        # obs/reqtrace.py: caller clocks are perf_counter-like seconds.
+        self._epoch_s = time.perf_counter()
+        self._wall_epoch_us = time.time_ns() / 1e3
+        self._tids: dict[str, int] = {}
+        # Per-replica cumulative host/device milliseconds (flight dumps
+        # carry these alongside page_events — satellite 2).
+        self._cum: dict[str, list[float]] = {}
+        # Active-iteration state.
+        self._it: int | None = None
+        self._t_begin: float | None = None
+        self._t_last: float | None = None
+        self._stack: list[str] = []
+        self._acc: dict[str, float] = {}
+        self._segs: list[tuple[str, float, float]] = []
+        self._replica: str | None = None
+        self.clock: Callable[[], float] = time.perf_counter
+
+    # -- lifecycle ----------------------------------------------------
+
+    def active(self) -> bool:
+        return self._t_begin is not None
+
+    def begin_iteration(self, it: int, t: float, *,
+                        clock: Callable[[], float] | None = None,
+                        replica: str | None = None) -> None:
+        if self._t_begin is not None:
+            # A crashed iteration never reached finish — close it so
+            # the ring stays a partition per record, not across them.
+            self.finish_iteration(t, aborted=True)
+        self._it = int(it)
+        self._t_begin = self._t_last = float(t)
+        self._stack = []
+        self._acc = {}
+        self._segs = []
+        self._replica = replica
+        if clock is not None:
+            self.clock = clock
+
+    def _attribute(self, t: float, phase: str) -> None:
+        dt = float(t) - self._t_last
+        if dt > 0:
+            self._acc[phase] = self._acc.get(phase, 0.0) + dt
+            self._segs.append((phase, self._t_last, float(t)))
+        self._t_last = float(t)
+
+    def enter(self, phase: str, t: float) -> None:
+        if self._t_begin is None:
+            return
+        self._attribute(t, self._stack[-1] if self._stack else OTHER)
+        self._stack.append(phase)
+
+    def exit(self, t: float) -> None:
+        if self._t_begin is None or not self._stack:
+            return
+        self._attribute(t, self._stack.pop())
+
+    def finish_iteration(self, t: float, **extra: Any) -> dict[str, Any]:
+        """Close the window; returns (and stores) the phase record."""
+        if self._t_begin is None:
+            return {}
+        while self._stack:          # exceptions may skip exits
+            self._attribute(t, self._stack.pop())
+        self._attribute(t, OTHER)
+        wall_ms = _ms(float(t) - self._t_begin)
+        phases = {p: _ms(self._acc[p]) for p in PHASES if p in self._acc}
+        # Taxonomy drift (an instrumentation site inventing a phase)
+        # must not silently vanish from the partition.
+        for p in sorted(self._acc):
+            if p not in phases:
+                phases[p] = _ms(self._acc[p])
+        host_ms = _ms(sum(self._acc.get(p, 0.0) for p in self._acc
+                          if p not in DEVICE_PHASES))
+        device_ms = _ms(sum(self._acc.get(p, 0.0) for p in self._acc
+                            if p in DEVICE_PHASES))
+        bubble = round(host_ms / wall_ms, 6) if wall_ms > 0 else 0.0
+        rkey = self._replica if self._replica is not None else ""
+        cum = self._cum.setdefault(rkey, [0.0, 0.0])
+        cum[0] = round(cum[0] + host_ms, 6)
+        cum[1] = round(cum[1] + device_ms, 6)
+        rec: dict[str, Any] = {
+            "it": self._it,
+            "t0": round(self._t_begin, 6),
+            "wall_ms": wall_ms,
+            "phases": phases,
+            "host_ms": host_ms,
+            "device_ms": device_ms,
+            "host_bubble_frac": bubble,
+            "host_ms_cum": cum[0],
+            "device_ms_cum": cum[1],
+        }
+        if self._replica is not None:
+            rec["replica"] = self._replica
+        if extra:
+            rec.update(extra)
+        rec["_segs"] = self._segs
+        self._records.append(rec)
+        self._it = None
+        self._t_begin = self._t_last = None
+        self._stack = []
+        self._acc = {}
+        self._segs = []
+        return rec
+
+    # -- queries ------------------------------------------------------
+
+    def records(self) -> list[dict[str, Any]]:
+        """Phase records, span segments stripped (JSON/fight-ring shape)."""
+        return [{k: v for k, v in r.items() if k != "_segs"}
+                for r in self._records]
+
+    def has_records(self) -> bool:
+        return bool(self._records)
+
+    def cumulative(self, replica: str | None = None) -> tuple[float, float]:
+        """(host_ms, device_ms) accumulated for one replica lane."""
+        cum = self._cum.get(replica if replica is not None else "")
+        return (cum[0], cum[1]) if cum else (0.0, 0.0)
+
+    # -- span export --------------------------------------------------
+
+    def _ts_us(self, t: float) -> float:
+        return self._wall_epoch_us + (t - self._epoch_s) * 1e6
+
+    def _tid(self, replica: str | None) -> int:
+        key = replica if replica is not None else ""
+        if key not in self._tids:
+            self._tids[key] = len(self._tids) + 1
+        return self._tids[key]
+
+    def to_chrome(self) -> dict[str, Any]:
+        events: list[dict[str, Any]] = [{
+            "name": "process_name", "ph": "M", "pid": STEP_PID, "tid": 0,
+            "args": {"name": "serving step phases"},
+        }]
+        emitted_threads: set[int] = set()
+        for rec in self._records:
+            tid = self._tid(rec.get("replica"))
+            if tid not in emitted_threads:
+                emitted_threads.add(tid)
+                label = rec.get("replica") or "steps"
+                events.append({
+                    "name": "thread_name", "ph": "M", "pid": STEP_PID,
+                    "tid": tid, "args": {"name": f"step-phases/{label}"}})
+            t0 = rec["t0"]
+            events.append({
+                "name": f"step[{rec['it']}]", "ph": "X", "cat": "step",
+                "pid": STEP_PID, "tid": tid, "ts": self._ts_us(t0),
+                "dur": max(rec["wall_ms"] * 1e3, 0.001),
+                "args": {"host_ms": rec["host_ms"],
+                         "device_ms": rec["device_ms"],
+                         "host_bubble_frac": rec["host_bubble_frac"]},
+            })
+            for phase, s0, s1 in rec.get("_segs", ()):
+                events.append({
+                    "name": phase, "ph": "X", "cat": "step-phase",
+                    "pid": STEP_PID, "tid": tid, "ts": self._ts_us(s0),
+                    "dur": max((s1 - s0) * 1e6, 0.001),
+                    "args": {"it": rec["it"]},
+                })
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def save(self, path: str | None = None) -> str:
+        """Write ``steps.spans.json`` (fixed stem: the report's
+        ``*.spans.json`` glob merges it into the Perfetto view)."""
+        if path is None:
+            base = self.run_dir or "."
+            os.makedirs(base, exist_ok=True)
+            path = os.path.join(base, "steps.spans.json")
+        with open(path, "w") as f:
+            json.dump(self.to_chrome(), f)
+        return path
+
+
+# -- module-global switchboard (mirrors obs/reqtrace.py) ---------------
+
+_PROFILER: StepProfiler | None = None
+
+
+def enable(run_dir: str | None = None,
+           capacity: int = 4096) -> StepProfiler:
+    global _PROFILER
+    _PROFILER = StepProfiler(run_dir=run_dir, capacity=capacity)
+    return _PROFILER
+
+
+def disable() -> None:
+    global _PROFILER
+    _PROFILER = None
+
+
+def get_profiler() -> StepProfiler | None:
+    return _PROFILER
+
+
+def set_profiler(p: StepProfiler | None) -> StepProfiler | None:
+    """Swap the active profiler, returning the previous one (bench
+    rungs profile a replay without clobbering an enclosing run)."""
+    global _PROFILER
+    prev, _PROFILER = _PROFILER, p
+    return prev
+
+
+def is_enabled() -> bool:
+    return _PROFILER is not None
+
+
+class _PhaseScope:
+    """Reusable stateless `with` scope for one phase name. These sit on
+    the serving hot path for EVERY iteration even when profiling is
+    off, so the inactive path must cost only a global load + two
+    attribute checks — no generator frame, no per-call allocation
+    (scopes are cached per name). The enter/exit guards are evaluated
+    independently, so a window opening or closing mid-scope degrades to
+    a no-op on the missing side instead of corrupting the stack."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self) -> None:
+        sp = _PROFILER
+        if sp is not None and sp._t_begin is not None:
+            sp.enter(self.name, sp.clock())
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        sp = _PROFILER
+        if sp is not None and sp._t_begin is not None:
+            sp.exit(sp.clock())
+        return False
+
+
+_PHASE_SCOPES: dict[str, _PhaseScope] = {}
+
+
+def phase(name: str) -> _PhaseScope:
+    """Scoped phase on the active iteration; no-op when profiling is
+    off or no iteration is open. Uses the profiler-carried clock so
+    nested instrumentation sites — the megakernel retarget runs under
+    serving/loop.py's iteration — stay in the loop's injected clock
+    domain."""
+    scope = _PHASE_SCOPES.get(name)
+    if scope is None:
+        scope = _PHASE_SCOPES[name] = _PhaseScope(name)
+    return scope
+
+
+def check_partition(rec: dict[str, Any],
+                    tol_ms: float = 1e-3) -> str | None:
+    """Verify Σ phases == wall on one phase record; returns a problem
+    string or None. Shared by obs.report --check, loadgen phase 12,
+    and the partition-invariant tests so the contract cannot drift."""
+    phases = rec.get("phases")
+    if not isinstance(phases, dict):
+        return "phase record missing 'phases' dict"
+    wall = rec.get("wall_ms")
+    if not isinstance(wall, (int, float)):
+        return "phase record missing 'wall_ms'"
+    total = 0.0
+    for k, v in phases.items():
+        if not isinstance(v, (int, float)) or v < 0:
+            return f"phase {k!r} has non-numeric/negative value {v!r}"
+        total += v
+    if abs(total - wall) > max(tol_ms, 1e-6 * wall):
+        return (f"partition invariant broken: sum(phases)={total:.6f}ms "
+                f"!= wall_ms={wall:.6f}ms (iter {rec.get('it')})")
+    frac = rec.get("host_bubble_frac")
+    if frac is not None and not (isinstance(frac, (int, float))
+                                 and -1e-9 <= frac <= 1.0 + 1e-9):
+        return f"host_bubble_frac {frac!r} outside [0, 1]"
+    return None

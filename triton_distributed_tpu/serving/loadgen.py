@@ -35,6 +35,7 @@ import time
 import numpy as np
 
 from triton_distributed_tpu.obs import reqtrace as obs_reqtrace
+from triton_distributed_tpu.obs import stepprof as obs_stepprof
 from triton_distributed_tpu.serving.scheduler import AdmitResult
 
 
@@ -266,7 +267,11 @@ def dryrun(json_path: str | None, flight_dir: str | None = None) -> int:
     mid-serve replica kill drained onto siblings (parity kept) and
     re-admitted after the rejoin probe, and an autoscaler
     shrink-then-grow round trip — with one named page auditor per
-    replica."""
+    replica. Phase 12 (ISSUE 18) proves the step-phase profiler on
+    every tier in the sweep: per-iteration phase vectors that
+    PARTITION the iteration wall with a nonzero host-bubble fraction
+    (plus per-replica labels on the fleet), written to
+    ``step-profile.json`` beside the flight dumps."""
     import os
 
     from triton_distributed_tpu.runtime.utils import (
@@ -1309,6 +1314,97 @@ def dryrun(json_path: str | None, flight_dir: str | None = None) -> int:
                   "w") as f:
             json.dump(report["fleet_router"], f, indent=2, default=str)
 
+    # Phase 12 (ISSUE 18) — step-phase profiler: EVERY serving tier in
+    # the sweep (xla, megakernel, disagg, fleet router) must produce
+    # per-iteration phase records whose named phases PARTITION the
+    # iteration wall (stepprof.check_partition under the loop's own
+    # clock) with a nonzero host-bubble fraction; the fleet's records
+    # must carry >= 2 replica labels. The summary lands in
+    # step-profile.json next to the flight dumps for CI's artifact.
+    step_profile: dict[str, dict] = {}
+
+    def _profiled_replay(name: str, se_, trace_):
+        prof12 = obs_stepprof.StepProfiler()
+        prev12 = obs_stepprof.set_profiler(prof12)
+        try:
+            run_trace(se_, [dict(t) for t in trace_])
+        finally:
+            obs_stepprof.set_profiler(prev12)
+        recs12 = prof12.records()
+        if not recs12:
+            failures.append(f"phase 12: {name} produced no step-phase "
+                            "records — the profiler hook regressed")
+            step_profile[name] = {"iterations": 0, "invariant_ok": False}
+            return recs12
+        bad12 = []
+        for r in recs12:
+            prob = obs_stepprof.check_partition(r)
+            if prob is not None:
+                bad12.append(f"iter {r['it']}: {prob}")
+        if bad12:
+            failures.append(
+                f"phase 12: {name} phase vectors do not partition the "
+                f"iteration wall: {bad12[:4]}")
+        wall12 = sum(r["wall_ms"] for r in recs12)
+        host12 = sum(r["host_ms"] for r in recs12)
+        bubble12 = (host12 / wall12) if wall12 else 0.0
+        if not bubble12 > 0.0:
+            failures.append(
+                f"phase 12: {name} host-bubble fraction is zero over "
+                f"{len(recs12)} iterations — attribution lost")
+        step_profile[name] = {
+            "iterations": len(recs12),
+            "wall_ms": round(wall12, 3),
+            "host_ms": round(host12, 3),
+            "device_ms": round(sum(r["device_ms"] for r in recs12), 3),
+            "host_bubble_frac": round(bubble12, 4),
+            "invariant_ok": not bad12,
+            "phases_seen": sorted({p for r in recs12
+                                   for p, v in r["phases"].items()
+                                   if v > 0}),
+        }
+        return recs12
+
+    _, se12 = _tiny_serving(engine, max_batch=4, num_pages=8,
+                            prefill_chunk=4, max_waiting=8)
+    _profiled_replay("xla", se12, trace)
+    _audit("phase12-stepprof-xla", se12)
+    se12mk = ServingEngine(mk_engine, max_batch=2, num_pages=2,
+                           prefill_chunk=128)
+    mk12 = _profiled_replay("megakernel", se12mk, mk_trace)
+    if mk12 and not any(r["phases"].get("retarget", 0) > 0
+                        for r in mk12):
+        failures.append(
+            "phase 12: no megakernel iteration attributed time to the "
+            "queue-retarget phase — the persistent-lane slice regressed")
+    _audit("phase12-stepprof-megakernel", se12mk)
+    se12dg = DisaggServingEngine(dg_pe, dg_de, max_batch=2, num_pages=5,
+                                 prefill_chunk=4, block_pages=1)
+    dg12 = _profiled_replay("disagg", se12dg, dg_trace)
+    if dg12 and not any(r["phases"].get("migrate", 0) > 0 for r in dg12):
+        failures.append(
+            "phase 12: no disagg iteration attributed time to the "
+            "KV-migration-advance phase")
+    _audit("phase12-stepprof-disagg", se12dg)
+    router12 = _mk_fleet(2)
+    fl12 = _profiled_replay(
+        "fleet", router12,
+        build_trace(LoadSpec(n_requests=6, seed=12,
+                             mean_interarrival_iters=0.0)))
+    fl_reps = sorted({r.get("replica") for r in fl12} - {None})
+    if len(fl_reps) < 2:
+        failures.append(
+            f"phase 12: fleet step records carry replica labels "
+            f"{fl_reps} — per-replica attribution regressed")
+    step_profile.setdefault("fleet", {})["replicas"] = fl_reps
+    report["step_profile"] = step_profile
+    if flight_dir:
+        # Next to the flight dumps: CI's obs artifact carries the
+        # host-bubble evidence alongside the postmortem inputs.
+        with open(os.path.join(flight_dir, "step-profile.json"),
+                  "w") as f:
+            json.dump(step_profile, f, indent=2)
+
     if audit_prev is None:
         os.environ.pop("TDTPU_PAGE_AUDIT", None)
     else:
@@ -1418,7 +1514,17 @@ def serving_bench_rung(n_streams: int = 8, prompt_len: int = 128,
         return trace
 
     run_trace(se, make_trace(0))                           # warmup/compile
-    report = run_trace(se, make_trace(1))
+    # Step-phase profile of the MEASURED replay only (ISSUE 18): a
+    # private profiler swapped in around the second replay, so an
+    # enclosing obs run's profiler (if any) neither pollutes nor is
+    # polluted by the rung's phase records.
+    prof = obs_stepprof.StepProfiler()
+    prev_prof = obs_stepprof.set_profiler(prof)
+    try:
+        report = run_trace(se, make_trace(1))
+    finally:
+        obs_stepprof.set_profiler(prev_prof)
+    prof_recs = prof.records()
     reqs = report.pop("requests")
     out = {
         "serve_tokens_per_s_concurrent": report["tokens_per_s"],
@@ -1430,6 +1536,18 @@ def serving_bench_rung(n_streams: int = 8, prompt_len: int = 128,
                       "the serving tier's real cost, unlike the pure "
                       "decode-chain rungs",
     }
+    if prof_recs:
+        # Host-bubble rungs (ISSUE 18): the fraction of measured-replay
+        # iteration wall spent in host-attributed phases, and the p99
+        # per-iteration host milliseconds — the synchronous-loop
+        # overhead the ledger tracks downward.
+        wall = sum(r["wall_ms"] for r in prof_recs)
+        host = sum(r["host_ms"] for r in prof_recs)
+        out["serve_host_bubble_frac"] = (round(host / wall, 4)
+                                         if wall else None)
+        from triton_distributed_tpu.obs.metrics import percentile
+        out["serve_step_host_ms_p99"] = round(
+            percentile([r["host_ms"] for r in prof_recs], 99), 4)
     if spec_k > 0:
         drafted = sum(r.drafted_tokens for r in reqs)
         accepted = sum(r.accepted_draft_tokens for r in reqs)

@@ -59,6 +59,7 @@ from triton_distributed_tpu.models.kv_cache import (
 )
 from triton_distributed_tpu.obs import metrics as obs_metrics
 from triton_distributed_tpu.obs import reqtrace as obs_reqtrace
+from triton_distributed_tpu.obs import stepprof as obs_stepprof
 from triton_distributed_tpu.obs import trace as obs_trace
 from triton_distributed_tpu.serving.request import Request, RequestState
 from triton_distributed_tpu.serving.scheduler import (
@@ -777,25 +778,38 @@ class ServingEngine:
         now = self.clock()
         if self._t0 is None:
             self._t0 = now
-        fleet_event = self._fleet_preflight()
-        self._sync_backend()
+        sp = obs_stepprof.get_profiler()
+        if sp is not None:
+            # Step-phase timeline (ISSUE 18): the window opens on the
+            # loop's injected clock so records are byte-deterministic
+            # under a fake clock; every phase below telescopes into it.
+            sp.begin_iteration(self._iter, now, clock=self.clock,
+                               replica=self.replica_id)
         try:
-            summary = self._step_work(now)
-        except Exception as exc:
-            handled = self._fleet_on_failure(exc)
-            if not handled:
-                raise
-            self._iter += 1
-            fleet_event = fleet_event or handled
-            summary = {"iter": self._iter, "admitted": [],
-                       "prefilled": None, "preempted": [], "decoded": 0,
-                       "waiting": len(self.sched.waiting),
-                       "active": self.sched.active_count,
-                       "free_pages": self.sched.allocator.free_count,
-                       "admit_cap": self.sched.admit_cap}
-        if fleet_event:
-            summary["fleet"] = fleet_event
-        return summary
+            with obs_stepprof.phase("preflight"):
+                fleet_event = self._fleet_preflight()
+                self._sync_backend()
+            try:
+                summary = self._step_work(now)
+            except Exception as exc:
+                handled = self._fleet_on_failure(exc)
+                if not handled:
+                    raise
+                self._iter += 1
+                fleet_event = fleet_event or handled
+                summary = {"iter": self._iter, "admitted": [],
+                           "prefilled": None, "preempted": [], "decoded": 0,
+                           "waiting": len(self.sched.waiting),
+                           "active": self.sched.active_count,
+                           "free_pages": self.sched.allocator.free_count,
+                           "admit_cap": self.sched.admit_cap}
+            if fleet_event:
+                summary["fleet"] = fleet_event
+            return summary
+        finally:
+            if sp is not None and sp.active():
+                rec = sp.finish_iteration(self.clock())
+                self._step_profile_close(rec)
 
     def _sync_backend(self) -> None:
         # The demotion ladder (driven from _slo_tick below, or by the
@@ -852,22 +866,32 @@ class ServingEngine:
         # verify step (prefill-only, post-fallback, empty batch) record
         # zeros in the flight ring instead of the last launch's counts.
         self._last_spec = (0, 0)
-        admitted = self.sched.schedule_admissions()
-        head = self.sched.prefill_head()
+        with obs_stepprof.phase("admit"):
+            # Admission scheduling includes the radix prefix match on
+            # warm submits — host-side planning, all of it.
+            admitted = self.sched.schedule_admissions()
+            head = self.sched.prefill_head()
         prefilled = None
         if head is not None:
-            prefilled = self._prefill_slice(head)
+            with obs_stepprof.phase("prefill"):
+                prefilled = self._prefill_slice(head)
         # Disagg hook (docs/disagg.md): between the prefill slice and the
         # decode batch, the disaggregated tier advances its in-flight
         # KV-migration streams (one double-buffer rotation each) so the
         # DCN transfers ride under this iteration's decode step. The
         # monolithic tier has nothing to move.
-        self._advance_migrations()
+        with obs_stepprof.phase("migrate"):
+            self._advance_migrations()
         # Speculative drafting happens BEFORE page growth so the whole
         # candidate window's reservation rides the same growth pass
         # (preempted victims drop their drafts with their pages).
-        extra = self._plan_drafts() if self._spec_enabled() else None
-        ready, preempted = self.sched.ensure_decode_pages(extra=extra)
+        if self._spec_enabled():
+            with obs_stepprof.phase("draft"):
+                extra = self._plan_drafts()
+        else:
+            extra = None
+        with obs_stepprof.phase("pages"):
+            ready, preempted = self.sched.ensure_decode_pages(extra=extra)
         # Prefix COW guard (ISSUE 15): no append may target a page that
         # still carries other readers — replace with a private copy (or
         # preempt) BEFORE any launch writes the pools. Runs here, not
@@ -875,35 +899,37 @@ class ServingEngine:
         # accounting (counter, summary, flight record) and ``decoded``
         # reflects the batch that actually stepped.
         if ready:
-            ready, cow_evicted = self._cow_shared_appends(ready)
+            with obs_stepprof.phase("cow"):
+                ready, cow_evicted = self._cow_shared_appends(ready)
             preempted = list(preempted) + cow_evicted
         decoded = len(ready)
         if ready:
             if self.page_audit is not None:
                 self._audit_launch(ready)
             self._decode(ready)
-        if self.prefix is not None:
-            self.prefix.note_peak()
-        self._iter += 1
-        if self.page_audit is not None:
-            self._audit_iteration()
-        obs_on = self._observing()
-        if obs_on:
-            reg = self._reg()
-            if preempted:
-                reg.counter(obs_metrics.SERVE_PREEMPTIONS,
-                            "sequences evicted under page pressure "
-                            "(recompute-on-resume)").inc(len(preempted))
-            self._publish_gauges(reg)
-            self._flight_record_iteration(now, admitted, prefilled,
-                                          preempted, decoded)
-        self._slo_tick()
-        if self.fleet is not None:
-            # Clean iteration: soft suspicion decays (flap damping) and
-            # the rejoin streak advances while evacuated.
-            self.fleet.observe_clean()
-            if self.evacuated:
-                self._clean_since_evac += 1
+        with obs_stepprof.phase("accounting"):
+            if self.prefix is not None:
+                self.prefix.note_peak()
+            self._iter += 1
+            if self.page_audit is not None:
+                self._audit_iteration()
+            obs_on = self._observing()
+            if obs_on:
+                reg = self._reg()
+                if preempted:
+                    reg.counter(obs_metrics.SERVE_PREEMPTIONS,
+                                "sequences evicted under page pressure "
+                                "(recompute-on-resume)").inc(len(preempted))
+                self._publish_gauges(reg)
+                self._flight_record_iteration(now, admitted, prefilled,
+                                              preempted, decoded)
+            self._slo_tick()
+            if self.fleet is not None:
+                # Clean iteration: soft suspicion decays (flap damping)
+                # and the rejoin streak advances while evacuated.
+                self.fleet.observe_clean()
+                if self.evacuated:
+                    self._clean_since_evac += 1
         return {"iter": self._iter, "admitted": [r.req_id for r in admitted],
                 "prefilled": prefilled,
                 "preempted": [r.req_id for r in preempted],
@@ -1083,7 +1109,11 @@ class ServingEngine:
                 "pages_shared": self.prefix.pages_shared(),
                 "evictions": self.prefix.evictions,
             }
-        self.flight.record({
+        # Kept by reference: the step profiler's phase vector for THIS
+        # iteration is only complete after step() returns, so
+        # _step_profile_close patches it in place (the flight ring
+        # stores the dict itself, not a copy).
+        self._last_flight_rec = {
             **rec_extra,
             "iter": self._iter, "t": round(now, 6),
             "admitted": [r.req_id for r in admitted],
@@ -1104,7 +1134,50 @@ class ServingEngine:
             "slo_violation_streak": self._viol_streak,
             "fleet_suspects": (len(self.fleet.suspects())
                                if self.fleet is not None else 0),
-        })
+        }
+        self.flight.record(self._last_flight_rec)
+
+    def _step_profile_close(self, rec: dict) -> None:
+        """Fold the finished iteration's phase record (ISSUE 18) into
+        the flight ring and the metrics registry. Runs in step()'s
+        ``finally`` — after the summary — so the ``accounting`` phase
+        covers the flight record, gauges, and SLO tick it just timed."""
+        if not rec:
+            return
+        flight_rec = getattr(self, "_last_flight_rec", None)
+        if flight_rec is not None and "phases" not in flight_rec:
+            # Satellite 2: dumps carry the phase vector + cumulative
+            # host/device milliseconds alongside page_events.
+            flight_rec["phases"] = rec["phases"]
+            flight_rec["wall_ms"] = rec["wall_ms"]
+            flight_rec["host_ms"] = rec["host_ms"]
+            flight_rec["device_ms"] = rec["device_ms"]
+            flight_rec["host_bubble_frac"] = rec["host_bubble_frac"]
+            flight_rec["host_ms_cum"] = rec["host_ms_cum"]
+            flight_rec["device_ms_cum"] = rec["device_ms_cum"]
+        self._last_flight_rec = None
+        if not self._observing():
+            return
+        reg = self._reg()
+        reg.gauge(
+            obs_metrics.SERVE_HOST_BUBBLE_FRAC,
+            "host milliseconds not overlapped with the device / "
+            "iteration wall — the synchronous-loop bubble ROADMAP "
+            "item 3's async loop must kill").set(rec["host_bubble_frac"])
+        reg.histogram(
+            obs_metrics.SERVE_STEP_HOST_MS,
+            "host-attributed milliseconds per serving iteration"
+            ).observe(rec["host_ms"])
+        reg.histogram(
+            obs_metrics.SERVE_STEP_DEVICE_MS,
+            "device-attributed milliseconds per serving iteration "
+            "(prefill / migrate / device-wait phases)"
+            ).observe(rec["device_ms"])
+        for phase_name, ms in rec["phases"].items():
+            reg.histogram(
+                f"{obs_metrics.SERVE_PHASE_MS_PREFIX}_{phase_name}",
+                f"step-phase '{phase_name}' milliseconds per iteration "
+                "(obs/stepprof.py taxonomy)").observe(ms)
 
     def _prefill_lane(self, req: Request):
         """(engine, slice_fn, logits_fn) the prefill stage runs through
@@ -1610,18 +1683,19 @@ class ServingEngine:
             # windowless must never receive a wins>1 step).
             self._decode_spec(ready)
             return
-        toks = np.zeros((self.max_batch,), np.int32)
-        lens = np.zeros((self.max_batch,), np.int32)
-        # Unmapped entries are -1 so the megakernel decoder's
-        # page-coverage guard can SEE them (it treats negatives as
-        # scratch and validates kv_len against the mapped count); the
-        # dense path substitutes the scratch page below.
-        table = np.full((self.max_batch, self.max_pages), -1, np.int32)
-        for req in ready:
-            toks[req.slot] = req.tokens[-1]
-            lens[req.slot] = req.kv_len
-            pages = alloc.pages(req.req_id)
-            table[req.slot, :len(pages)] = pages
+        with obs_stepprof.phase("decode_dispatch"):
+            toks = np.zeros((self.max_batch,), np.int32)
+            lens = np.zeros((self.max_batch,), np.int32)
+            # Unmapped entries are -1 so the megakernel decoder's
+            # page-coverage guard can SEE them (it treats negatives as
+            # scratch and validates kv_len against the mapped count); the
+            # dense path substitutes the scratch page below.
+            table = np.full((self.max_batch, self.max_pages), -1, np.int32)
+            for req in ready:
+                toks[req.slot] = req.tokens[-1]
+                lens[req.slot] = req.kv_len
+                pages = alloc.pages(req.req_id)
+                table[req.slot, :len(pages)] = pages
         if self._mk is not None:
             try:
                 self._decode_megakernel(ready, toks, lens, table)
@@ -1639,8 +1713,10 @@ class ServingEngine:
         eng._jit_compiled_last_call = False
         t0 = self.clock()
         with obs_trace.span("serving.decode_step", batch=len(ready)):
-            tok, self._cache = eng._decode_run(jnp.asarray(toks), cache)
-            tok_np = np.asarray(tok)        # host sync: the loop needs them
+            with obs_stepprof.phase("decode_dispatch"):
+                tok, self._cache = eng._decode_run(jnp.asarray(toks), cache)
+            with obs_stepprof.phase("device_wait"):
+                tok_np = np.asarray(tok)    # host sync: the loop needs them
         self._decode_tail(ready,
                           {r.req_id: [int(tok_np[r.slot])] for r in ready},
                           t0, eng._jit_compiled_last_call)
@@ -1678,9 +1754,13 @@ class ServingEngine:
         t0 = self.clock()
         with obs_trace.span("serving.decode_step_megakernel",
                             batch=len(ready)):
-            self._mk_ws, tok = self._mk.step(self._mk_ws, toks, lens,
-                                             table)
-            tok_np = np.asarray(tok)    # host sync: the loop needs them
+            with obs_stepprof.phase("decode_dispatch"):
+                # The decoder's host queue-word rewrite telescopes its
+                # own ``retarget`` slice out of this phase.
+                self._mk_ws, tok = self._mk.step(self._mk_ws, toks, lens,
+                                                 table)
+            with obs_stepprof.phase("device_wait"):
+                tok_np = np.asarray(tok)  # host sync: the loop needs them
         self._decode_tail(ready,
                           {r.req_id: [int(tok_np[r.slot])] for r in ready},
                           t0, self._mk.last_step_cold)
@@ -1695,21 +1775,22 @@ class ServingEngine:
         eng = self.engine
         alloc = self.sched.allocator
         W = self.spec_k + 1
-        toks = np.zeros((self.max_batch, W), np.int32)
-        lens = np.zeros((self.max_batch,), np.int32)
-        wins = np.ones((self.max_batch,), np.int32)
-        table = np.full((self.max_batch, self.max_pages), -1, np.int32)
-        drafts: dict[str, list[int]] = {}
-        for req in ready:
-            d = self._drafts.get(req.req_id, [])
-            drafts[req.req_id] = d
-            toks[req.slot, 0] = req.tokens[-1]
-            if d:
-                toks[req.slot, 1:1 + len(d)] = d
-            wins[req.slot] = 1 + len(d)
-            lens[req.slot] = req.kv_len
-            pages = alloc.pages(req.req_id)
-            table[req.slot, :len(pages)] = pages
+        with obs_stepprof.phase("decode_dispatch"):
+            toks = np.zeros((self.max_batch, W), np.int32)
+            lens = np.zeros((self.max_batch,), np.int32)
+            wins = np.ones((self.max_batch,), np.int32)
+            table = np.full((self.max_batch, self.max_pages), -1, np.int32)
+            drafts: dict[str, list[int]] = {}
+            for req in ready:
+                d = self._drafts.get(req.req_id, [])
+                drafts[req.req_id] = d
+                toks[req.slot, 0] = req.tokens[-1]
+                if d:
+                    toks[req.slot, 1:1 + len(d)] = d
+                wins[req.slot] = 1 + len(d)
+                lens[req.slot] = req.kv_len
+                pages = alloc.pages(req.req_id)
+                table[req.slot, :len(pages)] = pages
         if self._mk is not None:
             # The lane was compiled with spec_window == W (it rebuilds
             # through _build_megakernel_lane on every spec-state change).
@@ -1719,9 +1800,11 @@ class ServingEngine:
                 t0 = self.clock()
                 with obs_trace.span("serving.verify_step_megakernel",
                                     batch=len(ready), window=W):
-                    self._mk_ws, ver = self._mk.step(
-                        self._mk_ws, toks, lens, table, wins)
-                    ver_np = np.asarray(ver)
+                    with obs_stepprof.phase("decode_dispatch"):
+                        self._mk_ws, ver = self._mk.step(
+                            self._mk_ws, toks, lens, table, wins)
+                    with obs_stepprof.phase("device_wait"):
+                        ver_np = np.asarray(ver)
             except Exception as exc:
                 from triton_distributed_tpu import resilience
 
@@ -1740,9 +1823,11 @@ class ServingEngine:
         try:
             with obs_trace.span("serving.verify_step", batch=len(ready),
                                 window=W):
-                ver, self._cache = self._verify_jit()(
-                    eng.params, jnp.asarray(toks), cache)
-                ver_np = np.asarray(ver)
+                with obs_stepprof.phase("decode_dispatch"):
+                    ver, self._cache = self._verify_jit()(
+                        eng.params, jnp.asarray(toks), cache)
+                with obs_stepprof.phase("device_wait"):
+                    ver_np = np.asarray(ver)
         except Exception as exc:
             from triton_distributed_tpu import resilience
             from triton_distributed_tpu.resilience import fleet as fleet_mod
@@ -1813,37 +1898,40 @@ class ServingEngine:
         lists on the one-token paths; 1..k+1 accepted tokens from the
         spec lane — the ledger and the rolling tokens/s gauge count
         exactly what was accepted)."""
-        now = self.clock()
-        total = sum(len(v) for v in new_tokens.values())
-        rt = obs_reqtrace.get_tracer()
-        if rt is not None:
-            backend = self.engine.backend
-            for req in ready:
-                rt.span(req.req_id, "decode_step", t0, now,
-                        backend=backend,
-                        tokens=len(new_tokens[req.req_id]))
-                if rt.breakdown(req.req_id) is None:
-                    # This request's FIRST decode step: close its TTFT
-                    # decomposition window and publish the components.
-                    bd = rt.close_window(req.req_id, now)
-                    if bd is not None and self._observing():
-                        self._publish_ttft_breakdown(bd)
-        if self._observing():
-            reg = self._reg()
-            reg.counter("tdtpu_tokens_generated_total",
-                        "decode tokens generated").inc(total)
-            Engine._observe_step(
-                reg, (now - t0) * 1e3, cold,
-                "tdtpu_decode_step_latency_ms",
-                "one decode step, wall (device-synced only in sync runs)")
-        self.total_tokens += total
-        self._rate_events.append((now, total))
-        for req in list(ready):
-            ts = new_tokens[req.req_id]
-            req.tokens.extend(ts)
-            req.kv_len += len(ts)
-            if req.done:
-                self._finish(req)
+        with obs_stepprof.phase("accounting"):
+            now = self.clock()
+            total = sum(len(v) for v in new_tokens.values())
+            rt = obs_reqtrace.get_tracer()
+            if rt is not None:
+                backend = self.engine.backend
+                for req in ready:
+                    rt.span(req.req_id, "decode_step", t0, now,
+                            backend=backend,
+                            tokens=len(new_tokens[req.req_id]))
+                    if rt.breakdown(req.req_id) is None:
+                        # This request's FIRST decode step: close its
+                        # TTFT decomposition window and publish the
+                        # components.
+                        bd = rt.close_window(req.req_id, now)
+                        if bd is not None and self._observing():
+                            self._publish_ttft_breakdown(bd)
+            if self._observing():
+                reg = self._reg()
+                reg.counter("tdtpu_tokens_generated_total",
+                            "decode tokens generated").inc(total)
+                Engine._observe_step(
+                    reg, (now - t0) * 1e3, cold,
+                    "tdtpu_decode_step_latency_ms",
+                    "one decode step, wall (device-synced only in sync "
+                    "runs)")
+            self.total_tokens += total
+            self._rate_events.append((now, total))
+            for req in list(ready):
+                ts = new_tokens[req.req_id]
+                req.tokens.extend(ts)
+                req.kv_len += len(ts)
+                if req.done:
+                    self._finish(req)
 
     def _publish_gauges(self, reg) -> None:
         reg.gauge(obs_metrics.SERVE_QUEUE_DEPTH,
@@ -1918,7 +2006,7 @@ class ServingEngine:
 
             section = obs_slo.check_serving(
                 self._reg(), run_dir=obs.active_run_dir(),
-                cfg=self.slo_cfg)
+                cfg=self.slo_cfg, clock=self.clock)
         except Exception as e:   # the watchdog must never cost the serve
             import warnings
 
