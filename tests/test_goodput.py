@@ -1,0 +1,496 @@
+"""Goodput observatory (ISSUE 19, docs/observability.md "Goodput &
+waste attribution").
+
+The load-bearing contracts: the work ledger attributes every dispatched
+device token-row to exactly one category (useful / spec_rejected /
+recompute / overhead / idle) with dispatch widths recorded SEPARATELY
+from the attribution, so the PARTITION INVARIANT (Σ categories == rows)
+is a real cross-check on the instrumentation; records are
+byte-deterministic under the loop's injected clock; per-request waste
+counters reconcile exactly with the ledger lanes; the interval sampler
+and windowed alert rules fire ``goodput_regression`` flight dumps
+through the established trigger chain; and ``obs.report --check``
+gates both the lane and the partition on every dumped record.
+"""
+
+import json
+import os
+
+import pytest
+
+import jax
+
+from triton_distributed_tpu import obs
+from triton_distributed_tpu.models.config import tiny_config
+from triton_distributed_tpu.models.dense import init_dense_llm
+from triton_distributed_tpu.models.engine import Engine
+from triton_distributed_tpu.obs import flight as obs_flight
+from triton_distributed_tpu.obs import goodput
+from triton_distributed_tpu.obs import metrics as obs_metrics
+from triton_distributed_tpu.obs import postmortem as obs_postmortem
+from triton_distributed_tpu.obs import report as obs_report
+from triton_distributed_tpu.obs import stepprof
+from triton_distributed_tpu.obs import trace as obs_trace
+from triton_distributed_tpu.obs.goodput import WorkLedger
+from triton_distributed_tpu.runtime import initialize_distributed
+from triton_distributed_tpu.serving.loadgen import (
+    LoadSpec, build_trace, run_trace,
+)
+from triton_distributed_tpu.serving.loop import ServingEngine
+from triton_distributed_tpu.serving.spec import (
+    SpecConfigError, attribute_verify_rows,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_observers():
+    goodput.disable()
+    stepprof.disable()
+    obs_trace.disable()
+    yield
+    goodput.disable()
+    stepprof.disable()
+    obs_trace.disable()
+
+
+@pytest.fixture(scope="module")
+def ctx1():
+    return initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                  devices=jax.devices()[:1])
+
+
+@pytest.fixture(scope="module")
+def served(ctx1):
+    cfg = tiny_config()
+    params = init_dense_llm(jax.random.PRNGKey(7), cfg)
+    return Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                  page_size=4)
+
+
+class CounterClock:
+    """Deterministic injectable clock: monotone, no wall time."""
+
+    def __init__(self, step: float = 0.001):
+        self.t = 0.0
+        self.step = step
+
+    def __call__(self) -> float:
+        self.t += self.step
+        return round(self.t, 6)
+
+
+def _assert_partition(recs):
+    assert recs, "no work records produced"
+    for rec in recs:
+        problem = goodput.check_partition(rec)
+        assert problem is None, problem
+
+
+def _ledgered_run(eng, trace, **kw):
+    """One serving replay under a private ledger + CounterClock;
+    returns (ledger, report)."""
+    gl = WorkLedger(interval=2)
+    prev = goodput.set_ledger(gl)
+    try:
+        se = ServingEngine(eng, clock=CounterClock(), **kw)
+        report = run_trace(se, [dict(t) for t in trace])
+    finally:
+        goodput.set_ledger(prev)
+    return gl, report
+
+
+# ---------------------------------------------------------------------------
+# The partition contract (unit level).
+# ---------------------------------------------------------------------------
+
+def test_check_partition_rejects_broken_records():
+    good = {"it": 3, "rows": 10, "work": {"useful": 7, "idle": 3},
+            "goodput_frac": 0.7, "prefill_saved": 2}
+    assert goodput.check_partition(good) is None
+    assert "partition invariant broken" in goodput.check_partition(
+        {**good, "work": {"useful": 7}})
+    assert "missing 'work'" in goodput.check_partition({"rows": 1})
+    assert "unknown work category" in goodput.check_partition(
+        {**good, "work": {"useful": 9, "cache_miss": 1}})
+    # Exact integer discipline: bools and floats are not row counts.
+    assert goodput.check_partition({**good, "rows": True}) is not None
+    assert "non-int/negative" in goodput.check_partition(
+        {**good, "work": {"useful": 7.0, "idle": 3}})
+    assert "outside [0, 1]" in goodput.check_partition(
+        {**good, "goodput_frac": 1.7})
+    assert "prefill_saved" in goodput.check_partition(
+        {**good, "prefill_saved": -1})
+    # The flight ride-along shape (no it/frac) is also checkable.
+    assert goodput.check_partition(
+        {"rows": 4, "work": {"useful": 4}}) is None
+
+
+def test_attribute_verify_rows_partitions_by_construction():
+    """The verify-launch split rule lives next to the acceptance rule
+    it mirrors: accepted → useful, live-but-rejected → spec_rejected,
+    padding → idle, and Σ == rows dispatched."""
+    out = attribute_verify_rows(8, wins=[3, 3], accepted=[2, 1])
+    assert out == {"useful": 3, "spec_rejected": 3, "idle": 2}
+    assert sum(out.values()) == 8
+    # Whole-batch padding (no live slots) is all idle.
+    assert attribute_verify_rows(4, wins=[], accepted=[]) == {
+        "useful": 0, "spec_rejected": 0, "idle": 4}
+    with pytest.raises(SpecConfigError):
+        attribute_verify_rows(8, wins=[3], accepted=[4])   # acc > live
+    with pytest.raises(SpecConfigError):
+        attribute_verify_rows(2, wins=[3], accepted=[1])   # live > rows
+
+
+def test_workledger_lifecycle_and_record_shape():
+    gl = WorkLedger(interval=100)
+    assert not gl.active()
+    # Hooks are no-ops without an open iteration — the instrumentation
+    # sites fire unconditionally on the serving hot path.
+    gl.dispatch(5)
+    gl.add("useful", 5)
+    gl.credit_saved(2)
+    assert not gl.has_records() and gl.cumulative() == {}
+    gl.begin_iteration(0, 1.0, clock=CounterClock())
+    gl.dispatch(10)
+    gl.add("useful", 6)
+    gl.add("idle", 3)
+    gl.add("recompute", 1)
+    gl.add("overhead", 0)            # zero rows: category stays absent
+    gl.credit_saved(4)
+    with pytest.raises(ValueError):
+        gl.add("cache_miss", 1)      # taxonomy is closed
+    rec = gl.finish_iteration(2.0)
+    assert rec["rows"] == 10
+    assert rec["work"] == {"useful": 6, "recompute": 1, "idle": 3}
+    assert rec["goodput_frac"] == 0.6
+    assert rec["prefill_saved"] == 4
+    assert rec["rows_cum"] == 10 and rec["goodput_frac_cum"] == 0.6
+    assert goodput.check_partition(rec) is None
+    # A crashed iteration never reached finish — the next begin closes
+    # it as aborted so the ring stays one partition per record.
+    gl.begin_iteration(1, 3.0)
+    gl.dispatch(2)
+    gl.add("useful", 2)
+    gl.begin_iteration(2, 4.0)
+    gl.finish_iteration(5.0)
+    recs = gl.records()
+    assert [r["it"] for r in recs] == [0, 1, 2]
+    assert recs[1]["aborted"] is True
+    _assert_partition(recs)
+    cum = gl.cumulative()
+    assert cum["rows"] == 12 and cum["prefill_saved"] == 4
+    assert gl.goodput_frac() == round(8 / 12, 6)
+    assert gl.cumulative_all()["rows"] == 12
+    # Empty-dispatch iterations are vacuously all-useful, not 0-goodput.
+    assert recs[2]["rows"] == 0 and recs[2]["goodput_frac"] == 1.0
+
+
+def test_env_knobs_configure_sampler(monkeypatch):
+    monkeypatch.setenv("TDTPU_GOODPUT_INTERVAL", "2")
+    monkeypatch.setenv("TDTPU_GOODPUT_WINDOW", "5")
+    monkeypatch.setenv("TDTPU_GOODPUT_FLOOR", "0.75")
+    monkeypatch.setenv("TDTPU_GOODPUT_WASTE_MAX", "0.4")
+    gl = WorkLedger()
+    assert (gl.interval, gl.window) == (2, 5)
+    assert gl.goodput_floor == 0.75 and gl.waste_ceiling == 0.4
+    # Explicit kwargs beat the environment.
+    gl2 = WorkLedger(interval=7, window=1)
+    assert (gl2.interval, gl2.window) == (7, 1)
+
+
+# ---------------------------------------------------------------------------
+# Interval time-series + windowed alert rules (unit level).
+# ---------------------------------------------------------------------------
+
+def _iterate(gl, useful, waste_cat=None, waste=0):
+    it = len(gl.records())
+    gl.begin_iteration(it, float(it))
+    gl.dispatch(useful + waste)
+    gl.add("useful", useful)
+    if waste_cat is not None and waste:
+        gl.add(waste_cat, waste)
+    gl.finish_iteration(float(it) + 0.5)
+
+
+def test_floor_rule_needs_window_consecutive_breaches():
+    """goodput below the floor fires only after ``window`` consecutive
+    breaching samples; an idle (rows == 0) sample resets the streak,
+    and the streak resets after firing."""
+    gl = WorkLedger(interval=1, window=2, goodput_floor=0.9)
+    _iterate(gl, useful=1, waste_cat="idle", waste=9)   # 0.1 — breach 1
+    assert gl.alerts == []
+    _iterate(gl, useful=0)                              # idle: reset
+    _iterate(gl, useful=1, waste_cat="idle", waste=9)   # breach 1
+    assert gl.alerts == []
+    _iterate(gl, useful=1, waste_cat="idle", waste=9)   # breach 2: fire
+    assert [a["rule"] for a in gl.alerts] == ["goodput_floor"]
+    assert "below" in gl.alerts[0]["reason"]
+    _iterate(gl, useful=1, waste_cat="idle", waste=9)   # post-fire: 1
+    assert len(gl.alerts) == 1, "streak must reset after firing"
+    # The loop drains pending alerts exactly once.
+    assert [a["rule"] for a in gl.consume_alerts()] == ["goodput_floor"]
+    assert gl.consume_alerts() == []
+    tl = gl.timeline()
+    assert tl["schema"] == "tdtpu-goodput-timeline-v1"
+    assert len(tl["samples"]) == 5 and len(tl["alerts"]) == 1
+
+
+def test_waste_spike_rule_is_per_category():
+    gl = WorkLedger(interval=1, window=1, waste_ceiling=0.3)
+    _iterate(gl, useful=5, waste_cat="recompute", waste=5)   # 0.5 > 0.3
+    _iterate(gl, useful=9, waste_cat="spec_rejected", waste=1)  # 0.1 ok
+    assert [a["rule"] for a in gl.alerts] == ["waste_spike:recompute"]
+    # Both rule families can watch the same sample stream.
+    gl2 = WorkLedger(interval=1, window=1, goodput_floor=0.9,
+                     waste_ceiling=0.3)
+    _iterate(gl2, useful=1, waste_cat="recompute", waste=9)
+    assert sorted(a["rule"] for a in gl2.alerts) == [
+        "goodput_floor", "waste_spike:recompute"]
+
+
+# ---------------------------------------------------------------------------
+# Serving tiers: partition, determinism, reconciliation.
+# ---------------------------------------------------------------------------
+
+def test_dense_decode_partitions_and_is_byte_deterministic(served):
+    """Two identically-seeded replays under the injected clock produce
+    BYTE-IDENTICAL work records; every record satisfies the partition
+    invariant and padding lands in ``idle``."""
+    trace = build_trace(LoadSpec(n_requests=2, seed=3,
+                                 prompt_len=(4, 4), max_new=(3, 3),
+                                 mean_interarrival_iters=0.0))
+    gl1, report = _ledgered_run(served, trace, max_batch=4,
+                                num_pages=16, prefill_chunk=4)
+    gl2, _ = _ledgered_run(served, trace, max_batch=4,
+                           num_pages=16, prefill_chunk=4)
+    assert report["all_finished"]
+    recs = gl1.records()
+    _assert_partition(recs)
+    assert json.dumps(recs, sort_keys=True) == \
+        json.dumps(gl2.records(), sort_keys=True), \
+        "work records are not byte-deterministic under a fake clock"
+    cum = gl1.cumulative()
+    assert cum.get("useful", 0) > 0
+    assert cum.get("idle", 0) > 0, \
+        "2 requests in a max_batch=4 step must charge padding to idle"
+    assert cum["rows"] == sum(r["rows"] for r in recs)
+    # Cumulative fraction on the last record matches the lane totals.
+    assert recs[-1]["goodput_frac_cum"] == gl1.goodput_frac()
+
+
+def test_preemption_charges_recompute_and_reconciles(served):
+    """Page pressure forces eviction mid-decode: the re-prefill of
+    already-computed positions lands in ``recompute`` (via the
+    request's computed_high high-water mark) and Σ per-request
+    ``recompute_tokens`` reconciles EXACTLY with the ledger lane."""
+    trace = build_trace(LoadSpec(n_requests=8, seed=0,
+                                 mean_interarrival_iters=1.0))
+    gl, report = _ledgered_run(served, trace, max_batch=4, num_pages=8,
+                               prefill_chunk=4, max_waiting=8)
+    assert report["all_finished"]
+    assert report["preemptions"] > 0, \
+        "pool sizing no longer exercises eviction"
+    _assert_partition(gl.records())
+    cum = gl.cumulative()
+    assert cum.get("recompute", 0) > 0, \
+        "preempted resumes never charged the recompute lane"
+    reqs = report["requests"]
+    assert sum(r.recompute_tokens for r in reqs) == cum["recompute"]
+    assert sum(r.wasted_tokens for r in reqs) == \
+        cum["recompute"] + cum.get("spec_rejected", 0)
+
+
+def test_spec_rejection_attributed_and_reconciled(served):
+    """Draft-and-verify: rejected candidate rows land in
+    ``spec_rejected`` and reconcile with per-request rejected_tokens;
+    the verify launch's split keeps the partition."""
+    prompts = [[3, 9] * 4, [7, 7, 7, 7, 7], [11, 4, 11, 4, 11, 4]]
+    trace = [{"req_id": f"sp-{i}", "arrival_iter": 0, "prompt": p,
+              "max_new_tokens": g, "priority": 0}
+             for i, (p, g) in enumerate(zip(prompts, [10, 8, 8]))]
+    gl, report = _ledgered_run(served, trace, max_batch=3,
+                               num_pages=24, prefill_chunk=4, spec_k=2)
+    assert report["all_finished"]
+    _assert_partition(gl.records())
+    cum = gl.cumulative()
+    assert cum.get("spec_rejected", 0) > 0, \
+        "no verify launch rejected a candidate row"
+    reqs = report["requests"]
+    assert sum(r.rejected_tokens for r in reqs) == cum["spec_rejected"]
+
+
+def test_warm_prefix_admission_credits_prefill_saved(served):
+    """A warm prefix-cache admission skips the covered prefix rows:
+    they were never dispatched, so they land in the ``prefill_saved``
+    credit OUTSIDE the partition — not in any category."""
+    gl = WorkLedger(interval=2)
+    prev = goodput.set_ledger(gl)
+    try:
+        se = ServingEngine(served, max_batch=2, num_pages=16,
+                           prefill_chunk=4, prefix_cache=True,
+                           clock=CounterClock())
+        pre = list(range(10, 22))
+        se.submit(pre + [3, 5, 8, 9], 4, req_id="cold")
+        se.run()
+        saved_cold = gl.cumulative().get("prefill_saved", 0)
+        se.submit(pre + [3, 5, 8, 30, 31, 32], 4, req_id="warm")
+        se.run()
+    finally:
+        goodput.set_ledger(prev)
+    _assert_partition(gl.records())
+    cum = gl.cumulative()
+    assert saved_cold == 0, "a cold admission must not claim the credit"
+    assert cum["prefill_saved"] > 0, \
+        "the warm admission never credited prefill_saved"
+    # The credit is visible on the admitting iteration's record.
+    assert any(r["prefill_saved"] > 0 for r in gl.records())
+
+
+def test_fleet_replica_lanes_and_run_artifacts(tmp_path):
+    """Fleet replicas step through ONE ledger: records carry replica
+    labels, per-lane cumulative totals stay separate, the router
+    publishes the fleet-mean gauge + replica-labeled variants, and
+    ``obs.finish_run`` lands goodput.spans.json + timeline.json."""
+    from triton_distributed_tpu.fleet import FleetRouter, ReplicaHandle
+
+    cfg = tiny_config()
+    params = init_dense_llm(jax.random.PRNGKey(7), cfg)
+    reps = []
+    for i in range(2):
+        ctx = initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                     devices=jax.devices()[:1])
+        eng = Engine(cfg, params, ctx, backend="xla", max_seq=64,
+                     page_size=4)
+        reps.append(ReplicaHandle.build(str(i), eng, max_batch=2,
+                                        num_pages=16, prefill_chunk=4,
+                                        max_waiting=8))
+    obs.start_run(str(tmp_path))
+    try:
+        router = FleetRouter(reps, policy="round_robin")
+        run_trace(router, build_trace(LoadSpec(
+            n_requests=4, seed=5, prompt_len=(4, 6), max_new=(3, 4),
+            mean_interarrival_iters=0.0)))
+        gl = goodput.get_ledger()
+        recs = gl.records()
+        labels = sorted({r.get("replica") for r in recs} - {None})
+        cum0, cum1 = gl.cumulative("0"), gl.cumulative("1")
+        snap = obs_metrics.registry().snapshot()
+    finally:
+        obs.finish_run()
+    _assert_partition(recs)
+    assert labels == ["0", "1"], \
+        f"per-replica attribution lost (labels {labels})"
+    assert cum0.get("rows", 0) > 0 and cum1.get("rows", 0) > 0
+    total = gl.cumulative_all()
+    assert total["rows"] == cum0["rows"] + cum1["rows"]
+    merged = snap.get(obs_metrics.SERVE_GOODPUT_FRAC)
+    assert merged is not None and 0.0 < merged["value"] <= 1.0
+    labeled = [k for k in snap
+               if k.startswith(obs_metrics.SERVE_GOODPUT_FRAC + "{")
+               and 'replica="' in k]
+    assert len(labeled) == 2, labeled
+    # The run dir carries both artifacts with per-replica lanes.
+    spans = json.load(open(tmp_path / "goodput.spans.json"))
+    counters = {e["name"] for e in spans["traceEvents"]
+                if e.get("ph") == "C"}
+    assert {"work_tokens/0", "work_tokens/1", "goodput_frac/0",
+            "goodput_frac/1"} <= counters
+    tl = json.load(open(tmp_path / "timeline.json"))
+    assert set(tl["cumulative"]) == {"0", "1"}
+
+
+# ---------------------------------------------------------------------------
+# Evidence surfaces: flight dump, postmortem, report gate.
+# ---------------------------------------------------------------------------
+
+def test_floor_breach_fires_goodput_regression_flight_dump(served,
+                                                           tmp_path):
+    """A seeded goodput-floor breach fires the windowed rule, the loop
+    dumps through the ``goodput_regression`` trigger kind, the dumped
+    records carry partition-valid work dicts, the postmortem renders
+    the goodput table, and ``obs.report --check`` validates it all."""
+    from triton_distributed_tpu.obs.slo import SLOConfig
+
+    prior = obs_metrics.set_registry(obs_metrics.Registry())
+    gl = WorkLedger(interval=1, window=1, goodput_floor=0.99)
+    prev = goodput.set_ledger(gl)
+    os.environ["TDTPU_FLIGHT_DIR"] = str(tmp_path)
+    try:
+        # The default SLO config turns on the observability path (flight
+        # iteration records) without arming any violation rule.
+        se = ServingEngine(served, max_batch=4, num_pages=16,
+                           prefill_chunk=4, slo_cfg=SLOConfig(),
+                           clock=CounterClock())
+        se.submit(list(range(1, 8)), 3, req_id="fb-0")
+        se.run()
+    finally:
+        os.environ.pop("TDTPU_FLIGHT_DIR", None)
+        goodput.set_ledger(prev)
+        obs_metrics.set_registry(prior)
+    assert gl.alerts, "padding below a 0.99 floor must breach"
+    dumps = [p for p in obs_flight.find_dumps(str(tmp_path))
+             if "goodput_regression" in os.path.basename(p)]
+    assert dumps, "no goodput_regression dump was written"
+    data = obs_flight.load_dump(dumps[0])
+    assert data["trigger"]["kind"] == "goodput_regression"
+    assert "goodput_floor" in data["trigger"]["reason"]
+    ledgered = [r for r in data["iterations"]
+                if isinstance(r.get("goodput"), dict)]
+    assert ledgered, "flight records carry no work dicts"
+    for rec in ledgered:
+        assert goodput.check_partition(rec["goodput"]) is None
+    rendered = obs_postmortem.render(data, dumps[0])
+    assert "goodput (token-rows; good% = useful/rows):" in rendered
+    assert "cumulative goodput_frac:" in rendered
+    assert obs_report.main([str(tmp_path), "--check", "--require-series",
+                            "", "--allow-missing-step-profile"]) == 0
+    # The machine-readable postmortem carries the per-dump aggregate.
+    out = str(tmp_path / "pm.json")
+    assert obs_postmortem.main([str(tmp_path), "--check", "--json", out,
+                                "--quiet"]) == 0
+    pm = json.load(open(out))
+    assert pm["ok"] and pm["problems"] == []
+    entry = next(e for e in pm["dumps"]
+                 if e["trigger_detail"]["kind"] == "goodput_regression")
+    agg = entry["goodput"]
+    assert agg["partition_ok"] and agg["rows"] > 0
+    assert agg["rows"] == sum(agg["work"].values())
+    assert entry["valid"]
+
+
+def test_report_check_gates_goodput_lane_and_partition(tmp_path):
+    """A serving-tier snapshot without the goodput lane fails --check
+    (waste attribution lost); the opt-out or the lane passes it; a
+    flight dump whose work dict breaks the partition invariant fails
+    --check even with the lane present."""
+    from triton_distributed_tpu.obs.reqtrace import ReqTracer
+    from triton_distributed_tpu.obs.stepprof import StepProfiler
+
+    reg = obs_metrics.Registry()
+    reg.counter(obs_metrics.SERVE_FINISHED, "x").inc(1)
+    reg.gauge(obs_metrics.KV_PAGES_RESIDENT, "x").set(4)
+    reg.save(str(tmp_path))
+    rt = ReqTracer()
+    rt.arrival("r-0", 0.0)
+    rt.save(str(tmp_path / "requests.spans.json"))
+    sp = StepProfiler()
+    sp.begin_iteration(0, 1.0)
+    sp.finish_iteration(1.5)
+    sp.save(str(tmp_path / "steps.spans.json"))
+    args = [str(tmp_path), "--check", "--require-series", ""]
+    assert obs_report.main(args) == 1
+    assert obs_report.main(args + ["--allow-missing-goodput"]) == 0
+    gl = WorkLedger(interval=1)
+    gl.begin_iteration(0, 1.0)
+    gl.dispatch(4)
+    gl.add("useful", 4)
+    gl.finish_iteration(2.0)
+    gl.save(str(tmp_path / "goodput.spans.json"))
+    gl.save_timeline(str(tmp_path / "timeline.json"))
+    assert obs_report.main(args) == 0
+    # Now a flight dump whose work dict breaks the partition.
+    rec = obs_flight.FlightRecorder(capacity=4, run_dir=str(tmp_path))
+    rec.record({"iter": 0,
+                "goodput": {"rows": 5, "work": {"useful": 3}}})
+    rec.dump("slo_violation", "synthetic partition break", 1)
+    assert obs_report.main(args) == 1

@@ -269,7 +269,8 @@ def test_report_check_fails_on_missing_request_lane(tmp_path):
     """A serving-tier snapshot WITHOUT per-request timelines must fail
     --check (the postmortem evidence is gone); adding the lane — or the
     explicit opt-out — passes it. Since ISSUE 18 the step-phase lane
-    (steps.spans.json) is gated the same way."""
+    (steps.spans.json) is gated the same way, and since ISSUE 19 the
+    goodput lane (goodput.spans.json / timeline.json) too."""
     from triton_distributed_tpu.obs import stepprof as obs_stepprof
 
     reg = obs_metrics.Registry()
@@ -279,18 +280,20 @@ def test_report_check_fails_on_missing_request_lane(tmp_path):
     args = [str(tmp_path), "--check", "--require-series", ""]
     assert obs_report.main(args) == 1
     assert obs_report.main(args + ["--allow-missing-request-lane",
-                                   "--allow-missing-step-profile"]) == 0
+                                   "--allow-missing-step-profile",
+                                   "--allow-missing-goodput"]) == 0
     rt = ReqTracer()
     rt.arrival("req-lane", 0.0)
     rt.save(str(tmp_path / "requests.spans.json"))
-    # Request lane restored — the step-phase lane still gates alone.
+    # Request lane restored — the other lanes still gate alone.
     assert obs_report.main(args) == 1
-    assert obs_report.main(args + ["--allow-missing-step-profile"]) == 0
+    assert obs_report.main(args + ["--allow-missing-step-profile",
+                                   "--allow-missing-goodput"]) == 0
     sp = obs_stepprof.StepProfiler()
     sp.begin_iteration(0, 1.0)
     sp.finish_iteration(1.5)
     sp.save(str(tmp_path / "steps.spans.json"))
-    assert obs_report.main(args) == 0
+    assert obs_report.main(args + ["--allow-missing-goodput"]) == 0
 
 
 def test_utilization_gauges_published(served, tmp_path):

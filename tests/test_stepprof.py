@@ -437,7 +437,10 @@ def test_report_check_gates_steps_lane_and_partition(tmp_path):
     rt = ReqTracer()
     rt.arrival("r-0", 0.0)
     rt.save(str(tmp_path / "requests.spans.json"))
-    args = [str(tmp_path), "--check", "--require-series", ""]
+    # The goodput lane (ISSUE 19) gates the same way; opt out so this
+    # test stays focused on the step-phase lane.
+    args = [str(tmp_path), "--check", "--require-series", "",
+            "--allow-missing-goodput"]
     assert obs_report.main(args) == 1
     assert obs_report.main(args + ["--allow-missing-step-profile"]) == 0
     sp = StepProfiler()

@@ -45,6 +45,7 @@ from triton_distributed_tpu.models.engine import Engine
 from triton_distributed_tpu.models.kv_cache import (
     init_kv_cache, kv_cache_specs, paged_cache_specs,
 )
+from triton_distributed_tpu.obs import goodput as obs_goodput
 from triton_distributed_tpu.obs import metrics as obs_metrics
 from triton_distributed_tpu.obs import reqtrace as obs_reqtrace
 from triton_distributed_tpu.obs import trace as obs_trace
@@ -421,6 +422,7 @@ class DisaggServingEngine(ServingEngine):
         rt = obs_reqtrace.get_tracer()
         for rid, (req, stream) in list(self._streams.items()):
             t0 = self.clock() if rt is not None else 0.0
+            pages_before = stream.pages_moved
             try:
                 done = stream.advance(self._scatter_block)
             except Exception as exc:
@@ -446,6 +448,15 @@ class DisaggServingEngine(ServingEngine):
             if rt is not None:
                 rt.span(rid, "migrate_block", t0, self.clock(),
                         pages_moved=stream.pages_moved)
+            gl = obs_goodput.get_ledger()
+            if gl is not None and gl.active():
+                # Migration transport moves resident KV between pools —
+                # pure overhead rows (ISSUE 19, obs/goodput.py): the
+                # positions were already computed on the prefill role.
+                moved = stream.pages_moved - pages_before
+                if moved:
+                    gl.dispatch(moved * self.page)
+                    gl.add("overhead", moved * self.page)
             landed += 1
             if done:
                 del self._streams[rid]

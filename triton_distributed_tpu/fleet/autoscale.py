@@ -77,6 +77,26 @@ class Autoscaler:
             return f"queue_depth({depth} > {self.queue_high:g}/replica)"
         return None
 
+    @staticmethod
+    def _goodput_evidence(routable) -> str:
+        """Fleet-mean goodput fraction rendered for a decision reason
+        (ISSUE 19) — evidence only, never a signal: scaling stays a pure
+        function of the pressure counters above. Empty when no replica
+        has published the gauge (goodput ledger not enabled)."""
+        from triton_distributed_tpu.obs import metrics as m
+
+        vals = []
+        for rep in routable:
+            reg = getattr(rep, "registry", None)
+            if reg is None:
+                continue
+            g = reg.get(m.SERVE_GOODPUT_FRAC)
+            if g is not None:
+                vals.append(g.value)
+        if not vals:
+            return ""
+        return f" [goodput_frac={sum(vals) / len(vals):.3f}]"
+
     def _can_shrink(self, routable) -> bool:
         """True when the whole load fits in one fewer replica with
         ``shrink_margin`` of its slots left over — and nothing is
@@ -107,7 +127,8 @@ class Autoscaler:
             self.grows += 1
             self._since_last = 0
             rec = {"action": "grow", "replica": rep.replica_id,
-                   "reason": reason, "step": router.steps}
+                   "reason": reason + self._goodput_evidence(routable),
+                   "step": router.steps}
             self.log.append(rec)
             return rec
         if reason is None and self._can_shrink(routable):

@@ -470,6 +470,7 @@ class FleetRouter:
                                 "autoscaler deactivations",
                                 self.autoscaler.shrinks)
         bubbles = []
+        goodputs = []
         for rid in sorted(self.replicas):
             rep = self.replicas[rid]
             if rep.registry is None:
@@ -477,6 +478,9 @@ class FleetRouter:
             g = rep.registry.get(m.SERVE_HOST_BUBBLE_FRAC)
             if g is not None:
                 bubbles.append(g.value)
+            g = rep.registry.get(m.SERVE_GOODPUT_FRAC)
+            if g is not None:
+                goodputs.append(g.value)
             for key in rep.registry.names():
                 metric = rep.registry.get(key)
                 labels = {**(metric.labels or {}), "replica": rid} \
@@ -497,3 +501,12 @@ class FleetRouter:
                       "host milliseconds not overlapped with the device "
                       "/ iteration wall (fleet mean across replicas)"
                       ).set(round(sum(bubbles) / len(bubbles), 6))
+        if goodputs:
+            # Fleet-level goodput rollup (ISSUE 19): same contract as
+            # the bubble rollup above — unlabeled family head is the
+            # mean of the replicas' cumulative goodput fractions; the
+            # per-replica series ride the labeled merge.
+            reg.gauge(m.SERVE_GOODPUT_FRAC,
+                      "useful fraction of dispatched device token-rows "
+                      "(fleet mean across replicas)"
+                      ).set(round(sum(goodputs) / len(goodputs), 6))

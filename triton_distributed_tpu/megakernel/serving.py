@@ -504,6 +504,8 @@ class PagedMegakernelDecoder:
         self.last_step_cold = True
         self.last_step_active = 0       # RUNNING slots in the last launch
         self.last_step_pages = 0        # mapped pool pages in the last launch
+        self.last_step_rows = 0         # dispatched token-rows (ISSUE 19)
+        self.last_step_live_rows = 0    # live (non-padding) rows
         # The last host-rewritten queue + the slot state it was derived
         # from, for analysis/mklint.py's paged-step checks (references,
         # not copies — _retarget already owns a fresh queue array).
@@ -842,6 +844,18 @@ class PagedMegakernelDecoder:
                                if int(p) >= 0))
         self.last_step_active = active
         self.last_step_pages = pages_mapped
+        # Goodput launch accounting (ISSUE 19, obs/goodput.py): the
+        # persistent program dispatches every slot's FULL compiled
+        # window every step (num_slots × spec_w rows — padding rides
+        # the blocks whether or not a slot is live), and the live rows
+        # are the per-slot windows of slots with mapped KV. The serving
+        # loop's work ledger attributes from THESE numbers, so the
+        # lane's real dispatch shape — not an assumption about it — is
+        # what the partition invariant checks.
+        self.last_step_rows = self.num_slots * self.spec_w
+        self.last_step_live_rows = int(sum(
+            (int(wins[b]) if wins is not None else 1)
+            for b in range(self.num_slots) if int(kv_lens[b]) > 0))
         ws_main, wk8 = (ws if self.kv_fp8 else (ws, None))
         with obs_trace.span("mk_paged_step", slots=self.num_slots,
                             active=active, pages_mapped=pages_mapped):

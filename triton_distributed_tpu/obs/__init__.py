@@ -25,13 +25,13 @@ from __future__ import annotations
 import os
 
 from triton_distributed_tpu.obs import (  # noqa: F401
-    metrics, reqtrace, stepprof, trace,
+    goodput, metrics, reqtrace, stepprof, trace,
 )
 from triton_distributed_tpu.obs.metrics import Registry
 from triton_distributed_tpu.obs.trace import Tracer
 
-__all__ = ["trace", "metrics", "reqtrace", "stepprof", "start_run",
-           "finish_run", "active_run_dir", "run_from_env"]
+__all__ = ["trace", "metrics", "reqtrace", "stepprof", "goodput",
+           "start_run", "finish_run", "active_run_dir", "run_from_env"]
 
 # Enforcement tier (ISSUE 4) — imported lazily by name to keep package
 # import light: obs.history (bench ledger), obs.gate (cross-round
@@ -60,6 +60,7 @@ def start_run(run_dir: str, *, sync: bool = False) -> Tracer:
     metrics.set_registry(Registry())
     reqtrace.enable(run_dir)
     stepprof.enable(run_dir)
+    goodput.enable(run_dir)
     return trace.enable(run_dir, sync=sync)
 
 
@@ -72,6 +73,8 @@ def finish_run() -> str | None:
     rt = reqtrace.disable()
     sp = stepprof.get_profiler()
     stepprof.disable()
+    gl = goodput.get_ledger()
+    goodput.disable()
     run_dir = _RUN_DIR
     _RUN_DIR = None
     if t is None or run_dir is None:
@@ -87,6 +90,19 @@ def finish_run() -> str | None:
 
             warnings.warn(
                 f"step-phase lane skipped: {type(e).__name__}: {e}",
+                RuntimeWarning, stacklevel=2)
+    if gl is not None and gl.has_records():
+        # Goodput lane (ISSUE 19): counter tracks + the interval
+        # time-series, written only when ledgered iterations ran —
+        # same contract and best-effort guard as the lanes above.
+        try:
+            gl.save(os.path.join(run_dir, "goodput.spans.json"))
+            gl.save_timeline(os.path.join(run_dir, "timeline.json"))
+        except Exception as e:
+            import warnings
+
+            warnings.warn(
+                f"goodput lane skipped: {type(e).__name__}: {e}",
                 RuntimeWarning, stacklevel=2)
     if rt is not None and rt.has_events():
         # Request-timeline lane (ISSUE 13): written only when the run

@@ -13,7 +13,9 @@ JSON file into the run directory:
 * **disagg_demotion** — the disagg tier fell back to monolithic
   serving (a migration failure lands in the trigger chain first);
 * **evacuation** — the fleet preempted everything onto a survivor mesh;
-* **slo_violation** — a violation streak shrank the admission width.
+* **slo_violation** — a violation streak shrank the admission width;
+* **goodput_regression** — a windowed goodput alert rule fired (goodput
+  below floor / a waste category spiking — obs/goodput.py).
 
 Dump files are ``flight-NNNN-<kind>.json`` — sequence-numbered, never
 timestamped, so a run driven by an injected fake clock produces
@@ -39,7 +41,8 @@ from typing import Any
 SCHEMA = "tdtpu-flight-v1"
 
 TRIGGER_KINDS = ("backend_demotion", "disagg_demotion", "evacuation",
-                 "migration_failure", "slo_violation", "rejoin")
+                 "migration_failure", "slo_violation", "rejoin",
+                 "goodput_regression")
 
 
 class FlightRecorder:

@@ -1,0 +1,494 @@
+"""obs.goodput — token-level waste attribution for the serving tier.
+
+The serving stack deliberately burns device work in half a dozen places
+— speculative verify rows past the accepted prefix, recompute-on-resume
+after preemption/evacuation, COW page copies, migration transport, idle
+padded slots in the fixed-shape paged step — and until this module
+nothing totaled useful vs wasted tokens, so "does spec_k=4 pay for
+itself?" had no instrument. The :class:`WorkLedger` is the device-spend
+counterpart of the step-phase profiler (obs/stepprof.py): where stepprof
+partitions the iteration *wall*, the ledger partitions the iteration's
+dispatched *token-rows*.
+
+Every device token-row a serving iteration dispatches is attributed to
+exactly one category:
+
+=============  ======================================================
+category       covers
+=============  ======================================================
+useful         committed output tokens + cold prefill of new positions
+spec_rejected  verify rows past the accepted prefix (rolled back)
+recompute      re-prefill of positions computed before a preempt /
+               evacuation / backend-fallback resume
+overhead       COW page copies and disagg migration block transport
+idle           padded rows: empty decode slots, unused candidate
+               columns, prefill-slice padding past the real tokens
+=============  ======================================================
+
+plus ``prefill_saved`` as an avoided-work CREDIT (prefix-cache hits:
+rows that were never dispatched at all — outside the partition).
+
+**Partition invariant**: instrumentation sites record the launch width
+independently (:meth:`WorkLedger.dispatch`) from the attribution
+(:meth:`WorkLedger.add`), so ``Σ categories == rows dispatched`` is a
+real cross-check on the instrumentation, not a tautology —
+:func:`check_partition` verifies it on every record, and
+``obs.report --check`` re-verifies it on flight-dump records. All row
+counts are integers and the only clock read is the iteration boundary
+from the serving loop's injectable ``clock=``, so records are
+byte-deterministic under a fake clock.
+
+The time dimension (what end-of-run registry snapshots lack): every
+``interval`` finished iterations the ledger folds the window's deltas
+into a bounded ring of samples (→ ``timeline.json``), evaluates the
+windowed **alert rules** against the trailing samples — goodput below
+``goodput_floor`` for ``window`` consecutive intervals, or any waste
+category's fraction above ``waste_ceiling`` for ``window`` intervals —
+and queues fired alerts for the serving loop to dump through the
+flight recorder's ``goodput_regression`` trigger kind. Per-record
+Perfetto counter tracks export to ``goodput.spans.json`` (own pid lane,
+merged by the report's ``*.spans.json`` glob).
+
+Like the request tracer and step profiler, recording costs one
+module-global load plus a ``None`` check when disabled.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+from typing import Any, Callable
+
+# Chrome-trace process id for the goodput counter lane (stepprof owns
+# 93_001; commlint 95_000 — this lane slots between them).
+GOODPUT_PID = 94_001
+
+# The taxonomy, in render order (postmortem tables, report lane).
+CATEGORIES = ("useful", "spec_rejected", "recompute", "overhead", "idle")
+
+# Everything that is not useful — the alert rules' spike candidates.
+WASTE_CATEGORIES = ("spec_rejected", "recompute", "overhead", "idle")
+
+TIMELINE_SCHEMA = "tdtpu-goodput-timeline-v1"
+
+
+def _env_opt_float(var: str) -> float | None:
+    raw = os.environ.get(var, "")
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+def _env_int(var: str, default: int) -> int:
+    try:
+        return int(os.environ.get(var, "") or default)
+    except ValueError:
+        return default
+
+
+class WorkLedger:
+    """Bounded per-iteration work records + interval samples + alerts.
+
+    One ledger serves every engine in the process (fleet replicas
+    included): iterations are single-threaded per engine and the fleet
+    tier steps replicas sequentially, so one active-iteration slot
+    suffices; records carry ``replica`` and cumulative category totals
+    are kept per replica (the router's delta-merge publishes them under
+    ``replica=`` labels). Interval samples and alert rules are
+    process-wide — the time series watches the tier, not one replica.
+
+    Args:
+      run_dir: default directory for :meth:`save`/:meth:`save_timeline`.
+      capacity: iteration-record ring bound.
+      interval: finished iterations per timeline sample
+        (``TDTPU_GOODPUT_INTERVAL``, default 8).
+      window: consecutive breaching samples before an alert fires
+        (``TDTPU_GOODPUT_WINDOW``, default 3).
+      goodput_floor: alert when a sample's goodput fraction is below
+        this for ``window`` samples (``TDTPU_GOODPUT_FLOOR``; None
+        disables the rule).
+      waste_ceiling: alert when any single waste category's fraction of
+        the sample's rows exceeds this for ``window`` samples
+        (``TDTPU_GOODPUT_WASTE_MAX``; None disables the rule).
+    """
+
+    def __init__(self, run_dir: str | None = None, capacity: int = 4096,
+                 *, interval: int | None = None, window: int | None = None,
+                 goodput_floor: float | None = None,
+                 waste_ceiling: float | None = None,
+                 timeline_capacity: int = 1024):
+        self.run_dir = run_dir
+        self.capacity = capacity
+        self.interval = (int(interval) if interval is not None
+                         else max(1, _env_int("TDTPU_GOODPUT_INTERVAL", 8)))
+        self.window = (int(window) if window is not None
+                       else max(1, _env_int("TDTPU_GOODPUT_WINDOW", 3)))
+        self.goodput_floor = (goodput_floor if goodput_floor is not None
+                              else _env_opt_float("TDTPU_GOODPUT_FLOOR"))
+        self.waste_ceiling = (waste_ceiling if waste_ceiling is not None
+                              else _env_opt_float("TDTPU_GOODPUT_WASTE_MAX"))
+        self._records: deque[dict[str, Any]] = deque(maxlen=capacity)
+        self._samples: deque[dict[str, Any]] = deque(maxlen=timeline_capacity)
+        # Wall-clock rebase for the Perfetto merge (obs/stepprof.py
+        # recipe): caller clocks are perf_counter-like seconds.
+        self._epoch_s = time.perf_counter()
+        self._wall_epoch_us = time.time_ns() / 1e3
+        # Per-replica cumulative totals: {replica: {category: rows}} plus
+        # "rows"/"prefill_saved" keys — the registry/flight evidence.
+        self._cum: dict[str, dict[str, int]] = {}
+        # Process-wide totals + interval bookkeeping for the sampler.
+        self._g_cum: dict[str, int] = {}
+        self._g_saved = 0
+        self._g_rows = 0
+        self._n_finished = 0
+        self._last_sample: dict[str, Any] = {"rows": 0, "saved": 0,
+                                             "work": {}}
+        self._sample_seq = 0
+        # Windowed alert-rule streaks + fired alerts (all / unconsumed).
+        self._floor_streak = 0
+        self._waste_streaks: dict[str, int] = {}
+        self.alerts: list[dict[str, Any]] = []
+        self._pending_alerts: list[dict[str, Any]] = []
+        # Active-iteration state.
+        self._it: int | None = None
+        self._t_begin: float | None = None
+        self._rows = 0
+        self._acc: dict[str, int] = {}
+        self._saved = 0
+        self._replica: str | None = None
+        self.clock: Callable[[], float] = time.perf_counter
+
+    # -- lifecycle ----------------------------------------------------
+
+    def active(self) -> bool:
+        return self._t_begin is not None
+
+    def begin_iteration(self, it: int, t: float, *,
+                        clock: Callable[[], float] | None = None,
+                        replica: str | None = None) -> None:
+        if self._t_begin is not None:
+            # A crashed iteration never reached finish — close it so
+            # the ring stays a partition per record, not across them.
+            self.finish_iteration(t, aborted=True)
+        self._it = int(it)
+        self._t_begin = float(t)
+        self._rows = 0
+        self._acc = {}
+        self._saved = 0
+        # Normalized to str: an integer replica id 0 must stay a
+        # distinct lane, not collapse into the unlabeled "" key.
+        self._replica = str(replica) if replica is not None else None
+        if clock is not None:
+            self.clock = clock
+
+    def dispatch(self, rows: int) -> None:
+        """Record ``rows`` device token-rows launched. Deliberately
+        SEPARATE from :meth:`add`: the partition invariant cross-checks
+        the two, so a site that miscounts its split gets caught by
+        :func:`check_partition` instead of silently summing true."""
+        if self._t_begin is None:
+            return
+        self._rows += int(rows)
+
+    def add(self, category: str, rows: int) -> None:
+        """Attribute ``rows`` of the dispatched work to one category."""
+        if self._t_begin is None:
+            return
+        if category not in CATEGORIES:
+            raise ValueError(
+                f"unknown work category {category!r}: the goodput "
+                f"taxonomy is {CATEGORIES} (docs/observability.md "
+                "\"Goodput & waste attribution\") — a new waste class "
+                "must be added there, not invented at the call site")
+        n = int(rows)
+        if n:
+            self._acc[category] = self._acc.get(category, 0) + n
+
+    def credit_saved(self, rows: int) -> None:
+        """Avoided-work credit (prefix hits): rows that were NEVER
+        dispatched — outside the partition, reported alongside it."""
+        if self._t_begin is None:
+            return
+        self._saved += int(rows)
+
+    def finish_iteration(self, t: float, **extra: Any) -> dict[str, Any]:
+        """Close the window; returns (and stores) the work record."""
+        if self._t_begin is None:
+            return {}
+        work = {c: self._acc[c] for c in CATEGORIES if c in self._acc}
+        rows = self._rows
+        useful = work.get("useful", 0)
+        frac = round(useful / rows, 6) if rows else 1.0
+        rkey = self._replica if self._replica is not None else ""
+        cum = self._cum.setdefault(rkey, {})
+        for c, n in work.items():
+            cum[c] = cum.get(c, 0) + n
+            self._g_cum[c] = self._g_cum.get(c, 0) + n
+        cum["rows"] = cum.get("rows", 0) + rows
+        cum["prefill_saved"] = cum.get("prefill_saved", 0) + self._saved
+        self._g_rows += rows
+        self._g_saved += self._saved
+        cum_rows = cum["rows"]
+        frac_cum = (round(cum.get("useful", 0) / cum_rows, 6)
+                    if cum_rows else 1.0)
+        rec: dict[str, Any] = {
+            "it": self._it,
+            "t0": round(self._t_begin, 6),
+            "rows": rows,
+            "work": work,
+            "goodput_frac": frac,
+            "prefill_saved": self._saved,
+            "rows_cum": cum_rows,
+            "goodput_frac_cum": frac_cum,
+        }
+        if self._replica is not None:
+            rec["replica"] = self._replica
+        if extra:
+            rec.update(extra)
+        self._records.append(rec)
+        self._it = None
+        self._t_begin = None
+        self._rows = 0
+        self._acc = {}
+        self._saved = 0
+        self._n_finished += 1
+        if self._n_finished % self.interval == 0:
+            self._close_sample(t)
+        return rec
+
+    # -- interval time-series + windowed alert rules ------------------
+
+    def _close_sample(self, t: float) -> None:
+        last = self._last_sample
+        d_rows = self._g_rows - last["rows"]
+        d_work = {c: self._g_cum.get(c, 0) - last["work"].get(c, 0)
+                  for c in CATEGORIES
+                  if self._g_cum.get(c, 0) - last["work"].get(c, 0)}
+        d_saved = self._g_saved - last["saved"]
+        frac = (round(d_work.get("useful", 0) / d_rows, 6)
+                if d_rows else 1.0)
+        sample = {
+            "n": self._sample_seq,
+            "t": round(float(t), 6),
+            "iters": self.interval,
+            "rows": d_rows,
+            "work": d_work,
+            "goodput_frac": frac,
+            "prefill_saved": d_saved,
+        }
+        self._sample_seq += 1
+        self._samples.append(sample)
+        self._last_sample = {"rows": self._g_rows, "saved": self._g_saved,
+                             "work": dict(self._g_cum)}
+        self._evaluate_rules(sample)
+
+    def _fire(self, rule: str, reason: str, sample: dict) -> None:
+        alert = {"rule": rule, "reason": reason, "sample": sample["n"],
+                 "window": self.window}
+        self.alerts.append(alert)
+        self._pending_alerts.append(alert)
+
+    def _evaluate_rules(self, sample: dict[str, Any]) -> None:
+        # Idle tiers (rows == 0) breach nothing: goodput is vacuously
+        # 1.0 and every waste fraction 0 — the streak resets below.
+        rows = sample["rows"]
+        if self.goodput_floor is not None:
+            if rows and sample["goodput_frac"] < self.goodput_floor:
+                self._floor_streak += 1
+            else:
+                self._floor_streak = 0
+            if self._floor_streak >= self.window:
+                self._fire(
+                    "goodput_floor",
+                    f"goodput_frac {sample['goodput_frac']:.4f} below "
+                    f"floor {self.goodput_floor:.4f} for "
+                    f"{self._floor_streak} consecutive intervals "
+                    f"(interval={self.interval} iters, sample "
+                    f"{sample['n']})", sample)
+                self._floor_streak = 0
+        if self.waste_ceiling is not None:
+            for cat in WASTE_CATEGORIES:
+                w_frac = (sample["work"].get(cat, 0) / rows) if rows else 0.0
+                if rows and w_frac > self.waste_ceiling:
+                    streak = self._waste_streaks.get(cat, 0) + 1
+                else:
+                    streak = 0
+                self._waste_streaks[cat] = streak
+                if streak >= self.window:
+                    self._fire(
+                        f"waste_spike:{cat}",
+                        f"waste category '{cat}' at {w_frac:.4f} of "
+                        f"dispatched rows (> {self.waste_ceiling:.4f}) "
+                        f"for {streak} consecutive intervals (sample "
+                        f"{sample['n']})", sample)
+                    self._waste_streaks[cat] = 0
+
+    def consume_alerts(self) -> list[dict[str, Any]]:
+        """Drain the unconsumed alert queue (the serving loop dumps each
+        through the flight recorder's ``goodput_regression`` kind)."""
+        out, self._pending_alerts = self._pending_alerts, []
+        return out
+
+    # -- queries ------------------------------------------------------
+
+    def records(self) -> list[dict[str, Any]]:
+        return list(self._records)
+
+    def has_records(self) -> bool:
+        return bool(self._records)
+
+    def cumulative(self, replica: str | None = None) -> dict[str, int]:
+        """Per-replica cumulative totals: category rows plus ``rows``
+        and ``prefill_saved`` keys (empty dict before any record)."""
+        return dict(self._cum.get(str(replica) if replica is not None
+                                  else "", {}))
+
+    def cumulative_all(self) -> dict[str, int]:
+        """Process-wide cumulative totals across every replica lane:
+        category rows plus ``rows`` and ``prefill_saved`` keys."""
+        return {**self._g_cum, "rows": self._g_rows,
+                "prefill_saved": self._g_saved}
+
+    def goodput_frac(self, replica: str | None = None) -> float:
+        """Cumulative useful/dispatched for one replica lane (1.0 while
+        nothing has been dispatched — vacuously all-useful)."""
+        cum = self._cum.get(str(replica) if replica is not None else "")
+        if not cum or not cum.get("rows"):
+            return 1.0
+        return round(cum.get("useful", 0) / cum["rows"], 6)
+
+    def timeline(self) -> dict[str, Any]:
+        """The ``timeline.json`` payload: interval samples + cumulative
+        totals + every fired alert."""
+        return {
+            "schema": TIMELINE_SCHEMA,
+            "interval": self.interval,
+            "window": self.window,
+            "goodput_floor": self.goodput_floor,
+            "waste_ceiling": self.waste_ceiling,
+            "samples": list(self._samples),
+            "cumulative": {k or "": dict(v) for k, v in self._cum.items()},
+            "alerts": list(self.alerts),
+        }
+
+    # -- span export --------------------------------------------------
+
+    def _ts_us(self, t: float) -> float:
+        return self._wall_epoch_us + (t - self._epoch_s) * 1e6
+
+    def to_chrome(self) -> dict[str, Any]:
+        """Perfetto counter tracks ("C" events): one ``work_tokens``
+        multi-series counter (a stacked area per category) and one
+        ``goodput_frac`` counter per record, per replica lane."""
+        events: list[dict[str, Any]] = [{
+            "name": "process_name", "ph": "M", "pid": GOODPUT_PID,
+            "tid": 0, "args": {"name": "serving goodput"},
+        }]
+        for rec in self._records:
+            label = rec.get("replica")
+            suffix = f"/{label}" if label is not None else ""
+            ts = self._ts_us(rec["t0"])
+            events.append({
+                "name": f"work_tokens{suffix}", "ph": "C",
+                "pid": GOODPUT_PID, "tid": 0, "ts": ts,
+                "args": {c: rec["work"].get(c, 0) for c in CATEGORIES},
+            })
+            events.append({
+                "name": f"goodput_frac{suffix}", "ph": "C",
+                "pid": GOODPUT_PID, "tid": 0, "ts": ts,
+                "args": {"goodput_frac": rec["goodput_frac"]},
+            })
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def save(self, path: str | None = None) -> str:
+        """Write ``goodput.spans.json`` (fixed stem: the report's
+        ``*.spans.json`` glob merges it into the Perfetto view)."""
+        if path is None:
+            base = self.run_dir or "."
+            os.makedirs(base, exist_ok=True)
+            path = os.path.join(base, "goodput.spans.json")
+        with open(path, "w") as f:
+            json.dump(self.to_chrome(), f)
+        return path
+
+    def save_timeline(self, path: str | None = None) -> str:
+        """Write the interval time-series to ``timeline.json``."""
+        if path is None:
+            base = self.run_dir or "."
+            os.makedirs(base, exist_ok=True)
+            path = os.path.join(base, "timeline.json")
+        with open(path, "w") as f:
+            json.dump(self.timeline(), f)
+        return path
+
+
+# -- module-global switchboard (mirrors obs/stepprof.py) ---------------
+
+_LEDGER: WorkLedger | None = None
+
+
+def enable(run_dir: str | None = None, capacity: int = 4096,
+           **kw: Any) -> WorkLedger:
+    global _LEDGER
+    _LEDGER = WorkLedger(run_dir=run_dir, capacity=capacity, **kw)
+    return _LEDGER
+
+
+def disable() -> None:
+    global _LEDGER
+    _LEDGER = None
+
+
+def get_ledger() -> WorkLedger | None:
+    return _LEDGER
+
+
+def set_ledger(gl: WorkLedger | None) -> WorkLedger | None:
+    """Swap the active ledger, returning the previous one (bench rungs
+    ledger a replay without clobbering an enclosing run)."""
+    global _LEDGER
+    prev, _LEDGER = _LEDGER, gl
+    return prev
+
+
+def is_enabled() -> bool:
+    return _LEDGER is not None
+
+
+def check_partition(rec: dict[str, Any]) -> str | None:
+    """Verify Σ categories == rows dispatched on one work record;
+    returns a problem string or None. Shared by obs.report --check,
+    loadgen phase 13, and the partition-invariant tests so the contract
+    cannot drift. Exact integer equality — there is no float tolerance
+    to hide a miscounted row behind."""
+    work = rec.get("work")
+    if not isinstance(work, dict):
+        return "work record missing 'work' dict"
+    rows = rec.get("rows")
+    if not isinstance(rows, int) or isinstance(rows, bool) or rows < 0:
+        return f"work record 'rows' not a non-negative int: {rows!r}"
+    total = 0
+    for k, v in work.items():
+        if k not in CATEGORIES:
+            return (f"unknown work category {k!r} (taxonomy: "
+                    f"{CATEGORIES})")
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            return f"category {k!r} has non-int/negative value {v!r}"
+        total += v
+    if total != rows:
+        return (f"partition invariant broken: sum(work)={total} != "
+                f"rows={rows} (iter {rec.get('it')})")
+    frac = rec.get("goodput_frac")
+    if frac is not None and not (isinstance(frac, (int, float))
+                                 and -1e-9 <= frac <= 1.0 + 1e-9):
+        return f"goodput_frac {frac!r} outside [0, 1]"
+    saved = rec.get("prefill_saved")
+    if saved is not None and (not isinstance(saved, int)
+                              or isinstance(saved, bool) or saved < 0):
+        return f"prefill_saved not a non-negative int: {saved!r}"
+    return None

@@ -159,6 +159,12 @@ METRICS: tuple[MetricSpec, ...] = (
                "serving iteration host-attributed milliseconds p99 "
                "(step profiler, same window)",
                " ms", "lower", "serving"),
+    MetricSpec("serve_goodput_frac",
+               "goodput fraction of dispatched device token-rows (work "
+               "ledger: useful rows / rows dispatched over the measured "
+               "replay — spec rejections, recompute, COW/migration "
+               "overhead and padding are the waste)",
+               "", "higher", "serving"),
 )
 
 METRIC_BY_KEY = {m.key: m for m in METRICS}

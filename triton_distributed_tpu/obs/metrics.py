@@ -125,6 +125,21 @@ SERVE_PHASE_MS_PREFIX = "tdtpu_serve_phase_ms"
 STEPPROF_SERIES = (SERVE_HOST_BUBBLE_FRAC, SERVE_STEP_HOST_MS,
                    SERVE_STEP_DEVICE_MS)
 
+# Goodput / waste-attribution lane (ISSUE 19, obs/goodput.py): where
+# stepprof partitions the iteration wall, the work ledger partitions
+# the iteration's dispatched device token-rows. The gauge is the
+# CUMULATIVE useful/dispatched fraction (per-iteration vectors ride the
+# flight ring and timeline.json); the counter is a labeled family, one
+# member per taxonomy category (``category="useful"`` /
+# ``"spec_rejected"`` / ``"recompute"`` / ``"overhead"`` / ``"idle"``)
+# — the fleet router's generic per-replica merge re-labels both with
+# ``replica=`` for free. Published by serving/loop.py after each
+# finished iteration.
+SERVE_GOODPUT_FRAC = "tdtpu_serve_goodput_frac"
+WORK_TOKENS = "tdtpu_work_tokens_total"
+
+GOODPUT_SERIES = (SERVE_GOODPUT_FRAC, WORK_TOKENS)
+
 # KV-migration lane (disaggregated prefill/decode tier, docs/disagg.md):
 # published by disagg/migrate.py + disagg/engine.py, rendered as
 # obs.report's migration section. A migration spans queueing + every

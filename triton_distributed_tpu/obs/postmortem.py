@@ -12,11 +12,16 @@ incident the recorder captured:
   the utilization picture the aggregates can't give per incident;
 * **per-request timelines** — each traced request's lifecycle marks and
   TTFT decomposition (obs/reqtrace.py), so "which requests paid and
-  where the time went" is answerable after the fact.
+  where the time went" is answerable after the fact;
+* the **goodput table** (ISSUE 19, obs/goodput.py) — per-iteration
+  dispatched token-rows, the waste-category split and useful fraction,
+  whenever the ring's records carry a work ledger.
 
 ``--check`` validates every dump structurally (flight.validate_dump —
 the contract chaos rows and CI gate on) and exits nonzero on any
-problem; ``--json`` writes the machine-readable verdict. ``obs.report``
+problem; ``--json`` writes the machine-readable verdict — per dump the
+validation fields plus the structured incident content (trigger detail
+and chain, config, counters, the goodput aggregate). ``obs.report``
 folds the same validation into its run-directory summary, so a run dir
 with a malformed dump fails ``obs.report --check`` too
 (docs/observability.md "Request tracing & postmortems").
@@ -113,6 +118,34 @@ def render(data: dict, path: str) -> str:
             lines.append(
                 f"  cumulative: host {last['host_ms_cum']:.3f} ms, "
                 f"device {last.get('device_ms_cum', 0):.3f} ms")
+    # Goodput table (ISSUE 19, obs/goodput.py): rendered whenever the
+    # ring's records carry a work record — per-iteration dispatched
+    # rows, the category split, the useful fraction, and prefix credit.
+    ledgered = [r for r in shown if isinstance(r, dict)
+                and isinstance(r.get("goodput"), dict)]
+    if ledgered:
+        lines.append("")
+        lines.append("goodput (token-rows; good% = useful/rows):")
+        lines.append(f"  {'iter':>6} {'rows':>7} {'good%':>6} "
+                     f"{'saved':>6}  waste split")
+        for rec in ledgered:
+            gp = rec["goodput"]
+            frac = gp.get("goodput_frac")
+            frac_s = (f"{frac * 100:6.1f}"
+                      if isinstance(frac, (int, float)) else f"{'—':>6}")
+            work = gp.get("work") if isinstance(gp.get("work"), dict) \
+                else {}
+            waste = " ".join(
+                f"{k}={v}" for k, v in sorted(work.items())
+                if k != "useful" and isinstance(v, int) and v > 0)
+            lines.append(
+                f"  {_s(rec.get('iter')):>6} {_s(gp.get('rows')):>7} "
+                f"{frac_s} {_s(gp.get('prefill_saved')):>6}  "
+                f"{waste or '—'}")
+        last_gp = ledgered[-1]["goodput"]
+        if isinstance(last_gp.get("goodput_frac_cum"), (int, float)):
+            lines.append(f"  cumulative goodput_frac: "
+                         f"{last_gp['goodput_frac_cum']:.4f}")
     reqs = data.get("requests") or []
     if reqs:
         lines.append("")
@@ -143,6 +176,65 @@ def render(data: dict, path: str) -> str:
                          if isinstance(v, (int, float)) else
                          f"  {k} = {_s(v)}")
     return "\n".join(lines)
+
+
+def goodput_aggregate(data: dict) -> dict | None:
+    """Aggregate the ring's goodput work records for the machine-readable
+    verdict (ISSUE 19): total rows, the category split, the overall
+    useful fraction, prefix credit, and whether every record satisfied
+    the partition invariant. None when no record carries a ledger."""
+    from triton_distributed_tpu.obs import goodput as goodput_mod
+
+    rows = 0
+    saved = 0
+    work: dict[str, int] = {}
+    n = 0
+    partition_ok = True
+    for rec in data.get("iterations") or []:
+        gp = rec.get("goodput") if isinstance(rec, dict) else None
+        if not isinstance(gp, dict):
+            continue
+        n += 1
+        if goodput_mod.check_partition(gp) is not None:
+            partition_ok = False
+        if isinstance(gp.get("rows"), int):
+            rows += gp["rows"]
+        if isinstance(gp.get("prefill_saved"), int):
+            saved += gp["prefill_saved"]
+        for k, v in (gp.get("work") or {}).items():
+            if isinstance(v, int):
+                work[k] = work.get(k, 0) + v
+    if not n:
+        return None
+    frac = (work.get("useful", 0) / rows) if rows else 1.0
+    return {"iterations": n, "rows": rows, "work": work,
+            "goodput_frac": round(frac, 6), "prefill_saved": saved,
+            "partition_ok": partition_ok}
+
+
+def dump_entry(path: str, data: dict, dump_problems: list[str]) -> dict:
+    """One dump's machine-readable entry: the original verdict fields
+    plus the structured incident content (trigger detail + chain,
+    engine config, counters, the goodput aggregate) so downstream
+    tooling never has to re-parse the rendered text."""
+    trig = data.get("trigger") or {}
+    return {"path": path,
+            "trigger": trig.get("kind"),
+            "trigger_detail": {"kind": trig.get("kind"),
+                               "iter": trig.get("iter"),
+                               "reason": trig.get("reason")},
+            "trigger_chain": [
+                {"kind": ev.get("kind"), "iter": ev.get("iter"),
+                 "reason": ev.get("reason")}
+                for ev in data.get("trigger_chain") or []
+                if isinstance(ev, dict)],
+            "replica": data.get("replica"),
+            "config": data.get("config") or {},
+            "counters": data.get("counters") or {},
+            "iterations": len(data.get("iterations") or []),
+            "requests": len(data.get("requests") or []),
+            "goodput": goodput_aggregate(data),
+            "valid": not dump_problems}
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -183,12 +275,7 @@ def main(argv: list[str] | None = None) -> int:
             continue
         dump_problems = flight.validate_dump(data, path=p)
         problems += dump_problems
-        dumps.append({"path": p,
-                      "trigger": (data.get("trigger") or {}).get("kind"),
-                      "replica": data.get("replica"),
-                      "iterations": len(data.get("iterations") or []),
-                      "requests": len(data.get("requests") or []),
-                      "valid": not dump_problems})
+        dumps.append(dump_entry(p, data, dump_problems))
         if not args.quiet:
             try:
                 print(render(data, p))

@@ -242,9 +242,19 @@ def merge_run(run_dir: str) -> tuple[dict, dict]:
     step_files = glob.glob(os.path.join(run_dir, "**",
                                         "steps.spans.json"),
                            recursive=True)
+    # Goodput timelines (ISSUE 19, obs/goodput.py): the counter tracks
+    # merge through the *.spans.json glob; the lane gates on either
+    # artifact — a serving run without token-level waste attribution
+    # lost the goodput evidence the alert rules stand on.
+    gp_files = (glob.glob(os.path.join(run_dir, "**",
+                                       "goodput.spans.json"),
+                          recursive=True)
+                + glob.glob(os.path.join(run_dir, "**", "timeline.json"),
+                            recursive=True))
     lanes = {"host": bool(span_ev), "commlint": bool(cl_ev),
              "kernel": bool(kp_ev), "device": bool(dev_ev),
              "request": bool(req_files), "steps": bool(step_files),
+             "goodput": bool(gp_files),
              "kernel_summaries": kp_summaries}
     return trace, lanes
 
@@ -356,6 +366,10 @@ def summarize(run_dir: str, lanes: dict, metrics: dict | None,
     if step_sec:
         lines.append("")
         lines += step_sec
+    gp_sec = goodput_lane(metrics, run_dir)
+    if gp_sec:
+        lines.append("")
+        lines += gp_sec
     flight_sec = flight_section(
         load_flight_dumps(run_dir) if flight_dumps is None
         else flight_dumps)
@@ -466,6 +480,73 @@ def step_profile_problems(flight_dumps: list[tuple]) -> list[str]:
             if not isinstance(rec, dict) or "phases" not in rec:
                 continue
             msg = stepprof_mod.check_partition(rec)
+            if msg is not None:
+                problems.append(f"{os.path.basename(p)}: {msg}")
+            if len(problems) > 20:
+                problems.append("... (truncated)")
+                return problems
+    return problems
+
+
+def load_timeline(run_dir: str) -> dict | None:
+    """The goodput interval time-series (obs/goodput.py
+    ``save_timeline``), or None when the run has no goodput lane."""
+    path = os.path.join(run_dir, "timeline.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def goodput_lane(metrics: dict | None, run_dir: str) -> list[str]:
+    """The goodput summary (docs/observability.md "Goodput & waste
+    attribution"): the cumulative useful fraction + per-category
+    dispatched-row totals from the snapshot, and the interval
+    time-series / fired alerts from ``timeline.json``."""
+    from triton_distributed_tpu.obs import metrics as obs_metrics
+
+    lines: list[str] = []
+    for key in sorted(metrics or {}):
+        base = key.split("{", 1)[0]
+        if base not in obs_metrics.GOODPUT_SERIES:
+            continue
+        m = metrics[key]
+        if isinstance(m, dict) and "value" in m:
+            lines.append(f"  {key} = {m['value']:g}")
+    tl = load_timeline(run_dir)
+    if tl is not None:
+        samples = tl.get("samples") or []
+        alerts = tl.get("alerts") or []
+        lines.append(
+            f"  timeline.json: {len(samples)} interval sample(s) "
+            f"(interval={tl.get('interval')} iters, "
+            f"window={tl.get('window')}), {len(alerts)} alert(s)")
+        for a in alerts[:8]:
+            lines.append(f"    ALERT [{a.get('rule')}] "
+                         f"{str(a.get('reason'))[:100]}")
+    if not lines:
+        return []
+    return ["goodput (obs/goodput.py — token-level waste "
+            "attribution):"] + lines
+
+
+def goodput_problems(flight_dumps: list[tuple]) -> list[str]:
+    """Partition-invariant violations (Σ work categories == rows
+    dispatched) across every flight-dump iteration record carrying a
+    goodput work record — what --check gates (the step-profile
+    discipline, applied to the token-row ledger)."""
+    from triton_distributed_tpu.obs import goodput as goodput_mod
+
+    problems: list[str] = []
+    for p, data, _err in flight_dumps:
+        for rec in (data or {}).get("iterations") or []:
+            gp = rec.get("goodput") if isinstance(rec, dict) else None
+            if not isinstance(gp, dict):
+                continue
+            msg = goodput_mod.check_partition(gp)
             if msg is not None:
                 problems.append(f"{os.path.basename(p)}: {msg}")
             if len(problems) > 20:
@@ -868,6 +949,12 @@ def main(argv: list[str] | None = None) -> int:
                          "default a serving run that lost its "
                          "per-iteration phase attribution fails --check "
                          "(pre-ISSUE-18 run dirs)")
+    ap.add_argument("--allow-missing-goodput", action="store_true",
+                    help="accept a serving-tier snapshot without the "
+                         "goodput lane (goodput.spans.json / "
+                         "timeline.json) — by default a serving run "
+                         "that lost its token-level waste attribution "
+                         "fails --check (pre-ISSUE-19 run dirs)")
     ap.add_argument("--allow-page-audit-violations", action="store_true",
                     help="report page-audit (refcount/COW sanitizer) "
                          "violations without failing --check — by "
@@ -1011,8 +1098,21 @@ def main(argv: list[str] | None = None) -> int:
             "serving series present but the step-phase lane "
             "(steps.spans.json) is missing — host-bubble attribution "
             "lost (--allow-missing-step-profile to accept)")
+    # Goodput lane (ISSUE 19): a serving snapshot without the work
+    # ledger lost its token-level waste attribution; and every goodput
+    # work record in the flight dumps must satisfy the partition
+    # invariant (Σ categories == rows dispatched).
+    if (serving_present and not lanes.get("goodput")
+            and not args.allow_missing_goodput):
+        failures.append(
+            "serving series present but the goodput lane "
+            "(goodput.spans.json / timeline.json) is missing — "
+            "token-level waste attribution lost "
+            "(--allow-missing-goodput to accept)")
     failures += [f"step profile: {p}" for p in
                  step_profile_problems(flight_dumps)]
+    failures += [f"goodput: {p}" for p in
+                 goodput_problems(flight_dumps)]
     failures += [f"flight dump: {p}" for p in
                  flight_problems(flight_dumps)]
     demotions = degradation_count(metrics)

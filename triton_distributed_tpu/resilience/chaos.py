@@ -776,6 +776,168 @@ def spec_serve_selftest() -> list[CaseResult]:
     return cases
 
 
+def goodput_serve_selftest() -> list[CaseResult]:
+    """Two rows per --all sweep for the goodput work ledger (ISSUE 19,
+    obs/goodput.py): (a) ``preemption_storm`` — an undersized page pool
+    forces recompute-on-resume; the ledger must attribute a nonzero
+    ``recompute`` lane whose total reconciles EXACTLY with the
+    per-request ``recompute_tokens`` counters, with the partition
+    invariant (Σ categories == rows dispatched) holding on every record
+    and token parity vs a sequential serve; (b) ``spec_fault_shift`` —
+    a seeded verify-step fault falls the spec lane back to one-token
+    decode; the ledger must show ``spec_rejected`` rows from the live
+    spec phase AND the fallback's recompute shift, again with the
+    partition invariant and parity."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from triton_distributed_tpu.models import (
+        Engine, init_dense_llm, tiny_config,
+    )
+    from triton_distributed_tpu.obs import goodput as obs_goodput
+    from triton_distributed_tpu.runtime import initialize_distributed
+    from triton_distributed_tpu.serving.loop import ServingEngine
+
+    cfg = tiny_config()
+    params = init_dense_llm(jax.random.key(0), cfg)
+    ctx1 = initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                  devices=jax.devices()[:1])
+    oracle = Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                    page_size=4)
+    prompts = [[3, 9] * 4, [7, 7, 7, 7, 7], [11, 4, 11, 4, 11, 4]]
+    gens = [10, 8, 8]
+    golden = {}
+    for i, (p, g) in enumerate(zip(prompts, gens)):
+        golden[i] = np.asarray(
+            oracle.serve(jnp.asarray([p], jnp.int32), gen_len=g)
+        )[0].tolist()
+
+    def ledgered_serve(se):
+        gl = obs_goodput.WorkLedger(interval=2)
+        prev = obs_goodput.set_ledger(gl)
+        reqs = []
+        try:
+            for i, (p, g) in enumerate(zip(prompts, gens)):
+                req, res = se.submit(p, g, req_id=f"chaos-gp-{i}",
+                                     priority=1 if i == 0 else 0)
+                assert res.name == "ADMITTED", res
+                reqs.append(req)
+            it = 0
+            while se.sched.has_work():
+                se.step()
+                it += 1
+                assert it < 10_000, "goodput chaos serve did not drain"
+        finally:
+            obs_goodput.set_ledger(prev)
+        return reqs, gl
+
+    def partition_violations(gl):
+        return [p for p in (obs_goodput.check_partition(r)
+                            for r in gl.records()) if p is not None]
+
+    cases = []
+
+    # Row (a): preemption storm — an undersized pool evicts mid-decode;
+    # the recompute lane must light up and reconcile with the
+    # per-request counters.
+    t0 = time.time()
+    diags: list[str] = []
+    try:
+        eng = Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                     page_size=4)
+        # 7 pages against 3 requests wanting up to 5 each: guaranteed
+        # eviction pressure (the spec chaos row's storm shape).
+        se = ServingEngine(eng, max_batch=3, num_pages=7,
+                           prefill_chunk=4)
+        reqs, gl = ledgered_serve(se)
+        parity = all(r.tokens == golden[i] for i, r in enumerate(reqs))
+        preempted = [r.req_id for r in reqs if r.preemptions > 0]
+        bad = partition_violations(gl)
+        cum = gl.cumulative_all()
+        req_recompute = sum(r.recompute_tokens for r in reqs)
+        reconciled = req_recompute == cum.get("recompute", 0)
+        diags += [f"preempted: {preempted}",
+                  f"ledger recompute rows: {cum.get('recompute', 0)}",
+                  f"Σ req.recompute_tokens: {req_recompute}",
+                  f"partition violations: {bad[:3]}",
+                  f"parity vs sequential serve: {parity}"]
+        verdict = ("detected" if preempted and cum.get("recompute", 0) > 0
+                   and reconciled and not bad and parity else "error")
+    except Exception as exc:                        # died = the failure
+        verdict = "error"
+        diags.append(f"{type(exc).__name__}: {exc}")
+    cases.append(CaseResult(
+        op="goodput_serve", mesh="1", fault="preemption_storm",
+        verdict=verdict, detected_by="work_ledger",
+        expected=("detected",), ok=verdict == "detected", n_fired=1,
+        n_violations=0, diagnostics=diags,
+        elapsed_s=round(time.time() - t0, 3)))
+
+    # Row (b): seeded verify fault — live spec rows attribute
+    # spec_rejected; the fallback's preempt-and-rebuild shifts waste
+    # into the recompute lane. The ledger must show BOTH.
+    t0 = time.time()
+    diags = []
+    try:
+        eng = Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                     page_size=4)
+        se = ServingEngine(eng, max_batch=3, num_pages=24,
+                           prefill_chunk=4, spec_k=2)
+        real_verify = se._verify_jit
+        fired = {"n": 0}
+        calls = {"n": 0}
+
+        def faulty_verify():
+            fn = real_verify()
+
+            def wrapper(*a, **kw):
+                # Let two live verify launches land first so the
+                # spec_rejected lane has pre-fault evidence.
+                calls["n"] += 1
+                if fired["n"] == 0 and calls["n"] >= 3:
+                    fired["n"] += 1
+                    raise FaultInjectionError(
+                        "chaos: injected verify-step fault "
+                        "(kernel=serving_verify occurrence=2)")
+                return fn(*a, **kw)
+
+            return wrapper
+
+        se._verify_jit = faulty_verify
+        import warnings as _w
+
+        with _w.catch_warnings():
+            _w.simplefilter("ignore", RuntimeWarning)
+            reqs, gl = ledgered_serve(se)
+        parity = all(r.tokens == golden[i] for i, r in enumerate(reqs))
+        bad = partition_violations(gl)
+        cum = gl.cumulative_all()
+        req_rejected = sum(r.rejected_tokens for r in reqs)
+        reconciled = req_rejected == cum.get("spec_rejected", 0)
+        diags += [f"fault fired: {fired['n']}",
+                  f"spec fallback: {se._spec_fallback}",
+                  f"ledger spec_rejected rows: "
+                  f"{cum.get('spec_rejected', 0)}",
+                  f"ledger recompute rows: {cum.get('recompute', 0)}",
+                  f"partition violations: {bad[:3]}",
+                  f"parity vs sequential serve: {parity}"]
+        verdict = ("detected" if fired["n"] and se._spec_fallback
+                   and cum.get("spec_rejected", 0) > 0
+                   and cum.get("recompute", 0) > 0
+                   and reconciled and not bad and parity else "error")
+    except Exception as exc:
+        verdict = "error"
+        diags.append(f"{type(exc).__name__}: {exc}")
+    cases.append(CaseResult(
+        op="goodput_serve", mesh="1", fault="spec_fault_shift",
+        verdict=verdict, detected_by="work_ledger",
+        expected=("detected",), ok=verdict == "detected", n_fired=1,
+        n_violations=0, diagnostics=diags,
+        elapsed_s=round(time.time() - t0, 3)))
+    return cases
+
+
 def prefix_serve_selftest() -> list[CaseResult]:
     """Two rows per --all sweep for the prefix-reuse subsystem
     (ISSUE 15, docs/serving.md "Prefix cache"):
@@ -1658,6 +1820,15 @@ def sweep(ops, faults, ranks, *, seed: int = 0,
         # a seeded rank-loss evacuation must each leave a flight dump
         # that obs.postmortem --check validates rc=0.
         for case in flight_recorder_selftest():
+            cases.append(case)
+            failed += not case.ok
+            _print_case(case, verbose)
+        # Goodput-ledger rows (ISSUE 19): a preemption storm must light
+        # the recompute lane (reconciled with per-request counters,
+        # partition invariant on every record); a seeded verify fault
+        # must show spec_rejected rows AND the fallback's recompute
+        # shift — both with token parity.
+        for case in goodput_serve_selftest():
             cases.append(case)
             failed += not case.ok
             _print_case(case, verbose)

@@ -34,6 +34,7 @@ import time
 
 import numpy as np
 
+from triton_distributed_tpu.obs import goodput as obs_goodput
 from triton_distributed_tpu.obs import reqtrace as obs_reqtrace
 from triton_distributed_tpu.obs import stepprof as obs_stepprof
 from triton_distributed_tpu.serving.scheduler import AdmitResult
@@ -125,6 +126,9 @@ def request_records(reqs) -> list[dict]:
             "drafted": r.drafted_tokens,
             "accepted": r.accepted_draft_tokens,
             "prefix_hit_tokens": r.prefix_hit_tokens_total,
+            "recompute_tokens": r.recompute_tokens,
+            "rejected_tokens": r.rejected_tokens,
+            "wasted_tokens": r.wasted_tokens,
             "final_backend": r.final_backend,
             "state": r.state.name,
         }
@@ -1405,6 +1409,132 @@ def dryrun(json_path: str | None, flight_dir: str | None = None) -> int:
                   "w") as f:
             json.dump(step_profile, f, indent=2)
 
+    # Phase 13 (ISSUE 19) — goodput work ledger: EVERY serving tier in
+    # the sweep must produce per-iteration work records whose categories
+    # PARTITION the dispatched token-rows (goodput.check_partition), and
+    # the ledger's recompute / spec_rejected lanes must reconcile
+    # EXACTLY with the per-request waste counters (request_records
+    # carries them — both are fed by the same instrumentation sites).
+    # The xla tier replays twice under a deterministic counter clock and
+    # must produce byte-identical record streams; the fleet's records
+    # must carry >= 2 replica lanes. timeline.json + goodput.spans.json
+    # land next to the flight dumps so ``obs.report --check`` gates the
+    # goodput lane on CI's artifact.
+    goodput13: dict[str, dict] = {}
+
+    class _Tick13:
+        """Deterministic counter clock: the loop's only time source, so
+        two replays of the same trace are byte-identical."""
+
+        def __init__(self):
+            self.t = 0.0
+
+        def __call__(self) -> float:
+            self.t = round(self.t + 0.001, 6)
+            return self.t
+
+    def _ledgered_replay(name: str, se_, trace_):
+        gl13 = obs_goodput.WorkLedger(interval=2)
+        prev13 = obs_goodput.set_ledger(gl13)
+        try:
+            rep13 = run_trace(se_, [dict(t) for t in trace_])
+        finally:
+            obs_goodput.set_ledger(prev13)
+        recs13 = gl13.records()
+        if not recs13:
+            failures.append(f"phase 13: {name} produced no work records "
+                            "— the ledger hook regressed")
+            goodput13[name] = {"iterations": 0, "invariant_ok": False}
+            return gl13, rep13
+        bad13 = []
+        for r in recs13:
+            prob = obs_goodput.check_partition(r)
+            if prob is not None:
+                bad13.append(f"iter {r['it']}: {prob}")
+        if bad13:
+            failures.append(
+                f"phase 13: {name} work records break the partition "
+                f"invariant: {bad13[:4]}")
+        cum13 = gl13.cumulative_all()
+        reqs13 = rep13.get("requests") or []
+        req_recompute = sum(r.recompute_tokens for r in reqs13)
+        req_rejected = sum(r.rejected_tokens for r in reqs13)
+        if req_recompute != cum13.get("recompute", 0):
+            failures.append(
+                f"phase 13: {name} per-request recompute_tokens "
+                f"({req_recompute}) do not reconcile with the ledger's "
+                f"recompute lane ({cum13.get('recompute', 0)})")
+        if req_rejected != cum13.get("spec_rejected", 0):
+            failures.append(
+                f"phase 13: {name} per-request rejected_tokens "
+                f"({req_rejected}) do not reconcile with the ledger's "
+                f"spec_rejected lane ({cum13.get('spec_rejected', 0)})")
+        goodput13[name] = {
+            "iterations": len(recs13),
+            "rows": cum13.get("rows", 0),
+            "work": {c: cum13[c] for c in obs_goodput.CATEGORIES
+                     if c in cum13},
+            "goodput_frac": (round(cum13.get("useful", 0)
+                                   / cum13["rows"], 4)
+                             if cum13.get("rows") else 1.0),
+            "prefill_saved": cum13.get("prefill_saved", 0),
+            "invariant_ok": not bad13,
+            "reconciled": (req_recompute == cum13.get("recompute", 0)
+                           and req_rejected
+                           == cum13.get("spec_rejected", 0)),
+        }
+        return gl13, rep13
+
+    _, se13a = _tiny_serving(engine, max_batch=4, num_pages=8,
+                             prefill_chunk=4, max_waiting=8,
+                             clock=_Tick13())
+    gl13a, _ = _ledgered_replay("xla", se13a, trace)
+    if not any(r["work"].get("useful", 0) > 0 for r in gl13a.records()):
+        failures.append("phase 13: no xla iteration attributed useful "
+                        "rows — the decode/prefill hooks regressed")
+    # Byte-determinism: a second fresh tier under its own counter clock
+    # replaying the SAME trace must serialize to the SAME bytes.
+    _, se13b = _tiny_serving(engine, max_batch=4, num_pages=8,
+                             prefill_chunk=4, max_waiting=8,
+                             clock=_Tick13())
+    gl13b, _ = _ledgered_replay("xla-replay", se13b, trace)
+    if (json.dumps(gl13a.records(), sort_keys=True)
+            != json.dumps(gl13b.records(), sort_keys=True)):
+        failures.append(
+            "phase 13: two replays of the same trace under the counter "
+            "clock produced different work-record bytes — the ledger "
+            "leaked a wall-clock or ordering dependence")
+    se13mk = ServingEngine(mk_engine, max_batch=2, num_pages=2,
+                           prefill_chunk=128)
+    gl13mk, _ = _ledgered_replay("megakernel", se13mk, mk_trace)
+    se13dg = DisaggServingEngine(dg_pe, dg_de, max_batch=2, num_pages=5,
+                                 prefill_chunk=4, block_pages=1)
+    gl13dg, _ = _ledgered_replay("disagg", se13dg, dg_trace)
+    if gl13dg.cumulative_all().get("overhead", 0) <= 0:
+        failures.append(
+            "phase 13: disagg replay attributed no overhead rows — the "
+            "KV-migration transport accounting regressed")
+    router13 = _mk_fleet(2)
+    gl13fl, _ = _ledgered_replay(
+        "fleet", router13,
+        build_trace(LoadSpec(n_requests=6, seed=13,
+                             mean_interarrival_iters=0.0)))
+    fl13_reps = sorted({r.get("replica") for r in gl13fl.records()}
+                       - {None})
+    if len(fl13_reps) < 2:
+        failures.append(
+            f"phase 13: fleet work records carry replica lanes "
+            f"{fl13_reps} — per-replica ledger attribution regressed")
+    goodput13.setdefault("fleet", {})["replicas"] = fl13_reps
+    report["goodput"] = goodput13
+    if flight_dir:
+        # Next to the flight dumps: the fleet ledger's counter tracks
+        # (richest lane set — per-replica series) + interval timeline,
+        # so CI's obs artifact carries the goodput evidence and
+        # ``obs.report --check`` gates the lane.
+        gl13fl.save(os.path.join(flight_dir, "goodput.spans.json"))
+        gl13fl.save_timeline(os.path.join(flight_dir, "timeline.json"))
+
     if audit_prev is None:
         os.environ.pop("TDTPU_PAGE_AUDIT", None)
     else:
@@ -1520,10 +1650,15 @@ def serving_bench_rung(n_streams: int = 8, prompt_len: int = 128,
     # polluted by the rung's phase records.
     prof = obs_stepprof.StepProfiler()
     prev_prof = obs_stepprof.set_profiler(prof)
+    # Work ledger of the MEASURED replay only (ISSUE 19): same private
+    # swap discipline as the profiler above.
+    gl = obs_goodput.WorkLedger()
+    prev_gl = obs_goodput.set_ledger(gl)
     try:
         report = run_trace(se, make_trace(1))
     finally:
         obs_stepprof.set_profiler(prev_prof)
+        obs_goodput.set_ledger(prev_gl)
     prof_recs = prof.records()
     reqs = report.pop("requests")
     out = {
@@ -1548,6 +1683,12 @@ def serving_bench_rung(n_streams: int = 8, prompt_len: int = 128,
         from triton_distributed_tpu.obs.metrics import percentile
         out["serve_step_host_ms_p99"] = round(
             percentile([r["host_ms"] for r in prof_recs], 99), 4)
+    if gl.has_records():
+        # Goodput rung (ISSUE 19): the cumulative useful fraction of
+        # dispatched device token-rows over the measured replay — the
+        # waste (spec rejections, recompute, overhead, padding) the
+        # ledger tracks upward toward 1.0.
+        out["serve_goodput_frac"] = round(gl.goodput_frac(), 4)
     if spec_k > 0:
         drafted = sum(r.drafted_tokens for r in reqs)
         accepted = sum(r.accepted_draft_tokens for r in reqs)

@@ -57,6 +57,7 @@ from triton_distributed_tpu.models.kv_cache import (
     PageAllocator, init_kv_cache, init_paged_model_cache, kv_cache_specs,
     paged_cache_specs,
 )
+from triton_distributed_tpu.obs import goodput as obs_goodput
 from triton_distributed_tpu.obs import metrics as obs_metrics
 from triton_distributed_tpu.obs import reqtrace as obs_reqtrace
 from triton_distributed_tpu.obs import stepprof as obs_stepprof
@@ -646,6 +647,12 @@ class ServingEngine:
                     self._mk_decode_failed(
                         [r for r in ready if r not in evicted], exc)
                     return [], evicted
+                gl = obs_goodput.get_ledger()
+                if gl is not None and gl.active():
+                    # One COW copy moves a page of resident KV to a
+                    # private page — pure overhead rows (ISSUE 19).
+                    gl.dispatch(self.page)
+                    gl.add("overhead", self.page)
                 with obs_trace.span("serving.prefix_cow", req=req.req_id,
                                     src=old, dst=new):
                     pass
@@ -785,6 +792,13 @@ class ServingEngine:
             # under a fake clock; every phase below telescopes into it.
             sp.begin_iteration(self._iter, now, clock=self.clock,
                                replica=self.replica_id)
+        gl = obs_goodput.get_ledger()
+        if gl is not None:
+            # Work ledger (ISSUE 19): every device token-row the
+            # iteration dispatches below attributes into exactly one
+            # goodput category; the partition closes in the finally.
+            gl.begin_iteration(self._iter, now, clock=self.clock,
+                               replica=self.replica_id)
         try:
             with obs_stepprof.phase("preflight"):
                 fleet_event = self._fleet_preflight()
@@ -807,6 +821,11 @@ class ServingEngine:
                 summary["fleet"] = fleet_event
             return summary
         finally:
+            # Goodput close runs FIRST: _step_profile_close clears
+            # _last_flight_rec, and both closes patch that same dict.
+            if gl is not None and gl.active():
+                grec = gl.finish_iteration(self.clock())
+                self._goodput_close(grec, gl)
             if sp is not None and sp.active():
                 rec = sp.finish_iteration(self.clock())
                 self._step_profile_close(rec)
@@ -1179,6 +1198,49 @@ class ServingEngine:
                 f"step-phase '{phase_name}' milliseconds per iteration "
                 "(obs/stepprof.py taxonomy)").observe(ms)
 
+    def _goodput_close(self, rec: dict, gl) -> None:
+        """Fold the finished iteration's work record (ISSUE 19) into
+        the flight ring and the metrics registry, then drain any fired
+        windowed alert into a ``goodput_regression`` flight dump. Runs
+        in step()'s ``finally``, BEFORE _step_profile_close (which
+        clears the shared flight-record reference)."""
+        if not rec:
+            return
+        flight_rec = getattr(self, "_last_flight_rec", None)
+        if flight_rec is not None and "goodput" not in flight_rec:
+            # Dumps carry the work partition alongside the phase vector
+            # — obs.report --check re-verifies it on every dumped
+            # record, postmortem renders the goodput table from it.
+            flight_rec["goodput"] = {
+                "rows": rec["rows"],
+                "work": rec["work"],
+                "goodput_frac": rec["goodput_frac"],
+                "prefill_saved": rec["prefill_saved"],
+                "goodput_frac_cum": rec["goodput_frac_cum"],
+            }
+        if self._observing():
+            reg = self._reg()
+            reg.gauge(
+                obs_metrics.SERVE_GOODPUT_FRAC,
+                "cumulative useful/dispatched device token-row fraction "
+                "(obs/goodput.py taxonomy — the waste categories are "
+                "the labeled work-tokens counter)"
+                ).set(rec["goodput_frac_cum"])
+            for cat, n in rec["work"].items():
+                reg.counter(
+                    obs_metrics.WORK_TOKENS,
+                    "device token-rows dispatched, by goodput category "
+                    "(obs/goodput.py: useful / spec_rejected / "
+                    "recompute / overhead / idle)",
+                    labels={"category": cat}).inc(n)
+        # Windowed alert rules (goodput below floor / waste spiking for
+        # W intervals) fire through the established trigger chain.
+        for alert in gl.consume_alerts():
+            self.flight.note("goodput_regression", alert["reason"],
+                             self._iter, rule=alert["rule"])
+            self._flight_dump("goodput_regression",
+                              f"{alert['rule']}: {alert['reason']}")
+
     def _prefill_lane(self, req: Request):
         """(engine, slice_fn, logits_fn) the prefill stage runs through
         for ``req``. The disaggregated tier (disagg/engine.py)
@@ -1491,6 +1553,23 @@ class ServingEngine:
         if rt is not None:
             rt.span(req.req_id, "prefill_slice", t0, self.clock(),
                     start=start, tokens=len(real))
+        # Goodput attribution (ISSUE 19): the slice launch always
+        # computes ``chunk`` rows. Rows covering positions this request
+        # already computed before a preempt/evacuation/fallback are
+        # recompute; fresh positions are useful (cold prefill); the
+        # fixed-shape padding past the real tokens is idle. The
+        # per-request counter accrues unconditionally so loadgen's
+        # request_records reconcile against the ledger aggregates.
+        redo = max(0, min(start + len(real), req.computed_high) - start)
+        if redo:
+            req.recompute_tokens += redo
+        req.computed_high = max(req.computed_high, start + len(real))
+        gl = obs_goodput.get_ledger()
+        if gl is not None and gl.active():
+            gl.dispatch(self.chunk)
+            gl.add("recompute", redo)
+            gl.add("useful", len(real) - redo)
+            gl.add("idle", self.chunk - len(real))
         req.prefill_pos = min(start + self.chunk, T)
         done = req.prefill_pos >= T
         if done:
@@ -1591,6 +1670,13 @@ class ServingEngine:
         if rt is not None:
             rt.span(req.req_id, "prefix_gather", t0, self.clock(),
                     hit_tokens=hit, restart=restart)
+        if restart:
+            # Avoided-work credit (ISSUE 19): the skipped prefix rows
+            # were never dispatched — outside the partition, reported
+            # alongside it as prefill_saved.
+            gl = obs_goodput.get_ledger()
+            if gl is not None and gl.active():
+                gl.credit_saved(restart)
         if restart and self._observing():
             self._reg().counter(
                 obs_metrics.PREFIX_TOKENS_SAVED,
@@ -1717,6 +1803,13 @@ class ServingEngine:
                 tok, self._cache = eng._decode_run(jnp.asarray(toks), cache)
             with obs_stepprof.phase("device_wait"):
                 tok_np = np.asarray(tok)    # host sync: the loop needs them
+        gl = obs_goodput.get_ledger()
+        if gl is not None and gl.active():
+            # One-token dense step: max_batch rows, one committed token
+            # per ready slot, empty slots pad the fixed shape.
+            gl.dispatch(self.max_batch)
+            gl.add("useful", len(ready))
+            gl.add("idle", self.max_batch - len(ready))
         self._decode_tail(ready,
                           {r.req_id: [int(tok_np[r.slot])] for r in ready},
                           t0, eng._jit_compiled_last_call)
@@ -1761,6 +1854,15 @@ class ServingEngine:
                                                  table)
             with obs_stepprof.phase("device_wait"):
                 tok_np = np.asarray(tok)  # host sync: the loop needs them
+        gl = obs_goodput.get_ledger()
+        if gl is not None and gl.active():
+            # The persistent program covers EVERY slot block — use the
+            # decoder's own launch accounting (megakernel/serving.py),
+            # not an assumption about the lane's shape.
+            rows = self._mk.last_step_rows
+            gl.dispatch(rows)
+            gl.add("useful", len(ready))
+            gl.add("idle", rows - len(ready))
         self._decode_tail(ready,
                           {r.req_id: [int(tok_np[r.slot])] for r in ready},
                           t0, self._mk.last_step_cold)
@@ -1867,7 +1969,30 @@ class ServingEngine:
             accepted_drafts += len(acc) - 1
             req.drafted_tokens += len(d)
             req.accepted_draft_tokens += len(acc) - 1
+            # Verify rows past the accepted prefix are rolled back —
+            # per-request waste evidence (ISSUE 19), unconditional so
+            # request_records reconcile against the ledger.
+            req.rejected_tokens += (1 + len(d)) - len(acc)
         self._last_spec = (drafted_total, accepted_drafts)
+        gl = obs_goodput.get_ledger()
+        if gl is not None and gl.active():
+            # The attribution rule lives with the acceptance rule
+            # (serving/spec.py): accepted rows are useful, live rows
+            # past the accepted prefix are spec_rejected, padding
+            # columns and empty slots are idle.
+            from triton_distributed_tpu.serving.spec import (
+                attribute_verify_rows,
+            )
+
+            rows = (self._mk.last_step_rows if self._mk is not None
+                    else int(ver_np.shape[0]) * int(ver_np.shape[1]))
+            split = attribute_verify_rows(
+                rows,
+                [1 + len(drafts.get(r.req_id, [])) for r in ready],
+                [len(accepted[r.req_id]) for r in ready])
+            gl.dispatch(rows)
+            for cat, n in split.items():
+                gl.add(cat, n)
         if self._observing():
             reg = self._reg()
             reg.counter(obs_metrics.SPEC_DRAFT_TOKENS,
@@ -1930,6 +2055,9 @@ class ServingEngine:
                 ts = new_tokens[req.req_id]
                 req.tokens.extend(ts)
                 req.kv_len += len(ts)
+                # Decode appends KV for the consumed positions — the
+                # recompute detector's lifetime high-water (ISSUE 19).
+                req.computed_high = max(req.computed_high, req.kv_len)
                 if req.done:
                     self._finish(req)
 

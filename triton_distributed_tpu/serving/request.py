@@ -94,6 +94,16 @@ class Request:
     # request_records carries.
     prefix_hit_tokens: int = 0       # this admission's hit (reset on preempt)
     prefix_hit_tokens_total: int = 0
+    # Goodput / waste-attribution lane (ISSUE 19, obs/goodput.py): the
+    # per-request halves of the work ledger's recompute/spec_rejected
+    # categories — loadgen's request_records reconcile their sums
+    # against the ledger aggregates. ``computed_high`` is the lifetime
+    # high-water of computed KV positions (it survives preemption,
+    # unlike kv_len/prefill_pos) — re-prefilled rows below it are
+    # recompute, above it cold useful work.
+    recompute_tokens: int = 0        # re-prefilled rows of lost KV
+    rejected_tokens: int = 0         # verify rows past the accepted prefix
+    computed_high: int = 0           # recompute detector (never resets)
     _prefix_partial: int | None = None   # pinned partially-matched page
     final_backend: str | None = None  # engine backend at finish time
     arrival_seq: int = -1            # admission order stamp (scheduler)
@@ -124,6 +134,13 @@ class Request:
     @property
     def done(self) -> bool:
         return len(self.tokens) >= self.max_new_tokens
+
+    @property
+    def wasted_tokens(self) -> int:
+        """Device token-rows this request burned beyond its useful work
+        (ISSUE 19): recompute-on-resume re-prefills + rejected verify
+        rows. COW/migration overhead is pool-level, not per-request."""
+        return self.recompute_tokens + self.rejected_tokens
 
     # -- page-budget accounting view --------------------------------------
     @property

@@ -27,6 +27,36 @@ class SpecConfigError(ValueError):
     ``_check_decode_step_config`` style)."""
 
 
+def attribute_verify_rows(rows: int, wins, accepted) -> dict[str, int]:
+    """Goodput attribution for ONE draft-and-verify launch (ISSUE 19,
+    obs/goodput.py taxonomy): ``rows`` is the launch's total dispatched
+    token-rows (B × W — every slot pays the full compiled window),
+    ``wins`` the live per-slot candidate windows (1 + draft length) and
+    ``accepted`` the per-slot accepted token counts (longest accepted
+    prefix + the bonus token). The rule lives HERE, next to the
+    acceptance rule it mirrors, so the serving loop and the tests share
+    one definition:
+
+    * accepted rows committed output → ``useful``;
+    * live rows past the accepted prefix (rolled back by the
+      append-then-truncate discipline) → ``spec_rejected``;
+    * padding columns past each live window + whole empty slots →
+      ``idle``.
+
+    Σ of the three == ``rows`` by construction; the serving loop's work
+    ledger still cross-checks it against the independently recorded
+    dispatch width (check_partition)."""
+    live = int(sum(int(w) for w in wins))
+    acc = int(sum(int(a) for a in accepted))
+    if acc > live or live > rows:
+        raise SpecConfigError(
+            f"verify-row attribution impossible: accepted {acc} rows of "
+            f"{live} live of {rows} dispatched — each bound must not "
+            "exceed the next (arguments rows/wins/accepted)")
+    return {"useful": acc, "spec_rejected": live - acc,
+            "idle": rows - live}
+
+
 def _env_int(var: str, default: int) -> int:
     try:
         return int(os.environ.get(var, "") or default)
