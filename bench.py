@@ -972,6 +972,40 @@ def _serving_metric():
         out.update(wm)
     except Exception as e:
         out["serving_warm_error"] = f"{type(e).__name__}: {str(e)[:120]}"
+    # Round 20 (ISSUE 20): the async double-buffered loop races the
+    # sync rung in the same window — same workload, same model, the
+    # only difference is that iteration i+1's host planning overlaps
+    # iteration i's device step. `serve_host_bubble_frac_async` must
+    # come out strictly below the sync rung's bubble; the TTFT/TPOT
+    # ride alongside. Additive.
+    try:
+        ab = serving_bench_rung(n_streams=8, prompt_len=128, max_new=16,
+                                async_loop=True)
+        out["serve_tokens_per_s_async"] = \
+            ab["serve_tokens_per_s_concurrent"]
+        out["serve_ttft_p99_ms_async"] = ab["serve_ttft_p99_ms"]
+        out["serve_host_bubble_frac_async"] = \
+            ab.get("serve_host_bubble_frac")
+        out["serve_step_host_ms_p99_async"] = \
+            ab.get("serve_step_host_ms_p99")
+    except Exception as e:
+        out["serving_async_error"] = f"{type(e).__name__}: {str(e)[:120]}"
+    # Round 20 (ISSUE 20): the host KV-tier rung — family chains
+    # evicted to pinned host RAM by a cold burst, then warm admissions
+    # restore them through the checksummed stream. The swap-in TTFT p99
+    # sits between the cold rung's and the device-warm rung's in the
+    # same window; `kv_host_restore_ms` is the per-restore p99.
+    # Additive.
+    try:
+        from triton_distributed_tpu.serving.loadgen import (
+            kvtier_serving_bench_rung,
+        )
+
+        out.update(kvtier_serving_bench_rung(n_streams=8, prompt_len=128,
+                                             max_new=16))
+    except Exception as e:
+        out["serving_kvtier_error"] = \
+            f"{type(e).__name__}: {str(e)[:120]}"
     # Round 10: the disaggregated tier races the monolithic rung in the
     # same window (`serve_tokens_per_s_disagg` — prefill role on chip 0,
     # decode role on chip 1, checksummed KV-migration streams included

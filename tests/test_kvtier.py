@@ -1,0 +1,375 @@
+"""Host-RAM KV tier + async double-buffered loop (ISSUE 20,
+docs/serving.md "KV tiering & the async loop").
+
+The load-bearing contract: a cache-only chain evicted under pool
+pressure swaps its pages to bounded host RAM instead of dying; a later
+admission whose prompt extends past the device-resident hit RESTORES
+the chain through the checksummed stream and must be TOKEN-IDENTICAL
+to the cold oracle with zero cold prefill over the restored span.
+Integrity failures degrade to cold prefill — never wrong tokens. The
+async plan/commit split is a pure reordering: same tokens as the sync
+loop, including preempt/resume, with the page auditor clean and the
+named ``use-after-swap-out`` hazard flagged when a launch reads a
+swapped page.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.analysis.page_audit import PageAuditor
+from triton_distributed_tpu.models.config import tiny_config
+from triton_distributed_tpu.models.dense import init_dense_llm
+from triton_distributed_tpu.models.engine import Engine
+from triton_distributed_tpu.models.kv_cache import PageAllocator
+from triton_distributed_tpu.obs import goodput as obs_goodput
+from triton_distributed_tpu.obs import stepprof as obs_stepprof
+from triton_distributed_tpu.runtime import initialize_distributed
+from triton_distributed_tpu.serving.kvtier import (
+    HostKVTier, HostTierError, HostTierIntegrityError,
+)
+from triton_distributed_tpu.serving.loop import ServingEngine
+
+
+@pytest.fixture(scope="module")
+def ctx1():
+    return initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                  devices=jax.devices()[:1])
+
+
+@pytest.fixture(scope="module")
+def tiny(ctx1):
+    cfg = tiny_config()
+    params = init_dense_llm(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def _golden(engine, prompt, gen):
+    return np.asarray(
+        engine.serve(jnp.asarray([prompt], jnp.int32), gen_len=gen)
+    )[0].tolist()
+
+
+# ---------------------------------------------------------------------------
+# HostKVTier — pure-host unit contract (no device, no serving loop).
+# ---------------------------------------------------------------------------
+
+def _fetch_const(page):
+    """A deterministic fake pool page: bytes derived from the page id,
+    so checksum round-trips are meaningful."""
+    k = np.full((2, 4), float(page) + 0.5, np.float32)
+    v = np.full((2, 4), float(page) - 0.25, np.float32)
+    return k, v
+
+
+def test_disabled_tier_refuses_everything():
+    tier = HostKVTier(0, page_size=4, fetch=_fetch_const)
+    assert not tier.enabled
+    assert tier.swap_out([1, 2, 3, 4], 0) is False
+    assert tier.match([1, 2, 3, 4, 5], 0) == []
+    assert tier.pages == 0 and tier.swap_outs == 0
+
+
+def test_swap_out_and_match_walk():
+    tier = HostKVTier(1 << 20, page_size=4, fetch=_fetch_const)
+    toks = list(range(30, 42))                   # 3 pages of 4
+    tier.swap_out(toks[:4], 0)
+    tier.swap_out(toks[:8], 1)
+    tier.swap_out(toks[:12], 2)
+    assert tier.pages == 3 and tier.swap_outs == 3
+    # Longer prompt: all three chunks extend it.
+    assert len(tier.match(toks + [7, 7], 0)) == 3
+    # Identical prompt: the full-prompt chunk is capped out (at least
+    # one token must prefill for the next-token logits).
+    assert len(tier.match(toks, 0)) == 2
+    # From a device-resident hit boundary: the walk starts mid-chain.
+    assert tier.match(toks + [7], 4) == [tuple(toks[:8]), tuple(toks[:12])]
+    # Unaligned start / diverged tokens find nothing.
+    assert tier.match(toks + [7], 2) == []
+    assert tier.match(toks[:4] + [99, 99, 99, 99, 1], 4) == []
+    # The walk stops at the first missing chunk — no holes.
+    assert tier.drop_chain([toks[:8]]) == 1
+    assert tier.match(toks + [7, 7], 0) == [tuple(toks[:4])]
+
+
+def test_swap_out_dedups_by_content():
+    tier = HostKVTier(1 << 20, page_size=4, fetch=_fetch_const)
+    assert tier.swap_out([1, 2, 3, 4], 0)
+    held = tier.bytes_held
+    # The same token prefix from a DIFFERENT pool page is the same KV
+    # by content addressing: recency refreshes, nothing is re-copied.
+    assert tier.swap_out([1, 2, 3, 4], 5)
+    assert tier.swap_outs == 1 and tier.bytes_held == held
+
+
+def test_budget_lru_eviction():
+    k, v = _fetch_const(0)
+    chunk_bytes = k.nbytes + v.nbytes
+    tier = HostKVTier(2 * chunk_bytes, page_size=4, fetch=_fetch_const)
+    tier.swap_out([1, 2, 3, 4], 0)
+    tier.swap_out([1, 2, 3, 4, 5, 6, 7, 8], 1)
+    # Touch the older entry so the SECOND one is the LRU victim.
+    tier.chunk([1, 2, 3, 4])
+    tier.swap_out([9, 9, 9, 9], 2)
+    assert tier.pages == 2 and tier.host_evictions == 1
+    assert tier.bytes_held == 2 * chunk_bytes
+    assert tuple([1, 2, 3, 4]) in tier._entries      # recently used: kept
+    assert tuple([1, 2, 3, 4, 5, 6, 7, 8]) not in tier._entries
+    # A chunk that can never fit is refused outright, not thrashed in.
+    small = HostKVTier(chunk_bytes - 1, page_size=4, fetch=_fetch_const)
+    assert small.enabled
+    assert small.swap_out([1, 2, 3, 4], 0) is False
+    assert small.pages == 0
+
+
+def test_chunk_verifies_checksum_and_drops_corrupt():
+    tier = HostKVTier(1 << 20, page_size=4, fetch=_fetch_const)
+    tier.swap_out([1, 2, 3, 4], 0)
+    ent = tier._entries[(1, 2, 3, 4)]
+    ent.k = np.array(ent.k)
+    ent.k.flat[0] += 64.0                       # rot in host RAM
+    with pytest.raises(HostTierIntegrityError, match="checksum mismatch"):
+        tier.chunk([1, 2, 3, 4])
+    assert tier.integrity_failures == 1
+    # The corrupt copy is GONE: a retry prefills cold instead of
+    # re-reading the same bytes.
+    assert tier.pages == 0
+    with pytest.raises(HostTierError, match="evicted between"):
+        tier.chunk([1, 2, 3, 4], chunk_idx=0)
+    assert HostTierError.transient and HostTierIntegrityError.transient
+
+
+def test_chaos_hook_drop_and_mutate():
+    tier = HostKVTier(1 << 20, page_size=4, fetch=_fetch_const)
+    tier.swap_out([1, 2, 3, 4], 0)
+    tier.chaos_hook = lambda i, kv: None
+    with pytest.raises(HostTierError, match="lost between"):
+        tier.chunk([1, 2, 3, 4])
+    assert tier.pages == 0                      # dropped, not retryable
+    tier.chaos_hook = None
+    tier.swap_out([1, 2, 3, 4], 0)
+    tier.chaos_hook = lambda i, kv: (kv[0] + 1.0, kv[1])
+    with pytest.raises(HostTierIntegrityError):
+        tier.chunk([1, 2, 3, 4])
+
+
+def test_clear_resets_bytes():
+    tier = HostKVTier(1 << 20, page_size=4, fetch=_fetch_const)
+    tier.swap_out([1, 2, 3, 4], 0)
+    tier.swap_out([1, 2, 3, 4, 5, 6, 7, 8], 1)
+    assert tier.clear() == 2
+    assert tier.pages == 0 and tier.bytes_held == 0
+    assert tier.match([1, 2, 3, 4, 5], 0) == []
+
+
+# ---------------------------------------------------------------------------
+# Page auditor — the swap lifecycle and the named hazard.
+# ---------------------------------------------------------------------------
+
+def test_note_swap_validates_op():
+    al = PageAllocator(4, 4)
+    with pytest.raises(ValueError, match="note_swap op"):
+        al.note_swap("swapped", 0)
+
+
+def test_audit_use_after_swap_out():
+    aud = PageAuditor(page_size=4)
+    aud.record({"op": "alloc", "owner": "prefix:chain", "pages": [0, 1]})
+    aud.record({"op": "swap_out", "page": 1})
+    aud.note_launch([0, 1], [], site="decode")
+    kinds = [v.kind for v in aud.violations]
+    assert kinds == ["use-after-swap-out"]
+    # Re-allocation scatters fresh bytes: the hazard ends there.
+    aud.record({"op": "decref", "page": 1})
+    aud.record({"op": "alloc", "owner": "r2", "pages": [1]})
+    aud.record({"op": "swap_in", "page": 1})
+    n = len(aud.violations)
+    aud.note_launch([1], [], site="decode")
+    assert len(aud.violations) == n
+
+
+def test_audit_swap_event_desyncs():
+    aud = PageAuditor(page_size=4)
+    aud.record({"op": "alloc", "owner": "a", "pages": [0]})
+    aud.record({"op": "share", "owner": "b", "pages": [0]})
+    aud.record({"op": "swap_out", "page": 0})    # refcount 2: not cache-only
+    aud.record({"op": "swap_in", "page": 3})     # free target
+    kinds = [v.kind for v in aud.violations]
+    assert kinds == ["audit-desync", "audit-desync"]
+
+
+# ---------------------------------------------------------------------------
+# Serving integration — swap-out under pressure, warm restore parity.
+# ---------------------------------------------------------------------------
+
+def _build(tiny, ctx1, **kw):
+    cfg, params = tiny
+    engine = Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                    page_size=4)
+    kw.setdefault("kv_host_budget_bytes", 1 << 30)
+    se = ServingEngine(engine, max_batch=2, num_pages=10,
+                       prefill_chunk=4, prefix_cache=True, **kw)
+    return engine, se
+
+
+_PRE = list(range(10, 22))
+_WARM = _PRE + [3, 5, 8, 9]
+_FAT = list(range(30, 58))
+
+
+def _pressure_cycle(engine, se):
+    """Serve the warm chain, then a fat cold request that forces the
+    cache-only chain to swap out. Returns the warm request's golden."""
+    g_warm = _golden(engine, _WARM, 5)
+    r0, _ = se.submit(_WARM, 5, req_id="t0")
+    se.run()
+    assert r0.tokens == g_warm
+    g_fat = _golden(engine, _FAT, 4)
+    rf, _ = se.submit(_FAT, 4, req_id="fat")
+    se.run()
+    assert rf.tokens == g_fat
+    assert se.kvtier.swap_outs > 0, "pool sizing no longer forces swap-out"
+    return g_warm
+
+
+def test_swap_out_then_warm_restore_parity(tiny, ctx1):
+    engine, se = _build(tiny, ctx1)
+    assert se.kvtier is not None and se.kvtier.enabled
+    g_warm = _pressure_cycle(engine, se)
+    r2, _ = se.submit(_WARM, 5, req_id="t2")
+    se.run()
+    assert r2.tokens == g_warm
+    assert se.kvtier.restores > 0
+    assert r2.restored_tokens_total > 0
+    assert r2.prefix_hit_tokens_total >= r2.restored_tokens_total
+    # Pool accounting stays exact after the restore landed.
+    al = se.sched.allocator
+    assert al.free_count + se.prefix.pages_held == al.usable_pages
+
+
+def test_budget_zero_means_no_tier(tiny, ctx1):
+    _, se = _build(tiny, ctx1, kv_host_budget_bytes=0)
+    assert se.kvtier is None
+
+
+def test_corrupt_host_chain_degrades_to_cold_prefill(tiny, ctx1):
+    engine, se = _build(tiny, ctx1)
+    g_warm = _pressure_cycle(engine, se)
+    tier = se.kvtier
+    import dataclasses as _dc
+    for key, ch in list(tier._entries.items()):
+        bad_k = np.array(ch.k)
+        bad_k.flat[0] += 1024.0
+        tier._entries[key] = _dc.replace(ch, k=bad_k)
+    r2, _ = se.submit(_WARM, 5, req_id="t2")
+    se.run()
+    # Checksum catches the rot, the entry drops, the request recomputes
+    # cold — parity held, zero restored tokens, never wrong tokens.
+    assert tier.integrity_failures >= 1
+    assert r2.tokens == g_warm
+    assert r2.restored_tokens_total == 0
+
+
+def test_restore_drop_mid_stream_recomputes(tiny, ctx1):
+    engine, se = _build(tiny, ctx1)
+    g_warm = _pressure_cycle(engine, se)
+    fired = []
+
+    def drop_once(idx, kv):
+        if not fired:
+            fired.append(idx)
+            return None
+        return kv
+
+    se._kvtier_chaos = drop_once
+    r2, _ = se.submit(_WARM, 5, req_id="t2")
+    se.run()
+    assert fired, "chaos hook never fired — no restore was attempted"
+    assert se.kvtier.restore_failures >= 1
+    assert r2.preemptions >= 1
+    assert r2.tokens == g_warm
+
+
+# ---------------------------------------------------------------------------
+# Async double-buffered loop — pure reordering of the sync loop.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("spec_k", [0, 2])
+def test_async_sync_token_parity(tiny, ctx1, spec_k):
+    prompts = [
+        (_WARM, 5),
+        (_PRE + [3, 5, 8, 30, 31, 32], 6),
+        (list(range(30, 50)), 4),
+        (_WARM, 5),
+    ]
+    results = {}
+    for mode in ("sync", "async"):
+        _, se = _build(tiny, ctx1, spec_k=spec_k,
+                       async_loop=(mode == "async"))
+        for i, (p, g) in enumerate(prompts):
+            se.submit(p, g, req_id=f"r{i}")
+        se.run()
+        results[mode] = {r.req_id: r.tokens for r in se._finished}
+    assert results["sync"] == results["async"]
+
+
+def test_async_overlaps_and_partitions(tiny, ctx1):
+    prof = obs_stepprof.StepProfiler()
+    prev_p = obs_stepprof.set_profiler(prof)
+    gl = obs_goodput.WorkLedger(interval=2)
+    prev_g = obs_goodput.set_ledger(gl)
+    try:
+        engine, se = _build(tiny, ctx1, async_loop=True)
+        g_warm = _pressure_cycle(engine, se)
+        r2, _ = se.submit(_WARM, 5, req_id="t2")
+        se.run()
+    finally:
+        obs_stepprof.set_profiler(prev_p)
+        obs_goodput.set_ledger(prev_g)
+    # Warm restores land at commit boundaries with parity intact.
+    assert r2.tokens == g_warm and r2.restored_tokens_total > 0
+    recs = prof.records()
+    assert any(r.get("overlapped_ms", 0.0) > 0 for r in recs), \
+        "no iteration overlapped host work with the in-flight step"
+    # The goodput partition invariant holds at commit-time accounting.
+    bad = [obs_goodput.check_partition(r) for r in gl.records()]
+    assert all(b is None for b in bad), bad
+
+
+def test_sync_loop_records_no_overlap(tiny, ctx1):
+    prof = obs_stepprof.StepProfiler()
+    prev_p = obs_stepprof.set_profiler(prof)
+    try:
+        _, se = _build(tiny, ctx1)
+        se.submit(_WARM, 5, req_id="t0")
+        se.run()
+    finally:
+        obs_stepprof.set_profiler(prev_p)
+    assert all(r.get("overlapped_ms", 0.0) == 0 for r in prof.records())
+
+
+def test_report_check_gates_kv_tier_lane(tmp_path):
+    """A serving-tier snapshot without the KV host-tier series fails
+    --check (swap-out/restore evidence lost); the explicit opt-out or
+    the series themselves pass it. The loop publishes the series
+    UNCONDITIONALLY (zeros when no tier is configured), so absence
+    means "pre-tier run dir", never "tier off"."""
+    from triton_distributed_tpu.obs import metrics as obs_metrics
+    from triton_distributed_tpu.obs import report as obs_report
+
+    reg = obs_metrics.Registry()
+    reg.counter(obs_metrics.SERVE_FINISHED, "x").inc(1)
+    reg.gauge(obs_metrics.KV_PAGES_RESIDENT, "x").set(4)
+    reg.save(str(tmp_path))
+    args = [str(tmp_path), "--check", "--require-series", "",
+            "--allow-missing-request-lane", "--allow-missing-step-profile",
+            "--allow-missing-goodput"]
+    assert obs_report.main(args) == 1
+    assert obs_report.main(args + ["--allow-missing-kv-tier"]) == 0
+    reg.gauge(obs_metrics.KV_HOST_PAGES, "x").set(0)
+    reg.counter(obs_metrics.KV_HOST_RESTORES, "x")
+    reg.counter(obs_metrics.KV_HOST_EVICTIONS, "x")
+    reg.save(str(tmp_path))
+    assert obs_report.main(args) == 0

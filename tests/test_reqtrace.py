@@ -277,7 +277,10 @@ def test_report_check_fails_on_missing_request_lane(tmp_path):
     reg.counter(obs_metrics.SERVE_FINISHED, "x").inc(3)
     reg.gauge(obs_metrics.KV_PAGES_RESIDENT, "x").set(8)
     reg.save(str(tmp_path))
-    args = [str(tmp_path), "--check", "--require-series", ""]
+    # The KV host-tier lane (ISSUE 20) gates the same way; opt out so
+    # this test stays focused on the request/step/goodput lanes.
+    args = [str(tmp_path), "--check", "--require-series", "",
+            "--allow-missing-kv-tier"]
     assert obs_report.main(args) == 1
     assert obs_report.main(args + ["--allow-missing-request-lane",
                                    "--allow-missing-step-profile",

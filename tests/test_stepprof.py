@@ -437,10 +437,10 @@ def test_report_check_gates_steps_lane_and_partition(tmp_path):
     rt = ReqTracer()
     rt.arrival("r-0", 0.0)
     rt.save(str(tmp_path / "requests.spans.json"))
-    # The goodput lane (ISSUE 19) gates the same way; opt out so this
-    # test stays focused on the step-phase lane.
+    # The goodput (ISSUE 19) and KV host-tier (ISSUE 20) lanes gate the
+    # same way; opt out so this test stays focused on the step-phase lane.
     args = [str(tmp_path), "--check", "--require-series", "",
-            "--allow-missing-goodput"]
+            "--allow-missing-goodput", "--allow-missing-kv-tier"]
     assert obs_report.main(args) == 1
     assert obs_report.main(args + ["--allow-missing-step-profile"]) == 0
     sp = StepProfiler()

@@ -13,6 +13,10 @@ token-parity diff three subsystems later:
 * ``use-after-free`` — a share/incref of a free page, or (via
   :meth:`note_launch`) a decode/verify launch reading a page freed
   earlier in the same iteration;
+* ``use-after-swap-out`` — a launch reading a page whose bytes the
+  host KV tier swapped out (serving/kvtier.py): the device page is
+  stale until it is re-allocated and rewritten, so any read must go
+  through the tier's restore path, never the pool;
 * ``cow-before-append`` — a launch appending into a page whose shadow
   refcount is not exactly 1 (a sharer still reads those bytes; COW must
   have replaced the reference first);
@@ -45,6 +49,7 @@ from triton_distributed_tpu.analysis.checker import Violation
 PAGE_KIND_ORDER = (
     "double-free",
     "use-after-free",
+    "use-after-swap-out",
     "cow-before-append",
     "leak",
     "audit-desync",
@@ -95,6 +100,9 @@ class PageAuditor:
         self.shadow: dict[int, int] = {}       # page -> live references
         self.owned: dict[str, list[int]] = {}  # owner -> held pages
         self.freed_this_iter: set[int] = set()
+        # Pages whose bytes left for the host KV tier (swap_out events);
+        # a page exits on re-allocation (fresh bytes will be written).
+        self.swapped_out: set[int] = set()
         self.violations: list[Violation] = []
         self.max_violations = max_violations
         self.n_suppressed = 0
@@ -141,6 +149,15 @@ class PageAuditor:
                             f"shadow still counts {c} live reference(s) "
                             "on", site=f"alloc for {owner!r}")
                     self.shadow[p] = 1
+                    # Re-allocation means fresh bytes will be scattered
+                    # in — the stale-device-page hazard ends here, and
+                    # so does the freed-this-iteration one: the page can
+                    # only re-enter a launch through its NEW owner's
+                    # table, whose prefill/restore writes land first
+                    # (reclaim-free -> alloc -> restore -> decode inside
+                    # one iteration is the host-tier warm path).
+                    self.swapped_out.discard(p)
+                    self.freed_this_iter.discard(p)
                 else:
                     if c < 1:
                         self._flag(
@@ -183,10 +200,37 @@ class PageAuditor:
                            f"{self.shadow.get(new, 0)} reference(s)",
                            site=f"cow for {owner!r}")
             self.shadow[new] = 1
+            self.swapped_out.discard(new)
             held = self.owned.get(owner)
             if held and old in held:
                 held[held.index(old)] = new
             # the old page's reference drops via the decref that follows
+        elif op == "swap_out":
+            # Host KV tier (serving/kvtier.py): the chain page's bytes
+            # left for host RAM. Only the cache's own pin may hold it —
+            # any other reader would keep reading a page about to free.
+            p = ev["page"]
+            self._warm_seed(p)
+            c = self.shadow.get(p, 0)
+            if c != 1:
+                self._flag(
+                    "audit-desync",
+                    f"swap-out of page {p} with shadow refcount {c} — "
+                    "only a cache-held (refcount 1) chain page may be "
+                    "swapped to the host tier", site="swap_out")
+            self.swapped_out.add(p)
+        elif op == "swap_in":
+            # A restored chunk landed in a (freshly allocated) pool
+            # page of the warm request — the target must be live.
+            p = ev["page"]
+            self._warm_seed(p)
+            if self.shadow.get(p, 0) < 1:
+                self._flag(
+                    "audit-desync",
+                    f"swap-in landed in page {p} which holds no live "
+                    "reference — restored bytes written into a free "
+                    "page", site="swap_in")
+            self.swapped_out.discard(p)
         elif op == "free":
             self.owned.pop(ev["owner"], None)
         elif op == "free_tail":
@@ -203,6 +247,14 @@ class PageAuditor:
         append targets it writes, against the shadow state."""
         for p in read_pages:
             p = int(p)
+            if p in self.swapped_out:
+                self._flag(
+                    "use-after-swap-out",
+                    f"launch reads page {p} whose bytes were swapped to "
+                    "the host KV tier — the device page is stale until "
+                    "re-allocated and rewritten (restore goes through "
+                    "the tier, never the pool)", site=site)
+                continue
             if p in self.freed_this_iter or self.shadow.get(p, 0) < 1:
                 self._flag(
                     "use-after-free",

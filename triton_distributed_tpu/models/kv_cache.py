@@ -215,6 +215,20 @@ class PageAllocator:
             kw["op"] = op
             self.on_event(kw)
 
+    def note_swap(self, op: str, page: int) -> None:
+        """Emit a host-tier lifetime event (``swap_out`` when a page's
+        bytes are copied to host RAM just before its eviction decref,
+        ``swap_in`` when a restore streams them back into a live page).
+        Pure telemetry for the page-audit shadow replay — refcounts
+        move through the ordinary decref/alloc paths; the audit uses
+        these markers to distinguish a *restorable* freed page from a
+        dead one (reading it is a named ``use-after-swap-out``)."""
+        if op not in ("swap_out", "swap_in"):
+            raise ValueError(
+                f"note_swap op {op!r} invalid: expected 'swap_out' or "
+                "'swap_in' (operation note_swap)")
+        self._ev(op, page=int(page))
+
     @property
     def reserved(self) -> tuple[int, ...]:
         return self._reserved

@@ -165,6 +165,26 @@ METRICS: tuple[MetricSpec, ...] = (
                "replay — spec rejections, recompute, COW/migration "
                "overhead and padding are the waste)",
                "", "higher", "serving"),
+    MetricSpec("serve_host_bubble_frac_async",
+               "host-bubble fraction under the async double-buffered "
+               "loop (same workload as the sync rung in the same "
+               "window; overlapped host work is subtracted — must sit "
+               "strictly below the sync bubble)",
+               "", "lower", "serving"),
+    MetricSpec("serve_ttft_p99_ms_async",
+               "serving TTFT p99 (async double-buffered loop, same "
+               "window as the sync rung)",
+               " ms", "lower", "serving"),
+    MetricSpec("serve_ttft_p99_ms_swapin",
+               "serving TTFT p99 of host-warm admissions (family "
+               "chains evicted to pinned host RAM, restored through "
+               "the checksummed stream — restore cost IN the number; "
+               "sits between the cold and device-warm rungs)",
+               " ms", "lower", "serving"),
+    MetricSpec("kv_host_restore_ms",
+               "host-chain restore p99 (host RAM -> prefill buffer, "
+               "whole chain, per warm admission)",
+               " ms", "lower", "serving"),
 )
 
 METRIC_BY_KEY = {m.key: m for m in METRICS}

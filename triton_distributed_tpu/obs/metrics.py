@@ -158,6 +158,27 @@ MIGRATION_SERIES = (KV_MIGRATE_LATENCY_MS, KV_MIGRATE_BYTES,
                     KV_MIGRATE_PAGES, KV_MIGRATIONS, KV_MIGRATE_FAILURES,
                     DISAGG_DEMOTIONS)
 
+# KV host-tier lane (ISSUE 20, serving/kvtier.py): the second-chance
+# host-RAM store for evicted prefix chains. Gauges track residency
+# (pages/bytes held against TDTPU_KV_HOST_BUDGET_BYTES); counters track
+# swap-outs at eviction, restores on a later warm hit, the tier's own
+# LRU evictions, and named restore failures (checksum mismatch / chunk
+# lost — the cold-prefill fallback). The restore histogram spans one
+# whole chain stream back into the prefill buffer, so it shares the
+# migration lane's coarse buckets. Published by serving/loop.py
+# unconditionally whenever the tier is configured on an observed run.
+KV_HOST_PAGES = "tdtpu_kv_host_pages"
+KV_HOST_BYTES = "tdtpu_kv_host_bytes"
+KV_HOST_SWAPOUTS = "tdtpu_kv_host_swapouts_total"
+KV_HOST_RESTORES = "tdtpu_kv_host_restores_total"
+KV_HOST_EVICTIONS = "tdtpu_kv_host_evictions_total"
+KV_HOST_RESTORE_FAILURES = "tdtpu_kv_host_restore_failures_total"
+KV_HOST_RESTORE_MS = "tdtpu_kv_host_restore_ms"
+
+KV_TIER_SERIES = (KV_HOST_RESTORE_MS, KV_HOST_PAGES, KV_HOST_BYTES,
+                  KV_HOST_SWAPOUTS, KV_HOST_RESTORES, KV_HOST_EVICTIONS,
+                  KV_HOST_RESTORE_FAILURES)
+
 # Fleet-health lane (ISSUE 11, docs/resilience.md "Fleet degradation"):
 # published by resilience/deadline.py (per-rank timeout attribution) and
 # serving/loop.py (evacuation / rejoin / alive gauges), rendered as

@@ -955,6 +955,14 @@ def main(argv: list[str] | None = None) -> int:
                          "timeline.json) — by default a serving run "
                          "that lost its token-level waste attribution "
                          "fails --check (pre-ISSUE-19 run dirs)")
+    ap.add_argument("--allow-missing-kv-tier", action="store_true",
+                    help="accept a serving-tier snapshot without the "
+                         "host KV-tier series (tdtpu_kv_host_pages / "
+                         "_restores_total / _evictions_total) — by "
+                         "default a serving run that lost them fails "
+                         "--check (pre-ISSUE-20 run dirs; the loop "
+                         "publishes them unconditionally, zeros when no "
+                         "tier is configured)")
     ap.add_argument("--allow-page-audit-violations", action="store_true",
                     help="report page-audit (refcount/COW sanitizer) "
                          "violations without failing --check — by "
@@ -1109,6 +1117,20 @@ def main(argv: list[str] | None = None) -> int:
             "(goodput.spans.json / timeline.json) is missing — "
             "token-level waste attribution lost "
             "(--allow-missing-goodput to accept)")
+    # KV host-tier lane (ISSUE 20): the serving loop publishes the tier
+    # series unconditionally (zeros when no tier is configured), so a
+    # serving snapshot without them predates the tier — flag it unless
+    # the operator accepts old dirs.
+    _kv_tier_required = (_om.KV_HOST_PAGES, _om.KV_HOST_RESTORES,
+                         _om.KV_HOST_EVICTIONS)
+    _kv_tier_missing = [n for n in _kv_tier_required
+                        if n not in (metrics or {})]
+    if (serving_present and _kv_tier_missing
+            and not args.allow_missing_kv_tier):
+        failures.append(
+            "serving series present but the KV host-tier lane is "
+            f"missing {', '.join(_kv_tier_missing)} — swap-out/restore "
+            "evidence lost (--allow-missing-kv-tier to accept)")
     failures += [f"step profile: {p}" for p in
                  step_profile_problems(flight_dumps)]
     failures += [f"goodput: {p}" for p in

@@ -127,6 +127,17 @@ class StepProfiler:
         self._segs: list[tuple[str, float, float]] = []
         self._replica: str | None = None
         self.clock: Callable[[], float] = time.perf_counter
+        # Device-overlap windows (ISSUE 20, the async serving loop):
+        # [overlap_begin, overlap_end) marks wall time during which a
+        # device step is KNOWN to be in flight (async dispatch → the
+        # commit-point wait). Host phases inside a window are overlapped
+        # host work, not bubble. A window spans iteration boundaries
+        # (dispatch in iteration i, commit in i+1), so an open window
+        # carries: it closes against each record at finish and re-opens
+        # at the next begin.
+        self._ov_open: float | None = None
+        self._ov_windows: list[tuple[float, float]] = []
+        self._ov_carry = False
 
     # -- lifecycle ----------------------------------------------------
 
@@ -146,6 +157,11 @@ class StepProfiler:
         self._acc = {}
         self._segs = []
         self._replica = replica
+        # A window left open by the previous iteration's dispatch (its
+        # commit lands in THIS iteration) restarts at the new origin.
+        self._ov_windows = []
+        self._ov_open = float(t) if self._ov_carry else None
+        self._ov_carry = False
         if clock is not None:
             self.clock = clock
 
@@ -167,6 +183,29 @@ class StepProfiler:
             return
         self._attribute(t, self._stack.pop())
 
+    # -- device-overlap windows (async loop, ISSUE 20) ----------------
+
+    def overlap_begin(self, t: float) -> None:
+        """An async decode step was just dispatched: host work from
+        here until :meth:`overlap_end` runs UNDER the device step."""
+        if self._t_begin is None:
+            return
+        self._ov_open = float(t)
+
+    def overlap_end(self, t: float) -> None:
+        """The commit point is about to block on the in-flight step —
+        close the overlap window (called BEFORE the wait: the wait
+        itself is device time, not overlapped host work). Also the
+        abort hook: a cancelled pending launch must stop claiming
+        overlap credit."""
+        if self._t_begin is None or self._ov_open is None:
+            self._ov_carry = False
+            self._ov_open = None
+            return
+        if float(t) > self._ov_open:
+            self._ov_windows.append((self._ov_open, float(t)))
+        self._ov_open = None
+
     def finish_iteration(self, t: float, **extra: Any) -> dict[str, Any]:
         """Close the window; returns (and stores) the phase record."""
         if self._t_begin is None:
@@ -185,7 +224,27 @@ class StepProfiler:
                           if p not in DEVICE_PHASES))
         device_ms = _ms(sum(self._acc.get(p, 0.0) for p in self._acc
                             if p in DEVICE_PHASES))
-        bubble = round(host_ms / wall_ms, 6) if wall_ms > 0 else 0.0
+        # Close a still-open overlap window against this record and
+        # carry it into the next (the async dispatch→commit window
+        # spans the iteration boundary).
+        carry = self._ov_open is not None
+        if carry and float(t) > self._ov_open:
+            self._ov_windows.append((self._ov_open, float(t)))
+        overlapped = 0.0
+        if self._ov_windows:
+            for p, s0, s1 in self._segs:
+                if p in DEVICE_PHASES:
+                    continue
+                for w0, w1 in self._ov_windows:
+                    lo, hi = max(s0, w0), min(s1, w1)
+                    if hi > lo:
+                        overlapped += hi - lo
+        overlapped_ms = _ms(overlapped)
+        # The bubble is host time NOT hidden under an in-flight device
+        # step. With no windows (the synchronous loop) this reduces to
+        # the old host_ms / wall_ms exactly.
+        bubble = (round(max(0.0, host_ms - overlapped_ms) / wall_ms, 6)
+                  if wall_ms > 0 else 0.0)
         rkey = self._replica if self._replica is not None else ""
         cum = self._cum.setdefault(rkey, [0.0, 0.0])
         cum[0] = round(cum[0] + host_ms, 6)
@@ -197,6 +256,7 @@ class StepProfiler:
             "phases": phases,
             "host_ms": host_ms,
             "device_ms": device_ms,
+            "overlapped_ms": overlapped_ms,
             "host_bubble_frac": bubble,
             "host_ms_cum": cum[0],
             "device_ms_cum": cum[1],
@@ -212,6 +272,9 @@ class StepProfiler:
         self._stack = []
         self._acc = {}
         self._segs = []
+        self._ov_windows = []
+        self._ov_open = None
+        self._ov_carry = carry
         return rec
 
     # -- queries ------------------------------------------------------

@@ -1098,6 +1098,150 @@ def prefix_serve_selftest() -> list[CaseResult]:
     return cases
 
 
+def kvtier_serve_selftest() -> list[CaseResult]:
+    """Two rows per --all sweep for the host-RAM KV tier (ISSUE 20,
+    serving/kvtier.py):
+
+    (a) ``kvtier_corrupt_chain`` — a chain swapped out to host RAM is
+        corrupted at rest. The warm admission's restore must trip the
+        checksum re-verification (the NAMED transient
+        HostTierIntegrityError), drop the poisoned chain, and fall back
+        to a COLD prefill with token parity — corrupt host bytes must
+        never become tokens.
+
+    (b) ``kvtier_drop_mid_restore`` — the restore stream loses a block
+        in transit (chaos hook on the MigrationStream transport). The
+        request must preempt mid-restore and recompute on resume with
+        parity — the half-filled prefill buffer is discarded, never
+        attended."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from triton_distributed_tpu.models import (
+        Engine, init_dense_llm, tiny_config,
+    )
+    from triton_distributed_tpu.runtime import initialize_distributed
+    from triton_distributed_tpu.serving.loop import ServingEngine
+
+    cfg = tiny_config()
+    params = init_dense_llm(jax.random.key(0), cfg)
+    ctx1 = initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                  devices=jax.devices()[:1])
+    chain_prompt = list(range(10, 22)) + [3, 5, 8, 9]   # 4 full pages
+    fat_prompt = list(range(30, 58))    # pool pressure -> chain reclaim
+    gen = 5
+    oracle = Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                    page_size=4)
+    golden = np.asarray(
+        oracle.serve(jnp.asarray([chain_prompt], jnp.int32),
+                     gen_len=gen))[0].tolist()
+    golden_fat = np.asarray(
+        oracle.serve(jnp.asarray([fat_prompt], jnp.int32),
+                     gen_len=3))[0].tolist()
+
+    def build_with_host_chain():
+        """A ServingEngine whose tier holds chain_prompt's pages and
+        whose device index no longer does (the swap-out shape)."""
+        eng = Engine(cfg, params, ctx1, backend="xla", max_seq=64,
+                     page_size=4)
+        se = ServingEngine(eng, max_batch=2, num_pages=10,
+                           prefill_chunk=4, prefix_cache=True,
+                           kv_host_budget_bytes=1 << 30)
+        r0, res = se.submit(chain_prompt, gen, req_id="chaos-kt-seed")
+        assert res.name == "ADMITTED", res
+        se.run()
+        assert r0.tokens == golden, "seed serve lost parity"
+        rf, _ = se.submit(fat_prompt, 3, req_id="chaos-kt-fat")
+        se.run()
+        assert rf.tokens == golden_fat, "pressure serve lost parity"
+        assert se.kvtier.swap_outs > 0, "pressure never swapped out"
+        return se
+
+    import warnings as _w
+
+    cases: list[CaseResult] = []
+
+    # Row (a): corrupt a host-resident chain at rest.
+    t0 = time.time()
+    diags: list[str] = []
+    try:
+        se = build_with_host_chain()
+        tier = se.kvtier
+        # Rot EVERY resident chunk (checksums stay the swap-out stamps)
+        # so whichever part of the chain the warm admission restores,
+        # the re-verification must catch it.
+        for key, ch in list(tier._entries.items()):
+            bad_k = np.array(ch.k)                # writable copy
+            bad_k.flat[0] += 1024.0
+            tier._entries[key] = _dc.replace(ch, k=bad_k)
+        r1, _ = se.submit(chain_prompt, gen, req_id="chaos-kt-rot")
+        with _w.catch_warnings():
+            _w.simplefilter("ignore", RuntimeWarning)
+            se.run()
+        parity = r1.tokens == golden
+        finished = r1.state.name == "FINISHED"
+        named = tier.integrity_failures >= 1
+        cold = r1.restored_tokens_total == 0
+        diags += [f"integrity failures: {tier.integrity_failures}",
+                  f"restore failures: {tier.restore_failures}",
+                  f"cold-prefill fallback (no restored tokens): {cold}",
+                  f"parity vs sequential xla serve: {parity}"]
+        verdict = ("detected" if named and cold and parity and finished
+                   else "error")
+    except Exception as exc:                        # died = the failure
+        verdict = "error"
+        diags.append(f"{type(exc).__name__}: {exc}")
+    cases.append(CaseResult(
+        op="kvtier_serve", mesh="1", fault="kvtier_corrupt_chain",
+        verdict=verdict, detected_by="checksum",
+        expected=("detected",), ok=verdict == "detected", n_fired=1,
+        n_violations=0, diagnostics=diags,
+        elapsed_s=round(time.time() - t0, 3)))
+
+    # Row (b): drop a block mid-restore (transport chaos hook).
+    t0 = time.time()
+    diags = []
+    try:
+        se = build_with_host_chain()
+        tier = se.kvtier
+        fired = {"n": 0}
+
+        def drop_once(idx, kv):
+            if fired["n"] == 0:
+                fired["n"] += 1
+                return None                       # block lost in transit
+            return kv
+
+        se._kvtier_chaos = drop_once
+        r1, _ = se.submit(chain_prompt, gen, req_id="chaos-kt-drop")
+        with _w.catch_warnings():
+            _w.simplefilter("ignore", RuntimeWarning)
+            se.run()
+        parity = r1.tokens == golden
+        finished = r1.state.name == "FINISHED"
+        preempted = r1.preemptions >= 1
+        diags += [f"hook fired: {fired['n']}",
+                  f"preempted mid-restore: {preempted}",
+                  f"restore failures: {tier.restore_failures}",
+                  f"recompute-on-resume parity: {parity}"]
+        verdict = ("detected" if fired["n"] and preempted
+                   and tier.restore_failures >= 1 and parity and finished
+                   else "error")
+    except Exception as exc:
+        verdict = "error"
+        diags.append(f"{type(exc).__name__}: {exc}")
+    cases.append(CaseResult(
+        op="kvtier_serve", mesh="1", fault="kvtier_drop_mid_restore",
+        verdict=verdict, detected_by="transport",
+        expected=("detected",), ok=verdict == "detected", n_fired=1,
+        n_violations=0, diagnostics=diags,
+        elapsed_s=round(time.time() - t0, 3)))
+    return cases
+
+
 def page_audit_selftest() -> list[CaseResult]:
     """One row per --all sweep for the refcount/COW lifetime sanitizer
     (docs/mklint.md): a serving run that exercises the full page
@@ -1790,6 +1934,14 @@ def sweep(ops, faults, ranks, *, seed: int = 0,
         # a seeded fault in a warm admission's suffix prefill must
         # retry with parity and never corrupt shared pages.
         for case in prefix_serve_selftest():
+            cases.append(case)
+            failed += not case.ok
+            _print_case(case, verbose)
+        # Host KV-tier rows (ISSUE 20): a corrupted host chain must trip
+        # the restore checksum and fall back to cold prefill with
+        # parity; a block dropped mid-restore must preempt and
+        # recompute on resume — tokens are never wrong, only slower.
+        for case in kvtier_serve_selftest():
             cases.append(case)
             failed += not case.ok
             _print_case(case, verbose)

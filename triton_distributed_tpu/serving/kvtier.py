@@ -1,0 +1,286 @@
+"""Host-RAM KV tier: second-chance storage for evicted prefix chains.
+
+ISSUE 20 (ROADMAP item 3, the Mooncake-style KV tiering half): the
+radix prefix cache (serving/prefix.py) is HBM-sized — when
+refcount×recency eviction frees a cache-only chain, the chain's KV
+bytes die and the next warm request pays a cold prefill. This module
+gives those pages a second tier: at eviction time the prefix cache
+swaps each cache-only chunk to a pinned host buffer (a plain numpy
+copy on CPU builds — the pinned-allocation discipline is the TPU
+runtime's), and a later admission whose prompt extends past the
+device-resident hit restores the chain back into the pool through the
+disagg :class:`~triton_distributed_tpu.disagg.migrate.MigrationStream`
+double-buffer transport (serving/loop.py owns that wiring).
+
+Content addressing makes this safe (docs/serving.md "Prefix cache"):
+KV at a position depends only on the tokens at and before it, so a
+host entry keyed by the FULL token prefix through its chunk is valid
+for any request whose prompt starts with those tokens — the entry
+outlives the device page id it was copied from.
+
+Integrity: every entry is stamped with a float32 checksum at swap-out
+and RE-VERIFIED at restore time (the bytes sat in host RAM for
+arbitrarily long); a mismatch drops the entry and raises the named
+TRANSIENT :class:`HostTierIntegrityError`, so the serving loop's
+prefill-fault path degrades the request to a cold(er) prefill — never
+wrong tokens. fp8 pools swap at STORED width (the entry holds the
+pool's e4m3 bytes, not a dequantized copy), so host budget and
+restore bytes both price the real pool page.
+
+Budget: ``TDTPU_KV_HOST_BUDGET_BYTES`` (or the ``budget_bytes`` ctor
+arg) bounds resident host bytes; the tier runs its own recency (LRU)
+eviction when over budget. Budget 0 disables the tier.
+
+PURE HOST module (numpy only): the device hops — the pool-page fetch
+at swap-out and the restore stream's ``put``/scatter — are callbacks
+installed by the serving loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable
+
+import numpy as np
+
+
+class HostTierError(RuntimeError):
+    """A host-tier restore failed in a named way (entry evicted
+    mid-restore, chunk dropped by the chaos plane) — TRANSIENT by
+    design (``transient = True`` is the marker
+    ``resilience.is_transient`` honors): the serving loop preempts the
+    restoring request and recomputes the chain as a cold prefill."""
+
+    transient = True
+
+
+class HostTierIntegrityError(HostTierError):
+    """A host entry's checksum no longer matches the bytes about to
+    restore — corrupt host RAM (or a chaos injection) detected BEFORE
+    the chunk re-enters the pool. The entry is dropped, so the retry
+    admission prefills the positions cold instead of re-reading the
+    same corrupt copy."""
+
+
+def host_kv_budget_bytes() -> int:
+    """Host-tier byte budget from ``TDTPU_KV_HOST_BUDGET_BYTES``
+    (default 0 = tier disabled)."""
+    try:
+        return int(os.environ.get("TDTPU_KV_HOST_BUDGET_BYTES", "") or 0)
+    except ValueError:
+        return 0
+
+
+def _checksum(k: np.ndarray, v: np.ndarray) -> float:
+    """f32 sum of both halves, one fixed routine for stamp and verify
+    (numpy on host bytes both times, so summation order — and
+    therefore the float — is identical unless the bytes changed)."""
+    return float(np.asarray(k, np.float32).sum()
+                 + np.asarray(v, np.float32).sum())
+
+
+@dataclasses.dataclass
+class _HostChunk:
+    """One swapped-out page: host copies of its (k, v) pool bytes at
+    stored width, the integrity stamp, and the recency key."""
+
+    k: np.ndarray
+    v: np.ndarray
+    checksum: float
+    last_use: int
+    nbytes: int
+
+
+class HostKVTier:
+    """Bounded host-RAM store of evicted prefix-cache chunks.
+
+    Entries are content-addressed by the FULL token-id prefix through
+    the chunk (``tuple(tokens[:end])``) — the same keying discipline as
+    the radix index, flattened: a chain of n chunks becomes n entries,
+    and :meth:`match` re-walks them chunk-by-chunk from any
+    device-resident hit boundary.
+
+    Args:
+      budget_bytes: resident host-byte ceiling (None reads
+        ``TDTPU_KV_HOST_BUDGET_BYTES``; <= 0 disables — every
+        ``swap_out`` is refused and ``match`` finds nothing).
+      page_size: tokens per page/chunk (must equal the pool's).
+      fetch: ``fetch(page) -> (k, v)`` numpy copies of one pool page at
+        stored width — installed by the serving loop (it owns the
+        device arrays). Swap-outs are refused until it is set.
+    """
+
+    def __init__(self, budget_bytes: int | None = None, *,
+                 page_size: int, fetch: Callable | None = None):
+        if page_size < 1:
+            raise ValueError(
+                f"page_size = {page_size} invalid: host-tier chunks are "
+                "pool pages — argument page_size")
+        self.budget_bytes = (host_kv_budget_bytes() if budget_bytes is None
+                             else int(budget_bytes))
+        self.page_size = page_size
+        self.fetch = fetch
+        # Fault-injection point for the chaos plane (resilience/chaos.py):
+        # hook(chunk_idx, (k, v)) -> (k, v) | None per restored chunk —
+        # None models a chunk lost between host RAM and the pool, a
+        # mutated pair models corruption past the checksum stamp.
+        self.chaos_hook = None
+        self._entries: dict[tuple[int, ...], _HostChunk] = {}
+        self._clock = 0                  # logical recency counter
+        self.bytes_held = 0
+        # Evidence (obs satellite + loadgen phase 14).
+        self.swap_outs = 0               # pages copied to host
+        self.restores = 0                # pages streamed back to the pool
+        self.host_evictions = 0          # entries LRU-dropped over budget
+        self.restore_failures = 0        # streams that raised (named)
+        self.integrity_failures = 0      # checksum mismatches on restore
+
+    @property
+    def enabled(self) -> bool:
+        return self.budget_bytes > 0
+
+    @property
+    def pages(self) -> int:
+        """Entries (= pages) currently resident in host RAM."""
+        return len(self._entries)
+
+    # -- swap-out (called by PrefixCache.reclaim) ---------------------------
+    def swap_out(self, chain, page: int) -> bool:
+        """Copy pool ``page`` — the chunk holding positions
+        ``[len(chain) - page_size, len(chain))`` of token prefix
+        ``chain`` — into host RAM before the eviction decref frees it.
+        Returns True when the entry is resident afterwards (already
+        present counts: the bytes are identical by content
+        addressing, only recency refreshes)."""
+        if not self.enabled:
+            return False
+        key = tuple(int(t) for t in chain)
+        self._clock += 1
+        ent = self._entries.get(key)
+        if ent is not None:
+            ent.last_use = self._clock
+            return True
+        if self.fetch is None:
+            return False
+        k, v = self.fetch(int(page))
+        k = np.asarray(k)
+        v = np.asarray(v)
+        nbytes = int(k.nbytes + v.nbytes)
+        if nbytes > self.budget_bytes:
+            return False                 # one chunk can never fit
+        self._entries[key] = _HostChunk(k, v, _checksum(k, v),
+                                        self._clock, nbytes)
+        self.bytes_held += nbytes
+        self.swap_outs += 1
+        self._evict_to_budget()
+        return True
+
+    def _evict_to_budget(self) -> None:
+        """The tier's OWN recency eviction: drop least-recently-used
+        entries until under budget (insertion just bumped the newest,
+        so it is never the victim)."""
+        while self.bytes_held > self.budget_bytes and self._entries:
+            key = min(self._entries, key=lambda s: self._entries[s].last_use)
+            self._drop(key)
+            self.host_evictions += 1
+
+    def _drop(self, key: tuple[int, ...]) -> bool:
+        ent = self._entries.pop(key, None)
+        if ent is None:
+            return False
+        self.bytes_held -= ent.nbytes
+        return True
+
+    def drop_chain(self, keys) -> int:
+        """Forget entries (a failed restore's pending chain: the retry
+        admission must degrade to a cold prefill, not re-walk into the
+        same failure). Returns the count actually dropped."""
+        return sum(1 for key in keys if self._drop(tuple(key)))
+
+    def clear(self) -> int:
+        """Drop everything — the prefix cache calls this from
+        ``invalidate()``: after a device rebuild/evacuation the mesh
+        geometry (and so the collective reassociation the KV floats
+        rode) may have changed, and bit-exact parity is the contract."""
+        n = len(self._entries)
+        self._entries.clear()
+        self.bytes_held = 0
+        return n
+
+    # -- admission-side walk -------------------------------------------------
+    def match(self, tokens, start: int) -> list[tuple[int, ...]]:
+        """Chunk keys resident in host RAM extending a device-side hit:
+        walks ``tokens`` chunk-by-chunk from page-aligned ``start``,
+        capped at ``len(tokens) - 1`` (at least one token must prefill
+        — its logits produce the next token). READ-ONLY, like
+        ``PrefixCache.match``: recency moves only when a chunk actually
+        restores."""
+        if not self._entries or start % self.page_size:
+            return []
+        toks = [int(t) for t in tokens]
+        cap = len(toks) - 1
+        keys: list[tuple[int, ...]] = []
+        pos = int(start)
+        while pos + self.page_size <= cap:
+            key = tuple(toks[:pos + self.page_size])
+            if key not in self._entries:
+                break
+            keys.append(key)
+            pos += self.page_size
+        return keys
+
+    # -- restore-side assembly ----------------------------------------------
+    def chunk(self, key, *, chunk_idx: int = 0
+              ) -> tuple[np.ndarray, np.ndarray]:
+        """The host bytes for one chain key, checksum re-verified (the
+        restore-side half of the swap-out stamp). Raises the named
+        transient :class:`HostTierError` family when the entry is gone
+        (tier eviction raced the restore) or fails verification — the
+        corrupt/raced entry is dropped first, so a retry admission
+        degrades to a cold prefill instead of looping."""
+        key = tuple(int(t) for t in key)
+        ent = self._entries.get(key)
+        if ent is None:
+            raise HostTierError(
+                f"host-tier chunk {chunk_idx} (chain of {len(key)} "
+                "tokens) evicted between admission match and restore — "
+                "the request recomputes these positions cold")
+        k, v = ent.k, ent.v
+        if self.chaos_hook is not None:
+            kv = self.chaos_hook(chunk_idx, (k, v))
+            if kv is None:
+                self._drop(key)
+                raise HostTierError(
+                    f"host-tier chunk {chunk_idx} lost between host RAM "
+                    "and the pool — restore incomplete, the pages must "
+                    "not enter the decode batch")
+            k, v = kv
+        got = _checksum(k, v)
+        if got != ent.checksum:
+            self._drop(key)
+            self.integrity_failures += 1
+            raise HostTierIntegrityError(
+                f"host-tier chunk {chunk_idx} checksum mismatch on "
+                f"restore (stamped {ent.checksum!r}, read {got!r}) — "
+                "corrupt host copy dropped before entering the pool")
+        self._clock += 1
+        ent.last_use = self._clock
+        return k, v
+
+    def note_restored(self, n_pages: int) -> None:
+        """Count pages a completed restore streamed back (the serving
+        loop calls this once the whole chain landed)."""
+        self.restores += int(n_pages)
+
+    def stats(self) -> dict:
+        return {
+            "pages": self.pages,
+            "bytes_held": self.bytes_held,
+            "budget_bytes": self.budget_bytes,
+            "swap_outs": self.swap_outs,
+            "restores": self.restores,
+            "host_evictions": self.host_evictions,
+            "restore_failures": self.restore_failures,
+            "integrity_failures": self.integrity_failures,
+        }

@@ -126,6 +126,7 @@ def request_records(reqs) -> list[dict]:
             "drafted": r.drafted_tokens,
             "accepted": r.accepted_draft_tokens,
             "prefix_hit_tokens": r.prefix_hit_tokens_total,
+            "restored_tokens": r.restored_tokens_total,
             "recompute_tokens": r.recompute_tokens,
             "rejected_tokens": r.rejected_tokens,
             "wasted_tokens": r.wasted_tokens,
@@ -275,7 +276,15 @@ def dryrun(json_path: str | None, flight_dir: str | None = None) -> int:
     every tier in the sweep: per-iteration phase vectors that
     PARTITION the iteration wall with a nonzero host-bubble fraction
     (plus per-replica labels on the fleet), written to
-    ``step-profile.json`` beside the flight dumps."""
+    ``step-profile.json`` beside the flight dumps. Phase 13 (ISSUE 19)
+    proves the goodput work ledger on every tier: per-iteration
+    category partitions, per-request waste reconciliation, and
+    byte-identical replays under a counter clock. Phase 14 (ISSUE 20)
+    proves KV tiering to host RAM + the async double-buffered loop: a
+    forced chain eviction swaps to host, the warm re-admission
+    restores with zero cold prefill and exact parity, and the async
+    replay is a byte-identical pure reordering of the sync one with
+    nonzero plan/device overlap."""
     import os
 
     from triton_distributed_tpu.runtime.utils import (
@@ -1535,6 +1544,182 @@ def dryrun(json_path: str | None, flight_dir: str | None = None) -> int:
         gl13fl.save(os.path.join(flight_dir, "goodput.spans.json"))
         gl13fl.save_timeline(os.path.join(flight_dir, "timeline.json"))
 
+    # Phase 14 (ISSUE 20) — KV tiering to host RAM + the async
+    # double-buffered loop: a host-budgeted tier over a device pool
+    # sized to force chain eviction must swap the cache-only chain OUT
+    # to host instead of dropping it, then serve the warm re-admission
+    # by RESTORING it — zero cold prefill over the restored span
+    # (prefill_saved credit in the ledger, host-transport rows in the
+    # overhead lane, tdtpu_kv_host_{swapouts,restores}_total in the
+    # registry) — token-identical to the cold sequential oracle. The
+    # SAME trace replayed sync and async under counter clocks must
+    # produce byte-identical token-relevant request records, with the
+    # goodput partition invariant holding every async iteration and
+    # nonzero plan/device overlap in the async step profile (and none
+    # in the sync profile — overlap windows only open when a launch is
+    # held across the commit boundary).
+    pre14 = list(range(10, 22))
+    kv_trace = [
+        # A chain the radix index keeps after FINISH (6 pages at
+        # page_size 4: 16 prompt + 5 generated tokens).
+        {"req_id": "kt-warmup", "arrival_iter": 0,
+         "prompt": pre14 + [3, 5, 8, 9], "max_new_tokens": 5,
+         "priority": 0},
+        # A fat cold request (8 of the pool's 10 pages): reclaim MUST
+        # eat the cache-only chain, and with a host budget attached the
+        # physical free becomes a swap-out.
+        {"req_id": "kt-pressure", "arrival_iter": 12,
+         "prompt": list(range(30, 58)), "max_new_tokens": 4,
+         "priority": 0},
+        # The warm re-admission: its prefix now lives on HOST only.
+        {"req_id": "kt-warm", "arrival_iter": 30,
+         "prompt": pre14 + [3, 5, 8, 9], "max_new_tokens": 5,
+         "priority": 0},
+    ]
+    kv_golden = sequential_reference(engine, kv_trace)
+
+    def _kv_replay(async_loop: bool):
+        """One counter-clocked replay of kv_trace through a fresh
+        host-budgeted tier inside its own obs run: returns (se, report,
+        profiler records, ledger, registry snapshot). The RUN's own
+        step-profiler and work ledger are the evidence — start_run
+        installs them, so a privately-swapped pair would be shadowed."""
+        with tempfile.TemporaryDirectory() as kv_dir:
+            _obs.start_run(kv_dir)
+            try:
+                _, se14_ = _tiny_serving(
+                    engine, max_batch=2, num_pages=10,
+                    prefill_chunk=4, max_waiting=8,
+                    prefix_cache=True,
+                    kv_host_budget_bytes=1 << 30,
+                    async_loop=async_loop, clock=_Tick13())
+                prof14 = obs_stepprof.get_profiler()
+                gl14 = obs_goodput.get_ledger()
+                rep14_ = run_trace(se14_, [dict(t) for t in kv_trace])
+                prof14_recs = (prof14.records()
+                               if prof14 is not None else [])
+                snap14_ = _om.registry().snapshot()
+            finally:
+                _obs.finish_run()
+        return se14_, rep14_, prof14_recs, gl14, snap14_
+
+    se14, rep14, prof14s, gl14s, kv_snap = _kv_replay(async_loop=False)
+    se14a, rep14a, prof14a, gl14a, kv_snap_a = _kv_replay(async_loop=True)
+    kv_tier = se14.kvtier
+    if kv_tier is None or se14a.kvtier is None:
+        failures.append(
+            "phase 14: the host tier did not attach under an explicit "
+            "kv_host_budget_bytes — the ctor wiring regressed")
+    for label, rep_, se_ in (("sync", rep14, se14),
+                             ("async", rep14a, se14a)):
+        kv_reqs = {r.req_id: r for r in rep_.pop("requests")}
+        kv_mismatch = [rid for rid, r in kv_reqs.items()
+                       if r.tokens != kv_golden[rid]]
+        if kv_mismatch or not rep_["all_finished"]:
+            failures.append(
+                f"phase 14: {label} replay broke token parity vs the "
+                f"cold sequential oracle: {kv_mismatch} "
+                f"(all_finished={rep_['all_finished']})")
+        tier_ = se_.kvtier
+        if tier_ is not None and tier_.swap_outs < 1:
+            failures.append(
+                f"phase 14: {label} replay swapped no chain to host — "
+                "the device pool sizing no longer forces eviction of "
+                "the cache-only chain")
+        warm_ = kv_reqs.get("kt-warm")
+        if tier_ is not None and (
+                tier_.restores < 1 or warm_ is None
+                or warm_.restored_tokens_total < 1):
+            failures.append(
+                f"phase 14: {label} warm re-admission did not restore "
+                f"from the host tier (restores="
+                f"{tier_.restores if tier_ else None}, restored_tokens="
+                f"{warm_.restored_tokens_total if warm_ else None})")
+        if warm_ is not None and warm_.restored_tokens_total > 0 \
+                and warm_.prefix_hit_tokens_total \
+                < warm_.restored_tokens_total:
+            failures.append(
+                f"phase 14: {label} warm request counts more restored "
+                "tokens than admitted hit tokens — the restored span "
+                "was cold-prefilled anyway")
+    # Ledger evidence (sync replay): restored tokens ride the
+    # prefill_saved CREDIT; the host->device transport is the only
+    # overhead source in this tier, so the overhead lane reconciles
+    # EXACTLY with the per-request restored counters.
+    cum14 = gl14s.cumulative_all() if gl14s is not None else {}
+    restored14 = sum(r["restored_tokens"] for r in rep14["request_records"])
+    if cum14.get("prefill_saved", 0) < 1:
+        failures.append(
+            "phase 14: warm restore credited no prefill_saved rows in "
+            "the work ledger")
+    if cum14.get("overhead", 0) != restored14:
+        failures.append(
+            f"phase 14: ledger overhead lane ({cum14.get('overhead', 0)}) "
+            f"does not reconcile with the per-request restored tokens "
+            f"({restored14}) — the host-transport accounting regressed")
+    bad14 = [f"iter {r['it']}: {p}"
+             for r in (gl14a.records() if gl14a is not None else [])
+             if (p := obs_goodput.check_partition(r)) is not None]
+    if bad14:
+        failures.append(
+            f"phase 14: async work records break the partition "
+            f"invariant: {bad14[:4]}")
+    # Registry evidence: the kv-tier lane obs.report --check gates on.
+    for snap_, lbl_ in ((kv_snap, "sync"), (kv_snap_a, "async")):
+        so14 = (snap_.get(_om.KV_HOST_SWAPOUTS) or {}).get("value", 0)
+        rs14 = (snap_.get(_om.KV_HOST_RESTORES) or {}).get("value", 0)
+        if not so14 or not rs14:
+            failures.append(
+                f"phase 14: {lbl_} registry kv-tier lane empty "
+                f"(swapouts={so14!r}, restores={rs14!r}) — the gauge "
+                "publication regressed")
+        if _om.KV_HOST_RESTORE_MS not in snap_:
+            failures.append(
+                f"phase 14: {lbl_} run carries no "
+                f"{_om.KV_HOST_RESTORE_MS} histogram")
+    # Byte-identity: the async loop reorders WHEN host work happens,
+    # never WHAT tokens come out — so the token-relevant record fields
+    # (everything except wall-clock-derived latencies) serialize to the
+    # SAME bytes.
+    _kv_fields = ("req_id", "tokens", "preemptions", "prefix_hit_tokens",
+                  "restored_tokens", "recompute_tokens",
+                  "rejected_tokens", "drafted", "accepted", "state")
+
+    def _kv_bytes(rep_):
+        return json.dumps([{k: r[k] for k in _kv_fields}
+                           for r in rep_["request_records"]],
+                          sort_keys=True)
+
+    if _kv_bytes(rep14) != _kv_bytes(rep14a):
+        failures.append(
+            "phase 14: async and sync replays of the same trace under "
+            "counter clocks produced different token-relevant request "
+            "records — the double-buffered loop is not a pure "
+            "reordering")
+    async_overlap = sum(r.get("overlapped_ms", 0.0) for r in prof14a)
+    if not any(r.get("overlapped_ms", 0.0) > 0 for r in prof14a):
+        failures.append(
+            "phase 14: no async iteration overlapped host work with "
+            "the in-flight device step — the plan/commit split is not "
+            "buying anything")
+    if any(r.get("overlapped_ms", 0.0) > 0 for r in prof14s):
+        failures.append(
+            "phase 14: the SYNC loop recorded overlap windows — "
+            "overlap_begin leaked outside the pending-launch path")
+    report["kv_tier"] = {
+        "parity_ok": not any(f.startswith("phase 14") for f in failures),
+        "swap_outs": kv_tier.swap_outs if kv_tier else None,
+        "restores": kv_tier.restores if kv_tier else None,
+        "host_evictions": kv_tier.host_evictions if kv_tier else None,
+        "restored_tokens": restored14,
+        "prefill_saved": cum14.get("prefill_saved", 0),
+        "async_overlapped_ms": round(async_overlap, 3),
+        "async_iterations": len(prof14a),
+        "records_byte_identical": _kv_bytes(rep14) == _kv_bytes(rep14a),
+    }
+    _audit("phase14-kvtier", se14)
+    _audit("phase14-kvtier-async", se14a)
+
     if audit_prev is None:
         os.environ.pop("TDTPU_PAGE_AUDIT", None)
     else:
@@ -1579,7 +1764,8 @@ def _bench_shard_config():
 def serving_bench_rung(n_streams: int = 8, prompt_len: int = 128,
                        max_new: int = 16, *, backend: str = "xla",
                        page_size: int = 64, kv_dtype=None,
-                       spec_k: int = 0) -> dict:
+                       spec_k: int = 0,
+                       async_loop: bool = False) -> dict:
     """Tokens/s + p99 TTFT/TPOT at ``n_streams`` concurrent streams on
     the Qwen3-8B TP=8 PER-DEVICE shard shapes (the same single-chip
     pricing discipline as the decode rungs: n=1, no ICI in the number;
@@ -1603,7 +1789,14 @@ def serving_bench_rung(n_streams: int = 8, prompt_len: int = 128,
     rate (`spec_accept_rate` — accepted drafts / drafted, from the
     per-request ledger, so no obs run is required). The workload gains
     a repeated-phrase prompt shape when spec is on: lookup drafting
-    exists for exactly that traffic."""
+    exists for exactly that traffic.
+
+    ``async_loop`` (ISSUE 20): the double-buffered plan/commit split —
+    iteration i+1's host work runs while iteration i's device step is
+    in flight. bench.py races it against the sync rung in the same
+    window: ``serve_host_bubble_frac`` must come out strictly LOWER
+    async (that is the whole point of the split) at exact token
+    parity."""
     import jax
     import jax.random as jrandom
 
@@ -1619,7 +1812,7 @@ def serving_bench_rung(n_streams: int = 8, prompt_len: int = 128,
     engine = Engine(cfg, params, ctx1, backend=backend, max_seq=512,
                     page_size=page_size, kv_dtype=kv_dtype)
     se = ServingEngine(engine, max_batch=n_streams, prefill_chunk=128,
-                       spec_k=spec_k)
+                       spec_k=spec_k, async_loop=async_loop)
     if backend == "megakernel" and se._mk is None:
         # The rung exists to price the persistent lane; silently racing
         # a demoted dense loop would mislabel the ledger row.
@@ -1763,6 +1956,101 @@ def warm_serving_bench_rung(n_streams: int = 8, prompt_len: int = 128,
         "serve_warm_comm": "none (n=1 shard; prefix-cache warm replay "
                            "— shared 128-token preambles resident, "
                            "only divergent tails prefill)",
+    }
+
+
+def kvtier_serving_bench_rung(n_streams: int = 8, prompt_len: int = 128,
+                              max_new: int = 16, *,
+                              page_size: int = 64) -> dict:
+    """The host KV-tier rung (ISSUE 20, docs/serving.md "KV tiering"):
+    the warm rung's shared-prefix workload over a device pool sized so
+    a burst of COLD traffic evicts the cached family chains — with a
+    host budget attached, the eviction SWAPS them to pinned host
+    buffers instead of dropping them. The measured replay then admits
+    warm off the HOST tier: every warm TTFT includes the checksummed
+    host→device restore stream, and that p99
+    (``serve_ttft_p99_ms_swapin``) raced against the device-resident
+    warm rung's ``serve_ttft_p99_ms_warm`` in the same window is what
+    the tier costs — against ``serve_ttft_p99_ms`` (cold) it is what
+    the tier buys. ``kv_host_restore_ms`` is the per-restore p99."""
+    import jax
+    import jax.random as jrandom
+
+    from triton_distributed_tpu.models import Engine
+    from triton_distributed_tpu.models.dense import init_dense_llm
+    from triton_distributed_tpu.runtime import initialize_distributed
+    from triton_distributed_tpu.serving.loop import ServingEngine
+
+    cfg = _bench_shard_config()
+    params = init_dense_llm(jrandom.PRNGKey(0), cfg)
+    ctx1 = initialize_distributed(mesh_shape=(1,), axis_names=("tp",),
+                                  devices=jax.devices()[:1])
+    engine = Engine(cfg, params, ctx1, backend="xla", max_seq=512,
+                    page_size=page_size)
+    # Pool sizing: 8 concurrent 144-token requests need 24 pages; 28
+    # leaves too little slack to ALSO keep the finished family chains
+    # device-resident through the cold burst — reclaim must swap them.
+    se = ServingEngine(engine, max_batch=n_streams, num_pages=28,
+                       prefill_chunk=128, prefix_cache=True,
+                       kv_host_budget_bytes=4 << 30)
+    if se.kvtier is None:
+        raise RuntimeError("host KV tier did not attach — rung not "
+                           "measurable")
+    restore_ms: list[float] = []
+    orig_restore = se._kvtier_restore
+
+    def timed_restore(req, n_restore, _o=orig_restore):
+        t0 = time.perf_counter()
+        out = _o(req, n_restore)
+        restore_ms.append((time.perf_counter() - t0) * 1e3)
+        return out
+
+    se._kvtier_restore = timed_restore
+
+    def make_warm_trace(seed: int) -> list[dict]:
+        # Same family discipline as the warm rung: fixed prefix_seed,
+        # page-aligned 128-token preambles, divergent tails.
+        spec = LoadSpec(n_requests=n_streams, seed=seed,
+                        prompt_len=(max(1, prompt_len - 128),
+                                    max(1, prompt_len - 128)),
+                        max_new=(max_new, max_new),
+                        mean_interarrival_iters=0.0, vocab=cfg.vocab_size,
+                        prefix_families=2, prefix_len=128)
+        return build_trace(spec)
+
+    def make_cold_trace(seed: int) -> list[dict]:
+        spec = LoadSpec(n_requests=n_streams, seed=seed,
+                        prompt_len=(prompt_len, prompt_len),
+                        max_new=(max_new, max_new),
+                        mean_interarrival_iters=0.0, vocab=cfg.vocab_size)
+        return build_trace(spec)
+
+    run_trace(se, make_warm_trace(0))     # warmup: compile + index
+    run_trace(se, make_cold_trace(7))     # cold burst: force swap-out
+    if se.kvtier.swap_outs < 1:
+        raise RuntimeError(
+            "cold burst swapped no chain to host — the pool sizing no "
+            "longer forces eviction; rung not measurable")
+    restore_ms.clear()
+    report = run_trace(se, make_warm_trace(1))   # host-warm measurement
+    reqs = report.pop("requests")
+    swapin = sorted(r.ttft_s * 1e3 for r in reqs
+                    if r.restored_tokens_total > 0 and r.ttft_s is not None)
+    if not swapin or not restore_ms:
+        raise RuntimeError(
+            "no measurement request restored from the host tier — the "
+            "rung would mislabel a device-warm run as swap-in TTFT")
+    from triton_distributed_tpu.obs.metrics import percentile
+    return {
+        "serve_ttft_p99_ms_swapin": round(percentile(swapin, 99), 3),
+        "kv_host_restore_ms": round(percentile(restore_ms, 99), 3),
+        "serve_swapin_requests": len(swapin),
+        "kv_host_swap_outs": se.kvtier.swap_outs,
+        "kv_host_restores": se.kvtier.restores,
+        "serve_swapin_comm": (
+            "none (n=1 shard; warm admissions restore evicted family "
+            "chains from pinned host RAM through the checksummed "
+            "double-buffered stream — restore cost is IN the TTFT)"),
     }
 
 

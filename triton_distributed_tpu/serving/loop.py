@@ -165,6 +165,29 @@ class ServingEngine:
         token-identical to cold serve (tests + loadgen dryrun phase 10
         pin it). False (default) keeps every pre-prefix path
         byte-identical.
+      kv_host_budget_bytes: host-RAM budget for the second-chance KV
+        tier (ISSUE 20, serving/kvtier.py; requires ``prefix_cache``).
+        When > 0, a prefix chain the refcount×recency eviction would
+        physically free is first swapped to host RAM (at stored pool
+        width, checksum-stamped), and a later admission whose prompt
+        re-walks the chain streams it back through the disagg
+        MigrationStream transport shape instead of re-prefilling —
+        zero cold-prefill tokens for the restored positions, byte-exact
+        parity with a never-evicted run. Default None reads
+        ``TDTPU_KV_HOST_BUDGET_BYTES`` (0 = tier off, every pre-tier
+        path byte-identical).
+      async_loop: split each iteration into PLAN (pure host: admission,
+        radix match, drafts, page ops, table builds) and COMMIT (block
+        on the PREVIOUS iteration's decode launch), so iteration i+1's
+        host planning overlaps iteration i's device step (ISSUE 20,
+        ROADMAP item 3(ii) — the host bubble stepprof measures).
+        Token-exact vs the synchronous loop: greedy per-request streams
+        are batching-invariant, and the functional (donated-jit) pool
+        threading means any host-side page mutation for i+1 is ordered
+        after the in-flight launch's reads by XLA data dependence — the
+        COW guard + ``note_launch`` discipline stay the hazard set.
+        Default None reads ``TDTPU_ASYNC_LOOP`` (0 = synchronous,
+        byte-identical to today).
     """
 
     def __init__(self, engine: Engine, *, max_batch: int = 4,
@@ -174,7 +197,9 @@ class ServingEngine:
                  max_waiting: int = 64, slo_cfg=None, slo_every: int = 1,
                  fleet=None, clock=time.perf_counter, spec_k: int = 0,
                  prefix_cache: bool = False, metrics_registry=None,
-                 replica_id: str | int | None = None):
+                 replica_id: str | int | None = None,
+                 kv_host_budget_bytes: int | None = None,
+                 async_loop: bool | None = None):
         if engine.page_size is None:
             raise ServingConfigError(
                 "engine has no paged cache: construct Engine(page_size=...) "
@@ -317,10 +342,29 @@ class ServingEngine:
         # the allocator's reclaim hooks, so admission and page growth
         # treat cold cached chains as evictable capacity.
         self.prefix = None
+        self.kvtier = None
+        self._kvtier_chaos = None       # chaos hook for restore streams
         if prefix_cache:
             from triton_distributed_tpu.serving.prefix import PrefixCache
 
             self.prefix = PrefixCache(allocator, page)
+            # Host-RAM KV tier (ISSUE 20, serving/kvtier.py): a
+            # second-chance store behind the radix cache's eviction —
+            # chains the refcount×recency reclaim would physically free
+            # are swapped to pinned host buffers at stored width and
+            # streamed back on a later radix hit. Off unless a budget is
+            # configured, keeping every pre-tier path byte-identical.
+            from triton_distributed_tpu.serving.kvtier import (
+                HostKVTier, host_kv_budget_bytes,
+            )
+
+            budget = (host_kv_budget_bytes() if kv_host_budget_bytes is None
+                      else int(kv_host_budget_bytes))
+            tier = HostKVTier(budget, page_size=page,
+                              fetch=self._kvtier_fetch)
+            if tier.enabled:
+                self.kvtier = tier
+                self.prefix.attach_host_tier(tier)
         self.sched = Scheduler(
             num_slots=max_batch,
             allocator=allocator,
@@ -329,6 +373,13 @@ class ServingEngine:
             prefix=self.prefix)
         self._jits: dict = {}
         self._jits_backend = engine.backend
+        # Async double-buffered loop (ISSUE 20): when on, each decode
+        # dispatch is stashed instead of awaited, and the NEXT
+        # iteration's commit point (after its host planning) blocks on
+        # it — ``_pending`` is the one in-flight launch.
+        self.async_loop = (bool(_env_int("TDTPU_ASYNC_LOOP", 0))
+                           if async_loop is None else bool(async_loop))
+        self._pending: dict | None = None
         self.slo_every = max(1, int(slo_every))
         self._iter = 0
         self._t0: float | None = None
@@ -565,6 +616,126 @@ class ServingEngine:
             self._jits[key] = self._first_call(
                 key, jax.jit(fn, donate_argnums=(0,)), "prefix_gather")
         return self._jits[key]
+
+    # -- host-RAM KV tier (ISSUE 20, serving/kvtier.py) ----------------------
+    def _kvtier_fetch(self, page: int):
+        """One pool page's (k, v) bytes as host arrays at STORED width —
+        the tier's swap-out reader. Cache-only pages (refcount 1, held
+        by the radix index alone) are never an in-flight launch's append
+        target, so the device→host copy reads settled bytes; fp8 pools
+        swap at fp8 width (the gather dequantizes on restore exactly as
+        it would have from the device page)."""
+        return (np.asarray(self._cache.k_pools[:, page]),
+                np.asarray(self._cache.v_pools[:, page]))
+
+    def _kvtier_fill_jit(self):
+        """One restored host chunk → the prefill buffer at its token
+        offset. The buffer (not the pool) is the restore target: the
+        completion scatter then lands restored positions in the
+        request's OWN fresh pages through the same saturating-cast
+        write path as recomputed tokens — no second pool-write path to
+        keep megakernel workspaces or fp8 quantization in sync with."""
+        key = "kvtier_fill"
+        if key not in self._jits:
+            eng = self.engine
+
+            def step(pf, k, v, start):
+                k = k.astype(pf.k.dtype)
+                v = v.astype(pf.v.dtype)
+                return pf._replace(
+                    k=jax.lax.dynamic_update_slice(
+                        pf.k, k, (0, 0, start, 0, 0)),
+                    v=jax.lax.dynamic_update_slice(
+                        pf.v, v, (0, 0, start, 0, 0)))
+
+            kv_spec = kv_cache_specs(eng.shard_axes)
+            fn = eng._shard(
+                step, in_specs=(kv_spec, kv_spec.k, kv_spec.v, P()),
+                out_specs=kv_spec)
+            self._jits[key] = self._first_call(
+                key, jax.jit(fn, donate_argnums=(0,)), "kvtier_fill")
+        return self._jits[key]
+
+    def _kvtier_restore(self, req: Request, n_restore: int) -> None:
+        """Stream a warm admission's host-resident chain back into the
+        prefill buffer through the disagg double-buffer transport shape
+        (MigrationStream pointed at host memory): H2D for chunk i+1
+        overlaps the buffer fill for chunk i, every landing re-verified
+        against the checksum stamped at swap-out. Any failure raises the
+        named TRANSIENT migration-error family — the prefill-fault path
+        preempts for a cold recompute, and the failed chain is dropped
+        from the tier FIRST so the resume cannot walk back into the same
+        failure. Tokens are never wrong, only slower."""
+        from triton_distributed_tpu.disagg.migrate import MigrationStream
+
+        keys = list(req._kvtier_pending)
+        req._kvtier_pending = []
+        tier = self.kvtier
+        if tier is None or n_restore <= 0 or not keys:
+            return
+        n_restore = min(n_restore, len(keys))
+        eng = self.engine
+        device_hit = req.prefix_hit_tokens - req.restored_tokens
+        first_page = device_hit // self.page
+        kv_spec = kv_cache_specs(eng.shard_axes)
+        fill = self._kvtier_fill_jit()
+        t0 = self.clock()
+        try:
+            blocks = []
+            for i in range(n_restore):
+                k, v = tier.chunk(keys[i], chunk_idx=i)
+                # (L, 1, page, hkv, d): the prefill buffer's own layout,
+                # so the staged device block shards like a slice write.
+                blocks.append((k[:, None], v[:, None]))
+            dst = [first_page + i for i in range(n_restore)]
+
+            def put(kv):
+                return self._put_sharded(kv, (kv_spec.k, kv_spec.v))
+
+            def land(idx, kv, pages):
+                self._pf_set(req, fill(
+                    self._pf_get(req), kv[0], kv[1],
+                    jnp.int32(int(pages[0]) * self.page)))
+
+            stream = MigrationStream(
+                req.req_id, blocks, [[d] for d in dst], put,
+                clock=self.clock, chaos_hook=self._kvtier_chaos)
+            with obs_trace.span("serving.kvtier_restore",
+                                req=req.req_id, pages=n_restore):
+                while not stream.advance(land):
+                    pass
+        except Exception as exc:
+            from triton_distributed_tpu import resilience
+
+            if resilience.is_transient(exc):
+                tier.restore_failures += 1
+                tier.drop_chain(keys)
+            raise
+        restored = n_restore * self.page
+        pool_pages = self.sched.allocator.pages(req.req_id)
+        for d in dst:
+            if d < len(pool_pages):
+                self.sched.allocator.note_swap("swap_in", pool_pages[d])
+        tier.note_restored(n_restore)
+        req.restored_tokens_total += restored
+        gl = obs_goodput.get_ledger()
+        if gl is not None and gl.active():
+            # Host→device transport rows are pure overhead (ISSUE 19);
+            # the restored POSITIONS themselves are the gather restart's
+            # prefill_saved credit, same as a device-resident hit.
+            gl.dispatch(restored)
+            gl.add("overhead", restored)
+        rt = obs_reqtrace.get_tracer()
+        if rt is not None:
+            rt.span(req.req_id, "kvtier_restore", t0, self.clock(),
+                    pages=n_restore, tokens=restored)
+        if self._observing():
+            self._reg().histogram(
+                obs_metrics.KV_HOST_RESTORE_MS,
+                "one warm admission's whole host-chain restore (host "
+                "RAM -> prefill buffer), ms",
+                buckets=obs_metrics.MIGRATE_BUCKETS_MS,
+            ).observe((self.clock() - t0) * 1e3)
 
     def _copy_page_jit(self):
         """One pool-page copy — the copy half of copy-on-write: the
@@ -857,6 +1028,7 @@ class ServingEngine:
                 # the persistent backend: in-flight decode state lives in
                 # the megakernel pools, so running sequences recompute
                 # through the dense path (preempt-resume).
+                self._abort_pending()
                 self._mk = None
                 self._mk_ws = None
                 for req in list(self.sched.running()):
@@ -872,6 +1044,7 @@ class ServingEngine:
                 except BackendUnsupportedError as exc:
                     self._demote_backend(str(exc))
                 else:
+                    self._abort_pending()
                     if self.prefix is not None:
                         # The re-promoted lane starts a FRESH paged
                         # workspace: indexed chains are not resident in
@@ -901,6 +1074,14 @@ class ServingEngine:
         # monolithic tier has nothing to move.
         with obs_stepprof.phase("migrate"):
             self._advance_migrations()
+        # Async commit point (ISSUE 20): the host planning above (admit,
+        # radix match, prefill-slice setup, migration rotation) ran while
+        # LAST iteration's decode launch was still in flight; only now
+        # does the loop block on its tokens and run the tail bookkeeping.
+        # Draft/pages/cow stay below the commit because they read kv_len
+        # and token tails the commit advances.
+        if self.async_loop:
+            self._commit_pending()
         # Speculative drafting happens BEFORE page growth so the whole
         # candidate window's reservation rides the same growth pass
         # (preempted victims drop their drafts with their pages).
@@ -1370,6 +1551,7 @@ class ServingEngine:
         TTFT evidence. ``evacuation=True`` (the survivor-mesh path only)
         stamps ``req.evacuations`` — the record flag must not fire for a
         rejoin probe or a sub-threshold transient-fault rebuild."""
+        self._abort_pending()
         evicted = list(self.sched.active)
         for req in evicted:
             self.sched._preempt(req)
@@ -1382,6 +1564,7 @@ class ServingEngine:
         """Fresh KV pools + prefill buffer on the engine's CURRENT mesh
         and a cleared jit cache — the serving-side half of a
         repartition (jits rebuild lazily through ``_first_call``)."""
+        self._abort_pending()
         eng = self.engine
         cache = init_paged_model_cache(
             self.cfg, self.max_batch, page_size=self.page,
@@ -1651,10 +1834,19 @@ class ServingEngine:
         grid): tokens between the aligned restart and the token-granular
         hit recompute into the buffer — identical values by content
         addressing, so the COW'd boundary page's merged content is
-        exact either way."""
+        exact either way.
+
+        Host-tier extension (ISSUE 20): when part of the hit lives in
+        host RAM (``req.restored_tokens``), only the device-shared
+        prefix gathers from the pool; the host-resident chunks stream
+        into the buffer right after it via :meth:`_kvtier_restore`.
+        Both land in the same linear buffer the suffix slices attend,
+        so the downstream math cannot tell a restored position from a
+        device-resident one — that is the parity argument."""
         hit = req.prefix_hit_tokens
         restart = hit - hit % self.chunk
-        n_gather = restart // self.page
+        device_hit = hit - req.restored_tokens
+        n_gather = min(restart, device_hit) // self.page
         t0 = self.clock()
         if n_gather:
             pages = self.sched.allocator.pages(req.req_id)[:n_gather]
@@ -1662,6 +1854,12 @@ class ServingEngine:
                 self._pf_get(req), self._cache,
                 jnp.asarray(pages, jnp.int32))
             self._pf_set(req, buf)
+        if req._kvtier_pending:
+            # Chunk-aligned restarts can strand trailing host chunks
+            # (they stay resident in the tier); restore only the pages
+            # the restart actually skips past.
+            self._kvtier_restore(
+                req, max(0, restart - device_hit) // self.page)
         req.prefill_pos = restart
         with obs_trace.span("serving.prefix_hit", req=req.req_id,
                             hit_tokens=hit, restart=restart):
@@ -1699,7 +1897,12 @@ class ServingEngine:
         pages = self.sched.allocator.pages(req.req_id)[:n_pages]
         skip = 0
         if self.prefix is not None and req.prefix_hit_tokens > 0:
-            skip = req.prefix_hit_tokens // self.page
+            # Device-SHARED pages only: host-restored positions sit in
+            # the buffer like recomputed tokens and scatter into this
+            # request's own fresh pages below (re-indexing them makes
+            # the chain device-resident again for the next admission).
+            skip = (req.prefix_hit_tokens - req.restored_tokens) \
+                // self.page
             if req._prefix_partial is not None:
                 # The merged content lands in the private replacement;
                 # the read hold on the shared boundary page drops.
@@ -1801,6 +2004,11 @@ class ServingEngine:
         with obs_trace.span("serving.decode_step", batch=len(ready)):
             with obs_stepprof.phase("decode_dispatch"):
                 tok, self._cache = eng._decode_run(jnp.asarray(toks), cache)
+            if self.async_loop:
+                self._stash_pending("dense", ready, tok, t0,
+                                    cold=eng._jit_compiled_last_call,
+                                    rows=self.max_batch)
+                return
             with obs_stepprof.phase("device_wait"):
                 tok_np = np.asarray(tok)    # host sync: the loop needs them
         gl = obs_goodput.get_ledger()
@@ -1819,6 +2027,7 @@ class ServingEngine:
         recompute the in-flight batch through the dense path — their
         decode-time KV lived in the megakernel pools, so
         recompute-on-resume is the only state-correct hand-off."""
+        self._abort_pending()
         self._demote_backend(
             f"megakernel decode failed: {type(exc).__name__}: "
             f"{str(exc)[:120]}")
@@ -1852,6 +2061,11 @@ class ServingEngine:
                 # own ``retarget`` slice out of this phase.
                 self._mk_ws, tok = self._mk.step(self._mk_ws, toks, lens,
                                                  table)
+            if self.async_loop:
+                self._stash_pending("mk", ready, tok, t0,
+                                    cold=self._mk.last_step_cold,
+                                    rows=self._mk.last_step_rows)
+                return
             with obs_stepprof.phase("device_wait"):
                 tok_np = np.asarray(tok)  # host sync: the loop needs them
         gl = obs_goodput.get_ledger()
@@ -1905,6 +2119,12 @@ class ServingEngine:
                     with obs_stepprof.phase("decode_dispatch"):
                         self._mk_ws, ver = self._mk.step(
                             self._mk_ws, toks, lens, table, wins)
+                    if self.async_loop:
+                        self._stash_pending(
+                            "mk_spec", ready, ver, t0,
+                            cold=self._mk.last_step_cold,
+                            rows=self._mk.last_step_rows, drafts=drafts)
+                        return
                     with obs_stepprof.phase("device_wait"):
                         ver_np = np.asarray(ver)
             except Exception as exc:
@@ -1928,6 +2148,11 @@ class ServingEngine:
                 with obs_stepprof.phase("decode_dispatch"):
                     ver, self._cache = self._verify_jit()(
                         eng.params, jnp.asarray(toks), cache)
+                if self.async_loop:
+                    self._stash_pending("spec", ready, ver, t0,
+                                        cold=eng._jit_compiled_last_call,
+                                        rows=None, drafts=drafts)
+                    return
                 with obs_stepprof.phase("device_wait"):
                     ver_np = np.asarray(ver)
         except Exception as exc:
@@ -2061,6 +2286,98 @@ class ServingEngine:
                 if req.done:
                     self._finish(req)
 
+    # -- async double-buffered loop (ISSUE 20) --------------------------------
+    def _stash_pending(self, kind: str, ready: list[Request], out, t0,
+                       *, cold: bool, rows, drafts=None) -> None:
+        """Park a dispatched decode/verify launch for the NEXT
+        iteration's commit point instead of blocking on it here. The
+        launch's outputs (tokens + the already-threaded pool state) are
+        device futures; every host-side plan step that runs before the
+        commit either touches host structures only or issues jits whose
+        operands are the launch's OUTPUT pools — XLA data dependence is
+        the fence. The stepprof overlap window opens now: host time
+        until the commit closes it is overlap, not bubble."""
+        self._pending = {"kind": kind, "ready": list(ready), "out": out,
+                         "t0": t0, "cold": bool(cold), "rows": rows,
+                         "drafts": drafts}
+        sp = obs_stepprof.get_profiler()
+        if sp is not None and sp.active():
+            sp.overlap_begin(self.clock())
+
+    def _abort_pending(self) -> None:
+        """Drop the in-flight launch without committing its tokens —
+        every caller (evacuation, device-state rebuild, backend switch)
+        preempts the affected requests, so recompute-on-resume replays
+        the same greedy stream and parity holds; the launch's tokens
+        are simply never observed."""
+        if self._pending is None:
+            return
+        self._pending = None
+        sp = obs_stepprof.get_profiler()
+        if sp is not None and sp.active():
+            sp.overlap_end(self.clock())
+
+    def _commit_pending(self) -> None:
+        """The async loop's commit point: block on the decode/verify
+        launch stashed LAST iteration and run the tail bookkeeping the
+        synchronous loop ran inline. Requests that left RUNNING since
+        the dispatch (backend switch, evacuation already abort the whole
+        launch; a mid-flight migrate preemption only sheds its own row)
+        are dropped — their rows are computed-but-unobserved, exactly a
+        sync preemption's waste shape. Failures route by launch kind:
+        megakernel faults demote, dense verify faults disable the spec
+        lane, dense decode faults go to the fleet machinery — the same
+        triage the sync loop does at dispatch."""
+        pend = self._pending
+        if pend is None:
+            return
+        self._pending = None
+        sp = obs_stepprof.get_profiler()
+        if sp is not None and sp.active():
+            # Close the overlap window BEFORE blocking: the wait itself
+            # is device time, not overlapped host work.
+            sp.overlap_end(self.clock())
+        kind = pend["kind"]
+        try:
+            with obs_stepprof.phase("device_wait"):
+                out_np = np.asarray(pend["out"])
+        except Exception as exc:
+            from triton_distributed_tpu import resilience
+            from triton_distributed_tpu.resilience import fleet as fleet_mod
+
+            if not resilience.is_transient(exc):
+                raise
+            alive = [r for r in pend["ready"]
+                     if r.state is RequestState.RUNNING]
+            if kind in ("mk", "mk_spec"):
+                self._mk_decode_failed(alive, exc)
+                return
+            if (kind == "spec"
+                    and fleet_mod.attribute_rank(exc) is None
+                    and os.environ.get("TDTPU_DEMOTION_LADDER", "1")
+                    != "0"):
+                self._spec_disable(
+                    f"verify step failed at commit: "
+                    f"{type(exc).__name__}: {str(exc)[:120]}")
+                return
+            # Dense decode (or rank-attributable) transients are the
+            # step()-level fleet machinery's to judge, same as sync.
+            raise
+        alive = [r for r in pend["ready"]
+                 if r.state is RequestState.RUNNING]
+        if kind in ("spec", "mk_spec"):
+            self._spec_tail(alive, pend["drafts"], out_np, pend["t0"],
+                            pend["cold"])
+            return
+        gl = obs_goodput.get_ledger()
+        if gl is not None and gl.active():
+            gl.dispatch(pend["rows"])
+            gl.add("useful", len(alive))
+            gl.add("idle", pend["rows"] - len(alive))
+        self._decode_tail(
+            alive, {r.req_id: [int(out_np[r.slot])] for r in alive},
+            pend["t0"], pend["cold"])
+
     def _publish_gauges(self, reg) -> None:
         reg.gauge(obs_metrics.SERVE_QUEUE_DEPTH,
                   "requests waiting for admission"
@@ -2100,6 +2417,41 @@ class ServingEngine:
                 obs_metrics.PREFIX_HIT_RATE,
                 "cumulative warm-admission fraction (prefix-index hits "
                 "/ lookups)").set(self.prefix.hit_rate())
+        # Host-tier lane (ISSUE 20): published UNCONDITIONALLY so every
+        # observed serving run carries the series (zeros when no tier is
+        # configured) — the report's kv-tier gate keys on presence, and
+        # absence should mean "pre-tier run dir", not "tier off".
+        tier = self.kvtier
+        reg.gauge(obs_metrics.KV_HOST_PAGES,
+                  "prefix-chain pages resident in the host-RAM KV tier"
+                  ).set(tier.pages if tier is not None else 0)
+        reg.gauge(obs_metrics.KV_HOST_BYTES,
+                  "host-RAM bytes the KV tier holds (bounded by "
+                  "TDTPU_KV_HOST_BUDGET_BYTES)"
+                  ).set(tier.bytes_held if tier is not None else 0)
+        for name, help_, cur in (
+                (obs_metrics.KV_HOST_SWAPOUTS,
+                 "evicted prefix-chain pages swapped to host RAM "
+                 "instead of physically freed",
+                 tier.swap_outs if tier is not None else 0),
+                (obs_metrics.KV_HOST_RESTORES,
+                 "host-tier pages streamed back into the prefill path "
+                 "on warm admissions (swap-ins)",
+                 tier.restores if tier is not None else 0),
+                (obs_metrics.KV_HOST_EVICTIONS,
+                 "chunks the host tier's own LRU dropped to stay "
+                 "inside its byte budget",
+                 tier.host_evictions if tier is not None else 0),
+                (obs_metrics.KV_HOST_RESTORE_FAILURES,
+                 "chain restores that failed in a named way "
+                 "(checksum / transport) and fell back to cold prefill",
+                 tier.restore_failures if tier is not None else 0)):
+            # Reconcile the counter to the tier's own stats: swap-outs
+            # happen inside the allocator's reclaim hook where no
+            # registry is in scope, so event sites cannot inc directly.
+            c = reg.counter(name, help_)
+            if cur > c.value:
+                c.inc(cur - c.value)
         if self.fleet is not None:
             self._publish_fleet_gauges(reg)
 

@@ -114,8 +114,24 @@ class PrefixCache:
         # invalidate). Must never cost a serve: failures propagate to
         # the subscriber, not swallowed here.
         self.on_event = None
+        # Host-RAM second tier (ISSUE 20): when set, reclaim() copies
+        # each cache-only chunk to host RAM before the decref that
+        # physically frees it — see attach_host_tier().
+        self.host_tier = None
         allocator.reclaim = self.reclaim
         allocator.reclaimable = self.reclaimable
+
+    def attach_host_tier(self, tier) -> None:
+        """Install a :class:`~triton_distributed_tpu.serving.kvtier.
+        HostKVTier`: evicted cache-only chunks are swapped to host RAM
+        (at stored width, checksum-stamped) instead of dying with the
+        decref, and the serving loop restores them on a later radix
+        hit. The tier's entries are content-addressed by full token
+        chains, so they stay valid across device page reuse — but NOT
+        across :meth:`invalidate` (which clears the tier too: a device
+        rebuild may change mesh geometry, and restored bytes must be
+        bit-exact with what a cold prefill would produce)."""
+        self.host_tier = tier
 
     def note_peak(self) -> int:
         """Sample the live shared-page count into the peak stat (the
@@ -293,20 +309,26 @@ class PrefixCache:
         self.allocator.decref(page)
 
     # -- eviction ------------------------------------------------------------
-    def _evictable(self) -> list[tuple[int, _Node, _Node, tuple]]:
-        """(last_use, node, parent, key) for every LEAF whose page only
-        the cache holds (refcount == 1): releasing anything else either
-        frees nothing (live sharers) or breaks a deeper chain."""
+    def _evictable(self) -> list[tuple[int, _Node, _Node, tuple, tuple]]:
+        """(last_use, node, parent, key, chain) for every LEAF whose
+        page only the cache holds (refcount == 1): releasing anything
+        else either frees nothing (live sharers) or breaks a deeper
+        chain. ``chain`` is the full token prefix through the leaf —
+        the host tier's content address for the chunk (eviction is
+        leaf-first, so deep chunks swap out first and the tier's
+        chunk-by-chunk walk re-assembles chains from any device-resident
+        boundary)."""
         out = []
 
-        def walk(parent):
+        def walk(parent, prefix):
             for key, node in parent.children.items():
+                chain = prefix + key
                 if node.children:
-                    walk(node)
+                    walk(node, chain)
                 elif self.allocator.ref_count(node.page) == 1:
-                    out.append((node.last_use, node, parent, key))
+                    out.append((node.last_use, node, parent, key, chain))
 
-        walk(self._root)
+        walk(self._root, ())
         return out
 
     def reclaim(self, n: int) -> int:
@@ -322,9 +344,17 @@ class PrefixCache:
             if not cands:
                 break
             cands.sort(key=lambda c: c[0])
-            for _, node, parent, key in cands:
+            for _, node, parent, key, chain in cands:
                 if freed >= n:
                     break
+                if self.host_tier is not None:
+                    # Second chance BEFORE the decref frees the bytes:
+                    # the fetch must read the pool page while the cache
+                    # still owns it. A refused swap (tier disabled,
+                    # over-budget chunk) just means the chunk dies the
+                    # old way.
+                    if self.host_tier.swap_out(chain, node.page):
+                        self.allocator.note_swap("swap_out", node.page)
                 del parent.children[key]
                 self._tree_epoch += 1
                 self._pages.discard(node.page)
@@ -357,6 +387,11 @@ class PrefixCache:
         self._root = _Node(-1, self._clock)
         self._tree_epoch += 1
         self._walk_memo = None
+        if self.host_tier is not None:
+            # Host copies predate whatever forced the invalidation
+            # (mesh-geometry change, fresh workspace) — restoring them
+            # could break bit-exact parity with a cold prefill.
+            self.host_tier.clear()
         if self.on_event is not None:
             self.on_event("invalidate", None)
         return released

@@ -94,6 +94,17 @@ class Request:
     # request_records carries.
     prefix_hit_tokens: int = 0       # this admission's hit (reset on preempt)
     prefix_hit_tokens_total: int = 0
+    # Host-tier lane (ISSUE 20, serving/kvtier.py): tokens of THIS
+    # admission's hit that live in host RAM rather than device pages —
+    # a subset of prefix_hit_tokens; the serving loop streams their
+    # chunks back into the prefill buffer before the gather. The
+    # cumulative total is the per-request swap-in evidence
+    # request_records carries.
+    restored_tokens: int = 0         # this admission (reset on preempt)
+    restored_tokens_total: int = 0   # chunks that actually streamed back
+    # Host-tier chain keys awaiting restore for this admission (set by
+    # the scheduler, consumed by the loop's _kvtier_restore).
+    _kvtier_pending: list = dataclasses.field(default_factory=list)
     # Goodput / waste-attribution lane (ISSUE 19, obs/goodput.py): the
     # per-request halves of the work ledger's recompute/spec_rejected
     # categories — loadgen's request_records reconcile their sums

@@ -176,6 +176,18 @@ class Scheduler:
             hit, full, partial = (self.prefix.match(req.text)
                                   if self.prefix is not None
                                   else (0, [], None))
+            # Host-tier extension (ISSUE 20): chunks evicted to host RAM
+            # can extend a page-aligned device hit — the chain keys are
+            # noted here and the serving loop streams their bytes into
+            # the prefill buffer before the gather. The restored
+            # positions still allocate FRESH device pages (they are part
+            # of the suffix reservation below), so the page budget is
+            # unchanged; only the prefill compute is skipped. A partial
+            # tail match already covers more positions than the aligned
+            # tier walk could, so the two are mutually exclusive.
+            tier = self.prefix.host_tier if self.prefix is not None else None
+            tier_keys = (tier.match(req.text, hit)
+                         if tier is not None and partial is None else [])
             if partial is not None:
                 # Pin BEFORE the suffix allocation: a cold (cache-only)
                 # partially-matched page is otherwise evictable by the
@@ -194,12 +206,17 @@ class Scheduler:
                 if full:
                     self.allocator.free_pages(req.req_id)
                 break                # pool short: stays queued
-            req.prefix_hit_tokens = hit
-            if hit:
-                req.prefix_hit_tokens_total += hit
+            restored = len(tier_keys) * self.page_size
+            req.prefix_hit_tokens = hit + restored
+            req.restored_tokens = restored
+            req._kvtier_pending = list(tier_keys)
+            if req.prefix_hit_tokens:
+                req.prefix_hit_tokens_total += req.prefix_hit_tokens
             if self.prefix is not None:
                 # Stats + recency move only on the COMMITTED admission
                 # (match is a read-only probe — see PrefixCache.match).
+                # DEVICE hit only: host-tier recency moves when the
+                # chunks actually restore.
                 self.prefix.commit_match(req.text, hit)
             if partial is not None:
                 req._prefix_partial = partial
@@ -232,6 +249,8 @@ class Scheduler:
             self.prefix.unpin(req._prefix_partial)
             req._prefix_partial = None
         req.prefix_hit_tokens = 0    # re-admission re-matches the index
+        req.restored_tokens = 0      # host-tier chunks re-match too
+        req._kvtier_pending = []
         if req.slot is not None:
             self._free_slots.add(req.slot)
         req.slot = None
